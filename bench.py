@@ -1,0 +1,99 @@
+"""
+Benchmark: KMeans iterations/sec/chip (the BASELINE.json north-star workload —
+reference benchmarks/kmeans/, SURVEY.md §3.4/§6).
+
+Runs the jitted Lloyd iteration (heat_tpu.cluster.kmeans._kmeans_step: one MXU GEMM
+for assignment + one for the masked centroid update) on synthetic Gaussian blobs on
+the available accelerator and prints ONE JSON line.
+
+``vs_baseline``: the reference (marianna13/heat) delegates all local compute to
+PyTorch and cannot run here (no mpi4py in this image), so the baseline is the same
+Lloyd iteration implemented on the reference's compute engine — torch on CPU, single
+process (exactly what `mpirun -np 1 benchmarks/kmeans/heat-cpu.py` measures up to MPI
+constants). vs_baseline = (our iters/sec) / (torch-CPU iters/sec).
+"""
+
+import json
+import time
+
+import numpy as np
+
+N, F, K = 1_048_576, 32, 8
+ITERS = 30
+
+
+def _data(rng):
+    centers = rng.normal(scale=5.0, size=(K, F)).astype(np.float32)
+    labels = rng.integers(0, K, size=N)
+    return centers[labels] + rng.normal(scale=0.5, size=(N, F)).astype(np.float32)
+
+
+def bench_tpu(data_np):
+    import jax
+    import jax.numpy as jnp
+
+    from heat_tpu.cluster.kmeans import _kmeans_step
+
+    dev = jax.devices()[0]
+    x = jax.device_put(jnp.asarray(data_np), dev)
+    centers = x[:K]
+    # compile + warmup
+    centers_w, *_ = _kmeans_step(x, centers)
+    jax.block_until_ready(centers_w)
+    t0 = time.perf_counter()
+    c = centers
+    for _ in range(ITERS):
+        c, _, _, _ = _kmeans_step(x, c)
+    jax.block_until_ready(c)
+    dt = time.perf_counter() - t0
+    return ITERS / dt, str(dev)
+
+
+def bench_torch_cpu(data_np, iters=3):
+    import torch
+
+    x = torch.from_numpy(data_np)
+    c = x[:K].clone()
+    # one warmup
+    def step(x, c):
+        # same quadratic-expansion formulation as the TPU path (fair GEMM-based compare)
+        d2 = (x * x).sum(1, keepdim=True) - 2.0 * (x @ c.T) + (c * c).sum(1)[None, :]
+        labels = torch.argmin(d2, dim=1)
+        onehot = torch.nn.functional.one_hot(labels, K).to(x.dtype)
+        counts = onehot.sum(0)
+        sums = onehot.T @ x
+        return torch.where(counts[:, None] > 0, sums / counts.clamp(min=1)[:, None], c)
+
+    step(x, c)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        c = step(x, c)
+    dt = time.perf_counter() - t0
+    return iters / dt
+
+
+def main():
+    rng = np.random.default_rng(0)
+    data = _data(rng)
+    tpu_ips, device = bench_tpu(data)
+    try:
+        torch_ips = bench_torch_cpu(data)
+        vs = tpu_ips / torch_ips
+    except Exception:
+        torch_ips, vs = None, None
+    print(
+        json.dumps(
+            {
+                "metric": "kmeans_iters_per_sec_per_chip",
+                "value": round(tpu_ips, 3),
+                "unit": "iters/s (n=1048576, f=32, k=8, fp32)",
+                "vs_baseline": round(vs, 3) if vs is not None else None,
+                "device": device,
+                "baseline_iters_per_sec_torch_cpu": round(torch_ips, 3) if torch_ips else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""
+Distance-matrix benchmark (parity: reference benchmarks/distance_matrix/
+heat-cpu.py:20-32 — cdist timing with quadratic_expansion ∈ {False, True}).
+
+Run: python benchmarks/distance_matrix_bench.py [--n 16384] [--f 128]
+"""
+
+import argparse
+import json
+import time
+
+import jax
+
+import heat_tpu as ht
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=16384)
+    p.add_argument("--f", type=int, default=128)
+    p.add_argument("--trials", type=int, default=5)
+    args = p.parse_args()
+
+    x = ht.random.randn(args.n, args.f, split=0)
+    results = {}
+    for quad in (False, True):
+        ht.spatial.cdist(x, quadratic_expansion=quad)  # warmup/compile
+        times = []
+        for _ in range(args.trials):
+            t0 = time.perf_counter()
+            d = ht.spatial.cdist(x, quadratic_expansion=quad)
+            jax.block_until_ready(d.larray)
+            times.append(time.perf_counter() - t0)
+        results[f"quadratic_{quad}"] = sorted(times)[len(times) // 2]
+    ht.print0(json.dumps({"benchmark": "distance_matrix", "median_s": results}))
+
+
+if __name__ == "__main__":
+    main()

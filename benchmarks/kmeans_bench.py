@@ -1,0 +1,48 @@
+"""
+KMeans benchmark (parity: reference benchmarks/kmeans/heat-cpu.py + config.json —
+trials of fit() on an HDF5/synthetic dataset with timing per trial).
+
+Run: python benchmarks/kmeans_bench.py [--n 1048576] [--f 32] [--k 8] [--trials 5]
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+import heat_tpu as ht
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=1_048_576)
+    p.add_argument("--f", type=int, default=32)
+    p.add_argument("--k", type=int, default=8)
+    p.add_argument("--iters", type=int, default=30)
+    p.add_argument("--trials", type=int, default=5)
+    p.add_argument("--file", type=str, default=None, help="optional HDF5 file with 'data'")
+    args = p.parse_args()
+
+    if args.file:
+        x = ht.load(args.file, "data", split=0)
+    else:
+        rng = np.random.default_rng(0)
+        centers = rng.normal(scale=5.0, size=(args.k, args.f)).astype(np.float32)
+        data = centers[rng.integers(0, args.k, args.n)] + rng.normal(
+            scale=0.5, size=(args.n, args.f)
+        ).astype(np.float32)
+        x = ht.array(data, split=0)
+
+    times = []
+    for trial in range(args.trials):
+        km = ht.cluster.KMeans(n_clusters=args.k, init="random", max_iter=args.iters, tol=-1.0, random_state=trial)
+        t0 = time.perf_counter()
+        km.fit(x)
+        times.append(time.perf_counter() - t0)
+        ht.print0(f"trial {trial}: {times[-1]:.3f}s ({km.n_iter_} iters)")
+    ht.print0(json.dumps({"benchmark": "kmeans", "median_fit_s": sorted(times)[len(times) // 2]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""
+Statistical moments benchmark (parity: reference
+benchmarks/statistical_moments/heat-cpu.py:20-28 — per-trial timing of ht.mean /
+ht.std over axis ∈ {None, 0, 1}).
+
+Run: python benchmarks/statistical_moments_bench.py [--n 4194304] [--f 64]
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+import jax
+
+import heat_tpu as ht
+
+
+def timeit(fn, trials=5):
+    fn()  # warmup/compile
+    times = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn().larray)
+        times.append(time.perf_counter() - t0)
+    return sorted(times)[len(times) // 2]
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=4_194_304)
+    p.add_argument("--f", type=int, default=64)
+    args = p.parse_args()
+
+    x = ht.random.randn(args.n, args.f, split=0)
+    results = {}
+    for axis in (None, 0, 1):
+        results[f"mean_axis_{axis}"] = timeit(lambda: ht.mean(x, axis=axis))
+        results[f"std_axis_{axis}"] = timeit(lambda: ht.std(x, axis=axis))
+    ht.print0(json.dumps({"benchmark": "statistical_moments", "median_s": results}))
+
+
+if __name__ == "__main__":
+    main()

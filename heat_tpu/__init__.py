@@ -1,0 +1,20 @@
+"""
+heat_tpu: a TPU-native distributed tensor framework with the capabilities of Heat
+(the Helmholtz Analytics Toolkit). NumPy-compatible distributed arrays over JAX/XLA
+device meshes (parity: reference heat/__init__.py:1-18 namespace flattening).
+"""
+
+from .core import *
+from .core.linalg import *
+from .core import __version__
+
+from . import core
+from . import classification
+from . import cluster
+from . import graph
+from . import naive_bayes
+from . import nn
+from . import optim
+from . import regression
+from . import spatial
+from . import utils

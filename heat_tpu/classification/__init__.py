@@ -1,0 +1,3 @@
+"""Classification (parity: reference heat/classification/__init__.py)."""
+
+from .kneighborsclassifier import *

@@ -1,0 +1,72 @@
+"""
+k-nearest-neighbors classification.
+
+Parity with the reference's ``heat/classification/kneighborsclassifier.py`` (:31-166):
+``cdist`` test×train → ``topk`` smallest → one-hot vote sum.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from ..core.base import BaseEstimator, ClassificationMixin
+from ..core.dndarray import DNDarray
+
+__all__ = ["KNeighborsClassifier"]
+
+
+class KNeighborsClassifier(BaseEstimator, ClassificationMixin):
+    """
+    Classification by majority vote of the k nearest training samples.
+
+    Parameters
+    ----------
+    n_neighbors : int
+        Number of neighbors considered.
+    effective_metric_ : Callable, optional
+        Distance function; defaults to Euclidean ``ht.spatial.cdist``.
+
+    Reference parity: heat/classification/kneighborsclassifier.py:31-166.
+    """
+
+    def __init__(self, n_neighbors: int = 5, effective_metric_: Optional[Callable] = None):
+        self.n_neighbors = n_neighbors
+        self.effective_metric_ = effective_metric_ or ht.spatial.cdist
+        self.x = None
+        self.y = None
+        self._classes = None
+
+    def fit(self, x: DNDarray, y: DNDarray) -> "KNeighborsClassifier":
+        """Memorize the training data; labels may be class ids or one-hot (reference
+        kneighborsclassifier.py:62-95)."""
+        if not isinstance(x, DNDarray) or not isinstance(y, DNDarray):
+            raise ValueError("x and y need to be ht.DNDarrays")
+        self.x = x
+        if y.ndim == 1:
+            classes = jnp.unique(y.larray)
+            self._classes = classes
+            onehot = (y.larray[:, None] == classes[None, :]).astype(jnp.float32)
+            self.y = ht.array(onehot, split=y.split, device=y.device, comm=y.comm)
+        else:
+            self._classes = jnp.arange(y.shape[1])
+            self.y = y
+        return self
+
+    def predict(self, x: DNDarray) -> DNDarray:
+        """Majority vote over the k nearest training points (reference
+        kneighborsclassifier.py:96-165)."""
+        if self.x is None:
+            raise RuntimeError("fit the estimator before predicting")
+        distances = self.effective_metric_(x, self.x)  # (n_test, n_train)
+        # k smallest: negate and take top-k
+        neg = -distances.larray
+        _, idx = jax.lax.top_k(neg, self.n_neighbors)  # (n_test, k)
+        votes = jnp.take(self.y.larray, idx, axis=0)  # (n_test, k, n_classes)
+        counts = jnp.sum(votes, axis=1)  # (n_test, n_classes)
+        winner = jnp.argmax(counts, axis=1)
+        labels = jnp.take(self._classes, winner)
+        return ht.array(labels, split=x.split, device=x.device, comm=x.comm)

@@ -1,0 +1,7 @@
+"""Cluster analysis (parity: reference heat/cluster/__init__.py)."""
+
+from ._kcluster import *
+from .kmeans import *
+from .kmedians import *
+from .kmedoids import *
+from .spectral import *

@@ -1,0 +1,85 @@
+"""
+K-Medoids clustering.
+
+Parity with the reference's ``heat/cluster/kmedoids.py`` (``_update_centroids``
+:56-115: the new centroid is the closest *actual data point* to the per-cluster
+median).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from ._kcluster import _KCluster
+from .kmedians import _masked_medians
+from ..core.dndarray import DNDarray
+from ..spatial.distance import _manhattan
+
+__all__ = ["KMedoids"]
+
+
+class KMedoids(_KCluster):
+    """
+    K-Medoids: like K-Medians but centroids snap to the nearest actual sample.
+
+    Reference parity: heat/cluster/kmedoids.py:1-143.
+    """
+
+    def __init__(
+        self,
+        n_clusters: int = 8,
+        init: Union[str, DNDarray] = "random",
+        max_iter: int = 300,
+        random_state: Optional[int] = None,
+    ):
+        if isinstance(init, str) and init == "kmedoids++":
+            init = "probability_based"
+        super().__init__(
+            metric=_manhattan,
+            n_clusters=n_clusters,
+            init=init,
+            max_iter=max_iter,
+            tol=0.0,
+            random_state=random_state,
+        )
+
+    def _update_centroids(self, x: DNDarray, matching_centroids: DNDarray) -> DNDarray:
+        """Closest actual point to each cluster median (reference
+        kmedoids.py:56-115)."""
+        medians = _masked_medians(
+            x.larray, matching_centroids.larray, self.n_clusters, self._cluster_centers.larray
+        )
+        d = _manhattan(medians, x.larray)  # (k, n)
+        # restrict the snap to members of the cluster
+        labels = matching_centroids.larray
+        member = labels[None, :] == jnp.arange(self.n_clusters)[:, None]  # (k, n)
+        big = jnp.asarray(jnp.inf, dtype=d.dtype)
+        d = jnp.where(member, d, big)
+        idx = jnp.argmin(d, axis=1)  # (k,)
+        has_member = jnp.any(member, axis=1)
+        snapped = jnp.take(x.larray, idx, axis=0)
+        new_centers = jnp.where(has_member[:, None], snapped, self._cluster_centers.larray)
+        return ht.array(new_centers, device=x.device, comm=x.comm)
+
+    def fit(self, x: DNDarray) -> "KMedoids":
+        """Cluster the data (reference kmedoids.py fit)."""
+        if not isinstance(x, DNDarray):
+            raise ValueError(f"input needs to be a ht.DNDarray, but was {type(x)}")
+        self._initialize_cluster_centers(x)
+        n_iter = 0
+        for n_iter in range(1, self.max_iter + 1):
+            labels = self._assign_to_cluster(x)
+            new_centers = self._update_centroids(x, labels)
+            shift = float(jnp.sum(jnp.abs(new_centers.larray - self._cluster_centers.larray)))
+            self._cluster_centers = new_centers
+            if shift == 0.0:
+                break
+        self._labels = self._assign_to_cluster(x)
+        d = self._metric(x.larray, self._cluster_centers.larray)
+        self._inertia = float(jnp.sum(jnp.min(d, axis=1)))
+        self._n_iter = n_iter
+        return self

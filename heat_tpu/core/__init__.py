@@ -1,0 +1,31 @@
+"""Core namespace: flat re-export of every op module (parity: reference
+heat/core/__init__.py:1-31)."""
+
+from .communication import *
+from .arithmetics import *
+from .base import *
+from .complex_math import *
+from .constants import *
+from .devices import *
+from .dndarray import *
+from .exponential import *
+from .factories import *
+from .indexing import *
+from .io import *
+from .logical import *
+from .manipulations import *
+from .memory import *
+from .printing import *
+from .relational import *
+from .rounding import *
+from .sanitation import *
+from .statistics import *
+from .stride_tricks import *
+from .tiling import *
+from .trigonometrics import *
+from .types import *
+from .types import finfo, iinfo
+from .version import __version__
+from . import linalg
+from . import random
+from . import version

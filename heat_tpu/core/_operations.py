@@ -1,0 +1,200 @@
+"""
+Generic operation templates all public ops funnel through.
+
+Parity with the reference's ``heat/core/_operations.py`` (``__binary_op`` :24,
+``__cum_op`` :185, ``__local_op`` :282, ``__reduce_op`` :356). The reference's
+distribution matching — redistributing the non-dominant operand onto the dominant
+operand's chunk map (:113-165) — is unnecessary here: operands are global arrays whose
+shardings XLA reconciles; only the *logical* split of the result is computed, following
+the reference's dominance rules (:57-71): the leftmost non-``None`` split wins.
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Callable, Optional, Tuple, Union
+
+import numpy as np
+import jax.numpy as jnp
+
+from . import devices as _devices
+from . import sanitation
+from . import stride_tricks
+from .communication import sanitize_comm
+from .dndarray import DNDarray
+
+__all__ = []
+
+
+def __binary_op(
+    operation: Callable,
+    t1,
+    t2,
+    out: Optional[DNDarray] = None,
+    where=None,
+    fn_kwargs: Optional[dict] = None,
+) -> DNDarray:
+    """
+    Generic binary operation: promotes dtypes (reference _operations.py:24-111),
+    broadcasts shapes, determines the output split via operand dominance (:57-71), and
+    applies the jnp callable on the global arrays.
+    """
+    from . import factories
+    from .types import canonical_heat_type, result_type
+
+    fn_kwargs = fn_kwargs or {}
+
+    scalars = (builtins.int, builtins.float, builtins.bool, builtins.complex, np.number, np.bool_)
+    if not isinstance(t1, (DNDarray, *scalars)) and not isinstance(t1, (np.ndarray, list, tuple)):
+        raise TypeError(f"unsupported operand type(s): {type(t1)}")
+    if not isinstance(t2, (DNDarray, *scalars)) and not isinstance(t2, (np.ndarray, list, tuple)):
+        raise TypeError(f"unsupported operand type(s): {type(t2)}")
+
+    if not isinstance(t1, DNDarray) and not isinstance(t2, DNDarray):
+        t1 = factories.array(t1)
+
+    promoted = result_type(t1, t2)
+
+    arrays = []
+    dnd_ops = []
+    for t in (t1, t2):
+        if isinstance(t, DNDarray):
+            arrays.append(t.larray)
+            dnd_ops.append(t)
+        elif isinstance(t, scalars):
+            arrays.append(t)  # keep weak typing for scalars
+        else:
+            arrays.append(jnp.asarray(t))
+
+    out_shape = stride_tricks.broadcast_shapes(
+        *[tuple(np.shape(a)) if not hasattr(a, "shape") else tuple(a.shape) for a in arrays]
+    )
+
+    # output split: leftmost non-None split among DNDarray operands, remapped through
+    # broadcasting (reference dominance rules _operations.py:57-71)
+    out_split = None
+    for t in dnd_ops:
+        if t.split is not None:
+            out_split = len(out_shape) - (t.ndim - t.split)
+            break
+    if out_split is not None and out_split < 0:
+        out_split = None
+
+    device = dnd_ops[0].device if dnd_ops else _devices.get_device()
+    comm = dnd_ops[0].comm if dnd_ops else sanitize_comm(None)
+
+    result = operation(*arrays, **fn_kwargs)
+    if result.dtype != promoted.jnp_type() and np.dtype(result.dtype).kind != "b":
+        # comparison ops legitimately return bool; numeric ops are cast to the
+        # heat-promoted type
+        if operation not in (jnp.equal, jnp.not_equal):
+            result = result.astype(promoted.jnp_type())
+    res_dtype = canonical_heat_type(result.dtype)
+
+    if where is not None:
+        if isinstance(where, DNDarray):
+            where = where.larray
+        base = out.larray if out is not None else jnp.zeros(out_shape, dtype=result.dtype)
+        result = jnp.where(where, result, base)
+
+    if out is not None:
+        sanitation.sanitize_out(out, out_shape, out_split, device)
+        out.larray = jnp.broadcast_to(result, out.shape).astype(out.dtype.jnp_type())
+        return out
+
+    return DNDarray(result, tuple(result.shape), res_dtype, out_split, device, comm, True)
+
+
+def __local_op(
+    operation: Callable,
+    x: DNDarray,
+    out: Optional[DNDarray] = None,
+    no_cast: bool = False,
+    **kwargs,
+) -> DNDarray:
+    """
+    Generic elementwise local operation (reference _operations.py:282-355): no
+    communication, split/layout of the input is retained.
+    """
+    from .types import canonical_heat_type
+
+    sanitation.sanitize_in(x)
+    result = operation(x.larray, **kwargs)
+    res_dtype = canonical_heat_type(result.dtype)
+    if out is not None:
+        sanitation.sanitize_out(out, x.shape, x.split, x.device)
+        out.larray = jnp.broadcast_to(result, out.shape).astype(out.dtype.jnp_type())
+        return out
+    return DNDarray(result, tuple(result.shape), res_dtype, x.split, x.device, x.comm, True)
+
+
+def __reduce_op(
+    x: DNDarray,
+    partial_op: Callable,
+    reduction_op=None,
+    axis=None,
+    out: Optional[DNDarray] = None,
+    neutral=None,
+    keepdims: bool = False,
+    **kwargs,
+) -> DNDarray:
+    """
+    Generic reduction (reference _operations.py:356-482). The reference computes a
+    local partial reduce and crosses ranks with an MPI ``Allreduce`` when the split
+    axis is reduced (:441-444); here the global jnp reduction compiles to the same
+    psum/pmax collective when the operand is sharded on the reduced axis. The
+    ``reduction_op``/``neutral`` arguments are kept for signature parity.
+    """
+    from .types import canonical_heat_type
+
+    sanitation.sanitize_in(x)
+    axis = stride_tricks.sanitize_axis(x.shape, axis)
+    result = partial_op(x.larray, axis=axis, keepdims=keepdims, **kwargs)
+    result = jnp.asarray(result)
+
+    # split bookkeeping: reduced split axis -> None; earlier axes removed shift it left
+    split = x.split
+    if split is not None:
+        axes = range(x.ndim) if axis is None else ((axis,) if isinstance(axis, int) else axis)
+        if axis is None or split in axes:
+            split = None
+        elif not keepdims:
+            split -= sum(1 for a in axes if a < split)
+
+    res_dtype = canonical_heat_type(result.dtype)
+    if out is not None:
+        sanitation.sanitize_out(out, tuple(result.shape), split, x.device)
+        out.larray = jnp.broadcast_to(result, out.shape).astype(out.dtype.jnp_type())
+        return out
+    return DNDarray(result, tuple(result.shape), res_dtype, split, x.device, x.comm, True)
+
+
+def __cum_op(
+    x: DNDarray,
+    partial_op: Callable,
+    exscan_op=None,
+    final_op=None,
+    neutral=None,
+    axis: int = 0,
+    dtype=None,
+    out: Optional[DNDarray] = None,
+) -> DNDarray:
+    """
+    Generic cumulative operation (reference _operations.py:185-281: local cumop +
+    ``Exscan`` + local combine; here the global jnp scan lowers to the same pattern).
+    """
+    from .types import canonical_heat_type
+
+    sanitation.sanitize_in(x)
+    axis = stride_tricks.sanitize_axis(x.shape, axis)
+    if axis is None:
+        raise NotImplementedError("cumulative operations over flattened arrays: pass axis")
+    result = partial_op(x.larray, axis=axis)
+    if dtype is not None:
+        result = result.astype(canonical_heat_type(dtype).jnp_type())
+    res_dtype = canonical_heat_type(result.dtype)
+    if out is not None:
+        sanitation.sanitize_out(out, x.shape, x.split, x.device)
+        out.larray = result.astype(out.dtype.jnp_type())
+        return out
+    return DNDarray(result, tuple(result.shape), res_dtype, x.split, x.device, x.comm, True)

@@ -1,0 +1,250 @@
+"""
+Arithmetic operations on DNDarrays.
+
+Parity with the reference's ``heat/core/arithmetics.py`` (``__all__`` at
+arithmetics.py:28-60). Every function funnels through the generic templates in
+``_operations.py``; reductions (``sum``/``prod``) and scans (``cumsum``/``cumprod``)
+across a split axis lower to XLA psum/scan collectives instead of MPI
+Allreduce/Exscan.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from . import _operations
+from .dndarray import DNDarray
+
+__all__ = [
+    "add",
+    "bitwise_and",
+    "bitwise_not",
+    "bitwise_or",
+    "bitwise_xor",
+    "cumprod",
+    "cumproduct",
+    "cumsum",
+    "diff",
+    "div",
+    "divide",
+    "floordiv",
+    "floor_divide",
+    "fmod",
+    "invert",
+    "left_shift",
+    "mod",
+    "mul",
+    "multiply",
+    "neg",
+    "negative",
+    "pos",
+    "positive",
+    "pow",
+    "power",
+    "prod",
+    "remainder",
+    "right_shift",
+    "sub",
+    "subtract",
+    "sum",
+]
+
+
+def add(t1, t2, out=None, where=None) -> DNDarray:
+    """Element-wise addition of two operands (reference arithmetics.py add)."""
+    return _operations.__binary_op(jnp.add, t1, t2, out, where)
+
+
+def bitwise_and(t1, t2) -> DNDarray:
+    """Element-wise bitwise AND (reference arithmetics.py bitwise_and)."""
+    __integer_guard(t1, t2)
+    return _operations.__binary_op(jnp.bitwise_and, t1, t2)
+
+
+def bitwise_or(t1, t2) -> DNDarray:
+    """Element-wise bitwise OR (reference arithmetics.py bitwise_or)."""
+    __integer_guard(t1, t2)
+    return _operations.__binary_op(jnp.bitwise_or, t1, t2)
+
+
+def bitwise_xor(t1, t2) -> DNDarray:
+    """Element-wise bitwise XOR (reference arithmetics.py bitwise_xor)."""
+    __integer_guard(t1, t2)
+    return _operations.__binary_op(jnp.bitwise_xor, t1, t2)
+
+
+def __integer_guard(*ts) -> None:
+    from . import types
+
+    for t in ts:
+        dt = types.heat_type_of(t)
+        if not (issubclass(dt, types.integer) or dt is types.bool):
+            raise TypeError(f"Operation is not supported for float types, got {dt}")
+
+
+def invert(a, out=None) -> DNDarray:
+    """Element-wise bitwise NOT; boolean arrays invert logically (reference
+    arithmetics.py invert)."""
+    from . import types
+
+    dt = types.heat_type_of(a)
+    if issubclass(dt, (types.floating, types.complexfloating)):
+        raise TypeError(f"Operation is not supported for float types, got {dt}")
+    return _operations.__local_op(jnp.invert, a, out)
+
+
+bitwise_not = invert
+
+
+def cumprod(a, axis: int, dtype=None, out=None) -> DNDarray:
+    """Cumulative product along ``axis`` (reference arithmetics.py cumprod; MPI
+    Exscan there, XLA scan here)."""
+    return _operations.__cum_op(a, jnp.cumprod, axis=axis, dtype=dtype, out=out)
+
+
+cumproduct = cumprod
+
+
+def cumsum(a, axis: int, dtype=None, out=None) -> DNDarray:
+    """Cumulative sum along ``axis`` (reference arithmetics.py cumsum)."""
+    return _operations.__cum_op(a, jnp.cumsum, axis=axis, dtype=dtype, out=out)
+
+
+def diff(a, n: int = 1, axis: int = -1, prepend=None, append=None) -> DNDarray:
+    """n-th discrete difference along ``axis`` (reference arithmetics.py diff; the
+    neighbor-boundary exchange there is a shifted-slice subtraction here)."""
+    from . import sanitation
+
+    sanitation.sanitize_in(a)
+    if n < 0:
+        raise ValueError(f"diff requires that n be a positive number, got {n}")
+    kw = {}
+    if prepend is not None:
+        kw["prepend"] = prepend.larray if isinstance(prepend, DNDarray) else prepend
+    if append is not None:
+        kw["append"] = append.larray if isinstance(append, DNDarray) else append
+    return _operations.__local_op(jnp.diff, a, None, n=n, axis=axis, **kw)
+
+
+def div(t1, t2, out=None, where=None) -> DNDarray:
+    """Element-wise true division (reference arithmetics.py div)."""
+    return _operations.__binary_op(jnp.true_divide, t1, t2, out, where)
+
+
+divide = div
+
+
+def floordiv(t1, t2) -> DNDarray:
+    """Element-wise floor division (reference arithmetics.py floordiv)."""
+    return _operations.__binary_op(jnp.floor_divide, t1, t2)
+
+
+floor_divide = floordiv
+
+
+def fmod(t1, t2) -> DNDarray:
+    """Element-wise C-style (truncated) remainder (reference arithmetics.py fmod)."""
+    return _operations.__binary_op(jnp.fmod, t1, t2)
+
+
+def left_shift(t1, t2) -> DNDarray:
+    """Element-wise bit shift left (reference arithmetics.py left_shift)."""
+    __integer_guard(t1, t2)
+    return _operations.__binary_op(jnp.left_shift, t1, t2)
+
+
+def mod(t1, t2) -> DNDarray:
+    """Element-wise Python-style modulo (reference arithmetics.py mod)."""
+    return _operations.__binary_op(jnp.mod, t1, t2)
+
+
+remainder = mod
+
+
+def mul(t1, t2, out=None, where=None) -> DNDarray:
+    """Element-wise multiplication (reference arithmetics.py mul)."""
+    return _operations.__binary_op(jnp.multiply, t1, t2, out, where)
+
+
+multiply = mul
+
+
+def neg(a, out=None) -> DNDarray:
+    """Element-wise negation (reference arithmetics.py neg)."""
+    return _operations.__local_op(jnp.negative, a, out)
+
+
+negative = neg
+
+
+def pos(a, out=None) -> DNDarray:
+    """Element-wise unary plus (reference arithmetics.py pos)."""
+    return _operations.__local_op(jnp.positive, a, out)
+
+
+positive = pos
+
+
+def pow(t1, t2, out=None, where=None) -> DNDarray:
+    """Element-wise exponentiation (reference arithmetics.py pow)."""
+    return _operations.__binary_op(jnp.power, t1, t2, out, where)
+
+
+power = pow
+
+
+def prod(a, axis=None, out=None, keepdim=None) -> DNDarray:
+    """Product of elements over the given axis (reference arithmetics.py prod →
+    __reduce_op with MPI.PROD; here a sharded jnp.prod)."""
+    return _operations.__reduce_op(a, jnp.prod, axis=axis, out=out, keepdims=bool(keepdim))
+
+
+def right_shift(t1, t2) -> DNDarray:
+    """Element-wise bit shift right (reference arithmetics.py right_shift)."""
+    __integer_guard(t1, t2)
+    return _operations.__binary_op(jnp.right_shift, t1, t2)
+
+
+def sub(t1, t2, out=None, where=None) -> DNDarray:
+    """Element-wise subtraction (reference arithmetics.py sub)."""
+    return _operations.__binary_op(jnp.subtract, t1, t2, out, where)
+
+
+subtract = sub
+
+
+def sum(a, axis=None, out=None, keepdim=None) -> DNDarray:
+    """Sum of elements over the given axis (reference arithmetics.py sum →
+    __reduce_op with MPI.SUM at _operations.py:441; lowers to psum over ICI here)."""
+    return _operations.__reduce_op(a, jnp.sum, axis=axis, out=out, keepdims=bool(keepdim))
+
+
+# ---------------------------------------------------------------------- operators
+DNDarray.__add__ = lambda self, other: add(self, other)
+DNDarray.__radd__ = lambda self, other: add(other, self)
+DNDarray.__sub__ = lambda self, other: sub(self, other)
+DNDarray.__rsub__ = lambda self, other: sub(other, self)
+DNDarray.__mul__ = lambda self, other: mul(self, other)
+DNDarray.__rmul__ = lambda self, other: mul(other, self)
+DNDarray.__truediv__ = lambda self, other: div(self, other)
+DNDarray.__rtruediv__ = lambda self, other: div(other, self)
+DNDarray.__floordiv__ = lambda self, other: floordiv(self, other)
+DNDarray.__rfloordiv__ = lambda self, other: floordiv(other, self)
+DNDarray.__mod__ = lambda self, other: mod(self, other)
+DNDarray.__rmod__ = lambda self, other: mod(other, self)
+DNDarray.__pow__ = lambda self, other: pow(self, other)
+DNDarray.__rpow__ = lambda self, other: pow(other, self)
+DNDarray.__and__ = lambda self, other: bitwise_and(self, other)
+DNDarray.__or__ = lambda self, other: bitwise_or(self, other)
+DNDarray.__xor__ = lambda self, other: bitwise_xor(self, other)
+DNDarray.__lshift__ = lambda self, other: left_shift(self, other)
+DNDarray.__rshift__ = lambda self, other: right_shift(self, other)
+DNDarray.__invert__ = lambda self: invert(self)
+DNDarray.__neg__ = lambda self: neg(self)
+DNDarray.__pos__ = lambda self: pos(self)
+DNDarray.sum = sum
+DNDarray.prod = prod
+DNDarray.cumsum = cumsum
+DNDarray.cumprod = cumprod

@@ -1,0 +1,149 @@
+"""
+Scikit-learn-compatible estimator base classes.
+
+Parity with the reference's ``heat/core/base.py`` (``BaseEstimator`` :13-97,
+``ClassificationMixin``/``ClusteringMixin``/``RegressionMixin`` :98-219, helper
+predicates :221-270).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Dict, List
+
+__all__ = [
+    "BaseEstimator",
+    "ClassificationMixin",
+    "ClusteringMixin",
+    "RegressionMixin",
+    "is_classifier",
+    "is_estimator",
+    "is_regressor",
+    "is_transformer",
+]
+
+
+class BaseEstimator:
+    """Abstract base for all estimators, i.e. parametrized analysis algorithms
+    (reference base.py:13-97)."""
+
+    @classmethod
+    def _parameter_names(cls) -> List[str]:
+        init = cls.__init__
+        if init is object.__init__:
+            return []
+        sig = inspect.signature(init)
+        return sorted(
+            p.name
+            for p in sig.parameters.values()
+            if p.name != "self" and p.kind not in (p.VAR_POSITIONAL, p.VAR_KEYWORD)
+        )
+
+    def get_params(self, deep: bool = True) -> Dict[str, object]:
+        """Parameters of this estimator as a dict; nested estimators are expanded when
+        ``deep`` (reference base.py get_params)."""
+        params = {}
+        for key in self._parameter_names():
+            value = getattr(self, key, None)
+            if deep and hasattr(value, "get_params"):
+                for sub_key, sub_value in value.get_params().items():
+                    params[f"{key}__{sub_key}"] = sub_value
+            params[key] = value
+        return params
+
+    def set_params(self, **params) -> "BaseEstimator":
+        """Set the parameters of this estimator; supports ``component__parameter``
+        nesting (reference base.py set_params)."""
+        if not params:
+            return self
+        valid = self.get_params(deep=True)
+        nested = {}
+        for key, value in params.items():
+            key, delim, sub_key = key.partition("__")
+            if key not in valid:
+                raise ValueError(f"invalid parameter {key} for estimator {self}")
+            if delim:
+                nested.setdefault(key, {})[sub_key] = value
+            else:
+                setattr(self, key, value)
+                valid[key] = value
+        for key, sub_params in nested.items():
+            valid[key].set_params(**sub_params)
+        return self
+
+    def __repr__(self, indent: int = 1) -> str:
+        params = ", ".join(f"{k}={v!r}" for k, v in self.get_params(deep=False).items())
+        return f"{self.__class__.__name__}({params})"
+
+
+class ClassificationMixin:
+    """Mixin for all classifiers (reference base.py:98-144)."""
+
+    _estimator_type = "classifier"
+
+    def fit(self, x, y):
+        """Fit the model to data ``x`` with labels ``y``."""
+        raise NotImplementedError()
+
+    def fit_predict(self, x, y):
+        """Fit and return labels for ``x``."""
+        self.fit(x, y)
+        return self.predict(x)
+
+    def predict(self, x):
+        """Predict labels for ``x``."""
+        raise NotImplementedError()
+
+
+class ClusteringMixin:
+    """Mixin for all clustering algorithms (reference base.py:145-175)."""
+
+    _estimator_type = "clusterer"
+
+    def fit(self, x):
+        """Compute the clustering."""
+        raise NotImplementedError()
+
+    def fit_predict(self, x):
+        """Compute the clustering and return the labels."""
+        self.fit(x)
+        return self.predict(x)
+
+
+class RegressionMixin:
+    """Mixin for all regression estimators (reference base.py:176-219)."""
+
+    _estimator_type = "regressor"
+
+    def fit(self, x, y):
+        """Fit the model to data ``x`` with continuous targets ``y``."""
+        raise NotImplementedError()
+
+    def fit_predict(self, x, y):
+        """Fit and return predictions for ``x``."""
+        self.fit(x, y)
+        return self.predict(x)
+
+    def predict(self, x):
+        """Predict continuous targets for ``x``."""
+        raise NotImplementedError()
+
+
+def is_classifier(estimator) -> bool:
+    """Whether the given estimator is a classifier (reference base.py:221)."""
+    return getattr(estimator, "_estimator_type", None) == "classifier"
+
+
+def is_estimator(estimator) -> bool:
+    """Whether the given object is an estimator (reference base.py is_estimator)."""
+    return isinstance(estimator, BaseEstimator)
+
+
+def is_regressor(estimator) -> bool:
+    """Whether the given estimator is a regressor (reference base.py is_regressor)."""
+    return getattr(estimator, "_estimator_type", None) == "regressor"
+
+
+def is_transformer(estimator) -> bool:
+    """Whether the given estimator is a transformer (reference base.py is_transformer)."""
+    return hasattr(estimator, "transform") and is_estimator(estimator)

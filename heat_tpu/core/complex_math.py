@@ -1,0 +1,54 @@
+"""
+Complex number operations (all element-local).
+
+Parity with the reference's ``heat/core/complex_math.py`` (``__all__`` at
+complex_math.py:15).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import _operations
+from .dndarray import DNDarray
+
+__all__ = ["angle", "conj", "conjugate", "imag", "real"]
+
+
+def angle(x, deg: bool = False, out=None) -> DNDarray:
+    """Element-wise argument (phase) of a complex array; in degrees if ``deg``
+    (reference complex_math.py angle)."""
+    res = _operations.__local_op(jnp.angle, x, None)
+    if deg:
+        from . import trigonometrics
+
+        res = trigonometrics.rad2deg(res)
+    if out is not None:
+        from . import sanitation
+
+        sanitation.sanitize_out(out, res.shape, res.split, res.device)
+        out.larray = res.larray.astype(out.dtype.jnp_type())
+        return out
+    return res
+
+
+def conjugate(x, out=None) -> DNDarray:
+    """Element-wise complex conjugate (reference complex_math.py conjugate)."""
+    return _operations.__local_op(jnp.conj, x, out)
+
+
+conj = conjugate
+
+
+def imag(x) -> DNDarray:
+    """Imaginary part; zeros for real input (reference complex_math.py imag)."""
+    return _operations.__local_op(jnp.imag, x)
+
+
+def real(x) -> DNDarray:
+    """Real part (reference complex_math.py real)."""
+    from . import types
+
+    if not issubclass(x.dtype, types.complexfloating):
+        return x
+    return _operations.__local_op(jnp.real, x)

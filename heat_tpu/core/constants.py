@@ -1,0 +1,21 @@
+"""Constants (parity: reference heat/core/constants.py:7-19)."""
+
+import math
+
+__all__ = ["e", "Euler", "inf", "Inf", "Infty", "Infinity", "nan", "NaN", "pi"]
+
+e: float = math.e
+"""Euler's number."""
+pi: float = math.pi
+"""Archimedes' constant."""
+inf: float = float("inf")
+"""IEEE 754 positive infinity."""
+nan: float = float("nan")
+"""IEEE 754 Not a Number."""
+
+# aliases
+Euler = e
+Inf = inf
+Infty = inf
+Infinity = inf
+NaN = nan

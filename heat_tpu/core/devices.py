@@ -1,0 +1,162 @@
+"""
+Device abstraction over JAX platforms.
+
+Parity with the reference's ``heat/core/devices.py`` (Device class at devices.py:17,
+module globals ``cpu``/``gpu`` at :97-118, ``use_device``/``get_device``/
+``sanitize_device`` at :121-167) — redesigned for JAX: a :class:`Device` names a JAX
+*platform* (``cpu``, ``tpu``, ``gpu``) instead of a torch device, and ``tpu`` is the
+first-class accelerator. Which concrete ``jax.Device`` objects back a ``Device`` is
+decided by the communication layer's mesh (see ``communication.py``); the ``Device``
+object itself is placement intent, matching the reference's process-global default
+device semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+
+__all__ = ["Device", "cpu", "get_device", "sanitize_device", "use_device"]
+
+
+class Device:
+    """
+    Implements a compute device backed by a JAX platform.
+
+    Parameters
+    ----------
+    device_type : str
+        JAX platform name: ``"cpu"``, ``"tpu"`` or ``"gpu"``.
+    device_id : int
+        The index of the first device of this platform used by this process.
+
+    Reference parity: heat/core/devices.py:17-96 (there backed by a torch device
+    string; here by a JAX platform).
+    """
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        self.__device_type = device_type
+        self.__device_id = device_id
+
+    @property
+    def device_type(self) -> str:
+        """String representation of the platform."""
+        return self.__device_type
+
+    @property
+    def device_id(self) -> int:
+        """Index of the first JAX device of this platform used by this process."""
+        return self.__device_id
+
+    @property
+    def jax_device(self) -> "jax.Device":
+        """The concrete first :class:`jax.Device` of this platform."""
+        return jax.devices(self.__device_type)[self.__device_id]
+
+    @property
+    def jax_devices(self):
+        """All :class:`jax.Device` objects of this platform visible to this process."""
+        return jax.devices(self.__device_type)
+
+    def __repr__(self) -> str:
+        return f"device({self.__str__()!r})"
+
+    def __str__(self) -> str:
+        return f"{self.device_type}:{self.device_id}"
+
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, Device):
+            return self.device_type == other.device_type and self.device_id == other.device_id
+        return NotImplemented
+
+    def __hash__(self) -> int:
+        return hash((self.device_type, self.device_id))
+
+
+cpu: Device = Device("cpu")
+"""The standard CPU device. Always available."""
+
+# Accelerators are registered lazily on first use: probing jax.devices("tpu") at import
+# time would initialise the backend before test harnesses can force the cpu platform
+# (tests/conftest.py sets jax_platforms *after* import of this module is possible).
+__registered: dict = {"cpu": cpu}
+__default_device: Optional[Device] = None
+
+
+def __probe_accelerators() -> None:
+    for platform in ("tpu", "gpu"):
+        if platform in __registered:
+            continue
+        try:
+            if jax.devices(platform):
+                dev = Device(platform)
+                __registered[platform] = dev
+                globals()[platform] = dev
+                if platform not in __all__:
+                    __all__.append(platform)
+        except RuntimeError:
+            pass
+
+
+def get_device() -> Device:
+    """
+    Retrieves the currently globally set default :class:`Device`. Defaults to the best
+    available platform: ``tpu`` > ``gpu`` > ``cpu``.
+
+    Reference parity: heat/core/devices.py:121-135.
+    """
+    global __default_device
+    if __default_device is None:
+        __probe_accelerators()
+        __default_device = __registered.get(
+            "tpu", __registered.get("gpu", __registered["cpu"])
+        )
+    return __default_device
+
+
+def sanitize_device(device: Optional[Union[str, Device]]) -> Device:
+    """
+    Sanitizes a device or device identifier, i.e. checks whether it is already an
+    instance of :class:`Device` or a string with known device identifier and maps it to
+    a proper :class:`Device`.
+
+    Parameters
+    ----------
+    device : str or Device, optional
+        The device to be sanitized. ``None`` resolves to the global default device.
+
+    Raises
+    ------
+    ValueError
+        If the given device id is not recognized.
+
+    Reference parity: heat/core/devices.py:138-154.
+    """
+    if device is None:
+        return get_device()
+    if isinstance(device, Device):
+        return device
+    if isinstance(device, str):
+        name = device.strip().lower()
+        if ":" in name:
+            name, _, idx = name.partition(":")
+            idx = int(idx)
+        else:
+            idx = 0
+        __probe_accelerators()
+        if name in __registered:
+            base = __registered[name]
+            return base if idx == base.device_id else Device(name, idx)
+        raise ValueError(f"Unknown device, must be one of {sorted(__registered)}, got '{device}'")
+    raise ValueError(f"Unknown device, got '{device}'")
+
+
+def use_device(device: Optional[Union[str, Device]] = None) -> None:
+    """
+    Sets the globally used default :class:`Device`.
+
+    Reference parity: heat/core/devices.py:157-167.
+    """
+    global __default_device
+    __default_device = sanitize_device(device)

@@ -1,0 +1,75 @@
+"""
+Exponential and logarithmic operations (all element-local).
+
+Parity with the reference's ``heat/core/exponential.py`` (``__all__`` at
+exponential.py:11-23).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import _operations
+from .dndarray import DNDarray
+
+__all__ = ["exp", "expm1", "exp2", "log", "log2", "log10", "log1p", "logaddexp", "logaddexp2", "sqrt", "square"]
+
+
+def exp(x, out=None) -> DNDarray:
+    """Element-wise exponential (reference exponential.py exp)."""
+    return _operations.__local_op(jnp.exp, x, out)
+
+
+def expm1(x, out=None) -> DNDarray:
+    """Element-wise exp(x) - 1 (reference exponential.py expm1)."""
+    return _operations.__local_op(jnp.expm1, x, out)
+
+
+def exp2(x, out=None) -> DNDarray:
+    """Element-wise 2**x (reference exponential.py exp2)."""
+    return _operations.__local_op(jnp.exp2, x, out)
+
+
+def log(x, out=None) -> DNDarray:
+    """Element-wise natural logarithm (reference exponential.py log)."""
+    return _operations.__local_op(jnp.log, x, out)
+
+
+def log2(x, out=None) -> DNDarray:
+    """Element-wise base-2 logarithm (reference exponential.py log2)."""
+    return _operations.__local_op(jnp.log2, x, out)
+
+
+def log10(x, out=None) -> DNDarray:
+    """Element-wise base-10 logarithm (reference exponential.py log10)."""
+    return _operations.__local_op(jnp.log10, x, out)
+
+
+def log1p(x, out=None) -> DNDarray:
+    """Element-wise log(1 + x) (reference exponential.py log1p)."""
+    return _operations.__local_op(jnp.log1p, x, out)
+
+
+def logaddexp(x1, x2, out=None) -> DNDarray:
+    """Element-wise log(exp(x1) + exp(x2)) (reference exponential.py logaddexp)."""
+    return _operations.__binary_op(jnp.logaddexp, x1, x2, out)
+
+
+def logaddexp2(x1, x2, out=None) -> DNDarray:
+    """Element-wise log2(2**x1 + 2**x2) (reference exponential.py logaddexp2)."""
+    return _operations.__binary_op(jnp.logaddexp2, x1, x2, out)
+
+
+def sqrt(x, out=None) -> DNDarray:
+    """Element-wise square root (reference exponential.py sqrt)."""
+    return _operations.__local_op(jnp.sqrt, x, out)
+
+
+def square(x, out=None) -> DNDarray:
+    """Element-wise square (reference exponential.py square)."""
+    return _operations.__local_op(jnp.square, x, out)
+
+
+DNDarray.exp = exp
+DNDarray.log = log
+DNDarray.sqrt = sqrt

@@ -1,0 +1,374 @@
+"""
+Array creation functions.
+
+Parity with the reference's ``heat/core/factories.py`` (``arange`` :40, ``array``
+:150, ``asarray`` :434, ``empty`` :488, ``eye`` :586, the generic ``__factory``
+:665-718, ``full`` :789, ``linspace`` :896, ``logspace`` :982, ``meshgrid`` :1045,
+``ones`` :1128, ``zeros`` :1225 and the ``*_like`` variants). The reference allocates
+only the rank-local slab per process (``comm.chunk``); here each factory builds the
+global array lazily through jnp and places it with the sharding implied by ``split`` —
+on a mesh, XLA materialises only the per-device shard.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Tuple, Type, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import devices
+from .communication import Communication, MeshCommunication, sanitize_comm
+from .devices import Device
+from .dndarray import DNDarray
+from .stride_tricks import sanitize_axis, sanitize_shape
+from . import types
+from .types import datatype, canonical_heat_type
+
+__all__ = [
+    "arange",
+    "array",
+    "asarray",
+    "empty",
+    "empty_like",
+    "eye",
+    "from_numpy",
+    "full",
+    "full_like",
+    "linspace",
+    "logspace",
+    "meshgrid",
+    "ones",
+    "ones_like",
+    "zeros",
+    "zeros_like",
+]
+
+
+def __place(data: jax.Array, split: Optional[int], comm: Communication) -> jax.Array:
+    """Apply the sharding implied by ``split`` (replicates when not shardable)."""
+    if isinstance(comm, MeshCommunication) and split is not None:
+        return comm.shard(data, split)
+    return data
+
+
+def __sanitize_split(split: Optional[int], is_split: Optional[int], shape) -> Optional[int]:
+    if split is not None and is_split is not None:
+        raise ValueError("split and is_split are mutually exclusive")
+    s = split if split is not None else is_split
+    return sanitize_axis(tuple(shape), s)
+
+
+def array(
+    obj,
+    dtype: Optional[Type[datatype]] = None,
+    copy: bool = True,
+    ndmin: int = 0,
+    order: str = "C",
+    split: Optional[int] = None,
+    is_split: Optional[int] = None,
+    device: Optional[Union[str, Device]] = None,
+    comm: Optional[Communication] = None,
+) -> DNDarray:
+    """
+    Create a :class:`~heat_tpu.core.dndarray.DNDarray`.
+
+    Parameters
+    ----------
+    obj : array_like
+        Input data: scalar, (nested) sequence, numpy/jax array or DNDarray.
+    dtype : datatype, optional
+        Desired data type; inferred from ``obj`` if omitted.
+    copy : bool
+        Whether to force a copy (jax arrays are immutable; kept for parity).
+    ndmin : int
+        Minimum number of dimensions; prepends size-1 axes as needed.
+    order : str
+        Memory layout 'C' or 'F' (layout is XLA's concern; validated only).
+    split : int, optional
+        Axis to split the (global) data along across the device mesh.
+    is_split : int, optional
+        Axis along which ``obj`` is *already* the process-local chunk of a larger
+        array. In single-controller SPMD the controller holds all data, so this is
+        equivalent to ``split`` with the global shape inferred from ``obj`` (reference
+        factories.py:150-433 infers it with an Allreduce across ranks).
+    device, comm :
+        Placement overrides.
+
+    Reference parity: factories.py:150-433.
+    """
+    if order not in ("C", "F"):
+        raise ValueError(f"invalid memory layout, order must be 'C' or 'F', got {order}")
+    device = devices.sanitize_device(device if device is not None else (obj.device if isinstance(obj, DNDarray) else None))
+    comm = sanitize_comm(comm if comm is not None else (obj.comm if isinstance(obj, DNDarray) else None))
+
+    if isinstance(obj, DNDarray):
+        data = obj.larray
+        if split is None and is_split is None:
+            split = obj.split
+    elif isinstance(obj, (jnp.ndarray, jax.Array)):
+        data = obj
+    else:
+        data = jnp.asarray(np.asarray(obj) if not np.isscalar(obj) and not isinstance(obj, (list, tuple)) else obj)
+
+    if dtype is not None:
+        dtype = canonical_heat_type(dtype)
+        data = data.astype(dtype.jnp_type()) if data.dtype != dtype.jnp_type() else data
+    else:
+        dtype = canonical_heat_type(data.dtype)
+
+    if ndmin > 0 and data.ndim < ndmin:
+        data = data.reshape((1,) * (ndmin - data.ndim) + tuple(data.shape))
+
+    split = __sanitize_split(split, is_split, data.shape)
+    data = __place(data, split, comm)
+    return DNDarray(data, tuple(data.shape), dtype, split, device, comm, True)
+
+
+def asarray(
+    obj,
+    dtype: Optional[Type[datatype]] = None,
+    order: str = "C",
+    is_split: Optional[int] = None,
+    device: Optional[Union[str, Device]] = None,
+) -> DNDarray:
+    """Convert ``obj`` to a DNDarray without forcing a copy when avoidable
+    (reference factories.py:434-487)."""
+    if isinstance(obj, DNDarray) and (dtype is None or canonical_heat_type(dtype) is obj.dtype):
+        return obj
+    return array(obj, dtype=dtype, copy=False, order=order, is_split=is_split, device=device)
+
+
+def __factory(
+    shape,
+    dtype,
+    split,
+    local_factory,
+    device,
+    comm,
+    order: str = "C",
+) -> DNDarray:
+    """Abstract factory: build the global array, apply sharding, wrap (reference
+    factories.py:665-718)."""
+    shape = sanitize_shape(shape)
+    dtype = canonical_heat_type(dtype)
+    split = sanitize_axis(shape, split)
+    device = devices.sanitize_device(device)
+    comm = sanitize_comm(comm)
+    data = local_factory(shape, dtype=dtype.jnp_type())
+    data = __place(data, split, comm)
+    return DNDarray(data, shape, dtype, split, device, comm, True)
+
+
+def __factory_like(a, dtype, split, factory, device, comm, order="C", **kwargs) -> DNDarray:
+    """Abstract '*_like' factory (reference factories.py:719-788)."""
+    shape = a.shape if hasattr(a, "shape") else np.shape(a)
+    if dtype is None:
+        try:
+            dtype = types.heat_type_of(a)
+        except TypeError:
+            dtype = types.float32
+    if split is None and isinstance(a, DNDarray):
+        split = a.split
+    if device is None and isinstance(a, DNDarray):
+        device = a.device
+    if comm is None and isinstance(a, DNDarray):
+        comm = a.comm
+    return factory(shape, dtype=dtype, split=split, device=device, comm=comm, **kwargs)
+
+
+def arange(
+    *args,
+    dtype: Optional[Type[datatype]] = None,
+    split: Optional[int] = None,
+    device: Optional[Union[str, Device]] = None,
+    comm: Optional[Communication] = None,
+) -> DNDarray:
+    """
+    ``arange([start,] stop[, step])``: evenly spaced values within the half-open
+    interval (reference factories.py:40-149; there each rank computes its sub-range
+    analytically — here the sharding achieves the same placement).
+    """
+    if len(args) == 1:
+        start, stop, step = 0, args[0], 1
+    elif len(args) == 2:
+        start, stop, step = args[0], args[1], 1
+    elif len(args) == 3:
+        start, stop, step = args
+    else:
+        raise TypeError(f"arange takes 1 to 3 positional arguments, got {len(args)}")
+    data = jnp.arange(start, stop, step, dtype=dtype.jnp_type() if dtype is not None else None)
+    return array(data, dtype=dtype, split=split, device=device, comm=comm)
+
+
+def empty(
+    shape,
+    dtype: Type[datatype] = types.float32,
+    split: Optional[int] = None,
+    device: Optional[Union[str, Device]] = None,
+    comm: Optional[Communication] = None,
+    order: str = "C",
+) -> DNDarray:
+    """Uninitialized array of the given shape (reference factories.py:488-536; XLA
+    has no uninitialized allocation — zeros are used)."""
+    return __factory(shape, dtype, split, jnp.zeros, device, comm, order)
+
+
+def empty_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    """Empty array with the properties of ``a`` (reference factories.py:537-585)."""
+    return __factory_like(a, dtype, split, empty, device, comm, order=order)
+
+
+def eye(
+    shape,
+    dtype: Type[datatype] = types.float32,
+    split: Optional[int] = None,
+    device: Optional[Union[str, Device]] = None,
+    comm: Optional[Communication] = None,
+) -> DNDarray:
+    """2-D array with ones on the diagonal (reference factories.py:586-664)."""
+    if isinstance(shape, (int, np.integer)):
+        n, m = int(shape), int(shape)
+    else:
+        shape = tuple(shape)
+        n, m = (shape[0], shape[0]) if len(shape) == 1 else (shape[0], shape[1])
+    dtype = canonical_heat_type(dtype)
+    data = jnp.eye(n, m, dtype=dtype.jnp_type())
+    return array(data, dtype=dtype, split=split, device=device, comm=comm)
+
+
+def from_numpy(a: np.ndarray, split=None, device=None, comm=None) -> DNDarray:
+    """Create a DNDarray from a numpy array (convenience; TPU-native extension)."""
+    return array(a, split=split, device=device, comm=comm)
+
+
+def full(
+    shape,
+    fill_value,
+    dtype: Type[datatype] = types.float32,
+    split: Optional[int] = None,
+    device: Optional[Union[str, Device]] = None,
+    comm: Optional[Communication] = None,
+    order: str = "C",
+) -> DNDarray:
+    """Array of given shape filled with ``fill_value``; dtype defaults to float32
+    like the reference (factories.py:789-835)."""
+    if dtype is None:
+        dtype = types.float32
+
+    def local_factory(shape, dtype=None):
+        return jnp.full(shape, fill_value, dtype=dtype)
+
+    return __factory(shape, dtype, split, local_factory, device, comm, order)
+
+
+def full_like(a, fill_value, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    """Full array with the properties of ``a`` (reference factories.py:846-895)."""
+    if dtype is None and isinstance(a, DNDarray):
+        dtype = a.dtype
+    return __factory_like(a, dtype, split, full, device, comm, fill_value=fill_value, order=order)
+
+
+def linspace(
+    start,
+    stop,
+    num: int = 50,
+    endpoint: bool = True,
+    retstep: bool = False,
+    dtype: Optional[Type[datatype]] = None,
+    split: Optional[int] = None,
+    device: Optional[Union[str, Device]] = None,
+    comm: Optional[Communication] = None,
+):
+    """Evenly spaced numbers over an interval (reference factories.py:896-981)."""
+    num = int(num)
+    if num <= 0:
+        raise ValueError(f"number of samples 'num' must be non-negative, got {num}")
+    step = (stop - start) / max(1, num - int(bool(endpoint)))
+    data = jnp.linspace(start, stop, num, endpoint=endpoint,
+                        dtype=dtype.jnp_type() if dtype is not None else None)
+    ht = array(data, dtype=dtype, split=split, device=device, comm=comm)
+    if retstep:
+        return ht, step
+    return ht
+
+
+def logspace(
+    start,
+    stop,
+    num: int = 50,
+    endpoint: bool = True,
+    base: float = 10.0,
+    dtype: Optional[Type[datatype]] = None,
+    split: Optional[int] = None,
+    device: Optional[Union[str, Device]] = None,
+    comm: Optional[Communication] = None,
+) -> DNDarray:
+    """Numbers spaced evenly on a log scale (reference factories.py:982-1044)."""
+    data = jnp.logspace(start, stop, int(num), endpoint=endpoint, base=base,
+                        dtype=dtype.jnp_type() if dtype is not None else None)
+    return array(data, dtype=dtype, split=split, device=device, comm=comm)
+
+
+def meshgrid(*arrays, indexing: str = "xy") -> List[DNDarray]:
+    """Coordinate matrices from coordinate vectors (reference factories.py:1045-1127;
+    there the split of the last/second argument distributes the grid — the resulting
+    split metadata matches)."""
+    if indexing not in ("xy", "ij"):
+        raise ValueError(f"indexing must be 'xy' or 'ij', got {indexing}")
+    if not arrays:
+        return []
+    dnd = [a if isinstance(a, DNDarray) else array(a) for a in arrays]
+    splits = [a.split for a in dnd]
+    grids = jnp.meshgrid(*[a.larray for a in dnd], indexing=indexing)
+    # the reference splits the output grid along the dim corresponding to the
+    # (first) split input vector
+    out_split = None
+    for i, s in enumerate(splits):
+        if s is not None:
+            if len(dnd) == 1:
+                out_split = 0
+            elif indexing == "xy":
+                out_split = 0 if i == 1 else (1 if i == 0 else i)
+            else:
+                out_split = i
+            break
+    proto = dnd[0]
+    return [
+        array(g, split=out_split, device=proto.device, comm=proto.comm) for g in grids
+    ]
+
+
+def ones(
+    shape,
+    dtype: Type[datatype] = types.float32,
+    split: Optional[int] = None,
+    device: Optional[Union[str, Device]] = None,
+    comm: Optional[Communication] = None,
+    order: str = "C",
+) -> DNDarray:
+    """Array of ones (reference factories.py:1128-1176)."""
+    return __factory(shape, dtype, split, jnp.ones, device, comm, order)
+
+
+def ones_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    """Ones with the properties of ``a`` (reference factories.py:1177-1224)."""
+    return __factory_like(a, dtype, split, ones, device, comm, order=order)
+
+
+def zeros(
+    shape,
+    dtype: Type[datatype] = types.float32,
+    split: Optional[int] = None,
+    device: Optional[Union[str, Device]] = None,
+    comm: Optional[Communication] = None,
+    order: str = "C",
+) -> DNDarray:
+    """Array of zeros (reference factories.py:1225-1273)."""
+    return __factory(shape, dtype, split, jnp.zeros, device, comm, order)
+
+
+def zeros_like(a, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
+    """Zeros with the properties of ``a`` (reference factories.py:1274-1325)."""
+    return __factory_like(a, dtype, split, zeros, device, comm, order=order)

@@ -1,0 +1,51 @@
+"""
+Indexing operations.
+
+Parity with the reference's ``heat/core/indexing.py`` (``nonzero`` :16, ``where``
+:91). ``nonzero`` is eager (data-dependent output shape — fine outside jit; the
+reference offsets local indices by the split displacement, unnecessary on a global
+array).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import _operations
+from . import sanitation
+from .dndarray import DNDarray
+from . import types
+
+__all__ = ["nonzero", "where"]
+
+
+def nonzero(x) -> DNDarray:
+    """
+    Indices of nonzero elements as an (n, ndim) array (reference indexing.py:16-89
+    returns the transposed-stacked index layout of torch.nonzero).
+    """
+    sanitation.sanitize_in(x)
+    idx = jnp.stack(jnp.nonzero(x.larray), axis=1) if x.ndim > 0 else jnp.nonzero(x.larray.reshape(1))[0]
+    if x.ndim == 1:
+        idx = idx.reshape(-1)
+    split = 0 if x.split is not None else None
+    return DNDarray(idx, tuple(idx.shape), types.canonical_heat_type(idx.dtype), split, x.device, x.comm, True)
+
+
+def where(cond, x=None, y=None) -> DNDarray:
+    """
+    Either the nonzero indices (one argument) or element selection ``cond ? x : y``
+    (three arguments) (reference indexing.py:91-131).
+    """
+    if x is None and y is None:
+        return nonzero(cond)
+    if x is None or y is None:
+        raise TypeError("either both or neither of x and y must be given")
+    sanitation.sanitize_in(cond)
+    xv = x.larray if isinstance(x, DNDarray) else x
+    yv = y.larray if isinstance(y, DNDarray) else y
+    res = jnp.where(cond.larray, xv, yv)
+    split = cond.split
+    if split is not None and res.ndim != cond.ndim:
+        split = None
+    return DNDarray(res, tuple(res.shape), types.canonical_heat_type(res.dtype), split, cond.device, cond.comm, True)

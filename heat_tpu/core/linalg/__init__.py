@@ -1,0 +1,6 @@
+"""Linear algebra subpackage (parity: reference heat/core/linalg/__init__.py)."""
+
+from .basics import *
+from .qr import *
+from .solver import *
+from .svd import *

@@ -1,0 +1,266 @@
+"""
+Basic linear algebra.
+
+Parity with the reference's ``heat/core/linalg/basics.py`` (``__all__``: cross, det,
+dot, inv, matmul, matrix_norm, norm, outer, projection, trace, transpose, tril, triu,
+vdot, vecdot, vector_norm). The reference hand-schedules block-panel matmul with
+double-buffered ``Ibcast`` rounds (basics.py:799-1094) and a ring for ``outer``
+(:1565-1575); on TPU the sharded ``jnp.matmul`` *is* that algorithm — XLA SPMD emits
+the panel broadcasts/collectives and overlaps them with MXU compute via its
+latency-hiding scheduler.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import factories
+from .. import sanitation
+from .. import stride_tricks
+from .. import types
+from ..communication import MeshCommunication
+from ..dndarray import DNDarray
+
+__all__ = [
+    "cross",
+    "det",
+    "dot",
+    "inv",
+    "matmul",
+    "matrix_norm",
+    "norm",
+    "outer",
+    "projection",
+    "trace",
+    "transpose",
+    "tril",
+    "triu",
+    "vdot",
+    "vecdot",
+    "vector_norm",
+]
+
+
+def __wrap(proto: DNDarray, data: jax.Array, split) -> DNDarray:
+    return DNDarray(
+        data, tuple(data.shape), types.canonical_heat_type(data.dtype), split, proto.device, proto.comm, True
+    )
+
+
+def cross(a: DNDarray, b: DNDarray, axisa: int = -1, axisb: int = -1, axisc: int = -1, axis: int = -1) -> DNDarray:
+    """Cross product of 3-element vectors along an axis (reference
+    linalg/basics.py:47-159)."""
+    sanitation.sanitize_in(a)
+    sanitation.sanitize_in(b)
+    data = jnp.cross(a.larray, b.larray, axisa=axisa, axisb=axisb, axisc=axisc, axis=axis)
+    return __wrap(a, data, a.split if a.split is not None and a.split < data.ndim else None)
+
+
+def det(a: DNDarray) -> DNDarray:
+    """Determinant of a square matrix (reference linalg/basics.py:160-245 does
+    distributed row-block elimination with Bcast; here jnp.linalg.det — XLA's LU)."""
+    sanitation.sanitize_in(a)
+    if a.ndim < 2 or a.shape[-1] != a.shape[-2]:
+        raise ValueError("a must be a square matrix (or batch thereof)")
+    if not types.heat_type_is_inexact(a.dtype):
+        a = a.astype(types.float32)
+    data = jnp.linalg.det(a.larray)
+    return DNDarray(jnp.asarray(data), tuple(jnp.shape(data)), types.canonical_heat_type(jnp.asarray(data).dtype), None, a.device, a.comm, True)
+
+
+def dot(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None) -> Union[DNDarray, float]:
+    """
+    Dot product: scalar for 1-D inputs, matmul for 2-D (reference
+    linalg/basics.py:246-330).
+    """
+    if isinstance(a, DNDarray) and isinstance(b, DNDarray) and a.ndim == 1 and b.ndim == 1:
+        res = jnp.dot(a.larray, b.larray)
+        result = DNDarray(res, (), types.canonical_heat_type(res.dtype), None, a.device, a.comm, True)
+        if out is not None:
+            out.larray = res.astype(out.dtype.jnp_type())
+            return out
+        return result
+    if a.ndim <= 2 and b.ndim <= 2:
+        res = matmul(a, b)
+        if out is not None:
+            out.larray = res.larray.astype(out.dtype.jnp_type())
+            return out
+        return res
+    raise NotImplementedError("ht.dot supports 1-D and 2-D operands")
+
+
+def inv(a: DNDarray) -> DNDarray:
+    """Multiplicative inverse of a square matrix (reference linalg/basics.py:331-423
+    distributed Gauss-Jordan; here jnp.linalg.inv)."""
+    sanitation.sanitize_in(a)
+    if a.ndim < 2 or a.shape[-1] != a.shape[-2]:
+        raise ValueError("a must be a square matrix (or batch thereof)")
+    if not types.heat_type_is_inexact(a.dtype):
+        a = a.astype(types.float32)
+    data = jnp.linalg.inv(a.larray)
+    return __wrap(a, data, a.split)
+
+
+def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
+    """
+    Matrix multiplication (reference linalg/basics.py:424-1094). The reference's
+    case analysis over ``(a.split, b.split)`` with block-cyclic ``Ibcast`` panel
+    rounds is replaced by the sharded global ``jnp.matmul``: XLA SPMD partitions the
+    contraction, inserts the panel collectives over ICI and overlaps them with MXU
+    GEMMs. Split semantics of the result follow the reference: row-split ``a`` gives a
+    row-split result, column-split ``b`` a column-split result.
+    """
+    sanitation.sanitize_in(a)
+    sanitation.sanitize_in(b)
+    if a.ndim == 0 or b.ndim == 0:
+        raise ValueError("matmul requires at least 1-dimensional operands")
+    dtype = types.promote_types(a.dtype, b.dtype)
+    data = jnp.matmul(a.larray.astype(dtype.jnp_type()), b.larray.astype(dtype.jnp_type()))
+    ndim = data.ndim
+    if ndim == 0:
+        split = None
+    elif a.ndim >= 2 and a.split == a.ndim - 2:
+        split = ndim - 2
+    elif b.ndim >= 2 and b.split == b.ndim - 1:
+        split = ndim - 1
+    elif a.ndim >= 2 and a.split is not None and a.split < a.ndim - 2:
+        split = a.split  # batch dims
+    else:
+        split = None
+    return __wrap(a, data, split)
+
+
+def matrix_norm(x: DNDarray, axis=None, keepdims: bool = False, ord=None) -> DNDarray:
+    """Matrix norm over the last two (or given) axes (reference
+    linalg/basics.py:1095-1230)."""
+    sanitation.sanitize_in(x)
+    if axis is None:
+        if x.ndim < 2:
+            raise ValueError("matrix_norm requires at least 2 dimensions")
+        axis = (x.ndim - 2, x.ndim - 1)
+    axis = tuple(stride_tricks.sanitize_axis(x.shape, a) for a in axis)
+    data = jnp.linalg.norm(x.larray, ord=ord, axis=axis, keepdims=keepdims)
+    data = jnp.asarray(data)
+    return DNDarray(data, tuple(data.shape), types.canonical_heat_type(data.dtype), None, x.device, x.comm, True)
+
+
+def norm(x: DNDarray, axis=None, keepdims: bool = False, ord=None) -> DNDarray:
+    """Vector/matrix norm dispatch (reference linalg/basics.py:1231-1310)."""
+    sanitation.sanitize_in(x)
+    data = jnp.linalg.norm(x.larray, ord=ord, axis=axis, keepdims=keepdims)
+    data = jnp.asarray(data)
+    return DNDarray(data, tuple(data.shape), types.canonical_heat_type(data.dtype), None, x.device, x.comm, True)
+
+
+def outer(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None, split: Optional[int] = None) -> DNDarray:
+    """
+    Outer product of two vectors (reference linalg/basics.py:1372-1604 circulates
+    panels around a Send/Recv ring; here the sharded broadcast-multiply — XLA emits
+    the same systolic pattern for a (n,1)×(1,m) contraction).
+    """
+    sanitation.sanitize_in(a)
+    sanitation.sanitize_in(b)
+    dtype = types.promote_types(a.dtype, b.dtype)
+    data = jnp.outer(a.larray.astype(dtype.jnp_type()), b.larray.astype(dtype.jnp_type()))
+    if split is None:
+        split = 0 if a.split is not None else (1 if b.split is not None else None)
+    res = __wrap(a, data, split)
+    if out is not None:
+        out.larray = res.larray.astype(out.dtype.jnp_type())
+        return out
+    return res
+
+
+def projection(a: DNDarray, b: DNDarray) -> DNDarray:
+    """Projection of vector ``a`` onto vector ``b`` (reference
+    linalg/basics.py:1605-1628)."""
+    if a.ndim != 1 or b.ndim != 1:
+        raise RuntimeError(f"projection requires 1-D vectors, got {a.ndim}-d and {b.ndim}-d")
+    return (dot(a, b) / dot(b, b)) * b
+
+
+def trace(a: DNDarray, offset: int = 0, axis1: int = 0, axis2: int = 1, dtype=None, out=None):
+    """Sum along diagonals (reference linalg/basics.py:1629-1770)."""
+    sanitation.sanitize_in(a)
+    if a.ndim < 2:
+        raise ValueError("trace requires at least 2 dimensions")
+    data = jnp.trace(a.larray, offset=offset, axis1=axis1, axis2=axis2)
+    data = jnp.asarray(data)
+    if dtype is not None:
+        data = data.astype(types.canonical_heat_type(dtype).jnp_type())
+    res = DNDarray(data, tuple(data.shape), types.canonical_heat_type(data.dtype), None, a.device, a.comm, True)
+    if out is not None:
+        out.larray = data.astype(out.dtype.jnp_type())
+        return out
+    if res.ndim == 0:
+        return res.item()
+    return res
+
+
+def transpose(a: DNDarray, axes: Optional[List[int]] = None) -> DNDarray:
+    """Permute array dimensions; the split axis follows the permutation (reference
+    linalg/basics.py:2051-2120)."""
+    sanitation.sanitize_in(a)
+    if axes is None:
+        axes = list(range(a.ndim))[::-1]
+    axes = [stride_tricks.sanitize_axis(a.shape, ax) for ax in axes]
+    data = jnp.transpose(a.larray, axes)
+    split = axes.index(a.split) if a.split is not None else None
+    return __wrap(a, data, split)
+
+
+def tril(m: DNDarray, k: int = 0) -> DNDarray:
+    """Lower triangle (reference linalg/basics.py:2121-2178)."""
+    sanitation.sanitize_in(m)
+    data = jnp.tril(m.larray if m.ndim > 1 else jnp.tile(m.larray, (m.shape[0], 1)), k=k)
+    if m.ndim == 1:
+        return DNDarray(data, tuple(data.shape), m.dtype, None, m.device, m.comm, True)
+    return __wrap(m, data, m.split)
+
+
+def triu(m: DNDarray, k: int = 0) -> DNDarray:
+    """Upper triangle (reference linalg/basics.py:2179-2235)."""
+    sanitation.sanitize_in(m)
+    data = jnp.triu(m.larray if m.ndim > 1 else jnp.tile(m.larray, (m.shape[0], 1)), k=k)
+    if m.ndim == 1:
+        return DNDarray(data, tuple(data.shape), m.dtype, None, m.device, m.comm, True)
+    return __wrap(m, data, m.split)
+
+
+def vdot(x1: DNDarray, x2: DNDarray) -> DNDarray:
+    """Conjugated dot product of flattened inputs (reference
+    linalg/basics.py:2236-2270)."""
+    sanitation.sanitize_in(x1)
+    sanitation.sanitize_in(x2)
+    data = jnp.vdot(x1.larray, x2.larray)
+    return DNDarray(data, (), types.canonical_heat_type(data.dtype), None, x1.device, x1.comm, True)
+
+
+def vecdot(x1: DNDarray, x2: DNDarray, axis: Optional[int] = None, keepdim: bool = False) -> DNDarray:
+    """Vector dot product along an axis (reference linalg/basics.py:2271-2308)."""
+    sanitation.sanitize_in(x1)
+    sanitation.sanitize_in(x2)
+    if axis is None:
+        axis = -1
+    a, b = jnp.broadcast_arrays(x1.larray, x2.larray)
+    data = jnp.sum(jnp.conj(a) * b, axis=axis, keepdims=keepdim)
+    return DNDarray(data, tuple(data.shape), types.canonical_heat_type(data.dtype), None, x1.device, x1.comm, True)
+
+
+def vector_norm(x: DNDarray, axis=None, keepdims: bool = False, ord=None) -> DNDarray:
+    """Vector norm along an axis (reference linalg/basics.py:1311-1371)."""
+    sanitation.sanitize_in(x)
+    if axis is None and x.ndim > 1:
+        data = jnp.linalg.norm(x.larray.reshape(-1), ord=ord if ord is not None else 2)
+    else:
+        data = jnp.linalg.norm(x.larray, ord=ord if ord is not None else 2, axis=axis, keepdims=keepdims)
+    data = jnp.asarray(data)
+    return DNDarray(data, tuple(data.shape), types.canonical_heat_type(data.dtype), None, x.device, x.comm, True)
+
+
+DNDarray.__matmul__ = lambda self, other: matmul(self, other)
+DNDarray.transpose = transpose

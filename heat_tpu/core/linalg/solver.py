@@ -1,0 +1,128 @@
+"""
+Iterative solvers built entirely from framework ops.
+
+Parity with the reference's ``heat/core/linalg/solver.py`` (``cg`` :13-66,
+``lanczos`` :68-184) — algorithmic layer with no direct communication; all collectives
+come from the distributed matmul/dot underneath.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from .. import factories
+from .. import sanitation
+from ..dndarray import DNDarray
+from .basics import matmul, dot, transpose, norm
+
+__all__ = ["cg", "lanczos"]
+
+
+def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -> DNDarray:
+    """
+    Conjugate gradients for ``A @ x = b`` with symmetric positive-definite ``A``
+    (reference linalg/solver.py:13-66).
+    """
+    if not isinstance(A, DNDarray) or not isinstance(b, DNDarray) or not isinstance(x0, DNDarray):
+        raise TypeError("A, b and x0 need to be of type ht.DNDarray")
+    if A.ndim != 2:
+        raise RuntimeError("A needs to be a 2D matrix")
+    if b.ndim != 1:
+        raise RuntimeError("b needs to be a 1D vector")
+    if x0.ndim != 1:
+        raise RuntimeError("c needs to be a 1D vector")
+
+    r = b - matmul(A, x0)
+    p = r
+    rsold = matmul(r, r)
+    x = x0
+
+    for i in range(len(b)):
+        Ap = matmul(A, p)
+        alpha = rsold / matmul(p, Ap)
+        x = x + alpha * p
+        r = r - alpha * Ap
+        rsnew = matmul(r, r)
+        if float(jnp.sqrt(rsnew.larray)) < 1e-10:
+            if out is not None:
+                out.larray = x.larray
+                return out
+            return x
+        p = r + (rsnew / rsold) * p
+        rsold = rsnew
+
+    if out is not None:
+        out.larray = x.larray
+        return out
+    return x
+
+
+def lanczos(
+    A: DNDarray,
+    m: int,
+    v0: Optional[DNDarray] = None,
+    V_out: Optional[DNDarray] = None,
+    T_out: Optional[DNDarray] = None,
+) -> Tuple[DNDarray, DNDarray]:
+    """
+    Lanczos tridiagonalization of a symmetric matrix: returns ``(V, T)`` with
+    ``A ≈ V @ T @ V.T``, ``V`` the (n, m) Krylov basis and ``T`` tridiagonal
+    (reference linalg/solver.py:68-184).
+    """
+    if not isinstance(A, DNDarray):
+        raise TypeError(f"A needs to be of type ht.DNDarray, but was {type(A)}")
+    if not isinstance(m, int):
+        raise TypeError(f"m must be int, got {type(m)}")
+    n, column = A.shape
+    if n != column:
+        raise TypeError("A needs to be a square matrix")
+
+    T = factories.zeros((m, m), device=A.device, comm=A.comm)
+    if v0 is None:
+        from .. import random
+
+        vr = random.rand(n, split=A.split, device=A.device, comm=A.comm)
+        v0 = vr / norm(vr)
+    else:
+        if v0.split != A.split:
+            v0 = v0.resplit(A.split)
+
+    # first iteration
+    w = matmul(A, v0)
+    alpha = dot(w, v0)
+    w = w - alpha * v0
+    T[0, 0] = alpha
+    V = [v0]
+
+    for i in range(1, m):
+        beta = norm(w)
+        if abs(float(beta.larray)) < 1e-10:
+            # pick a new random orthogonal vector (breakdown restart)
+            from .. import random
+
+            vr = random.rand(n, split=A.split, device=A.device, comm=A.comm)
+            vi = vr / norm(vr)
+        else:
+            vi = w / beta
+        # full re-orthogonalization against previous basis vectors
+        for vj in V:
+            vi = vi - dot(vi, vj) * vj
+        vi = vi / norm(vi)
+        w = matmul(A, vi)
+        alpha = dot(w, vi)
+        w = w - alpha * vi - beta * V[-1]
+        T[i - 1, i] = beta
+        T[i, i - 1] = beta
+        T[i, i] = alpha
+        V.append(vi)
+
+    from ..manipulations import stack
+
+    V_dnd = transpose(stack(V, axis=0), None)  # (n, m)
+    if V_out is not None:
+        V_out.larray = V_dnd.larray
+        T_out.larray = T.larray
+        return V_out, T_out
+    return V_dnd, T

@@ -1,0 +1,114 @@
+"""
+Logical operations.
+
+Parity with the reference's ``heat/core/logical.py`` (``__all__`` at logical.py:20-34).
+``all``/``any`` reduce with MPI.LAND/LOR in the reference (via __reduce_op); here they
+are sharded jnp reductions.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from . import _operations
+from . import sanitation
+from .dndarray import DNDarray
+
+__all__ = [
+    "all",
+    "allclose",
+    "any",
+    "isclose",
+    "isfinite",
+    "isinf",
+    "isnan",
+    "isneginf",
+    "isposinf",
+    "logical_and",
+    "logical_not",
+    "logical_or",
+    "logical_xor",
+    "signbit",
+]
+
+
+def all(x, axis=None, out=None, keepdim=None) -> DNDarray:
+    """Whether all elements evaluate to True over the given axis (reference
+    logical.py all → MPI.LAND)."""
+    return _operations.__reduce_op(x, jnp.all, axis=axis, out=out, keepdims=bool(keepdim))
+
+
+def allclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False) -> bool:
+    """Whether all elements of two arrays are pairwise within tolerance (reference
+    logical.py allclose — scalar Allreduce there)."""
+    a = x.larray if isinstance(x, DNDarray) else jnp.asarray(x)
+    b = y.larray if isinstance(y, DNDarray) else jnp.asarray(y)
+    return bool(jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def any(x, axis=None, out=None, keepdim=None) -> DNDarray:
+    """Whether any element evaluates to True over the given axis (reference
+    logical.py any → MPI.LOR)."""
+    return _operations.__reduce_op(x, jnp.any, axis=axis, out=out, keepdims=bool(keepdim))
+
+
+def isclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False) -> DNDarray:
+    """Element-wise closeness within tolerance (reference logical.py isclose)."""
+    return _operations.__binary_op(
+        jnp.isclose, x, y, fn_kwargs={"rtol": rtol, "atol": atol, "equal_nan": equal_nan}
+    )
+
+
+def isfinite(x) -> DNDarray:
+    """Element-wise finiteness test (reference logical.py isfinite)."""
+    return _operations.__local_op(jnp.isfinite, x)
+
+
+def isinf(x) -> DNDarray:
+    """Element-wise infinity test (reference logical.py isinf)."""
+    return _operations.__local_op(jnp.isinf, x)
+
+
+def isnan(x) -> DNDarray:
+    """Element-wise NaN test (reference logical.py isnan)."""
+    return _operations.__local_op(jnp.isnan, x)
+
+
+def isneginf(x, out=None) -> DNDarray:
+    """Element-wise negative-infinity test (reference logical.py isneginf)."""
+    return _operations.__local_op(jnp.isneginf, x, out)
+
+
+def isposinf(x, out=None) -> DNDarray:
+    """Element-wise positive-infinity test (reference logical.py isposinf)."""
+    return _operations.__local_op(jnp.isposinf, x, out)
+
+
+def logical_and(t1, t2) -> DNDarray:
+    """Element-wise logical AND (reference logical.py logical_and)."""
+    return _operations.__binary_op(jnp.logical_and, t1, t2)
+
+
+def logical_not(t, out=None) -> DNDarray:
+    """Element-wise logical NOT (reference logical.py logical_not)."""
+    return _operations.__local_op(jnp.logical_not, t, out)
+
+
+def logical_or(t1, t2) -> DNDarray:
+    """Element-wise logical OR (reference logical.py logical_or)."""
+    return _operations.__binary_op(jnp.logical_or, t1, t2)
+
+
+def logical_xor(t1, t2) -> DNDarray:
+    """Element-wise logical XOR (reference logical.py logical_xor)."""
+    return _operations.__binary_op(jnp.logical_xor, t1, t2)
+
+
+def signbit(x, out=None) -> DNDarray:
+    """Element-wise signbit test (reference logical.py signbit)."""
+    return _operations.__local_op(jnp.signbit, x, out)
+
+
+DNDarray.all = all
+DNDarray.any = any

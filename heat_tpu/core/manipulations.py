@@ -1,0 +1,509 @@
+"""
+Array manipulation operations.
+
+Parity with the reference's ``heat/core/manipulations.py`` (``__all__`` at
+manipulations.py:25-60). The comm-heavy reference paths — ``concatenate``'s chunk-map
+matching (:188), ``reshape``'s Alltoallv re-chunking (:1878), ``sort``'s parallel
+sample-sort (:2263), ``unique``'s Allgatherv dedup (:3051), ``roll``'s neighbor sends
+(:1985) — are global jnp operations here whose collectives XLA emits from the sharding;
+data-dependent-shape ops (``unique``, ``nonzero``) run eagerly.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import factories
+from . import sanitation
+from . import stride_tricks
+from . import types
+from .communication import MeshCommunication
+from .dndarray import DNDarray
+
+__all__ = [
+    "balance",
+    "broadcast_to",
+    "column_stack",
+    "concatenate",
+    "diag",
+    "diagonal",
+    "dsplit",
+    "expand_dims",
+    "flatten",
+    "flip",
+    "fliplr",
+    "flipud",
+    "hsplit",
+    "hstack",
+    "moveaxis",
+    "pad",
+    "ravel",
+    "redistribute",
+    "repeat",
+    "reshape",
+    "resplit",
+    "roll",
+    "rot90",
+    "row_stack",
+    "shape",
+    "sort",
+    "split",
+    "squeeze",
+    "stack",
+    "swapaxes",
+    "tile",
+    "topk",
+    "unique",
+    "vsplit",
+    "vstack",
+]
+
+
+def __wrap(proto: DNDarray, data: jax.Array, split) -> DNDarray:
+    return DNDarray(
+        data, tuple(data.shape), types.canonical_heat_type(data.dtype), split, proto.device, proto.comm, True
+    )
+
+
+def balance(array: DNDarray, copy: bool = False) -> DNDarray:
+    """Out-of-place balance (reference manipulations.py balance). Balanced by
+    construction here; returns (a copy of) the array."""
+    sanitation.sanitize_in(array)
+    if copy:
+        from .memory import copy as _copy
+
+        return _copy(array)
+    return array
+
+
+def broadcast_to(x: DNDarray, shape) -> DNDarray:
+    """Broadcast an array to a new shape (view semantics; numpy parity)."""
+    sanitation.sanitize_in(x)
+    shape = stride_tricks.sanitize_shape(shape)
+    data = jnp.broadcast_to(x.larray, shape)
+    new_split = None if x.split is None else len(shape) - (x.ndim - x.split)
+    if new_split is not None and new_split < 0:
+        new_split = None
+    return __wrap(x, data, new_split)
+
+
+def column_stack(arrays: Sequence[DNDarray]) -> DNDarray:
+    """Stack 1-D/2-D arrays as columns of a 2-D array (reference manipulations.py
+    column_stack)."""
+    proto = arrays[0]
+    data = jnp.column_stack([a.larray if isinstance(a, DNDarray) else jnp.asarray(a) for a in arrays])
+    split = proto.split if proto.split == 0 else None
+    return __wrap(proto, data, split)
+
+
+def concatenate(arrays: Sequence[DNDarray], axis: int = 0) -> DNDarray:
+    """
+    Join arrays along an existing axis (reference manipulations.py:188-540, which
+    redistributes operands to matching chunk maps — a plain sharded concat here).
+    """
+    if not isinstance(arrays, (tuple, list)) or len(arrays) == 0:
+        raise TypeError("arrays must be a non-empty sequence of DNDarrays")
+    arrays = [a if isinstance(a, DNDarray) else factories.array(a) for a in arrays]
+    proto = arrays[0]
+    axis = stride_tricks.sanitize_axis(proto.shape, axis)
+    for a in arrays[1:]:
+        if a.ndim != proto.ndim:
+            raise ValueError("all input arrays must have the same number of dimensions")
+    out_dtype = arrays[0].dtype
+    for a in arrays[1:]:
+        out_dtype = types.promote_types(out_dtype, a.dtype)
+    data = jnp.concatenate([a.larray.astype(out_dtype.jnp_type()) for a in arrays], axis=axis)
+    split = proto.split
+    return __wrap(proto, data, split)
+
+
+def diag(a: DNDarray, offset: int = 0) -> DNDarray:
+    """Extract a diagonal (2-D input) or construct a diagonal array (1-D input)
+    (reference manipulations.py diag)."""
+    sanitation.sanitize_in(a)
+    if a.ndim > 2:
+        raise ValueError("input must be 1- or 2-dimensional")
+    if a.ndim == 2:
+        return diagonal(a, offset=offset)
+    data = jnp.diag(a.larray, k=offset)
+    return __wrap(a, data, a.split)
+
+
+def diagonal(a: DNDarray, offset: int = 0, dim1: int = 0, dim2: int = 1) -> DNDarray:
+    """Diagonal of the array along (dim1, dim2) (reference manipulations.py
+    diagonal)."""
+    sanitation.sanitize_in(a)
+    dim1 = stride_tricks.sanitize_axis(a.shape, dim1)
+    dim2 = stride_tricks.sanitize_axis(a.shape, dim2)
+    if dim1 == dim2:
+        raise ValueError("dim1 and dim2 must be different")
+    data = jnp.diagonal(a.larray, offset=offset, axis1=dim1, axis2=dim2)
+    # the two diagonal dims are removed and the diagonal appended last; a batch
+    # split shifts left past any removed lower axes, a split on dim1/dim2 is lost
+    split = a.split
+    if split is not None:
+        if split in (dim1, dim2):
+            split = None
+        else:
+            split -= sum(1 for d in (dim1, dim2) if d < split)
+    return __wrap(a, data, split)
+
+
+def dsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
+    """Split along the 3rd axis (reference manipulations.py dsplit)."""
+    return split(x, indices_or_sections, axis=2)
+
+
+def expand_dims(a: DNDarray, axis: int) -> DNDarray:
+    """Insert a new size-1 axis (reference manipulations.py expand_dims)."""
+    sanitation.sanitize_in(a)
+    axis = stride_tricks.sanitize_axis(tuple(a.shape) + (1,), axis)
+    data = jnp.expand_dims(a.larray, axis)
+    split = a.split
+    if split is not None and axis <= split:
+        split += 1
+    return __wrap(a, data, split)
+
+
+def flatten(a: DNDarray) -> DNDarray:
+    """Flatten to one dimension (reference manipulations.py flatten)."""
+    sanitation.sanitize_in(a)
+    data = a.larray.reshape(-1)
+    split = None if a.split is None else 0
+    return __wrap(a, data, split)
+
+
+def flip(a: DNDarray, axis=None) -> DNDarray:
+    """Reverse element order along the given axes (reference manipulations.py flip)."""
+    sanitation.sanitize_in(a)
+    axis = stride_tricks.sanitize_axis(a.shape, axis)
+    data = jnp.flip(a.larray, axis=axis)
+    return __wrap(a, data, a.split)
+
+
+def fliplr(a: DNDarray) -> DNDarray:
+    """Flip left/right (axis 1) (reference manipulations.py fliplr)."""
+    if a.ndim < 2:
+        raise IndexError("input must be at least 2-dimensional")
+    return flip(a, 1)
+
+
+def flipud(a: DNDarray) -> DNDarray:
+    """Flip up/down (axis 0) (reference manipulations.py flipud)."""
+    return flip(a, 0)
+
+
+def hsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
+    """Split horizontally (axis 1, or 0 for 1-D) (reference manipulations.py hsplit)."""
+    return split(x, indices_or_sections, axis=1 if x.ndim > 1 else 0)
+
+
+def hstack(arrays: Sequence[DNDarray]) -> DNDarray:
+    """Stack horizontally (reference manipulations.py hstack)."""
+    arrays = [a if isinstance(a, DNDarray) else factories.array(a) for a in arrays]
+    axis = 0 if arrays[0].ndim == 1 else 1
+    return concatenate(arrays, axis=axis)
+
+
+def moveaxis(x: DNDarray, source, destination) -> DNDarray:
+    """Move axes to new positions (reference manipulations.py moveaxis)."""
+    sanitation.sanitize_in(x)
+    data = jnp.moveaxis(x.larray, source, destination)
+    split = x.split
+    if split is not None:
+        order = list(range(x.ndim))
+        src = [source] if isinstance(source, int) else list(source)
+        dst = [destination] if isinstance(destination, int) else list(destination)
+        src = [s % x.ndim for s in src]
+        dst = [d % x.ndim for d in dst]
+        rest = [a for a in order if a not in src]
+        new_order = [None] * x.ndim
+        for s, d in zip(src, dst):
+            new_order[d] = s
+        it = iter(rest)
+        for i in range(x.ndim):
+            if new_order[i] is None:
+                new_order[i] = next(it)
+        split = new_order.index(split)
+    return __wrap(x, data, split)
+
+
+def pad(array: DNDarray, pad_width, mode: str = "constant", constant_values=0) -> DNDarray:
+    """
+    Pad an array (reference manipulations.py:1128-1360, which pads only the edge ranks
+    on the split axis — here a global jnp.pad; the sharding handles placement).
+    """
+    sanitation.sanitize_in(array)
+    kw = {"constant_values": constant_values} if mode == "constant" else {}
+    # normalize heat-style pad_width (list of tuples, possibly partial) to numpy form
+    data = jnp.pad(array.larray, pad_width, mode=mode, **kw)
+    return __wrap(array, data, array.split)
+
+
+def ravel(a: DNDarray) -> DNDarray:
+    """Flatten (view when possible) (reference manipulations.py ravel)."""
+    return flatten(a)
+
+
+def redistribute(arr: DNDarray, lshape_map=None, target_map=None) -> DNDarray:
+    """Out-of-place redistribute (reference manipulations.py redistribute)."""
+    from .memory import copy as _copy
+
+    out = _copy(arr)
+    out.redistribute_(lshape_map=lshape_map, target_map=target_map)
+    return out
+
+
+def repeat(a, repeats, axis: Optional[int] = None) -> DNDarray:
+    """Repeat elements of an array (reference manipulations.py repeat)."""
+    if not isinstance(a, DNDarray):
+        a = factories.array(a)
+    if isinstance(repeats, DNDarray):
+        repeats = repeats.larray
+    elif isinstance(repeats, (list, tuple, np.ndarray)):
+        repeats = jnp.asarray(repeats)
+    data = jnp.repeat(a.larray, repeats, axis=axis)
+    split = (None if a.split is None else 0) if axis is None else a.split
+    return __wrap(a, data, split)
+
+
+def reshape(a: DNDarray, *shape, new_split: Optional[int] = None, **kwargs) -> DNDarray:
+    """
+    Reshape without changing data (reference manipulations.py:1817-1984; the
+    Alltoallv re-chunk there is XLA's resharding here). ``new_split`` sets the split
+    axis of the result (default: preserves a split at axis position 0 when split).
+    """
+    sanitation.sanitize_in(a)
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    shape = tuple(int(s) for s in shape)
+    if new_split is None:
+        new_split = kwargs.get("new_split", None)
+    neg = [i for i, s in enumerate(shape) if s == -1]
+    if len(neg) > 1:
+        raise ValueError("can only specify one unknown dimension")
+    data = a.larray.reshape(shape)
+    if new_split is None:
+        new_split = None if a.split is None else (a.split if a.split < data.ndim and
+                                                  data.shape[a.split] == a.shape[a.split] else 0)
+    new_split = stride_tricks.sanitize_axis(tuple(data.shape), new_split)
+    return __wrap(a, data, new_split)
+
+
+def resplit(arr: DNDarray, axis: Optional[int] = None) -> DNDarray:
+    """Out-of-place split-axis change (reference manipulations.py resplit; one
+    resharding placement here)."""
+    from .memory import copy as _copy
+
+    out = _copy(arr)
+    out.resplit_(axis)
+    return out
+
+
+def roll(x: DNDarray, shift, axis=None) -> DNDarray:
+    """Roll elements along the given axes (reference manipulations.py:1985-2110 with
+    neighbor sends on the split axis; global jnp.roll here)."""
+    sanitation.sanitize_in(x)
+    data = jnp.roll(x.larray, shift, axis=axis)
+    return __wrap(x, data, x.split)
+
+
+def rot90(m: DNDarray, k: int = 1, axes=(0, 1)) -> DNDarray:
+    """Rotate by 90 degrees in the plane of the given axes (reference
+    manipulations.py rot90)."""
+    sanitation.sanitize_in(m)
+    axes = tuple(stride_tricks.sanitize_axis(m.shape, a) for a in axes)
+    if len(set(axes)) != 2:
+        raise ValueError("axes must be different")
+    data = jnp.rot90(m.larray, k=k, axes=axes)
+    split = m.split
+    if split in axes and k % 2 == 1:
+        split = axes[0] if split == axes[1] else axes[1]
+    return __wrap(m, data, split)
+
+
+def row_stack(arrays: Sequence[DNDarray]) -> DNDarray:
+    """Stack arrays row-wise (reference manipulations.py row_stack)."""
+    arrays = [a if isinstance(a, DNDarray) else factories.array(a) for a in arrays]
+    arrays2 = [a if a.ndim > 1 else expand_dims(a, 0) for a in arrays]
+    return concatenate(arrays2, axis=0)
+
+
+def shape(a: DNDarray) -> Tuple[int, ...]:
+    """Global shape of the array (reference manipulations.py shape)."""
+    sanitation.sanitize_in(a)
+    return a.shape
+
+
+def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
+    """
+    Sort along an axis; returns ``(sorted_values, original_indices)`` (reference
+    manipulations.py:2263-3050 implements a parallel sample-sort; here a sharded
+    global sort — XLA's distributed sort handles the exchange).
+    """
+    sanitation.sanitize_in(a)
+    axis = stride_tricks.sanitize_axis(a.shape, axis)
+    if axis is None:
+        axis = a.ndim - 1
+    idx = jnp.argsort(a.larray, axis=axis, descending=descending, stable=True)
+    vals = jnp.take_along_axis(a.larray, idx, axis=axis)
+    v = __wrap(a, vals, a.split)
+    idx_t = types.default_index_type()
+    i = DNDarray(
+        idx.astype(idx_t.jnp_type()), tuple(idx.shape), idx_t, a.split, a.device, a.comm, True
+    )
+    if out is not None:
+        if not isinstance(out, tuple) or len(out) != 2:
+            raise TypeError("out must be a tuple of two DNDarrays")
+        out[0].larray = vals.astype(out[0].dtype.jnp_type())
+        out[1].larray = idx.astype(out[1].dtype.jnp_type())
+        return out
+    return v, i
+
+
+def split(x: DNDarray, indices_or_sections, axis: int = 0) -> List[DNDarray]:
+    """
+    Split into multiple sub-arrays along an axis (reference manipulations.py split).
+    """
+    sanitation.sanitize_in(x)
+    axis = stride_tricks.sanitize_axis(x.shape, axis)
+    if isinstance(indices_or_sections, DNDarray):
+        indices_or_sections = indices_or_sections.numpy().tolist()
+    if isinstance(indices_or_sections, (int, np.integer)):
+        if x.shape[axis] % int(indices_or_sections) != 0:
+            raise ValueError("array split does not result in an equal division")
+    parts = jnp.split(x.larray, indices_or_sections, axis=axis)
+    split_meta = x.split if x.split != axis else None
+    return [__wrap(x, p, split_meta) for p in parts]
+
+
+def squeeze(x: DNDarray, axis=None) -> DNDarray:
+    """Remove size-1 axes (reference manipulations.py squeeze)."""
+    sanitation.sanitize_in(x)
+    axis = stride_tricks.sanitize_axis(x.shape, axis)
+    data = jnp.squeeze(x.larray, axis=axis)
+    split = x.split
+    if split is not None:
+        removed = (
+            [i for i, s in enumerate(x.shape) if s == 1]
+            if axis is None
+            else ([axis] if isinstance(axis, int) else list(axis))
+        )
+        if split in removed:
+            split = None
+        else:
+            split -= sum(1 for r in removed if r < split)
+    return __wrap(x, data, split)
+
+
+def stack(arrays: Sequence[DNDarray], axis: int = 0, out=None) -> DNDarray:
+    """Join arrays along a new axis (reference manipulations.py stack)."""
+    arrays = [a if isinstance(a, DNDarray) else factories.array(a) for a in arrays]
+    proto = arrays[0]
+    for a in arrays[1:]:
+        if a.shape != proto.shape:
+            raise ValueError("all input arrays must have the same shape")
+    data = jnp.stack([a.larray for a in arrays], axis=axis)
+    split = proto.split
+    if split is not None and axis <= split:
+        split += 1
+    result = __wrap(proto, data, split)
+    if out is not None:
+        out.larray = data.astype(out.dtype.jnp_type())
+        return out
+    return result
+
+
+def swapaxes(x: DNDarray, axis1: int, axis2: int) -> DNDarray:
+    """Interchange two axes (reference manipulations.py swapaxes)."""
+    sanitation.sanitize_in(x)
+    axis1 = stride_tricks.sanitize_axis(x.shape, axis1)
+    axis2 = stride_tricks.sanitize_axis(x.shape, axis2)
+    data = jnp.swapaxes(x.larray, axis1, axis2)
+    split = x.split
+    if split == axis1:
+        split = axis2
+    elif split == axis2:
+        split = axis1
+    return __wrap(x, data, split)
+
+
+def tile(x: DNDarray, reps) -> DNDarray:
+    """Construct an array by repeating ``x`` the number of times given by reps
+    (reference manipulations.py tile)."""
+    sanitation.sanitize_in(x)
+    if isinstance(reps, DNDarray):
+        reps = reps.numpy().tolist()
+    data = jnp.tile(x.larray, reps)
+    split = x.split if x.split is not None and data.ndim == x.ndim else None
+    return __wrap(x, data, split)
+
+
+def topk(a: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool = True, out=None):
+    """
+    The ``k`` largest (or smallest) elements along a dimension; returns
+    ``(values, indices)`` (reference manipulations.py topk: local top-k + allgather +
+    re-select; here a global lax.top_k).
+    """
+    sanitation.sanitize_in(a)
+    dim = stride_tricks.sanitize_axis(a.shape, dim)
+    moved = jnp.moveaxis(a.larray, dim, -1)
+    if largest:
+        vals, idx = jax.lax.top_k(moved, k)
+    else:
+        vals, idx = jax.lax.top_k(-moved, k)
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, dim)
+    idx = jnp.moveaxis(idx, -1, dim)
+    split = a.split if a.split != dim else None
+    v = __wrap(a, vals, split)
+    idx_t = types.default_index_type()
+    i = DNDarray(idx.astype(idx_t.jnp_type()), tuple(idx.shape), idx_t, split, a.device, a.comm, True)
+    if out is not None:
+        out[0].larray = vals.astype(out[0].dtype.jnp_type())
+        out[1].larray = idx.astype(out[1].dtype.jnp_type())
+        return out
+    return v, i
+
+
+def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis: Optional[int] = None):
+    """
+    Unique elements of the array (reference manipulations.py:3051+: local unique +
+    Allgatherv + global dedup; eager global jnp.unique here — the output shape is
+    data-dependent).
+    """
+    sanitation.sanitize_in(a)
+    res = jnp.unique(a.larray, return_inverse=return_inverse, axis=axis)
+    if return_inverse:
+        vals, inv = res
+        v = DNDarray(vals, tuple(vals.shape), a.dtype, None, a.device, a.comm, True)
+        idx_t = types.default_index_type()
+        i = DNDarray(inv.astype(idx_t.jnp_type()), tuple(inv.shape), idx_t, None, a.device, a.comm, True)
+        return v, i
+    vals = res
+    return DNDarray(vals, tuple(vals.shape), a.dtype, None, a.device, a.comm, True)
+
+
+def vsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
+    """Split vertically (axis 0) (reference manipulations.py vsplit)."""
+    return split(x, indices_or_sections, axis=0)
+
+
+def vstack(arrays: Sequence[DNDarray]) -> DNDarray:
+    """Stack vertically (reference manipulations.py vstack)."""
+    arrays = [a if isinstance(a, DNDarray) else factories.array(a) for a in arrays]
+    arrays = [a if a.ndim > 1 else expand_dims(a, 0) for a in arrays]
+    return concatenate(arrays, axis=0)
+
+
+DNDarray.expand_dims = expand_dims
+DNDarray.flatten = flatten
+DNDarray.ravel = ravel
+DNDarray.reshape = reshape
+DNDarray.resplit = resplit
+DNDarray.squeeze = squeeze
+DNDarray.unique = unique

@@ -1,0 +1,36 @@
+"""
+Memory/layout operations.
+
+Parity with the reference's ``heat/core/memory.py`` (``copy`` :13,
+``sanitize_memory_layout`` :42). Physical layout is XLA's concern on TPU, so the
+layout sanitizer validates and passes through.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .dndarray import DNDarray
+from . import sanitation
+
+__all__ = ["copy", "sanitize_memory_layout"]
+
+
+def copy(a: DNDarray) -> DNDarray:
+    """A (deep) copy of the array (reference memory.py:13-40)."""
+    sanitation.sanitize_in(a)
+    return DNDarray.__new_like__(a, jnp.copy(a.larray))
+
+
+def sanitize_memory_layout(x, order: str = "C"):
+    """
+    Return the array in the given memory layout (reference memory.py:42-94 permutes
+    torch strides). XLA chooses tilings on TPU; 'C'/'F' are validated and the array is
+    returned unchanged.
+    """
+    if order not in ("C", "F"):
+        raise ValueError(f"invalid memory layout, order must be 'C' or 'F', got {order}")
+    return x
+
+
+DNDarray.copy = copy

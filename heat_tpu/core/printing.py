@@ -1,0 +1,94 @@
+"""
+Global and local printing of DNDarrays.
+
+Parity with the reference's ``heat/core/printing.py`` (modes :30-149,
+``set_printoptions`` :150, formatting :184-295). The reference gathers a truncated
+copy to rank 0 (``_torch_data`` resplits to None, :208); here the controller already
+addresses the global array, so formatting is a numpy repr with heat-style framing.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["get_printoptions", "global_printing", "local_printing", "print0", "set_printoptions"]
+
+# default print options (numpy-aligned, reference printing.py:13-28)
+__PRINT_OPTIONS = dict(precision=4, threshold=1000, edgeitems=3, linewidth=120, sci_mode=None)
+__LOCAL_PRINTING = False
+
+
+def get_printoptions() -> dict:
+    """Returns the currently configured printing options (reference printing.py
+    get_printoptions)."""
+    return dict(__PRINT_OPTIONS)
+
+
+def set_printoptions(
+    precision=None, threshold=None, edgeitems=None, linewidth=None, profile=None, sci_mode=None
+):
+    """
+    Configures the printing options (reference printing.py:150-183).
+
+    Parameters
+    ----------
+    profile : str, optional
+        ``'default'``, ``'short'`` or ``'full'`` preset overridden by the explicit
+        options.
+    """
+    global __PRINT_OPTIONS
+    if profile == "default":
+        __PRINT_OPTIONS.update(precision=4, threshold=1000, edgeitems=3, linewidth=120)
+    elif profile == "short":
+        __PRINT_OPTIONS.update(precision=2, threshold=1000, edgeitems=2, linewidth=120)
+    elif profile == "full":
+        __PRINT_OPTIONS.update(precision=4, threshold=float("inf"), edgeitems=3, linewidth=120)
+    for key, val in (
+        ("precision", precision),
+        ("threshold", threshold),
+        ("edgeitems", edgeitems),
+        ("linewidth", linewidth),
+        ("sci_mode", sci_mode),
+    ):
+        if val is not None:
+            __PRINT_OPTIONS[key] = val
+
+
+def local_printing() -> None:
+    """Print only the process-local data (reference printing.py:30-60)."""
+    global __LOCAL_PRINTING
+    __LOCAL_PRINTING = True
+
+
+def global_printing() -> None:
+    """Print the global array (default; reference printing.py:61-99)."""
+    global __LOCAL_PRINTING
+    __LOCAL_PRINTING = False
+
+
+def print0(*args, **kwargs) -> None:
+    """Print from rank 0 only (reference printing.py:100-149). One controller here —
+    plain print."""
+    import jax
+
+    if jax.process_index() == 0:
+        print(*args, **kwargs)
+
+
+def __str__(dndarray) -> str:
+    """Returns the string representation of the given array (reference
+    printing.py:184-295)."""
+    opts = __PRINT_OPTIONS
+    with np.printoptions(
+        precision=opts["precision"],
+        threshold=int(opts["threshold"]) if opts["threshold"] != float("inf") else np.iinfo(np.int64).max,
+        edgeitems=opts["edgeitems"],
+        linewidth=opts["linewidth"],
+    ):
+        body = np.array2string(
+            np.asarray(dndarray.numpy()), separator=", ", prefix="DNDarray("
+        )
+    return (
+        f"DNDarray({body}, dtype=ht.{dndarray.dtype.__name__}, "
+        f"device={dndarray.device}, split={dndarray.split})"
+    )

@@ -1,0 +1,214 @@
+"""
+Counter-based parallel pseudo-random number generation.
+
+Parity with the reference's ``heat/core/random.py``: the reference hand-implements the
+Threefry-2x32/2x64 block cipher in tensorized torch (random.py:868-1041) and assigns
+each rank the counter range of its chunk (:55-202) so results are identical regardless
+of process count. JAX's native PRNG *is* Threefry-2x32 — the same cipher family — so
+this module keeps a global ``(seed, counter)`` state (:764-818) and derives a fresh key
+per call by folding the counter into the seed key. Being single-controller, results are
+trivially device-count-invariant; the sharding of the output only affects layout.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import devices as _devices
+from . import factories
+from . import types
+from .communication import sanitize_comm
+from .dndarray import DNDarray
+from .stride_tricks import sanitize_shape
+
+__all__ = [
+    "get_state",
+    "normal",
+    "permutation",
+    "rand",
+    "ranf",
+    "randint",
+    "random_integer",
+    "randn",
+    "random",
+    "random_sample",
+    "randperm",
+    "sample",
+    "seed",
+    "set_state",
+    "standard_normal",
+]
+
+# global (seed, counter) state, reference random.py:764-818
+__seed: int = 0
+__counter: int = 0
+
+
+def __next_key(nelem: int) -> jax.Array:
+    """Derive the key for the next ``nelem`` draws and advance the counter."""
+    global __counter
+    key = jax.random.fold_in(jax.random.PRNGKey(__seed), __counter % (2**31))
+    __counter += max(int(nelem), 1)
+    return key
+
+
+def __wrap(data: jax.Array, dtype, split, device, comm) -> DNDarray:
+    device = _devices.sanitize_device(device)
+    comm = sanitize_comm(comm)
+    arr = factories.array(data, dtype=dtype, split=split, device=device, comm=comm)
+    return arr
+
+
+def get_state() -> Tuple[str, int, int, int, float]:
+    """The internal state of the generator as
+    ``('Threefry', seed, counter, 0, 0.0)`` (reference random.py:203-219)."""
+    return ("Threefry", __seed, __counter, 0, 0.0)
+
+
+def set_state(state: Tuple[str, int, int, int, float]) -> None:
+    """
+    Sets the internal state of the generator; accepts the tuple layout of
+    :func:`get_state` (reference random.py:782-818).
+
+    Raises
+    ------
+    TypeError / ValueError
+        If the state tuple is malformed.
+    """
+    global __seed, __counter
+    if not isinstance(state, (tuple, list)) or len(state) not in (3, 5):
+        raise TypeError("state needs to be a 3- or 5-tuple")
+    if state[0] != "Threefry":
+        raise ValueError("algorithm must be 'Threefry'")
+    __seed = int(state[1])
+    __counter = int(state[2])
+
+
+def seed(new_seed: Optional[int] = None) -> None:
+    """Seed the generator; ``None`` draws entropy from the OS (reference
+    random.py:764-781)."""
+    global __seed, __counter
+    if new_seed is None:
+        new_seed = int.from_bytes(np.random.bytes(4), "little")
+    __seed = int(new_seed)
+    __counter = 0
+
+
+def __shape_of(args) -> Tuple[int, ...]:
+    if len(args) == 0:
+        return ()
+    if len(args) == 1 and isinstance(args[0], (tuple, list)):
+        return tuple(args[0])
+    return tuple(int(a) for a in args)
+
+
+def rand(*d, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """
+    Uniform random samples in [0, 1) of the given shape (reference random.py:268-330).
+    """
+    shape = __shape_of(d)
+    nelem = int(np.prod(shape)) if shape else 1
+    key = __next_key(nelem)
+    dtype = types.canonical_heat_type(dtype)
+    data = jax.random.uniform(key, shape, dtype=jnp.float32).astype(dtype.jnp_type())
+    return __wrap(data, dtype, split, device, comm)
+
+
+def randint(
+    low: int,
+    high: Optional[int] = None,
+    size: Optional[Union[int, Tuple[int, ...]]] = None,
+    dtype=types.int32,
+    split=None,
+    device=None,
+    comm=None,
+) -> DNDarray:
+    """
+    Random integers in [low, high) — or [0, low) when ``high`` is None — of the given
+    ``size`` (reference random.py:331-420).
+    """
+    if high is None:
+        low, high = 0, low
+    if high <= low:
+        raise ValueError("low >= high")
+    if size is None:
+        size = ()
+    shape = sanitize_shape(size) if size != () else ()
+    nelem = int(np.prod(shape)) if shape else 1
+    key = __next_key(nelem)
+    dtype = types.canonical_heat_type(dtype)
+    data = jax.random.randint(key, shape, int(low), int(high)).astype(dtype.jnp_type())
+    return __wrap(data, dtype, split, device, comm)
+
+
+random_integer = randint
+
+
+def randn(*d, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """
+    Standard-normal random samples of the given shape (reference random.py:584-640 via
+    the Kundu transform; jax uses inverse-CDF/Box-Muller in native XLA).
+    """
+    shape = __shape_of(d)
+    nelem = int(np.prod(shape)) if shape else 1
+    key = __next_key(nelem)
+    dtype = types.canonical_heat_type(dtype)
+    data = jax.random.normal(key, shape, dtype=jnp.float32).astype(dtype.jnp_type())
+    return __wrap(data, dtype, split, device, comm)
+
+
+def normal(mean=0.0, std=1.0, shape=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Normal samples with the given mean and standard deviation (reference
+    random.py:641-700)."""
+    if np.any(np.asarray(std) < 0):
+        raise ValueError("std must be non-negative")
+    shape = () if shape is None else sanitize_shape(shape)
+    base = randn(*shape, dtype=dtype, split=split, device=device, comm=comm)
+    return base * std + mean
+
+
+def standard_normal(shape=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Standard-normal samples (reference random.py:701-763)."""
+    shape = () if shape is None else sanitize_shape(shape)
+    return randn(*shape, dtype=dtype, split=split, device=device, comm=comm)
+
+
+def random(shape=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
+    """Uniform [0,1) samples of the given shape (reference random.py random/
+    random_sample)."""
+    shape = () if shape is None else sanitize_shape(shape)
+    return rand(*shape, dtype=dtype, split=split, device=device, comm=comm)
+
+
+random_sample = random
+ranf = random
+sample = random
+
+
+def randperm(n: int, dtype=None, split=None, device=None, comm=None) -> DNDarray:
+    """A random permutation of ``range(n)`` (reference random.py randperm)."""
+    if not isinstance(n, (int, np.integer)):
+        raise TypeError(f"n must be an integer, got {type(n)}")
+    if dtype is None:
+        dtype = types.default_index_type()
+    key = __next_key(int(n))
+    data = jax.random.permutation(key, int(n))
+    return __wrap(data, types.canonical_heat_type(dtype), split, device, comm)
+
+
+def permutation(x) -> DNDarray:
+    """
+    Randomly permute a sequence: ints become permuted ranges, arrays are shuffled
+    along the first axis (reference random.py permutation).
+    """
+    if isinstance(x, (int, np.integer)):
+        return randperm(int(x))
+    if isinstance(x, DNDarray):
+        key = __next_key(x.shape[0] if x.ndim else 1)
+        data = jax.random.permutation(key, x.larray, axis=0)
+        return DNDarray.__new_like__(x, data)
+    raise TypeError(f"x must be int or DNDarray, got {type(x)}")

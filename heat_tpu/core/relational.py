@@ -1,0 +1,99 @@
+"""
+Relational (comparison) operations.
+
+Parity with the reference's ``heat/core/relational.py`` (``__all__`` at
+relational.py:19-32). ``equal``'s global AND (there an MPI scalar Allreduce) is a
+sharded jnp.all here.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import _operations
+from .dndarray import DNDarray
+
+__all__ = [
+    "eq",
+    "equal",
+    "ge",
+    "greater",
+    "greater_equal",
+    "gt",
+    "le",
+    "less",
+    "less_equal",
+    "lt",
+    "ne",
+    "not_equal",
+]
+
+
+def eq(t1, t2) -> DNDarray:
+    """Element-wise ``t1 == t2`` as uint8/bool array (reference relational.py eq)."""
+    return _operations.__binary_op(jnp.equal, t1, t2)
+
+
+def equal(t1, t2) -> bool:
+    """``True`` if both operands have the same shape and all elements equal
+    (reference relational.py equal — scalar AND Allreduce there)."""
+    from . import factories
+
+    if not isinstance(t1, DNDarray) and not isinstance(t2, DNDarray):
+        t1 = factories.array(t1)
+    a = t1.larray if isinstance(t1, DNDarray) else jnp.asarray(t1)
+    b = t2.larray if isinstance(t2, DNDarray) else jnp.asarray(t2)
+    if tuple(jnp.shape(a)) != tuple(jnp.shape(b)):
+        try:
+            jnp.broadcast_shapes(jnp.shape(a), jnp.shape(b))
+        except ValueError:
+            return False
+    return bool(jnp.all(a == b))
+
+
+def ge(t1, t2) -> DNDarray:
+    """Element-wise ``t1 >= t2`` (reference relational.py ge)."""
+    return _operations.__binary_op(jnp.greater_equal, t1, t2)
+
+
+greater_equal = ge
+
+
+def gt(t1, t2) -> DNDarray:
+    """Element-wise ``t1 > t2`` (reference relational.py gt)."""
+    return _operations.__binary_op(jnp.greater, t1, t2)
+
+
+greater = gt
+
+
+def le(t1, t2) -> DNDarray:
+    """Element-wise ``t1 <= t2`` (reference relational.py le)."""
+    return _operations.__binary_op(jnp.less_equal, t1, t2)
+
+
+less_equal = le
+
+
+def lt(t1, t2) -> DNDarray:
+    """Element-wise ``t1 < t2`` (reference relational.py lt)."""
+    return _operations.__binary_op(jnp.less, t1, t2)
+
+
+less = lt
+
+
+def ne(t1, t2) -> DNDarray:
+    """Element-wise ``t1 != t2`` (reference relational.py ne)."""
+    return _operations.__binary_op(jnp.not_equal, t1, t2)
+
+
+not_equal = ne
+
+DNDarray.__eq__ = lambda self, other: eq(self, other)
+DNDarray.__ne__ = lambda self, other: ne(self, other)
+DNDarray.__lt__ = lambda self, other: lt(self, other)
+DNDarray.__le__ = lambda self, other: le(self, other)
+DNDarray.__gt__ = lambda self, other: gt(self, other)
+DNDarray.__ge__ = lambda self, other: ge(self, other)
+DNDarray.__hash__ = None

@@ -1,0 +1,117 @@
+"""
+Rounding operations (all element-local).
+
+Parity with the reference's ``heat/core/rounding.py`` (``__all__`` at
+rounding.py:15-27).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from . import _operations
+from . import sanitation
+from .dndarray import DNDarray
+
+__all__ = ["abs", "absolute", "ceil", "clip", "fabs", "floor", "modf", "round", "sgn", "sign", "trunc"]
+
+
+def abs(x, out=None, dtype=None) -> DNDarray:
+    """Element-wise absolute value; optional output dtype (reference rounding.py abs)."""
+    from .types import canonical_heat_type, datatype
+
+    res = _operations.__local_op(jnp.abs, x, out)
+    if dtype is not None:
+        if not isinstance(dtype, type) or not issubclass(dtype, datatype):
+            raise TypeError("dtype must be a heat data type")
+        res = res.astype(canonical_heat_type(dtype), copy=False)
+    return res
+
+
+absolute = abs
+
+
+def ceil(x, out=None) -> DNDarray:
+    """Element-wise ceiling (reference rounding.py ceil)."""
+    return _operations.__local_op(jnp.ceil, x, out)
+
+
+def clip(x, min, max, out=None) -> DNDarray:
+    """Clip values to the interval [min, max] (reference rounding.py clip)."""
+    sanitation.sanitize_in(x)
+    if min is None and max is None:
+        raise ValueError("either min or max must be set")
+    return _operations.__local_op(jnp.clip, x, out, min=min, max=max)
+
+
+def fabs(x, out=None) -> DNDarray:
+    """Element-wise absolute value, float result (reference rounding.py fabs)."""
+    from . import types
+
+    res = _operations.__local_op(jnp.abs, x, None)
+    if not types.heat_type_is_inexact(res.dtype):
+        res = res.astype(types.float32, copy=False)
+    if out is not None:
+        sanitation.sanitize_out(out, res.shape, res.split, res.device)
+        out.larray = res.larray.astype(out.dtype.jnp_type())
+        return out
+    return res
+
+
+def floor(x, out=None) -> DNDarray:
+    """Element-wise floor (reference rounding.py floor)."""
+    return _operations.__local_op(jnp.floor, x, out)
+
+
+def modf(x, out=None) -> Tuple[DNDarray, DNDarray]:
+    """Fractional and integral parts (reference rounding.py modf)."""
+    sanitation.sanitize_in(x)
+    frac, integ = jnp.modf(x.larray)
+    f = DNDarray.__new_like__(x, frac)
+    i = DNDarray.__new_like__(x, integ)
+    if out is not None:
+        if not isinstance(out, tuple) or len(out) != 2:
+            raise TypeError("out must be a tuple of two DNDarrays")
+        out[0].larray, out[1].larray = frac, integ
+        return out
+    return f, i
+
+
+def round(x, decimals: int = 0, out=None, dtype=None) -> DNDarray:
+    """Round to the given number of decimals (reference rounding.py round)."""
+    from .types import canonical_heat_type
+
+    res = _operations.__local_op(jnp.round, x, out, decimals=decimals)
+    if dtype is not None:
+        res = res.astype(canonical_heat_type(dtype), copy=False)
+    return res
+
+
+def sgn(x, out=None) -> DNDarray:
+    """Element-wise sign (complex: x/|x|) (reference rounding.py sgn)."""
+    return _operations.__local_op(jnp.sign, x, out)
+
+
+def sign(x, out=None) -> DNDarray:
+    """Element-wise sign; complex input uses the sign of the real part (reference
+    rounding.py sign)."""
+    from . import types
+
+    if issubclass(x.dtype, types.complexfloating):
+        sanitation.sanitize_in(x)
+        res = jnp.sign(jnp.real(x.larray)).astype(x.dtype.jnp_type())
+        return DNDarray.__new_like__(x, res)
+    return _operations.__local_op(jnp.sign, x, out)
+
+
+def trunc(x, out=None) -> DNDarray:
+    """Element-wise truncation (reference rounding.py trunc)."""
+    return _operations.__local_op(jnp.trunc, x, out)
+
+
+DNDarray.__abs__ = lambda self: abs(self)
+DNDarray.abs = abs
+DNDarray.clip = clip
+DNDarray.round = round

@@ -1,0 +1,128 @@
+"""
+Input/output validation and distribution sanitation.
+
+Parity with the reference's ``heat/core/sanitation.py`` (``sanitize_distribution``
+:31-158, ``sanitize_out`` :259, plus ``sanitize_in``/``sanitize_sequence``/
+``scalar_to_1d``). Under balanced JAX shardings, "matching the distribution" of
+operands needs no data motion — XLA reshards lazily — so these helpers validate
+metadata compatibility instead of chaining Send/Recv.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple, Union
+
+import numpy as np
+import jax.numpy as jnp
+
+from .communication import MeshCommunication
+from .dndarray import DNDarray
+
+__all__ = [
+    "sanitize_distribution",
+    "sanitize_in",
+    "sanitize_infinity",
+    "sanitize_in_tensor",
+    "sanitize_lshape",
+    "sanitize_out",
+    "sanitize_sequence",
+    "scalar_to_1d",
+]
+
+
+def sanitize_distribution(*args: DNDarray, target: DNDarray, diff_map=None) -> Union[DNDarray, Tuple[DNDarray, ...]]:
+    """
+    Distribute every arg like ``target`` (reference sanitation.py:31-158, which
+    physically redistributes via ``redistribute_``). Balanced shardings mean the only
+    action needed is aligning the logical split where shapes allow it.
+    """
+    out = []
+    tsplit = target.split
+    tshape = target.shape
+    for arg in args:
+        sanitize_in(arg)
+        if arg.split == tsplit or tsplit is None or arg.split is None:
+            out.append(arg)
+        else:
+            out.append(arg.resplit_(tsplit) if arg.shape == tshape else arg)
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def sanitize_in(x: Any) -> None:
+    """Verify ``x`` is a DNDarray; raise TypeError otherwise (reference
+    sanitation.py sanitize_in)."""
+    if not isinstance(x, DNDarray):
+        raise TypeError(f"input needs to be a DNDarray, but was {type(x)}")
+
+
+def sanitize_in_tensor(x: Any) -> None:
+    """Verify ``x`` is a jax array (the reference checks torch.Tensor)."""
+    if not isinstance(x, (jnp.ndarray, np.ndarray)):
+        raise TypeError(f"input needs to be an array, but was {type(x)}")
+
+
+def sanitize_infinity(x: DNDarray) -> Union[int, float]:
+    """Largest representable value of ``x``'s dtype (reference sanitation.py
+    sanitize_infinity)."""
+    dt = np.dtype(x.dtype.jnp_type())
+    if dt.kind in "iu":
+        return int(np.iinfo(dt).max)
+    return float("inf")
+
+
+def sanitize_lshape(array: DNDarray, tensor) -> None:
+    """Verify that ``tensor`` is a legal local shard of ``array`` (reference
+    sanitation.py sanitize_lshape)."""
+    gshape = array.shape
+    tshape = tuple(tensor.shape)
+    if tshape == gshape:
+        return
+    split = array.split
+    if split is None:
+        raise ValueError(f"local tensor of shape {tshape} is not a chunk of global shape {gshape}")
+    non_split_ok = all(t == g for d, (t, g) in enumerate(zip(tshape, gshape)) if d != split)
+    if not non_split_ok or tshape[split] > gshape[split]:
+        raise ValueError(f"local tensor of shape {tshape} is not a chunk of global shape {gshape} on split {split}")
+
+
+def sanitize_out(
+    out: Any,
+    output_shape: Tuple[int, ...],
+    output_split,
+    output_device,
+    output_comm=None,
+) -> None:
+    """
+    Validate that ``out`` is a DNDarray suitable to receive a result of the given
+    global shape/split/device (reference sanitation.py:259-386). Broadcasting of the
+    result into ``out`` is permitted per NumPy rules.
+    """
+    if not isinstance(out, DNDarray):
+        raise TypeError(f"expected out to be None or a DNDarray, but was {type(out)}")
+    out_proto = np.broadcast_shapes(tuple(output_shape), tuple(out.shape))
+    if out_proto != tuple(out.shape):
+        raise ValueError(
+            f"Expecting output buffer of shape {tuple(output_shape)}, got {tuple(out.shape)}"
+        )
+
+
+def sanitize_sequence(seq: Any) -> list:
+    """Check that ``seq`` is a sequence and return it as a list (reference
+    sanitation.py sanitize_sequence)."""
+    if isinstance(seq, list):
+        return seq
+    if isinstance(seq, tuple):
+        return list(seq)
+    if isinstance(seq, DNDarray):
+        return seq.tolist()
+    if isinstance(seq, (np.ndarray, jnp.ndarray)):
+        return list(np.asarray(seq))
+    raise TypeError(f"seq must be a list, tuple, DNDarray or array, got {type(seq)}")
+
+
+def scalar_to_1d(x: DNDarray) -> DNDarray:
+    """Turn a scalar DNDarray into a 1-D DNDarray with one element (reference
+    sanitation.py scalar_to_1d)."""
+    if x.ndim != 0:
+        return x
+    return DNDarray.__new_like__(x, x.larray.reshape(1), split=None)

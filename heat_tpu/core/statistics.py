@@ -1,0 +1,321 @@
+"""
+Statistical operations.
+
+Parity with the reference's ``heat/core/statistics.py`` (``__all__`` at
+statistics.py:22-41). The reference's distributed machinery — pairwise moment merging
+over Allreduced (μ, n) tuples (:51-118, :741-866), custom ``MPI_ARGMAX``/``MPI_ARGMIN``
+ops over packed (value, index) buffers (:1218), distributed selection for
+``median``/``percentile`` (:867-1074) — all lowers to sharded jnp reductions here: XLA
+emits the psum/pmax collectives and the (value, index) argmax pattern is a native
+variadic reduce.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import numpy as np
+import jax.numpy as jnp
+
+from . import _operations
+from . import factories
+from . import sanitation
+from . import stride_tricks
+from . import types
+from .communication import sanitize_comm
+from .dndarray import DNDarray
+
+__all__ = [
+    "argmax",
+    "argmin",
+    "average",
+    "bincount",
+    "bucketize",
+    "cov",
+    "digitize",
+    "histc",
+    "histogram",
+    "kurtosis",
+    "max",
+    "maximum",
+    "mean",
+    "median",
+    "min",
+    "minimum",
+    "percentile",
+    "skew",
+    "std",
+    "var",
+]
+
+# builtins shadowed by min/max
+_builtin_min = min
+_builtin_max = max
+
+
+def argmax(x, axis=None, out=None, **kwargs) -> DNDarray:
+    """
+    Indices of the maximum values along an axis; flattened-index result for
+    ``axis=None`` (reference statistics.py argmax via the packed (value,index)
+    MPI_ARGMAX op, :1218)."""
+    res = _operations.__reduce_op(x, jnp.argmax, axis=axis, out=None, keepdims=kwargs.get("keepdim", False))
+    res = res.astype(types.default_index_type(), copy=False)
+    if out is not None:
+        sanitation.sanitize_out(out, res.shape, res.split, res.device)
+        out.larray = res.larray.astype(out.dtype.jnp_type())
+        return out
+    return res
+
+
+def argmin(x, axis=None, out=None, **kwargs) -> DNDarray:
+    """Indices of the minimum values along an axis (reference statistics.py argmin)."""
+    res = _operations.__reduce_op(x, jnp.argmin, axis=axis, out=None, keepdims=kwargs.get("keepdim", False))
+    res = res.astype(types.default_index_type(), copy=False)
+    if out is not None:
+        sanitation.sanitize_out(out, res.shape, res.split, res.device)
+        out.larray = res.larray.astype(out.dtype.jnp_type())
+        return out
+    return res
+
+
+def average(x, axis=None, weights=None, returned: bool = False):
+    """
+    Weighted average over the given axis (reference statistics.py average).
+
+    Returns ``(average, sum_of_weights)`` if ``returned``.
+    """
+    sanitation.sanitize_in(x)
+    w = weights.larray if isinstance(weights, DNDarray) else weights
+    axis = stride_tricks.sanitize_axis(x.shape, axis)
+    avg, wsum = jnp.average(x.larray, axis=axis, weights=w, returned=True)
+    split = x.split
+    if split is not None:
+        if axis is None or axis == split:
+            split = None
+        elif axis < split:
+            split -= 1
+    res = DNDarray(avg, tuple(avg.shape), types.canonical_heat_type(avg.dtype), split, x.device, x.comm, True)
+    if returned:
+        wret = DNDarray(
+            jnp.broadcast_to(wsum, avg.shape),
+            tuple(avg.shape),
+            types.canonical_heat_type(jnp.asarray(wsum).dtype),
+            split,
+            x.device,
+            x.comm,
+            True,
+        )
+        return res, wret
+    return res
+
+
+def bincount(x, weights=None, minlength: int = 0) -> DNDarray:
+    """Count occurrences of each value in a non-negative int array (reference
+    statistics.py bincount; eager — data-dependent output length)."""
+    sanitation.sanitize_in(x)
+    w = weights.larray if isinstance(weights, DNDarray) else weights
+    res = jnp.bincount(x.larray, weights=w, minlength=minlength)
+    return DNDarray(res, tuple(res.shape), types.canonical_heat_type(res.dtype), None, x.device, x.comm, True)
+
+
+def bucketize(input, boundaries, out_int32: bool = False, right: bool = False, out=None) -> DNDarray:
+    """Index of the bucket each element falls into (reference statistics.py
+    bucketize)."""
+    sanitation.sanitize_in(input)
+    b = boundaries.larray if isinstance(boundaries, DNDarray) else jnp.asarray(boundaries)
+    res = jnp.searchsorted(b, input.larray, side="right" if right else "left")
+    idx_t = types.int32 if out_int32 else types.default_index_type()
+    res = res.astype(idx_t.jnp_type())
+    result = DNDarray.__new_like__(input, res, idx_t)
+    if out is not None:
+        out.larray = res.astype(out.dtype.jnp_type())
+        return out
+    return result
+
+
+def digitize(x, bins, right: bool = False) -> DNDarray:
+    """Indices of the bins each value belongs to (numpy semantics; reference
+    statistics.py digitize)."""
+    sanitation.sanitize_in(x)
+    b = bins.larray if isinstance(bins, DNDarray) else jnp.asarray(bins)
+    res = jnp.digitize(x.larray, b, right=right)
+    return DNDarray.__new_like__(x, res, types.canonical_heat_type(res.dtype))
+
+
+def cov(m, y=None, rowvar: bool = True, bias: bool = False, ddof: Optional[int] = None) -> DNDarray:
+    """Estimate the covariance matrix (reference statistics.py cov)."""
+    sanitation.sanitize_in(m)
+    if ddof is not None and not isinstance(ddof, int):
+        raise TypeError("ddof must be an integer")
+    yv = y.larray if isinstance(y, DNDarray) else y
+    res = jnp.cov(m.larray, y=yv, rowvar=rowvar, bias=bias, ddof=ddof)
+    res = jnp.atleast_2d(res)
+    return DNDarray(res, tuple(res.shape), types.canonical_heat_type(res.dtype), None, m.device, m.comm, True)
+
+
+def histc(input, bins: int = 100, min: float = 0.0, max: float = 0.0, out=None) -> DNDarray:
+    """Histogram with equal-width bins in [min, max] (torch semantics; reference
+    statistics.py histc)."""
+    sanitation.sanitize_in(input)
+    lo, hi = float(min), float(max)
+    if lo == 0.0 and hi == 0.0:
+        lo, hi = float(jnp.min(input.larray)), float(jnp.max(input.larray))
+    hist, _ = jnp.histogram(input.larray, bins=bins, range=(lo, hi))
+    hist = hist.astype(input.dtype.jnp_type())
+    res = DNDarray(hist, tuple(hist.shape), input.dtype, None, input.device, input.comm, True)
+    if out is not None:
+        out.larray = hist.astype(out.dtype.jnp_type())
+        return out
+    return res
+
+
+def histogram(a, bins=10, range=None, normed=None, weights=None, density=None):
+    """Histogram of a dataset, numpy semantics: returns ``(hist, bin_edges)``
+    (reference statistics.py histogram)."""
+    sanitation.sanitize_in(a)
+    w = weights.larray if isinstance(weights, DNDarray) else weights
+    hist, edges = jnp.histogram(a.larray, bins=bins, range=range, weights=w, density=density or normed)
+    h = DNDarray(hist, tuple(hist.shape), types.canonical_heat_type(hist.dtype), None, a.device, a.comm, True)
+    e = DNDarray(edges, tuple(edges.shape), types.canonical_heat_type(edges.dtype), None, a.device, a.comm, True)
+    return h, e
+
+
+def __moment(x, axis, keepdims, moment_fn):
+    sanitation.sanitize_in(x)
+    axis = stride_tricks.sanitize_axis(x.shape, axis)
+    res = moment_fn(x.larray, axis)
+    split = x.split
+    if split is not None:
+        axes = range(x.ndim) if axis is None else ((axis,) if isinstance(axis, int) else tuple(axis))
+        if axis is None or split in axes:
+            split = None
+        elif not keepdims:
+            split -= sum(1 for a in axes if a < split)
+    return DNDarray(res, tuple(res.shape), types.canonical_heat_type(res.dtype), split, x.device, x.comm, True)
+
+
+def kurtosis(x, axis=None, unbiased: bool = True, Fischer: bool = True) -> DNDarray:
+    """
+    Kurtosis (Fisher's definition when ``Fischer``, i.e. normal ==> 0.0) along an axis
+    (reference statistics.py kurtosis; the reference merges per-rank partial moments —
+    here a sharded global moment computation).
+    """
+
+    def _kurt(a, ax):
+        mu = jnp.mean(a, axis=ax, keepdims=True)
+        d = a - mu
+        m2 = jnp.mean(d**2, axis=ax)
+        m4 = jnp.mean(d**4, axis=ax)
+        n = a.size if ax is None else a.shape[ax]
+        if unbiased:
+            k = 1.0 / (n - 2) / (n - 3) * ((n**2 - 1.0) * m4 / m2**2 - 3 * (n - 1) ** 2) + 3
+        else:
+            k = m4 / m2**2
+        return k - 3 if Fischer else k
+
+    return __moment(x, axis, False, _kurt)
+
+
+def skew(x, axis=None, unbiased: bool = True) -> DNDarray:
+    """Sample skewness along an axis (reference statistics.py skew)."""
+
+    def _skew(a, ax):
+        mu = jnp.mean(a, axis=ax, keepdims=True)
+        d = a - mu
+        m2 = jnp.mean(d**2, axis=ax)
+        m3 = jnp.mean(d**3, axis=ax)
+        g1 = m3 / jnp.power(m2, 1.5)
+        n = a.size if ax is None else a.shape[ax]
+        if unbiased:
+            return jnp.sqrt(n * (n - 1.0)) / (n - 2.0) * g1
+        return g1
+
+    return __moment(x, axis, False, _skew)
+
+
+def max(x, axis=None, out=None, keepdim=None) -> DNDarray:
+    """Maximum along an axis (reference statistics.py max → MPI.MAX reduce)."""
+    return _operations.__reduce_op(x, jnp.max, axis=axis, out=out, keepdims=bool(keepdim))
+
+
+def maximum(x1, x2, out=None) -> DNDarray:
+    """Element-wise maximum of two arrays (reference statistics.py maximum)."""
+    return _operations.__binary_op(jnp.maximum, x1, x2, out)
+
+
+def mean(x, axis=None) -> DNDarray:
+    """
+    Arithmetic mean along an axis (reference statistics.py:741-866: per-rank partial
+    moments merged via Allreduce; here the sharded jnp.mean lowers to the same psum).
+    """
+    return __moment(x, axis, False, lambda a, ax: jnp.mean(a, axis=ax))
+
+
+def median(x, axis=None, keepdim: bool = False) -> DNDarray:
+    """Median along an axis (reference statistics.py:867-1074 does distributed
+    selection; here a sharded global sort/select)."""
+
+    def _med(a, ax):
+        return jnp.median(a, axis=ax, keepdims=keepdim)
+
+    return __moment(x, axis, keepdim, _med)
+
+
+def min(x, axis=None, out=None, keepdim=None) -> DNDarray:
+    """Minimum along an axis (reference statistics.py min → MPI.MIN reduce)."""
+    return _operations.__reduce_op(x, jnp.min, axis=axis, out=out, keepdims=bool(keepdim))
+
+
+def minimum(x1, x2, out=None) -> DNDarray:
+    """Element-wise minimum of two arrays (reference statistics.py minimum)."""
+    return _operations.__binary_op(jnp.minimum, x1, x2, out)
+
+
+def percentile(x, q, axis=None, out=None, interpolation: str = "linear", keepdim: bool = False) -> DNDarray:
+    """
+    q-th percentile along an axis (reference statistics.py:1256+ distributed
+    selection). Interpolation: 'linear', 'lower', 'higher', 'midpoint', 'nearest'.
+    """
+    sanitation.sanitize_in(x)
+    if interpolation not in ("linear", "lower", "higher", "midpoint", "nearest"):
+        raise ValueError(f"unsupported interpolation method {interpolation!r}")
+    axis = stride_tricks.sanitize_axis(x.shape, axis)
+    qv = q.larray if isinstance(q, DNDarray) else jnp.asarray(q, dtype=jnp.float32)
+    res = jnp.percentile(x.larray.astype(jnp.float32), qv, axis=axis, method=interpolation, keepdims=keepdim)
+    result = DNDarray(
+        jnp.asarray(res), tuple(jnp.shape(res)), types.canonical_heat_type(jnp.asarray(res).dtype),
+        None, x.device, x.comm, True,
+    )
+    if out is not None:
+        sanitation.sanitize_out(out, result.shape, None, x.device)
+        out.larray = result.larray.astype(out.dtype.jnp_type())
+        return out
+    return result
+
+
+def std(x, axis=None, ddof: int = 0, **kwargs) -> DNDarray:
+    """Standard deviation along an axis with ``ddof`` delta degrees of freedom
+    (reference statistics.py std)."""
+    if not isinstance(ddof, int) or ddof < 0:
+        raise ValueError(f"ddof must be a non-negative integer, got {ddof}")
+    return __moment(x, axis, kwargs.get("keepdim", False), lambda a, ax: jnp.std(a, axis=ax, ddof=ddof))
+
+
+def var(x, axis=None, ddof: int = 0, **kwargs) -> DNDarray:
+    """Variance along an axis with ``ddof`` delta degrees of freedom (reference
+    statistics.py:1704-1847: pairwise moment merging over Allreduce; sharded jnp.var
+    here)."""
+    if not isinstance(ddof, int) or ddof < 0:
+        raise ValueError(f"ddof must be a non-negative integer, got {ddof}")
+    return __moment(x, axis, kwargs.get("keepdim", False), lambda a, ax: jnp.var(a, axis=ax, ddof=ddof))
+
+
+DNDarray.argmax = argmax
+DNDarray.argmin = argmin
+DNDarray.average = average
+DNDarray.max = max
+DNDarray.mean = mean
+DNDarray.median = median
+DNDarray.min = min
+DNDarray.std = std
+DNDarray.var = var

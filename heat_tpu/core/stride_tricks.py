@@ -1,0 +1,94 @@
+"""
+Shape/axis sanitation helpers.
+
+Parity with the reference's ``heat/core/stride_tricks.py`` (``broadcast_shape`` :12,
+``sanitize_axis`` :72, ``sanitize_shape`` :135, ``sanitize_slice`` :180).
+"""
+
+from __future__ import annotations
+
+import numbers
+from typing import Optional, Tuple, Union
+
+import numpy as np
+
+__all__ = ["broadcast_shape", "broadcast_shapes", "sanitize_axis", "sanitize_shape", "sanitize_slice"]
+
+
+def broadcast_shape(shape_a: Tuple[int, ...], shape_b: Tuple[int, ...]) -> Tuple[int, ...]:
+    """
+    Infers, if possible, the broadcast output shape of two operands. Raises
+    ``ValueError`` on incompatible shapes. Reference parity: stride_tricks.py:12-70.
+    """
+    return broadcast_shapes(shape_a, shape_b)
+
+
+def broadcast_shapes(*shapes: Tuple[int, ...]) -> Tuple[int, ...]:
+    """N-ary broadcast shape inference (NumPy rules)."""
+    try:
+        return tuple(np.broadcast_shapes(*shapes))
+    except ValueError:
+        raise ValueError(f"operands could not be broadcast, input shapes {shapes}")
+
+
+def sanitize_axis(
+    shape: Tuple[int, ...], axis: Optional[Union[int, Tuple[int, ...]]]
+) -> Optional[Union[int, Tuple[int, ...]]]:
+    """
+    Checks conformity of an axis with respect to a given shape: resolves negative
+    axes, verifies bounds. Axis may be ``None``, an int, or a tuple of ints.
+    Reference parity: stride_tricks.py:72-133.
+
+    Raises
+    ------
+    TypeError
+        If the axis is not integral.
+    ValueError
+        If the axis is out of range.
+    """
+    if axis is None:
+        return None
+    ndim = len(shape)
+    if isinstance(axis, (tuple, list)):
+        return tuple(sanitize_axis(shape, a) for a in axis)
+    if isinstance(axis, np.ndarray) and axis.ndim == 0:
+        axis = axis.item()
+    if not isinstance(axis, (int, np.integer)):
+        raise TypeError(f"axis must be None or int or tuple of ints, got {type(axis)}")
+    axis = int(axis)
+    if ndim == 0 and axis in (-1, 0):
+        return axis  # scalars accept the degenerate axes, reference stride_tricks.py:110
+    if axis < -ndim or axis >= ndim:
+        raise ValueError(f"axis {axis} is out of bounds for {ndim}-dimensional shape {shape}")
+    return axis % ndim if ndim else axis
+
+
+def sanitize_shape(shape: Union[int, Tuple[int, ...]], lval: int = 0) -> Tuple[int, ...]:
+    """
+    Verifies and normalizes the given shape: scalars become 1-tuples, all entries must
+    be integral and ``>= lval``. Reference parity: stride_tricks.py:135-178.
+    """
+    if isinstance(shape, (int, np.integer)):
+        shape = (shape,)
+    shape = tuple(shape)
+    out = []
+    for dim in shape:
+        if isinstance(dim, float) and not dim.is_integer():
+            raise TypeError(f"expected integer shape entry, got {dim}")
+        if not isinstance(dim, (int, np.integer, float)):
+            raise TypeError(f"expected integer shape entry, got {type(dim)}")
+        dim = int(dim)
+        if dim < lval:
+            raise ValueError(f"negative dimensions are not allowed, got {dim}")
+        out.append(dim)
+    return tuple(out)
+
+
+def sanitize_slice(sl: slice, max_dim: int) -> slice:
+    """
+    Resolves a slice against a dimension length: fills Nones, resolves negatives.
+    Reference parity: stride_tricks.py:180-210.
+    """
+    if not isinstance(sl, slice):
+        raise TypeError("can only be a slice")
+    return slice(*sl.indices(max_dim))

@@ -1,0 +1,174 @@
+"""
+Tile decompositions.
+
+Parity with the reference's ``heat/core/tiling.py`` (``SplitTiles`` :14-330,
+``SquareDiagTiles`` :331-1257). In the reference these drive hand-written
+communication schedules (``resplit_``'s Isend/Irecv mesh, tiled QR); on TPU XLA owns
+physical tiling, so these classes are *metadata* views: they expose the same tile-grid
+geometry (one tile per device per dimension, square tiles on the diagonal) computed
+from the balanced chunk layout, and tile get/set operate on the global array.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import numpy as np
+import jax.numpy as jnp
+
+from .communication import MeshCommunication
+from .dndarray import DNDarray
+
+__all__ = ["SplitTiles", "SquareDiagTiles"]
+
+
+class SplitTiles:
+    """
+    One tile per device per dimension (reference tiling.py:14-330): the tile grid is
+    the Cartesian product of every dimension's balanced chunk boundaries.
+    """
+
+    def __init__(self, arr: DNDarray):
+        self.__arr = arr
+        comm = arr.comm
+        size = comm.size if isinstance(comm, MeshCommunication) else 1
+        ends = []
+        for dim, g in enumerate(arr.shape):
+            bounds = [comm.chunk(arr.shape, dim, rank=r)[1][dim] for r in range(size)] if isinstance(
+                comm, MeshCommunication
+            ) else [g]
+            ends.append(np.cumsum(bounds))
+        self.__tile_ends_per_dim = ends
+        # tile_locations: which device owns each tile along the split axis
+        shape = tuple(size for _ in arr.shape)
+        locs = np.zeros(shape, dtype=np.int64)
+        if arr.split is not None:
+            idx = [np.newaxis] * arr.ndim
+            idx[arr.split] = slice(None)
+            locs = locs + np.arange(size)[tuple(idx)]
+        self.__tile_locations = locs
+
+    @property
+    def arr(self) -> DNDarray:
+        """The tiled array."""
+        return self.__arr
+
+    @property
+    def tile_ends_per_dim(self):
+        """Cumulative tile end indices for every dimension."""
+        return self.__tile_ends_per_dim
+
+    @property
+    def tile_locations(self) -> np.ndarray:
+        """Device owning each tile (reference tiling.py set_tile_locations :108)."""
+        return self.__tile_locations
+
+    def __tile_slices(self, key) -> Tuple[slice, ...]:
+        if not isinstance(key, tuple):
+            key = (key,)
+        slices = []
+        for dim, k in enumerate(key):
+            ends = self.__tile_ends_per_dim[dim]
+            starts = np.concatenate([[0], ends[:-1]])
+            slices.append(slice(int(starts[k]), int(ends[k])))
+        while len(slices) < self.__arr.ndim:
+            slices.append(slice(None))
+        return tuple(slices)
+
+    def __getitem__(self, key):
+        """The data of the indexed tile."""
+        return self.__arr.larray[self.__tile_slices(key)]
+
+    def __setitem__(self, key, value):
+        """Set the data of the indexed tile."""
+        if isinstance(value, DNDarray):
+            value = value.larray
+        self.__arr.larray = self.__arr.larray.at[self.__tile_slices(key)].set(value)
+
+
+class SquareDiagTiles:
+    """
+    Tile grid with square tiles on the diagonal for tiled QR (reference
+    tiling.py:331-1257). Geometry only: per-device tile row/column maps with square
+    diagonal blocks sized by the split-axis chunking.
+    """
+
+    def __init__(self, arr: DNDarray, tiles_per_proc: int = 1):
+        if arr.ndim != 2:
+            raise ValueError("SquareDiagTiles requires a 2-D DNDarray")
+        if tiles_per_proc < 1:
+            raise ValueError("tiles_per_proc must be >= 1")
+        self.__arr = arr
+        comm = arr.comm
+        size = comm.size if isinstance(comm, MeshCommunication) else 1
+        split = arr.split if arr.split is not None else 0
+        # split-axis chunk boundaries subdivided tiles_per_proc ways
+        bounds = []
+        for r in range(size):
+            _, lshape, _ = (
+                comm.chunk(arr.shape, split, rank=r)
+                if isinstance(comm, MeshCommunication)
+                else (0, arr.shape, None)
+            )
+            n = lshape[split]
+            base, rem = divmod(n, tiles_per_proc)
+            bounds.extend([base + 1] * rem + [base] * (tiles_per_proc - rem))
+        row_sizes = np.asarray([b for b in bounds if b > 0], dtype=np.int64)
+        # square diagonal: column boundaries mirror row boundaries up to the smaller dim
+        m, n = arr.shape
+        col_sizes = []
+        acc = 0
+        for b in row_sizes:
+            if acc + b <= n:
+                col_sizes.append(b)
+                acc += b
+        if acc < n:
+            col_sizes.append(n - acc)
+        self.__row_indices = np.concatenate([[0], np.cumsum(row_sizes)])[:-1]
+        self.__col_indices = np.concatenate([[0], np.cumsum(col_sizes)])[:-1]
+        self.__row_sizes = row_sizes
+        self.__col_sizes = np.asarray(col_sizes, dtype=np.int64)
+        self.__tiles_per_proc = tiles_per_proc
+
+    @property
+    def arr(self) -> DNDarray:
+        """The tiled array."""
+        return self.__arr
+
+    @property
+    def row_indices(self) -> np.ndarray:
+        """Start row of each tile row."""
+        return self.__row_indices
+
+    @property
+    def col_indices(self) -> np.ndarray:
+        """Start column of each tile column."""
+        return self.__col_indices
+
+    @property
+    def tile_rows(self) -> int:
+        """Number of tile rows."""
+        return len(self.__row_sizes)
+
+    @property
+    def tile_columns(self) -> int:
+        """Number of tile columns."""
+        return len(self.__col_sizes)
+
+    def get_tile(self, row: int, col: int):
+        """The data of tile (row, col) (reference local_get/local_to_global)."""
+        r0 = int(self.__row_indices[row])
+        c0 = int(self.__col_indices[col])
+        r1 = r0 + int(self.__row_sizes[row])
+        c1 = c0 + int(self.__col_sizes[col])
+        return self.__arr.larray[r0:r1, c0:c1]
+
+    def set_tile(self, row: int, col: int, value) -> None:
+        """Overwrite tile (row, col)."""
+        if isinstance(value, DNDarray):
+            value = value.larray
+        r0 = int(self.__row_indices[row])
+        c0 = int(self.__col_indices[col])
+        r1 = r0 + int(self.__row_sizes[row])
+        c1 = c0 + int(self.__col_sizes[col])
+        self.__arr.larray = self.__arr.larray.at[r0:r1, c0:c1].set(value)

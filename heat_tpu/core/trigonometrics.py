@@ -1,0 +1,143 @@
+"""
+Trigonometric and hyperbolic operations (all element-local).
+
+Parity with the reference's ``heat/core/trigonometrics.py`` (``__all__`` at
+trigonometrics.py:18-45).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import _operations
+from .dndarray import DNDarray
+
+__all__ = [
+    "acos",
+    "acosh",
+    "asin",
+    "asinh",
+    "atan",
+    "atan2",
+    "atanh",
+    "arccos",
+    "arccosh",
+    "arcsin",
+    "arcsinh",
+    "arctan",
+    "arctan2",
+    "arctanh",
+    "cos",
+    "cosh",
+    "deg2rad",
+    "degrees",
+    "rad2deg",
+    "radians",
+    "sin",
+    "sinh",
+    "tan",
+    "tanh",
+]
+
+
+def arccos(x, out=None) -> DNDarray:
+    """Element-wise inverse cosine (reference trigonometrics.py arccos)."""
+    return _operations.__local_op(jnp.arccos, x, out)
+
+
+acos = arccos
+
+
+def arccosh(x, out=None) -> DNDarray:
+    """Element-wise inverse hyperbolic cosine (reference trigonometrics.py arccosh)."""
+    return _operations.__local_op(jnp.arccosh, x, out)
+
+
+acosh = arccosh
+
+
+def arcsin(x, out=None) -> DNDarray:
+    """Element-wise inverse sine (reference trigonometrics.py arcsin)."""
+    return _operations.__local_op(jnp.arcsin, x, out)
+
+
+asin = arcsin
+
+
+def arcsinh(x, out=None) -> DNDarray:
+    """Element-wise inverse hyperbolic sine (reference trigonometrics.py arcsinh)."""
+    return _operations.__local_op(jnp.arcsinh, x, out)
+
+
+asinh = arcsinh
+
+
+def arctan(x, out=None) -> DNDarray:
+    """Element-wise inverse tangent (reference trigonometrics.py arctan)."""
+    return _operations.__local_op(jnp.arctan, x, out)
+
+
+atan = arctan
+
+
+def arctan2(t1, t2) -> DNDarray:
+    """Element-wise quadrant-aware inverse tangent of t1/t2 (reference
+    trigonometrics.py arctan2)."""
+    return _operations.__binary_op(jnp.arctan2, t1, t2)
+
+
+atan2 = arctan2
+
+
+def arctanh(x, out=None) -> DNDarray:
+    """Element-wise inverse hyperbolic tangent (reference trigonometrics.py arctanh)."""
+    return _operations.__local_op(jnp.arctanh, x, out)
+
+
+atanh = arctanh
+
+
+def cos(x, out=None) -> DNDarray:
+    """Element-wise cosine (reference trigonometrics.py cos)."""
+    return _operations.__local_op(jnp.cos, x, out)
+
+
+def cosh(x, out=None) -> DNDarray:
+    """Element-wise hyperbolic cosine (reference trigonometrics.py cosh)."""
+    return _operations.__local_op(jnp.cosh, x, out)
+
+
+def deg2rad(x, out=None) -> DNDarray:
+    """Degrees to radians (reference trigonometrics.py deg2rad)."""
+    return _operations.__local_op(jnp.deg2rad, x, out)
+
+
+radians = deg2rad
+
+
+def rad2deg(x, out=None) -> DNDarray:
+    """Radians to degrees (reference trigonometrics.py rad2deg)."""
+    return _operations.__local_op(jnp.rad2deg, x, out)
+
+
+degrees = rad2deg
+
+
+def sin(x, out=None) -> DNDarray:
+    """Element-wise sine (reference trigonometrics.py sin)."""
+    return _operations.__local_op(jnp.sin, x, out)
+
+
+def sinh(x, out=None) -> DNDarray:
+    """Element-wise hyperbolic sine (reference trigonometrics.py sinh)."""
+    return _operations.__local_op(jnp.sinh, x, out)
+
+
+def tan(x, out=None) -> DNDarray:
+    """Element-wise tangent (reference trigonometrics.py tan)."""
+    return _operations.__local_op(jnp.tan, x, out)
+
+
+def tanh(x, out=None) -> DNDarray:
+    """Element-wise hyperbolic tangent (reference trigonometrics.py tanh)."""
+    return _operations.__local_op(jnp.tanh, x, out)

@@ -1,0 +1,502 @@
+"""
+NumPy-style dtype class hierarchy over JAX dtypes.
+
+Parity with the reference's ``heat/core/types.py`` (hierarchy at types.py:64-416,
+``canonical_heat_type`` :495, ``heat_type_of`` :565, ``can_cast`` :671,
+``promote_types`` :836, ``result_type`` :868, ``finfo``/``iinfo`` :950-1007) with two
+TPU-native extensions: ``bfloat16`` and ``float16`` are first-class dtypes (the
+reference only smuggles them through MPI as int16 buffers,
+communication.py:130-143) since they are the native MXU compute types.
+
+Note on 64-bit types: JAX canonicalises 64-bit dtypes to 32-bit unless
+``jax.config.jax_enable_x64`` is set. ``float64``/``int64``/``complex128`` are defined
+and behave correctly under x64; without it they degrade to their 32-bit counterparts
+(appropriate on TPU, where f64 is emulated).
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Any, Iterable, Optional, Type, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "datatype",
+    "number",
+    "integer",
+    "signedinteger",
+    "unsignedinteger",
+    "bool",
+    "bool_",
+    "floating",
+    "int8",
+    "byte",
+    "int16",
+    "short",
+    "int32",
+    "int",
+    "int64",
+    "long",
+    "uint8",
+    "ubyte",
+    "float16",
+    "half",
+    "bfloat16",
+    "float32",
+    "float",
+    "float_",
+    "float64",
+    "double",
+    "flexible",
+    "can_cast",
+    "canonical_heat_type",
+    "heat_type_is_exact",
+    "heat_type_is_inexact",
+    "iscomplex",
+    "isreal",
+    "issubdtype",
+    "heat_type_of",
+    "promote_types",
+    "result_type",
+    "complex64",
+    "cfloat",
+    "csingle",
+    "complex128",
+    "cdouble",
+    "finfo",
+    "iinfo",
+]
+
+
+class _DtypeMeta(type):
+    def __repr__(cls):
+        return f"ht.{cls.__name__}"
+
+    def __str__(cls):
+        return cls.__name__
+
+
+class datatype(metaclass=_DtypeMeta):
+    """
+    Generic base class for the Heat-style data types. Instantiating a datatype *casts*:
+    ``ht.float32(x)`` returns a :class:`~heat_tpu.core.dndarray.DNDarray` of that type
+    (reference types.py:64-170).
+    """
+
+    _np: Any = None  # numpy-compatible dtype object (ml_dtypes for bfloat16)
+
+    def __new__(cls, *value, split=None, device=None, comm=None):
+        from . import factories
+
+        if cls._np is None:
+            raise TypeError(f"cannot instantiate abstract dtype {cls.__name__}")
+        if len(value) == 0:
+            value = ((0,),)  # cast of nothing: zero scalar, reference types.py:120
+        if len(value) == 1:
+            value = value[0]
+            from .dndarray import DNDarray
+
+            if isinstance(value, DNDarray):
+                return value.astype(cls)
+        return factories.array(value, dtype=cls, split=split, device=device, comm=comm)
+
+    @classmethod
+    def jnp_type(cls) -> np.dtype:
+        """The corresponding JAX/numpy dtype object."""
+        if cls._np is None:
+            raise TypeError(f"abstract dtype {cls.__name__} has no concrete jnp type")
+        return np.dtype(cls._np)
+
+    @classmethod
+    def char(cls) -> str:
+        """The name of this dtype."""
+        return cls.__name__
+
+
+class bool(datatype):
+    """Boolean: True or False."""
+
+    _np = np.bool_
+
+
+class number(datatype):
+    """Generic numeric type."""
+
+
+class integer(number):
+    """Abstract integer type."""
+
+
+class signedinteger(integer):
+    """Abstract signed integer type."""
+
+
+class unsignedinteger(integer):
+    """Abstract unsigned integer type."""
+
+
+class floating(number):
+    """Abstract floating point type."""
+
+
+class flexible(datatype):
+    """Types with no predefined size (parity placeholder, reference types.py:416)."""
+
+
+class complexfloating(number):
+    """Abstract complex floating type."""
+
+
+class int8(signedinteger):
+    """8-bit signed integer."""
+
+    _np = np.int8
+
+
+class int16(signedinteger):
+    """16-bit signed integer."""
+
+    _np = np.int16
+
+
+class int32(signedinteger):
+    """32-bit signed integer."""
+
+    _np = np.int32
+
+
+class int64(signedinteger):
+    """64-bit signed integer (degrades to int32 without jax x64)."""
+
+    _np = np.int64
+
+
+class uint8(unsignedinteger):
+    """8-bit unsigned integer."""
+
+    _np = np.uint8
+
+
+class float16(floating):
+    """16-bit IEEE half-precision float (TPU-native extension)."""
+
+    _np = np.float16
+
+
+class bfloat16(floating):
+    """16-bit brain float — the native MXU compute type (TPU-native extension)."""
+
+    _np = jnp.bfloat16
+
+
+class float32(floating):
+    """32-bit single-precision float. The default float type."""
+
+    _np = np.float32
+
+
+class float64(floating):
+    """64-bit double-precision float (degrades to float32 without jax x64)."""
+
+    _np = np.float64
+
+
+class complex64(complexfloating):
+    """64-bit complex (two float32)."""
+
+    _np = np.complex64
+
+
+class complex128(complexfloating):
+    """128-bit complex (degrades to complex64 without jax x64)."""
+
+    _np = np.complex128
+
+
+# aliases, reference types.py __all__
+bool_ = bool
+byte = int8
+short = int16
+int = int32
+long = int64
+ubyte = uint8
+half = float16
+float = float32
+float_ = float32
+double = float64
+cfloat = complex64
+csingle = complex64
+cdouble = complex128
+
+_COMPLEX_TYPES = (complex64, complex128)
+_FLOAT_TYPES = (float16, bfloat16, float32, float64)
+_INT_TYPES = (int8, int16, int32, int64, uint8)
+_CONCRETE = (bool,) + _INT_TYPES + _FLOAT_TYPES + _COMPLEX_TYPES
+
+# numpy/jax dtype -> heat type
+__np_to_heat = {t.jnp_type(): t for t in _CONCRETE}
+# string name -> heat type (includes aliases)
+__name_to_heat = {t.__name__: t for t in _CONCRETE}
+__name_to_heat.update(
+    {
+        "bool_": bool,
+        "byte": int8,
+        "short": int16,
+        "int": int32,
+        "long": int64,
+        "ubyte": uint8,
+        "half": float16,
+        "float": float32,
+        "float_": float32,
+        "double": float64,
+        "cfloat": complex64,
+        "csingle": complex64,
+        "cdouble": complex128,
+    }
+)
+# python builtin type -> heat type
+__builtin_to_heat = {
+    builtins.bool: bool,
+    builtins.int: int64,
+    builtins.float: float32,
+    builtins.complex: complex64,
+}
+
+
+def default_index_type() -> Type[datatype]:
+    """The widest available index type: int64 under jax x64, else int32 (TPU
+    default). Keeps index-producing ops (argmax, sort, nonzero, …) warning-free."""
+    import jax
+
+    return int64 if jax.config.jax_enable_x64 else int32
+
+
+def canonical_heat_type(a_type: Any) -> Type[datatype]:
+    """
+    Canonicalize the builtin Python type, string, numpy/jax dtype, or heat type into a
+    canonical heat type class. Reference parity: types.py:495-540.
+
+    Raises
+    ------
+    TypeError
+        If the type cannot be converted.
+    """
+    if isinstance(a_type, type) and issubclass(a_type, datatype):
+        if a_type._np is None:
+            raise TypeError(f"data type {a_type!r} is abstract and not understood")
+        # collapse aliases onto canonical classes
+        return __np_to_heat[a_type.jnp_type()]
+    if a_type in __builtin_to_heat:
+        return __builtin_to_heat[a_type]
+    if isinstance(a_type, str):
+        name = a_type.strip().lower()
+        if name in __name_to_heat:
+            return __name_to_heat[name]
+        try:
+            return __np_to_heat[np.dtype(name)]
+        except (TypeError, KeyError):
+            raise TypeError(f"data type '{a_type}' is not understood")
+    try:
+        return __np_to_heat[np.dtype(a_type)]
+    except (TypeError, KeyError):
+        raise TypeError(f"data type {a_type!r} is not understood")
+
+
+def heat_type_of(obj: Any) -> Type[datatype]:
+    """
+    Returns the canonical heat data type of the given object: a scalar, an array
+    (DNDarray / numpy / jax) or an iterable. Reference parity: types.py:565-630.
+    """
+    dt = getattr(obj, "dtype", None)
+    if dt is not None:
+        if isinstance(dt, type) and issubclass(dt, datatype):
+            return canonical_heat_type(dt)
+        return canonical_heat_type(dt)
+    if isinstance(obj, (builtins.bool, builtins.int, builtins.float, builtins.complex)):
+        return __builtin_to_heat[type(obj)]
+    if isinstance(obj, (list, tuple)) or hasattr(obj, "__iter__"):
+        return canonical_heat_type(np.asarray(obj).dtype)
+    raise TypeError(f"data type of {obj!r} is not understood")
+
+
+def heat_type_is_exact(ht_dtype: Type[datatype]) -> builtins.bool:
+    """Whether the type is an exact (integer/boolean) type. Reference types.py:632."""
+    ht_dtype = canonical_heat_type(ht_dtype)
+    return issubclass(ht_dtype, integer) or ht_dtype is bool
+
+
+def heat_type_is_inexact(ht_dtype: Type[datatype]) -> builtins.bool:
+    """Whether the type is an inexact (floating/complex) type. Reference types.py:650."""
+    ht_dtype = canonical_heat_type(ht_dtype)
+    return issubclass(ht_dtype, (floating, complexfloating))
+
+
+def issubdtype(arg1: Any, arg2: Any) -> builtins.bool:
+    """
+    Returns ``True`` if the first type is lower/equal in the type hierarchy.
+    Accepts heat abstract classes (``ht.integer`` etc.) as the second argument.
+    Reference parity: types.py (issubdtype).
+    """
+
+    def resolve(a):
+        if isinstance(a, type) and issubclass(a, datatype):
+            return a
+        try:
+            return canonical_heat_type(a)
+        except TypeError:
+            return heat_type_of(a)
+
+    t1, t2 = resolve(arg1), resolve(arg2)
+    if t1._np is None:
+        # abstract-vs-abstract: subclass check
+        return issubclass(t1, t2)
+    t1 = canonical_heat_type(t1)
+    return issubclass(t1, t2)
+
+
+def can_cast(from_: Any, to: Any, casting: str = "intuitive") -> builtins.bool:
+    """
+    Returns ``True`` if a cast between data types can occur according to the casting
+    rule.
+
+    Parameters
+    ----------
+    from_ : scalar, DNDarray or type
+        Source.
+    to : type
+        Target type.
+    casting : str
+        ``'no'``, ``'safe'``, ``'same_kind'``, ``'unsafe'`` (NumPy semantics) or
+        ``'intuitive'`` (safe + allows integer to float32 and float to complex64).
+
+    Reference parity: types.py:671-835.
+    """
+    if casting not in ("no", "safe", "same_kind", "unsafe", "intuitive"):
+        raise ValueError(f"casting must be one of 'no','safe','same_kind','unsafe','intuitive', got {casting!r}")
+    try:
+        src = canonical_heat_type(from_)
+    except TypeError:
+        src = heat_type_of(from_)
+    dst = canonical_heat_type(to)
+
+    def proxy(t: Type[datatype]) -> np.dtype:
+        # bfloat16 is outside numpy's lattice; treat as float16-equivalent for casting
+        return np.dtype(np.float16) if t is bfloat16 else t.jnp_type()
+
+    if casting == "unsafe":
+        return True
+    if casting == "no":
+        return src is dst
+    if casting == "intuitive":
+        if src is dst or np.can_cast(proxy(src), proxy(dst), "safe"):
+            return True
+        if issubclass(src, (integer, bool)) and issubclass(dst, (floating, complexfloating)):
+            return True
+        if issubclass(src, floating) and issubclass(dst, complexfloating):
+            return True
+        return False
+    return np.can_cast(proxy(src), proxy(dst), casting)
+
+
+def promote_types(type1: Any, type2: Any) -> Type[datatype]:
+    """
+    Returns the data type with the smallest size and smallest scalar kind to which both
+    ``type1`` and ``type2`` may be safely cast. Reference parity: types.py:836-867
+    (NumPy promotion table; bfloat16 follows the JAX lattice).
+    """
+    t1 = canonical_heat_type(type1)
+    t2 = canonical_heat_type(type2)
+    return canonical_heat_type(jnp.promote_types(t1.jnp_type(), t2.jnp_type()))
+
+
+def result_type(*arrays_and_types: Any) -> Type[datatype]:
+    """
+    Returns the data type that results from type promotions rules performed in an
+    arithmetic operation. Reference parity: types.py:868-949.
+    """
+    operands = []
+    for a in arrays_and_types:
+        from .dndarray import DNDarray
+
+        if isinstance(a, DNDarray):
+            operands.append(a.dtype.jnp_type())
+        elif isinstance(a, type) and issubclass(a, datatype):
+            operands.append(canonical_heat_type(a).jnp_type())
+        elif isinstance(a, (builtins.bool, builtins.int, builtins.float, builtins.complex)):
+            operands.append(a)  # keep python scalars weak, numpy value-based rules
+        else:
+            try:
+                operands.append(canonical_heat_type(a).jnp_type())
+            except TypeError:
+                operands.append(np.asarray(a).dtype)
+    return canonical_heat_type(jnp.result_type(*operands))
+
+
+class finfo:
+    """
+    Machine limits for floating point types: ``bits``, ``eps``, ``max``, ``min``,
+    ``tiny``. Reference parity: types.py:950-1006.
+    """
+
+    def __new__(cls, dtype: Type[datatype]):
+        dtype = canonical_heat_type(dtype)
+        if not issubclass(dtype, (floating, complexfloating)):
+            raise TypeError(f"data type {dtype!r} not inexact")
+        obj = object.__new__(cls)
+        info = jnp.finfo(dtype.jnp_type())
+        obj.bits = builtins.int(info.bits)
+        obj.eps = builtins.float(info.eps)
+        obj.max = builtins.float(info.max)
+        obj.min = builtins.float(info.min)
+        obj.tiny = builtins.float(info.tiny)
+        return obj
+
+
+class iinfo:
+    """
+    Machine limits for integer types: ``bits``, ``max``, ``min``.
+    Reference parity: types.py:1007-1056.
+    """
+
+    def __new__(cls, dtype: Type[datatype]):
+        dtype = canonical_heat_type(dtype)
+        if not issubclass(dtype, (integer, bool)):
+            raise TypeError(f"data type {dtype!r} not exact")
+        obj = object.__new__(cls)
+        if dtype is bool:
+            obj.bits, obj.max, obj.min = 8, 1, 0
+        else:
+            info = jnp.iinfo(dtype.jnp_type())
+            obj.bits = builtins.int(info.bits)
+            obj.max = builtins.int(info.max)
+            obj.min = builtins.int(info.min)
+        return obj
+
+
+def iscomplex(x):
+    """Element-wise: is the element complex with nonzero imaginary part (reference
+    types.py iscomplex)."""
+    from . import factories
+    from .dndarray import DNDarray
+
+    if not isinstance(x, DNDarray):
+        x = factories.array(x)
+    if issubclass(x.dtype, complexfloating):
+        return DNDarray.__new_like__(x, jnp.imag(x.larray) != 0, bool)
+    return DNDarray.__new_like__(x, jnp.zeros(x.larray.shape, dtype=np.bool_), bool)
+
+
+def isreal(x):
+    """Element-wise: is the element real-valued (reference types.py isreal)."""
+    from . import factories
+    from .dndarray import DNDarray
+
+    if not isinstance(x, DNDarray):
+        x = factories.array(x)
+    if issubclass(x.dtype, complexfloating):
+        return DNDarray.__new_like__(x, jnp.imag(x.larray) == 0, bool)
+    return DNDarray.__new_like__(x, jnp.ones(x.larray.shape, dtype=np.bool_), bool)

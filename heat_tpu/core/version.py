@@ -1,0 +1,16 @@
+"""Version information (parity: reference heat/core/version.py:3-8)."""
+
+major: int = 0
+"""Major version number."""
+minor: int = 1
+"""Minor version number."""
+micro: int = 0
+"""Micro version number."""
+extension: str = "dev"
+"""Version extension tag."""
+
+if not extension:
+    __version__: str = f"{major}.{minor}.{micro}"
+    """String containing the full version."""
+else:
+    __version__: str = f"{major}.{minor}.{micro}-{extension}"

@@ -1,0 +1,3 @@
+"""Graph algorithms (parity: reference heat/graph/__init__.py)."""
+
+from .laplacian import *

@@ -1,0 +1,3 @@
+"""Naive Bayes (parity: reference heat/naive_bayes/__init__.py)."""
+
+from .gaussianNB import *

@@ -1,0 +1,25 @@
+"""
+Neural network subpackage.
+
+Parity with the reference's ``heat/nn/__init__.py``: exposes ``DataParallel``/
+``DataParallelMultiGPU`` plus a fallthrough module surface. The reference falls
+through to ``torch.nn`` ("torch with Heat interposed", nn/functional.py:9-33); the
+TPU-native fallthrough is ``flax.linen`` — ``ht.nn.Dense``, ``ht.nn.Conv`` etc. are
+flax modules, and ``ht.nn.functional`` maps to ``jax.nn``.
+"""
+
+from .data_parallel import DataParallel, DataParallelMultiGPU
+from . import functional
+
+try:
+    import flax.linen as _linen
+except ImportError:  # pragma: no cover
+    _linen = None
+
+
+def __getattr__(name: str):
+    """Fall through to flax.linen for module classes (reference heat/nn/__init__
+    falls through to torch.nn)."""
+    if _linen is not None and hasattr(_linen, name):
+        return getattr(_linen, name)
+    raise AttributeError(f"module 'heat_tpu.nn' has no attribute {name!r}")

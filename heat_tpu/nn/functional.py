@@ -1,0 +1,26 @@
+"""
+Functional NN interface.
+
+Parity with the reference's ``heat/nn/functional.py`` (:9-33), which is a
+module-level ``__getattr__`` falling through to ``torch.nn.functional``. The
+TPU-native fallthrough targets ``jax.nn`` (activations, softmax, one_hot, …) and then
+``flax.linen`` for anything jax.nn lacks.
+"""
+
+from __future__ import annotations
+
+import jax.nn as _jnn
+
+try:
+    import flax.linen as _fnn
+except ImportError:  # pragma: no cover - flax is baked into the target image
+    _fnn = None
+
+
+def __getattr__(name: str):
+    """Fall through to jax.nn, then flax.linen (reference functional.py:9-33)."""
+    if hasattr(_jnn, name):
+        return getattr(_jnn, name)
+    if _fnn is not None and hasattr(_fnn, name):
+        return getattr(_fnn, name)
+    raise AttributeError(f"module 'heat_tpu.nn.functional' has no attribute {name!r}")

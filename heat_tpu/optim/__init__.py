@@ -1,0 +1,26 @@
+"""
+Optimizers subpackage.
+
+Parity with the reference's ``heat/optim/__init__.py``: ``DataParallelOptimizer``,
+``DASO``, ``DetectMetricPlateau``, ``lr_scheduler``, plus a fallthrough to optax (the
+reference falls through to ``torch.optim``) — ``ht.optim.sgd``, ``ht.optim.adam`` etc.
+resolve to optax transformations.
+"""
+
+import optax as _optax
+
+from .dp_optimizer import DASO, DataParallelOptimizer
+from .utils import DetectMetricPlateau
+from . import lr_scheduler
+from . import utils
+
+
+def __getattr__(name: str):
+    """Fall through to optax (reference heat/optim falls through to torch.optim)."""
+    if hasattr(_optax, name):
+        return getattr(_optax, name)
+    # torch-style capitalized names map onto optax factories
+    lowered = {"SGD": "sgd", "Adam": "adam", "AdamW": "adamw", "Adagrad": "adagrad", "RMSprop": "rmsprop"}
+    if name in lowered:
+        return getattr(_optax, lowered[name])
+    raise AttributeError(f"module 'heat_tpu.optim' has no attribute {name!r}")

@@ -1,0 +1,332 @@
+"""
+Data-parallel optimizers: ``DataParallelOptimizer`` and the hierarchical
+asynchronous ``DASO``.
+
+Parity with the reference's ``heat/optim/dp_optimizer.py``: there DASO (:46-833)
+combines intra-node NCCL synchronization every batch (unless ``local_skip``-ped) with
+inter-node MPI-group synchronization every ``global_skip`` batches — the global sync
+sends a flattened bf16 parameter buffer with custom MPI f16/bf16 sum ops (:21-43,
+since MPI lacks native bf16) and blends it in ``batches_to_wait`` batches later as
+``local * 1/4 + global * 3/4`` (:502-652); skips decay on loss plateau (:336-430).
+
+TPU-native redesign: the node hierarchy is a 2-D ``(node, local)`` device mesh.
+Parameters live *per node group* (a leading ``node`` axis on every leaf, sharded over
+the ``node`` mesh axis) so node groups genuinely drift between global syncs, exactly
+like the reference's per-node DDP replicas. The local sync is a ``psum`` over the
+``local`` mesh axis inside the compiled step; the global sync is a bf16-cast ``psum``
+over ``node``. No custom reduction ops are needed — bf16 is a first-class ICI
+reduction type. The async "receive N batches later" is inherited from JAX's async
+dispatch: the global-sync program is dispatched immediately and its result consumed
+``batches_to_wait`` steps later without blocking the intervening local steps.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.communication import MeshCommunication, sanitize_comm
+from .utils import DetectMetricPlateau
+
+__all__ = ["DataParallelOptimizer", "DASO"]
+
+
+class DataParallelOptimizer:
+    """
+    Thin wrapper binding an optax transformation to data-parallel training
+    (reference dp_optimizer.py:834-877, which gates torch ``step()`` for
+    blocking/non-blocking hook modes — both collapse into the compiled psum here).
+
+    Parameters
+    ----------
+    optimizer : optax.GradientTransformation
+        The local optimizer.
+    blocking : bool
+        Parity flag; with jit the gradient collective is always overlapped.
+    """
+
+    def __init__(self, optimizer: optax.GradientTransformation, blocking: bool = False):
+        if not isinstance(blocking, bool):
+            raise TypeError(f"blocking must be a bool, got {type(blocking)}")
+        self.torch_optimizer = optimizer  # parity attribute name
+        self.optimizer = optimizer
+        self.blocking_parameter_updates = blocking
+        self.opt_state = None
+
+    def init(self, params):
+        """Initialize optimizer state."""
+        self.opt_state = self.optimizer.init(params)
+        return self.opt_state
+
+    def update(self, grads, opt_state, params):
+        """Apply the optax update rule."""
+        return self.optimizer.update(grads, opt_state, params)
+
+    def step(self, grads, params, opt_state=None):
+        """Functional step: returns (new_params, new_opt_state)."""
+        opt_state = self.opt_state if opt_state is None else opt_state
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        self.opt_state = opt_state
+        return optax.apply_updates(params, updates), opt_state
+
+
+class DASO:
+    """
+    Distributed Asynchronous and Selective Optimization over a hierarchical
+    ``(node, local)`` TPU mesh.
+
+    Parameters
+    ----------
+    local_optimizer : optax.GradientTransformation
+        Optimizer applied within each node group (reference: a torch optimizer).
+    total_epochs : int
+        Total training epochs (needed for the cooldown phase).
+    comm : MeshCommunication, optional
+        World communicator supplying the devices.
+    nodes : int, optional
+        Number of node groups; defaults to a near-square factorization of the device
+        count (the reference reads the physical node count; a TPU slice has no
+        process-level node boundary, so the hierarchy is a mesh-shape choice).
+    warmup_epochs, cooldown_epochs : int
+        Blocking-sync phases at the start/end of training (reference
+        dp_optimizer.py:61-67).
+    stability_level : float
+        Loss plateau threshold driving skip decay.
+    max_global_skips : int
+        Upper bound of the global-skip cycle.
+    downcast_type :
+        dtype for the global parameter sync; default bfloat16 (first-class on ICI —
+        the entire custom-MPI-op machinery of the reference, :21-43, vanishes).
+    skip_reduction_factor, local_skip_factor : int
+        Skip schedule shape (reference dp_optimizer.py parameters).
+    verbose : bool
+        Debug printing.
+
+    Reference parity: heat/optim/dp_optimizer.py:46-833.
+    """
+
+    def __init__(
+        self,
+        local_optimizer: optax.GradientTransformation,
+        total_epochs: int,
+        comm: Optional[MeshCommunication] = None,
+        warmup_epochs: int = 4,
+        cooldown_epochs: int = 4,
+        scheduler=None,
+        stability_level: float = 0.05,
+        max_global_skips: int = 8,
+        sending_chunk_size: int = 10_000_000,
+        downcast_type=jnp.bfloat16,
+        use_mpi_groups: bool = True,
+        skip_reduction_factor: int = 2,
+        local_skip_factor: int = 4,
+        verbose: bool = False,
+        nodes: Optional[int] = None,
+    ):
+        self.local_optimizer = local_optimizer
+        self.total_epochs = total_epochs
+        self.comm = sanitize_comm(comm)
+        self.warmup_epochs = warmup_epochs
+        self.cooldown_epochs = cooldown_epochs
+        self.scheduler = scheduler
+        self.stability = DetectMetricPlateau(patience=2, threshold=stability_level)
+        self.max_gs = max_global_skips
+        self.global_skip = max_global_skips
+        self.local_skip = max(max_global_skips // local_skip_factor, 1)
+        self.batches_to_wait = max(max_global_skips // 4, 1)
+        self.skip_reduction_factor = skip_reduction_factor
+        self.local_skip_factor = local_skip_factor
+        self.downcast_type = downcast_type
+        self.verbose = verbose
+        self.epoch = 0
+        self.batch = 0
+        self.last_batch = None
+        self._pending_global = None
+        self._pending_countdown = 0
+        self.opt_state = None
+        self.params = None
+        self._local_step = None
+        self._global_sync = None
+
+        # hierarchical mesh: factor the world into (nodes, local)
+        size = self.comm.size
+        if nodes is None:
+            nodes = 1
+            for cand in range(int(np.sqrt(size)), 0, -1):
+                if size % cand == 0:
+                    nodes = cand
+                    break
+        if size % nodes != 0:
+            raise ValueError(f"device count {size} not divisible into {nodes} node groups")
+        self.nodes = nodes
+        self.local_size = size // nodes
+        devs = np.asarray(self.comm.mesh.devices).reshape(nodes, self.local_size)
+        self.mesh = Mesh(devs, ("node", "local"))
+
+    # ------------------------------------------------------------------ placement
+    def _node_sharding(self):
+        return NamedSharding(self.mesh, P("node"))
+
+    def init(self, params):
+        """
+        Stack parameters with a leading ``node`` axis (one replica per node group,
+        sharded over the ``node`` mesh axis) and initialize per-node optimizer state.
+        """
+        stacked = jax.tree.map(lambda a: jnp.broadcast_to(a[None], (self.nodes,) + a.shape), params)
+        self.params = jax.tree.map(
+            lambda a: jax.device_put(a, NamedSharding(self.mesh, P("node", *([None] * (a.ndim - 1))))),
+            stacked,
+        )
+        # per-node optimizer state: stack like params
+        base = self.local_optimizer.init(jax.tree.map(lambda a: a[0], self.params))
+        self.opt_state = jax.tree.map(lambda a: jnp.broadcast_to(jnp.asarray(a)[None], (self.nodes,) + jnp.shape(a)), base)
+        return self.params
+
+    # ------------------------------------------------------------------ compiled steps
+    def make_train_step(self, loss_fn: Callable, apply_fn: Callable):
+        """
+        Builds the jitted hierarchical step
+        ``step(params, opt_state, x, y) -> (params, opt_state, loss)`` where the
+        gradient is averaged over the ``local`` axis only — node groups drift, as in
+        the reference's tDDP replicas (dp_optimizer.py:432-476).
+        """
+        opt = self.local_optimizer
+        mesh = self.mesh
+
+        def local_block(params, opt_state, x, y):
+            p = jax.tree.map(lambda a: a[0], params)
+            s = jax.tree.map(lambda a: a[0], opt_state)
+
+            def lossf(pp):
+                return loss_fn(pp, apply_fn, x, y)
+
+            loss, grads = jax.value_and_grad(lossf)(p)
+            grads = jax.lax.pmean(grads, "local")
+            loss = jax.lax.pmean(loss, ("node", "local"))
+            updates, s2 = opt.update(grads, s, p)
+            p2 = optax.apply_updates(p, updates)
+            return (
+                jax.tree.map(lambda a: a[None], p2),
+                jax.tree.map(lambda a: jnp.asarray(a)[None], s2),
+                loss,
+            )
+
+        pspec = jax.tree.map(lambda _: P("node"), self.params)
+        sspec = jax.tree.map(lambda _: P("node"), self.opt_state)
+
+        step = jax.jit(
+            jax.shard_map(
+                local_block,
+                mesh=mesh,
+                in_specs=(pspec, sspec, P(("node", "local")), P(("node", "local"))),
+                out_specs=(pspec, sspec, P()),
+                check_vma=False,
+            )
+        )
+
+        def global_block(params):
+            p = jax.tree.map(lambda a: a[0], params)
+            # bf16 downcast for the wire, blend local 1/4 + global 3/4
+            def sync(leaf):
+                cast = leaf.astype(self.downcast_type)
+                avg = jax.lax.pmean(cast, "node").astype(leaf.dtype)
+                return 0.25 * leaf + 0.75 * avg
+
+            p2 = jax.tree.map(sync, p)
+            return jax.tree.map(lambda a: a[None], p2)
+
+        gsync = jax.jit(
+            jax.shard_map(
+                global_block, mesh=mesh, in_specs=(pspec,), out_specs=pspec, check_vma=False
+            )
+        )
+        self._local_step = step
+        self._global_sync = gsync
+        return step
+
+    # ------------------------------------------------------------------ train loop API
+    def shard_batch(self, *arrays):
+        """Shard the batch axis over the flattened (node, local) mesh."""
+        out = []
+        for a in arrays:
+            a = jnp.asarray(a)
+            if a.shape[0] % (self.nodes * self.local_size) == 0:
+                sh = NamedSharding(self.mesh, P(("node", "local"), *([None] * (a.ndim - 1))))
+                a = jax.device_put(a, sh)
+            out.append(a)
+        return tuple(out)
+
+    def step(self, x, y) -> jax.Array:
+        """
+        One DASO batch (reference ``step`` dp_optimizer.py:730-815): local-sync
+        update always (local skips collapse into the compiled overlap), dispatch a
+        global sync every ``global_skip`` batches, consume a pending global sync
+        ``batches_to_wait`` batches after dispatch.
+        """
+        if self._local_step is None:
+            raise RuntimeError("call make_train_step(loss_fn, apply_fn) first")
+        x, y = self.shard_batch(x, y)
+        self.params, self.opt_state, loss = self._local_step(self.params, self.opt_state, x, y)
+
+        in_warmup = self.epoch < self.warmup_epochs
+        in_cooldown = self.epoch >= self.total_epochs - self.cooldown_epochs
+        if in_warmup or in_cooldown:
+            # blocking averaging update every batch (reference phases 2/4)
+            self.params = self._global_sync(self.params)
+        else:
+            if self._pending_global is not None:
+                self._pending_countdown -= 1
+                if self._pending_countdown <= 0:
+                    self.params = self._pending_global
+                    self._pending_global = None
+            if self.global_skip == 0 or self.batch % max(self.global_skip, 1) == 0:
+                # dispatch async global sync; consumed batches_to_wait later
+                self._pending_global = self._global_sync(self.params)
+                self._pending_countdown = self.batches_to_wait
+        self.batch += 1
+        if self.last_batch is not None and self.batch >= self.last_batch:
+            self.batch = 0
+            self.epoch += 1
+        return loss
+
+    def epoch_loss_logic(self, loss, loss_globally_averaged: bool = False) -> None:
+        """
+        Skip-schedule decay on loss plateau (reference dp_optimizer.py:336-430):
+        when the loss stabilizes, divide the skips by ``skip_reduction_factor``;
+        when they bottom out at 1, reset the cycle to ``max_global_skips``.
+        """
+        stable = self.stability.test_if_improving(float(loss))
+        if stable:
+            if self.global_skip <= 1:
+                self.global_skip = self.max_gs
+            else:
+                self.global_skip = max(self.global_skip // self.skip_reduction_factor, 1)
+            self.local_skip = max(self.global_skip // self.local_skip_factor, 1)
+            self.batches_to_wait = max(self.global_skip // 4, 1)
+            if self.verbose:
+                print(
+                    f"DASO: loss stable -> global_skip={self.global_skip}, "
+                    f"local_skip={self.local_skip}, batches_to_wait={self.batches_to_wait}"
+                )
+
+    def add_scaler(self, scaler) -> None:
+        """Gradient-scaler hook for AMP parity (reference dp_optimizer.py
+        add_scaler). JAX mixed precision flows through dtypes; kept as a no-op
+        attachment."""
+        self.scaler = scaler
+
+    def print0(self, *args, **kwargs) -> None:
+        """Print from the controller only (reference dp_optimizer.py:687)."""
+        if jax.process_index() == 0:
+            print(*args, **kwargs)
+
+    @property
+    def merged_params(self):
+        """Node-averaged parameters (for evaluation/checkpointing): mean over the
+        node axis of the per-node replicas."""
+        return jax.tree.map(lambda a: jnp.mean(a, axis=0), self.params)

@@ -1,0 +1,26 @@
+"""
+Learning-rate schedulers.
+
+Parity with the reference's ``heat/optim/lr_scheduler.py`` (:10-29), a module-level
+``__getattr__`` fallthrough to ``torch.optim.lr_scheduler``. The TPU-native target is
+``optax.schedules`` / ``optax`` (e.g. ``ht.optim.lr_scheduler.cosine_decay_schedule``,
+``exponential_decay``, ``warmup_cosine_decay_schedule``).
+"""
+
+from __future__ import annotations
+
+import optax as _optax
+
+try:
+    import optax.schedules as _schedules
+except ImportError:  # pragma: no cover - older optax layouts
+    _schedules = None
+
+
+def __getattr__(name: str):
+    """Fall through to optax schedules (reference lr_scheduler.py:10-29)."""
+    if _schedules is not None and hasattr(_schedules, name):
+        return getattr(_schedules, name)
+    if hasattr(_optax, name):
+        return getattr(_optax, name)
+    raise AttributeError(f"module 'heat_tpu.optim.lr_scheduler' has no attribute {name!r}")

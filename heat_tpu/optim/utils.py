@@ -1,0 +1,115 @@
+"""
+Optimizer utilities.
+
+Parity with the reference's ``heat/optim/utils.py`` (``DetectMetricPlateau``
+:14-210): a ReduceLROnPlateau-style state machine used by DASO's skip schedule, with
+get/set_state for checkpointing.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+__all__ = ["DetectMetricPlateau"]
+
+
+class DetectMetricPlateau:
+    """
+    Determines if a metric has reached a plateau.
+
+    Parameters
+    ----------
+    mode : str
+        ``'min'`` (metric should decrease) or ``'max'``.
+    patience : int
+        Number of measurements without improvement before a plateau is declared.
+    threshold : float
+        Relative/absolute improvement threshold.
+    threshold_mode : str
+        ``'rel'`` (best * (1 ± threshold)) or ``'abs'`` (best ± threshold).
+
+    Reference parity: heat/optim/utils.py:14-210.
+    """
+
+    def __init__(
+        self,
+        mode: str = "min",
+        patience: int = 10,
+        threshold: float = 1e-4,
+        threshold_mode: str = "rel",
+    ):
+        self.patience = patience
+        self.mode = mode
+        self.threshold = threshold
+        self.threshold_mode = threshold_mode
+        self.best = None
+        self.num_bad_epochs = None
+        self.mode_worse = None  # the worse value for the chosen mode
+        self.last_epoch = -1
+        self._init_is_better(mode=mode, threshold=threshold, threshold_mode=threshold_mode)
+        self.reset()
+
+    def get_state(self) -> Dict:
+        """Gets the state dictionary for checkpointing (reference utils.py:72-90)."""
+        return {
+            "patience": self.patience,
+            "mode": self.mode,
+            "threshold": self.threshold,
+            "threshold_mode": self.threshold_mode,
+            "best": self.best,
+            "num_bad_epochs": self.num_bad_epochs,
+            "mode_worse": self.mode_worse,
+            "last_epoch": self.last_epoch,
+        }
+
+    def set_state(self, dic: Dict) -> None:
+        """Loads a state dictionary (reference utils.py:91-108)."""
+        for key, value in dic.items():
+            setattr(self, key, value)
+
+    def reset(self) -> None:
+        """Resets num_bad_epochs counter and cooldown counter (reference
+        utils.py:109-120)."""
+        self.best = self.mode_worse
+        self.num_bad_epochs = 0
+
+    def test_if_improving(self, metrics) -> bool:
+        """True if the metric has plateaued — i.e. *not* improved for ``patience``
+        measurements (reference utils.py:121-150)."""
+        current = float(metrics)
+        self.last_epoch += 1
+        if self.is_better(current, self.best):
+            self.best = current
+            self.num_bad_epochs = 0
+        else:
+            self.num_bad_epochs += 1
+        if self.num_bad_epochs > self.patience:
+            self.num_bad_epochs = 0
+            return True
+        return False
+
+    def is_better(self, a: float, best: Optional[float]) -> bool:
+        """Whether ``a`` improves on ``best`` under the configured mode/threshold
+        (reference utils.py:151-180)."""
+        if best is None:
+            return True
+        if self.mode == "min" and self.threshold_mode == "rel":
+            rel_epsilon = 1.0 - self.threshold
+            return a < best * rel_epsilon
+        if self.mode == "min" and self.threshold_mode == "abs":
+            return a < best - self.threshold
+        if self.mode == "max" and self.threshold_mode == "rel":
+            rel_epsilon = self.threshold + 1.0
+            return a > best * rel_epsilon
+        return a > best + self.threshold
+
+    def _init_is_better(self, mode: str, threshold: float, threshold_mode: str) -> None:
+        """Validates configuration (reference utils.py:181-210)."""
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode {mode} is unknown!")
+        if threshold_mode not in ("rel", "abs"):
+            raise ValueError(f"threshold mode {threshold_mode} is unknown!")
+        self.mode_worse = float("inf") if mode == "min" else -float("inf")
+        self.mode = mode
+        self.threshold = threshold
+        self.threshold_mode = threshold_mode

@@ -1,0 +1,3 @@
+"""Regression (parity: reference heat/regression/__init__.py)."""
+
+from .lasso import *

@@ -1,0 +1,3 @@
+"""Spatial algorithms (parity: reference heat/spatial/__init__.py)."""
+
+from .distance import *

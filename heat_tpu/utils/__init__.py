@@ -1,0 +1,4 @@
+"""Utilities subpackage (parity: reference heat/utils/__init__.py)."""
+
+from . import data
+from . import vision_transforms

@@ -1,0 +1,10 @@
+"""Data utilities (parity: reference heat/utils/data/__init__.py)."""
+
+from .datatools import *
+from .matrixgallery import parter
+from .mnist import MNISTDataset
+from .partial_dataset import PartialH5Dataset, PartialH5DataLoaderIter
+from . import datatools
+from . import matrixgallery
+from . import mnist
+from . import partial_dataset

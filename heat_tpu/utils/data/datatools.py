@@ -1,0 +1,149 @@
+"""
+Data tools: Dataset and DataLoader over DNDarrays.
+
+Parity with the reference's ``heat/utils/data/datatools.py`` (``DataLoader`` :16,
+``Dataset`` :143, ``dataset_shuffle``/``dataset_ishuffle`` :246-376). The reference
+wraps a torch DataLoader over the rank-local slab and exchanges random slices between
+ranks after each epoch (Alltoallv/Isend); single-controller SPMD shuffles the global
+array with the counter-based RNG and shards each batch over the mesh — the
+cross-device exchange is the resharding XLA emits.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from ...core.dndarray import DNDarray
+
+__all__ = ["DataLoader", "Dataset", "dataset_shuffle", "dataset_ishuffle"]
+
+
+class Dataset:
+    """
+    Dataset wrapping one or more (split) DNDarrays for NN training.
+
+    Parameters
+    ----------
+    array : DNDarray
+        Data samples, batch axis first.
+    transform : Callable, optional
+        Per-sample transform applied on access.
+    ishuffle : bool
+        Use the non-blocking shuffle protocol (parity flag; shuffles are async under
+        JAX dispatch either way).
+
+    Reference parity: heat/utils/data/datatools.py:143-245.
+    """
+
+    def __init__(self, array: DNDarray, transform=None, ishuffle: bool = False):
+        self.htdata = array
+        self.transform = transform
+        self.ishuffle = ishuffle
+        self.comm = array.comm
+
+    @property
+    def data(self):
+        """The backing (global) jax array."""
+        return self.htdata.larray
+
+    def __getitem__(self, index):
+        item = self.htdata.larray[index]
+        if self.transform is not None:
+            item = self.transform(item)
+        return item
+
+    def __len__(self) -> int:
+        return self.htdata.shape[0]
+
+    def Shuffle(self):
+        """Shuffle the dataset along the batch axis (reference datatools.py
+        Shuffle)."""
+        dataset_shuffle(self)
+
+    def Ishuffle(self):
+        """Non-blocking shuffle (reference datatools.py Ishuffle)."""
+        dataset_ishuffle(self)
+
+
+class DataLoader:
+    """
+    Iterates batches of a (split) DNDarray or Dataset with epoch-end reshuffling.
+
+    Parameters
+    ----------
+    dataset : Dataset or DNDarray
+        The data to iterate.
+    batch_size : int
+        Samples per batch.
+    drop_last : bool
+        Drop the trailing partial batch.
+    shuffle : bool
+        Reshuffle after every epoch (reference: cross-rank slice exchange,
+        datatools.py:246-376).
+
+    Reference parity: heat/utils/data/datatools.py:16-142.
+    """
+
+    def __init__(
+        self,
+        dataset=None,
+        batch_size: int = 1,
+        drop_last: bool = True,
+        shuffle: bool = True,
+        lcl_dataset=None,
+    ):
+        if isinstance(dataset, DNDarray):
+            dataset = Dataset(dataset)
+        if dataset is None and lcl_dataset is not None:
+            dataset = lcl_dataset
+        if dataset is None:
+            raise TypeError("a Dataset or DNDarray is required")
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+        self.ishuffle = getattr(dataset, "ishuffle", False)
+        self._first_epoch = True
+
+    def __iter__(self) -> Iterator:
+        if self.shuffle and not self._first_epoch:
+            dataset_shuffle(self.dataset)
+        self._first_epoch = False
+        n = len(self.dataset)
+        nbatch = n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+        for b in range(nbatch):
+            yield self.dataset[b * self.batch_size : min((b + 1) * self.batch_size, n)]
+
+    def __len__(self) -> int:
+        n = len(self.dataset)
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+
+def dataset_shuffle(dataset, attrs: Optional[List] = None) -> None:
+    """
+    Shuffle the dataset in place with the global counter-based RNG (reference
+    datatools.py:246-330 exchanges random slices between ranks via Alltoallv).
+    """
+    target = dataset.htdata if hasattr(dataset, "htdata") else dataset
+    perm = ht.random.randperm(target.shape[0])
+    attrs = attrs or ["htdata"]
+    for attr in attrs:
+        name = attr[0] if isinstance(attr, (list, tuple)) else attr
+        arr = getattr(dataset, name, None)
+        if arr is None:
+            continue
+        if isinstance(arr, DNDarray):
+            arr.larray = jnp.take(arr.larray, perm.larray, axis=0)
+        else:
+            setattr(dataset, name, jnp.take(jnp.asarray(arr), perm.larray, axis=0))
+
+
+def dataset_ishuffle(dataset, attrs: Optional[List] = None) -> None:
+    """Non-blocking shuffle (reference datatools.py:331-376). JAX dispatch is
+    asynchronous, so this is the same operation — completion happens at first use."""
+    dataset_shuffle(dataset, attrs)

@@ -1,0 +1,34 @@
+"""
+Test-matrix generators.
+
+Parity with the reference's ``heat/utils/data/matrixgallery.py`` (``parter`` :15-48).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from ...core.communication import Communication
+from ...core.devices import Device
+from ...core.dndarray import DNDarray
+from ...core import types
+
+__all__ = ["parter"]
+
+
+def parter(
+    n: int,
+    split: Optional[int] = None,
+    device: Optional[Device] = None,
+    comm: Optional[Communication] = None,
+) -> DNDarray:
+    """
+    The (n, n) Parter matrix, a Cauchy matrix with elements 1/(i - j + 0.5) whose
+    singular values cluster at π (reference matrixgallery.py:15-48).
+    """
+    ii, jj = jnp.meshgrid(jnp.arange(n, dtype=jnp.float32), jnp.arange(n, dtype=jnp.float32), indexing="ij")
+    data = 1.0 / (ii - jj + 0.5)
+    return ht.array(data, split=split, device=device, comm=comm)

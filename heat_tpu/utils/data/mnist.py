@@ -1,0 +1,121 @@
+"""
+MNIST dataset.
+
+Parity with the reference's ``heat/utils/data/mnist.py`` (``MNISTDataset`` :16-131:
+torchvision MNIST sliced per rank with the Shuffle/Ishuffle protocol). This version
+reads the raw IDX files directly (no torchvision dependency) from a local directory;
+when the files are absent it can generate a deterministic synthetic stand-in so
+examples and tests run in air-gapped environments.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from .datatools import Dataset
+
+__all__ = ["MNISTDataset"]
+
+
+def _read_idx(path: str) -> np.ndarray:
+    """Read an (optionally gzipped) IDX file."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.reshape(dims)
+
+
+def _synthetic_mnist(n: int, seed: int = 0):
+    """Deterministic synthetic digits: 10 Gaussian-blob class templates + noise."""
+    rng = np.random.default_rng(seed)
+    templates = rng.uniform(0, 1, size=(10, 28, 28)).astype(np.float32)
+    labels = rng.integers(0, 10, size=n)
+    images = templates[labels] + 0.3 * rng.standard_normal((n, 28, 28)).astype(np.float32)
+    return images.astype(np.float32), labels.astype(np.int64)
+
+
+class MNISTDataset(Dataset):
+    """
+    MNIST digits as a (split) DNDarray dataset.
+
+    Parameters
+    ----------
+    root : str
+        Directory holding the raw IDX files
+        (``train-images-idx3-ubyte[.gz]`` etc.).
+    train : bool
+        Training or test split.
+    transform : Callable, optional
+        Per-sample image transform.
+    ishuffle : bool
+        Non-blocking shuffle protocol flag.
+    test_set : bool
+        Alias for ``not train`` (reference parity).
+    synthetic_fallback : bool
+        Generate deterministic synthetic data when the files are missing (extension
+        for air-gapped environments; the reference downloads via torchvision).
+
+    Reference parity: heat/utils/data/mnist.py:16-131.
+    """
+
+    def __init__(
+        self,
+        root: str,
+        train: bool = True,
+        transform=None,
+        ishuffle: bool = False,
+        test_set: bool = False,
+        synthetic_fallback: bool = True,
+    ):
+        if test_set:
+            train = False
+        prefix = "train" if train else "t10k"
+        img_path = None
+        lbl_path = None
+        for suffix in ("", ".gz"):
+            ip = os.path.join(root, f"{prefix}-images-idx3-ubyte{suffix}")
+            lp = os.path.join(root, f"{prefix}-labels-idx1-ubyte{suffix}")
+            if os.path.exists(ip) and os.path.exists(lp):
+                img_path, lbl_path = ip, lp
+                break
+            ip = os.path.join(root, "MNIST", "raw", f"{prefix}-images-idx3-ubyte{suffix}")
+            lp = os.path.join(root, "MNIST", "raw", f"{prefix}-labels-idx1-ubyte{suffix}")
+            if os.path.exists(ip) and os.path.exists(lp):
+                img_path, lbl_path = ip, lp
+                break
+        if img_path is not None:
+            images = _read_idx(img_path).astype(np.float32) / 255.0
+            labels = _read_idx(lbl_path).astype(np.int64)
+        elif synthetic_fallback:
+            n = 60000 if train else 10000
+            # keep the synthetic set small enough for tests unless explicitly large
+            n = min(n, 4096)
+            images, labels = _synthetic_mnist(n, seed=0 if train else 1)
+        else:
+            raise FileNotFoundError(f"MNIST IDX files not found under {root}")
+
+        data = ht.array(images, split=0)
+        super().__init__(data, transform=transform, ishuffle=ishuffle)
+        self.httargets = ht.array(labels, split=0)
+        self.train = train
+
+    @property
+    def targets(self):
+        """The label array."""
+        return self.httargets.larray
+
+    def __getitem__(self, index):
+        img = self.htdata.larray[index]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.httargets.larray[index]

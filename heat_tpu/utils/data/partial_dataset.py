@@ -1,0 +1,182 @@
+"""
+Out-of-core HDF5 dataset pipeline.
+
+Parity with the reference's ``heat/utils/data/partial_dataset.py``
+(``PartialH5Dataset`` :32, ``queue_thread`` :20, ``PartialH5DataLoaderIter`` :224):
+each process loads a window of an HDF5 file, while background threads convert/load
+the next batches during training. The host-side threading carries over unchanged —
+it feeds the TPU via async device puts instead of CUDA copies.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+
+__all__ = ["PartialH5Dataset", "PartialH5DataLoaderIter", "queue_thread"]
+
+try:
+    import h5py
+
+    _HAS_HDF5 = True
+except ImportError:  # pragma: no cover
+    _HAS_HDF5 = False
+
+
+def queue_thread(q: queue.Queue) -> None:
+    """
+    Drain and execute ``(function, args)`` items from a queue until a ``None``
+    sentinel (reference partial_dataset.py:20-30).
+    """
+    while True:
+        items = q.get()
+        if items is None:
+            q.task_done()
+            break
+        func, args = items
+        func(*args)
+        q.task_done()
+
+
+class PartialH5Dataset:
+    """
+    Windowed HDF5 dataset with background prefetch.
+
+    Parameters
+    ----------
+    file : str
+        HDF5 file path.
+    comm :
+        Communicator (parity; the controller owns all windows).
+    dataset_names : list of str
+        Names of the datasets to read (e.g. ``["data", "labels"]``).
+    initial_load : int
+        Number of samples in the resident window.
+    load_length : int
+        Number of samples fetched per background load.
+    transforms : list of Callable, optional
+        Per-dataset sample transforms.
+    use_gpu : bool
+        Parity flag (device placement is the mesh's concern here).
+    np_buffer : bool
+        Keep the prefetch buffer as numpy before device put.
+
+    Reference parity: heat/utils/data/partial_dataset.py:32-223.
+    """
+
+    def __init__(
+        self,
+        file: str,
+        comm=None,
+        dataset_names: List[str] = ("data",),
+        initial_load: int = 7000,
+        load_length: int = 1000,
+        transforms: Optional[List[Callable]] = None,
+        use_gpu: bool = True,
+        np_buffer: bool = True,
+        np_buffer_dataset_names: List[str] = ("data",),
+    ):
+        if not _HAS_HDF5:
+            raise RuntimeError("h5py is required for PartialH5Dataset")
+        self.file = file
+        self.comm = comm
+        self.dataset_names = list(dataset_names)
+        self.transforms = transforms
+        self.load_initial = initial_load
+        self.load_len = load_length
+        self.np_buffer = np_buffer
+
+        with h5py.File(file, "r") as f:
+            self.total_size = f[self.dataset_names[0]].shape[0]
+            self.loads_needed = max(1, -(-self.total_size // load_length))
+            window = {}
+            for name in self.dataset_names:
+                window[name] = np.asarray(f[name][: min(initial_load, self.total_size)])
+        self._window = window
+        self.next_start = min(initial_load, self.total_size)
+        self.load_queue: queue.Queue = queue.Queue()
+        self.load_thread = threading.Thread(target=queue_thread, args=(self.load_queue,), daemon=True)
+        self.load_thread.start()
+        self.epoch_end = False
+
+    def _load_next(self) -> None:
+        """Background fetch of the next window slab (reference
+        partial_dataset.py:120-180)."""
+        start = self.next_start
+        end = min(start + self.load_len, self.total_size)
+        if start >= self.total_size:
+            self.epoch_end = True
+            return
+        with h5py.File(self.file, "r") as f:
+            for name in self.dataset_names:
+                slab = np.asarray(f[name][start:end])
+                self._window[name] = np.concatenate([self._window[name][self.load_len:], slab], axis=0) \
+                    if self._window[name].shape[0] >= self.load_len else slab
+        self.next_start = end
+
+    def load_next_group(self) -> None:
+        """Enqueue the next background load (reference partial_dataset.py Convert)."""
+        self.load_queue.put((self._load_next, ()))
+
+    def __len__(self) -> int:
+        return self.total_size
+
+    def __getitem__(self, index):
+        out = []
+        for name in self.dataset_names:
+            item = self._window[name][index]
+            out.append(item)
+        if self.transforms:
+            out = [t(o) if t is not None else o for t, o in zip(self.transforms, out)]
+        return tuple(out) if len(out) > 1 else out[0]
+
+    def Shuffle(self) -> None:
+        """Shuffle the resident window (reference partial_dataset.py Shuffle)."""
+        perm = np.random.permutation(self._window[self.dataset_names[0]].shape[0])
+        for name in self.dataset_names:
+            self._window[name] = self._window[name][perm]
+
+    def Ishuffle(self) -> None:
+        """Queue a shuffle on the background thread."""
+        self.load_queue.put((self.Shuffle, ()))
+
+    def close(self) -> None:
+        """Stop the background thread."""
+        self.load_queue.put(None)
+        self.load_thread.join(timeout=5)
+
+
+class PartialH5DataLoaderIter:
+    """
+    Batched iterator over a :class:`PartialH5Dataset` that triggers background loads
+    while yielding device-resident batches (reference partial_dataset.py:224-359).
+    """
+
+    def __init__(self, dataset: PartialH5Dataset, batch_size: int = 32, drop_last: bool = True):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+
+    def __iter__(self):
+        window_len = self.dataset._window[self.dataset.dataset_names[0]].shape[0]
+        nbatch = window_len // self.batch_size
+        for b in range(nbatch):
+            sl = slice(b * self.batch_size, (b + 1) * self.batch_size)
+            items = self.dataset[sl]
+            if b % max(1, nbatch // max(1, self.dataset.loads_needed)) == 0:
+                self.dataset.load_next_group()
+            if isinstance(items, tuple):
+                yield tuple(jnp.asarray(i) for i in items)
+            else:
+                yield jnp.asarray(items)
+
+    def __len__(self):
+        window_len = self.dataset._window[self.dataset.dataset_names[0]].shape[0]
+        return window_len // self.batch_size

@@ -1,0 +1,49 @@
+"""
+Vision transforms.
+
+Parity with the reference's ``heat/utils/vision_transforms.py`` (:12-33), a
+``__getattr__`` fallthrough to ``torchvision.transforms``. torchvision is optional;
+a small set of jnp-native transforms is provided first, then the fallthrough (when
+torchvision is installed).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+try:
+    import torchvision.transforms as _tvt
+except ImportError:  # pragma: no cover - torchvision absent in TPU images
+    _tvt = None
+
+
+def normalize(mean, std):
+    """Returns f(x) = (x - mean) / std (jnp-native Normalize)."""
+    mean = jnp.asarray(mean)
+    std = jnp.asarray(std)
+
+    def _apply(x):
+        return (jnp.asarray(x) - mean) / std
+
+    return _apply
+
+
+def to_tensor():
+    """Returns f(x) = float32 array scaled to [0, 1] (jnp-native ToTensor)."""
+
+    def _apply(x):
+        x = jnp.asarray(x, dtype=jnp.float32)
+        return x / 255.0 if x.max() > 1.0 else x
+
+    return _apply
+
+
+def __getattr__(name: str):
+    """Fall through to torchvision.transforms when available (reference
+    vision_transforms.py:12-33)."""
+    if _tvt is not None and hasattr(_tvt, name):
+        return getattr(_tvt, name)
+    raise AttributeError(
+        f"module 'heat_tpu.utils.vision_transforms' has no attribute {name!r}"
+        + ("" if _tvt else " (torchvision not installed)")
+    )

@@ -1,0 +1,18 @@
+"""
+Test harness configuration.
+
+Mirrors the reference's CI strategy (Jenkinsfile:24-31: the whole suite under
+mpirun -n 1..8) in single-controller form: the suite runs once over a *forced
+8-device CPU mesh* (`xla_force_host_platform_device_count`), so every test that
+builds a split DNDarray exercises real multi-device sharding and the collectives XLA
+emits for it. The counter-based RNG keeps results device-count-invariant.
+"""
+
+import os
+
+# must happen before any JAX backend initialisation
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,92 @@
+"""Tests for clustering (parity model: reference heat/cluster/tests/)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+def _blobs(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    c1 = rng.normal(loc=(-5, -5), scale=0.5, size=(n // 2, 2))
+    c2 = rng.normal(loc=(5, 5), scale=0.5, size=(n // 2, 2))
+    data = np.concatenate([c1, c2]).astype(np.float32)
+    labels = np.array([0] * (n // 2) + [1] * (n // 2))
+    perm = rng.permutation(n)
+    return data[perm], labels[perm]
+
+
+def _cluster_accuracy(pred, truth):
+    match = (pred == truth).mean()
+    return max(match, 1 - match)
+
+
+@pytest.mark.parametrize("init", ["random", "probability_based"])
+def test_kmeans(init):
+    data, truth = _blobs()
+    x = ht.array(data, split=0)
+    km = ht.cluster.KMeans(n_clusters=2, init=init, max_iter=50, random_state=42)
+    km.fit(x)
+    assert km.cluster_centers_.shape == (2, 2)
+    assert km.labels_.shape == (64,)
+    pred = km.labels_.numpy()
+    assert _cluster_accuracy(pred, truth) > 0.95
+    assert km.inertia_ < 100
+    assert km.n_iter_ >= 1
+    pred2 = km.predict(x)
+    np.testing.assert_array_equal(pred2.numpy(), pred)
+
+
+def test_kmeans_explicit_init_and_errors():
+    data, _ = _blobs()
+    x = ht.array(data, split=0)
+    init_centers = ht.array(data[:2])
+    km = ht.cluster.KMeans(n_clusters=2, init=init_centers, max_iter=10)
+    km.fit(x)
+    assert km.cluster_centers_.shape == (2, 2)
+    with pytest.raises(ValueError):
+        ht.cluster.KMeans(n_clusters=2, init=ht.ones((3, 3))).fit(x)
+    with pytest.raises(ValueError):
+        ht.cluster.KMeans(n_clusters=2, init="bogus").fit(x)
+    with pytest.raises(ValueError):
+        km.fit(data)
+
+
+def test_kmedians():
+    data, truth = _blobs(seed=1)
+    x = ht.array(data, split=0)
+    km = ht.cluster.KMedians(n_clusters=2, init="random", max_iter=50, random_state=1)
+    km.fit(x)
+    assert _cluster_accuracy(km.labels_.numpy(), truth) > 0.95
+
+
+def test_kmedoids():
+    data, truth = _blobs(seed=2)
+    x = ht.array(data, split=0)
+    km = ht.cluster.KMedoids(n_clusters=2, init="random", max_iter=50, random_state=2)
+    km.fit(x)
+    assert _cluster_accuracy(km.labels_.numpy(), truth) > 0.95
+    # medoids are actual data points
+    centers = km.cluster_centers_.numpy()
+    for c in centers:
+        assert (np.abs(data - c).sum(axis=1) < 1e-5).any()
+
+
+def test_spectral():
+    data, truth = _blobs(n=32, seed=3)
+    x = ht.array(data, split=0)
+    sp = ht.cluster.Spectral(n_clusters=2, gamma=0.1, n_lanczos=20)
+    sp.fit(x)
+    assert sp.labels_.shape == (32,)
+    assert _cluster_accuracy(sp.labels_.numpy(), truth) > 0.9
+
+
+def test_get_set_params():
+    km = ht.cluster.KMeans(n_clusters=4)
+    params = km.get_params()
+    assert params["n_clusters"] == 4
+    km.set_params(n_clusters=7)
+    assert km.n_clusters == 7
+    with pytest.raises(ValueError):
+        km.set_params(bogus=1)
+    assert "KMeans" in repr(km)

@@ -1,0 +1,83 @@
+"""Tests for the mesh communication substrate (parity model: reference
+heat/core/tests/test_communication.py chunk checks :23-40)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.core.communication import MeshCommunication, WORLD, get_comm, sanitize_comm, use_comm
+
+
+def test_world_size():
+    assert WORLD.size == 8
+    assert WORLD.rank == 0
+    assert WORLD.is_distributed()
+
+
+@pytest.mark.parametrize("n", [8, 10, 17, 64, 3])
+def test_chunk_partition(n):
+    shape = (n, 5)
+    total = 0
+    prev_end = 0
+    for r in range(WORLD.size):
+        offset, lshape, slices = WORLD.chunk(shape, 0, rank=r)
+        assert offset == prev_end
+        assert lshape[1] == 5
+        total += lshape[0]
+        prev_end = offset + lshape[0]
+        assert slices[0] == slice(offset, offset + lshape[0])
+        assert slices[1] == slice(None)
+    assert total == n
+    # sizes differ by at most one, larger chunks first
+    sizes = [WORLD.chunk(shape, 0, rank=r)[1][0] for r in range(WORLD.size)]
+    assert max(sizes) - min(sizes) <= 1
+    assert sizes == sorted(sizes, reverse=True)
+
+
+def test_chunk_none_split():
+    offset, lshape, slices = WORLD.chunk((4, 4), None)
+    assert offset == 0
+    assert lshape == (4, 4)
+    assert slices == (slice(None), slice(None))
+
+
+def test_counts_displs():
+    counts, displs = WORLD.counts_displs((20, 3), 0)
+    assert sum(counts) == 20
+    assert displs[0] == 0
+    assert all(displs[i + 1] == displs[i] + counts[i] for i in range(len(counts) - 1))
+
+
+def test_lshape_map():
+    m = WORLD.lshape_map((16, 4), 0)
+    assert m.shape == (8, 2)
+    assert m[:, 0].sum() == 16
+    assert (m[:, 1] == 4).all()
+
+
+def test_is_shardable():
+    assert WORLD.is_shardable((16, 4), 0)
+    assert not WORLD.is_shardable((10, 4), 0)
+    assert WORLD.is_shardable((10, 4), None)
+
+
+def test_shard_places_data():
+    import jax.numpy as jnp
+
+    x = jnp.arange(16.0)
+    xs = WORLD.shard(x, 0)
+    shard_shapes = sorted(s.data.shape for s in xs.addressable_shards)
+    assert shard_shapes == [(2,)] * 8
+
+
+def test_sanitize_use_comm():
+    assert sanitize_comm(None) is get_comm()
+    assert sanitize_comm(WORLD) is WORLD
+    with pytest.raises(TypeError):
+        sanitize_comm("nope")
+    use_comm(WORLD)
+    assert get_comm() is WORLD
+
+
+def test_mpi_world_alias():
+    assert ht.MPI_WORLD is ht.WORLD

@@ -1,0 +1,158 @@
+"""Tests for the DNDarray container (parity model: reference
+heat/core/tests/test_dndarray.py)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+def test_properties():
+    a = ht.zeros((16, 4), split=0)
+    assert a.shape == (16, 4)
+    assert a.gshape == (16, 4)
+    assert a.ndim == 2
+    assert a.size == 64
+    assert a.gnumel == 64
+    assert a.split == 0
+    assert a.balanced
+    assert a.is_balanced()
+    assert a.dtype is ht.float32
+    assert a.itemsize == 4
+    assert a.nbytes == 256
+    assert len(a) == 16
+
+
+def test_lshape_map():
+    a = ht.zeros((16, 4), split=0)
+    m = a.lshape_map
+    assert m.shape == (8, 2)
+    assert m[:, 0].sum() == 16
+    counts, displs = a.counts_displs()
+    assert sum(counts) == 16
+    b = ht.zeros((4,))
+    with pytest.raises(ValueError):
+        b.counts_displs()
+
+
+def test_astype():
+    a = ht.ones((4,), dtype=ht.float32)
+    b = a.astype(ht.int32)
+    assert b.dtype is ht.int32
+    assert a.dtype is ht.float32
+    a.astype(ht.int8, copy=False)
+    assert a.dtype is ht.int8
+
+
+def test_item_scalar_conversions():
+    a = ht.full((1,), 5.0)
+    assert a.item() == 5.0
+    assert int(a) == 5
+    assert float(a) == 5.0
+    assert bool(a)
+    with pytest.raises(ValueError):
+        ht.ones((3,)).item()
+
+
+def test_numpy_tolist_array_protocol():
+    a = ht.arange(6, split=0)
+    np.testing.assert_array_equal(a.numpy(), np.arange(6))
+    assert a.tolist() == list(range(6))
+    np.testing.assert_array_equal(np.asarray(a), np.arange(6))
+
+
+def test_getitem_basic():
+    data = np.arange(64.0).reshape(16, 4)
+    a = ht.array(data, split=0)
+    np.testing.assert_array_equal(a[0].numpy(), data[0])
+    np.testing.assert_array_equal(a[2:5].numpy(), data[2:5])
+    np.testing.assert_array_equal(a[:, 1].numpy(), data[:, 1])
+    np.testing.assert_array_equal(a[3, 2].numpy(), data[3, 2])
+    np.testing.assert_array_equal(a[..., -1].numpy(), data[..., -1])
+    # split axis untouched -> retained
+    assert a[:, 1:3].split == 0
+    # split axis sliced -> degraded to None (conservative, correctness identical)
+    assert a[2:5].split is None
+
+
+def test_getitem_advanced():
+    data = np.arange(20).reshape(4, 5)
+    a = ht.array(data)
+    idx = ht.array([0, 2])
+    np.testing.assert_array_equal(a[idx].numpy(), data[[0, 2]])
+    mask = data > 10
+    np.testing.assert_array_equal(a[ht.array(mask)].numpy(), data[mask])
+
+
+def test_setitem():
+    data = np.zeros((4, 4))
+    a = ht.array(data.copy())
+    a[1] = 5.0
+    data[1] = 5.0
+    np.testing.assert_array_equal(a.numpy(), data)
+    a[:, 2] = ht.full((4,), 7.0)
+    data[:, 2] = 7.0
+    np.testing.assert_array_equal(a.numpy(), data)
+    a[0, 0] = -1
+    data[0, 0] = -1
+    np.testing.assert_array_equal(a.numpy(), data)
+    mask = data > 4
+    a[ht.array(mask)] = 0.0
+    data[mask] = 0.0
+    np.testing.assert_array_equal(a.numpy(), data)
+
+
+def test_resplit():
+    a = ht.zeros((16, 8), split=0)
+    a.resplit_(1)
+    assert a.split == 1
+    a.resplit_(None)
+    assert a.split is None
+    b = a.resplit(0)
+    assert b.split == 0 and a.split is None
+    np.testing.assert_array_equal(b.numpy(), a.numpy())
+
+
+def test_balance_redistribute_noop():
+    a = ht.zeros((10, 3), split=0)  # 10 not divisible by 8: replicated fallback
+    a.balance_()
+    a.redistribute_()
+    assert a.is_balanced()
+    with pytest.raises(ValueError):
+        a.redistribute_(target_map=np.zeros((8, 2), dtype=int))
+
+
+def test_halo():
+    a = ht.array(np.arange(32.0).reshape(16, 2), split=0)
+    a.get_halo(1)
+    assert a.halo_prev is not None and a.halo_next is not None
+    with pytest.raises(TypeError):
+        a.get_halo("x")
+    with pytest.raises(ValueError):
+        a.get_halo(-1)
+
+
+def test_lloc():
+    a = ht.zeros((4, 4))
+    a.lloc[0, 0] = 3.0
+    assert a.larray[0, 0] == 3.0
+    assert float(a.lloc[0, 0]) == 3.0
+
+
+def test_iter_and_T():
+    a = ht.array(np.arange(6.0).reshape(3, 2))
+    rows = [r.numpy() for r in a]
+    assert len(rows) == 3
+    np.testing.assert_array_equal(a.T.numpy(), a.numpy().T)
+
+
+def test_repr():
+    s = repr(ht.ones((2, 2), split=0))
+    assert "DNDarray" in s and "float32" in s and "split=0" in s
+
+
+def test_cpu():
+    a = ht.ones((2,), split=0)
+    b = a.cpu()
+    assert b.device.device_type == "cpu"
+    np.testing.assert_array_equal(b.numpy(), a.numpy())

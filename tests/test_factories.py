@@ -1,0 +1,116 @@
+"""Tests for array creation (parity model: reference heat/core/tests/test_factories.py)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+SPLITS = [None, 0, 1]
+
+
+def test_array_basic():
+    a = ht.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.split is None
+    np.testing.assert_array_equal(a.numpy(), [[1, 2], [3, 4]])
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_array_split(split):
+    data = np.arange(32.0).reshape(16, 2)
+    a = ht.array(data, split=split)
+    assert a.split == split
+    assert a.shape == (16, 2)
+    np.testing.assert_array_equal(a.numpy(), data)
+
+
+def test_array_is_split():
+    data = np.arange(8.0)
+    a = ht.array(data, is_split=0)
+    assert a.split == 0
+    np.testing.assert_array_equal(a.numpy(), data)
+
+
+def test_array_dtype_ndmin():
+    a = ht.array([1, 2, 3], dtype=ht.float32, ndmin=3)
+    assert a.dtype is ht.float32
+    assert a.shape == (1, 1, 3)
+    with pytest.raises(ValueError):
+        ht.array([1], order="X")
+    with pytest.raises(ValueError):
+        ht.array([1], split=0, is_split=0)
+
+
+def test_asarray_passthrough():
+    a = ht.ones((3,))
+    assert ht.asarray(a) is a
+
+
+def test_arange():
+    np.testing.assert_array_equal(ht.arange(10).numpy(), np.arange(10))
+    np.testing.assert_array_equal(ht.arange(2, 10).numpy(), np.arange(2, 10))
+    np.testing.assert_array_equal(ht.arange(2, 10, 3).numpy(), np.arange(2, 10, 3))
+    a = ht.arange(16, split=0)
+    assert a.split == 0
+    with pytest.raises(TypeError):
+        ht.arange()
+
+
+def test_linspace_logspace():
+    np.testing.assert_allclose(ht.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5), rtol=1e-6)
+    arr, step = ht.linspace(0, 10, 11, retstep=True)
+    assert step == 1.0
+    np.testing.assert_allclose(
+        ht.logspace(0, 2, 4).numpy(), np.logspace(0, 2, 4).astype(np.float32), rtol=1e-5
+    )
+    with pytest.raises(ValueError):
+        ht.linspace(0, 1, 0)
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_eye(split):
+    e = ht.eye(6, split=split)
+    np.testing.assert_array_equal(e.numpy(), np.eye(6, dtype=np.float32))
+    e2 = ht.eye((4, 6))
+    assert e2.shape == (4, 6)
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+def test_zeros_ones_full(split):
+    shape = (8, 4)
+    z = ht.zeros(shape, split=split)
+    o = ht.ones(shape, split=split)
+    f = ht.full(shape, 7.0, split=split)
+    np.testing.assert_array_equal(z.numpy(), np.zeros(shape))
+    np.testing.assert_array_equal(o.numpy(), np.ones(shape))
+    np.testing.assert_array_equal(f.numpy(), np.full(shape, 7.0))
+    assert z.split == split and o.split == split and f.split == split
+
+
+def test_like_factories():
+    a = ht.ones((4, 4), dtype=ht.int32, split=0)
+    z = ht.zeros_like(a)
+    assert z.shape == a.shape and z.dtype is a.dtype and z.split == a.split
+    o = ht.ones_like(a, dtype=ht.float32)
+    assert o.dtype is ht.float32
+    f = ht.full_like(a, 3)
+    assert (f.numpy() == 3).all()
+    e = ht.empty_like(a)
+    assert e.shape == a.shape
+
+
+def test_empty():
+    e = ht.empty((2, 3), dtype=ht.float64)
+    assert e.shape == (2, 3)
+
+
+def test_meshgrid():
+    x = ht.arange(3)
+    y = ht.arange(4, split=0)
+    xx, yy = ht.meshgrid(x, y)
+    nx, ny = np.meshgrid(np.arange(3), np.arange(4))
+    np.testing.assert_array_equal(xx.numpy(), nx)
+    np.testing.assert_array_equal(yy.numpy(), ny)
+    assert ht.meshgrid() == []
+    with pytest.raises(ValueError):
+        ht.meshgrid(x, indexing="ab")

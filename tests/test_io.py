@@ -1,0 +1,72 @@
+"""Tests for I/O (parity model: reference heat/core/tests/test_io.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+def test_supports():
+    assert ht.supports_hdf5()  # h5py is baked in
+    assert isinstance(ht.supports_netcdf(), bool)
+
+
+def test_hdf5_roundtrip(tmp_path):
+    path = str(tmp_path / "data.h5")
+    data = np.arange(64.0, dtype=np.float32).reshape(16, 4)
+    a = ht.array(data, split=0)
+    ht.save_hdf5(a, path, "mydata")
+    b = ht.load_hdf5(path, "mydata", split=0)
+    np.testing.assert_array_equal(b.numpy(), data)
+    assert b.split == 0
+    c = ht.load(path, "mydata")
+    np.testing.assert_array_equal(c.numpy(), data)
+    with pytest.raises(TypeError):
+        ht.load_hdf5(1, "x")
+    with pytest.raises(TypeError):
+        ht.load_hdf5(path, 1)
+    with pytest.raises(TypeError):
+        ht.save_hdf5("no", path, "x")
+
+
+def test_save_load_dispatch(tmp_path):
+    a = ht.ones((4, 2))
+    h5 = str(tmp_path / "a.h5")
+    ht.save(a, h5, "data")
+    np.testing.assert_array_equal(ht.load(h5, "data").numpy(), a.numpy())
+    with pytest.raises(ValueError):
+        ht.save(a, str(tmp_path / "a.xyz"))
+    with pytest.raises(ValueError):
+        ht.load(str(tmp_path / "a.xyz"))
+    with pytest.raises(TypeError):
+        ht.load(17)
+
+
+def test_csv_roundtrip(tmp_path):
+    path = str(tmp_path / "data.csv")
+    data = np.arange(12.0, dtype=np.float32).reshape(4, 3)
+    ht.save_csv(ht.array(data), path)
+    b = ht.load_csv(path, split=0)
+    np.testing.assert_allclose(b.numpy(), data)
+    # header lines and custom sep
+    path2 = str(tmp_path / "data2.csv")
+    ht.save_csv(ht.array(data), path2, header_lines="a;b;c", sep=";")
+    c = ht.load_csv(path2, header_lines=1, sep=";")
+    np.testing.assert_allclose(c.numpy(), data)
+    with pytest.raises(TypeError):
+        ht.load_csv(1)
+    with pytest.raises(TypeError):
+        ht.load_csv(path, sep=5)
+    with pytest.raises(TypeError):
+        ht.load_csv(path, header_lines="x")
+    with pytest.raises(ValueError):
+        ht.save_csv(ht.ones((2, 2, 2)), path)
+
+
+def test_dndarray_save_method(tmp_path):
+    path = str(tmp_path / "m.h5")
+    a = ht.ones((4,))
+    a.save(path, "d")
+    np.testing.assert_array_equal(ht.load(path, "d").numpy(), a.numpy())

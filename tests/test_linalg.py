@@ -1,0 +1,133 @@
+"""Tests for linear algebra (parity model: reference
+heat/core/linalg/tests/test_{basics,qr,solver}.py)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+SPLITS = [None, 0, 1]
+
+
+@pytest.mark.parametrize("sa", SPLITS)
+@pytest.mark.parametrize("sb", SPLITS)
+def test_matmul_split_matrix(sa, sb):
+    rng = np.random.default_rng(4)
+    a = rng.normal(size=(16, 8)).astype(np.float32)
+    b = rng.normal(size=(8, 24)).astype(np.float32)
+    ha = ht.array(a, split=sa)
+    hb = ht.array(b, split=sb)
+    res = ht.matmul(ha, hb)
+    np.testing.assert_allclose(res.numpy(), a @ b, rtol=1e-4)
+    if sa == 0:
+        assert res.split == 0
+    elif sb == 1:
+        assert res.split == 1
+
+
+def test_matmul_operator_and_vectors():
+    a = ht.array(np.arange(6.0).reshape(2, 3))
+    b = ht.array(np.arange(3.0))
+    np.testing.assert_allclose((a @ b).numpy(), a.numpy() @ b.numpy())
+    np.testing.assert_allclose(ht.dot(b, b).numpy(), 5.0)
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_qr(split):
+    rng = np.random.default_rng(5)
+    a = rng.normal(size=(32, 4)).astype(np.float32)
+    h = ht.array(a, split=split)
+    q, r = ht.qr(h)
+    np.testing.assert_allclose(q.numpy() @ r.numpy(), a, atol=1e-4)
+    np.testing.assert_allclose(q.numpy().T @ q.numpy(), np.eye(4), atol=1e-4)
+    assert np.allclose(r.numpy(), np.triu(r.numpy()), atol=1e-5)
+    if split == 0:
+        assert q.split == 0
+    r_only = ht.qr(h, calc_q=False)
+    assert r_only.Q is None
+    np.testing.assert_allclose(np.abs(r_only.R.numpy()), np.abs(r.numpy()), atol=1e-4)
+    with pytest.raises(ValueError):
+        ht.qr(ht.ones(3))
+
+
+def test_det_inv_trace():
+    rng = np.random.default_rng(6)
+    a = rng.normal(size=(4, 4)).astype(np.float32) + 4 * np.eye(4, dtype=np.float32)
+    h = ht.array(a)
+    np.testing.assert_allclose(float(ht.det(h).larray), np.linalg.det(a), rtol=1e-3)
+    np.testing.assert_allclose(ht.inv(h).numpy(), np.linalg.inv(a), rtol=1e-3, atol=1e-5)
+    assert abs(ht.trace(h) - np.trace(a)) < 1e-4
+    with pytest.raises(ValueError):
+        ht.det(ht.ones((2, 3)))
+
+
+def test_norms():
+    a = np.arange(1.0, 7.0, dtype=np.float32).reshape(2, 3)
+    h = ht.array(a, split=0)
+    np.testing.assert_allclose(float(ht.norm(h).larray), np.linalg.norm(a), rtol=1e-5)
+    np.testing.assert_allclose(
+        ht.vector_norm(ht.array(a[0])).numpy(), np.linalg.norm(a[0]), rtol=1e-5
+    )
+    np.testing.assert_allclose(ht.matrix_norm(h).numpy(), np.linalg.norm(a), rtol=1e-5)
+
+
+def test_transpose_tril_triu():
+    a = np.arange(12.0, dtype=np.float32).reshape(3, 4)
+    h = ht.array(a, split=1)
+    t = ht.transpose(h)
+    np.testing.assert_array_equal(t.numpy(), a.T)
+    assert t.split == 0
+    np.testing.assert_array_equal(ht.tril(ht.array(a)).numpy(), np.tril(a))
+    np.testing.assert_array_equal(ht.triu(ht.array(a), k=1).numpy(), np.triu(a, 1))
+
+
+def test_outer_projection_vdot_vecdot_cross():
+    x = np.array([1.0, 2.0, 3.0], np.float32)
+    y = np.array([4.0, 5.0, 6.0], np.float32)
+    hx, hy = ht.array(x, split=0), ht.array(y)
+    np.testing.assert_allclose(ht.outer(hx, hy).numpy(), np.outer(x, y))
+    np.testing.assert_allclose(
+        ht.projection(hx, hy).numpy(), (x @ y) / (y @ y) * y, rtol=1e-5
+    )
+    np.testing.assert_allclose(float(ht.vdot(hx, hy).larray), np.vdot(x, y))
+    np.testing.assert_allclose(ht.vecdot(hx, hy).numpy(), np.dot(x, y))
+    np.testing.assert_allclose(ht.cross(hx, hy).numpy(), np.cross(x, y))
+    with pytest.raises(RuntimeError):
+        ht.projection(ht.ones((2, 2)), hy)
+
+
+def test_cg():
+    rng = np.random.default_rng(7)
+    m = rng.normal(size=(6, 6)).astype(np.float32)
+    A = m @ m.T + 6 * np.eye(6, dtype=np.float32)
+    b = rng.normal(size=(6,)).astype(np.float32)
+    hA, hb = ht.array(A), ht.array(b)
+    x0 = ht.zeros((6,))
+    x = ht.cg(hA, hb, x0)
+    np.testing.assert_allclose(A @ x.numpy(), b, atol=1e-2)
+    with pytest.raises(TypeError):
+        ht.cg(A, hb, x0)
+
+
+def test_lanczos():
+    rng = np.random.default_rng(8)
+    m = rng.normal(size=(10, 10)).astype(np.float32)
+    A = (m + m.T) / 2
+    hA = ht.array(A)
+    V, T = ht.lanczos(hA, 10)
+    # V T V^T ~ A for full Krylov dimension
+    recon = V.numpy() @ T.numpy() @ V.numpy().T
+    np.testing.assert_allclose(recon, A, atol=1e-2)
+
+
+def test_svd():
+    rng = np.random.default_rng(9)
+    a = rng.normal(size=(32, 4)).astype(np.float32)
+    h = ht.array(a, split=0)
+    u, s, vh = ht.linalg.svd(h)
+    np.testing.assert_allclose(
+        u.numpy() @ np.diag(s.numpy()) @ vh.numpy(), a, atol=1e-3
+    )
+    np.testing.assert_allclose(s.numpy(), np.linalg.svd(a, compute_uv=False), rtol=1e-4)
+    s_only = ht.linalg.svd(ht.array(a), compute_uv=False)
+    np.testing.assert_allclose(s_only.numpy(), np.linalg.svd(a, compute_uv=False), rtol=1e-4)

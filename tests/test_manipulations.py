@@ -1,0 +1,162 @@
+"""Tests for manipulations (parity model: reference
+heat/core/tests/test_manipulations.py)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+SPLITS = [None, 0, 1]
+
+
+def _arr(split=0, shape=(8, 4)):
+    a = np.arange(np.prod(shape), dtype=np.float32).reshape(shape)
+    return ht.array(a, split=split), a
+
+
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("axis", [0, 1])
+def test_concatenate(split, axis):
+    h1, a1 = _arr(split)
+    h2, a2 = _arr(split)
+    res = ht.concatenate([h1, h2], axis=axis)
+    np.testing.assert_array_equal(res.numpy(), np.concatenate([a1, a2], axis=axis))
+    assert res.split == split
+    with pytest.raises(TypeError):
+        ht.concatenate([])
+
+
+def test_stack_hstack_vstack_dstack_analogs():
+    h, a = _arr(None, (4, 3))
+    np.testing.assert_array_equal(ht.stack([h, h], axis=0).numpy(), np.stack([a, a]))
+    np.testing.assert_array_equal(ht.stack([h, h], axis=2).numpy(), np.stack([a, a], axis=2))
+    np.testing.assert_array_equal(ht.hstack([h, h]).numpy(), np.hstack([a, a]))
+    np.testing.assert_array_equal(ht.vstack([h, h]).numpy(), np.vstack([a, a]))
+    np.testing.assert_array_equal(ht.column_stack([h, h]).numpy(), np.column_stack([a, a]))
+    np.testing.assert_array_equal(ht.row_stack([h, h]).numpy(), np.row_stack([a, a]))
+    v = ht.arange(3)
+    np.testing.assert_array_equal(ht.hstack([v, v]).numpy(), np.hstack([np.arange(3)] * 2))
+    with pytest.raises(ValueError):
+        ht.stack([h, ht.ones((2, 2))])
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_reshape_ravel_flatten(split):
+    h, a = _arr(split, (8, 4))
+    np.testing.assert_array_equal(ht.reshape(h, (4, 8)).numpy(), a.reshape(4, 8))
+    np.testing.assert_array_equal(ht.reshape(h, 32).numpy(), a.reshape(32))
+    np.testing.assert_array_equal(ht.reshape(h, (-1, 2)).numpy(), a.reshape(-1, 2))
+    np.testing.assert_array_equal(ht.flatten(h).numpy(), a.flatten())
+    np.testing.assert_array_equal(ht.ravel(h).numpy(), a.ravel())
+    assert ht.reshape(h, (4, 8), new_split=1).split == 1
+    with pytest.raises(ValueError):
+        ht.reshape(h, (-1, -1))
+
+
+def test_sort_topk():
+    rng = np.random.default_rng(3)
+    a = rng.normal(size=(8, 6)).astype(np.float32)
+    h = ht.array(a, split=0)
+    v, i = ht.sort(h, axis=1)
+    np.testing.assert_array_equal(v.numpy(), np.sort(a, axis=1))
+    np.testing.assert_array_equal(i.numpy(), np.argsort(a, axis=1, kind="stable"))
+    vd, _ = ht.sort(h, axis=0, descending=True)
+    np.testing.assert_array_equal(vd.numpy(), -np.sort(-a, axis=0))
+    tv, ti = ht.topk(h, 3, dim=1)
+    np.testing.assert_array_equal(tv.numpy(), -np.sort(-a, axis=1)[:, :3])
+    sv, si = ht.topk(h, 2, dim=1, largest=False)
+    np.testing.assert_array_equal(sv.numpy(), np.sort(a, axis=1)[:, :2])
+
+
+def test_unique():
+    a = np.array([3, 1, 3, 2, 2, 7, 1, 0])
+    h = ht.array(a, split=0)
+    np.testing.assert_array_equal(ht.unique(h).numpy(), np.unique(a))
+    vals, inv = ht.unique(h, return_inverse=True)
+    nv, ni = np.unique(a, return_inverse=True)
+    np.testing.assert_array_equal(vals.numpy(), nv)
+    np.testing.assert_array_equal(inv.numpy().reshape(-1), ni)
+
+
+def test_pad_roll_flip():
+    h, a = _arr(0, (8, 4))
+    np.testing.assert_array_equal(
+        ht.pad(h, ((1, 1), (0, 2))).numpy(), np.pad(a, ((1, 1), (0, 2)))
+    )
+    np.testing.assert_array_equal(ht.roll(h, 2, axis=0).numpy(), np.roll(a, 2, axis=0))
+    np.testing.assert_array_equal(ht.roll(h, -1).numpy(), np.roll(a, -1))
+    np.testing.assert_array_equal(ht.flip(h, 0).numpy(), np.flip(a, 0))
+    np.testing.assert_array_equal(ht.flipud(h).numpy(), np.flipud(a))
+    np.testing.assert_array_equal(ht.fliplr(h).numpy(), np.fliplr(a))
+    with pytest.raises(IndexError):
+        ht.fliplr(ht.arange(3))
+
+
+def test_squeeze_expand_dims_broadcast_to():
+    h = ht.ones((1, 8, 1, 4), split=1)
+    s = ht.squeeze(h)
+    assert s.shape == (8, 4)
+    assert s.split == 0
+    e = ht.expand_dims(ht.arange(8, split=0), 0)
+    assert e.shape == (1, 8)
+    assert e.split == 1
+    b = ht.broadcast_to(ht.arange(4), (3, 4))
+    assert b.shape == (3, 4)
+
+
+def test_diag_diagonal():
+    h, a = _arr(None, (4, 4))
+    np.testing.assert_array_equal(ht.diag(h).numpy(), np.diag(a))
+    np.testing.assert_array_equal(ht.diagonal(h, offset=1).numpy(), np.diagonal(a, offset=1))
+    v = ht.arange(3)
+    np.testing.assert_array_equal(ht.diag(v).numpy(), np.diag(np.arange(3)))
+    with pytest.raises(ValueError):
+        ht.diag(ht.ones((2, 2, 2)))
+
+
+def test_split_family():
+    h, a = _arr(None, (8, 4))
+    parts = ht.split(h, 4, axis=0)
+    assert len(parts) == 4
+    np.testing.assert_array_equal(parts[0].numpy(), a[:2])
+    hs = ht.hsplit(h, 2)
+    np.testing.assert_array_equal(hs[1].numpy(), a[:, 2:])
+    vs = ht.vsplit(h, 2)
+    np.testing.assert_array_equal(vs[1].numpy(), a[4:])
+    d = ht.ones((2, 2, 4))
+    ds = ht.dsplit(d, 2)
+    assert ds[0].shape == (2, 2, 2)
+    with pytest.raises(ValueError):
+        ht.split(h, 3, axis=0)
+
+
+def test_moveaxis_swapaxes_rot90_tile_repeat():
+    h, a = _arr(0, (8, 4))
+    np.testing.assert_array_equal(ht.moveaxis(h, 0, 1).numpy(), np.moveaxis(a, 0, 1))
+    sw = ht.swapaxes(h, 0, 1)
+    np.testing.assert_array_equal(sw.numpy(), np.swapaxes(a, 0, 1))
+    assert sw.split == 1
+    np.testing.assert_array_equal(ht.rot90(h).numpy(), np.rot90(a))
+    np.testing.assert_array_equal(ht.tile(h, (2, 1)).numpy(), np.tile(a, (2, 1)))
+    np.testing.assert_array_equal(ht.repeat(h, 2, axis=1).numpy(), np.repeat(a, 2, axis=1))
+    np.testing.assert_array_equal(ht.repeat(h, 2).numpy(), np.repeat(a, 2))
+
+
+def test_resplit_redistribute_balance_shape():
+    h, a = _arr(0, (16, 4))
+    r = ht.resplit(h, 1)
+    assert r.split == 1 and h.split == 0
+    rr = ht.redistribute(h)
+    np.testing.assert_array_equal(rr.numpy(), a)
+    assert ht.balance(h) is h
+    assert ht.manipulations.shape(h) == (16, 4) if hasattr(ht, "manipulations") else True
+    from heat_tpu.core.manipulations import shape as _shape
+
+    assert _shape(h) == (16, 4)
+
+
+def test_diagonal_batch_split_remap():
+    a = ht.ones((2, 3, 8), split=2)
+    d = ht.diagonal(a, dim1=0, dim2=1)  # batch axis 2 survives, shifts to 0
+    assert d.split == 0
+    assert d.shape == (8, 2)

@@ -1,0 +1,171 @@
+"""Tests for indexing, printing, memory, devices, constants, tiling, utils.data
+(parity model: reference heat/core/tests/ + heat/utils/tests/)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+def test_nonzero_where():
+    a = ht.array(np.array([[0, 1], [2, 0]]), split=0)
+    nz = ht.nonzero(a)
+    np.testing.assert_array_equal(nz.numpy(), np.stack(np.nonzero(a.numpy()), axis=1))
+    v = ht.array(np.array([0, 3, 0, 5]))
+    np.testing.assert_array_equal(ht.nonzero(v).numpy(), np.nonzero(v.numpy())[0])
+    w = ht.where(a > 0, a, -1)
+    np.testing.assert_array_equal(w.numpy(), np.where(a.numpy() > 0, a.numpy(), -1))
+    w2 = ht.where(a > 0)
+    np.testing.assert_array_equal(w2.numpy(), np.stack(np.nonzero(a.numpy()), axis=1))
+    with pytest.raises(TypeError):
+        ht.where(a > 0, a)
+
+
+def test_printing_options():
+    opts = ht.get_printoptions()
+    assert "precision" in opts
+    ht.set_printoptions(precision=2)
+    assert ht.get_printoptions()["precision"] == 2
+    ht.set_printoptions(profile="full")
+    ht.set_printoptions(profile="short")
+    ht.set_printoptions(profile="default")
+    ht.local_printing()
+    ht.global_printing()
+    ht.print0("rank0 print")
+
+
+def test_memory():
+    a = ht.ones((3,), split=0)
+    b = ht.copy(a)
+    b.lloc[0] = 5.0
+    assert float(a.larray[0]) == 1.0
+    assert ht.sanitize_memory_layout(a, "C") is a
+    with pytest.raises(ValueError):
+        ht.sanitize_memory_layout(a, "X")
+    c = a.copy()
+    np.testing.assert_array_equal(c.numpy(), a.numpy())
+
+
+def test_devices():
+    assert ht.cpu.device_type == "cpu"
+    d = ht.get_device()
+    assert d.device_type == "cpu"  # forced in conftest
+    assert ht.sanitize_device(None) is d
+    assert ht.sanitize_device("cpu") is ht.cpu
+    assert ht.sanitize_device(ht.cpu) is ht.cpu
+    with pytest.raises(ValueError):
+        ht.sanitize_device("quantum")
+    ht.use_device("cpu")
+    assert ht.get_device() is ht.cpu
+    assert "cpu" in repr(ht.cpu)
+    assert ht.cpu == ht.cpu
+    assert hash(ht.cpu) == hash(ht.cpu)
+
+
+def test_constants():
+    assert ht.pi == np.pi
+    assert ht.e == np.e
+    assert ht.inf == np.inf
+    assert np.isnan(ht.nan)
+    assert ht.Inf is ht.inf
+
+
+def test_tiling():
+    from heat_tpu.core.tiling import SplitTiles, SquareDiagTiles
+
+    a = ht.array(np.arange(64.0).reshape(16, 4), split=0)
+    st = SplitTiles(a)
+    assert st.arr is a
+    assert st.tile_locations.shape == (8, 8)
+    t0 = st[0, 0]
+    assert t0.shape[0] == 2
+    st[0, 0] = np.zeros_like(np.asarray(t0))
+    assert float(a.larray[0, 0]) == 0.0
+    sq = SquareDiagTiles(a, tiles_per_proc=1)
+    assert sq.tile_rows >= 1 and sq.tile_columns >= 1
+    tile = sq.get_tile(0, 0)
+    sq.set_tile(0, 0, np.ones_like(np.asarray(tile)))
+    assert float(a.larray[0, 0]) == 1.0
+    with pytest.raises(ValueError):
+        SquareDiagTiles(ht.ones(3))
+
+
+def test_dataloader_dataset():
+    data = np.arange(64.0, dtype=np.float32).reshape(16, 4)
+    ds = ht.utils.data.Dataset(ht.array(data, split=0))
+    assert len(ds) == 16
+    loader = ht.utils.data.DataLoader(ds, batch_size=4)
+    batches = list(loader)
+    assert len(batches) == 4
+    assert batches[0].shape == (4, 4)
+    # epoch 2 reshuffles
+    ht.random.seed(0)
+    batches2 = list(loader)
+    assert len(batches2) == 4
+    # DNDarray direct
+    loader2 = ht.utils.data.DataLoader(ht.array(data), batch_size=5, drop_last=False)
+    assert len(loader2) == 4
+    with pytest.raises(TypeError):
+        ht.utils.data.DataLoader()
+
+
+def test_dataset_shuffle():
+    data = np.arange(32.0, dtype=np.float32).reshape(16, 2)
+    ds = ht.utils.data.Dataset(ht.array(data, split=0))
+    ht.random.seed(5)
+    ht.utils.data.dataset_shuffle(ds)
+    shuffled = np.asarray(ds.htdata.larray)
+    assert not np.array_equal(shuffled, data)
+    np.testing.assert_array_equal(np.sort(shuffled[:, 0]), data[:, 0])
+    ds.Shuffle()
+    ds.Ishuffle()
+
+
+def test_mnist_synthetic(tmp_path):
+    ds = ht.utils.data.MNISTDataset(str(tmp_path), train=True)
+    img, lbl = ds[0]
+    assert img.shape == (28, 28)
+    assert 0 <= int(lbl) <= 9
+    assert len(ds) > 0
+    assert ds.targets.shape[0] == len(ds)
+
+
+def test_parter():
+    p = ht.utils.data.parter(10)
+    assert p.shape == (10, 10)
+    s = np.linalg.svd(p.numpy(), compute_uv=False)
+    assert abs(s[0] - np.pi) < 0.1
+
+
+def test_partial_h5(tmp_path):
+    import h5py
+
+    path = str(tmp_path / "p.h5")
+    with h5py.File(path, "w") as f:
+        f["data"] = np.arange(200.0, dtype=np.float32).reshape(50, 4)
+        f["labels"] = np.arange(50)
+    ds = ht.utils.data.PartialH5Dataset(path, dataset_names=["data", "labels"], initial_load=20, load_length=10)
+    assert len(ds) == 50
+    x, y = ds[0]
+    assert x.shape == (4,)
+    it = ht.utils.data.PartialH5DataLoaderIter(ds, batch_size=5)
+    batches = list(it)
+    assert len(batches) == 4
+    ds.Shuffle()
+    ds.close()
+
+
+def test_vision_transforms():
+    from heat_tpu.utils import vision_transforms as vt
+
+    f = vt.normalize(0.5, 0.5)
+    np.testing.assert_allclose(np.asarray(f(np.array([1.0]))), [1.0])
+    g = vt.to_tensor()
+    out = np.asarray(g(np.array([255.0])))
+    np.testing.assert_allclose(out, [1.0])
+    with pytest.raises(AttributeError):
+        vt.DefinitelyNotATransform
+
+
+def test_version():
+    assert ht.__version__.startswith("0.")

@@ -1,0 +1,133 @@
+"""Tests for Lasso, kNN, GaussianNB, Laplacian (parity model: reference
+heat/{regression,classification,naive_bayes,graph}/tests/)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+def test_lasso():
+    rng = np.random.default_rng(20)
+    n, f = 64, 4
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    true_coef = np.array([2.0, 0.0, -3.0, 0.0], np.float32)
+    y = X @ true_coef + 1.5 + 0.01 * rng.normal(size=n).astype(np.float32)
+    lasso = ht.regression.Lasso(lam=0.01, max_iter=200, tol=1e-8)
+    lasso.fit(ht.array(X, split=0), ht.array(y, split=0))
+    coef = lasso.coef_.numpy().reshape(-1)
+    assert abs(coef[0] - 2.0) < 0.2
+    assert abs(coef[2] + 3.0) < 0.2
+    assert abs(lasso.intercept_.item() - 1.5) < 0.2
+    pred = lasso.predict(ht.array(X, split=0))
+    rmse = lasso.rmse(ht.array(y), ht.array(pred.numpy().reshape(-1)))
+    assert rmse < 0.5
+    assert lasso.lam == 0.01
+    lasso.lam = 0.5
+    assert lasso.lam == 0.5
+    with pytest.raises(ValueError):
+        lasso.fit(X, ht.array(y))
+
+
+def test_lasso_soft_threshold():
+    lasso = ht.regression.Lasso(lam=1.0)
+    import jax.numpy as jnp
+
+    out = lasso.soft_threshold(jnp.asarray([-2.0, 0.5, 2.0]))
+    np.testing.assert_allclose(np.asarray(out), [-1.0, 0.0, 1.0])
+
+
+def test_knn():
+    rng = np.random.default_rng(21)
+    c1 = rng.normal(loc=(-3, -3), size=(32, 2)).astype(np.float32)
+    c2 = rng.normal(loc=(3, 3), size=(32, 2)).astype(np.float32)
+    X = np.concatenate([c1, c2])
+    y = np.array([0] * 32 + [1] * 32)
+    knn = ht.classification.KNeighborsClassifier(n_neighbors=5)
+    knn.fit(ht.array(X, split=0), ht.array(y, split=0))
+    pred = knn.predict(ht.array(X, split=0))
+    assert (pred.numpy() == y).mean() > 0.95
+    with pytest.raises(RuntimeError):
+        ht.classification.KNeighborsClassifier().predict(ht.array(X))
+    with pytest.raises(ValueError):
+        knn.fit(X, y)
+
+
+def test_knn_one_hot_labels():
+    rng = np.random.default_rng(22)
+    X = rng.normal(size=(16, 2)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[np.array([0, 1] * 8)]
+    knn = ht.classification.KNeighborsClassifier(n_neighbors=3)
+    knn.fit(ht.array(X), ht.array(y))
+    pred = knn.predict(ht.array(X))
+    assert pred.shape == (16,)
+
+
+def test_gaussian_nb():
+    from sklearn.naive_bayes import GaussianNB as SkGNB
+
+    rng = np.random.default_rng(23)
+    c1 = rng.normal(loc=(-2, 0), size=(40, 2)).astype(np.float32)
+    c2 = rng.normal(loc=(2, 1), size=(40, 2)).astype(np.float32)
+    X = np.concatenate([c1, c2])
+    y = np.array([0] * 40 + [1] * 40)
+    gnb = ht.naive_bayes.GaussianNB()
+    gnb.fit(ht.array(X, split=0), ht.array(y, split=0))
+    pred = gnb.predict(ht.array(X, split=0)).numpy()
+    sk = SkGNB().fit(X, y)
+    sk_pred = sk.predict(X)
+    assert (pred == sk_pred).mean() > 0.97
+    np.testing.assert_allclose(gnb.theta_.numpy(), sk.theta_, rtol=1e-3, atol=1e-3)
+    proba = gnb.predict_proba(ht.array(X, split=0)).numpy()
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-4)
+    logp = gnb.predict_log_proba(ht.array(X, split=0)).numpy()
+    np.testing.assert_allclose(np.exp(logp), proba, rtol=1e-4, atol=1e-5)
+
+
+def test_gaussian_nb_partial_fit_and_priors():
+    rng = np.random.default_rng(24)
+    X = rng.normal(size=(40, 3)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int64)
+    gnb = ht.naive_bayes.GaussianNB()
+    gnb.partial_fit(ht.array(X[:20]), ht.array(y[:20]), classes=np.array([0, 1]))
+    gnb.partial_fit(ht.array(X[20:]), ht.array(y[20:]))
+    full = ht.naive_bayes.GaussianNB().fit(ht.array(X), ht.array(y))
+    np.testing.assert_allclose(gnb.theta_.numpy(), full.theta_.numpy(), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(gnb.sigma_.numpy(), full.sigma_.numpy(), rtol=1e-2, atol=1e-4)
+    with pytest.raises(ValueError):
+        ht.naive_bayes.GaussianNB(priors=[0.9, 0.2]).fit(ht.array(X), ht.array(y))
+    with pytest.raises(ValueError):
+        ht.naive_bayes.GaussianNB(priors=[0.9, 0.1, 0.0]).fit(ht.array(X), ht.array(y))
+    ok = ht.naive_bayes.GaussianNB(priors=[0.5, 0.5]).fit(ht.array(X), ht.array(y))
+    np.testing.assert_allclose(ok.class_prior_.numpy(), [0.5, 0.5])
+
+
+def test_laplacian():
+    rng = np.random.default_rng(25)
+    X = rng.normal(size=(8, 2)).astype(np.float32)
+    lap = ht.graph.Laplacian(lambda x: ht.spatial.rbf(x, sigma=1.0), definition="simple")
+    L = lap.construct(ht.array(X, split=0))
+    Ln = L.numpy()
+    np.testing.assert_allclose(Ln.sum(axis=1), 0.0, atol=1e-5)
+    assert (np.diag(Ln) >= 0).all()
+    lap2 = ht.graph.Laplacian(
+        lambda x: ht.spatial.rbf(x, sigma=1.0),
+        definition="norm_sym",
+        mode="eNeighbour",
+        threshold_key="lower",
+        threshold_value=0.5,
+    )
+    L2 = lap2.construct(ht.array(X, split=0))
+    assert L2.shape == (8, 8)
+    with pytest.raises(NotImplementedError):
+        ht.graph.Laplacian(lambda x: x, definition="bogus")
+    with pytest.raises(NotImplementedError):
+        ht.graph.Laplacian(lambda x: x, mode="bogus")
+
+
+def test_base_predicates():
+    from heat_tpu.core.base import is_classifier, is_estimator, is_regressor
+
+    assert is_classifier(ht.classification.KNeighborsClassifier())
+    assert is_regressor(ht.regression.Lasso())
+    assert is_estimator(ht.cluster.KMeans())

@@ -1,0 +1,145 @@
+"""Tests for NN data-parallel training and DASO (parity model: reference
+heat/nn/tests/test_data_parallel.py and heat/optim/tests/test_dp_optimizer.py —
+train tiny models and assert convergence/replica consistency)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import heat_tpu as ht
+
+
+def _toy_data(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    w = rng.normal(size=(8, 1)).astype(np.float32)
+    y = (x @ w + 0.1 * rng.normal(size=(n, 1))).astype(np.float32)
+    return x, y
+
+
+def _mlp():
+    import flax.linen as nn
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(16)(x)
+            x = nn.relu(x)
+            return nn.Dense(1)(x)
+
+    return MLP()
+
+
+def _mse(params, apply_fn, x, y):
+    pred = apply_fn(params, x)
+    return jnp.mean((pred - y) ** 2)
+
+
+def test_data_parallel_training():
+    x, y = _toy_data()
+    dp = ht.nn.DataParallel(_mlp(), optimizer=optax.adam(1e-2))
+    dp.init(0, x[:2])
+    dp.make_train_step(_mse)
+    losses = []
+    for _ in range(60):
+        losses.append(float(dp.train_step(x, y)))
+    assert losses[-1] < losses[0] * 0.2
+    out = dp(x)
+    assert out.shape == (64, 1)
+
+
+def test_data_parallel_requires_setup():
+    dp = ht.nn.DataParallel(_mlp())
+    with pytest.raises(RuntimeError):
+        dp.train_step(np.zeros((4, 8), np.float32))
+    with pytest.raises(ValueError):
+        dp.make_train_step(_mse)
+
+
+def test_nn_fallthrough():
+    import flax.linen as nn
+
+    assert ht.nn.Dense is nn.Dense
+    assert ht.nn.functional.relu is jax.nn.relu
+    with pytest.raises(AttributeError):
+        ht.nn.functional.definitely_not_a_function
+    with pytest.raises(AttributeError):
+        ht.nn.DefinitelyNotAModule
+
+
+def test_daso_training():
+    x, y = _toy_data(n=64, seed=1)
+    model = _mlp()
+    daso = ht.optim.DASO(
+        local_optimizer=optax.sgd(1e-2),
+        total_epochs=4,
+        warmup_epochs=1,
+        cooldown_epochs=1,
+        max_global_skips=4,
+    )
+    assert daso.nodes * daso.local_size == 8
+    params = model.init(jax.random.PRNGKey(0), x[:2])
+    daso.init(params)
+    daso.make_train_step(_mse, model.apply)
+    daso.last_batch = 4
+    losses = []
+    for epoch in range(4):
+        for b in range(4):
+            loss = daso.step(x, y)
+        losses.append(float(loss))
+        daso.epoch_loss_logic(losses[-1])
+    assert losses[-1] < losses[0]
+    merged = daso.merged_params
+    out = model.apply(merged, x)
+    assert out.shape == (64, 1)
+
+
+def test_daso_skip_logic():
+    daso = ht.optim.DASO(local_optimizer=optax.sgd(0.1), total_epochs=10, max_global_skips=8)
+    daso.stability.patience = 0  # force plateau on second call
+    daso.epoch_loss_logic(1.0)
+    daso.epoch_loss_logic(1.0)  # not improving -> plateau -> skip reduction
+    assert daso.global_skip in (4, 8)
+    # cycle reset when bottomed out
+    daso.global_skip = 1
+    daso.epoch_loss_logic(1.0)  # bottomed out -> reset to max
+    daso.epoch_loss_logic(1.0)  # decay again
+    assert daso.global_skip == 4
+
+
+def test_data_parallel_optimizer():
+    dpo = ht.optim.DataParallelOptimizer(optax.sgd(0.1))
+    params = {"w": jnp.ones((3,))}
+    dpo.init(params)
+    grads = {"w": jnp.ones((3,))}
+    new_params, _ = dpo.step(grads, params)
+    np.testing.assert_allclose(np.asarray(new_params["w"]), 0.9)
+    with pytest.raises(TypeError):
+        ht.optim.DataParallelOptimizer(optax.sgd(0.1), blocking="yes")
+
+
+def test_detect_metric_plateau():
+    dmp = ht.optim.DetectMetricPlateau(patience=1)
+    assert not dmp.test_if_improving(1.0)
+    assert not dmp.test_if_improving(0.5)
+    assert not dmp.test_if_improving(0.5)
+    assert dmp.test_if_improving(0.5)  # patience exceeded
+    state = dmp.get_state()
+    dmp2 = ht.optim.DetectMetricPlateau()
+    dmp2.set_state(state)
+    assert dmp2.best == dmp.best
+    with pytest.raises(ValueError):
+        ht.optim.DetectMetricPlateau(mode="bogus")
+    with pytest.raises(ValueError):
+        ht.optim.DetectMetricPlateau(threshold_mode="bogus")
+
+
+def test_optim_fallthrough():
+    assert ht.optim.sgd is optax.sgd
+    assert ht.optim.SGD is optax.sgd
+    assert ht.optim.Adam is optax.adam
+    with pytest.raises(AttributeError):
+        ht.optim.DefinitelyNotAnOptimizer

@@ -1,0 +1,235 @@
+"""Tests for arithmetic/relational/logical/rounding/exponential/trig/complex ops
+(parity model: reference heat/core/tests/test_{arithmetics,relational,logical,
+rounding,exponential,trigonometrics,complex_math}.py)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+SPLITS = [None, 0, 1]
+
+
+def _pair(split):
+    rng = np.random.default_rng(0)
+    a = rng.uniform(0.5, 2.0, (8, 4)).astype(np.float32)
+    b = rng.uniform(0.5, 2.0, (8, 4)).astype(np.float32)
+    return ht.array(a, split=split), ht.array(b, split=split), a, b
+
+
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize(
+    "ht_op,np_op",
+    [
+        (ht.add, np.add),
+        (ht.sub, np.subtract),
+        (ht.mul, np.multiply),
+        (ht.div, np.true_divide),
+        (ht.pow, np.power),
+        (ht.fmod, np.fmod),
+        (ht.mod, np.mod),
+        (ht.floordiv, np.floor_divide),
+        (ht.maximum, np.maximum),
+        (ht.minimum, np.minimum),
+        (ht.atan2, np.arctan2),
+        (ht.logaddexp, np.logaddexp),
+    ],
+)
+def test_binary_ops(split, ht_op, np_op):
+    ha, hb, a, b = _pair(split)
+    res = ht_op(ha, hb)
+    np.testing.assert_allclose(res.numpy(), np_op(a, b), rtol=1e-5)
+    assert res.split == split
+
+
+def test_binary_broadcast_and_scalars():
+    a = ht.array(np.arange(12.0).reshape(3, 4), split=0)
+    b = ht.array(np.arange(4.0))
+    np.testing.assert_allclose((a + b).numpy(), a.numpy() + b.numpy())
+    np.testing.assert_allclose((a + 2).numpy(), a.numpy() + 2)
+    np.testing.assert_allclose((2 + a).numpy(), a.numpy() + 2)
+    np.testing.assert_allclose((a - 1.5).numpy(), a.numpy() - 1.5)
+    assert (a + b).split == 0
+    res = ht.add(1, 2)
+    assert res.numpy().item() == 3
+
+
+def test_operator_dunders():
+    a = ht.array(np.array([4.0, 9.0]))
+    np.testing.assert_allclose((-a).numpy(), [-4.0, -9.0])
+    np.testing.assert_allclose((+a).numpy(), [4.0, 9.0])
+    np.testing.assert_allclose(abs(-a).numpy(), [4.0, 9.0])
+    np.testing.assert_allclose((a**0.5).numpy(), [2.0, 3.0])
+    np.testing.assert_allclose((a % 2).numpy(), [0.0, 1.0])
+
+
+def test_bitwise():
+    a = ht.array(np.array([0b1100, 0b1010]))
+    b = ht.array(np.array([0b1010, 0b0110]))
+    np.testing.assert_array_equal(ht.bitwise_and(a, b).numpy(), [0b1000, 0b0010])
+    np.testing.assert_array_equal(ht.bitwise_or(a, b).numpy(), [0b1110, 0b1110])
+    np.testing.assert_array_equal(ht.bitwise_xor(a, b).numpy(), [0b0110, 0b1100])
+    np.testing.assert_array_equal(ht.invert(ht.array(np.array([0], np.int32))).numpy(), [-1])
+    np.testing.assert_array_equal(ht.left_shift(a, 1).numpy(), [0b11000, 0b10100])
+    np.testing.assert_array_equal(ht.right_shift(a, 2).numpy(), [0b11, 0b10])
+    with pytest.raises(TypeError):
+        ht.bitwise_and(ht.ones(3), ht.ones(3))
+
+
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("axis", [None, 0, 1])
+def test_sum_prod(split, axis):
+    ha, _, a, _ = _pair(split)
+    np.testing.assert_allclose(ht.sum(ha, axis=axis).numpy(), a.sum(axis=axis), rtol=1e-5)
+    np.testing.assert_allclose(ht.prod(ha, axis=axis).numpy(), a.prod(axis=axis), rtol=1e-4)
+
+
+def test_reduction_split_semantics():
+    a = ht.ones((8, 4), split=0)
+    assert ht.sum(a, axis=0).split is None
+    assert ht.sum(a, axis=1).split == 0
+    assert ht.sum(a).split is None
+    assert ht.sum(a, axis=1, keepdim=True).shape == (8, 1)
+    b = ht.ones((8, 4), split=1)
+    assert ht.sum(b, axis=0).split == 0
+
+
+@pytest.mark.parametrize("axis", [0, 1])
+def test_cumops(axis):
+    ha, _, a, _ = _pair(0)
+    np.testing.assert_allclose(ht.cumsum(ha, axis).numpy(), np.cumsum(a, axis), rtol=1e-5)
+    np.testing.assert_allclose(ht.cumprod(ha, axis).numpy(), np.cumprod(a, axis), rtol=1e-4)
+
+
+def test_diff():
+    a = np.cumsum(np.ones((5, 4)), axis=0).astype(np.float32)
+    h = ht.array(a, split=0)
+    np.testing.assert_allclose(ht.diff(h, axis=0).numpy(), np.diff(a, axis=0))
+    np.testing.assert_allclose(ht.diff(h, n=2, axis=1).numpy(), np.diff(a, n=2, axis=1))
+    with pytest.raises(ValueError):
+        ht.diff(h, n=-1)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_relational(split):
+    ha, hb, a, b = _pair(split)
+    for ht_op, np_op in [
+        (ht.eq, np.equal),
+        (ht.ne, np.not_equal),
+        (ht.lt, np.less),
+        (ht.le, np.less_equal),
+        (ht.gt, np.greater),
+        (ht.ge, np.greater_equal),
+    ]:
+        np.testing.assert_array_equal(ht_op(ha, hb).numpy().astype(bool), np_op(a, b))
+    assert ht.equal(ha, ha)
+    assert not ht.equal(ha, hb)
+
+
+def test_logical():
+    a = ht.array(np.array([[True, False], [True, True]]))
+    assert not bool(ht.all(a))
+    assert bool(ht.any(a))
+    np.testing.assert_array_equal(ht.all(a, axis=0).numpy(), [True, False])
+    np.testing.assert_array_equal(ht.logical_not(a).numpy(), [[False, True], [False, False]])
+    b = ht.array(np.array([[False, True], [True, False]]))
+    np.testing.assert_array_equal(ht.logical_and(a, b).numpy(), [[False, False], [True, False]])
+    np.testing.assert_array_equal(ht.logical_or(a, b).numpy(), [[True, True], [True, True]])
+    np.testing.assert_array_equal(ht.logical_xor(a, b).numpy(), [[True, True], [False, True]])
+
+
+def test_isclose_allclose_isnan():
+    a = ht.array(np.array([1.0, np.nan, np.inf, -np.inf]))
+    np.testing.assert_array_equal(ht.isnan(a).numpy(), [False, True, False, False])
+    np.testing.assert_array_equal(ht.isinf(a).numpy(), [False, False, True, True])
+    np.testing.assert_array_equal(ht.isfinite(a).numpy(), [True, False, False, False])
+    np.testing.assert_array_equal(ht.isposinf(a).numpy(), [False, False, True, False])
+    np.testing.assert_array_equal(ht.isneginf(a).numpy(), [False, False, False, True])
+    x = ht.ones((3,))
+    assert ht.allclose(x, x + 1e-9)
+    assert not ht.allclose(x, x + 1.0)
+    assert ht.isclose(x, x + 1e-9).numpy().all()
+    np.testing.assert_array_equal(ht.signbit(ht.array(np.array([-1.0, 1.0]))).numpy(), [True, False])
+
+
+@pytest.mark.parametrize(
+    "ht_op,np_op,domain",
+    [
+        (ht.exp, np.exp, (0.1, 2)),
+        (ht.expm1, np.expm1, (0.1, 2)),
+        (ht.exp2, np.exp2, (0.1, 2)),
+        (ht.log, np.log, (0.1, 2)),
+        (ht.log2, np.log2, (0.1, 2)),
+        (ht.log10, np.log10, (0.1, 2)),
+        (ht.log1p, np.log1p, (0.1, 2)),
+        (ht.sqrt, np.sqrt, (0.1, 2)),
+        (ht.square, np.square, (0.1, 2)),
+        (ht.sin, np.sin, (-1, 1)),
+        (ht.cos, np.cos, (-1, 1)),
+        (ht.tan, np.tan, (-1, 1)),
+        (ht.sinh, np.sinh, (-1, 1)),
+        (ht.cosh, np.cosh, (-1, 1)),
+        (ht.tanh, np.tanh, (-1, 1)),
+        (ht.arcsin, np.arcsin, (-0.9, 0.9)),
+        (ht.arccos, np.arccos, (-0.9, 0.9)),
+        (ht.arctan, np.arctan, (-1, 1)),
+        (ht.arcsinh, np.arcsinh, (-1, 1)),
+        (ht.arccosh, np.arccosh, (1.1, 3)),
+        (ht.arctanh, np.arctanh, (-0.9, 0.9)),
+        (ht.floor, np.floor, (-2, 2)),
+        (ht.ceil, np.ceil, (-2, 2)),
+        (ht.trunc, np.trunc, (-2, 2)),
+        (ht.fabs, np.fabs, (-2, 2)),
+        (ht.abs, np.abs, (-2, 2)),
+        (ht.sign, np.sign, (-2, 2)),
+        (ht.deg2rad, np.deg2rad, (0, 180)),
+        (ht.rad2deg, np.rad2deg, (0, 3)),
+    ],
+)
+def test_elementwise(ht_op, np_op, domain):
+    rng = np.random.default_rng(1)
+    a = rng.uniform(*domain, (6, 3)).astype(np.float32)
+    h = ht.array(a, split=0)
+    np.testing.assert_allclose(ht_op(h).numpy(), np_op(a), rtol=1e-5, atol=1e-6)
+    assert ht_op(h).split == 0
+
+
+def test_rounding_extra():
+    a = ht.array(np.array([-1.7, 1.2, 3.5]))
+    np.testing.assert_allclose(ht.round(a).numpy(), np.round([-1.7, 1.2, 3.5]))
+    np.testing.assert_allclose(ht.clip(a, -1, 2).numpy(), np.clip([-1.7, 1.2, 3.5], -1, 2))
+    frac, integ = ht.modf(a)
+    nf, ni = np.modf(np.array([-1.7, 1.2, 3.5], np.float32))
+    np.testing.assert_allclose(frac.numpy(), nf, rtol=1e-6)
+    np.testing.assert_allclose(integ.numpy(), ni)
+    with pytest.raises(ValueError):
+        ht.clip(a, None, None)
+
+
+def test_complex_math():
+    a = ht.array(np.array([1 + 1j, -2 + 2j], np.complex64))
+    np.testing.assert_allclose(ht.angle(a).numpy(), np.angle(a.numpy()), rtol=1e-6)
+    np.testing.assert_allclose(
+        ht.angle(a, deg=True).numpy(), np.angle(a.numpy(), deg=True), rtol=1e-5
+    )
+    np.testing.assert_allclose(ht.conj(a).numpy(), np.conj(a.numpy()))
+    np.testing.assert_allclose(ht.real(a).numpy(), a.numpy().real)
+    np.testing.assert_allclose(ht.imag(a).numpy(), a.numpy().imag)
+    r = ht.ones((2,))
+    assert ht.real(r) is r
+    np.testing.assert_array_equal(ht.imag(r).numpy(), [0.0, 0.0])
+
+
+def test_out_kwarg():
+    a = ht.ones((4,))
+    out = ht.zeros((4,))
+    ht.add(a, a, out=out)
+    np.testing.assert_array_equal(out.numpy(), [2.0] * 4)
+    ht.exp(ht.zeros((4,)), out=out)
+    np.testing.assert_array_equal(out.numpy(), [1.0] * 4)
+
+
+def test_where_kwarg():
+    a = ht.array(np.array([1.0, 2.0, 3.0]))
+    res = ht.add(a, a, where=ht.array(np.array([True, False, True])))
+    np.testing.assert_array_equal(res.numpy(), [2.0, 0.0, 6.0])

@@ -1,0 +1,91 @@
+"""Tests for the counter-based RNG (parity model: reference
+heat/core/tests/test_random.py)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+def test_seed_reproducibility():
+    ht.random.seed(1234)
+    a = ht.random.rand(16, 4, split=0)
+    ht.random.seed(1234)
+    b = ht.random.rand(16, 4, split=0)
+    np.testing.assert_array_equal(a.numpy(), b.numpy())
+    c = ht.random.rand(16, 4)
+    assert not np.array_equal(a.numpy(), c.numpy())
+
+
+def test_state_roundtrip():
+    ht.random.seed(7)
+    _ = ht.random.rand(8)
+    state = ht.random.get_state()
+    assert state[0] == "Threefry"
+    x = ht.random.rand(8)
+    ht.random.set_state(state)
+    y = ht.random.rand(8)
+    np.testing.assert_array_equal(x.numpy(), y.numpy())
+    with pytest.raises(TypeError):
+        ht.random.set_state("bogus")
+    with pytest.raises(ValueError):
+        ht.random.set_state(("NotThreefry", 0, 0))
+
+
+def test_rand_range_dtype():
+    ht.random.seed(0)
+    a = ht.random.rand(100)
+    assert a.dtype is ht.float32
+    assert float(a.min().larray) >= 0.0
+    assert float(a.max().larray) < 1.0
+    b = ht.random.rand(5, 5, dtype=ht.float64)
+    assert b.shape == (5, 5)
+
+
+def test_randn_normal_standard_normal():
+    ht.random.seed(0)
+    a = ht.random.randn(2000)
+    assert abs(float(ht.mean(a).larray)) < 0.1
+    assert abs(float(ht.std(a).larray) - 1.0) < 0.1
+    n = ht.random.normal(5.0, 2.0, (2000,))
+    assert abs(float(ht.mean(n).larray) - 5.0) < 0.25
+    s = ht.random.standard_normal((4, 4), split=0)
+    assert s.shape == (4, 4) and s.split == 0
+    with pytest.raises(ValueError):
+        ht.random.normal(0.0, -1.0, (3,))
+
+
+def test_randint():
+    ht.random.seed(0)
+    a = ht.random.randint(0, 10, size=(200,))
+    arr = a.numpy()
+    assert arr.min() >= 0 and arr.max() < 10
+    assert a.dtype is ht.int32
+    b = ht.random.randint(5, size=(50,))
+    assert b.numpy().max() < 5
+    with pytest.raises(ValueError):
+        ht.random.randint(5, 5)
+
+
+def test_randperm_permutation():
+    ht.random.seed(0)
+    p = ht.random.randperm(32)
+    assert sorted(p.numpy().tolist()) == list(range(32))
+    x = ht.arange(10)
+    px = ht.random.permutation(x)
+    assert sorted(px.numpy().tolist()) == list(range(10))
+    pr = ht.random.permutation(8)
+    assert sorted(pr.numpy().tolist()) == list(range(8))
+    with pytest.raises(TypeError):
+        ht.random.permutation("x")
+    with pytest.raises(TypeError):
+        ht.random.randperm(1.5)
+
+
+def test_aliases():
+    assert ht.random.random_sample is ht.random.random
+    assert ht.random.ranf is ht.random.random
+    assert ht.random.sample is ht.random.random
+    assert ht.random.random_integer is ht.random.randint
+    r = ht.random.random((3, 3))
+    assert r.shape == (3, 3)

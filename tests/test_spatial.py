@@ -1,0 +1,60 @@
+"""Tests for pairwise distances incl. the ppermute ring (parity model: reference
+heat/spatial/tests/test_distance.py)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+def _cdist_np(a, b):
+    return np.sqrt(((a[:, None, :] - b[None, :, :]) ** 2).sum(-1))
+
+
+@pytest.mark.parametrize("split", [None, 0])
+@pytest.mark.parametrize("quad", [False, True])
+def test_cdist(split, quad):
+    rng = np.random.default_rng(10)
+    a = rng.normal(size=(16, 4)).astype(np.float32)  # divisible by 8 -> ring path
+    h = ht.array(a, split=split)
+    d = ht.spatial.cdist(h, quadratic_expansion=quad)
+    np.testing.assert_allclose(d.numpy(), _cdist_np(a, a), atol=5e-3)
+    assert d.shape == (16, 16)
+    assert d.split == split
+
+
+def test_cdist_two_operands():
+    rng = np.random.default_rng(11)
+    a = rng.normal(size=(16, 3)).astype(np.float32)
+    b = rng.normal(size=(8, 3)).astype(np.float32)
+    d = ht.spatial.cdist(ht.array(a, split=0), ht.array(b, split=0))
+    np.testing.assert_allclose(d.numpy(), _cdist_np(a, b), atol=5e-3)
+    # ragged (non divisible) shapes take the broadcast fallback
+    c = rng.normal(size=(10, 3)).astype(np.float32)
+    d2 = ht.spatial.cdist(ht.array(c, split=0), ht.array(b, split=0))
+    np.testing.assert_allclose(d2.numpy(), _cdist_np(c, b), atol=5e-3)
+
+
+@pytest.mark.parametrize("quad", [False, True])
+def test_rbf(quad):
+    rng = np.random.default_rng(12)
+    a = rng.normal(size=(16, 4)).astype(np.float32)
+    sigma = 2.0
+    k = ht.spatial.rbf(ht.array(a, split=0), sigma=sigma, quadratic_expansion=quad)
+    expected = np.exp(-_cdist_np(a, a) ** 2 / (2 * sigma**2))
+    np.testing.assert_allclose(k.numpy(), expected, atol=5e-3)
+
+
+def test_manhattan():
+    rng = np.random.default_rng(13)
+    a = rng.normal(size=(16, 4)).astype(np.float32)
+    d = ht.spatial.manhattan(ht.array(a, split=0))
+    expected = np.abs(a[:, None, :] - a[None, :, :]).sum(-1)
+    np.testing.assert_allclose(d.numpy(), expected, atol=1e-4)
+
+
+def test_cdist_input_validation():
+    with pytest.raises(NotImplementedError):
+        ht.spatial.cdist(ht.ones((2, 2, 2)))
+    with pytest.raises(TypeError):
+        ht.spatial.cdist(np.ones((4, 4)))

@@ -1,0 +1,134 @@
+"""Tests for statistics (parity model: reference heat/core/tests/test_statistics.py)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+SPLITS = [None, 0, 1]
+
+
+def _arr(split):
+    rng = np.random.default_rng(2)
+    a = rng.normal(size=(8, 6)).astype(np.float32)
+    return ht.array(a, split=split), a
+
+
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("axis", [None, 0, 1])
+def test_mean_var_std(split, axis):
+    h, a = _arr(split)
+    np.testing.assert_allclose(ht.mean(h, axis=axis).numpy(), a.mean(axis=axis), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(ht.var(h, axis=axis).numpy(), a.var(axis=axis), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(ht.std(h, axis=axis).numpy(), a.std(axis=axis), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(ht.var(h, axis=axis, ddof=1).numpy(), a.var(axis=axis, ddof=1), rtol=1e-4, atol=1e-6)
+    with pytest.raises(ValueError):
+        ht.var(h, ddof=-1)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("axis", [None, 0, 1])
+def test_min_max_arg(split, axis):
+    h, a = _arr(split)
+    np.testing.assert_allclose(ht.max(h, axis=axis).numpy(), a.max(axis=axis))
+    np.testing.assert_allclose(ht.min(h, axis=axis).numpy(), a.min(axis=axis))
+    np.testing.assert_array_equal(ht.argmax(h, axis=axis).numpy(), a.argmax(axis=axis))
+    np.testing.assert_array_equal(ht.argmin(h, axis=axis).numpy(), a.argmin(axis=axis))
+
+
+def test_average():
+    h, a = _arr(0)
+    np.testing.assert_allclose(ht.average(h).numpy(), np.average(a), rtol=1e-5)
+    w = np.arange(1.0, 7.0, dtype=np.float32)
+    res, wsum = ht.average(h, axis=1, weights=ht.array(w), returned=True)
+    expected, wexp = np.average(a, axis=1, weights=w, returned=True)
+    np.testing.assert_allclose(res.numpy(), expected, rtol=1e-5)
+    np.testing.assert_allclose(wsum.numpy(), wexp, rtol=1e-5)
+
+
+def test_median_percentile():
+    h, a = _arr(0)
+    np.testing.assert_allclose(ht.median(h).numpy(), np.median(a), rtol=1e-5)
+    np.testing.assert_allclose(ht.median(h, axis=0).numpy(), np.median(a, axis=0), rtol=1e-5)
+    np.testing.assert_allclose(
+        ht.percentile(h, 30, axis=0).numpy(), np.percentile(a, 30, axis=0), rtol=1e-4
+    )
+    for interp in ("lower", "higher", "nearest", "midpoint"):
+        np.testing.assert_allclose(
+            ht.percentile(h, 42, interpolation=interp).numpy(),
+            np.percentile(a, 42, method=interp),
+            rtol=1e-5,
+        )
+    with pytest.raises(ValueError):
+        ht.percentile(h, 50, interpolation="bogus")
+
+
+def test_bincount_digitize_bucketize():
+    x = ht.array(np.array([0, 1, 1, 3, 2, 1]))
+    np.testing.assert_array_equal(ht.bincount(x).numpy(), np.bincount([0, 1, 1, 3, 2, 1]))
+    np.testing.assert_array_equal(
+        ht.bincount(x, minlength=6).numpy(), np.bincount([0, 1, 1, 3, 2, 1], minlength=6)
+    )
+    v = ht.array(np.array([0.2, 6.4, 3.0]))
+    bins = ht.array(np.array([0.0, 1.0, 2.5, 4.0, 10.0]))
+    np.testing.assert_array_equal(
+        ht.digitize(v, bins).numpy(), np.digitize([0.2, 6.4, 3.0], [0.0, 1.0, 2.5, 4.0, 10.0])
+    )
+    b = ht.statistics.bucketize(v, bins) if hasattr(ht, "statistics") else None
+    from heat_tpu.core.statistics import bucketize
+
+    res = bucketize(v, bins)
+    assert res.shape == (3,)
+
+
+def test_cov():
+    h, a = _arr(None)
+    np.testing.assert_allclose(ht.cov(h).numpy(), np.cov(a), rtol=1e-4)
+    np.testing.assert_allclose(ht.cov(h, bias=True).numpy(), np.cov(a, bias=True), rtol=1e-4)
+
+
+def test_histc_histogram():
+    h, a = _arr(0)
+    hist, edges = ht.histogram(h, bins=5)
+    nh, ne = np.histogram(a, bins=5)
+    np.testing.assert_array_equal(hist.numpy(), nh)
+    np.testing.assert_allclose(edges.numpy(), ne, rtol=1e-5)
+    hc = ht.histc(h, bins=5, min=-1, max=1)
+    assert hc.shape == (5,)
+
+
+def test_skew_kurtosis():
+    from scipy import stats  # available via sklearn dependency
+
+    h, a = _arr(0)
+    flat = a.reshape(-1)
+    np.testing.assert_allclose(
+        ht.skew(ht.array(flat), unbiased=False).numpy(), stats.skew(flat), rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        ht.kurtosis(ht.array(flat), unbiased=False).numpy(), stats.kurtosis(flat), rtol=1e-4
+    )
+
+
+def test_maximum_minimum_broadcast():
+    a = ht.array(np.array([[1.0, 5.0], [3.0, 2.0]]), split=0)
+    b = ht.array(np.array([2.0, 3.0]))
+    np.testing.assert_array_equal(ht.maximum(a, b).numpy(), [[2.0, 5.0], [3.0, 3.0]])
+    np.testing.assert_array_equal(ht.minimum(a, b).numpy(), [[1.0, 3.0], [2.0, 2.0]])
+
+
+def test_bucketize_torch_semantics():
+    from heat_tpu.core.statistics import bucketize
+
+    v = ht.array(np.array([3.0, 6.0, 9.0]))
+    bins = ht.array(np.array([1.0, 3.0, 5.0, 7.0, 9.0]))
+    np.testing.assert_array_equal(bucketize(v, bins).numpy(), [1, 3, 4])
+    np.testing.assert_array_equal(bucketize(v, bins, right=True).numpy(), [2, 3, 5])
+
+
+def test_average_split_remap():
+    r = ht.average(ht.ones((4, 6), split=1), axis=0)
+    assert r.split == 0  # axis below split removed -> split shifts left
+    r.resplit_(r.split)  # must not raise
+    r2 = ht.average(ht.ones((4, 6), split=0), axis=0)
+    assert r2.split is None

@@ -1,0 +1,108 @@
+"""Tests for the dtype system (parity model: reference heat/core/tests/test_types.py)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.core import types
+
+
+def test_canonical_heat_type():
+    assert types.canonical_heat_type(ht.float32) is ht.float32
+    assert types.canonical_heat_type("float32") is ht.float32
+    assert types.canonical_heat_type(np.float32) is ht.float32
+    assert types.canonical_heat_type(np.dtype("int8")) is ht.int8
+    assert types.canonical_heat_type(int) is ht.int64
+    assert types.canonical_heat_type(float) is ht.float32
+    assert types.canonical_heat_type(bool) is ht.bool
+    assert types.canonical_heat_type("bfloat16") is ht.bfloat16
+    with pytest.raises(TypeError):
+        types.canonical_heat_type("nope")
+
+
+def test_aliases():
+    assert ht.byte is ht.int8
+    assert ht.short is ht.int16
+    assert ht.int is ht.int32
+    assert ht.long is ht.int64
+    assert ht.ubyte is ht.uint8
+    assert ht.float is ht.float32
+    assert ht.double is ht.float64
+    assert ht.cfloat is ht.complex64
+
+
+def test_instantiation_casts():
+    x = ht.float32([1, 2, 3])
+    assert x.dtype is ht.float32
+    assert x.numpy().dtype == np.float32
+    y = ht.int32(x)
+    assert y.dtype is ht.int32
+    z = ht.int8()
+    assert z.numpy().item() == 0
+
+
+def test_heat_type_of():
+    assert types.heat_type_of(1) is ht.int64
+    assert types.heat_type_of(1.0) is ht.float32
+    assert types.heat_type_of(True) is ht.bool
+    assert types.heat_type_of([1.0, 2.0]) is ht.float64 or types.heat_type_of([1.0, 2.0]) is ht.float32
+    assert types.heat_type_of(np.zeros(3, np.int16)) is ht.int16
+    assert types.heat_type_of(ht.ones((2,))) is ht.float32
+
+
+def test_promote_types():
+    assert types.promote_types(ht.uint8, ht.int8) is ht.int16
+    assert types.promote_types(ht.int32, ht.float32) is ht.float32
+    assert types.promote_types(ht.int8, ht.uint8) is ht.int16
+    assert types.promote_types(ht.bool, ht.uint8) is ht.uint8
+    assert types.promote_types(ht.bfloat16, ht.float32) is ht.float32
+
+
+def test_result_type():
+    assert types.result_type(ht.ones(3, dtype=ht.int32), ht.ones(3, dtype=ht.float32)) is ht.float32
+    assert types.result_type(ht.ones(3, dtype=ht.int32), 1.5) is ht.float32
+
+
+def test_issubdtype():
+    assert types.issubdtype(ht.int32, ht.integer)
+    assert types.issubdtype(ht.float32, ht.floating)
+    assert types.issubdtype(ht.float32, ht.number)
+    assert not types.issubdtype(ht.float32, ht.integer)
+
+
+def test_can_cast():
+    assert types.can_cast(ht.int32, ht.int64)
+    assert types.can_cast(ht.int64, ht.float32, casting="intuitive")
+    assert not types.can_cast(ht.float32, ht.int32, casting="safe")
+    assert types.can_cast(ht.float32, ht.int32, casting="unsafe")
+    assert types.can_cast(ht.int32, ht.int32, casting="no")
+    assert not types.can_cast(ht.int32, ht.int64, casting="no")
+    with pytest.raises(ValueError):
+        types.can_cast(ht.int32, ht.int64, casting="bogus")
+
+
+def test_exact_inexact():
+    assert types.heat_type_is_exact(ht.int16)
+    assert not types.heat_type_is_exact(ht.float32)
+    assert types.heat_type_is_inexact(ht.bfloat16)
+    assert types.heat_type_is_inexact(ht.complex64)
+
+
+def test_finfo_iinfo():
+    fi = ht.finfo(ht.float32)
+    assert fi.bits == 32
+    assert fi.eps == np.finfo(np.float32).eps
+    ii = ht.iinfo(ht.int8)
+    assert ii.max == 127 and ii.min == -128
+    with pytest.raises(TypeError):
+        ht.finfo(ht.int32)
+    with pytest.raises(TypeError):
+        ht.iinfo(ht.float32)
+
+
+def test_iscomplex_isreal():
+    x = ht.array([1 + 1j, 2 + 0j], dtype=ht.complex64)
+    assert types.iscomplex(x).numpy().tolist() == [True, False]
+    assert types.isreal(x).numpy().tolist() == [False, True]
+    y = ht.ones((2,))
+    assert types.isreal(y).numpy().all()
