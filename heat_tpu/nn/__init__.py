@@ -9,6 +9,7 @@ flax modules, and ``ht.nn.functional`` maps to ``jax.nn``.
 """
 
 from .data_parallel import DataParallel, DataParallelMultiGPU
+from .attention import ring_attention, scaled_dot_product_attention, ulysses_attention
 from . import functional
 
 try:

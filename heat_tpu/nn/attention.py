@@ -1,0 +1,217 @@
+"""
+Long-context attention: ring (sequence-parallel) and Ulysses (all-to-all head-parallel)
+attention over the device mesh.
+
+The reference has no transformer code; its mechanism for scaling one huge axis is the
+ring-systolic sweep of ``heat/spatial/distance.py:209-494`` (stationary row slabs,
+rotating column slabs). Ring attention is the same communication pattern with an
+online-softmax accumulator instead of a distance tile write-back, so this module
+generalizes the machinery of :mod:`heat_tpu.spatial.distance` to attention:
+
+- :func:`ring_attention` — queries stay put, (K, V) blocks rotate around the ring via
+  ``lax.ppermute`` (one ICI hop per step), each step rescales the running
+  (max, denominator, numerator) triple exactly as flash attention does. Memory per
+  device is O(seq/p · seq/p) for the score tile, so sequence length scales linearly
+  with the ring size.
+- :func:`ulysses_attention` — ``lax.all_to_all`` re-shards from sequence-split to
+  head-split, runs dense attention locally, and re-shards back (DeepSpeed-Ulysses
+  pattern); cheaper than the ring when heads ≥ devices and the full sequence fits.
+
+Both accept either raw ``jax.Array`` inputs of shape ``(batch, seq, heads, head_dim)``
+plus a :class:`~heat_tpu.core.communication.MeshCommunication`, or sequence-split
+(``split=1``) :class:`~heat_tpu.core.dndarray.DNDarray` operands.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..core.communication import MeshCommunication, sanitize_comm
+from ..core.dndarray import DNDarray
+from ..core import types
+
+__all__ = ["scaled_dot_product_attention", "ring_attention", "ulysses_attention"]
+
+
+def scaled_dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Dense reference attention on ``(batch, seq, heads, head_dim)`` operands."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        q_pos = jnp.arange(q.shape[1])
+        k_pos = jnp.arange(k.shape[1])
+        s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, -jnp.inf)
+    o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v)
+    return o.astype(q.dtype)
+
+
+def _ring_attention_sharded(axis: str, p: int, causal: bool, scale: float):
+    """Build the per-device ring body (runs under shard_map)."""
+    perm = [(i, (i - 1) % p) for i in range(p)]  # rotate K/V blocks towards lower ranks
+
+    def ring(q_blk: jax.Array, k_blk: jax.Array, v_blk: jax.Array) -> jax.Array:
+        # q_blk/k_blk/v_blk: (b, s/p, h, d) — this device's sequence block.
+        i0 = lax.axis_index(axis)
+        b, s_blk, h, d = q_blk.shape
+        q32 = q_blk.astype(jnp.float32)
+        q_pos = i0 * s_blk + jnp.arange(s_blk)
+        m0 = jnp.full((b, h, s_blk), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((b, h, s_blk), jnp.float32)
+        o0 = jnp.zeros((b, h, s_blk, d), jnp.float32)
+
+        def accumulate(k_cur, v_cur, m, l, o, t):
+            # block index currently held: step 0 is our own block, so causal rows
+            # see their diagonal first and the running max is finite from the start.
+            j = (i0 + t) % p
+            s = jnp.einsum("bqhd,bkhd->bhqk", q32, k_cur.astype(jnp.float32)) * scale
+            if causal:
+                k_pos = j * s_blk + jnp.arange(s_blk)
+                s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, -jnp.inf)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            alpha = jnp.exp(m - m_new)  # 0 at the first step (m = -inf, m_new finite)
+            prob = jnp.exp(s - m_new[..., None])
+            l = l * alpha + jnp.sum(prob, axis=-1)
+            o = o * alpha[..., None] + jnp.einsum(
+                "bhqk,bkhd->bhqd", prob, v_cur.astype(jnp.float32)
+            )
+            return m_new, l, o
+
+        def step(carry, t):
+            k_cur, v_cur, m, l, o = carry
+            m, l, o = accumulate(k_cur, v_cur, m, l, o, t)
+            k_next = lax.ppermute(k_cur, axis, perm)
+            v_next = lax.ppermute(v_cur, axis, perm)
+            return (k_next, v_next, m, l, o), None
+
+        # p-1 permuted rounds, then the last held block without the (discarded)
+        # final rotation — p-1 ICI hops total, not p.
+        (k_last, v_last, m, l, o), _ = lax.scan(
+            step, (k_blk, v_blk, m0, l0, o0), jnp.arange(p - 1)
+        )
+        _, l, o = accumulate(k_last, v_last, m, l, o, p - 1)
+        out = o / l[..., None]
+        return jnp.transpose(out, (0, 2, 1, 3)).astype(q_blk.dtype)
+
+    return ring
+
+
+def ring_attention(
+    q: Union[jax.Array, DNDarray],
+    k: Union[jax.Array, DNDarray],
+    v: Union[jax.Array, DNDarray],
+    comm: Optional[MeshCommunication] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> Union[jax.Array, DNDarray]:
+    """
+    Sequence-parallel attention: Q blocks stationary, (K, V) blocks rotate around the
+    ``ppermute`` ring with a flash-style online softmax (the comm pattern of the
+    reference's ring ``_dist``, distance.py:279-346, with attention accumulators).
+
+    Operands are ``(batch, seq, heads, head_dim)``; the sequence axis is sharded over
+    the mesh. Falls back to dense attention when not distributed or the sequence axis
+    doesn't shard evenly.
+    """
+    if isinstance(q, DNDarray):
+        return _dnd_attention(ring_attention, q, k, v, causal=causal, scale=scale)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    comm = sanitize_comm(comm)
+    if (
+        not isinstance(comm, MeshCommunication)
+        or not comm.is_distributed()
+        or q.shape[1] % comm.size != 0
+        or k.shape[1] != q.shape[1]
+    ):
+        return scaled_dot_product_attention(q, k, v, causal=causal, scale=scale)
+    axis = comm.axis_name
+    fn = jax.shard_map(
+        _ring_attention_sharded(axis, comm.size, causal, scale),
+        mesh=comm.mesh,
+        in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+        out_specs=P(None, axis),
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+def ulysses_attention(
+    q: Union[jax.Array, DNDarray],
+    k: Union[jax.Array, DNDarray],
+    v: Union[jax.Array, DNDarray],
+    comm: Optional[MeshCommunication] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> Union[jax.Array, DNDarray]:
+    """
+    All-to-all sequence parallelism (DeepSpeed-Ulysses): re-shard sequence-split
+    operands to head-split with one ``lax.all_to_all``, run dense attention on the
+    full sequence locally, and re-shard back. Requires ``heads % p == 0``; falls back
+    to dense attention (or the ring) otherwise.
+    """
+    if isinstance(q, DNDarray):
+        return _dnd_attention(ulysses_attention, q, k, v, causal=causal, scale=scale)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    comm = sanitize_comm(comm)
+    if (
+        not isinstance(comm, MeshCommunication)
+        or not comm.is_distributed()
+        or q.shape[1] % comm.size != 0
+        or q.shape[2] % comm.size != 0
+        or k.shape[1] % comm.size != 0
+        or v.shape[1] != k.shape[1]
+    ):
+        return scaled_dot_product_attention(q, k, v, causal=causal, scale=scale)
+    axis = comm.axis_name
+
+    def body(q_blk, k_blk, v_blk):
+        # (b, s/p, h, d) -> all_to_all -> (b, s, h/p, d): full sequence, head shard
+        def to_heads(x):
+            return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+        def to_seq(x):
+            return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+        o = scaled_dot_product_attention(
+            to_heads(q_blk), to_heads(k_blk), to_heads(v_blk), causal=causal, scale=scale
+        )
+        return to_seq(o)
+
+    fn = jax.shard_map(
+        body,
+        mesh=comm.mesh,
+        in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+        out_specs=P(None, axis),
+        check_vma=False,
+    )
+    return fn(q, k, v)
+
+
+def _dnd_attention(impl, q: DNDarray, k: DNDarray, v: DNDarray, **kw) -> DNDarray:
+    """DNDarray front-end: operands must share split (sequence axis 1 when split)."""
+    for t in (q, k, v):
+        if not isinstance(t, DNDarray):
+            raise TypeError("q, k, v must all be DNDarrays (or all jax arrays)")
+        if t.ndim != 4:
+            raise ValueError("attention operands must be (batch, seq, heads, head_dim)")
+        if t.split not in (None, 1):
+            raise ValueError("attention operands must be split on the sequence axis (1)")
+        if t.comm is not q.comm:
+            raise ValueError("q, k, v must share one communicator/mesh")
+    out = impl(q.larray, k.larray, v.larray, comm=q.comm, **kw)
+    return DNDarray(
+        out, q.shape, types.canonical_heat_type(out.dtype), q.split, q.device, q.comm, True
+    )

@@ -158,7 +158,10 @@ def _ring_dist(comm: MeshCommunication, x: jax.Array, y: jax.Array, metric: Call
             y_next = jax.lax.ppermute(y_cur, axis, perm)
             return y_next, (tile, (i0 + k) % p)
 
-        _, (tiles, cols) = jax.lax.scan(step, y_block, jnp.arange(p))
+        # p-1 rotated rounds + the final held block without the discarded rotation
+        y_last, (tiles, cols) = jax.lax.scan(step, y_block, jnp.arange(p - 1))
+        tiles = jnp.concatenate([tiles, metric(x_block, y_last)[None]], axis=0)
+        cols = jnp.concatenate([cols, ((i0 + p - 1) % p)[None]], axis=0)
         # tiles: (p, m/p, n/p) in ring order; scatter to column order
         order = jnp.argsort(cols)
         tiles = jnp.take(tiles, order, axis=0)  # (p, m/p, n/p) by column block
