@@ -1,0 +1,45 @@
+"""
+Lasso benchmark (parity: reference benchmarks/lasso/ — coordinate-descent fit on a
+split design matrix, timing per trial).
+
+Run: python benchmarks/lasso_bench.py [--n 65536] [--f 64] [--trials 5]
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+import heat_tpu as ht
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=65_536)
+    p.add_argument("--f", type=int, default=64)
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--lam", type=float, default=0.1)
+    p.add_argument("--trials", type=int, default=5)
+    args = p.parse_args()
+
+    rng = np.random.default_rng(0)
+    x_np = rng.normal(size=(args.n, args.f)).astype(np.float32)
+    true_w = np.zeros(args.f, np.float32)
+    true_w[: args.f // 4] = rng.normal(size=args.f // 4)  # sparse ground truth
+    y_np = (x_np @ true_w + 0.01 * rng.normal(size=args.n)).astype(np.float32)
+    x = ht.array(x_np, split=0)
+    y = ht.array(y_np[:, None], split=0)
+
+    times = []
+    for trial in range(args.trials):
+        est = ht.regression.Lasso(lam=args.lam, max_iter=args.iters, tol=-1.0)
+        t0 = time.perf_counter()
+        est.fit(x, y)
+        times.append(time.perf_counter() - t0)
+        ht.print0(f"trial {trial}: {times[-1]:.3f}s")
+    ht.print0(json.dumps({"benchmark": "lasso", "median_fit_s": sorted(times)[len(times) // 2]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,123 @@
+"""
+Fused pallas Lloyd iteration for :class:`~heat_tpu.cluster.kmeans.KMeans`.
+
+The XLA formulation (kmeans.py:_kmeans_step) is two MXU GEMMs with an argmin in
+between, which costs two full passes over the dataset in HBM traffic. This kernel
+fuses the whole iteration — distance tile, argmin, one-hot accumulation of per-cluster
+sums/counts and inertia — into ONE pass: each grid step streams a row tile of ``x``
+through VMEM once and accumulates the (k, f) partials in place. For the bench shape
+(2²⁰×32, k=8) that halves HBM bytes per iteration, which is the bound resource
+(SURVEY §6 north star #1).
+
+Only the single-device hot loop lives here; the distributed reduction over a
+row-sharded dataset stays in XLA-land (psum of the returned partials).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_TILE_ROWS = 4096
+
+
+def _fused_kernel(x_ref, c_ref, labels_ref, sums_ref, counts_ref, inertia_ref, *, k: int):
+    from ..spatial.distance import _quadratic_expand
+
+    t = pl.program_id(0)
+    x = x_ref[:]  # (T, f)
+    c = c_ref[:]  # (k, f)
+    d2 = jnp.maximum(_quadratic_expand(x, c), 0.0)  # (T, k)
+    # keep every intermediate 2-D: Mosaic's layout engine rejects 1-D relayouts
+    labels = jnp.argmin(d2, axis=1, keepdims=True).astype(jnp.int32)  # (T, 1)
+    labels_ref[:] = labels
+    onehot = (
+        labels == jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], k), 1)
+    ).astype(jnp.float32)
+    psums = jnp.dot(onehot.T, x, preferred_element_type=jnp.float32)  # (k, f)
+    pcounts = jnp.sum(onehot, axis=0, keepdims=True)  # (1, k)
+    pinertia = jnp.sum(jnp.min(d2, axis=1, keepdims=True))
+
+    @pl.when(t == 0)
+    def _():
+        sums_ref[:] = psums
+        counts_ref[:] = pcounts
+        inertia_ref[0, 0] = pinertia
+
+    @pl.when(t > 0)
+    def _():
+        sums_ref[:] = sums_ref[:] + psums
+        counts_ref[:] = counts_ref[:] + pcounts
+        inertia_ref[0, 0] = inertia_ref[0, 0] + pinertia
+
+
+@functools.partial(jax.jit, static_argnames=("tile_rows", "interpret"))
+def kmeans_step_fused(
+    x: jax.Array, centers: jax.Array, tile_rows: int = _TILE_ROWS, interpret: bool = False
+):
+    """
+    One fused Lloyd iteration. Same contract as ``kmeans._kmeans_step``:
+    returns ``(new_centers, labels, shift, inertia)``.
+
+    Requires ``x.shape[0] % tile_rows == 0`` (callers pick a divisor or fall back
+    to the XLA path).
+    """
+    n, f = x.shape
+    k = centers.shape[0]
+    if n % tile_rows != 0:
+        raise ValueError(f"n={n} must be divisible by tile_rows={tile_rows}")
+    grid = (n // tile_rows,)
+    labels2d, sums, counts, inertia = pl.pallas_call(
+        functools.partial(_fused_kernel, k=k),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_rows, f), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, f), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile_rows, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, f), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, 1), jnp.int32),
+            jax.ShapeDtypeStruct((k, f), jnp.float32),
+            jax.ShapeDtypeStruct((1, k), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * n * k * f,
+            bytes_accessed=n * f * 4 + n * 4 + 2 * k * f * 4,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(x.astype(jnp.float32), centers.astype(jnp.float32))
+    counts = counts[0]
+    new_centers = jnp.where(
+        counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), centers
+    ).astype(centers.dtype)
+    shift = jnp.sum((new_centers - centers) ** 2)
+    return new_centers, labels2d[:, 0], shift, inertia[0, 0]
+
+
+_VMEM_BUDGET_BYTES = 8 * 1024 * 1024  # half of ~16MB VMEM, leaving room for pipelining
+
+
+def fused_step_available(
+    n: int, f: int = 32, k: int = 8, tile_rows: int = _TILE_ROWS
+) -> bool:
+    """The fused kernel targets real TPUs, row counts the grid tiles evenly, and
+    shapes whose per-step working set (x tile + d2 + onehot + centers/sums) fits
+    comfortably in VMEM."""
+    working_set = tile_rows * (f + 2 * k + 2) * 4 + 2 * k * f * 4
+    return (
+        jax.default_backend() == "tpu"
+        and n % tile_rows == 0
+        and n >= tile_rows
+        and working_set <= _VMEM_BUDGET_BYTES
+    )

@@ -100,9 +100,21 @@ class KMeans(_KCluster):
         self._initialize_cluster_centers(x)
         centers = self._cluster_centers.larray
         data = x.larray
+        # fused single-pass pallas step on a single real TPU; sharded/CPU data keeps
+        # the two-GEMM XLA step (whose psum the sharding inserts)
+        from ._pallas import fused_step_available, kmeans_step_fused
+
+        if (
+            fused_step_available(data.shape[0], data.shape[1], self.n_clusters)
+            and data.dtype == jnp.float32
+            and len(data.devices()) == 1
+        ):
+            step = kmeans_step_fused
+        else:
+            step = _kmeans_step
         n_iter = 0
         for n_iter in range(1, self.max_iter + 1):
-            centers, labels, shift, inertia = _kmeans_step(data, centers)
+            centers, labels, shift, inertia = step(data, centers)
             if float(shift) <= self.tol:
                 break
         self._cluster_centers = ht.array(centers, device=x.device, comm=x.comm)

@@ -45,10 +45,11 @@ def _euclidian_fast(x: jax.Array, y: jax.Array) -> jax.Array:
 
 def _quadratic_expand(x: jax.Array, y: jax.Array) -> jax.Array:
     """|x|^2 - 2 x.y + |y|^2 (reference distance.py:46-65): one MXU GEMM + rank-1
-    updates — the TPU-optimal formulation."""
+    updates — the TPU-optimal formulation. All intermediates stay 2-D and the GEMM
+    pins f32 accumulation, so this is also the canonical in-kernel (pallas) form."""
     x_norm = jnp.sum(x * x, axis=1, keepdims=True)
     y_norm = jnp.sum(y * y, axis=1, keepdims=True)
-    return x_norm - 2.0 * (x @ y.T) + y_norm.T
+    return x_norm - 2.0 * jnp.dot(x, y.T, preferred_element_type=jnp.float32) + y_norm.T
 
 
 def _gaussian(x: jax.Array, y: jax.Array, sigma: float = 1.0) -> jax.Array:

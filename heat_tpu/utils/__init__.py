@@ -2,3 +2,6 @@
 
 from . import data
 from . import vision_transforms
+from . import checkpoint
+from . import profiling
+from .checkpoint import CheckpointManager, load_checkpoint, save_checkpoint

@@ -1,0 +1,214 @@
+"""
+Checkpoint / resume.
+
+The reference has no framework-level checkpointing (SURVEY §5): it ships building
+blocks only — parallel ``ht.save``/``load`` (heat/core/io.py:1060), RNG state
+get/set (heat/core/random.py:203,782) and ``DetectMetricPlateau`` state
+(heat/optim/utils.py:72-108), leaving NN checkpointing to raw ``torch.save``. This
+module composes those blocks into a real subsystem — a capability superset:
+
+- :func:`save_checkpoint` / :func:`load_checkpoint` — persist an arbitrary pytree of
+  :class:`~heat_tpu.core.dndarray.DNDarray` / ``jax.Array`` / numpy leaves to one
+  HDF5 file. DNDarray leaves round-trip their ``(gshape, dtype, split)`` contract:
+  on load they come back sharded the same way over the current mesh. The global RNG
+  state rides along so a resumed run continues the counter-based stream exactly.
+- :class:`CheckpointManager` — step-numbered checkpoints with ``max_to_keep``
+  retention, ``latest_step()`` discovery, and atomic write-then-rename.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import tempfile
+from typing import Any, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as ht_random
+from ..core import types
+from ..core.communication import sanitize_comm
+from ..core.devices import sanitize_device
+from ..core.dndarray import DNDarray
+from ..core.factories import array as ht_array
+
+__all__ = ["save_checkpoint", "load_checkpoint", "CheckpointManager"]
+
+_KIND_DND = "dndarray"
+_KIND_ARR = "array"
+_KIND_JSON = "json"
+
+
+def _flatten(state: Any):
+    """Flatten a pytree to (path, leaf) pairs with '/'-joined string paths."""
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(
+        state, is_leaf=lambda x: isinstance(x, DNDarray)
+    )[0]
+    out = []
+    for keypath, leaf in leaves_with_paths:
+        parts = []
+        for k in keypath:
+            if hasattr(k, "key"):
+                parts.append(str(k.key))
+            elif hasattr(k, "idx"):
+                parts.append(str(k.idx))
+            else:
+                parts.append(str(k))
+        out.append(("/".join(parts) if parts else "__root__", leaf))
+    return out
+
+
+def save_checkpoint(path: str, state: Any, include_rng: bool = True) -> None:
+    """
+    Save a pytree ``state`` to ``path`` (one HDF5 file, written atomically).
+
+    Leaves may be DNDarrays (split metadata preserved), jax/numpy arrays, or JSON
+    scalars/strings. Raises on unsupported leaf types.
+    """
+    import h5py
+
+    entries = {}
+    tmp_fd, tmp_path = tempfile.mkstemp(
+        dir=os.path.dirname(os.path.abspath(path)) or ".", suffix=".ckpt.tmp"
+    )
+    os.close(tmp_fd)
+    try:
+        with h5py.File(tmp_path, "w") as f:
+            for name, leaf in _flatten(state):
+                if name in entries:
+                    raise ValueError(
+                        f"checkpoint leaf name collision at {name!r} "
+                        "(a dict key containing '/' shadows a nested path)"
+                    )
+                if isinstance(leaf, DNDarray):
+                    f.create_dataset(name, data=leaf.numpy())
+                    entries[name] = {
+                        "kind": _KIND_DND,
+                        "split": leaf.split,
+                        "dtype": leaf.dtype.char(),
+                    }
+                elif isinstance(leaf, (jax.Array, np.ndarray)):
+                    f.create_dataset(name, data=np.asarray(leaf))
+                    entries[name] = {"kind": _KIND_ARR}
+                elif isinstance(leaf, (bool, int, float, str)) or leaf is None:
+                    entries[name] = {"kind": _KIND_JSON, "value": leaf}
+                else:
+                    raise TypeError(
+                        f"unsupported checkpoint leaf at {name!r}: {type(leaf)}"
+                    )
+            meta = {
+                "entries": entries,
+                "rng_state": list(ht_random.get_state()) if include_rng else None,
+            }
+            f.attrs["heat_tpu_checkpoint"] = json.dumps(meta)
+        os.replace(tmp_path, path)
+    except BaseException:
+        if os.path.exists(tmp_path):
+            os.unlink(tmp_path)
+        raise
+
+
+def load_checkpoint(
+    path: str,
+    target: Any,
+    restore_rng: bool = True,
+    device=None,
+    comm=None,
+) -> Any:
+    """
+    Restore a checkpoint written by :func:`save_checkpoint` into the structure of
+    ``target`` (a pytree with the same treedef; its leaf values supply placement:
+    DNDarray leaves are restored as DNDarrays with the saved split over the current
+    mesh, array leaves as ``jax.Array``).
+    """
+    import h5py
+
+    device = sanitize_device(device)
+    comm = sanitize_comm(comm)
+    with h5py.File(path, "r") as f:
+        meta = json.loads(f.attrs["heat_tpu_checkpoint"])
+        entries = meta["entries"]
+        flat_target = _flatten(target)
+        restored = []
+        for name, leaf in flat_target:
+            if name not in entries:
+                raise KeyError(f"checkpoint {path!r} has no entry {name!r}")
+            ent = entries[name]
+            if ent["kind"] == _KIND_JSON:
+                restored.append(ent["value"])
+            elif ent["kind"] == _KIND_DND:
+                data = np.asarray(f[name])
+                restored.append(
+                    ht_array(
+                        data,
+                        dtype=types.canonical_heat_type(ent["dtype"]),
+                        split=ent["split"],
+                        device=device,
+                        comm=comm,
+                    )
+                )
+            else:
+                data = jnp.asarray(np.asarray(f[name]))
+                if isinstance(leaf, (jax.Array, np.ndarray)) and hasattr(leaf, "sharding") and hasattr(leaf.sharding, "mesh"):
+                    data = jax.device_put(data, leaf.sharding)
+                restored.append(data)
+        if restore_rng and meta.get("rng_state") is not None:
+            ht_random.set_state(tuple(meta["rng_state"]))
+    treedef = jax.tree_util.tree_structure(
+        target, is_leaf=lambda x: isinstance(x, DNDarray)
+    )
+    return jax.tree_util.tree_unflatten(treedef, restored)
+
+
+class CheckpointManager:
+    """
+    Step-numbered checkpoint directory with retention.
+
+    >>> mgr = CheckpointManager("/tmp/ckpts", max_to_keep=3)
+    >>> mgr.save(100, {"params": params, "step": 100})
+    >>> state = mgr.restore(target)          # latest
+    >>> state = mgr.restore(target, step=100)
+    """
+
+    _FMT = "ckpt_{step:012d}.h5"
+    _RE = re.compile(r"^ckpt_(\d{12,})\.h5$")
+
+    def __init__(self, directory: str, max_to_keep: Optional[int] = None):
+        self.directory = directory
+        self.max_to_keep = max_to_keep
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, step: int) -> str:
+        return os.path.join(self.directory, self._FMT.format(step=step))
+
+    def all_steps(self):
+        steps = []
+        for name in os.listdir(self.directory):
+            m = self._RE.match(name)
+            if m:
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def save(self, step: int, state: Any, include_rng: bool = True) -> str:
+        path = self._path(step)
+        save_checkpoint(path, state, include_rng=include_rng)
+        if self.max_to_keep is not None:
+            steps = self.all_steps()
+            for old in steps[: max(0, len(steps) - self.max_to_keep)]:
+                os.unlink(self._path(old))
+        return path
+
+    def restore(self, target: Any, step: Optional[int] = None, **kw) -> Any:
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoints in {self.directory!r}")
+        return load_checkpoint(self._path(step), target, **kw)

@@ -1,0 +1,61 @@
+"""
+Tracing / profiling helpers.
+
+The reference has no profiling support at all (SURVEY §5: bare ``time.perf_counter``
+loops in its benchmarks). On TPU the platform profiler comes for free; this module
+wraps it in a stable framework surface:
+
+- :func:`trace` — context manager writing a Perfetto/TensorBoard-loadable trace of
+  everything (XLA ops, collectives, host callbacks) under the block.
+- :class:`Timer` — device-synchronizing wall-clock timer for benchmark loops; its
+  ``block_on`` ensures async dispatch doesn't lie about step time.
+- :func:`annotate` — named region in the trace timeline (``jax.profiler.TraceAnnotation``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Optional
+
+import jax
+
+__all__ = ["trace", "annotate", "Timer"]
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, create_perfetto_link: bool = False):
+    """Capture a device+host profile of the enclosed block into ``log_dir``."""
+    jax.profiler.start_trace(log_dir, create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region on the profiler timeline (usable as context manager)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class Timer:
+    """Wall-clock timer that forces pending device work to finish at each mark.
+
+    >>> t = Timer()
+    >>> out = step(x)
+    >>> dt = t.lap(out)       # seconds since last lap, after out is ready
+    """
+
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def lap(self, block_on: Optional[Any] = None) -> float:
+        if block_on is not None:
+            jax.block_until_ready(block_on)
+        now = time.perf_counter()
+        dt = now - self._t0
+        self._t0 = now
+        return dt

@@ -1,0 +1,67 @@
+"""Checkpoint/resume subsystem (capability superset: SURVEY §5 — the reference has
+building blocks only, no framework-level checkpointing)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from heat_tpu.utils import CheckpointManager, load_checkpoint, save_checkpoint
+
+
+def test_roundtrip_pytree(tmp_path):
+    x = ht.array(np.arange(24, dtype=np.float32).reshape(8, 3), split=0)
+    state = {
+        "params": {"w": jnp.ones((4, 2)), "b": np.zeros(2, np.float32)},
+        "data": x,
+        "step": 7,
+        "name": "run1",
+        "lr": 0.125,
+    }
+    p = str(tmp_path / "ck.h5")
+    save_checkpoint(p, state)
+    out = load_checkpoint(p, state)
+    assert out["step"] == 7 and out["name"] == "run1" and out["lr"] == 0.125
+    np.testing.assert_array_equal(np.asarray(out["params"]["w"]), np.ones((4, 2)))
+    assert isinstance(out["data"], ht.DNDarray)
+    assert out["data"].split == 0 and out["data"].shape == (8, 3)
+    np.testing.assert_array_equal(out["data"].numpy(), x.numpy())
+
+
+def test_rng_state_resumes(tmp_path):
+    ht.random.seed(1234)
+    _ = ht.random.rand(10)  # advance the counter
+    p = str(tmp_path / "ck.h5")
+    save_checkpoint(p, {"step": 1})
+    expected = ht.random.rand(10).numpy()  # next draw after the checkpoint
+    ht.random.seed(999)  # clobber the stream
+    load_checkpoint(p, {"step": 1})
+    got = ht.random.rand(10).numpy()
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_manager_retention_and_latest(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpts"), max_to_keep=2)
+    for step in (10, 20, 30):
+        mgr.save(step, {"step": step, "w": jnp.full((2,), float(step))})
+    assert mgr.all_steps() == [20, 30]
+    assert mgr.latest_step() == 30
+    out = mgr.restore({"step": 0, "w": jnp.zeros((2,))})
+    assert out["step"] == 30
+    np.testing.assert_array_equal(np.asarray(out["w"]), [30.0, 30.0])
+    out20 = mgr.restore({"step": 0, "w": jnp.zeros((2,))}, step=20)
+    assert out20["step"] == 20
+
+
+def test_missing_entry_raises(tmp_path):
+    p = str(tmp_path / "ck.h5")
+    save_checkpoint(p, {"a": 1})
+    with pytest.raises(KeyError):
+        load_checkpoint(p, {"b": 2})
+
+
+def test_restore_empty_dir_raises(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "none"))
+    with pytest.raises(FileNotFoundError):
+        mgr.restore({"x": 0})

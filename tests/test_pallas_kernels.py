@@ -1,0 +1,37 @@
+"""Fused pallas Lloyd-iteration kernel vs the XLA two-GEMM step (interpret mode on
+the CPU mesh; the compiled path runs on real TPU via bench.py / KMeans.fit)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from heat_tpu.cluster._pallas import fused_step_available, kmeans_step_fused
+from heat_tpu.cluster.kmeans import _kmeans_step
+
+
+def test_fused_step_matches_xla():
+    n, f, k = 8192, 16, 5
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(n, f)).astype(np.float32))
+    c0 = x[:k]
+    want_c, want_l, want_s, want_i = _kmeans_step(x, c0)
+    got_c, got_l, got_s, got_i = kmeans_step_fused(x, c0, tile_rows=1024, interpret=True)
+    np.testing.assert_allclose(np.asarray(got_c), np.asarray(want_c), rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(got_l), np.asarray(want_l))
+    np.testing.assert_allclose(float(got_s), float(want_s), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(float(got_i), float(want_i), rtol=1e-4)
+
+
+def test_fused_step_rejects_ragged():
+    x = jnp.zeros((1000, 8), jnp.float32)
+    with pytest.raises(ValueError):
+        kmeans_step_fused(x, x[:3], tile_rows=512, interpret=True)
+
+
+def test_fused_availability_gate():
+    # on the CPU test mesh the compiled kernel must report unavailable
+    if jax.default_backend() != "tpu":
+        assert not fused_step_available(1 << 20)
+    assert not fused_step_available(1000)  # ragged row count never eligible
