@@ -49,7 +49,8 @@ def _quadratic_expand(x: jax.Array, y: jax.Array) -> jax.Array:
     pins f32 accumulation, so this is also the canonical in-kernel (pallas) form."""
     x_norm = jnp.sum(x * x, axis=1, keepdims=True)
     y_norm = jnp.sum(y * y, axis=1, keepdims=True)
-    return x_norm - 2.0 * jnp.dot(x, y.T, preferred_element_type=jnp.float32) + y_norm.T
+    acc = jnp.promote_types(x.dtype, jnp.float32)  # ≥f32 accumulation, f64 stays f64
+    return x_norm - 2.0 * jnp.dot(x, y.T, preferred_element_type=acc) + y_norm.T
 
 
 def _gaussian(x: jax.Array, y: jax.Array, sigma: float = 1.0) -> jax.Array:

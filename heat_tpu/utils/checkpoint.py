@@ -152,10 +152,17 @@ def load_checkpoint(
                     )
                 )
             else:
-                data = jnp.asarray(np.asarray(f[name]))
-                if isinstance(leaf, (jax.Array, np.ndarray)) and hasattr(leaf, "sharding") and hasattr(leaf.sharding, "mesh"):
-                    data = jax.device_put(data, leaf.sharding)
-                restored.append(data)
+                raw = np.asarray(f[name])
+                if isinstance(leaf, np.ndarray):
+                    # exact round-trip for host arrays, including 64-bit dtypes
+                    restored.append(raw)
+                else:
+                    data = jnp.asarray(raw)
+                    if hasattr(leaf, "dtype") and data.dtype != leaf.dtype:
+                        data = data.astype(leaf.dtype)
+                    if isinstance(leaf, jax.Array) and hasattr(leaf.sharding, "mesh"):
+                        data = jax.device_put(data, leaf.sharding)
+                    restored.append(data)
         if restore_rng and meta.get("rng_state") is not None:
             ht_random.set_state(tuple(meta["rng_state"]))
     treedef = jax.tree_util.tree_structure(
@@ -201,8 +208,11 @@ class CheckpointManager:
         path = self._path(step)
         save_checkpoint(path, state, include_rng=include_rng)
         if self.max_to_keep is not None:
-            steps = self.all_steps()
-            for old in steps[: max(0, len(steps) - self.max_to_keep)]:
+            # retention keeps the newest max_to_keep steps but never evicts the
+            # checkpoint just written (out-of-order saves after a rollback must land)
+            candidates = [s for s in self.all_steps() if s != step]
+            excess = len(candidates) + 1 - self.max_to_keep
+            for old in candidates[: max(0, excess)]:
                 os.unlink(self._path(old))
         return path
 
