@@ -143,7 +143,6 @@ def test_empty_slice_and_size_one(split):
 def test_is_split_adoption():
     """Factories with is_split adopt pre-distributed chunks (reference
     factories.py:150-433: gshape inferred by allreduce)."""
-    comm = ht.get_comm()
     full = np.arange(64, dtype=np.float32).reshape(16, 4)
     a = ht.array(full, is_split=0)
     assert a.shape[1] == 4
