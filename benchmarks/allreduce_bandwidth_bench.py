@@ -1,0 +1,88 @@
+"""
+Allreduce bandwidth microbenchmark — the second BASELINE.json north-star metric
+("DNDarray Allreduce ICI bandwidth (GB/s)").
+
+Measures a ``lax.psum`` over the full device mesh via ``shard_map`` (the collective
+the framework's ``__reduce_op`` path emits when a reduction crosses the split axis)
+at several buffer sizes and reports algorithm bandwidth
+
+    bw = 2 * (p - 1) / p * bytes / time        (ring-allreduce convention)
+
+On a TPU slice this is ICI bandwidth; on the virtual CPU mesh it validates the
+same code path. With one device the psum is a no-op, so the benchmark reports the
+HBM-roundtrip bandwidth of the buffer instead (noted in the output).
+
+Run: python benchmarks/allreduce_bandwidth_bench.py [--sizes-mb 1 8 64 256] [--trials 5]
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+
+def bench_size(mesh, n_bytes, trials):
+    p = mesh.devices.size
+    n = n_bytes // 4
+    local = n // p
+    x = jax.device_put(
+        jnp.ones((p, local), jnp.float32),
+        NamedSharding(mesh, P("d", None)),
+    )
+
+    @jax.jit
+    def allreduce(x):
+        return shard_map(
+            lambda v: jax.lax.psum(v, "d"),
+            mesh=mesh,
+            in_specs=P("d", None),
+            out_specs=P("d", None),
+        )(x)
+
+    out = allreduce(x)
+    jax.block_until_ready(out)  # compile + warmup
+    best = float("inf")
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        out = allreduce(x)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    eff_bytes = 2 * (p - 1) / p * (local * p * 4) if p > 1 else local * 4 * 2
+    return eff_bytes / best / 1e9
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--sizes-mb", type=int, nargs="+", default=[1, 8, 64, 256])
+    parser.add_argument("--trials", type=int, default=5)
+    parser.add_argument("--n", type=int, default=None, help="unused (config grid compat)")
+    parser.add_argument("--f", type=int, default=None, help="unused (config grid compat)")
+    args = parser.parse_args()
+
+    devs = jax.devices()
+    mesh = Mesh(np.asarray(devs), ("d",))
+    results = {}
+    for mb in args.sizes_mb:
+        results[f"{mb}MB"] = round(bench_size(mesh, mb * 1024 * 1024, args.trials), 3)
+
+    print(
+        json.dumps(
+            {
+                "metric": "allreduce_bandwidth_gbps",
+                "value": max(results.values()),
+                "unit": f"GB/s (algorithm bw, {len(devs)} device(s), best size)",
+                "per_size": results,
+                "devices": [str(d) for d in devs],
+                "note": "single-device = HBM roundtrip, multi-device = ICI allreduce",
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""
+Generate launch scripts for the benchmark matrix (reference
+benchmarks/generate_jobscripts.py, which emits SLURM job files with
+``srun``/``mpirun`` over node×task grids).
+
+TPU-native form: two script flavours per benchmark config —
+
+- **single-host** (one controller, all local chips — including a virtual CPU mesh
+  for device-count scaling studies without hardware):
+  ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` runs.
+- **multi-host pod slice**: a ``gcloud compute tpus tpu-vm ssh --worker=all``
+  wrapper that starts the same script on every host; `jax.distributed.initialize`
+  inside the framework picks up the pod topology (coordinator from worker 0).
+
+Usage: python benchmarks/generate_jobscripts.py [--config benchmarks/config.json]
+       [--out benchmarks/jobs] [--tpu-name my-pod] [--zone us-central2-b]
+"""
+
+import argparse
+import json
+import os
+import stat
+
+SINGLE_HOST_TEMPLATE = """#!/bin/bash -x
+# {name}: single-host run over {devices} device(s)
+# (virtual CPU mesh when no TPU is attached — same code path, XLA collectives)
+cd {workdir}
+export JAX_PLATFORMS=${{JAX_PLATFORMS:-}}
+export XLA_FLAGS="--xla_force_host_platform_device_count={devices} $XLA_FLAGS"
+python -u {script} {parameters}
+"""
+
+MULTI_HOST_TEMPLATE = """#!/bin/bash -x
+# {name}: multi-host TPU pod-slice run ({tpu_name}, all workers)
+# every host runs the same SPMD program; jax.distributed.initialize() inside
+# heat_tpu wires the pod topology (coordinator = worker 0).
+gcloud compute tpus tpu-vm ssh {tpu_name} --zone={zone} --worker=all --command \\
+  "cd {workdir} && python -u {script} {parameters}"
+"""
+
+
+def emit(path, content):
+    with open(path, "w") as f:
+        f.write(content)
+    os.chmod(path, os.stat(path).st_mode | stat.S_IEXEC)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default=os.path.join(os.path.dirname(__file__), "config.json"))
+    p.add_argument("--out", default=os.path.join(os.path.dirname(__file__), "jobs"))
+    p.add_argument("--tpu-name", default="heat-tpu-pod")
+    p.add_argument("--zone", default="us-central2-b")
+    p.add_argument("--workdir", default=None)
+    args = p.parse_args()
+
+    with open(args.config) as f:
+        config = json.load(f)
+    workdir = args.workdir or os.path.abspath(config.get("workdir", "."))
+    os.makedirs(args.out, exist_ok=True)
+
+    count = 0
+    for name, bench in config["benchmarks"].items():
+        script = bench["script"]
+        trials = bench.get("trials", 5)
+        # static per-benchmark flags passed verbatim (lists become space-joined)
+        static = ""
+        for key, val in bench.get("flags", {}).items():
+            val = " ".join(str(v) for v in val) if isinstance(val, list) else val
+            static += f" --{key} {val}"
+        for mode in ("strong", "weak"):
+            grid = bench.get(mode)
+            if not grid:
+                continue
+            for devices in grid.get("devices", [1]):
+                if mode == "strong":
+                    n = grid.get("n")
+                else:
+                    n = grid.get("n_per_device", 0) * devices
+                params = f"--trials {trials}" + static
+                if n:
+                    params += f" --n {n}"
+                if grid.get("f"):
+                    params += f" --f {grid['f']}"
+                fname = f"{name}_{mode}_{devices}dev.sh"
+                emit(
+                    os.path.join(args.out, fname),
+                    SINGLE_HOST_TEMPLATE.format(
+                        name=name, devices=devices, workdir=workdir,
+                        script=script, parameters=params,
+                    ),
+                )
+                count += 1
+        # one pod-slice script per benchmark
+        fname = f"{name}_podslice.sh"
+        emit(
+            os.path.join(args.out, fname),
+            MULTI_HOST_TEMPLATE.format(
+                name=name, tpu_name=args.tpu_name, zone=args.zone,
+                workdir=workdir, script=script,
+                parameters=f"--trials {trials}" + static,
+            ),
+        )
+        count += 1
+    print(f"wrote {count} job scripts to {args.out}")
+
+
+if __name__ == "__main__":
+    main()

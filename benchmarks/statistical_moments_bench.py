@@ -16,7 +16,7 @@ import jax
 import heat_tpu as ht
 
 
-def timeit(fn, trials=5):
+def timeit(fn, trials):
     fn()  # warmup/compile
     times = []
     for _ in range(trials):
@@ -30,13 +30,14 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--n", type=int, default=4_194_304)
     p.add_argument("--f", type=int, default=64)
+    p.add_argument("--trials", type=int, default=5)
     args = p.parse_args()
 
     x = ht.random.randn(args.n, args.f, split=0)
     results = {}
     for axis in (None, 0, 1):
-        results[f"mean_axis_{axis}"] = timeit(lambda: ht.mean(x, axis=axis))
-        results[f"std_axis_{axis}"] = timeit(lambda: ht.std(x, axis=axis))
+        results[f"mean_axis_{axis}"] = timeit(lambda: ht.mean(x, axis=axis), args.trials)
+        results[f"std_axis_{axis}"] = timeit(lambda: ht.std(x, axis=axis), args.trials)
     ht.print0(json.dumps({"benchmark": "statistical_moments", "median_s": results}))
 
 

@@ -1,0 +1,56 @@
+"""
+k-nearest-neighbours demo (reference examples/classification/demo_knn.py): load the
+bundled iris dataset, run leave-one-fold-out cross-validation with
+``KNeighborsClassifier``, and print per-fold accuracy.
+
+The reference loads ``heat/datasets/iris.h5`` and hand-builds folds with
+Python lists; here the same flow runs through ``ht.datasets`` + ``ht.load_hdf5``
+and stays in DNDarray land throughout.
+"""
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.classification.kneighborsclassifier import KNeighborsClassifier
+
+
+def calculate_accuracy(pred_y, true_y):
+    """Fraction of correctly labelled samples (reference demo_knn.py:28-57)."""
+    if pred_y.gshape != true_y.gshape:
+        raise ValueError(f"expecting same lengths, got {pred_y.gshape}, {true_y.gshape}")
+    return float(ht.sum(ht.where(pred_y == true_y, 1, 0)).item()) / pred_y.gshape[0]
+
+
+def main(folds=5, n_neighbors=5):
+    x = ht.load_hdf5(ht.datasets.path("iris.h5"), dataset="data", split=0)
+    # iris.h5 rows are ordered by class: 50 of each
+    labels = ht.array(np.repeat(np.arange(3, dtype=np.int32), 50), split=0)
+
+    n = x.shape[0]
+    rng = np.random.default_rng(0)
+    perm = rng.permutation(n)
+    fold_size = n // folds
+
+    x_np, y_np = x.numpy(), labels.numpy()
+    accuracies = []
+    for k in range(folds):
+        test_idx = np.sort(perm[k * fold_size : (k + 1) * fold_size])
+        train_idx = np.sort(np.setdiff1d(perm, test_idx))
+
+        x_train = ht.array(x_np[train_idx], split=0)
+        y_train = ht.array(y_np[train_idx], split=0)
+        x_test = ht.array(x_np[test_idx], split=0)
+        y_test = ht.array(y_np[test_idx], split=0)
+
+        knn = KNeighborsClassifier(n_neighbors=n_neighbors)
+        knn.fit(x_train, y_train)
+        pred = knn.predict(x_test)
+        acc = calculate_accuracy(pred.astype(ht.int32), y_test)
+        accuracies.append(acc)
+        print(f"fold {k}: accuracy {acc:.3f}")
+
+    print(f"mean accuracy over {folds} folds: {np.mean(accuracies):.3f}")
+
+
+if __name__ == "__main__":
+    main()

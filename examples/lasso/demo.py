@@ -1,0 +1,56 @@
+"""
+Lasso-path demo (reference examples/lasso/demo.py): load the bundled diabetes
+dataset from HDF5, sweep the regularisation strength, and record the coordinate-
+descent Lasso coefficients at each lambda. Saves a lasso-path plot when matplotlib
+is available, otherwise prints the path as text.
+"""
+
+import numpy as np
+
+import heat_tpu as ht
+from heat_tpu.regression.lasso import Lasso
+
+
+def main():
+    path = ht.datasets.path("diabetes.h5")
+    x = ht.load_hdf5(path, dataset="x", split=0)
+    y = ht.load_hdf5(path, dataset="y", split=0)
+
+    # normalise features (reference demo.py:27)
+    x = x / ht.sqrt(ht.mean(x**2, axis=0))
+
+    estimator = Lasso(max_iter=100)
+    lamda = np.logspace(0, 4, 10) / 10
+
+    theta_list = []
+    for la in lamda:
+        estimator.lam = float(la)
+        estimator.fit(x, y)
+        theta_list.append(estimator.theta.numpy().flatten())
+
+    theta_lasso = np.stack(theta_list).T[1:, :]  # drop intercept row
+
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        from matplotlib import pyplot as plt
+
+        plt.figure(figsize=(8, 5))
+        for i, coef in enumerate(theta_lasso):
+            plt.semilogx(lamda, coef, label=f"feature {i}")
+        plt.xlabel("lambda")
+        plt.ylabel("coefficient")
+        plt.title("Lasso paths — heat_tpu implementation")
+        plt.legend(fontsize=7)
+        out = "lasso_paths.png"
+        plt.savefig(out, dpi=120)
+        print(f"saved {out}")
+    except Exception:
+        print("lambda:", " ".join(f"{v:8.3f}" for v in lamda))
+        for i, coef in enumerate(theta_lasso):
+            print(f"feat {i}:", " ".join(f"{v:8.3f}" for v in coef))
+
+
+if __name__ == "__main__":
+    main()

@@ -9,6 +9,7 @@ from .core.linalg import *
 from .core import __version__
 
 from . import core
+from . import datasets
 from . import classification
 from . import cluster
 from . import graph

@@ -1,0 +1,133 @@
+"""
+Bundled small datasets (the reference ships ``heat/datasets/``: iris.csv/h5/nc,
+iris_X_train/test + label CSVs, diabetes.h5 — used as fixtures by its io, cluster,
+and naive-bayes tests and demos).
+
+TPU-native build: instead of checking binary blobs into the repository, the same
+datasets are materialised on first use into this package's ``_data`` directory from
+``sklearn.datasets`` (the canonical public source of both Fisher's iris and the
+scikit-learn diabetes set). File formats mirror the reference bundle:
+
+- ``iris.csv``     — 150×4 feature matrix, ``;``-separated (reference iris.csv)
+- ``iris.h5``      — HDF5 with dataset ``"data"`` (reference iris.h5)
+- ``iris.nc``      — NetCDF with variable ``"data"`` (only if netCDF4 is present)
+- ``iris_X_train.csv`` / ``iris_X_test.csv`` / ``iris_labels.csv`` /
+  ``iris_y_pred_proba.csv`` — the kNN demo fixtures (reference examples use a
+  105/45 split; labels one-hot encoded like heat's demo_knn)
+- ``diabetes.h5``  — HDF5 with datasets ``"x"`` (442×10) and ``"y"`` (442,) used by
+  the Lasso demo (reference examples/lasso/demo.py:23-24 reads diabetes.h5["x"/"y"])
+
+Public API: ``path(name)`` returns the on-disk path (materialising if needed);
+``load_iris(split=...)`` / ``load_diabetes(split=...)`` return DNDarrays directly.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["path", "load_iris", "load_diabetes"]
+
+_DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_data")
+
+
+def _iris_arrays():
+    from sklearn.datasets import load_iris as _sk_iris
+
+    b = _sk_iris()
+    return b.data.astype(np.float32), b.target.astype(np.int32)
+
+
+def _diabetes_arrays():
+    from sklearn.datasets import load_diabetes as _sk_diabetes
+
+    b = _sk_diabetes()
+    return b.data.astype(np.float32), b.target.astype(np.float32)
+
+
+def _train_test_split(x, y, train=105, seed=42):
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(len(x))
+    tr, te = perm[:train], perm[train:]
+    return x[tr], x[te], y[tr], y[te]
+
+
+def _materialise(name: str, dest: str) -> None:
+    os.makedirs(_DATA_DIR, exist_ok=True)
+    if name == "iris.csv":
+        x, _ = _iris_arrays()
+        np.savetxt(dest, x, delimiter=";", fmt="%.1f")
+    elif name == "iris.h5":
+        import h5py
+
+        x, _ = _iris_arrays()
+        with h5py.File(dest, "w") as f:
+            f.create_dataset("data", data=x)
+    elif name == "iris.nc":
+        import netCDF4
+
+        x, _ = _iris_arrays()
+        with netCDF4.Dataset(dest, "w") as f:
+            f.createDimension("rows", x.shape[0])
+            f.createDimension("cols", x.shape[1])
+            var = f.createVariable("data", "f4", ("rows", "cols"))
+            var[:] = x
+    elif name in (
+        "iris_X_train.csv",
+        "iris_X_test.csv",
+        "iris_labels.csv",
+        "iris_y_pred_proba.csv",
+    ):
+        x, y = _iris_arrays()
+        x_tr, x_te, y_tr, y_te = _train_test_split(x, y)
+        onehot = np.eye(3, dtype=np.float32)[y_tr]
+        proba = np.eye(3, dtype=np.float32)[y_te]
+        arrays = {
+            "iris_X_train.csv": x_tr,
+            "iris_X_test.csv": x_te,
+            "iris_labels.csv": onehot,
+            "iris_y_pred_proba.csv": proba,
+        }
+        for fname, arr in arrays.items():
+            np.savetxt(os.path.join(_DATA_DIR, fname), arr, delimiter=";", fmt="%.1f")
+    elif name == "diabetes.h5":
+        import h5py
+
+        x, y = _diabetes_arrays()
+        with h5py.File(dest, "w") as f:
+            f.create_dataset("x", data=x)
+            f.create_dataset("y", data=y)
+    else:
+        raise ValueError(f"unknown bundled dataset: {name!r}")
+
+
+def path(name: str) -> str:
+    """Absolute path of a bundled dataset file, materialising it on first use."""
+    dest = os.path.join(_DATA_DIR, name)
+    if not os.path.exists(dest):
+        _materialise(name, dest)
+    return dest
+
+
+def load_iris(split: Optional[int] = None, return_labels: bool = False):
+    """The 150×4 iris feature matrix as a DNDarray (optionally with int labels)."""
+    from ..core import factories
+
+    x, y = _iris_arrays()
+    data = factories.array(x, split=split)
+    if return_labels:
+        return data, factories.array(y, split=split)
+    return data
+
+
+def load_diabetes(split: Optional[int] = None, return_target: bool = False):
+    """The 442×10 diabetes feature matrix as a DNDarray (optionally with target)."""
+    from ..core import factories
+
+    x, y = _diabetes_arrays()
+    data = factories.array(x, split=split)
+    if return_target:
+        return data, factories.array(y, split=split)
+    return data

@@ -4,6 +4,7 @@ from .datatools import *
 from .matrixgallery import parter
 from .mnist import MNISTDataset
 from .partial_dataset import PartialH5Dataset, PartialH5DataLoaderIter
+from . import _utils
 from . import datatools
 from . import matrixgallery
 from . import mnist

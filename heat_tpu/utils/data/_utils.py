@@ -1,0 +1,147 @@
+"""
+Offline dataset-preparation tooling (reference heat/utils/data/_utils.py:
+``dali_tfrecord2idx`` DALI index prep + ``merge_files_imagenet_tfrecord`` — merge
+sharded ImageNet TFRecords into two big HDF5 files for ``PartialH5Dataset``).
+
+TPU-native form: the consumer is the same (``PartialH5Dataset`` windowed HDF5
+reads feeding the mesh), but the ingest side is generalised — merge any collection
+of record shards (``.npz``/``.npy`` files, or TFRecords when tensorflow is
+importable) into one chunked HDF5 file laid out for sequential window reads.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+try:
+    import h5py
+
+    _HAS_HDF5 = True
+except ImportError:  # pragma: no cover
+    _HAS_HDF5 = False
+
+__all__ = ["merge_npz_to_h5", "merge_imagenet_tfrecord_to_h5"]
+
+
+def _require_h5():
+    if not _HAS_HDF5:
+        raise RuntimeError("h5py is required for HDF5 dataset merging")
+
+
+def merge_npz_to_h5(
+    files: Sequence[str],
+    output_file: str,
+    keys: Optional[Dict[str, str]] = None,
+    chunk_rows: int = 1024,
+) -> str:
+    """
+    Merge sharded ``.npz``/``.npy`` record files into one chunked HDF5 file.
+
+    Parameters
+    ----------
+    files : sequence of str
+        Shard paths, concatenated in order along axis 0.
+    output_file : str
+        Destination ``.h5`` path.
+    keys : dict, optional
+        Mapping of npz key → output dataset name. Default: every key in the first
+        shard maps to itself (plain ``.npy`` shards map to dataset ``"data"``).
+    chunk_rows : int
+        HDF5 chunk length along axis 0 — sized for PartialH5Dataset windows.
+    """
+    _require_h5()
+    if not files:
+        raise ValueError("no input files")
+
+    def _load(path):
+        arr = np.load(path, allow_pickle=False)
+        if isinstance(arr, np.ndarray):
+            return {"data": arr}
+        return {k: arr[k] for k in arr.files}
+
+    first = _load(files[0])
+    if keys is None:
+        keys = {k: k for k in first}
+
+    with h5py.File(output_file, "w") as out:
+        dsets = {}
+        for src_key, dst_name in keys.items():
+            a = first[src_key]
+            dsets[src_key] = out.create_dataset(
+                dst_name,
+                shape=a.shape,
+                maxshape=(None,) + a.shape[1:],
+                dtype=a.dtype,
+                chunks=(min(chunk_rows, a.shape[0]),) + a.shape[1:],
+            )
+            dsets[src_key][:] = a
+        for path in files[1:]:
+            shard = _load(path)
+            for src_key, d in dsets.items():
+                a = shard[src_key]
+                old = d.shape[0]
+                d.resize(old + a.shape[0], axis=0)
+                d[old:] = a
+    return output_file
+
+
+def merge_imagenet_tfrecord_to_h5(
+    folder_name: str,
+    output_folder: Optional[str] = None,
+    datasets: Sequence[str] = ("train", "validation"),
+) -> List[str]:
+    """
+    Merge ImageNet-style TFRecord shards into per-split HDF5 files with
+    ``"images"`` (encoded bytes, vlen) and ``"metadata"`` (label) datasets —
+    the reference's ``merge_files_imagenet_tfrecord`` (heat/utils/data/_utils.py:47)
+    retargeted at PartialH5Dataset. Requires tensorflow for TFRecord parsing.
+    """
+    _require_h5()
+    try:
+        import tensorflow as tf  # noqa: F401
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError(
+            "merge_imagenet_tfrecord_to_h5 requires tensorflow to parse TFRecords; "
+            "convert shards to .npz and use merge_npz_to_h5 instead"
+        ) from e
+
+    output_folder = output_folder or folder_name
+    written = []
+    for split in datasets:
+        shards = sorted(
+            os.path.join(folder_name, f)
+            for f in os.listdir(folder_name)
+            if f.startswith(split)
+        )
+        if not shards:
+            continue
+        out_path = os.path.join(output_folder, f"imagenet_merged_{split}.h5")
+        feature_desc = {
+            "image/encoded": tf.io.FixedLenFeature([], tf.string),
+            "image/class/label": tf.io.FixedLenFeature([], tf.int64),
+        }
+        with h5py.File(out_path, "w") as out:
+            img_ds = out.create_dataset(
+                "images", shape=(0,), maxshape=(None,),
+                dtype=h5py.vlen_dtype(np.uint8), chunks=(1024,),
+            )
+            label_ds = out.create_dataset(
+                "metadata", shape=(0,), maxshape=(None,), dtype=np.int64, chunks=(4096,),
+            )
+            for shard in shards:
+                imgs, labels = [], []
+                for rec in tf.data.TFRecordDataset(shard):
+                    ex = tf.io.parse_single_example(rec, feature_desc)
+                    imgs.append(np.frombuffer(ex["image/encoded"].numpy(), np.uint8))
+                    labels.append(int(ex["image/class/label"].numpy()))
+                old = img_ds.shape[0]
+                img_ds.resize(old + len(imgs), axis=0)
+                label_ds.resize(old + len(labels), axis=0)
+                for i, b in enumerate(imgs):
+                    img_ds[old + i] = b
+                label_ds[old:] = labels
+        written.append(out_path)
+    return written

@@ -1,0 +1,39 @@
+#!/usr/bin/env python
+"""
+Interactive distributed session (reference scripts/interactive.py: an MPI-aware
+InteractiveConsole started under ``mpirun -stdin all``).
+
+TPU-native form: there is one controller, so a plain REPL suffices — this script
+drops into an InteractiveConsole with ``heat_tpu`` preloaded and a banner showing
+the device mesh every op will run on. Useful for poking at shardings:
+
+    $ python scripts/interactive.py
+    >>> x = ht.arange(16, split=0)
+    >>> x.larray.sharding
+"""
+
+import code
+import sys
+
+
+def main():
+    import jax
+
+    import heat_tpu as ht
+
+    devices = jax.devices()
+    banner = (
+        f"heat_tpu {ht.__version__} interactive session\n"
+        f"devices ({len(devices)}): {', '.join(str(d) for d in devices)}\n"
+        f"`ht` and `jax` are preloaded; ht.* ops run SPMD over all devices."
+    )
+    console = code.InteractiveConsole(locals={"ht": ht, "jax": jax})
+    try:
+        console.interact(banner=banner, exitmsg="")
+    except SystemExit:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

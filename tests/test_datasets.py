@@ -1,0 +1,87 @@
+"""Bundled datasets + offline ingest tooling (reference heat/datasets/ fixtures and
+heat/utils/data/_utils.py merge tooling)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+h5py = pytest.importorskip("h5py")
+
+
+def test_iris_loaders():
+    x = ht.datasets.load_iris(split=0)
+    assert x.shape == (150, 4)
+    assert x.split == 0
+    x2, y = ht.datasets.load_iris(return_labels=True)
+    assert y.shape == (150,)
+    assert sorted(np.unique(y.numpy())) == [0, 1, 2]
+
+
+def test_diabetes_loaders():
+    x, y = ht.datasets.load_diabetes(split=0, return_target=True)
+    assert x.shape == (442, 10)
+    assert y.shape == (442,)
+
+
+def test_materialised_files_roundtrip():
+    # iris.h5 through the parallel loader
+    path = ht.datasets.path("iris.h5")
+    assert os.path.exists(path)
+    data = ht.load_hdf5(path, dataset="data", split=0)
+    np.testing.assert_allclose(data.numpy(), ht.datasets.load_iris().numpy())
+
+    # diabetes.h5 carries x and y (reference examples/lasso/demo.py:23-24)
+    dpath = ht.datasets.path("diabetes.h5")
+    with h5py.File(dpath, "r") as f:
+        assert f["x"].shape == (442, 10)
+        assert f["y"].shape == (442,)
+
+    # csv fixture parses with the csv loader
+    cpath = ht.datasets.path("iris.csv")
+    csv = ht.load_csv(cpath, sep=";", split=0)
+    assert csv.shape == (150, 4)
+
+    # kNN demo fixtures exist and partition 150 rows
+    tr = np.loadtxt(ht.datasets.path("iris_X_train.csv"), delimiter=";")
+    te = np.loadtxt(ht.datasets.path("iris_X_test.csv"), delimiter=";")
+    assert tr.shape[0] + te.shape[0] == 150
+
+
+def test_merge_npz_to_h5(tmp_path):
+    from heat_tpu.utils.data._utils import merge_npz_to_h5
+
+    files = []
+    for i in range(3):
+        p = tmp_path / f"shard{i}.npz"
+        np.savez(p, data=np.full((4, 2), i, np.float32), labels=np.arange(4) + 10 * i)
+        files.append(str(p))
+    out = merge_npz_to_h5(files, str(tmp_path / "merged.h5"))
+    with h5py.File(out, "r") as f:
+        assert f["data"].shape == (12, 2)
+        np.testing.assert_array_equal(f["data"][4:8], np.full((4, 2), 1, np.float32))
+        np.testing.assert_array_equal(f["labels"][8:], np.arange(4) + 20)
+    # merged file feeds PartialH5Dataset
+    ds = ht.utils.data.PartialH5Dataset(out, dataset_names=["data", "labels"], initial_load=8, load_length=4)
+    x, y = ds[0]
+    assert x.shape == (2,)
+    ds.close()
+
+
+def test_generate_jobscripts(tmp_path):
+    import subprocess
+    import sys
+
+    out = tmp_path / "jobs"
+    res = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "..", "benchmarks", "generate_jobscripts.py"),
+         "--out", str(out)],
+        capture_output=True, text=True,
+    )
+    assert res.returncode == 0, res.stderr
+    scripts = list(out.glob("*.sh"))
+    assert len(scripts) > 10
+    body = (out / "kmeans_strong_8dev.sh").read_text()
+    assert "--xla_force_host_platform_device_count=8" in body
