@@ -32,30 +32,37 @@ def bench_tpu(data_np):
     import jax
     import jax.numpy as jnp
 
-    from heat_tpu.cluster.kmeans import _kmeans_step
+    from heat_tpu.cluster.kmeans import _kmeans_step, _kmeans_iterate
     from heat_tpu.cluster._pallas import fused_step_available, kmeans_step_fused
 
     dev = jax.devices()[0]
     x = jax.device_put(jnp.asarray(data_np), dev)
     centers = x[:K]
 
-    def time_step(step, iters):
-        c, *_ = step(x, centers)  # compile + warmup
-        jax.block_until_ready(c)
-        t0 = time.perf_counter()
-        c = centers
-        for _ in range(iters):
-            c, _, _, _ = step(x, c)
-        jax.block_until_ready(c)
-        return iters / (time.perf_counter() - t0)
+    def time_loop(step, iters):
+        # the whole fixed-count Lloyd loop runs on-device as one XLA program
+        # (KMeans.fit's while_loop path, minus the convergence test).
+        # Honest timing on async/remote runtimes: perturb the input so no cached
+        # result can be replayed, and read the result back to host — the clock
+        # only stops when real bytes arrive.
+        np.asarray(_kmeans_iterate(x, centers, step, iters))  # compile + warmup
+        best = float("inf")
+        for trial in range(3):
+            c2 = centers * (1.0 + 1e-6 * (trial + 1))
+            t0 = time.perf_counter()
+            np.asarray(_kmeans_iterate(x, c2, step, iters))
+            best = min(best, time.perf_counter() - t0)
+        return iters / best
 
     candidates = {"xla": _kmeans_step}
     if fused_step_available(N, F, K):
         candidates["pallas_fused"] = kmeans_step_fused
-    # short calibration pass picks the faster step for this runtime, then measure
-    rates = {name: time_step(step, max(ITERS // 3, 5)) for name, step in candidates.items()}
+    # short calibration pass picks the faster step for this runtime (the fused
+    # on-device loop makes dispatch cost moot, so a short loop ranks correctly),
+    # then the winner is measured at full length
+    rates = {name: time_loop(step, max(ITERS // 3, 10)) for name, step in candidates.items()}
     best = max(rates, key=rates.get)
-    return time_step(candidates[best], ITERS * 3), f"{dev} [{best}]"
+    return time_loop(candidates[best], ITERS * 3), f"{dev} [{best}]"
 
 
 def bench_torch_cpu(data_np, iters=3):

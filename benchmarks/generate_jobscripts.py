@@ -23,10 +23,13 @@ import os
 import stat
 
 SINGLE_HOST_TEMPLATE = """#!/bin/bash -x
-# {name}: single-host run over {devices} device(s)
-# (virtual CPU mesh when no TPU is attached — same code path, XLA collectives)
+# {name}: single-host run over a {devices}-device virtual CPU mesh — the same
+# code path and XLA collectives as real chips, so the 1/2/4/8 grid measures
+# scaling without hardware. The forced device count only applies to the CPU
+# platform; run with JAX_PLATFORMS=tpu to use all attached chips instead (the
+# device grid is then inert).
 cd {workdir}
-export JAX_PLATFORMS=${{JAX_PLATFORMS:-}}
+export JAX_PLATFORMS=${{JAX_PLATFORMS:-cpu}}
 export XLA_FLAGS="--xla_force_host_platform_device_count={devices} $XLA_FLAGS"
 python -u {script} {parameters}
 """

@@ -40,6 +40,44 @@ def _kmeans_step(x: jax.Array, centers: jax.Array):
     return new_centers, labels, shift, inertia
 
 
+@partial(jax.jit, static_argnames=("step", "max_iter", "tol"))
+def _kmeans_fit_loop(x: jax.Array, centers: jax.Array, step, max_iter: int, tol: float):
+    """
+    The ENTIRE Lloyd fit as one XLA program: `lax.while_loop` over the iteration
+    with the convergence test on-device, then one assignment pass against the
+    final centers. The reference's fit loop round-trips `shift` to the host every
+    iteration (kmeans.py:102-130); here nothing leaves the device until the fit is
+    done, so per-iteration latency is kernel time, not dispatch time.
+    Returns (centers, labels, inertia, n_iter).
+    """
+
+    def cond(carry):
+        _, shift, it = carry
+        return jnp.logical_and(it < max_iter, shift > tol)
+
+    def body(carry):
+        c, _, it = carry
+        new_c, _, shift, _ = step(x, c)
+        return (new_c, shift, it + jnp.int32(1))
+
+    init = (centers, jnp.asarray(jnp.inf, centers.dtype), jnp.int32(0))
+    centers, _, n_iter = jax.lax.while_loop(cond, body, init)
+    # labels/inertia w.r.t. the final centers (discard the extra centroid update)
+    _, labels, _, inertia = step(x, centers)
+    return centers, labels, inertia, n_iter
+
+
+@partial(jax.jit, static_argnames=("step", "iters"))
+def _kmeans_iterate(x: jax.Array, centers: jax.Array, step, iters: int):
+    """Fixed-count Lloyd iterations as one fused on-device loop (benchmark path)."""
+
+    def body(_, c):
+        new_c, _, _, _ = step(x, c)
+        return new_c
+
+    return jax.lax.fori_loop(0, iters, body, centers)
+
+
 class KMeans(_KCluster):
     """
     K-Means clustering with Lloyd's algorithm.
@@ -112,13 +150,11 @@ class KMeans(_KCluster):
             step = kmeans_step_fused
         else:
             step = _kmeans_step
-        n_iter = 0
-        for n_iter in range(1, self.max_iter + 1):
-            centers, labels, shift, inertia = step(data, centers)
-            if float(shift) <= self.tol:
-                break
+        centers, labels, inertia, n_iter = _kmeans_fit_loop(
+            data, centers, step, self.max_iter, float(self.tol)
+        )
         self._cluster_centers = ht.array(centers, device=x.device, comm=x.comm)
         self._labels = ht.array(labels, split=x.split, device=x.device, comm=x.comm)
         self._inertia = float(inertia)
-        self._n_iter = n_iter
+        self._n_iter = int(n_iter)
         return self

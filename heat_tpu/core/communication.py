@@ -33,6 +33,7 @@ __all__ = [
     "get_comm",
     "sanitize_comm",
     "use_comm",
+    "distributed_init",
 ]
 
 #: The mesh axis name every 1-D split sharding partitions over.
@@ -294,3 +295,31 @@ def use_comm(comm: Optional[Communication] = None) -> None:
     communication.py:1927-1940)."""
     global __default_comm
     __default_comm = sanitize_comm(comm)
+
+
+def distributed_init(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> MeshCommunication:
+    """
+    Join a multi-host run and return the world communicator spanning the whole pod.
+
+    The reference framework becomes multi-node by launching every rank under
+    ``mpirun``; the TPU-native equivalent is one controller process per host with
+    ``jax.distributed.initialize`` wiring the pod topology (on Cloud TPU the
+    arguments are auto-detected from the metadata server — call with no args).
+    Must be called before any other JAX/heat_tpu operation in the process.
+    After it returns, ``WORLD``/``get_comm()`` cover all chips in the pod and every
+    ``split`` array spans hosts, with XLA routing collectives over ICI within a
+    slice and DCN across slices.
+    """
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    return get_comm()

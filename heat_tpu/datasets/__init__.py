@@ -34,15 +34,41 @@ _DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_data")
 
 
 def _iris_arrays():
-    from sklearn.datasets import load_iris as _sk_iris
-
+    """(150, 4) features + (150,) int labels. Prefers the materialised bundle (rows
+    class-ordered, 50 per class — the layout of the reference's iris.csv), so an
+    install without scikit-learn still works once the files exist."""
+    csv = os.path.join(_DATA_DIR, "iris.csv")
+    if os.path.exists(csv):
+        x = np.loadtxt(csv, delimiter=";").astype(np.float32)
+        return x, np.repeat(np.arange(3, dtype=np.int32), 50)
+    try:
+        from sklearn.datasets import load_iris as _sk_iris
+    except ImportError as e:
+        raise RuntimeError(
+            "bundled iris data not materialised yet and scikit-learn is not "
+            "installed; install the 'datasets' extra to generate it"
+        ) from e
     b = _sk_iris()
     return b.data.astype(np.float32), b.target.astype(np.int32)
 
 
 def _diabetes_arrays():
-    from sklearn.datasets import load_diabetes as _sk_diabetes
+    h5 = os.path.join(_DATA_DIR, "diabetes.h5")
+    if os.path.exists(h5):
+        try:
+            import h5py
 
+            with h5py.File(h5, "r") as f:
+                return np.asarray(f["x"], np.float32), np.asarray(f["y"], np.float32)
+        except ImportError:
+            pass
+    try:
+        from sklearn.datasets import load_diabetes as _sk_diabetes
+    except ImportError as e:
+        raise RuntimeError(
+            "bundled diabetes data not materialised yet and scikit-learn is not "
+            "installed; install the 'datasets' extra to generate it"
+        ) from e
     b = _sk_diabetes()
     return b.data.astype(np.float32), b.target.astype(np.float32)
 
@@ -54,26 +80,37 @@ def _train_test_split(x, y, train=105, seed=42):
     return x[tr], x[te], y[tr], y[te]
 
 
+def _replace(tmp: str, final: str) -> None:
+    os.replace(tmp, final)
+
+
 def _materialise(name: str, dest: str) -> None:
+    """Write the named dataset. All writes go to a temp path and are atomically
+    renamed into place, so an interrupted write never leaves a truncated file that
+    ``path()`` would treat as valid."""
     os.makedirs(_DATA_DIR, exist_ok=True)
+    tmp = dest + ".tmp"
     if name == "iris.csv":
         x, _ = _iris_arrays()
-        np.savetxt(dest, x, delimiter=";", fmt="%.1f")
+        np.savetxt(tmp, x, delimiter=";", fmt="%.1f")
+        _replace(tmp, dest)
     elif name == "iris.h5":
         import h5py
 
         x, _ = _iris_arrays()
-        with h5py.File(dest, "w") as f:
+        with h5py.File(tmp, "w") as f:
             f.create_dataset("data", data=x)
+        _replace(tmp, dest)
     elif name == "iris.nc":
         import netCDF4
 
         x, _ = _iris_arrays()
-        with netCDF4.Dataset(dest, "w") as f:
+        with netCDF4.Dataset(tmp, "w") as f:
             f.createDimension("rows", x.shape[0])
             f.createDimension("cols", x.shape[1])
             var = f.createVariable("data", "f4", ("rows", "cols"))
             var[:] = x
+        _replace(tmp, dest)
     elif name in (
         "iris_X_train.csv",
         "iris_X_test.csv",
@@ -91,14 +128,17 @@ def _materialise(name: str, dest: str) -> None:
             "iris_y_pred_proba.csv": proba,
         }
         for fname, arr in arrays.items():
-            np.savetxt(os.path.join(_DATA_DIR, fname), arr, delimiter=";", fmt="%.1f")
+            fdest = os.path.join(_DATA_DIR, fname)
+            np.savetxt(fdest + ".tmp", arr, delimiter=";", fmt="%.1f")
+            _replace(fdest + ".tmp", fdest)
     elif name == "diabetes.h5":
         import h5py
 
         x, y = _diabetes_arrays()
-        with h5py.File(dest, "w") as f:
+        with h5py.File(tmp, "w") as f:
             f.create_dataset("x", data=x)
             f.create_dataset("y", data=y)
+        _replace(tmp, dest)
     else:
         raise ValueError(f"unknown bundled dataset: {name!r}")
 

@@ -109,6 +109,7 @@ def merge_imagenet_tfrecord_to_h5(
         ) from e
 
     output_folder = output_folder or folder_name
+    os.makedirs(output_folder, exist_ok=True)
     written = []
     for split in datasets:
         shards = sorted(

@@ -10,8 +10,14 @@ emits for it. The counter-based RNG keeps results device-count-invariant.
 
 import os
 
+# device count of the virtual mesh (the reference's mpirun -n {1..8} matrix maps
+# to HEAT_TPU_TEST_DEVICES ∈ {1,2,4,8}; default 8)
+_n = os.environ.get("HEAT_TPU_TEST_DEVICES", "8")
+
 # must happen before any JAX backend initialisation
-os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + f" --xla_force_host_platform_device_count={_n}"
+)
 
 import jax
 

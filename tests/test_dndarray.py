@@ -26,7 +26,7 @@ def test_properties():
 def test_lshape_map():
     a = ht.zeros((16, 4), split=0)
     m = a.lshape_map
-    assert m.shape == (8, 2)
+    assert m.shape == (ht.get_comm().size, 2)
     assert m[:, 0].sum() == 16
     counts, displs = a.counts_displs()
     assert sum(counts) == 16
@@ -125,7 +125,9 @@ def test_balance_redistribute_noop():
 def test_halo():
     a = ht.array(np.arange(32.0).reshape(16, 2), split=0)
     a.get_halo(1)
-    assert a.halo_prev is not None and a.halo_next is not None
+    if ht.get_comm().size > 1:
+        # edge shards have one neighbor; a 1-device world has none
+        assert a.halo_prev is not None and a.halo_next is not None
     with pytest.raises(TypeError):
         a.get_halo("x")
     with pytest.raises(ValueError):

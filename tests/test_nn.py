@@ -80,7 +80,7 @@ def test_daso_training():
         cooldown_epochs=1,
         max_global_skips=4,
     )
-    assert daso.nodes * daso.local_size == 8
+    assert daso.nodes * daso.local_size == ht.get_comm().size
     params = model.init(jax.random.PRNGKey(0), x[:2])
     daso.init(params)
     daso.make_train_step(_mse, model.apply)
