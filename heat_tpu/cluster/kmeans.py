@@ -40,7 +40,7 @@ def _kmeans_step(x: jax.Array, centers: jax.Array):
     return new_centers, labels, shift, inertia
 
 
-@partial(jax.jit, static_argnames=("step", "max_iter", "tol"))
+@partial(jax.jit, static_argnames=("step",))
 def _kmeans_fit_loop(x: jax.Array, centers: jax.Array, step, max_iter: int, tol: float):
     """
     The ENTIRE Lloyd fit as one XLA program: `lax.while_loop` over the iteration

@@ -245,6 +245,11 @@ class _LazyWorld(MeshCommunication):
         self.__built = False
 
     @property
+    def mesh_built(self) -> bool:
+        """Whether the lazy mesh has been resolved to concrete devices."""
+        return self.__built
+
+    @property
     def mesh(self) -> Mesh:
         if not self.__built:
             devs = jax.devices()
@@ -314,6 +319,12 @@ def distributed_init(
     ``split`` array spans hosts, with XLA routing collectives over ICI within a
     slice and DCN across slices.
     """
+    if getattr(WORLD, "mesh_built", False):
+        raise RuntimeError(
+            "distributed_init() must run before any heat_tpu/JAX operation: the "
+            "world communicator has already resolved to this host's devices, so "
+            "joining the pod now would leave every split array single-host"
+        )
     kwargs = {}
     if coordinator_address is not None:
         kwargs["coordinator_address"] = coordinator_address

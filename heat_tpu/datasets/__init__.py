@@ -60,8 +60,8 @@ def _diabetes_arrays():
 
             with h5py.File(h5, "r") as f:
                 return np.asarray(f["x"], np.float32), np.asarray(f["y"], np.float32)
-        except ImportError:
-            pass
+        except (ImportError, OSError, KeyError):
+            pass  # no h5py, or a stale/corrupt file — fall back to regeneration
     try:
         from sklearn.datasets import load_diabetes as _sk_diabetes
     except ImportError as e:
@@ -80,27 +80,25 @@ def _train_test_split(x, y, train=105, seed=42):
     return x[tr], x[te], y[tr], y[te]
 
 
-def _replace(tmp: str, final: str) -> None:
-    os.replace(tmp, final)
-
-
 def _materialise(name: str, dest: str) -> None:
     """Write the named dataset. All writes go to a temp path and are atomically
     renamed into place, so an interrupted write never leaves a truncated file that
     ``path()`` would treat as valid."""
     os.makedirs(_DATA_DIR, exist_ok=True)
-    tmp = dest + ".tmp"
+    # per-process tmp name: concurrent materialisers (multi-host shared fs,
+    # pytest-xdist) each publish a complete file; last atomic rename wins
+    tmp = f"{dest}.tmp.{os.getpid()}"
     if name == "iris.csv":
         x, _ = _iris_arrays()
         np.savetxt(tmp, x, delimiter=";", fmt="%.1f")
-        _replace(tmp, dest)
+        os.replace(tmp, dest)
     elif name == "iris.h5":
         import h5py
 
         x, _ = _iris_arrays()
         with h5py.File(tmp, "w") as f:
             f.create_dataset("data", data=x)
-        _replace(tmp, dest)
+        os.replace(tmp, dest)
     elif name == "iris.nc":
         import netCDF4
 
@@ -110,7 +108,7 @@ def _materialise(name: str, dest: str) -> None:
             f.createDimension("cols", x.shape[1])
             var = f.createVariable("data", "f4", ("rows", "cols"))
             var[:] = x
-        _replace(tmp, dest)
+        os.replace(tmp, dest)
     elif name in (
         "iris_X_train.csv",
         "iris_X_test.csv",
@@ -129,8 +127,9 @@ def _materialise(name: str, dest: str) -> None:
         }
         for fname, arr in arrays.items():
             fdest = os.path.join(_DATA_DIR, fname)
-            np.savetxt(fdest + ".tmp", arr, delimiter=";", fmt="%.1f")
-            _replace(fdest + ".tmp", fdest)
+            ftmp = f"{fdest}.tmp.{os.getpid()}"
+            np.savetxt(ftmp, arr, delimiter=";", fmt="%.1f")
+            os.replace(ftmp, fdest)
     elif name == "diabetes.h5":
         import h5py
 
@@ -138,7 +137,7 @@ def _materialise(name: str, dest: str) -> None:
         with h5py.File(tmp, "w") as f:
             f.create_dataset("x", data=x)
             f.create_dataset("y", data=y)
-        _replace(tmp, dest)
+        os.replace(tmp, dest)
     else:
         raise ValueError(f"unknown bundled dataset: {name!r}")
 

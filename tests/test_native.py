@@ -1,0 +1,63 @@
+"""Native C++ runtime helpers (heat_tpu/native): the threaded CSV parser and its
+integration with ht.load_csv (reference io.py:713-925 byte-range parallel CSV)."""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu import native
+
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no C++ toolchain for the native fast path"
+)
+
+
+def test_parse_basic():
+    raw = b"1.5,2,3\n4,5.25,-6\n"
+    out = native.parse_csv(raw, ",", 0)
+    np.testing.assert_allclose(out, [[1.5, 2, 3], [4, 5.25, -6]])
+
+
+def test_parse_header_blank_crlf():
+    raw = b"a;b\r\n# two header lines\r\n1;2\r\n\r\n  \r\n3;4\r\n-1e3;+2.5e-2\r\n"
+    out = native.parse_csv(raw, ";", 2)
+    np.testing.assert_allclose(out, [[1, 2], [3, 4], [-1000, 0.025]])
+
+
+def test_parse_no_trailing_newline():
+    out = native.parse_csv(b"1,2\n3,4", ",", 0)
+    np.testing.assert_allclose(out, [[1, 2], [3, 4]])
+
+
+def test_parse_malformed_returns_none():
+    assert native.parse_csv(b"1,2\n3\n", ",", 0) is None  # ragged row
+    assert native.parse_csv(b"1,x\n", ",", 0) is None  # bad float
+    assert native.parse_csv(b"1,2\n", ",,", 0) is None  # multi-char sep
+
+
+def test_parse_empty():
+    out = native.parse_csv(b"", ",", 0)
+    assert out.shape == (0, 0)
+
+
+def test_matches_python_path_large(tmp_path):
+    rng = np.random.default_rng(0)
+    arr = rng.normal(size=(5000, 12))
+    p = tmp_path / "big.csv"
+    np.savetxt(p, arr, delimiter=",", fmt="%.10g")
+    raw = p.read_bytes()
+    out = native.parse_csv(raw, ",", 0)
+    np.testing.assert_allclose(out, arr, rtol=1e-9)
+
+
+def test_load_csv_uses_native_and_agrees(tmp_path):
+    rng = np.random.default_rng(1)
+    arr = rng.normal(size=(257, 7)).astype(np.float32)  # odd row count: chunk edges
+    p = tmp_path / "data.csv"
+    np.savetxt(p, arr, delimiter=";", fmt="%.8g")
+    a = ht.load_csv(str(p), sep=";", split=0)
+    np.testing.assert_allclose(a.numpy(), arr, rtol=1e-5)
+    # latin-1 encoding forces the Python fallback; results agree
+    b = ht.load_csv(str(p), sep=";", split=0, encoding="latin-1")
+    np.testing.assert_allclose(b.numpy(), a.numpy())
