@@ -319,10 +319,10 @@ def distributed_init(
     ``split`` array spans hosts, with XLA routing collectives over ICI within a
     slice and DCN across slices.
     """
-    if getattr(WORLD, "mesh_built", False):
+    if getattr(WORLD, "mesh_built", False) or getattr(SELF, "mesh_built", False):
         raise RuntimeError(
-            "distributed_init() must run before any heat_tpu/JAX operation: the "
-            "world communicator has already resolved to this host's devices, so "
+            "distributed_init() must run before any heat_tpu/JAX operation: a "
+            "communicator has already resolved to this host's devices, so "
             "joining the pod now would leave every split array single-host"
         )
     kwargs = {}

@@ -114,8 +114,12 @@ int parse_chunk(const char* buf, const Range& r, char sep, double* out,
 
 extern "C" {
 
+// `nthreads` fixes the chunk decomposition; `out_chunk_counts` (size nthreads,
+// zero-filled by the caller) receives per-chunk row counts so ht_csv_parse can
+// reuse them instead of re-scanning the buffer.
 int ht_csv_count(const char* buf, int64_t len, char sep, int64_t header_lines,
-                 int64_t* out_rows, int64_t* out_cols) {
+                 int nthreads, int64_t* out_rows, int64_t* out_cols,
+                 int64_t* out_chunk_counts) {
     int64_t start = skip_header(buf, len, header_lines);
     // columns from the first non-blank line
     int64_t cols = 0;
@@ -137,41 +141,38 @@ int ht_csv_count(const char* buf, int64_t len, char sep, int64_t header_lines,
         *out_rows = 0;
         return 0;
     }
-    int n = std::max(1u, std::min(std::thread::hardware_concurrency(), 16u));
+    int n = nthreads > 0
+                ? nthreads
+                : std::max(1u, std::min(std::thread::hardware_concurrency(), 16u));
     auto ranges = split_ranges(buf, len, start, n);
-    std::vector<int64_t> counts(ranges.size(), 0);
     std::vector<std::thread> threads;
     for (size_t i = 0; i < ranges.size(); ++i)
         threads.emplace_back(
-            [&, i] { counts[i] = count_rows(buf, ranges[i]); });
+            [&, i] { out_chunk_counts[i] = count_rows(buf, ranges[i]); });
     for (auto& t : threads) t.join();
     int64_t total = 0;
-    for (int64_t c : counts) total += c;
+    for (size_t i = 0; i < ranges.size(); ++i) total += out_chunk_counts[i];
     *out_rows = total;
     return 0;
 }
 
+// `chunk_counts` must come from ht_csv_count with the same nthreads (it fixes the
+// chunk decomposition), so the buffer is scanned exactly twice overall: once to
+// count, once to parse.
 int ht_csv_parse(const char* buf, int64_t len, char sep, int64_t header_lines,
-                 double* out, int64_t rows, int64_t cols, int nthreads) {
+                 double* out, int64_t rows, int64_t cols, int nthreads,
+                 const int64_t* chunk_counts) {
     int64_t start = skip_header(buf, len, header_lines);
     int n = nthreads > 0
                 ? nthreads
                 : std::max(1u, std::min(std::thread::hardware_concurrency(), 16u));
     auto ranges = split_ranges(buf, len, start, n);
-    std::vector<int64_t> counts(ranges.size(), 0);
-    {
-        std::vector<std::thread> threads;
-        for (size_t i = 0; i < ranges.size(); ++i)
-            threads.emplace_back(
-                [&, i] { counts[i] = count_rows(buf, ranges[i]); });
-        for (auto& t : threads) t.join();
-    }
     // prefix sums -> per-chunk output row offsets
     std::vector<int64_t> row0(ranges.size(), 0);
     int64_t acc = 0;
     for (size_t i = 0; i < ranges.size(); ++i) {
         row0[i] = acc;
-        acc += counts[i];
+        acc += chunk_counts[i];
     }
     if (acc != rows) return -1;  // caller's count is stale
     std::atomic<int> status{0};

@@ -38,16 +38,53 @@ from ..core import types
 __all__ = ["scaled_dot_product_attention", "ring_attention", "ulysses_attention"]
 
 
+def _flash_available(q: jax.Array, k: jax.Array) -> bool:
+    """Whether the pallas TPU flash-attention kernel applies: single-device TPU
+    operands (the distributed paths handle their own blocking), floating dtypes,
+    and sequence lengths the kernel's fixed 128-block tiling divides."""
+    if jax.default_backend() != "tpu":
+        return False
+    if q.shape[1] % 128 != 0 or k.shape[1] % 128 != 0:
+        return False
+    try:
+        if len(q.devices()) != 1:
+            return False
+    except Exception:  # traced values: inside jit, sharding is the compiler's job
+        return False
+    return q.dtype in (jnp.float32, jnp.bfloat16)
+
+
 def scaled_dot_product_attention(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
     causal: bool = False,
     scale: Optional[float] = None,
+    impl: str = "auto",
 ) -> jax.Array:
-    """Dense reference attention on ``(batch, seq, heads, head_dim)`` operands."""
+    """
+    Attention on ``(batch, seq, heads, head_dim)`` operands.
+
+    ``impl``: ``"auto"`` uses the fused pallas flash-attention kernel on a single
+    TPU device (one HBM pass, no materialised score matrix) and the dense XLA
+    formulation elsewhere; ``"dense"``/``"flash"`` force a path.
+    """
+    if impl not in ("auto", "dense", "flash"):
+        raise ValueError(f"impl must be 'auto', 'dense' or 'flash', got {impl!r}")
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    if impl == "flash" or (impl == "auto" and _flash_available(q, k)):
+        from jax.experimental.pallas.ops.tpu.flash_attention import flash_attention
+
+        o = flash_attention(
+            # kernel layout is (batch, heads, seq, head_dim)
+            jnp.transpose(q, (0, 2, 1, 3)),
+            jnp.transpose(k, (0, 2, 1, 3)),
+            jnp.transpose(v, (0, 2, 1, 3)),
+            causal=causal,
+            sm_scale=scale,
+        )
+        return jnp.transpose(o, (0, 2, 1, 3)).astype(q.dtype)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if causal:
         q_pos = jnp.arange(q.shape[1])

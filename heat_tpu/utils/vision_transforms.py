@@ -18,24 +18,68 @@ except ImportError:  # pragma: no cover - torchvision absent in TPU images
 
 
 def normalize(mean, std):
-    """Returns f(x) = (x - mean) / std (jnp-native Normalize)."""
-    mean = jnp.asarray(mean)
-    std = jnp.asarray(std)
-
-    def _apply(x):
-        return (jnp.asarray(x) - mean) / std
-
-    return _apply
+    """Returns f(x) = (x - mean) / std (functional form of :class:`Normalize`)."""
+    return Normalize(mean, std)
 
 
 def to_tensor():
-    """Returns f(x) = float32 array scaled to [0, 1] (jnp-native ToTensor)."""
+    """Returns the HWC→CHW [0,1] conversion (functional form of :class:`ToTensor`)."""
+    return ToTensor()
 
-    def _apply(x):
-        x = jnp.asarray(x, dtype=jnp.float32)
-        return x / 255.0 if x.max() > 1.0 else x
 
-    return _apply
+class Compose:
+    """Chain transforms left to right (torchvision.transforms.Compose semantics)."""
+
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class Normalize:
+    """(x - mean) / std, jnp-native (torchvision.transforms.Normalize semantics:
+    per-channel stats broadcast over trailing image dims for CHW input)."""
+
+    def __init__(self, mean, std):
+        self.mean = jnp.asarray(mean)
+        self.std = jnp.asarray(std)
+
+    def __call__(self, x):
+        x = jnp.asarray(x)
+        mean, std = self.mean, self.std
+        if mean.ndim == 1 and x.ndim >= 3:  # CHW layout: broadcast over H, W
+            mean = mean[:, None, None]
+            std = std[:, None, None]
+        return (x - mean) / std
+
+
+class ToTensor:
+    """torchvision.transforms.ToTensor semantics on jnp arrays: an (H, W) or
+    (H, W, C) image becomes float32 CHW, with integer dtypes scaled to [0, 1].
+    Output is a jnp array (downstream transforms here are jnp-native too)."""
+
+    def __call__(self, x):
+        x = jnp.asarray(x)
+        if x.ndim == 2:
+            x = x[None, :, :]
+        elif x.ndim == 3 and x.shape[-1] in (1, 3, 4):
+            x = jnp.transpose(x, (2, 0, 1))  # HWC -> CHW
+        if jnp.issubdtype(x.dtype, jnp.integer):
+            return x.astype(jnp.float32) / 255.0
+        return x.astype(jnp.float32)
+
+
+class Lambda:
+    """Wrap an arbitrary callable as a transform."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def __call__(self, x):
+        return self.fn(x)
 
 
 def __getattr__(name: str):

@@ -71,3 +71,12 @@ def test_uneven_seq_falls_back(comm):
     want = scaled_dot_product_attention(q, k, v)
     got = ring_attention(q, k, v, comm=comm)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5)
+
+
+def test_sdpa_impl_flag():
+    """impl='auto' falls back to dense off-TPU; explicit impl='dense' matches."""
+    rng = np.random.default_rng(3)
+    q, k, v = (jnp.asarray(rng.normal(size=(2, 64, 2, 16)), jnp.float32) for _ in range(3))
+    auto = scaled_dot_product_attention(q, k, v, causal=True)
+    dense = scaled_dot_product_attention(q, k, v, causal=True, impl="dense")
+    np.testing.assert_allclose(np.asarray(auto), np.asarray(dense), atol=1e-6)

@@ -162,11 +162,31 @@ def test_vision_transforms():
     f = vt.normalize(0.5, 0.5)
     np.testing.assert_allclose(np.asarray(f(np.array([1.0]))), [1.0])
     g = vt.to_tensor()
-    out = np.asarray(g(np.array([255.0])))
+    out = np.asarray(g(np.array([255], np.uint8)))      # integer input: scaled
     np.testing.assert_allclose(out, [1.0])
+    out = np.asarray(g(np.array([0.25], np.float32)))   # float input: passthrough
+    np.testing.assert_allclose(out, [0.25])
     with pytest.raises(AttributeError):
         vt.DefinitelyNotATransform
 
 
 def test_version():
     assert ht.__version__.startswith("0.")
+
+
+def test_vision_transforms_native():
+    """jnp-native Compose/ToTensor/Normalize/Lambda (reference
+    vision_transforms.py is a torchvision passthrough; these work without it)."""
+    from heat_tpu.utils import vision_transforms as vt
+
+    img = (np.arange(24, dtype=np.uint8).reshape(4, 2, 3) * 10)  # HWC, 3 channels
+    tf = vt.Compose([vt.ToTensor(), vt.Normalize([0.5, 0.5, 0.5], [0.5, 0.5, 0.5])])
+    out = np.asarray(tf(img))
+    want = (np.transpose(img, (2, 0, 1)).astype(np.float32) / 255.0 - 0.5) / 0.5
+    assert out.shape == (3, 4, 2)  # torchvision ToTensor: HWC -> CHW
+    np.testing.assert_allclose(out, want, atol=1e-6)
+    chw = np.ones((3, 4, 4), np.float32)
+    out = np.asarray(vt.Normalize([1.0, 1.0, 0.0], [1.0, 2.0, 4.0])(chw))
+    np.testing.assert_allclose(out[0], 0.0, atol=1e-6)
+    np.testing.assert_allclose(out[2], 0.25, atol=1e-6)
+    assert float(np.asarray(vt.Lambda(lambda x: x + 1)(np.zeros(())))) == 1.0
