@@ -16,6 +16,7 @@ Python/NumPy when the toolchain is unavailable.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import sys
@@ -28,7 +29,11 @@ __all__ = ["available", "parse_csv"]
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "_csv.cpp")
-_LIB_NAME = f"_native_{sys.platform}.so"
+
+
+def _src_digest() -> str:
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()[:12]
 
 _lock = threading.Lock()
 _lib = None
@@ -60,21 +65,27 @@ def _load():
         if _tried:
             return _lib
         _tried = True
-        dest = os.path.join(_DIR, _LIB_NAME)
+        # The source digest in the cache name ties the binary to the exact C ABI;
+        # a stale .so from older sources can never be loaded (mtime is unreliable
+        # across tar/rsync extraction).
+        dest = os.path.join(_DIR, f"_native_{sys.platform}_{_src_digest()}.so")
         try:
-            if not os.path.exists(dest) or os.path.getmtime(dest) < os.path.getmtime(_SRC):
+            if not os.path.exists(dest):
                 if not _compile(dest):
                     return None
             lib = ctypes.CDLL(dest)
             lib.ht_csv_count.argtypes = [
                 ctypes.c_char_p, ctypes.c_int64, ctypes.c_char, ctypes.c_int64,
+                ctypes.c_int,
                 ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int64),
             ]
             lib.ht_csv_count.restype = ctypes.c_int
             lib.ht_csv_parse.argtypes = [
                 ctypes.c_char_p, ctypes.c_int64, ctypes.c_char, ctypes.c_int64,
                 np.ctypeslib.ndpointer(dtype=np.float64, ndim=2, flags="C_CONTIGUOUS"),
                 ctypes.c_int64, ctypes.c_int64, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int64),
             ]
             lib.ht_csv_parse.restype = ctypes.c_int
             _lib = lib
@@ -102,12 +113,17 @@ def parse_csv(raw: bytes, sep: str, header_lines: int):
     rows = ctypes.c_int64(0)
     cols = ctypes.c_int64(0)
     sep_b = sep.encode("ascii")
-    if lib.ht_csv_count(raw, n, sep_b, header_lines, ctypes.byref(rows), ctypes.byref(cols)) != 0:
+    # The thread count fixes the chunk decomposition shared by count and parse.
+    nthreads = max(1, min(os.cpu_count() or 1, 16))
+    chunk_counts = (ctypes.c_int64 * nthreads)()
+    if lib.ht_csv_count(raw, n, sep_b, header_lines, nthreads,
+                        ctypes.byref(rows), ctypes.byref(cols), chunk_counts) != 0:
         return None
     if rows.value == 0 or cols.value == 0:
         return np.empty((0, 0), np.float64)
     out = np.empty((rows.value, cols.value), np.float64)
-    rc = lib.ht_csv_parse(raw, n, sep_b, header_lines, out, rows.value, cols.value, 0)
+    rc = lib.ht_csv_parse(raw, n, sep_b, header_lines, out, rows.value, cols.value,
+                          nthreads, chunk_counts)
     if rc != 0:
         return None
     return out

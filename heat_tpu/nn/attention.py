@@ -46,11 +46,18 @@ def _flash_available(q: jax.Array, k: jax.Array) -> bool:
         return False
     if q.shape[1] % 128 != 0 or k.shape[1] % 128 != 0:
         return False
+    head_dim = q.shape[-1]
+    if head_dim > 128 and head_dim % 128 != 0:  # kernel rejects such head dims
+        return False
     try:
         if len(q.devices()) != 1:
             return False
-    except Exception:  # traced values: inside jit, sharding is the compiler's job
-        return False
+    except Exception:
+        # Traced values carry no placement; inside jit the kernel is valid
+        # whenever this process drives a single device (the sharded paths go
+        # through ring/ulysses, not here).
+        if jax.local_device_count() != 1:
+            return False
     return q.dtype in (jnp.float32, jnp.bfloat16)
 
 

@@ -18,16 +18,16 @@ except ImportError:  # pragma: no cover - torchvision absent in TPU images
 
 
 def normalize(mean, std):
-    """Returns f(x) = (x - mean) / std (functional form of :class:`Normalize`)."""
-    return Normalize(mean, std)
+    """Returns f(x) = (x - mean) / std (functional form of :class:`JnpNormalize`)."""
+    return JnpNormalize(mean, std)
 
 
 def to_tensor():
-    """Returns the HWC→CHW [0,1] conversion (functional form of :class:`ToTensor`)."""
-    return ToTensor()
+    """Returns the HWC→CHW [0,1] conversion (functional form of :class:`JnpToTensor`)."""
+    return JnpToTensor()
 
 
-class Compose:
+class JnpCompose:
     """Chain transforms left to right (torchvision.transforms.Compose semantics)."""
 
     def __init__(self, transforms):
@@ -39,9 +39,10 @@ class Compose:
         return x
 
 
-class Normalize:
-    """(x - mean) / std, jnp-native (torchvision.transforms.Normalize semantics:
-    per-channel stats broadcast over trailing image dims for CHW input)."""
+class JnpNormalize:
+    """(x - mean) / std, jnp-native. Per-channel stats align against whichever
+    axis matches their length — leading (CHW, torchvision layout) wins when
+    ambiguous, trailing (HWC) otherwise."""
 
     def __init__(self, mean, std):
         self.mean = jnp.asarray(mean)
@@ -50,13 +51,13 @@ class Normalize:
     def __call__(self, x):
         x = jnp.asarray(x)
         mean, std = self.mean, self.std
-        if mean.ndim == 1 and x.ndim >= 3:  # CHW layout: broadcast over H, W
-            mean = mean[:, None, None]
+        if mean.ndim == 1 and x.ndim >= 3 and x.shape[-3] == mean.shape[0]:
+            mean = mean[:, None, None]  # CHW: broadcast over H, W
             std = std[:, None, None]
         return (x - mean) / std
 
 
-class ToTensor:
+class JnpToTensor:
     """torchvision.transforms.ToTensor semantics on jnp arrays: an (H, W) or
     (H, W, C) image becomes float32 CHW, with integer dtypes scaled to [0, 1].
     Output is a jnp array (downstream transforms here are jnp-native too)."""
@@ -65,14 +66,14 @@ class ToTensor:
         x = jnp.asarray(x)
         if x.ndim == 2:
             x = x[None, :, :]
-        elif x.ndim == 3 and x.shape[-1] in (1, 3, 4):
-            x = jnp.transpose(x, (2, 0, 1))  # HWC -> CHW
+        elif x.ndim == 3:
+            x = jnp.transpose(x, (2, 0, 1))  # HWC -> CHW, any channel count
         if jnp.issubdtype(x.dtype, jnp.integer):
             return x.astype(jnp.float32) / 255.0
         return x.astype(jnp.float32)
 
 
-class Lambda:
+class JnpLambda:
     """Wrap an arbitrary callable as a transform."""
 
     def __init__(self, fn):
@@ -82,11 +83,23 @@ class Lambda:
         return self.fn(x)
 
 
+# With torchvision absent the common names resolve to the jnp-native versions.
+_JNP_FALLBACK = {
+    "Compose": JnpCompose,
+    "Normalize": JnpNormalize,
+    "ToTensor": JnpToTensor,
+    "Lambda": JnpLambda,
+}
+
+
 def __getattr__(name: str):
-    """Fall through to torchvision.transforms when available (reference
-    vision_transforms.py:12-33)."""
+    """Fall through to torchvision.transforms when available — torchvision wins,
+    matching the reference's pure-passthrough module (vision_transforms.py:12-33) —
+    else serve the jnp-native equivalents for the common transform names."""
     if _tvt is not None and hasattr(_tvt, name):
         return getattr(_tvt, name)
+    if name in _JNP_FALLBACK:
+        return _JNP_FALLBACK[name]
     raise AttributeError(
         f"module 'heat_tpu.utils.vision_transforms' has no attribute {name!r}"
         + ("" if _tvt else " (torchvision not installed)")

@@ -175,18 +175,34 @@ def test_version():
 
 
 def test_vision_transforms_native():
-    """jnp-native Compose/ToTensor/Normalize/Lambda (reference
-    vision_transforms.py is a torchvision passthrough; these work without it)."""
+    """jnp-native JnpCompose/JnpToTensor/JnpNormalize/JnpLambda (reference
+    vision_transforms.py is a torchvision passthrough; these work without it).
+    Named classes are used directly so the test is valid even when torchvision
+    is installed (the bare names then resolve to torchvision via __getattr__)."""
     from heat_tpu.utils import vision_transforms as vt
 
     img = (np.arange(24, dtype=np.uint8).reshape(4, 2, 3) * 10)  # HWC, 3 channels
-    tf = vt.Compose([vt.ToTensor(), vt.Normalize([0.5, 0.5, 0.5], [0.5, 0.5, 0.5])])
+    tf = vt.JnpCompose(
+        [vt.JnpToTensor(), vt.JnpNormalize([0.5, 0.5, 0.5], [0.5, 0.5, 0.5])]
+    )
     out = np.asarray(tf(img))
     want = (np.transpose(img, (2, 0, 1)).astype(np.float32) / 255.0 - 0.5) / 0.5
     assert out.shape == (3, 4, 2)  # torchvision ToTensor: HWC -> CHW
     np.testing.assert_allclose(out, want, atol=1e-6)
     chw = np.ones((3, 4, 4), np.float32)
-    out = np.asarray(vt.Normalize([1.0, 1.0, 0.0], [1.0, 2.0, 4.0])(chw))
+    out = np.asarray(vt.JnpNormalize([1.0, 1.0, 0.0], [1.0, 2.0, 4.0])(chw))
     np.testing.assert_allclose(out[0], 0.0, atol=1e-6)
     np.testing.assert_allclose(out[2], 0.25, atol=1e-6)
-    assert float(np.asarray(vt.Lambda(lambda x: x + 1)(np.zeros(())))) == 1.0
+    # HWC float input: per-channel stats broadcast on the trailing axis
+    hwc = np.ones((4, 4, 3), np.float32)
+    out = np.asarray(vt.JnpNormalize([1.0, 1.0, 0.0], [1.0, 2.0, 4.0])(hwc))
+    np.testing.assert_allclose(out[..., 0], 0.0, atol=1e-6)
+    np.testing.assert_allclose(out[..., 2], 0.25, atol=1e-6)
+    # ToTensor transposes any channel count, not just 1/3/4
+    assert vt.JnpToTensor()(np.zeros((4, 5, 2), np.float32)).shape == (2, 4, 5)
+    assert float(np.asarray(vt.JnpLambda(lambda x: x + 1)(np.zeros(())))) == 1.0
+    # without torchvision the bare names fall back to the Jnp classes
+    try:
+        import torchvision  # noqa: F401
+    except ImportError:
+        assert vt.Compose is vt.JnpCompose and vt.ToTensor is vt.JnpToTensor
