@@ -41,6 +41,14 @@ _tried = False
 
 
 def _compile(dest: str) -> bool:
+    import glob
+
+    for stale in glob.glob(os.path.join(_DIR, "_native_*.so")):
+        if stale != dest:
+            try:
+                os.remove(stale)  # binaries from older sources/naming schemes
+            except OSError:
+                pass
     for cxx in ("g++", "c++", "clang++"):
         try:
             with tempfile.TemporaryDirectory(dir=_DIR) as tmp:

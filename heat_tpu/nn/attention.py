@@ -53,10 +53,11 @@ def _flash_available(q: jax.Array, k: jax.Array) -> bool:
         if len(q.devices()) != 1:
             return False
     except Exception:
-        # Traced values carry no placement; inside jit the kernel is valid
-        # whenever this process drives a single device (the sharded paths go
-        # through ring/ulysses, not here).
-        if jax.local_device_count() != 1:
+        # Traced values carry no placement; inside jit the kernel is only safe
+        # when the whole program runs on one device (no sharding possible —
+        # with more devices a batch-sharded operand could reach the unpartitioned
+        # pallas call, so fall back to dense XLA which shards under GSPMD).
+        if jax.device_count() != 1:
             return False
     return q.dtype in (jnp.float32, jnp.bfloat16)
 

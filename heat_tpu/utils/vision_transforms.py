@@ -18,13 +18,15 @@ except ImportError:  # pragma: no cover - torchvision absent in TPU images
 
 
 def normalize(mean, std):
-    """Returns f(x) = (x - mean) / std (functional form of :class:`JnpNormalize`)."""
-    return JnpNormalize(mean, std)
+    """Returns f(x) = (x - mean) / std — the same class the bare ``Normalize``
+    name resolves to, so pipelines stay torch- or jnp-consistent throughout."""
+    return __getattr__("Normalize")(mean, std)
 
 
 def to_tensor():
-    """Returns the HWC→CHW [0,1] conversion (functional form of :class:`JnpToTensor`)."""
-    return JnpToTensor()
+    """Returns the HWC→CHW [0,1] conversion, consistent with the bare
+    ``ToTensor`` name (torchvision's when installed, jnp-native otherwise)."""
+    return __getattr__("ToTensor")()
 
 
 class JnpCompose:
