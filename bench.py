@@ -54,10 +54,15 @@ def bench_tpu(data_np):
             best = min(best, time.perf_counter() - t0)
         return best
 
-    def steady_rate(step, short=300, long=3000):
+    def steady_rate(step, calib_rate):
         # Steady-state device throughput: difference two dispatch lengths so the
         # fixed per-dispatch cost (host->device RPC; tens of ms on tunneled
-        # runtimes) cancels, leaving pure per-iteration device time.
+        # runtimes) cancels, leaving pure per-iteration device time. Lengths are
+        # sized from the calibration rate so the long leg targets ~4s of device
+        # time on any backend (a CPU fallback at ~10 iters/s measures 40 vs 4
+        # iterations, not a fixed 3000).
+        long = int(np.clip(calib_rate * 4.0, 10, 3000))
+        short = max(1, long // 10)
         t_short = time_once(step, short)
         t_long = time_once(step, long)
         dt = t_long - t_short
@@ -73,7 +78,7 @@ def bench_tpu(data_np):
     # then the winner is measured at steady state
     rates = {name: ITERS / time_once(step, ITERS) for name, step in candidates.items()}
     best = max(rates, key=rates.get)
-    return steady_rate(candidates[best]), f"{dev} [{best}]"
+    return steady_rate(candidates[best], rates[best]), f"{dev} [{best}]"
 
 
 def bench_torch_cpu(data_np, iters=3):

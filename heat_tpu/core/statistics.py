@@ -88,12 +88,7 @@ def average(x, axis=None, weights=None, returned: bool = False):
     w = weights.larray if isinstance(weights, DNDarray) else weights
     axis = stride_tricks.sanitize_axis(x.shape, axis)
     avg, wsum = jnp.average(x.larray, axis=axis, weights=w, returned=True)
-    split = x.split
-    if split is not None:
-        if axis is None or axis == split:
-            split = None
-        elif axis < split:
-            split -= 1
+    split = stride_tricks.reduced_split(x.split, axis)
     res = DNDarray(avg, tuple(avg.shape), types.canonical_heat_type(avg.dtype), split, x.device, x.comm, True)
     if returned:
         wret = DNDarray(
@@ -184,13 +179,7 @@ def __moment(x, axis, keepdims, moment_fn):
     sanitation.sanitize_in(x)
     axis = stride_tricks.sanitize_axis(x.shape, axis)
     res = moment_fn(x.larray, axis)
-    split = x.split
-    if split is not None:
-        axes = range(x.ndim) if axis is None else ((axis,) if isinstance(axis, int) else tuple(axis))
-        if axis is None or split in axes:
-            split = None
-        elif not keepdims:
-            split -= sum(1 for a in axes if a < split)
+    split = stride_tricks.reduced_split(x.split, axis, keepdims)
     return DNDarray(res, tuple(res.shape), types.canonical_heat_type(res.dtype), split, x.device, x.comm, True)
 
 
@@ -282,9 +271,12 @@ def percentile(x, q, axis=None, out=None, interpolation: str = "linear", keepdim
     axis = stride_tricks.sanitize_axis(x.shape, axis)
     qv = q.larray if isinstance(q, DNDarray) else jnp.asarray(q, dtype=jnp.float32)
     res = jnp.percentile(x.larray.astype(jnp.float32), qv, axis=axis, method=interpolation, keepdims=keepdim)
+    # the split axis survives when it is not the reduced axis; a vector q prepends
+    # qv.ndim leading axes, shifting the surviving split accordingly
+    split = stride_tricks.reduced_split(x.split, axis, keepdim, prepend=int(qv.ndim))
     result = DNDarray(
         jnp.asarray(res), tuple(jnp.shape(res)), types.canonical_heat_type(jnp.asarray(res).dtype),
-        None, x.device, x.comm, True,
+        split, x.device, x.comm, True,
     )
     if out is not None:
         sanitation.sanitize_out(out, result.shape, None, x.device)

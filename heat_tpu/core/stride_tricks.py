@@ -31,6 +31,29 @@ def broadcast_shapes(*shapes: Tuple[int, ...]) -> Tuple[int, ...]:
         raise ValueError(f"operands could not be broadcast, input shapes {shapes}")
 
 
+def reduced_split(
+    split: Optional[int],
+    axis: Optional[Union[int, Tuple[int, ...]]],
+    keepdims: bool = False,
+    prepend: int = 0,
+) -> Optional[int]:
+    """
+    The split axis of a reduction's result: ``None`` when the split axis itself is
+    reduced (or a full reduction), otherwise the input split shifted left by the
+    number of reduced axes before it (unless ``keepdims``) and right by ``prepend``
+    leading result axes (e.g. a vector ``q`` in percentile). ``axis`` must already
+    be sanitized (non-negative int, tuple of such, or None).
+    """
+    if split is None:
+        return None
+    axes = (axis,) if isinstance(axis, (int, np.integer)) else axis
+    if axes is None or split in axes:
+        return None
+    if not keepdims:
+        split -= sum(1 for a in axes if a < split)
+    return split + prepend
+
+
 def sanitize_axis(
     shape: Tuple[int, ...], axis: Optional[Union[int, Tuple[int, ...]]]
 ) -> Optional[Union[int, Tuple[int, ...]]]:

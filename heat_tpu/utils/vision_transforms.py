@@ -18,15 +18,18 @@ except ImportError:  # pragma: no cover - torchvision absent in TPU images
 
 
 def normalize(mean, std):
-    """Returns f(x) = (x - mean) / std — the same class the bare ``Normalize``
-    name resolves to, so pipelines stay torch- or jnp-consistent throughout."""
-    return __getattr__("Normalize")(mean, std)
+    """Returns the jnp-native f(x) = (x - mean) / std transform. Unlike the bare
+    ``Normalize`` name (which resolves to torchvision when installed, reference
+    parity), this helper is jnp-in/jnp-out regardless of the environment — a
+    torchvision Normalize would reject jnp/numpy inputs."""
+    return JnpNormalize(mean, std)
 
 
 def to_tensor():
-    """Returns the HWC→CHW [0,1] conversion, consistent with the bare
-    ``ToTensor`` name (torchvision's when installed, jnp-native otherwise)."""
-    return __getattr__("ToTensor")()
+    """Returns the jnp-native HWC→CHW [0,1] conversion. Unlike the bare
+    ``ToTensor`` name, always accepts numpy/jnp arrays (torchvision's rejects
+    them)."""
+    return JnpToTensor()
 
 
 class JnpCompose:
