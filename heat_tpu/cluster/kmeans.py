@@ -138,20 +138,11 @@ class KMeans(_KCluster):
         self._initialize_cluster_centers(x)
         centers = self._cluster_centers.larray
         data = x.larray
-        # fused single-pass pallas step on a single real TPU; sharded/CPU data keeps
-        # the two-GEMM XLA step (whose psum the sharding inserts)
-        from ._pallas import fused_step_available, kmeans_step_fused
-
-        if (
-            fused_step_available(data.shape[0], data.shape[1], self.n_clusters)
-            and data.dtype == jnp.float32
-            and len(data.devices()) == 1
-        ):
-            step = kmeans_step_fused
-        else:
-            step = _kmeans_step
+        # the two-GEMM XLA step wins at every measured shape (the fused pallas
+        # kernel in _pallas.py loses ~6x on v5e — see its module docstring), and
+        # on sharded data XLA inserts the psum over the sample axis
         centers, labels, inertia, n_iter = _kmeans_fit_loop(
-            data, centers, step, self.max_iter, float(self.tol)
+            data, centers, _kmeans_step, self.max_iter, float(self.tol)
         )
         self._cluster_centers = ht.array(centers, device=x.device, comm=x.comm)
         self._labels = ht.array(labels, split=x.split, device=x.device, comm=x.comm)
