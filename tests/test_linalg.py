@@ -131,3 +131,42 @@ def test_svd():
     np.testing.assert_allclose(s.numpy(), np.linalg.svd(a, compute_uv=False), rtol=1e-4)
     s_only = ht.linalg.svd(ht.array(a), compute_uv=False)
     np.testing.assert_allclose(s_only.numpy(), np.linalg.svd(a, compute_uv=False), rtol=1e-4)
+
+
+def test_svd_wide_split1():
+    # wide column-split input takes the transpose trick; Vh stays column-split
+    rng = np.random.default_rng(10)
+    a = rng.normal(size=(4, 32)).astype(np.float32)
+    h = ht.array(a, split=1)
+    u, s, vh = ht.linalg.svd(h)
+    assert u.shape == (4, 4) and s.shape == (4,) and vh.shape == (4, 32)
+    assert vh.split == 1
+    np.testing.assert_allclose(
+        u.numpy() @ np.diag(s.numpy()) @ vh.numpy(), a, atol=1e-3
+    )
+    np.testing.assert_allclose(s.numpy(), np.linalg.svd(a, compute_uv=False), rtol=1e-4)
+    # orthonormality of both factors
+    np.testing.assert_allclose(u.numpy().T @ u.numpy(), np.eye(4), atol=1e-4)
+    np.testing.assert_allclose(vh.numpy() @ vh.numpy().T, np.eye(4), atol=1e-4)
+
+
+def test_rsvd():
+    rng = np.random.default_rng(11)
+    # low-rank + noise: exact rank-r structure dominates
+    r = 5
+    base = rng.normal(size=(256, r)).astype(np.float32) @ rng.normal(size=(r, 48)).astype(np.float32)
+    a = base + 1e-4 * rng.normal(size=(256, 48)).astype(np.float32)
+    h = ht.array(a, split=0)
+    u, s, vh = ht.linalg.rsvd(h, rank=r, n_iter=3, random_state=0)
+    assert u.shape == (256, r) and s.shape == (r,) and vh.shape == (r, 48)
+    assert u.split == 0  # factor stays row-distributed
+    recon = u.numpy() @ np.diag(s.numpy()) @ vh.numpy()
+    np.testing.assert_allclose(recon, a, atol=5e-2)
+    np.testing.assert_allclose(
+        s.numpy(), np.linalg.svd(a, compute_uv=False)[:r], rtol=1e-2
+    )
+    np.testing.assert_allclose(u.numpy().T @ u.numpy(), np.eye(r), atol=1e-3)
+    with pytest.raises(ValueError):
+        ht.linalg.rsvd(h, rank=0)
+    with pytest.raises(ValueError):
+        ht.linalg.rsvd(ht.array(a[0]), rank=2)
