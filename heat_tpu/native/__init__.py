@@ -11,6 +11,14 @@ The shared library is compiled on first use with the system C++ toolchain and
 cached next to the sources (wheel-less deployment; zero install-time deps). Every
 consumer treats the native path as an optional fast path and falls back to pure
 Python/NumPy when the toolchain is unavailable.
+
+Components:
+
+* ``parse_csv`` — threaded CSV parser behind ``ht.load_csv`` (reference
+  io.py:713-925's byte-range line-aligned split, as native threads).
+* ``SlabPrefetcher`` — threaded ordered byte-range reader feeding the input
+  pipeline (the reference's Python ``queue_thread`` prefetch,
+  partial_dataset.py:20-230, without the GIL on the read path).
 """
 
 from __future__ import annotations
@@ -25,15 +33,18 @@ import threading
 
 import numpy as np
 
-__all__ = ["available", "parse_csv"]
+__all__ = ["available", "parse_csv", "SlabPrefetcher"]
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "_csv.cpp")
+_SOURCES = [os.path.join(_DIR, "_csv.cpp"), os.path.join(_DIR, "_prefetch.cpp")]
 
 
 def _src_digest() -> str:
-    with open(_SRC, "rb") as f:
-        return hashlib.sha256(f.read()).hexdigest()[:12]
+    h = hashlib.sha256()
+    for src in _SOURCES:
+        with open(src, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:12]
 
 _lock = threading.Lock()
 _lib = None
@@ -55,7 +66,7 @@ def _compile(dest: str) -> bool:
                 tmp_so = os.path.join(tmp, "lib.so")
                 proc = subprocess.run(
                     [cxx, "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
-                     _SRC, "-o", tmp_so],
+                     *_SOURCES, "-o", tmp_so],
                     capture_output=True,
                     timeout=120,
                 )
@@ -73,11 +84,13 @@ def _load():
         if _tried:
             return _lib
         _tried = True
-        # The source digest in the cache name ties the binary to the exact C ABI;
-        # a stale .so from older sources can never be loaded (mtime is unreliable
-        # across tar/rsync extraction).
-        dest = os.path.join(_DIR, f"_native_{sys.platform}_{_src_digest()}.so")
         try:
+            # The source digest in the cache name ties the binary to the exact
+            # C ABI; a stale .so from older sources can never be loaded (mtime
+            # is unreliable across tar/rsync extraction). Inside the try: a
+            # checkout without the .cpp sources must degrade to the Python
+            # path, not raise out of available().
+            dest = os.path.join(_DIR, f"_native_{sys.platform}_{_src_digest()}.so")
             if not os.path.exists(dest):
                 if not _compile(dest):
                     return None
@@ -96,6 +109,18 @@ def _load():
                 ctypes.POINTER(ctypes.c_int64),
             ]
             lib.ht_csv_parse.restype = ctypes.c_int
+            lib.ht_prefetch_open.argtypes = [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+            ]
+            lib.ht_prefetch_open.restype = ctypes.c_void_p
+            lib.ht_prefetch_next.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ]
+            lib.ht_prefetch_next.restype = ctypes.c_int64
+            lib.ht_prefetch_close.argtypes = [ctypes.c_void_p]
+            lib.ht_prefetch_close.restype = None
             _lib = lib
         except OSError:
             _lib = None
@@ -135,3 +160,89 @@ def parse_csv(raw: bytes, sep: str, header_lines: int):
     if rc != 0:
         return None
     return out
+
+
+class SlabPrefetcher:
+    """
+    Ordered background reader of byte ranges from one file using native threads.
+
+    ``next_into(buf)`` blocks until the next slab (in submission order) has been
+    read, copies it into ``buf`` and returns the byte count; ``None`` marks the
+    end. The ring depth bounds memory: at most ``depth`` slabs are resident.
+    Single-consumer; use as a context manager or call :meth:`close`.
+
+    Raises RuntimeError when the native library is unavailable — callers gate on
+    :func:`available` and keep a Python fallback (see
+    ``utils/data/partial_dataset.py``).
+    """
+
+    def __init__(self, path: str, offsets, lengths, depth: int = 4, nthreads: int = 2):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+        lengths = np.ascontiguousarray(lengths, dtype=np.int64)
+        if offsets.shape != lengths.shape or offsets.ndim != 1:
+            raise ValueError("offsets and lengths must be equal-length 1-D sequences")
+        if (offsets < 0).any() or (lengths < 0).any():
+            raise ValueError("offsets and lengths must be non-negative")
+        self._lib = lib
+        self._n = len(offsets)
+        self._max_len = int(lengths.max()) if self._n else 0
+        self._handle = lib.ht_prefetch_open(
+            os.fsencode(path),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            self._n,
+            int(depth),
+            int(nthreads),
+        )
+        if not self._handle:
+            raise RuntimeError(f"could not open {path!r} for prefetch")
+
+    def next_into(self, buf) -> int | None:
+        """Copy the next slab into ``buf`` (writable buffer); returns the byte
+        count, or None when all slabs have been delivered."""
+        if self._handle is None:
+            raise RuntimeError("prefetcher is closed")
+        mv = memoryview(buf)
+        if mv.readonly:
+            raise ValueError("buf must be writable")
+        cap = mv.nbytes
+        dest = (ctypes.c_char * cap).from_buffer(mv.cast("B"))
+        rc = self._lib.ht_prefetch_next(self._handle, dest, cap)
+        if rc == -1:
+            return None
+        if rc == -2:
+            raise IOError("prefetch read failed (truncated file or IO error)")
+        if rc == -3:
+            raise ValueError(f"destination buffer too small (needs {self._max_len} bytes)")
+        if rc == -4:
+            raise RuntimeError("prefetcher closed concurrently")
+        return int(rc)
+
+    def __iter__(self):
+        buf = np.empty(self._max_len, dtype=np.uint8)
+        while True:
+            n = self.next_into(buf)
+            if n is None:
+                return
+            yield bytes(buf[:n])
+
+    def close(self) -> None:
+        """Join the worker threads and release the ring buffers."""
+        if self._handle is not None:
+            self._lib.ht_prefetch_close(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,175 @@
+// Threaded slab prefetcher: reads an ordered list of (offset, length) byte
+// ranges from a file into a bounded ring of buffers using native worker
+// threads, delivering slabs to the consumer strictly in order.
+//
+// Role in the framework: the host-side IO runtime feeding the TPU input
+// pipeline (the reference's out-of-core HDF5 path, heat
+// utils/data/partial_dataset.py:20-230, does this with Python threads that
+// serialize on the GIL for every byte; here the reads run as plain pread(2)
+// with the GIL released, so disk latency overlaps Python-side work and device
+// puts). Exposed through a plain C ABI for ctypes — no pybind11.
+//
+// Concurrency design: workers claim slab ordinals from an atomic counter and
+// write into slot (ordinal % depth); a slot is reusable once the consumer has
+// copied the previous occupant out. Consumer-side ht_prefetch_next() blocks
+// until the next ordinal's slot is filled, copies into the caller's buffer,
+// frees the slot. Errors are per-slab and surface on the consuming call.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <unistd.h>
+
+namespace {
+
+struct Prefetcher {
+  int fd = -1;
+  std::vector<int64_t> offsets;
+  std::vector<int64_t> lengths;
+  int depth = 0;
+
+  std::vector<std::vector<char>> ring;
+  // state per ring slot ordinal: filled[i % depth] corresponds to ordinal
+  // slot_owner[s]; -1 = empty
+  std::vector<int64_t> slot_owner;
+  std::vector<int64_t> slot_bytes;  // -1 = read error
+
+  std::atomic<int64_t> next_claim{0};
+  int64_t next_consume = 0;
+  bool closed = false;
+  bool consumer_active = false;
+
+  std::mutex mu;
+  std::condition_variable cv_filled;
+  std::condition_variable cv_free;
+  std::condition_variable cv_consumer_done;
+  std::vector<std::thread> workers;
+
+  int64_t nslabs() const { return static_cast<int64_t>(offsets.size()); }
+};
+
+void worker_loop(Prefetcher* p) {
+  for (;;) {
+    const int64_t i = p->next_claim.fetch_add(1);
+    if (i >= p->nslabs()) return;
+    const int slot = static_cast<int>(i % p->depth);
+    {
+      std::unique_lock<std::mutex> lk(p->mu);
+      // empty slot alone is not enough: ordinal i may only take its slot once
+      // consumption has advanced past i - depth, else a later ordinal could
+      // reserve the slot ahead of an earlier one and deadlock the in-order
+      // consumer
+      p->cv_free.wait(lk, [&] {
+        return p->closed ||
+               (p->slot_owner[slot] == -1 && i - p->next_consume < p->depth);
+      });
+      if (p->closed) return;
+      p->slot_owner[slot] = i;  // reserve while reading
+      p->slot_bytes[slot] = -2; // in flight
+    }
+    const int64_t len = p->lengths[i];
+    std::vector<char>& buf = p->ring[slot];
+    if (static_cast<int64_t>(buf.size()) < len) buf.resize(len);
+    int64_t done = 0;
+    bool ok = true;
+    while (done < len) {
+      const ssize_t r = pread(p->fd, buf.data() + done, len - done, p->offsets[i] + done);
+      if (r <= 0) { ok = false; break; }
+      done += r;
+    }
+    {
+      std::lock_guard<std::mutex> lk(p->mu);
+      p->slot_bytes[slot] = ok ? len : -1;
+      p->cv_filled.notify_all();
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ht_prefetch_open(const char* path, const int64_t* offsets,
+                       const int64_t* lengths, int64_t nslabs, int depth,
+                       int nthreads) {
+  if (nslabs < 0 || depth < 1 || nthreads < 1) return nullptr;
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  auto* p = new Prefetcher();
+  p->fd = fd;
+  p->offsets.assign(offsets, offsets + nslabs);
+  p->lengths.assign(lengths, lengths + nslabs);
+  p->depth = depth;
+  p->ring.resize(depth);
+  p->slot_owner.assign(depth, -1);
+  p->slot_bytes.assign(depth, -2);
+  if (nthreads > depth) nthreads = depth;  // more workers than slots can deadlock-spin
+  for (int t = 0; t < nthreads; ++t) p->workers.emplace_back(worker_loop, p);
+  return p;
+}
+
+// Returns: bytes copied (>=0), -1 after the last slab, -2 on read error,
+// -3 if dest_cap is too small (the slab stays consumable), -4 if the
+// prefetcher was closed concurrently. Single consumer.
+int64_t ht_prefetch_next(void* handle, char* dest, int64_t dest_cap) {
+  auto* p = static_cast<Prefetcher*>(handle);
+  std::unique_lock<std::mutex> lk(p->mu);
+  if (p->closed) return -4;
+  if (p->next_consume >= p->nslabs()) return -1;
+  const int slot = static_cast<int>(p->next_consume % p->depth);
+  // consumer_active handshake: ht_prefetch_close must not free the mutex this
+  // thread sleeps on; it waits for the consumer to observe `closed` and leave
+  p->consumer_active = true;
+  p->cv_filled.wait(lk, [&] {
+    return p->closed ||
+           (p->slot_owner[slot] == p->next_consume && p->slot_bytes[slot] != -2);
+  });
+  int64_t result;
+  if (p->closed) {
+    result = -4;
+  } else {
+    const int64_t bytes = p->slot_bytes[slot];
+    if (bytes == -1) {
+      result = -2;
+    } else if (bytes > dest_cap) {
+      result = -3;
+    } else {
+      memcpy(dest, p->ring[slot].data(), bytes);
+      p->slot_owner[slot] = -1;
+      p->next_consume++;
+      p->cv_free.notify_all();
+      result = bytes;
+    }
+  }
+  p->consumer_active = false;
+  p->cv_consumer_done.notify_all();
+  return result;
+}
+
+void ht_prefetch_close(void* handle) {
+  auto* p = static_cast<Prefetcher*>(handle);
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    p->closed = true;
+    p->cv_free.notify_all();
+    p->cv_filled.notify_all();
+    // a consumer blocked in ht_prefetch_next still sleeps on this mutex;
+    // deleting p under it would be use-after-free — wait it out
+    p->cv_consumer_done.wait(lk, [&] { return !p->consumer_active; });
+  }
+  // drain claims so workers waiting on ordinals past the end exit
+  p->next_claim.store(p->nslabs());
+  for (auto& t : p->workers) {
+    if (t.joinable()) t.join();
+  }
+  close(p->fd);
+  delete p;
+}
+
+}  // extern "C"

@@ -97,29 +97,96 @@ class PartialH5Dataset:
             self.total_size = f[self.dataset_names[0]].shape[0]
             self.loads_needed = max(1, -(-self.total_size // load_length))
             window = {}
+            meta = {}
             for name in self.dataset_names:
-                window[name] = np.asarray(f[name][: min(initial_load, self.total_size)])
+                ds = f[name]
+                window[name] = np.asarray(ds[: min(initial_load, self.total_size)])
+                # contiguous, uncompressed datasets expose a flat byte layout the
+                # native prefetcher can pread directly (bypassing h5py + the GIL
+                # on the background read path)
+                offset = ds.id.get_offset()
+                if offset is not None and ds.chunks is None and ds.compression is None:
+                    meta[name] = (offset, np.dtype(ds.dtype), tuple(ds.shape[1:]))
         self._window = window
         self.next_start = min(initial_load, self.total_size)
+        self._prefetchers = self.__build_prefetchers(meta)
         self.load_queue: queue.Queue = queue.Queue()
         self.load_thread = threading.Thread(target=queue_thread, args=(self.load_queue,), daemon=True)
         self.load_thread.start()
         self.epoch_end = False
 
+    def __build_prefetchers(self, meta):
+        """One native SlabPrefetcher per contiguous dataset, covering every
+        remaining load window in order (None when the native path is out)."""
+        from ... import native
+
+        if not meta or len(meta) != len(self.dataset_names) or not native.available():
+            return None
+        starts = list(range(self.next_start, self.total_size, self.load_len))
+        if not starts:
+            return None
+        prefetchers = {}
+        try:
+            for name, (base, dtype, row_shape) in meta.items():
+                rowbytes = int(dtype.itemsize * np.prod(row_shape, dtype=np.int64)) if row_shape else dtype.itemsize
+                offsets = [base + s * rowbytes for s in starts]
+                lengths = [
+                    (min(s + self.load_len, self.total_size) - s) * rowbytes for s in starts
+                ]
+                prefetchers[name] = (
+                    native.SlabPrefetcher(self.file, offsets, lengths, depth=2, nthreads=2),
+                    dtype,
+                    row_shape,
+                )
+        except (RuntimeError, OSError):
+            for p, _, _ in prefetchers.values():
+                p.close()
+            return None
+        return prefetchers
+
     def _load_next(self) -> None:
         """Background fetch of the next window slab (reference
-        partial_dataset.py:120-180)."""
+        partial_dataset.py:120-180). Served by the native prefetcher when the
+        HDF5 layout allows, h5py otherwise."""
         start = self.next_start
         end = min(start + self.load_len, self.total_size)
         if start >= self.total_size:
             self.epoch_end = True
             return
+        if self._prefetchers is not None:
+            # stage every dataset's slab before advancing any window, so a
+            # failure mid-loop cannot leave data/labels misaligned; any native
+            # error (short read, IO error, closed) demotes to the h5py path
+            slabs = {}
+            try:
+                for name in self.dataset_names:
+                    pf, dtype, row_shape = self._prefetchers[name]
+                    slab = np.empty((end - start,) + row_shape, dtype=dtype)
+                    if pf.next_into(slab) != slab.nbytes:
+                        raise IOError("prefetch exhausted early")
+                    slabs[name] = slab
+            except (IOError, ValueError, RuntimeError):
+                self.__close_prefetchers()
+                return self._load_next()
+            for name in self.dataset_names:
+                self.__advance_window(name, slabs[name])
+            self.next_start = end
+            return
         with h5py.File(self.file, "r") as f:
             for name in self.dataset_names:
                 slab = np.asarray(f[name][start:end])
-                self._window[name] = np.concatenate([self._window[name][self.load_len:], slab], axis=0) \
-                    if self._window[name].shape[0] >= self.load_len else slab
+                self.__advance_window(name, slab)
         self.next_start = end
+
+    def __advance_window(self, name: str, slab: np.ndarray) -> None:
+        self._window[name] = np.concatenate([self._window[name][self.load_len:], slab], axis=0) \
+            if self._window[name].shape[0] >= self.load_len else slab
+
+    def __close_prefetchers(self) -> None:
+        if self._prefetchers is not None:
+            for p, _, _ in self._prefetchers.values():
+                p.close()
+            self._prefetchers = None
 
     def load_next_group(self) -> None:
         """Enqueue the next background load (reference partial_dataset.py Convert)."""
@@ -148,9 +215,10 @@ class PartialH5Dataset:
         self.load_queue.put((self.Shuffle, ()))
 
     def close(self) -> None:
-        """Stop the background thread."""
+        """Stop the background thread and release any native prefetcher."""
         self.load_queue.put(None)
         self.load_thread.join(timeout=5)
+        self.__close_prefetchers()
 
 
 class PartialH5DataLoaderIter:

@@ -61,3 +61,119 @@ def test_load_csv_uses_native_and_agrees(tmp_path):
     # latin-1 encoding forces the Python fallback; results agree
     b = ht.load_csv(str(p), sep=";", split=0, encoding="latin-1")
     np.testing.assert_allclose(b.numpy(), a.numpy())
+
+
+# ---------------------------------------------------------------- SlabPrefetcher
+
+
+def test_prefetch_ordered_delivery(tmp_path):
+    rng = np.random.default_rng(2)
+    blob = rng.integers(0, 256, size=100_000, dtype=np.uint8).tobytes()
+    p = tmp_path / "blob.bin"
+    p.write_bytes(blob)
+    # random non-overlapping-ish slabs, deliberately more slabs than ring depth
+    offsets, lengths = [], []
+    pos = 0
+    while pos + 500 < len(blob):
+        ln = int(rng.integers(1, 4000))
+        ln = min(ln, len(blob) - pos)
+        offsets.append(pos)
+        lengths.append(ln)
+        pos += ln
+    with native.SlabPrefetcher(str(p), offsets, lengths, depth=3, nthreads=2) as pf:
+        got = list(pf)
+    assert len(got) == len(offsets)
+    for o, l, g in zip(offsets, lengths, got):
+        assert g == blob[o : o + l]
+
+
+def test_prefetch_next_into_and_reuse(tmp_path):
+    p = tmp_path / "x.bin"
+    data = bytes(range(256)) * 16
+    p.write_bytes(data)
+    offsets = [0, 1024, 2048, 3072]
+    lengths = [1024] * 4
+    buf = np.empty(1024, dtype=np.uint8)
+    with native.SlabPrefetcher(str(p), offsets, lengths, depth=2, nthreads=4) as pf:
+        for o in offsets:
+            n = pf.next_into(buf)
+            assert n == 1024
+            assert buf.tobytes() == data[o : o + 1024]
+        assert pf.next_into(buf) is None
+        assert pf.next_into(buf) is None  # idempotent at end
+
+
+def test_prefetch_errors(tmp_path):
+    with pytest.raises(RuntimeError):
+        native.SlabPrefetcher(str(tmp_path / "missing.bin"), [0], [4])
+    p = tmp_path / "short.bin"
+    p.write_bytes(b"abcd")
+    # slab reaches past EOF: surfaced as IOError on the consuming call
+    pf = native.SlabPrefetcher(str(p), [0, 2], [4, 100], depth=2)
+    buf = np.empty(128, dtype=np.uint8)
+    assert pf.next_into(buf) == 4
+    with pytest.raises(IOError):
+        pf.next_into(buf)
+    pf.close()
+    with pytest.raises(ValueError):
+        native.SlabPrefetcher(str(p), [0], [-1])
+    with pytest.raises(ValueError):
+        native.SlabPrefetcher(str(p), [0, 1], [1])
+    # too-small destination
+    pf = native.SlabPrefetcher(str(p), [0], [4])
+    with pytest.raises(ValueError):
+        pf.next_into(np.empty(2, dtype=np.uint8))
+    pf.close()
+
+
+def test_prefetch_early_close_no_hang(tmp_path):
+    p = tmp_path / "y.bin"
+    p.write_bytes(b"\0" * 65536)
+    pf = native.SlabPrefetcher(str(p), list(range(0, 65536, 1024)), [1024] * 64, depth=2)
+    buf = np.empty(1024, dtype=np.uint8)
+    assert pf.next_into(buf) == 1024
+    pf.close()  # workers blocked on ring slots must exit promptly
+    with pytest.raises(RuntimeError):
+        pf.next_into(buf)
+
+
+def test_partial_h5_native_path_agrees(tmp_path):
+    h5py = pytest.importorskip("h5py")
+    from heat_tpu.utils.data.partial_dataset import PartialH5Dataset, PartialH5DataLoaderIter
+
+    rng = np.random.default_rng(3)
+    data = rng.normal(size=(600, 5)).astype(np.float32)
+    labels = rng.integers(0, 10, size=(600,)).astype(np.int64)
+    f = tmp_path / "train.h5"
+    with h5py.File(f, "w") as h:
+        h.create_dataset("data", data=data)  # contiguous, uncompressed
+        h.create_dataset("labels", data=labels)
+    ds = PartialH5Dataset(str(f), dataset_names=["data", "labels"], initial_load=200, load_length=100)
+    assert ds._prefetchers is not None  # native path engaged
+    np.testing.assert_array_equal(ds[0:4][0], data[0:4])
+    # three loads walk the window forward exactly like the h5py path
+    for _ in range(3):
+        ds._load_next()
+    # equality against a pure-h5py reference dataset driven identically
+    ds2 = PartialH5Dataset(str(f), dataset_names=["data", "labels"], initial_load=200, load_length=100)
+    ds2._prefetchers = None  # force h5py path
+    for _ in range(3):
+        ds2._load_next()
+    np.testing.assert_array_equal(ds._window["data"], ds2._window["data"])
+    np.testing.assert_array_equal(ds._window["labels"], ds2._window["labels"])
+    ds.close()
+    ds2.close()
+
+
+def test_partial_h5_compressed_falls_back(tmp_path):
+    h5py = pytest.importorskip("h5py")
+    from heat_tpu.utils.data.partial_dataset import PartialH5Dataset
+
+    f = tmp_path / "c.h5"
+    with h5py.File(f, "w") as h:
+        h.create_dataset("data", data=np.arange(100.0).reshape(50, 2), compression="gzip")
+    ds = PartialH5Dataset(str(f), dataset_names=["data"], initial_load=20, load_length=10)
+    assert ds._prefetchers is None  # chunked/compressed layout: h5py path
+    ds._load_next()
+    np.testing.assert_array_equal(ds._window["data"][-10:], np.arange(40.0, 60.0).reshape(10, 2))
+    ds.close()
