@@ -188,7 +188,10 @@ class SlabPrefetcher:
             raise ValueError("offsets and lengths must be non-negative")
         self._lib = lib
         self._n = len(offsets)
+        self._lengths = lengths
+        self._delivered = 0
         self._max_len = int(lengths.max()) if self._n else 0
+        self._close_lock = threading.Lock()
         self._handle = lib.ht_prefetch_open(
             os.fsencode(path),
             offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
@@ -216,9 +219,11 @@ class SlabPrefetcher:
         if rc == -2:
             raise IOError("prefetch read failed (truncated file or IO error)")
         if rc == -3:
-            raise ValueError(f"destination buffer too small (needs {self._max_len} bytes)")
+            needed = int(self._lengths[self._delivered]) if self._delivered < self._n else cap
+            raise ValueError(f"destination buffer too small (needs {needed} bytes)")
         if rc == -4:
             raise RuntimeError("prefetcher closed concurrently")
+        self._delivered += 1
         return int(rc)
 
     def __iter__(self):
@@ -230,10 +235,13 @@ class SlabPrefetcher:
             yield bytes(buf[:n])
 
     def close(self) -> None:
-        """Join the worker threads and release the ring buffers."""
-        if self._handle is not None:
-            self._lib.ht_prefetch_close(self._handle)
-            self._handle = None
+        """Join the worker threads and release the ring buffers. Thread-safe and
+        idempotent: concurrent callers race on the handle under a lock, so
+        ``ht_prefetch_close`` runs exactly once."""
+        with self._close_lock:
+            handle, self._handle = self._handle, None
+        if handle is not None:
+            self._lib.ht_prefetch_close(handle)
 
     def __enter__(self):
         return self

@@ -140,7 +140,13 @@ int64_t ht_prefetch_next(void* handle, char* dest, int64_t dest_cap) {
     } else if (bytes > dest_cap) {
       result = -3;
     } else {
+      // The slot is reserved for this consumer (owner == next_consume, not in
+      // flight), so the copy can run unlocked — workers keep posting
+      // completions and claiming slabs instead of stalling behind a multi-MB
+      // memcpy. close() still waits on consumer_active before freeing.
+      lk.unlock();
       memcpy(dest, p->ring[slot].data(), bytes);
+      lk.lock();
       p->slot_owner[slot] = -1;
       p->next_consume++;
       p->cv_free.notify_all();

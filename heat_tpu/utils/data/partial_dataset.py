@@ -218,6 +218,12 @@ class PartialH5Dataset:
         """Stop the background thread and release any native prefetcher."""
         self.load_queue.put(None)
         self.load_thread.join(timeout=5)
+        if self.load_thread.is_alive():
+            # A slow queued load is still running and may be inside a
+            # prefetcher call; freeing the native handles under it would be a
+            # use-after-free. Wait for the drain sentinel instead of a bounded
+            # timeout (SlabPrefetcher.close itself is idempotent/thread-safe).
+            self.load_thread.join()
         self.__close_prefetchers()
 
 
