@@ -1,0 +1,13 @@
+"""Shared benchmark helpers."""
+
+import jax.numpy as jnp
+
+
+def sync(arr) -> float:
+    """Materialization barrier: fetch one element of ``arr``.
+
+    ``jax.block_until_ready`` alone can return before deferred remote execution
+    actually runs (observed on the axon TPU tunnel); a value fetch cannot — the
+    scalar transfer forces the producing computation to finish.
+    """
+    return float(jnp.ravel(arr)[0])

@@ -17,6 +17,8 @@ Run: python benchmarks/allreduce_bandwidth_bench.py [--sizes-mb 1 8 64 256] [--t
 
 import argparse
 import json
+import os
+import sys
 import time
 
 import numpy as np
@@ -24,6 +26,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import sync as _sync
 
 
 def bench_size(mesh, n_bytes, trials):
@@ -44,13 +49,12 @@ def bench_size(mesh, n_bytes, trials):
             out_specs=P("d", None),
         )(x)
 
-    out = allreduce(x)
-    jax.block_until_ready(out)  # compile + warmup
+    _sync(allreduce(x))  # compile + warmup
     best = float("inf")
     for _ in range(trials):
         t0 = time.perf_counter()
         out = allreduce(x)
-        jax.block_until_ready(out)
+        _sync(out)
         best = min(best, time.perf_counter() - t0)
     eff_bytes = 2 * (p - 1) / p * (local * p * 4) if p > 1 else local * 4 * 2
     return eff_bytes / best / 1e9

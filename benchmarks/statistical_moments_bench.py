@@ -10,18 +10,21 @@ import argparse
 import json
 import time
 
-import numpy as np
-import jax
+import os
+import sys
 
 import heat_tpu as ht
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import sync as _sync
+
 
 def timeit(fn, trials):
-    fn()  # warmup/compile
+    _sync(fn().larray)  # warmup/compile
     times = []
     for _ in range(trials):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn().larray)
+        _sync(fn().larray)
         times.append(time.perf_counter() - t0)
     return sorted(times)[len(times) // 2]
 

@@ -119,6 +119,8 @@ def _load():
                 ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
             ]
             lib.ht_prefetch_next.restype = ctypes.c_int64
+            lib.ht_prefetch_cancel.argtypes = [ctypes.c_void_p]
+            lib.ht_prefetch_cancel.restype = None
             lib.ht_prefetch_close.argtypes = [ctypes.c_void_p]
             lib.ht_prefetch_close.restype = None
             _lib = lib
@@ -191,7 +193,12 @@ class SlabPrefetcher:
         self._lengths = lengths
         self._delivered = 0
         self._max_len = int(lengths.max()) if self._n else 0
-        self._close_lock = threading.Lock()
+        # close/consume lifecycle: _cond guards _handle/_closing/_inflight.
+        # close() cancels (wakes blocked consumers), drains in-flight consumers,
+        # then frees — so ht_prefetch_next can never run on a freed handle.
+        self._cond = threading.Condition()
+        self._closing = False
+        self._inflight = 0
         self._handle = lib.ht_prefetch_open(
             os.fsencode(path),
             offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
@@ -206,14 +213,22 @@ class SlabPrefetcher:
     def next_into(self, buf) -> int | None:
         """Copy the next slab into ``buf`` (writable buffer); returns the byte
         count, or None when all slabs have been delivered."""
-        if self._handle is None:
-            raise RuntimeError("prefetcher is closed")
-        mv = memoryview(buf)
-        if mv.readonly:
-            raise ValueError("buf must be writable")
-        cap = mv.nbytes
-        dest = (ctypes.c_char * cap).from_buffer(mv.cast("B"))
-        rc = self._lib.ht_prefetch_next(self._handle, dest, cap)
+        with self._cond:
+            if self._handle is None or self._closing:
+                raise RuntimeError("prefetcher is closed")
+            handle = self._handle
+            self._inflight += 1
+        try:
+            mv = memoryview(buf)
+            if mv.readonly:
+                raise ValueError("buf must be writable")
+            cap = mv.nbytes
+            dest = (ctypes.c_char * cap).from_buffer(mv.cast("B"))
+            rc = self._lib.ht_prefetch_next(handle, dest, cap)
+        finally:
+            with self._cond:
+                self._inflight -= 1
+                self._cond.notify_all()
         if rc == -1:
             return None
         if rc == -2:
@@ -236,12 +251,27 @@ class SlabPrefetcher:
 
     def close(self) -> None:
         """Join the worker threads and release the ring buffers. Thread-safe and
-        idempotent: concurrent callers race on the handle under a lock, so
-        ``ht_prefetch_close`` runs exactly once."""
-        with self._close_lock:
-            handle, self._handle = self._handle, None
-        if handle is not None:
-            self._lib.ht_prefetch_close(handle)
+        idempotent. Two phases: cancel (wakes any consumer blocked in
+        ``ht_prefetch_next``), drain in-flight consumers, then free — a consumer
+        that snapshotted the handle but has not yet entered the C call gets -4
+        instead of a dangling pointer."""
+        with self._cond:
+            if self._handle is None:
+                return
+            if self._closing:  # another closer is mid-flight; wait it out
+                while self._handle is not None:
+                    self._cond.wait()
+                return
+            self._closing = True
+            handle = self._handle
+        self._lib.ht_prefetch_cancel(handle)
+        with self._cond:
+            while self._inflight:
+                self._cond.wait()
+        self._lib.ht_prefetch_close(handle)
+        with self._cond:
+            self._handle = None
+            self._cond.notify_all()
 
     def __enter__(self):
         return self

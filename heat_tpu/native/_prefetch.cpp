@@ -158,6 +158,19 @@ int64_t ht_prefetch_next(void* handle, char* dest, int64_t dest_cap) {
   return result;
 }
 
+// Phase one of a two-phase shutdown: mark closed and wake everyone, without
+// freeing. A consumer entering ht_prefetch_next after this sees `closed` and
+// returns -4 immediately; the Python wrapper drains in-flight consumers between
+// cancel and close so ht_prefetch_close never races a consumer that holds the
+// pointer but has not yet entered.
+void ht_prefetch_cancel(void* handle) {
+  auto* p = static_cast<Prefetcher*>(handle);
+  std::lock_guard<std::mutex> lk(p->mu);
+  p->closed = true;
+  p->cv_free.notify_all();
+  p->cv_filled.notify_all();
+}
+
 void ht_prefetch_close(void* handle) {
   auto* p = static_cast<Prefetcher*>(handle);
   {

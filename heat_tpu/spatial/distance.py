@@ -31,31 +31,64 @@ __all__ = ["cdist", "manhattan", "rbf"]
 
 # ----------------------------------------------------------------- metric kernels
 # (reference distance.py:16-135; jnp versions, fused by XLA)
+
+# Upper bound on elements of the (rows, n, f) difference tensor a single exact-metric
+# step may materialize (HBM working set ≈ 4 bytes × this). The exact metrics tile
+# their row axis so compilation never plans an O(m·n·f) buffer.
+_EXACT_TILE_ELEMS = 1 << 27
+
+
+def _row_blocked(tile_fn: Callable, x: jax.Array, y: jax.Array) -> jax.Array:
+    """Apply a pairwise tile metric over row blocks of ``x`` via ``lax.map`` so the
+    3-D broadcast intermediate stays bounded (the reference streams tiles through
+    its ring for the same reason, distance.py:279-346)."""
+    m, f = x.shape
+    n = y.shape[0]
+    if m * n * f <= _EXACT_TILE_ELEMS:
+        return tile_fn(x, y)
+    b = max(1, _EXACT_TILE_ELEMS // (n * f))
+    nblocks = -(-m // b)
+    pad = nblocks * b - m
+    xp = jnp.pad(x, ((0, pad), (0, 0))) if pad else x
+    tiles = jax.lax.map(lambda xb: tile_fn(xb, y), xp.reshape(nblocks, b, f))
+    out = tiles.reshape(nblocks * b, n)
+    return out[:m] if pad else out
+
+
 def _euclidian(x: jax.Array, y: jax.Array) -> jax.Array:
     """Pairwise Euclidean distance between row sets, exact differences (reference
-    distance.py:16-30)."""
-    return jnp.sqrt(jnp.sum((x[:, None, :] - y[None, :, :]) ** 2, axis=-1))
+    distance.py:16-30). Row-blocked: peak memory is O(block·n·f), not O(m·n·f)."""
+    return _row_blocked(
+        lambda xb, yb: jnp.sqrt(jnp.sum((xb[:, None, :] - yb[None, :, :]) ** 2, axis=-1)), x, y
+    )
 
 
 def _euclidian_fast(x: jax.Array, y: jax.Array) -> jax.Array:
-    """Euclidean via quadratic expansion — one MXU GEMM, less accurate (reference
-    distance.py:31-45)."""
-    return jnp.sqrt(jnp.maximum(_quadratic_expand(x, y), 0.0))
+    """Euclidean via quadratic expansion — one MXU GEMM, less accurate than exact
+    differences but matching the reference's f32 GEMM (reference distance.py:31-45)."""
+    return jnp.sqrt(jnp.maximum(_quadratic_expand(x, y, jax.lax.Precision.HIGHEST), 0.0))
 
 
-def _quadratic_expand(x: jax.Array, y: jax.Array) -> jax.Array:
+def _quadratic_expand(x: jax.Array, y: jax.Array, precision=None) -> jax.Array:
     """|x|^2 - 2 x.y + |y|^2 (reference distance.py:46-65): one MXU GEMM + rank-1
     updates — the TPU-optimal formulation. All intermediates stay 2-D and the GEMM
-    pins f32 accumulation, so this is also the canonical in-kernel (pallas) form."""
+    pins f32 accumulation, so this is also the canonical in-kernel (pallas) form.
+
+    ``precision=None`` is the MXU default (one bf16 pass for f32 operands) —
+    throughput-critical callers like the KMeans assignment step keep it. The
+    user-facing distance functions pass HIGHEST to match the reference's f32 GEMM
+    accuracy (distance.py:46-65)."""
     x_norm = jnp.sum(x * x, axis=1, keepdims=True)
     y_norm = jnp.sum(y * y, axis=1, keepdims=True)
     acc = jnp.promote_types(x.dtype, jnp.float32)  # ≥f32 accumulation, f64 stays f64
-    return x_norm - 2.0 * jnp.dot(x, y.T, preferred_element_type=acc) + y_norm.T
+    return x_norm - 2.0 * jnp.dot(
+        x, y.T, preferred_element_type=acc, precision=precision
+    ) + y_norm.T
 
 
 def _gaussian(x: jax.Array, y: jax.Array, sigma: float = 1.0) -> jax.Array:
     """RBF kernel exp(-d^2 / 2 sigma^2) (reference distance.py:66-85)."""
-    d2 = jnp.maximum(_quadratic_expand(x, y), 0.0)
+    d2 = jnp.maximum(_quadratic_expand(x, y, jax.lax.Precision.HIGHEST), 0.0)
     return jnp.exp(-d2 / (2.0 * sigma * sigma))
 
 
@@ -65,8 +98,11 @@ def _gaussian_fast(x: jax.Array, y: jax.Array, sigma: float = 1.0) -> jax.Array:
 
 
 def _manhattan(x: jax.Array, y: jax.Array) -> jax.Array:
-    """Pairwise L1 distance (reference distance.py:105-119)."""
-    return jnp.sum(jnp.abs(x[:, None, :] - y[None, :, :]), axis=-1)
+    """Pairwise L1 distance (reference distance.py:105-119). Row-blocked like
+    :func:`_euclidian`."""
+    return _row_blocked(
+        lambda xb, yb: jnp.sum(jnp.abs(xb[:, None, :] - yb[None, :, :]), axis=-1), x, y
+    )
 
 
 def _manhattan_fast(x: jax.Array, y: jax.Array) -> jax.Array:
@@ -89,9 +125,8 @@ def rbf(
     quadratic_expansion: bool = False,
 ) -> DNDarray:
     """Pairwise RBF kernel matrix (reference distance.py:159-185)."""
-    if quadratic_expansion:
-        return _dist(X, Y, lambda x, y: _gaussian_fast(x, y, sigma))
-    return _dist(X, Y, lambda x, y: _gaussian(x, y, sigma))
+    metric = _gaussian_fast if quadratic_expansion else _gaussian
+    return _dist(X, Y, metric, margs=(float(sigma),))
 
 
 def manhattan(X: DNDarray, Y: Optional[DNDarray] = None, expand: bool = False) -> DNDarray:
@@ -101,7 +136,23 @@ def manhattan(X: DNDarray, Y: Optional[DNDarray] = None, expand: bool = False) -
     return _dist(X, Y, _manhattan)
 
 
-def _dist(X: DNDarray, Y: Optional[DNDarray] = None, metric: Callable = _euclidian) -> DNDarray:
+# jit/ring executables cached on (metric fn, static args) — a fresh jit wrapper per
+# call would retrace and recompile every invocation (jit keys on function identity)
+_JIT_CACHE: dict = {}
+
+
+def _jit_metric(metric: Callable, margs: tuple) -> Callable:
+    key = (metric, margs)
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(lambda x, y: metric(x, y, *margs))
+        _JIT_CACHE[key] = fn
+    return fn
+
+
+def _dist(
+    X: DNDarray, Y: Optional[DNDarray] = None, metric: Callable = _euclidian, margs: tuple = ()
+) -> DNDarray:
     """
     The distributed distance engine (reference distance.py:209-494). Ring algorithm
     when both operands are row-sharded over the mesh: X's row block stays put, Y's
@@ -135,45 +186,55 @@ def _dist(X: DNDarray, Y: Optional[DNDarray] = None, metric: Callable = _euclidi
         and comm.is_shardable(y_shape, 0)
     )
     if use_ring:
-        data = _ring_dist(comm, x, yarr, metric)
+        data = _ring_dist(comm, x, yarr, metric, margs)
     else:
-        data = metric(x, yarr)
+        # jit so the broadcast-diff → square → reduce chain fuses into one XLA
+        # computation (eager per-primitive dispatch would materialize the 3-D
+        # intermediate of the exact metrics)
+        data = _jit_metric(metric, margs)(x, yarr)
     return DNDarray(
         data, out_shape, types.canonical_heat_type(data.dtype), X.split, X.device, comm, True
     )
 
 
-def _ring_dist(comm: MeshCommunication, x: jax.Array, y: jax.Array, metric: Callable) -> jax.Array:
+def _ring_dist(
+    comm: MeshCommunication, x: jax.Array, y: jax.Array, metric: Callable, margs: tuple = ()
+) -> jax.Array:
     """Ring systolic tile sweep via shard_map + ppermute."""
     mesh = comm.mesh
     axis = comm.axis_name
     p = comm.size
-    n_block = y.shape[0] // p
-    perm = [(i, (i - 1) % p) for i in range(p)]  # rotate blocks towards lower ranks
+    key = ("ring", metric, margs, mesh, axis)
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+        perm = [(i, (i - 1) % p) for i in range(p)]  # rotate blocks towards lower ranks
 
-    def ring(x_block, y_block):
-        i0 = jax.lax.axis_index(axis)
+        def ring(x_block, y_block):
+            i0 = jax.lax.axis_index(axis)
 
-        def step(carry, k):
-            y_cur = carry
-            tile = metric(x_block, y_cur)  # (m/p, n/p)
-            y_next = jax.lax.ppermute(y_cur, axis, perm)
-            return y_next, (tile, (i0 + k) % p)
+            def step(carry, k):
+                y_cur = carry
+                tile = metric(x_block, y_cur, *margs)  # (m/p, n/p)
+                y_next = jax.lax.ppermute(y_cur, axis, perm)
+                return y_next, (tile, (i0 + k) % p)
 
-        # p-1 rotated rounds + the final held block without the discarded rotation
-        y_last, (tiles, cols) = jax.lax.scan(step, y_block, jnp.arange(p - 1))
-        tiles = jnp.concatenate([tiles, metric(x_block, y_last)[None]], axis=0)
-        cols = jnp.concatenate([cols, ((i0 + p - 1) % p)[None]], axis=0)
-        # tiles: (p, m/p, n/p) in ring order; scatter to column order
-        order = jnp.argsort(cols)
-        tiles = jnp.take(tiles, order, axis=0)  # (p, m/p, n/p) by column block
-        return jnp.concatenate(jnp.split(tiles.reshape(p * tiles.shape[1], -1), p, axis=0), axis=1)
+            # p-1 rotated rounds + the final held block without the discarded rotation
+            y_last, (tiles, cols) = jax.lax.scan(step, y_block, jnp.arange(p - 1))
+            tiles = jnp.concatenate([tiles, metric(x_block, y_last, *margs)[None]], axis=0)
+            cols = jnp.concatenate([cols, ((i0 + p - 1) % p)[None]], axis=0)
+            # tiles: (p, m/p, n/p) in ring order; scatter to column order
+            order = jnp.argsort(cols)
+            tiles = jnp.take(tiles, order, axis=0)  # (p, m/p, n/p) by column block
+            return jnp.concatenate(jnp.split(tiles.reshape(p * tiles.shape[1], -1), p, axis=0), axis=1)
 
-    fn = jax.shard_map(
-        ring,
-        mesh=mesh,
-        in_specs=(P(axis, None), P(axis, None)),
-        out_specs=P(axis, None),
-        check_vma=False,
-    )
+        fn = jax.jit(
+            jax.shard_map(
+                ring,
+                mesh=mesh,
+                in_specs=(P(axis, None), P(axis, None)),
+                out_specs=P(axis, None),
+                check_vma=False,
+            )
+        )
+        _JIT_CACHE[key] = fn
     return fn(x, y)
