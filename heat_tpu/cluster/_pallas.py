@@ -2,18 +2,27 @@
 Fused pallas Lloyd iteration for :class:`~heat_tpu.cluster.kmeans.KMeans`.
 
 The XLA formulation (kmeans.py:_kmeans_step) is two MXU GEMMs with an argmin in
-between. This kernel fuses the whole iteration — assignment scores, argmin, one-hot
-accumulation of per-cluster sums/counts — into one pass over ``x``: each grid step
-streams a row tile through VMEM and writes its (k, f) partials; the cross-tile
-reduction happens in XLA afterwards (no carried accumulator, so the grid pipeline
-overlaps the tile DMA with compute).
+between; XLA hoists a bf16 copy of the loop-invariant sample matrix out of the fit
+loop, so its per-iteration HBM traffic is ~one bf16 pass over ``x`` plus the (n, k)
+distance intermediate. This kernel fuses the whole iteration — assignment scores,
+argmin, one-hot accumulation of per-cluster sums/counts, inertia partials — into a
+single streaming pass over the bf16 ``x`` with nothing but the per-tile partials
+ever leaving VMEM, i.e. the HBM floor of one Lloyd iteration.
 
-**Measured result (TPU v5e, n=2²⁰, f=32, k=8, fp32): the XLA step is ~6× faster**
-(≈8.6k iters/s vs ≈1.4k) — XLA's own fusion of the two GEMMs is excellent at these
-shapes and the kernel's small-K GEMM tiles underutilize the MXU. The kernel is kept
-as an opt-in reference implementation (``KMeans.fit`` does NOT select it; bench.py
-races both and reports the winner), and as the template for shapes where a fused
-single-pass actually wins (large f, large k).
+Layout: everything in the kernel is computed transposed, with the row-tile dimension
+in the lanes — scores are ``(k, T)`` from one ``dot_general`` contracting the
+feature axis of ``c`` and ``x`` (no transposes/relayouts in VMEM), labels are the
+axis-0 argmin ``(1, T)``, and the one-hot ``(k, T)`` feeds the second MXU
+``dot_general`` against the ``(T, f)`` tile for the centroid sums.
+
+**Measured result (TPU v5e, n=2²⁰, f=32, k=8, fp32): the XLA step still wins ~3×**
+(≈8.7k iters/s vs ≈2.7k, steady-state differenced timing, bf16 input pre-cast,
+tile_rows swept 4k-32k). At these shapes both MXU contractions have tiny
+non-contraction dims (k=8, f=32 against 128-wide MXU tiles) and the per-tile VPU
+passes dominate; XLA's own fusion of the two GEMMs schedules better. The kernel is
+kept as an opt-in reference implementation (``KMeans.fit`` does NOT select it;
+bench.py races both and reports the winner) and as the template for shapes where a
+fused single pass wins (large f / large k).
 
 Only the single-device hot loop lives here; the distributed reduction over a
 row-sharded dataset stays in XLA-land (psum of the returned partials).
@@ -28,30 +37,36 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# The (tile, 1) labels output block is lane-padded to (tile, 128) in VMEM and
-# double-buffered by the pipeline; 4096 rows keeps the whole working set within
-# the 16MB scoped-VMEM limit (8192 OOMs at compile time).
-_TILE_ROWS = 4096
+_TILE_ROWS = 16384
 
 
-def _fused_kernel(x_ref, c_ref, labels_ref, sums_ref, counts_ref, *, k: int):
-    x = x_ref[:]  # (T, f)
-    c = c_ref[:]  # (k, f)
-    # assignment scores: |x|^2 is constant per row, so argmin only needs
-    # -2 x @ c^T + |c|^2 (saves the x*x elementwise pass)
-    score = -2.0 * jnp.dot(x, c.T, preferred_element_type=jnp.float32) + jnp.sum(
-        c * c, axis=1
-    )[None, :]
-    # keep every intermediate 2-D: Mosaic's layout engine rejects 1-D relayouts
-    labels = jnp.argmin(score, axis=1, keepdims=True).astype(jnp.int32)  # (T, 1)
-    labels_ref[:] = labels
+def _fused_kernel(x_ref, c_ref, labels_ref, sums_ref, counts_ref, inertia_ref, *, k: int):
+    x = x_ref[:]  # (T, f) bf16
+    c = c_ref[:]  # (k, f) f32
+    c_b = c.astype(jnp.bfloat16)
+    # transposed scores (k, T): one MXU pass contracting f, f32 accumulate.
+    # |x|^2 is constant per row, so the argmin only needs -2 x.c + |c|^2; the norm
+    # uses the same bf16-rounded centers as the cross term so scores stay
+    # internally consistent
+    c_bf = c_b.astype(jnp.float32)
+    score = -2.0 * jax.lax.dot_general(
+        c_b, x, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) + jnp.sum(c_bf * c_bf, axis=1, keepdims=True)  # (k, T)
+    labels = jnp.argmin(score, axis=0, keepdims=True).astype(jnp.int32)  # (1, T)
+    labels_ref[0] = labels
     onehot = (
-        labels == jax.lax.broadcasted_iota(jnp.int32, (x.shape[0], k), 1)
-    ).astype(jnp.float32)
+        jax.lax.broadcasted_iota(jnp.int32, (k, score.shape[1]), 0) == labels
+    )
     # per-tile partials; each grid step owns its own output slot, so there is no
     # carried dependence between steps and the pipeline can run ahead
-    sums_ref[0] = jnp.dot(onehot.T, x, preferred_element_type=jnp.float32)  # (k, f)
-    counts_ref[0] = jnp.sum(onehot, axis=0, keepdims=True)  # (1, k)
+    sums_ref[0] = jax.lax.dot_general(
+        onehot.astype(jnp.bfloat16), x, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (k, f)
+    counts_ref[0] = jnp.sum(onehot.astype(jnp.float32), axis=1, keepdims=True)  # (k, 1)
+    # inertia partial: sum_rows min_k d2 = sum min(score) + sum |x|^2
+    xf = x.astype(jnp.float32)
+    inertia_ref[0] = (jnp.sum(jnp.min(score, axis=0)) + jnp.sum(xf * xf)).reshape(1, 1)
 
 
 @functools.partial(jax.jit, static_argnames=("tile_rows", "interpret"))
@@ -62,6 +77,11 @@ def kmeans_step_fused(
     One fused Lloyd iteration. Same contract as ``kmeans._kmeans_step``:
     returns ``(new_centers, labels, shift, inertia)``.
 
+    ``x`` may be f32 or bf16. Loop callers should pre-cast to bf16 once outside
+    the loop: XLA does not hoist the convert across the pallas custom-call, so an
+    in-loop cast re-reads the f32 array every iteration (3× the HBM traffic; at
+    the bench shapes the measured rate is ~2.7k iters/s either way because the
+    per-tile VPU/MXU work dominates, see module docstring).
     Requires ``x.shape[0] % tile_rows == 0`` (callers pick a divisor or fall back
     to the XLA path).
     """
@@ -70,9 +90,9 @@ def kmeans_step_fused(
     if n % tile_rows != 0:
         raise ValueError(f"n={n} must be divisible by tile_rows={tile_rows}")
     grid_n = n // tile_rows
-    x = x.astype(jnp.float32)
+    x_b = x if x.dtype == jnp.bfloat16 else x.astype(jnp.bfloat16)
     centers = centers.astype(jnp.float32)
-    labels2d, psums, pcounts = pl.pallas_call(
+    labels2d, psums, pcounts, pinertia = pl.pallas_call(
         functools.partial(_fused_kernel, k=k),
         grid=(grid_n,),
         in_specs=[
@@ -80,36 +100,34 @@ def kmeans_step_fused(
             pl.BlockSpec((k, f), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((tile_rows, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            # 3-D so each trailing block dim equals the overall array dim (the
+            # TPU lowering's block-shape divisibility rule)
+            pl.BlockSpec((1, 1, tile_rows), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, k, f), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, k), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k, 1), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, 1), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n, 1), jnp.int32),
+            jax.ShapeDtypeStruct((grid_n, 1, tile_rows), jnp.int32),
             jax.ShapeDtypeStruct((grid_n, k, f), jnp.float32),
-            jax.ShapeDtypeStruct((grid_n, 1, k), jnp.float32),
+            jax.ShapeDtypeStruct((grid_n, k, 1), jnp.float32),
+            jax.ShapeDtypeStruct((grid_n, 1, 1), jnp.float32),
         ],
         cost_estimate=pl.CostEstimate(
             flops=4 * n * k * f,
-            bytes_accessed=n * f * 4 + n * 4 + 2 * k * f * 4,
+            bytes_accessed=n * f * 2 + n * 4 + 2 * k * f * 4,
             transcendentals=0,
         ),
         interpret=interpret,
-    )(x, centers)
+    )(x_b, centers)
     sums = psums.sum(axis=0)
-    counts = pcounts.sum(axis=0)[0]
+    counts = pcounts.sum(axis=0)[:, 0]
     new_centers = jnp.where(
         counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), centers
     ).astype(centers.dtype)
     shift = jnp.sum((new_centers - centers) ** 2)
-    # inertia w.r.t. the incoming centers (adds the dropped |x|^2 term back)
-    labels = labels2d[:, 0]
-    d2 = (
-        jnp.sum(x * x, axis=1)
-        - 2.0 * jnp.einsum("nf,nf->n", x, centers[labels])
-        + jnp.sum(centers[labels] * centers[labels], axis=1)
-    )
-    inertia = jnp.sum(jnp.maximum(d2, 0.0))
+    labels = labels2d.reshape(-1)
+    inertia = jnp.maximum(pinertia.sum(), 0.0)
     return new_centers, labels, shift, inertia
 
 
@@ -119,14 +137,11 @@ _VMEM_BUDGET_BYTES = 8 * 1024 * 1024  # half of ~16MB scoped VMEM, room for pipe
 def fused_step_available(
     n: int, f: int = 32, k: int = 8, tile_rows: int = _TILE_ROWS
 ) -> bool:
-    """Whether the fused kernel can run at all: real TPU backend, row count tiles
-    the grid evenly, and the per-step working set (x tile + scores + one-hot +
-    centers/partials) fits in scoped VMEM. NOTE: "available" is not "faster" —
-    measured on v5e the XLA step wins at the bench shapes (see module docstring),
-    so ``KMeans.fit`` never selects this kernel; bench.py races both."""
-    # x tile + lane-padded (tile,128) labels + score/one-hot (tile,k) each, all
-    # double-buffered by the grid pipeline, plus the (k,f) partials
-    working_set = 2 * tile_rows * (f + 128 + 2 * k) * 4 + 4 * k * f * 4
+    """Whether the fused kernel can run: real TPU backend, row count tiles the grid
+    evenly, and the per-step working set (bf16 x tile, f32 (k, T) scores, one-hot,
+    (1, T) labels, all double-buffered by the pipeline, plus the small partials)
+    fits in scoped VMEM."""
+    working_set = 2 * tile_rows * (2 * f + 4 * k + 2 * k + 4) + 4 * k * f * 4
     return (
         jax.default_backend() == "tpu"
         and n % tile_rows == 0

@@ -16,13 +16,45 @@ import os
 from typing import Optional, Tuple, Union
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from . import devices
 from . import factories
 from . import types
-from .communication import sanitize_comm
+from .communication import MeshCommunication, sanitize_comm
 from .dndarray import DNDarray
+
+
+def _load_sharded(reader, gshape, dtype, split, device, comm) -> Optional[DNDarray]:
+    """
+    Slab-wise distributed load: read each device's ``comm.chunk`` slab separately
+    (``reader(slices) -> np.ndarray``) and assemble the global array with
+    ``jax.make_array_from_single_device_arrays`` — the reference's per-rank slab
+    read (io.py:268-390) without ever materializing the full array on one host.
+    Returns None when the layout calls for a plain replicated read.
+    """
+    comm = sanitize_comm(comm)
+    if (
+        split is None
+        or not isinstance(comm, MeshCommunication)
+        or not comm.is_distributed()
+        or not comm.is_shardable(gshape, split)
+    ):
+        return None
+    from .stride_tricks import sanitize_axis
+
+    split = sanitize_axis(gshape, split)  # same normalization/errors as factories.array
+    htype = types.canonical_heat_type(dtype)
+    np_dtype = np.dtype(htype.jnp_type())
+    sharding = comm.sharding(len(gshape), split)
+    shards = []
+    for r, dev in enumerate(comm.mesh.devices.ravel()):
+        _, _, slices = comm.chunk(gshape, split, rank=r)
+        slab = np.asarray(reader(slices), dtype=np_dtype)
+        shards.append(jax.device_put(slab, dev))
+    arr = jax.make_array_from_single_device_arrays(gshape, sharding, shards)
+    return DNDarray(arr, tuple(gshape), htype, split, devices.sanitize_device(device), comm, True)
 
 __all__ = ["load", "load_csv", "save_csv", "save", "supports_hdf5", "supports_netcdf"]
 
@@ -76,7 +108,12 @@ if __HDF5:
         if not isinstance(dataset, str):
             raise TypeError(f"dataset must be str, not {type(dataset)}")
         with h5py.File(path, "r") as handle:
-            data = np.asarray(handle[dataset])
+            dset = handle[dataset]
+            gshape = tuple(int(s) for s in dset.shape)
+            res = _load_sharded(lambda sl: dset[sl], gshape, dtype, split, device, comm)
+            if res is not None:
+                return res
+            data = np.asarray(dset)
         return factories.array(data, dtype=dtype, split=split, device=device, comm=comm)
 
     def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs) -> None:
@@ -89,7 +126,21 @@ if __HDF5:
         if not isinstance(path, str):
             raise TypeError(f"path must be str, not {type(path)}")
         with h5py.File(path, mode) as handle:
-            handle.create_dataset(dataset, data=data.numpy(), **kwargs)
+            arr = data.larray
+            if (
+                data.split is not None
+                and len(arr.sharding.device_set) > 1
+                and not arr.sharding.is_fully_replicated
+            ):
+                # shard-wise write: fetch one device slab at a time (the
+                # reference's per-rank offset writes, io.py:391-470) instead of
+                # gathering the full array on the host first
+                np_dtype = np.dtype(data.dtype.jnp_type())
+                dset = handle.create_dataset(dataset, shape=data.shape, dtype=np_dtype, **kwargs)
+                for shard in arr.addressable_shards:
+                    dset[shard.index] = np.asarray(shard.data)
+            else:
+                handle.create_dataset(dataset, data=data.numpy(), **kwargs)
 
 
 if __NETCDF:
@@ -103,9 +154,17 @@ if __NETCDF:
         device=None,
         comm=None,
     ) -> DNDarray:
-        """Load a NetCDF variable into a (split) DNDarray (reference io.py:471-590)."""
+        """Load a NetCDF variable into a (split) DNDarray (reference io.py:471-590);
+        slab-wise per device like :func:`load_hdf5`."""
         with nc.Dataset(path, "r") as handle:
-            data = np.asarray(handle.variables[variable][:])
+            var = handle.variables[variable]
+            gshape = tuple(int(s) for s in var.shape)
+            res = _load_sharded(
+                lambda sl: np.asarray(var[sl]), gshape, dtype, split, device, comm
+            )
+            if res is not None:
+                return res
+            data = np.asarray(var[:])
         return factories.array(data, dtype=dtype, split=split, device=device, comm=comm)
 
     def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w", **kwargs) -> None:

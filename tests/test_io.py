@@ -70,3 +70,40 @@ def test_dndarray_save_method(tmp_path):
     a = ht.ones((4,))
     a.save(path, "d")
     np.testing.assert_array_equal(ht.load(path, "d").numpy(), a.numpy())
+
+
+def test_hdf5_sharded_slab_load_and_save(tmp_path):
+    if not ht.supports_hdf5():
+        pytest.skip("h5py unavailable")
+    path = str(tmp_path / "slab.h5")
+    data = np.arange(16 * 6, dtype=np.float32).reshape(16, 6)
+    ht.save_hdf5(ht.array(data, split=0), path, "d")
+    np.testing.assert_array_equal(ht.load_hdf5(path, "d").numpy(), data)
+    # slab-wise distributed load: one shard per device, correct layout + values
+    x = ht.load_hdf5(path, "d", split=0)
+    assert x.split == 0
+    assert len(x.larray.addressable_shards) == len(x.comm.mesh.devices.ravel())
+    shard0 = x.larray.addressable_shards[0]
+    assert shard0.data.shape[0] == 16 // len(x.larray.addressable_shards)
+    np.testing.assert_array_equal(x.numpy(), data)
+    # split=1 slab load
+    y = ht.load_hdf5(path, "d", split=1)
+    np.testing.assert_array_equal(y.numpy(), data)
+    # ragged (not divisible) falls back to replicated placement, keeps metadata
+    path2 = str(tmp_path / "rag.h5")
+    rag = np.arange(7 * 3, dtype=np.float32).reshape(7, 3)
+    ht.save_hdf5(ht.array(rag), path2, "d")
+    z = ht.load_hdf5(path2, "d", split=0)
+    assert z.split == 0
+    np.testing.assert_array_equal(z.numpy(), rag)
+
+
+def test_netcdf_sharded_slab_load(tmp_path):
+    if not ht.supports_netcdf():
+        pytest.skip("netCDF4 unavailable")
+    path = str(tmp_path / "slab.nc")
+    data = np.arange(8 * 5, dtype=np.float32).reshape(8, 5)
+    ht.save_netcdf(ht.array(data), path, "v")
+    x = ht.load_netcdf(path, "v", split=0)
+    assert x.split == 0
+    np.testing.assert_array_equal(x.numpy(), data)
