@@ -392,7 +392,13 @@ class MeshCommunication(Communication):
                 raise ValueError(f"color list must have length {self.size}, got {len(colors)}")
             members = [d for d, c in zip(devs, colors) if c == colors[0]]
         else:
-            members = [devs[int(i)] for i in devices]
+            idx = [int(i) for i in devices]
+            bad = [i for i in idx if not 0 <= i < self.size]
+            if bad:
+                raise ValueError(f"device indices {bad} out of range for {self.size} devices")
+            if len(set(idx)) != len(idx):
+                raise ValueError(f"duplicate device indices in {idx}")
+            members = [devs[i] for i in idx]
         if not members:
             raise ValueError("communicator split produced an empty group")
         return MeshCommunication(devices=members)
@@ -424,6 +430,8 @@ def _build_collective(comm: "MeshCommunication", kind: str, split: int, ndim: in
     mesh = comm.mesh
     ax = comm.axis_name
     p = comm.size
+    if kind in ("allreduce", "scan") and op not in _REDUCERS:
+        raise ValueError(f"unknown reduction op {op!r}; expected one of {sorted(_REDUCERS)}")
     spec_split = PartitionSpec(*([None] * split + [ax]))
     spec_repl = PartitionSpec()
 

@@ -2,6 +2,7 @@
 Named collective shims on MeshCommunication vs the reference MPI semantics
 (chunks of the split axis = per-rank local buffers; reference
 heat/core/communication.py:521-1873). Ground truth computed with numpy chunk math.
+Device-count agnostic: runs at any HEAT_TPU_TEST_DEVICES in {1, 2, 4, 8, 16}.
 """
 
 import numpy as np
@@ -14,16 +15,20 @@ from heat_tpu.core.communication import MeshCommunication, get_comm
 @pytest.fixture(scope="module")
 def comm() -> MeshCommunication:
     c = get_comm()
-    assert c.size == 8, "suite expects the 8-device CPU mesh"
+    assert 16 % c.size == 0, "suite expects a device count dividing 16"
     return c
 
 
 RNG = np.random.default_rng(3)
 X = RNG.standard_normal((16, 6)).astype(np.float32)
-CHUNKS = np.split(X, 8, axis=0)
+
+
+def _chunks(comm, x=X):
+    return np.split(x, comm.size, axis=0)
 
 
 def test_allreduce_ops(comm):
+    chunks = _chunks(comm)
     for op, ref in (
         ("sum", np.add.reduce),
         ("max", np.maximum.reduce),
@@ -31,18 +36,20 @@ def test_allreduce_ops(comm):
         ("prod", lambda c: np.multiply.reduce(c)),
     ):
         got = np.asarray(comm.Allreduce(X, op=op))
-        want = ref(np.stack(CHUNKS))
+        want = ref(np.stack(chunks))
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
     b = X > 0
     np.testing.assert_array_equal(
-        np.asarray(comm.Allreduce(b, op="land")), np.logical_and.reduce(np.split(b, 8))
+        np.asarray(comm.Allreduce(b, op="land")),
+        np.logical_and.reduce(np.split(b, comm.size)),
     )
     np.testing.assert_array_equal(
-        np.asarray(comm.Allreduce(b, op="lor")), np.logical_or.reduce(np.split(b, 8))
+        np.asarray(comm.Allreduce(b, op="lor")),
+        np.logical_or.reduce(np.split(b, comm.size)),
     )
     # Reduce is the same collective under one controller
     np.testing.assert_allclose(
-        np.asarray(comm.Reduce(X, op="sum", root=3)), np.add.reduce(np.stack(CHUNKS))
+        np.asarray(comm.Reduce(X, op="sum", root=0)), np.add.reduce(np.stack(chunks))
     )
 
 
@@ -53,95 +60,121 @@ def test_allgather_variants(comm):
 
 def test_scatter_places_chunks(comm):
     y = comm.Scatter(X, split=0)
-    assert len(y.addressable_shards) == 8
+    assert len(y.addressable_shards) == comm.size
     np.testing.assert_array_equal(np.asarray(y), X)
     shard0 = y.addressable_shards[0]
-    assert shard0.data.shape == (2, 6)
+    assert shard0.data.shape == (16 // comm.size, 6)
 
 
 def test_bcast_replicates_root_chunk(comm):
-    got = np.asarray(comm.Bcast(X, root=3))
-    want = np.concatenate([CHUNKS[3]] * 8, axis=0)
+    root = comm.size - 1
+    got = np.asarray(comm.Bcast(X, root=root))
+    want = np.concatenate([_chunks(comm)[root]] * comm.size, axis=0)
     np.testing.assert_array_equal(got, want)
 
 
 def test_scan_exscan(comm):
+    chunks = np.stack(_chunks(comm))
     got = np.asarray(comm.Scan(X, op="sum"))
-    want = np.concatenate(list(np.cumsum(np.stack(CHUNKS), axis=0)), axis=0)
+    want = np.concatenate(list(np.cumsum(chunks, axis=0)), axis=0)
     np.testing.assert_allclose(got, want, rtol=1e-5)
     got_ex = np.asarray(comm.Exscan(X, op="sum"))
-    prefix = np.cumsum(np.stack(CHUNKS), axis=0)
-    want_ex = np.concatenate([np.zeros_like(CHUNKS[0])] + list(prefix[:-1]), axis=0)
+    prefix = np.cumsum(chunks, axis=0)
+    want_ex = np.concatenate([np.zeros_like(chunks[0])] + list(prefix[:-1]), axis=0)
     np.testing.assert_allclose(got_ex, want_ex, rtol=1e-5)
-    # max scan
     got_mx = np.asarray(comm.Scan(X, op="max"))
-    want_mx = np.concatenate(list(np.maximum.accumulate(np.stack(CHUNKS), axis=0)), axis=0)
+    want_mx = np.concatenate(list(np.maximum.accumulate(chunks, axis=0)), axis=0)
     np.testing.assert_array_equal(got_mx, want_mx)
 
 
 def test_alltoall_resplits_without_changing_values(comm):
-    a = RNG.standard_normal((8, 16)).astype(np.float32)
+    a = RNG.standard_normal((16, 16)).astype(np.float32)
     out = comm.Alltoall(a, split_axis=1, concat_axis=0)
     np.testing.assert_array_equal(np.asarray(out), a)
-    # physically sharded on the new axis now
     shard0 = out.addressable_shards[0]
-    assert shard0.data.shape == (8, 2)
+    assert shard0.data.shape == (16, 16 // comm.size)
     np.testing.assert_array_equal(np.asarray(comm.Alltoallv(a, 1, 0)), a)
     with pytest.raises(ValueError):
         comm.Alltoall(a, split_axis=0, concat_axis=0)
 
 
 def test_ppermute_rotates_chunks(comm):
+    chunks = _chunks(comm)
     got = np.asarray(comm.Ppermute(X, shift=1, split=0))
-    want = np.concatenate([CHUNKS[-1]] + CHUNKS[:-1], axis=0)
+    want = np.concatenate([chunks[-1]] + chunks[:-1], axis=0)
     np.testing.assert_array_equal(got, want)
     got2 = np.asarray(comm.Ppermute(X, shift=-1, split=0))
-    want2 = np.concatenate(CHUNKS[1:] + [CHUNKS[0]], axis=0)
+    want2 = np.concatenate(chunks[1:] + [chunks[0]], axis=0)
     np.testing.assert_array_equal(got2, want2)
 
 
 def test_split_subcommunicator(comm):
-    sub = comm.Split([0, 1, 2, 3])
-    assert sub.size == 4
-    y = np.arange(8.0, dtype=np.float32).reshape(8, 1)
+    if comm.size < 2:
+        pytest.skip("needs a multi-device mesh")
+    half = comm.size // 2
+    sub = comm.Split(list(range(half)))
+    assert sub.size == half
+    y = np.arange(16.0, dtype=np.float32).reshape(16, 1)
     np.testing.assert_allclose(
-        np.asarray(sub.Allreduce(y, op="sum")), np.add.reduce(np.split(y, 4))
+        np.asarray(sub.Allreduce(y, op="sum")), np.add.reduce(np.split(y, half))
     )
-    # color semantics: two groups of four; group of color of device 0
-    sub2 = comm.Split(color=[0, 0, 0, 0, 1, 1, 1, 1])
-    assert sub2.size == 4
+    # color semantics: two groups; group of the color of device 0
+    colors = [0] * half + [1] * (comm.size - half)
+    sub2 = comm.Split(color=colors)
+    assert sub2.size == half
     with pytest.raises(ValueError):
         comm.Split([])
     with pytest.raises(ValueError):
-        comm.Split([0, 1], color=[0] * 8)  # exactly one of devices/color
+        comm.Split([0], color=colors)  # exactly one of devices/color
     with pytest.raises(ValueError):
-        comm.Split(color=[0, 1])  # wrong color-list length
+        comm.Split(color=[0])  # wrong color-list length
 
 
 def test_collective_errors(comm):
     with pytest.raises(ValueError):
         comm.Allreduce(np.float32(3.0))  # scalar
+    if comm.size > 1:
+        ragged = np.ones((comm.size + 1, 3), np.float32)
+        with pytest.raises(ValueError):
+            comm.Allreduce(ragged)  # not evenly partitionable
+        with pytest.raises(ValueError):
+            comm.Scatter(np.ones(comm.size + 1, np.float32))
     with pytest.raises(ValueError):
-        comm.Allreduce(np.ones((7, 3), np.float32))  # not evenly partitionable
-    with pytest.raises(ValueError):
-        comm.Scatter(np.ones(7, np.float32))  # Scatter validates like the others
-    with pytest.raises(ValueError):
-        comm.Bcast(X, root=8)  # out-of-range root must not silently zero
+        comm.Bcast(X, root=comm.size)  # out-of-range root must not silently zero
 
 
 def test_logical_ops_use_truthiness(comm):
     # 256 wraps to 0 under a uint8 cast and 0.5 truncates to 0 under an int cast;
     # both are logically true
-    big = np.full((8, 2), 256, np.int32)
+    big = np.full((16, 2), 256, np.int32)
     assert bool(np.all(np.asarray(comm.Allreduce(big, op="land"))))
-    halves = np.full((8, 2), 0.5, np.float32)
+    halves = np.full((16, 2), 0.5, np.float32)
     assert bool(np.all(np.asarray(comm.Allreduce(halves, op="land"))))
 
 
 def test_bcast_preserves_dtype(comm):
-    b = (X > 0)
-    out = comm.Bcast(b, root=2)
+    b = X > 0
+    out = comm.Bcast(b, root=0)
     assert np.asarray(out).dtype == np.bool_
     np.testing.assert_array_equal(
-        np.asarray(out), np.concatenate([np.split(b, 8)[2]] * 8, axis=0)
+        np.asarray(out),
+        np.concatenate([np.split(b, comm.size)[0]] * comm.size, axis=0),
     )
+
+
+def test_split_validates_indices(comm):
+    if comm.size < 2:
+        pytest.skip("needs a multi-device mesh")
+    with pytest.raises(ValueError):
+        comm.Split([0, 0])  # duplicates
+    with pytest.raises(ValueError):
+        comm.Split([0, comm.size])  # out of range
+    with pytest.raises(ValueError):
+        comm.Split([0, -1])  # negatives don't silently wrap
+
+
+def test_unknown_op_raises_value_error(comm):
+    with pytest.raises(ValueError):
+        comm.Allreduce(X, op="avg")
+    with pytest.raises(ValueError):
+        comm.Scan(X, op="Sum")
