@@ -25,6 +25,12 @@ from .. import types
 from ..communication import MeshCommunication
 from ..dndarray import DNDarray
 
+# User-facing linalg runs its MXU contractions at full input precision by default:
+# the TPU default lowers f32 operands to one bf16 pass (~1e-2 relative error on a
+# GEMM), but reference users expect the accuracy of torch's f32 GEMM. Callers that
+# prefer throughput (fit loops, sketching) pass precision=None/DEFAULT explicitly.
+GEMM_PRECISION = jax.lax.Precision.HIGHEST
+
 __all__ = [
     "cross",
     "det",
@@ -78,7 +84,7 @@ def dot(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None) -> Union[DNDar
     linalg/basics.py:246-330).
     """
     if isinstance(a, DNDarray) and isinstance(b, DNDarray) and a.ndim == 1 and b.ndim == 1:
-        res = jnp.dot(a.larray, b.larray)
+        res = jnp.dot(a.larray, b.larray, precision=GEMM_PRECISION)
         result = DNDarray(res, (), types.canonical_heat_type(res.dtype), None, a.device, a.comm, True)
         if out is not None:
             out.larray = res.astype(out.dtype.jnp_type())
@@ -105,7 +111,7 @@ def inv(a: DNDarray) -> DNDarray:
     return __wrap(a, data, a.split)
 
 
-def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
+def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False, precision=GEMM_PRECISION) -> DNDarray:
     """
     Matrix multiplication (reference linalg/basics.py:424-1094). The reference's
     case analysis over ``(a.split, b.split)`` with block-cyclic ``Ibcast`` panel
@@ -119,7 +125,11 @@ def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
     if a.ndim == 0 or b.ndim == 0:
         raise ValueError("matmul requires at least 1-dimensional operands")
     dtype = types.promote_types(a.dtype, b.dtype)
-    data = jnp.matmul(a.larray.astype(dtype.jnp_type()), b.larray.astype(dtype.jnp_type()))
+    data = jnp.matmul(
+        a.larray.astype(dtype.jnp_type()),
+        b.larray.astype(dtype.jnp_type()),
+        precision=precision,
+    )
     ndim = data.ndim
     if ndim == 0:
         split = None
@@ -131,6 +141,10 @@ def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False) -> DNDarray:
         split = a.split  # batch dims
     else:
         split = None
+    if split is not None:
+        # a matvec collapses ndim below the 2-D case analysis; canonicalize so a
+        # row-split A @ x yields a split=0 vector, never a negative split
+        split %= ndim
     return __wrap(a, data, split)
 
 
@@ -236,7 +250,7 @@ def vdot(x1: DNDarray, x2: DNDarray) -> DNDarray:
     linalg/basics.py:2236-2270)."""
     sanitation.sanitize_in(x1)
     sanitation.sanitize_in(x2)
-    data = jnp.vdot(x1.larray, x2.larray)
+    data = jnp.vdot(x1.larray, x2.larray, precision=GEMM_PRECISION)
     return DNDarray(data, (), types.canonical_heat_type(data.dtype), None, x1.device, x1.comm, True)
 
 

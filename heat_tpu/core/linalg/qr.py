@@ -50,7 +50,9 @@ def __tsqr(a: DNDarray) -> Tuple[jax.Array, jax.Array]:
         q2, r = jnp.linalg.qr(r_stack.reshape(p * n, n))  # (p*n, n), (n, n)
         i = jax.lax.axis_index(axis)
         q2_block = jax.lax.dynamic_slice_in_dim(q2, i * n, n, axis=0)  # (n, n)
-        return q1 @ q2_block, r
+        # full-precision correction GEMM: a bf16 pass here degrades Q's orthogonality
+        q = jnp.matmul(q1, q2_block, precision=jax.lax.Precision.HIGHEST)
+        return q, r
 
     fn = jax.shard_map(
         local,

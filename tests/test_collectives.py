@@ -15,7 +15,8 @@ from heat_tpu.core.communication import MeshCommunication, get_comm
 @pytest.fixture(scope="module")
 def comm() -> MeshCommunication:
     c = get_comm()
-    assert 16 % c.size == 0, "suite expects a device count dividing 16"
+    if 16 % c.size != 0:
+        pytest.skip(f"chunk ground truth needs a device count dividing 16, got {c.size}")
     return c
 
 
