@@ -586,6 +586,7 @@ def distributed_init(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    local_devices: Optional[int] = None,
 ) -> MeshCommunication:
     """
     Join a multi-host run and return the world communicator spanning the whole pod.
@@ -605,6 +606,24 @@ def distributed_init(
             "communicator has already resolved to this host's devices, so "
             "joining the pod now would leave every split array single-host"
         )
+    # Multi-process CPU runs (the reference's `mpirun -n N` development mode) need
+    # the gloo cross-process collective client. Set it unconditionally — it only
+    # affects CPU backend creation, so it is harmless for TPU pods, and gating on
+    # the platform string would miss auto-detected CPU-only machines. Probing the
+    # platform here would initialize the backend, which must not happen before
+    # jax.distributed.initialize.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except AttributeError:
+        import warnings
+
+        warnings.warn(
+            "could not enable gloo CPU collectives (jax config option missing); "
+            "multi-process CPU collectives may hang",
+            RuntimeWarning,
+        )
+    if local_devices is not None:
+        jax.config.update("jax_num_cpu_devices", int(local_devices))
     kwargs = {}
     if coordinator_address is not None:
         kwargs["coordinator_address"] = coordinator_address

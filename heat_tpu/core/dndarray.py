@@ -393,8 +393,15 @@ class DNDarray:
 
     def numpy(self) -> np.ndarray:
         """The global array as a numpy array (parity: dndarray.py:995 — there a
-        resplit(None) gather; here a device fetch)."""
-        return np.asarray(jax.device_get(self.__array))
+        resplit(None) gather; here a device fetch). In a multi-controller run the
+        shards on other hosts are gathered with ``process_allgather`` (every host
+        gets the full array, like the reference's resplit(None))."""
+        arr = self.__array
+        if hasattr(arr, "is_fully_addressable") and not arr.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+        return np.asarray(jax.device_get(arr))
 
     def __array__(self, dtype=None) -> np.ndarray:
         arr = self.numpy()

@@ -25,10 +25,13 @@ from .. import types
 from ..communication import MeshCommunication
 from ..dndarray import DNDarray
 
-# User-facing linalg runs its MXU contractions at full input precision by default:
-# the TPU default lowers f32 operands to one bf16 pass (~1e-2 relative error on a
-# GEMM), but reference users expect the accuracy of torch's f32 GEMM. Callers that
-# prefer throughput (fit loops, sketching) pass precision=None/DEFAULT explicitly.
+# Linalg runs its MXU contractions at full input precision by default — including
+# the iterative solvers (cg/lanczos) and final SVD projections, which accumulate
+# GEMM error: the TPU default lowers f32 operands to one bf16 pass (~1e-2 relative
+# error), but reference users expect the accuracy of torch's f32 GEMM. Callers that
+# prefer throughput pass matmul(..., precision=jax.lax.Precision.DEFAULT) — the
+# rsvd power-iteration sketch does, and ML fit loops (e.g. the KMeans step) use raw
+# jnp contractions at the fast default deliberately.
 GEMM_PRECISION = jax.lax.Precision.HIGHEST
 
 __all__ = [
@@ -133,18 +136,26 @@ def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False, precision=GEMM
     ndim = data.ndim
     if ndim == 0:
         split = None
-    elif a.ndim >= 2 and a.split == a.ndim - 2:
+    elif b.ndim == 1:
+        # matvec: result dims are a.shape[:-1]; a's split survives unless it was
+        # the contracted axis
+        split = a.split if (a.split is not None and a.split < a.ndim - 1) else None
+    elif a.ndim == 1:
+        # vecmat: result dims are b.shape[:-2] + b.shape[-1:]
+        if b.split is None or b.split == b.ndim - 2:
+            split = None
+        elif b.split == b.ndim - 1:
+            split = ndim - 1
+        else:
+            split = b.split  # batch dims
+    elif a.split == a.ndim - 2:
         split = ndim - 2
-    elif b.ndim >= 2 and b.split == b.ndim - 1:
+    elif b.split == b.ndim - 1:
         split = ndim - 1
-    elif a.ndim >= 2 and a.split is not None and a.split < a.ndim - 2:
+    elif a.split is not None and a.split < a.ndim - 2:
         split = a.split  # batch dims
     else:
         split = None
-    if split is not None:
-        # a matvec collapses ndim below the 2-D case analysis; canonicalize so a
-        # row-split A @ x yields a split=0 vector, never a negative split
-        split %= ndim
     return __wrap(a, data, split)
 
 

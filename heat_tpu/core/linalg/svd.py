@@ -123,13 +123,17 @@ def rsvd(
     omega_data = jax.random.normal(key, (n, l), dtype=a.dtype.jnp_type())
     omega = DNDarray(omega_data, (n, l), a.dtype, None, a.device, a.comm, True)
 
-    y = matmul(a, omega)  # (m, l), split follows a's rows
+    # the sketch only has to find the dominant subspace — the QR re-orthonormalisation
+    # restores it each round — so its GEMMs run at the fast MXU default; the final
+    # projection/recovery GEMMs below stay at full precision
+    fast = jax.lax.Precision.DEFAULT
+    y = matmul(a, omega, precision=fast)  # (m, l), split follows a's rows
     at = transpose(a, (1, 0))
     for _ in range(int(n_iter)):
         # subspace iteration: y <- a (a^T y); re-orthonormalise to stop the
         # sketch collapsing onto the top singular vector
         y = _qr(y).Q
-        y = matmul(a, matmul(at, y))
+        y = matmul(a, matmul(at, y, precision=fast), precision=fast)
     q = _qr(y).Q  # (m, l) orthonormal, distributed for split=0
     b = matmul(transpose(q, (1, 0)), a)  # (l, n) small, contraction over rows
     u_b, s, vh = jnp.linalg.svd(b.resplit(None).larray, full_matrices=False)

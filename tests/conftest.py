@@ -21,4 +21,8 @@ os.environ["XLA_FLAGS"] = (
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+# HEAT_TPU_TEST_REAL_DEVICE=1 runs the suite on whatever accelerator JAX finds
+# (e.g. the one real TPU chip) instead of the virtual CPU mesh — used to validate
+# the op surface against real-hardware numerics/lowering. Default: CPU mesh.
+if os.environ.get("HEAT_TPU_TEST_REAL_DEVICE") != "1":
+    jax.config.update("jax_platforms", "cpu")
