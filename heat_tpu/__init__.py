@@ -19,3 +19,20 @@ from . import optim
 from . import regression
 from . import spatial
 from . import utils
+
+# ---------------------------------------------------------------------- methods
+# Reference parity: the remainder of the reference's `DNDarray.<op> = <op>` method
+# attachments scattered across its op modules (each heat_tpu module already attaches
+# its own core set — this is the long tail, e.g. x.sin(), x.tril(), x.kurtosis()).
+from .core.dndarray import DNDarray as _DNDarray
+
+for _name in (
+    "absolute", "acos", "allclose", "asin", "atan", "atan2", "balance", "ceil",
+    "conj", "cos", "cosh", "exp2", "expm1", "fabs", "floor", "isclose", "kurtosis",
+    "log10", "log1p", "log2", "modf", "nonzero", "norm", "redistribute", "rot90",
+    "sin", "sinh", "skew", "square", "swapaxes", "tan", "tanh", "trace", "tril",
+    "triu", "trunc",
+):
+    if not hasattr(_DNDarray, _name):
+        setattr(_DNDarray, _name, globals()[_name])
+del _DNDarray, _name

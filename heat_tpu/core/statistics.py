@@ -15,6 +15,7 @@ from __future__ import annotations
 from typing import Optional, Tuple, Union
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from . import _operations
@@ -143,7 +144,9 @@ def cov(m, y=None, rowvar: bool = True, bias: bool = False, ddof: Optional[int] 
     if ddof is not None and not isinstance(ddof, int):
         raise TypeError("ddof must be an integer")
     yv = y.larray if isinstance(y, DNDarray) else y
-    res = jnp.cov(m.larray, y=yv, rowvar=rowvar, bias=bias, ddof=ddof)
+    with jax.default_matmul_precision("highest"):
+        # the covariance GEMM must not drop to the TPU's bf16 default pass
+        res = jnp.cov(m.larray, y=yv, rowvar=rowvar, bias=bias, ddof=ddof)
     res = jnp.atleast_2d(res)
     return DNDarray(res, tuple(res.shape), types.canonical_heat_type(res.dtype), None, m.device, m.comm, True)
 

@@ -158,3 +158,22 @@ def test_cpu():
     b = a.cpu()
     assert b.device.device_type == "cpu"
     np.testing.assert_array_equal(b.numpy(), a.numpy())
+
+
+def test_reference_method_surface():
+    """Every `DNDarray.<name> = ...` attachment in the reference exists here."""
+    x = ht.array(np.linspace(0.1, 0.9, 12).reshape(3, 4).astype(np.float32), split=0)
+    # the long-tail method attachments (heat_tpu/__init__.py) actually dispatch
+    np.testing.assert_allclose(x.sin().numpy(), np.sin(x.numpy()), rtol=1e-6)
+    np.testing.assert_allclose(x.square().numpy(), x.numpy() ** 2, rtol=1e-6)
+    np.testing.assert_allclose(float(x.trace()), np.trace(x.numpy()), rtol=1e-5)
+    assert x.rot90().shape == (4, 3)
+    assert x.swapaxes(0, 1).shape == (4, 3)
+    assert bool(x.allclose(x))
+    for name in (
+        "absolute", "acos", "asin", "atan", "atan2", "balance", "ceil", "conj",
+        "cos", "cosh", "exp2", "expm1", "fabs", "floor", "isclose", "kurtosis",
+        "log10", "log1p", "log2", "modf", "nonzero", "norm", "redistribute",
+        "sinh", "skew", "tan", "tanh", "tril", "triu", "trunc",
+    ):
+        assert hasattr(ht.DNDarray, name), name
