@@ -1,0 +1,53 @@
+"""
+Shared real-accelerator test policy.
+
+On the CPU mesh (default) everything matches numpy/libm tightly. On a real
+accelerator (``HEAT_TPU_TEST_REAL_DEVICE=1``) two hardware realities apply
+(documented in doc/performance.md):
+
+- VPU transcendentals are fast polynomial approximations (≤ ~2.2e-4 relative
+  on v5e) → :func:`tol` widens the comparison for those ops;
+- some backends have no complex-dtype support (TPU v5e) → tests exercising
+  complex64/128 guard with :data:`requires_complex`.
+"""
+
+import os
+
+import jax
+import pytest
+
+ON_ACCELERATOR = jax.default_backend() != "cpu"
+
+TRANSCENDENTAL_RTOL = 5e-4
+
+# includes numpy ufunc spellings ("power", "arctan2") since callers key by
+# np_op.__name__ as well as by the ht-op label
+TRANSCENDENTALS = frozenset(
+    {"exp", "expm1", "exp2", "log", "log2", "log10", "log1p", "sqrt",
+     "sin", "cos", "tan", "sinh", "cosh", "tanh",
+     "arcsin", "arccos", "arctan", "arcsinh", "arccosh", "arctanh",
+     "logaddexp", "logaddexp2", "atan2", "arctan2", "pow", "power"}
+)
+
+
+def tol(name, rtol=2e-5, atol=1e-6):
+    """Comparison tolerance for op ``name``: the accelerator transcendental
+    relaxation when it applies, the given defaults otherwise."""
+    if ON_ACCELERATOR and name in TRANSCENDENTALS:
+        return dict(rtol=TRANSCENDENTAL_RTOL, atol=1e-5)
+    return dict(rtol=rtol, atol=atol)
+
+
+# TPUs have no complex-dtype support; probing with a live complex op is not safe
+# (a failed complex lowering can poison the whole backend for the process — and on
+# deferred-execution runtimes the probe's try/except never even sees the failure).
+# Static rule scoped to TPU-family backends (GPU supports complex and keeps
+# coverage), overridable via HEAT_TPU_TEST_COMPLEX=1:
+COMPLEX_SUPPORTED = (
+    jax.default_backend() not in ("tpu", "axon")
+    or os.environ.get("HEAT_TPU_TEST_COMPLEX") == "1"
+)
+
+requires_complex = pytest.mark.skipif(
+    not COMPLEX_SUPPORTED, reason="backend has no complex-dtype support (e.g. TPU v5e)"
+)

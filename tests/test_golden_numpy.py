@@ -5,7 +5,6 @@ parametrized table. Each case builds small arrays with split ∈ {None, 0, 1},
 applies the ht op and the numpy op, and compares the gathered result plus metadata.
 """
 
-import jax
 import numpy as np
 import pytest
 
@@ -121,24 +120,9 @@ def _np_from(res):
     return out
 
 
-# On a real accelerator (HEAT_TPU_TEST_REAL_DEVICE=1) the VPU evaluates
-# transcendentals with fast polynomial approximations — measured ≤ ~2.2e-4
-# relative on v5e vs numpy's correctly-rounded libm (see doc/performance.md).
-# The CPU mesh matches libm, so the tight default tolerance applies there.
-_ON_ACCELERATOR = jax.default_backend() != "cpu"
-_TRANSCENDENTAL_RTOL = 5e-4
-_TRANSCENDENTALS = frozenset(
-    {"exp", "expm1", "exp2", "log", "log2", "log10", "log1p", "sqrt",
-     "sin", "cos", "tan", "sinh", "cosh", "tanh",
-     "arcsin", "arccos", "arctan", "arcsinh", "arctanh",
-     "logaddexp", "logaddexp2", "atan2", "pow"}
-)
-
-
-def _golden_tol(name):
-    if _ON_ACCELERATOR and name in _TRANSCENDENTALS:
-        return dict(rtol=_TRANSCENDENTAL_RTOL, atol=1e-5)
-    return dict(rtol=2e-5, atol=1e-6)
+# accelerator tolerance policy shared with the rest of the suite (tests/_accel.py;
+# rationale in doc/performance.md)
+from _accel import tol as _golden_tol
 
 
 @pytest.mark.parametrize("split", SPLITS)

@@ -47,9 +47,15 @@ def test_memory():
 
 
 def test_devices():
+    import jax
+
     assert ht.cpu.device_type == "cpu"
     d = ht.get_device()
-    assert d.device_type == "cpu"  # forced in conftest
+    if jax.default_backend() == "cpu":
+        assert d.device_type == "cpu"  # forced CPU mesh
+    else:
+        # on real hardware the default must be the accelerator, never cpu
+        assert d.device_type != "cpu"
     assert ht.sanitize_device(None) is d
     assert ht.sanitize_device("cpu") is ht.cpu
     assert ht.sanitize_device(ht.cpu) is ht.cpu

@@ -4,6 +4,7 @@ import numpy as np
 import pytest
 
 import heat_tpu as ht
+from _accel import requires_complex
 from heat_tpu.core import types
 
 
@@ -100,6 +101,7 @@ def test_finfo_iinfo():
         ht.iinfo(ht.float32)
 
 
+@requires_complex
 def test_iscomplex_isreal():
     x = ht.array([1 + 1j, 2 + 0j], dtype=ht.complex64)
     assert types.iscomplex(x).numpy().tolist() == [True, False]
