@@ -113,12 +113,22 @@ def qr(
         return QR(q, r)
 
     # local / gathered path (reference qr.py:98-106 for split=None)
+    distributed = isinstance(comm, MeshCommunication) and comm.is_distributed()
     if calc_q:
         q_data, r_data = jnp.linalg.qr(a.larray)
-        q = DNDarray(q_data, tuple(q_data.shape), a.dtype, a.split if a.split == 0 else None, a.device, a.comm, True)
+        q_split = a.split if a.split == 0 else None
+        if distributed:
+            # place like the metadata promises: sharded when divisible, the
+            # documented replicated fallback (logical split retained) otherwise;
+            # R is replicated like the TSQR path's out_specs guarantee
+            q_data = comm.shard(q_data, q_split)
+            r_data = comm.shard(r_data, None)
+        q = DNDarray(q_data, tuple(q_data.shape), a.dtype, q_split, a.device, a.comm, True)
         r = DNDarray(r_data, tuple(r_data.shape), a.dtype, None, a.device, a.comm, True)
         return QR(q, r)
     r_data = jnp.linalg.qr(a.larray, mode="r")
+    if distributed:
+        r_data = comm.shard(r_data, None)
     r = DNDarray(r_data, tuple(r_data.shape), a.dtype, None, a.device, a.comm, True)
     return QR(None, r)
 
