@@ -555,6 +555,18 @@ MPI_SELF = SELF
 __default_comm: MeshCommunication = WORLD
 
 
+def ensure_placement(data, split, comm):
+    """
+    Reconcile an array's physical layout with its ``split`` metadata: shape-changing
+    XLA outputs can come back replicated even when the split axis shards evenly.
+    ``comm.shard`` under the standard guards (sharded when divisible, the documented
+    replicated fallback otherwise); a no-op for local/replicated cases.
+    """
+    if split is not None and isinstance(comm, MeshCommunication) and comm.is_distributed():
+        return comm.shard(data, split)
+    return data
+
+
 def get_comm() -> Communication:
     """Retrieves the globally set default communicator (reference
     communication.py:1897-1903)."""

@@ -28,7 +28,7 @@ from jax.sharding import PartitionSpec as P
 
 from .. import sanitation
 from .. import types
-from ..communication import MeshCommunication
+from ..communication import MeshCommunication, ensure_placement
 from ..dndarray import DNDarray
 
 __all__ = ["qr"]
@@ -118,10 +118,9 @@ def qr(
         q_data, r_data = jnp.linalg.qr(a.larray)
         q_split = a.split if a.split == 0 else None
         if distributed:
-            # place like the metadata promises: sharded when divisible, the
-            # documented replicated fallback (logical split retained) otherwise;
-            # R is replicated like the TSQR path's out_specs guarantee
-            q_data = comm.shard(q_data, q_split)
+            # place like the metadata promises; R is replicated like the TSQR
+            # path's out_specs guarantee
+            q_data = ensure_placement(q_data, q_split, comm)
             r_data = comm.shard(r_data, None)
         q = DNDarray(q_data, tuple(q_data.shape), a.dtype, q_split, a.device, a.comm, True)
         r = DNDarray(r_data, tuple(r_data.shape), a.dtype, None, a.device, a.comm, True)

@@ -21,7 +21,7 @@ from . import factories
 from . import sanitation
 from . import stride_tricks
 from . import types
-from .communication import MeshCommunication
+from .communication import MeshCommunication, ensure_placement
 from .dndarray import DNDarray
 
 __all__ = [
@@ -64,6 +64,7 @@ __all__ = [
 
 
 def __wrap(proto: DNDarray, data: jax.Array, split) -> DNDarray:
+    data = ensure_placement(data, split, proto.comm)
     return DNDarray(
         data, tuple(data.shape), types.canonical_heat_type(data.dtype), split, proto.device, proto.comm, True
     )

@@ -86,13 +86,37 @@ class DataParallel:
         return NamedSharding(self.mesh, P())
 
     def shard_batch(self, *arrays):
-        """Place arrays with the batch axis sharded over the mesh."""
+        """
+        Place arrays with the batch axis sharded over the mesh. Non-divisible
+        batches are trimmed to the largest divisible length (drop-last semantics,
+        same policy as :meth:`DASO.shard_batch`), with a one-time warning.
+        """
+        world = self.comm.size
         out = []
         for a in arrays:
             if isinstance(a, DNDarray):
                 a = a.larray
             a = jnp.asarray(a)
-            if a.ndim > 0 and a.shape[0] % self.comm.size == 0:
+            if a.ndim > 0:
+                n = a.shape[0]
+                if n % world != 0:
+                    keep = (n // world) * world
+                    if keep == 0:
+                        raise ValueError(
+                            f"batch of {n} rows cannot be sharded over {world} devices"
+                        )
+                    if not getattr(self, "_trim_warned", False):
+                        import warnings
+
+                        warnings.warn(
+                            f"batch of {n} rows is not divisible by the {world}-device "
+                            f"mesh; trimming to {keep} (drop-last). Size batches as a "
+                            "multiple of the device count to train on all data.",
+                            RuntimeWarning,
+                            stacklevel=3,
+                        )
+                        self._trim_warned = True
+                    a = a[:keep]
                 a = jax.device_put(a, self.batch_sharding(a.ndim))
             out.append(a)
         return out[0] if len(out) == 1 else tuple(out)

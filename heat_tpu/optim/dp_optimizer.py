@@ -148,6 +148,7 @@ class DASO:
         self.last_batch = None
         self._pending_global = None
         self._pending_countdown = 0
+        self._trim_warned = False
         self.opt_state = None
         self.params = None
         self._local_step = None
@@ -251,14 +252,38 @@ class DASO:
 
     # ------------------------------------------------------------------ train loop API
     def shard_batch(self, *arrays):
-        """Shard the batch axis over the flattened (node, local) mesh."""
+        """
+        Shard the batch axis over the flattened (node, local) mesh. A batch whose
+        length is not divisible by the device count is trimmed to the largest
+        divisible length (drop-last semantics — the reference's per-rank
+        DataLoader slicing never produces ragged global batches either).
+        """
+        world = self.nodes * self.local_size
         out = []
         for a in arrays:
             a = jnp.asarray(a)
-            if a.shape[0] % (self.nodes * self.local_size) == 0:
-                sh = NamedSharding(self.mesh, P(("node", "local"), *([None] * (a.ndim - 1))))
-                a = jax.device_put(a, sh)
-            out.append(a)
+            n = a.shape[0]
+            if n % world != 0:
+                keep = (n // world) * world
+                if keep == 0:
+                    raise ValueError(
+                        f"batch of {n} rows cannot be sharded over {world} devices"
+                    )
+                if not self._trim_warned:
+                    import warnings
+
+                    warnings.warn(
+                        f"DASO batch of {n} rows is not divisible by the {world}-device "
+                        f"mesh; trimming to {keep}. This drops {n - keep} rows from "
+                        "EVERY such batch — size your batches as a multiple of the "
+                        "device count to train on all data.",
+                        RuntimeWarning,
+                        stacklevel=3,
+                    )
+                    self._trim_warned = True
+                a = a[:keep]
+            sh = NamedSharding(self.mesh, P(("node", "local"), *([None] * (a.ndim - 1))))
+            out.append(jax.device_put(a, sh))
         return tuple(out)
 
     def step(self, x, y) -> jax.Array:

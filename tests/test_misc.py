@@ -85,7 +85,9 @@ def test_tiling():
     p_sz = ht.get_comm().size
     assert st.tile_locations.shape == (p_sz, p_sz)
     t0 = st[0, 0]
-    assert t0.shape[0] == 16 // p_sz
+    # first chunk takes the remainder (reference chunk layout: sizes differ by <= 1)
+    _, lshape0, _ = ht.get_comm().chunk((16, 4), 0, rank=0)
+    assert t0.shape[0] == lshape0[0]
     st[0, 0] = np.zeros_like(np.asarray(t0))
     assert float(a.larray[0, 0]) == 0.0
     sq = SquareDiagTiles(a, tiles_per_proc=1)
