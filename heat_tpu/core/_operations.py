@@ -7,6 +7,13 @@ distribution matching — redistributing the non-dominant operand onto the domin
 operand's chunk map (:113-165) — is unnecessary here: operands are global arrays whose
 shardings XLA reconciles; only the *logical* split of the result is computed, following
 the reference's dominance rules (:57-71): the leftmost non-``None`` split wins.
+
+Ragged split axes ride the padded physical layout (see ``dndarray.py``): the hot
+templates compute directly on the sharded physical arrays — elementwise/cumulative ops
+let the pad carry garbage (it sits at the global END of the axis, so it never
+contaminates the valid region), and reductions across the split axis first fill the
+pad with the operation's neutral element (the reference's neutral-element fill for
+empty ranks, _operations.py:414-425, repurposed for pad rows).
 """
 
 from __future__ import annotations
@@ -24,6 +31,31 @@ from .communication import sanitize_comm
 from .dndarray import DNDarray
 
 __all__ = []
+
+
+def __neutral_for(partial_op: Callable, dtype) -> Optional[object]:
+    """Neutral element with which pad rows are filled before ``partial_op`` reduces
+    across the split axis (None = no fill known; caller falls back to the logical
+    view)."""
+    if partial_op in (jnp.sum, jnp.nansum, jnp.count_nonzero):
+        return 0
+    if partial_op in (jnp.prod, jnp.nanprod):
+        return 1
+    if partial_op in (jnp.max, jnp.argmax, jnp.nanmax):
+        dt = np.dtype(dtype)
+        if dt.kind == "b":
+            return False
+        return np.iinfo(dt).min if dt.kind in "iu" else -np.inf
+    if partial_op in (jnp.min, jnp.argmin, jnp.nanmin):
+        dt = np.dtype(dtype)
+        if dt.kind == "b":
+            return True
+        return np.iinfo(dt).max if dt.kind in "iu" else np.inf
+    if partial_op is jnp.all:
+        return True
+    if partial_op is jnp.any:
+        return False
+    return None
 
 
 def __binary_op(
@@ -67,7 +99,11 @@ def __binary_op(
             arrays.append(jnp.asarray(t))
 
     out_shape = stride_tricks.broadcast_shapes(
-        *[tuple(np.shape(a)) if not hasattr(a, "shape") else tuple(a.shape) for a in arrays]
+        *[
+            tuple(t.shape) if isinstance(t, DNDarray) else
+            (tuple(np.shape(a)) if not hasattr(a, "shape") else tuple(a.shape))
+            for t, a in zip((t1, t2), arrays)
+        ]
     )
 
     # output split: leftmost non-None split among DNDarray operands, remapped through
@@ -82,6 +118,44 @@ def __binary_op(
 
     device = dnd_ops[0].device if dnd_ops else _devices.get_device()
     comm = dnd_ops[0].comm if dnd_ops else sanitize_comm(None)
+
+    # Ragged fast path: when an operand carries a padded split axis, compute on the
+    # sharded physical arrays instead of gathering the logical views — garbage in the
+    # pad region stays in the pad region (same physical extent on every operand).
+    phys = (
+        out_split is not None
+        and where is None
+        and dnd_ops
+        and any(t.is_padded for t in dnd_ops)
+        and (out is None or out.split == out_split)
+    )
+    if phys:
+        from .communication import MeshCommunication
+
+        comm_pad = next((t.comm for t in dnd_ops if isinstance(t.comm, MeshCommunication)), None)
+        phys_arrays = []
+        for t, a in zip((t1, t2), arrays):
+            and_shape = tuple(t.shape) if isinstance(t, DNDarray) else tuple(np.shape(a))
+            ndim_a = len(and_shape)
+            ax_t = ndim_a - (len(out_shape) - out_split)
+            if ax_t < 0 or ndim_a == 0 or and_shape[ax_t] == 1:
+                # scalars / broadcast-1 axes broadcast over the padded extent too
+                phys_arrays.append(t.larray if isinstance(t, DNDarray) else a)
+            elif isinstance(t, DNDarray) and t.split == ax_t and and_shape[ax_t] == out_shape[out_split]:
+                phys_arrays.append(t.parray)
+            elif and_shape[ax_t] == out_shape[out_split] and comm_pad is not None and (
+                not isinstance(t, DNDarray) or t.split is None
+            ):
+                # replicated operand (raw array or unsplit DNDarray) at full logical
+                # extent: pad it to the shared physical extent
+                phys_arrays.append(
+                    comm_pad.pad_physical(t.larray if isinstance(t, DNDarray) else jnp.asarray(a), ax_t)
+                )
+            else:
+                phys = False
+                break
+        if phys:
+            arrays = phys_arrays
 
     result = operation(*arrays, **fn_kwargs)
     if result.dtype != promoted.jnp_type() and np.dtype(result.dtype).kind != "b":
@@ -99,10 +173,15 @@ def __binary_op(
 
     if out is not None:
         sanitation.sanitize_out(out, out_shape, out_split, device)
-        out.larray = jnp.broadcast_to(result, out.shape).astype(out.dtype.jnp_type())
+        if tuple(result.shape) == out_shape or tuple(result.shape) == tuple(out.pshape):
+            out.larray = result.astype(out.dtype.jnp_type())
+        else:
+            out.larray = jnp.broadcast_to(result, out.shape).astype(out.dtype.jnp_type())
         return out
 
-    return DNDarray(result, tuple(result.shape), res_dtype, out_split, device, comm, True)
+    # result.shape is the physical shape on the ragged fast path; out_shape is the
+    # logical one — DNDarray.__init__ reconciles either form
+    return DNDarray(result, out_shape, res_dtype, out_split, device, comm, True)
 
 
 def __local_op(
@@ -119,13 +198,26 @@ def __local_op(
     from .types import canonical_heat_type
 
     sanitation.sanitize_in(x)
-    result = operation(x.larray, **kwargs)
+    # compute on the physical array: elementwise ops keep the pad in the pad region
+    result = operation(x.parray, **kwargs)
+    if tuple(result.shape) == tuple(x.parray.shape):
+        gshape = x.shape
+    elif x.is_padded:
+        # shape-changing op (e.g. diff): the physical result is not the canonical
+        # padded layout of any logical shape — recompute on the logical view
+        result = operation(x.larray, **kwargs)
+        gshape = tuple(result.shape)
+    else:
+        gshape = tuple(result.shape)
     res_dtype = canonical_heat_type(result.dtype)
     if out is not None:
-        sanitation.sanitize_out(out, x.shape, x.split, x.device)
-        out.larray = jnp.broadcast_to(result, out.shape).astype(out.dtype.jnp_type())
+        sanitation.sanitize_out(out, gshape, x.split, x.device)
+        if tuple(result.shape) == tuple(out.pshape) or tuple(result.shape) == tuple(out.shape):
+            out.larray = result.astype(out.dtype.jnp_type())
+        else:
+            out.larray = jnp.broadcast_to(result, out.shape).astype(out.dtype.jnp_type())
         return out
-    return DNDarray(result, tuple(result.shape), res_dtype, x.split, x.device, x.comm, True)
+    return DNDarray(result, gshape, res_dtype, x.split, x.device, x.comm, True)
 
 
 def __reduce_op(
@@ -149,24 +241,53 @@ def __reduce_op(
 
     sanitation.sanitize_in(x)
     axis = stride_tricks.sanitize_axis(x.shape, axis)
-    result = partial_op(x.larray, axis=axis, keepdims=keepdims, **kwargs)
-    result = jnp.asarray(result)
 
     # split bookkeeping: reduced split axis -> None; earlier axes removed shift it left
     split = x.split
+    xsplit = None if x.split is None else int(x.split) % max(x.ndim, 1)
+    axes = range(x.ndim) if axis is None else ((axis,) if isinstance(axis, int) else tuple(axis))
+    split_reduced = xsplit is not None and (axis is None or xsplit in axes)
     if split is not None:
-        axes = range(x.ndim) if axis is None else ((axis,) if isinstance(axis, int) else axis)
-        if axis is None or split in axes:
+        if split_reduced:
             split = None
         elif not keepdims:
-            split -= sum(1 for a in axes if a < split)
+            split = xsplit - sum(1 for a in axes if a < xsplit)
+        else:
+            split = xsplit
+
+    # pad handling: a reduction across the split axis must not see the pad — fill it
+    # with the op's neutral element (reference neutral-element fill for empty chunks,
+    # _operations.py:414-425); reductions over other axes keep the pad in the pad
+    # region of the (still padded, still sharded) result
+    if x.is_padded and split_reduced:
+        if partial_op in (jnp.argmax, jnp.argmin) and axis is None:
+            # flattened arg-reductions return flat indices: those must be logical
+            operand = x.larray
+        else:
+            neutral = __neutral_for(partial_op, x.dtype.jnp_type())
+            operand = x.filled(neutral) if neutral is not None else x.larray
+    else:
+        operand = x.parray
+    result = partial_op(operand, axis=axis, keepdims=keepdims, **kwargs)
+    result = jnp.asarray(result)
+
+    # the logical result shape (the physical one may carry the pad through)
+    if axis is None:
+        out_gshape = tuple(1 for _ in x.shape) if keepdims else ()
+    elif keepdims:
+        out_gshape = tuple(1 if d in axes else s for d, s in enumerate(x.shape))
+    else:
+        out_gshape = tuple(s for d, s in enumerate(x.shape) if d not in axes)
 
     res_dtype = canonical_heat_type(result.dtype)
     if out is not None:
-        sanitation.sanitize_out(out, tuple(result.shape), split, x.device)
-        out.larray = jnp.broadcast_to(result, out.shape).astype(out.dtype.jnp_type())
+        sanitation.sanitize_out(out, out_gshape, split, x.device)
+        if tuple(result.shape) == tuple(out.pshape) or tuple(result.shape) == tuple(out.shape):
+            out.larray = result.astype(out.dtype.jnp_type())
+        else:
+            out.larray = jnp.broadcast_to(result, out.shape).astype(out.dtype.jnp_type())
         return out
-    return DNDarray(result, tuple(result.shape), res_dtype, split, x.device, x.comm, True)
+    return DNDarray(result, out_gshape, res_dtype, split, x.device, x.comm, True)
 
 
 def __cum_op(
@@ -189,7 +310,9 @@ def __cum_op(
     axis = stride_tricks.sanitize_axis(x.shape, axis)
     if axis is None:
         raise NotImplementedError("cumulative operations over flattened arrays: pass axis")
-    result = partial_op(x.larray, axis=axis)
+    # physical compute is safe even along a padded split axis: the pad sits at the
+    # global END, so the cumulative prefix over the valid region never sees it
+    result = partial_op(x.parray, axis=axis)
     if dtype is not None:
         result = result.astype(canonical_heat_type(dtype).jnp_type())
     res_dtype = canonical_heat_type(result.dtype)
@@ -197,4 +320,4 @@ def __cum_op(
         sanitation.sanitize_out(out, x.shape, x.split, x.device)
         out.larray = result.astype(out.dtype.jnp_type())
         return out
-    return DNDarray(result, tuple(result.shape), res_dtype, x.split, x.device, x.comm, True)
+    return DNDarray(result, x.shape, res_dtype, x.split, x.device, x.comm, True)

@@ -212,10 +212,13 @@ class MeshCommunication(Communication):
 
     def is_shardable(self, shape: Sequence[int], split: Optional[int]) -> bool:
         """
-        Whether ``shape`` can be physically partitioned on ``split`` over this mesh.
-        JAX requires the split axis to be divisible by the mesh size; ragged
-        distributions (reference dndarray.py:1033 allows arbitrary lshape maps) fall
-        back to replicated placement with logical ``split`` metadata retained.
+        Whether ``shape`` can be physically partitioned on ``split`` over this mesh
+        *without padding*: JAX NamedShardings require the split axis to be divisible
+        by the mesh size. Non-divisible ("ragged") axes — which the reference chunks
+        with the remainder spread over low ranks, communication.py:161-210 — are
+        still genuinely distributed here, via the padded physical layout (see
+        :meth:`padded_dim`/:meth:`placed`); this predicate only reports whether the
+        pad is empty.
         """
         if split is None:
             return True
@@ -225,16 +228,87 @@ class MeshCommunication(Communication):
         split = int(split) % len(shape)
         return shape[split] % self.size == 0
 
+    # ------------------------------------------------------------------ padded layout
+    #
+    # JAX shardings are equal-chunk; the reference allows ragged distributions
+    # (arbitrary axis lengths chunked per communication.py:161-210). The TPU-native
+    # answer (SURVEY §7(a)) is a *padded physical layout*: an array split on an axis
+    # of logical length n is physically stored with that axis padded at the global
+    # END to ceil(n/p)*p and sharded evenly; the logical gshape is metadata. Because
+    # the pad sits at the end, any in-bounds index is identical in logical and
+    # physical coordinates, so indexing and elementwise compute run directly on the
+    # sharded physical array. Reductions/contractions across the split axis mask the
+    # pad with the operation's neutral element first (`_operations.py`), and
+    # consumers of the logical array slice the pad off (``DNDarray.larray``).
+
+    def padded_dim(self, n: int) -> int:
+        """Physical length of a split axis of logical length ``n``: the smallest
+        multiple of the mesh size >= n (== n when already divisible)."""
+        p = self.size
+        return -(-int(n) // p) * p
+
+    def padded_shape(self, shape: Sequence[int], split: Optional[int]) -> Tuple[int, ...]:
+        """Physical shape of a logically ``shape``-d array split on ``split``."""
+        shape = tuple(int(s) for s in shape)
+        if split is None or not shape or not self.is_distributed():
+            return shape
+        split = int(split) % len(shape)
+        return shape[:split] + (self.padded_dim(shape[split]),) + shape[split + 1 :]
+
+    def pad_physical(self, data: "jax.Array", split: int, fill=0) -> "jax.Array":
+        """Pad a *logical* array at the end of ``split`` up to the physical shape,
+        filling with ``fill`` (the consuming op's neutral element; 0 by default)."""
+        split = int(split) % data.ndim
+        n = data.shape[split]
+        pn = self.padded_dim(n)
+        if pn == n:
+            return data
+        widths = [(0, 0)] * data.ndim
+        widths[split] = (0, pn - n)
+        return jax.numpy.pad(data, widths, constant_values=fill)
+
+    def placed(
+        self,
+        data: "jax.Array",
+        split: Optional[int],
+        gshape: Optional[Sequence[int]] = None,
+        fill=0,
+    ) -> "jax.Array":
+        """
+        Put ``data`` into the canonical physical layout for ``split``: padded at the
+        global end of the split axis to an even multiple of the mesh size, and
+        sharded over the mesh (replicated when ``split`` is None). Accepts either
+        the logical array (padding applied here) or an already-padded physical
+        array (placement re-asserted only). This one placement subsumes the
+        reference's ``resplit_``/``redistribute_`` Send/Recv choreography
+        (reference dndarray.py:1033-1362) — XLA emits the slice-exchange
+        collectives.
+        """
+        if split is None or data.ndim == 0:
+            return jax.device_put(data, self.sharding(data.ndim, None))
+        split = int(split) % data.ndim
+        gshape = tuple(data.shape) if gshape is None else tuple(int(s) for s in gshape)
+        pshape = self.padded_shape(gshape, split)
+        if tuple(data.shape) == pshape:
+            pass  # already physical
+        elif data.shape[split] == gshape[split]:
+            data = self.pad_physical(data, split, fill=fill)
+        else:
+            raise ValueError(
+                f"array of shape {tuple(data.shape)} is neither the logical {gshape} "
+                f"nor the physical {pshape} layout for split={split}"
+            )
+        return jax.device_put(data, self.sharding(data.ndim, split))
+
     def shard(self, array: "jax.Array", split: Optional[int]) -> "jax.Array":
         """
-        Places ``array`` according to ``split``: partitioned over the mesh when the
-        axis is divisible by the mesh size, replicated otherwise. This is the whole of
-        the reference's ``resplit_``/``redistribute_`` machinery
-        (dndarray.py:1033-1362) — a single resharding ``device_put``; XLA emits the
-        all-gather / slice-exchange collectives.
+        Places ``array`` (a *logical* global array) according to ``split`` — padding
+        the split axis into the physical layout when it is not divisible by the mesh
+        size. NOTE: for ragged axes the returned array is the padded physical array;
+        callers tracking logical shapes should use :meth:`placed` and keep the
+        logical gshape as metadata (``DNDarray`` does).
         """
-        eff_split = split if self.is_shardable(array.shape, split) else None
-        return jax.device_put(array, self.sharding(array.ndim, eff_split))
+        return self.placed(array, split)
 
     # ------------------------------------------------------------------ collectives
     #
@@ -297,8 +371,25 @@ class MeshCommunication(Communication):
         return self.__collective("allgather", split, x.ndim)(x)
 
     def Allgatherv(self, x, split: int = 0):
-        """Balanced layouts make the vector form identical to :meth:`Allgather`."""
-        return self.Allgather(x, split=split)
+        """
+        Vector form of :meth:`Allgather`: accepts *ragged* layouts — a split axis of
+        any length (the reference's counts/displs collectives,
+        communication.py:211-240, 1002-1198). The result is the replicated logical
+        array; ragged chunks ride the padded physical layout and the pad is sliced
+        off here.
+        """
+        x = jax.numpy.asarray(x)
+        if x.ndim == 0:
+            raise ValueError("collectives operate on arrays with a split axis, got a scalar")
+        split = int(split) % x.ndim
+        if self.is_shardable(x.shape, split):
+            return self.Allgather(x, split=split)
+        placed = self.placed(x, split)
+        gathered = jax.device_put(placed, self.sharding(x.ndim, None))
+        idx = tuple(
+            slice(0, x.shape[d]) if d == split else slice(None) for d in range(x.ndim)
+        )
+        return gathered[idx]
 
     def Gather(self, x, root: int = 0, split: int = 0):
         """Gather chunks to the root (reference Gather(v), communication.py:1476-1873);
@@ -306,8 +397,8 @@ class MeshCommunication(Communication):
         return self.Allgather(x, split=split)
 
     def Gatherv(self, x, root: int = 0, split: int = 0):
-        """Vector form of :meth:`Gather` (balanced → identical)."""
-        return self.Allgather(x, split=split)
+        """Vector form of :meth:`Gather` — ragged-capable like :meth:`Allgatherv`."""
+        return self.Allgatherv(x, split=split)
 
     def Scatter(self, x, root: int = 0, split: int = 0):
         """Partition the root's array across the mesh along ``split`` (reference
@@ -316,8 +407,12 @@ class MeshCommunication(Communication):
         return self.__prep(x, split)[0]
 
     def Scatterv(self, x, root: int = 0, split: int = 0):
-        """Vector form of :meth:`Scatter` (balanced → identical)."""
-        return self.Scatter(x, root=root, split=split)
+        """Vector form of :meth:`Scatter`: accepts ragged axes via the padded
+        physical layout (reference communication.py:1476-1873 with counts/displs)."""
+        x = jax.numpy.asarray(x)
+        if x.ndim == 0:
+            raise ValueError("collectives operate on arrays with a split axis, got a scalar")
+        return self.placed(x, int(split) % x.ndim)
 
     def Bcast(self, x, root: int = 0, split: int = 0):
         """
@@ -360,8 +455,20 @@ class MeshCommunication(Communication):
         return self.__collective("alltoall", cur, x.ndim, sa=split_axis)(x)
 
     def Alltoallv(self, x, split_axis: int, concat_axis: int):
-        """Vector form of :meth:`Alltoall` (balanced → identical)."""
-        return self.Alltoall(x, split_axis, concat_axis)
+        """
+        Vector form of :meth:`Alltoall`: accepts ragged axes (the reference's
+        Alltoallw axis rotation with per-rank counts, communication.py:1199-1475).
+        The re-chunk is a single resharding placement from ``concat_axis`` to
+        ``split_axis`` — XLA emits the all-to-all.
+        """
+        x = jax.numpy.asarray(x)
+        split_axis = int(split_axis) % x.ndim
+        concat_axis = int(concat_axis) % x.ndim
+        if split_axis == concat_axis:
+            raise ValueError("split_axis and concat_axis must differ")
+        if self.is_shardable(x.shape, split_axis) and self.is_shardable(x.shape, concat_axis):
+            return self.Alltoall(x, split_axis, concat_axis)
+        return self.placed(x, split_axis)
 
     def Ppermute(self, x, shift: int = 1, split: int = 0):
         """
@@ -555,15 +662,15 @@ MPI_SELF = SELF
 __default_comm: MeshCommunication = WORLD
 
 
-def ensure_placement(data, split, comm):
+def ensure_placement(data, split, comm, gshape=None):
     """
     Reconcile an array's physical layout with its ``split`` metadata: shape-changing
     XLA outputs can come back replicated even when the split axis shards evenly.
-    ``comm.shard`` under the standard guards (sharded when divisible, the documented
-    replicated fallback otherwise); a no-op for local/replicated cases.
+    Applies the canonical (padded, sharded) placement via :meth:`MeshCommunication.placed`;
+    a no-op for local/replicated cases.
     """
     if split is not None and isinstance(comm, MeshCommunication) and comm.is_distributed():
-        return comm.shard(data, split)
+        return comm.placed(data, split, gshape)
     return data
 
 

@@ -14,12 +14,18 @@ single-controller SPMD for TPU:
   data motion into ICI collectives — the reference's Send/Recv choreography
   (redistribute_/resplit_, dndarray.py:1033-1362) therefore collapses into a single
   resharding placement.
-* ``larray`` returns the backing ``jax.Array`` (the controller addresses all shards);
-  per-device chunk geometry is still available via :attr:`lshape_map`/``comm.chunk`` —
-  the layout math matches the reference exactly.
-* Ragged layouts: JAX shardings are balanced by construction, so ``balanced`` is
-  always ``True`` and ``balance_`` is a no-op; a split axis not divisible by the mesh
-  size is placed replicated while retaining logical ``split`` (graceful degradation).
+* ``larray`` returns the *logical* global ``jax.Array`` (the controller addresses all
+  shards); per-device chunk geometry is still available via
+  :attr:`lshape_map`/``comm.chunk`` — the layout math matches the reference exactly.
+* Ragged layouts (split axis not divisible by the mesh size — reference
+  communication.py:161-210 chunks any length): the array is stored in a **padded
+  physical layout** — the split axis padded at the global end to ``ceil(n/p)*p`` and
+  sharded evenly (:attr:`parray`, physical shape :attr:`pshape`). The pad content is
+  unspecified; reductions/contractions across the split axis mask it with the
+  operation's neutral element (`_operations.py`), in-bounds indexing is identical in
+  logical and physical coordinates (pad at the end), and :attr:`larray` slices the
+  pad off. ``balanced`` stays ``True`` — chunks differ by at most the pad of the
+  last shards, mirroring the reference's max-1 imbalance.
 """
 
 from __future__ import annotations
@@ -96,14 +102,26 @@ class DNDarray:
         comm: Communication,
         balanced: Optional[bool] = True,
     ):
+        gshape = tuple(int(s) for s in gshape)
+        # Normalize to the canonical physical layout (padded + sharded) at the one
+        # choke point every wrap goes through. Tracers are left untouched (placement
+        # inside jit is the caller's concern); non-distributed cases are no-ops.
+        if (
+            split is not None
+            and isinstance(comm, MeshCommunication)
+            and not isinstance(array, jax.core.Tracer)
+            and comm.is_distributed()
+        ):
+            array = comm.placed(array, split, gshape)
         self.__array = array
-        self.__gshape = tuple(int(s) for s in gshape)
+        self.__gshape = gshape
         self.__dtype = dtype
         self.__split = split
         self.__device = device
         self.__comm = comm
         self.__balanced = True if balanced is None else balanced
         self.__lshape_map = None
+        self.__logical = None  # cached logical view of a padded physical array
         self.__halo_next = None
         self.__halo_prev = None
 
@@ -123,17 +141,84 @@ class DNDarray:
     @property
     def larray(self) -> jax.Array:
         """
-        The backing ``jax.Array``. NOTE: in single-controller SPMD this is the *global*
-        array (all shards addressable from the one controller); the reference's
-        per-rank local tensor view corresponds to one shard of it
-        (``self.larray.addressable_shards``).
+        The *logical* global ``jax.Array``. NOTE: in single-controller SPMD this is
+        the global array (all shards addressable from the one controller); the
+        reference's per-rank local tensor view corresponds to one shard of it
+        (``self.larray.addressable_shards``). For ragged split axes this is a view
+        of the padded physical array (:attr:`parray`) with the pad sliced off —
+        sharded compute paths should prefer :attr:`parray`/:meth:`filled`.
         """
-        return self.__array
+        if not self.is_padded:
+            return self.__array
+        if self.__logical is None:
+            idx = tuple(
+                slice(0, self.__gshape[d]) if d == self.__split_axis else slice(None)
+                for d in range(len(self.__gshape))
+            )
+            self.__logical = self.__array[idx]
+        return self.__logical
 
     @larray.setter
     def larray(self, array: jax.Array):
-        """Setter for larray; does not update metadata (parity: dndarray.py larray setter)."""
+        """Setter for larray; does not update metadata (parity: dndarray.py larray
+        setter). Accepts a logical or physical array and re-establishes the
+        canonical placement."""
+        if (
+            self.__split is not None
+            and isinstance(self.__comm, MeshCommunication)
+            and not isinstance(array, jax.core.Tracer)
+            and self.__comm.is_distributed()
+            and tuple(array.shape) in (self.__gshape, self.pshape)
+        ):
+            array = self.__comm.placed(array, self.__split, self.__gshape)
         self.__array = array
+        self.__logical = None
+
+    @property
+    def parray(self) -> jax.Array:
+        """The backing *physical* ``jax.Array``: the split axis padded at the global
+        end to an even multiple of the mesh size and sharded over it. Equal to
+        :attr:`larray` when no padding is needed. Pad content is unspecified."""
+        return self.__array
+
+    @property
+    def __split_axis(self) -> Optional[int]:
+        """The split axis normalized to a non-negative index."""
+        if self.__split is None:
+            return None
+        return int(self.__split) % max(len(self.__gshape), 1)
+
+    @property
+    def pshape(self) -> Tuple[int, ...]:
+        """The physical (padded) global shape."""
+        return tuple(self.__array.shape)
+
+    @property
+    def is_padded(self) -> bool:
+        """Whether the physical layout carries pad rows on the split axis."""
+        s = self.__split_axis
+        return s is not None and len(self.__gshape) > 0 and tuple(self.__array.shape) != self.__gshape
+
+    @property
+    def pad_count(self) -> int:
+        """Number of pad positions on the split axis (0 when evenly divisible)."""
+        s = self.__split_axis
+        if s is None or not self.__gshape:
+            return 0
+        return int(self.__array.shape[s]) - self.__gshape[s]
+
+    def filled(self, fill) -> jax.Array:
+        """The physical array with the pad region set to ``fill`` — the form sharded
+        reductions/contractions consume (``fill`` = the op's neutral element)."""
+        if not self.is_padded:
+            return self.__array
+        s = self.__split_axis
+        n = self.__gshape[s]
+        iota = jnp.arange(self.__array.shape[s])
+        shape = [1] * len(self.__gshape)
+        shape[s] = self.__array.shape[s]
+        mask = iota.reshape(shape) < n
+        return jnp.where(mask, self.__array, jnp.asarray(fill, dtype=self.__array.dtype))
 
     @property
     def balanced(self) -> bool:
@@ -191,8 +276,8 @@ class DNDarray:
 
     @property
     def lshape(self) -> Tuple[int, ...]:
-        """Shape of the controller-addressable data (== global shape here)."""
-        return tuple(self.__array.shape)
+        """Shape of the controller-addressable logical data (== global shape here)."""
+        return self.__gshape
 
     @property
     def lshape_map(self) -> np.ndarray:
@@ -273,7 +358,7 @@ class DNDarray:
     @property
     def array_with_halos(self) -> jax.Array:
         """The local array including any fetched halos (global view: the array itself)."""
-        return self.__array
+        return self.larray
 
     # ------------------------------------------------------------------ layout ops
     def is_balanced(self, force_check: bool = False) -> bool:
@@ -314,17 +399,21 @@ class DNDarray:
         if axis == self.__split:
             return self
         comm = self.__comm
-        if isinstance(comm, MeshCommunication):
-            self.__array = comm.shard(self.__array, axis)
+        if isinstance(comm, MeshCommunication) and comm.is_distributed():
+            # go through the logical view: the old axis's pad is dropped, the new
+            # axis's pad (if ragged) is established by placed()
+            self.__array = comm.placed(self.larray, axis, self.__gshape)
         self.__split = axis
         self.__lshape_map = None
+        self.__logical = None
         return self
 
     def redistribute_(self, lshape_map=None, target_map=None) -> None:
         """
         Redistribution to an explicit target chunk map. Balanced shardings make every
         layout canonical, so this only validates the arguments and (re)applies the
-        sharding (reference dndarray.py:1033-1237 moved data with chained Send/Recv).
+        canonical placement (reference dndarray.py:1033-1237 moved data with chained
+        Send/Recv).
         """
         if self.__split is None:
             return
@@ -336,8 +425,9 @@ class DNDarray:
                     f"{tm.sum(axis=0)[self.__split]} != {self.__gshape[self.__split]}"
                 )
         comm = self.__comm
-        if isinstance(comm, MeshCommunication):
-            self.__array = comm.shard(self.__array, self.__split)
+        if isinstance(comm, MeshCommunication) and comm.is_distributed():
+            self.__array = comm.placed(self.__array, self.__split, self.__gshape)
+            self.__logical = None
 
     def get_halo(self, halo_size: int) -> None:
         """
@@ -362,8 +452,8 @@ class DNDarray:
         idx_prev[split] = slice(0, halo_size)
         idx_next = [slice(None)] * self.ndim
         idx_next[split] = slice(self.shape[split] - halo_size, self.shape[split])
-        self.__halo_prev = self.__array[tuple(idx_next)]
-        self.__halo_next = self.__array[tuple(idx_prev)]
+        self.__halo_prev = self.larray[tuple(idx_next)]
+        self.__halo_next = self.larray[tuple(idx_prev)]
 
     # ------------------------------------------------------------------ conversions
     def astype(self, dtype, copy: bool = True) -> "DNDarray":
@@ -380,6 +470,7 @@ class DNDarray:
                 casted, self.shape, dtype, self.split, self.device, self.comm, True
             )
         self.__array = casted
+        self.__logical = None
         self.__dtype = dtype
         return self
 
@@ -389,19 +480,27 @@ class DNDarray:
         (parity: dndarray.py:974)."""
         if self.size != 1:
             raise ValueError("only one-element DNDarrays can be converted to Python scalars")
-        return self.__array.reshape(()).item()
+        return self.larray.reshape(()).item()
 
     def numpy(self) -> np.ndarray:
-        """The global array as a numpy array (parity: dndarray.py:995 — there a
-        resplit(None) gather; here a device fetch). In a multi-controller run the
+        """The global logical array as a numpy array (parity: dndarray.py:995 — there
+        a resplit(None) gather; here a device fetch). In a multi-controller run the
         shards on other hosts are gathered with ``process_allgather`` (every host
         gets the full array, like the reference's resplit(None))."""
         arr = self.__array
         if hasattr(arr, "is_fully_addressable") and not arr.is_fully_addressable:
             from jax.experimental import multihost_utils
 
-            return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
-        return np.asarray(jax.device_get(arr))
+            full = np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+            if self.is_padded:
+                s = self.__split_axis
+                idx = tuple(
+                    slice(0, self.__gshape[d]) if d == s else slice(None)
+                    for d in range(len(self.__gshape))
+                )
+                full = full[idx]
+            return full
+        return np.asarray(jax.device_get(self.larray))
 
     def __array__(self, dtype=None) -> np.ndarray:
         arr = self.numpy()
@@ -455,52 +554,8 @@ class DNDarray:
         return printing.__str__(self)
 
     # ------------------------------------------------------------------ indexing
-    @staticmethod
-    def __split_after_getitem(key, gshape, split) -> Optional[int]:
-        """Infer the split of an indexing result. Conservative: distribution is kept
-        only when the split axis passes through untouched; otherwise the result is
-        logically unsplit (the reference keeps distribution through heavy
-        bookkeeping, dndarray.py:656-915 — correctness is identical, layout differs)."""
-        if split is None:
-            return None
-        ndim = len(gshape)
-        if not isinstance(key, tuple):
-            key = (key,)
-        # expand ellipsis
-        n_specified = sum(1 for k in key if k is not Ellipsis and k is not None)
-        expanded = []
-        for k in key:
-            if k is Ellipsis:
-                expanded.extend([slice(None)] * (ndim - n_specified))
-            else:
-                expanded.append(k)
-        while len(expanded) < ndim + sum(1 for k in expanded if k is None):
-            expanded.append(slice(None))
-        dim = 0  # input dim
-        out_dim = 0  # output dim
-        for k in expanded:
-            if k is None:
-                out_dim += 1
-                continue
-            if dim >= ndim:
-                break
-            if isinstance(k, slice):
-                if dim == split:
-                    return out_dim if k == slice(None) else None
-                dim += 1
-                out_dim += 1
-            elif isinstance(k, (int, np.integer)):
-                if dim == split:
-                    return None
-                dim += 1
-            else:  # advanced indexing
-                return None
-        if dim <= split:
-            return out_dim + (split - dim)
-        return None
-
     def __process_key(self, key):
-        """Convert DNDarray keys to jax arrays."""
+        """Convert DNDarray/list/numpy keys to jax arrays."""
         def conv(k):
             if isinstance(k, DNDarray):
                 return k.larray
@@ -512,15 +567,141 @@ class DNDarray:
             return tuple(conv(k) for k in key)
         return conv(key)
 
+    def __index_plan(self, key):
+        """
+        Resolve an indexing key into *physical* coordinates and infer the result's
+        split axis (the reference's distributed ``__getitem__`` bookkeeping,
+        dndarray.py:656-915, reduced to layout metadata: since the pad sits at the
+        global END of the split axis, any in-bounds logical index is the identical
+        physical index — only negative indices and open slice bounds need resolving
+        against the logical extent).
+
+        Returns ``(norm_key, new_split, fast)``: ``norm_key`` applies directly to
+        :attr:`parray` when ``fast`` is True (otherwise the caller must index the
+        logical :attr:`larray` with the original key); ``new_split`` is the split
+        axis of the result (``None`` = replicated).
+        """
+        gshape = self.__gshape
+        split = self.__split_axis
+        ndim = len(gshape)
+        jkey = self.__process_key(key)
+        if not isinstance(jkey, tuple):
+            jkey = (jkey,)
+
+        # expand Ellipsis to the right number of full slices
+        n_consumed = 0
+        for k in jkey:
+            if k is None or k is Ellipsis:
+                continue
+            n_consumed += k.ndim if (hasattr(k, "dtype") and k.dtype == np.bool_) else 1
+        expanded = []
+        seen_ellipsis = False
+        for k in jkey:
+            if k is Ellipsis:
+                if seen_ellipsis:
+                    raise IndexError("an index can only have a single ellipsis ('...')")
+                seen_ellipsis = True
+                expanded.extend([slice(None)] * (ndim - n_consumed))
+            else:
+                expanded.append(k)
+        # implicit trailing full slices
+        consumed = sum(
+            (k.ndim if (hasattr(k, "dtype") and k.dtype == np.bool_) else 1)
+            for k in expanded
+            if k is not None
+        )
+        expanded.extend([slice(None)] * (ndim - consumed))
+
+        n_advanced = sum(
+            1 for k in expanded if hasattr(k, "ndim") and not isinstance(k, (int, np.integer))
+        )
+        in_ax = 0
+        out_ax = 0
+        new_split = None
+        fast = True
+        norm = []
+        for k in expanded:
+            if k is None:
+                norm.append(None)
+                out_ax += 1
+            elif isinstance(k, slice):
+                if in_ax == split:
+                    start, stop, step = k.indices(gshape[split])
+                    # a descending slice that reaches index 0 has stop=-1, which
+                    # must stay "before the start", not wrap to the last element
+                    norm.append(slice(start, None if (step < 0 and stop < 0) else stop, step))
+                    new_split = out_ax
+                else:
+                    norm.append(k)
+                in_ax += 1
+                out_ax += 1
+            elif isinstance(k, (bool, np.bool_)):
+                # scalar bool key: numpy adds a leading axis — not an integer index
+                fast = False
+                norm.append(k)
+                out_ax += 1
+            elif isinstance(k, (int, np.integer)):
+                kk = int(k)
+                if kk < 0:
+                    kk += gshape[in_ax]
+                if not 0 <= kk < gshape[in_ax]:
+                    raise IndexError(
+                        f"index {int(k)} is out of bounds for axis {in_ax} with size {gshape[in_ax]}"
+                    )
+                norm.append(kk)
+                in_ax += 1
+            elif hasattr(k, "dtype") and k.dtype == np.bool_:
+                covers = range(in_ax, in_ax + k.ndim)
+                if split in covers and self.is_padded:
+                    d = split - in_ax
+                    widths = [(0, 0)] * k.ndim
+                    widths[d] = (0, self.pshape[split] - gshape[split])
+                    k = jnp.pad(k, widths, constant_values=False)
+                norm.append(k)
+                # a boolean mask yields one output axis; the result's row order is
+                # the mask's row order along the (former) split axis → keep split 0
+                # only in the canonical 1-advanced-key case below
+                if n_advanced == 1 and split in covers:
+                    new_split = out_ax
+                in_ax += k.ndim
+                out_ax += 1
+            elif hasattr(k, "ndim"):  # integer array
+                if in_ax == split:
+                    if self.is_padded:
+                        # negatives wrap and positives clamp at the LOGICAL extent
+                        # (jax's documented clamping), never exposing pad content
+                        n = gshape[split]
+                        k = jnp.clip(jnp.where(k < 0, k + n, k), 0, max(n - 1, 0))
+                    if n_advanced == 1 and k.ndim == 1:
+                        new_split = out_ax
+                norm.append(k)
+                in_ax += 1
+                out_ax += k.ndim if n_advanced == 1 else 1
+            else:
+                fast = False
+                norm.append(k)
+                in_ax += 1
+                out_ax += 1
+        if n_advanced > 1:
+            # multiple advanced keys: numpy may move result axes to the front —
+            # conservatively replicate instead of tracking the permutation
+            new_split = None
+        return tuple(norm), new_split, fast
+
     def __getitem__(self, key) -> "DNDarray":
         """
         Global indexing: accepts ints, slices, ellipsis, newaxis, boolean masks,
         integer arrays and DNDarrays (reference's fully distributed ``__getitem__``,
-        dndarray.py:656-915 — here plain global indexing, XLA handles the gathers).
+        dndarray.py:656-915). Distribution is preserved whenever the split axis is
+        consumed by a slice (including stepped/negative slices) or by the single
+        advanced key (1-D integer array / boolean mask); the result is re-placed on
+        its inferred split axis.
         """
-        jkey = self.__process_key(key)
-        result = self.__array[jkey]
-        new_split = DNDarray.__split_after_getitem(key, self.__gshape, self.__split)
+        norm, new_split, fast = self.__index_plan(key)
+        if fast:
+            result = self.__array[norm]
+        else:
+            result = self.larray[self.__process_key(key)]
         if np.isscalar(result) or (hasattr(result, "ndim") and result.ndim == 0):
             new_split = None
         return DNDarray(
@@ -530,17 +711,42 @@ class DNDarray:
     def __setitem__(self, key, value):
         """
         Global assignment via functional update (reference dndarray.py:1363-1681).
+        Runs directly on the physical array — in-bounds keys are identical in
+        logical and physical coordinates.
         """
         if isinstance(value, DNDarray):
             value = value.larray
         elif isinstance(value, (list, tuple, np.ndarray)):
             value = jnp.asarray(value, dtype=self.dtype.jnp_type())
+        # full-array boolean-mask assignment: .at does not take masks; use where
         jkey = self.__process_key(key)
-        # boolean-mask assignment: .at does not take masks; use where
-        if isinstance(jkey, jnp.ndarray) and jkey.dtype == np.bool_ and jkey.shape == self.__array.shape:
-            self.__array = jnp.where(jkey, jnp.asarray(value, dtype=self.__array.dtype), self.__array)
+        if (
+            isinstance(jkey, jnp.ndarray)
+            and jkey.dtype == np.bool_
+            and jkey.shape == self.__gshape
+        ):
+            if self.is_padded:
+                s = self.__split_axis
+                widths = [(0, 0)] * self.ndim
+                widths[s] = (0, self.pshape[s] - self.__gshape[s])
+                jkey = jnp.pad(jkey, widths, constant_values=False)
+                if hasattr(value, "shape") and tuple(value.shape) == self.__gshape:
+                    value = jnp.pad(value, widths)
+            self.__array = jnp.where(
+                jkey, jnp.asarray(value, dtype=self.__array.dtype), self.__array
+            )
+            self.__logical = None
             return
-        self.__array = self.__array.at[jkey].set(value)
+        norm, _, fast = self.__index_plan(key)
+        if fast:
+            self.__array = self.__array.at[norm].set(value)
+        else:
+            updated = self.larray.at[jkey].set(value)
+            comm = self.__comm
+            if isinstance(comm, MeshCommunication) and self.__split is not None and comm.is_distributed():
+                updated = comm.placed(updated, self.__split, self.__gshape)
+            self.__array = updated
+        self.__logical = None
 
     # dunder arithmetic/comparison operators are attached by the op modules
     # (arithmetics.py, relational.py, …) heat-style, see each module's tail.

@@ -4,14 +4,21 @@ Array creation functions.
 Parity with the reference's ``heat/core/factories.py`` (``arange`` :40, ``array``
 :150, ``asarray`` :434, ``empty`` :488, ``eye`` :586, the generic ``__factory``
 :665-718, ``full`` :789, ``linspace`` :896, ``logspace`` :982, ``meshgrid`` :1045,
-``ones`` :1128, ``zeros`` :1225 and the ``*_like`` variants). The reference allocates
-only the rank-local slab per process (``comm.chunk``); here each factory builds the
-global array lazily through jnp and places it with the sharding implied by ``split`` —
-on a mesh, XLA materialises only the per-device shard.
+``ones`` :1128, ``zeros`` :1225 and the ``*_like`` variants).
+
+**Sharded at birth.** The reference allocates only the rank-local slab per process
+(``comm.chunk``, factories.py:665-718); the equivalent here is that no factory ever
+materialises the global array on one device: on-device factories (zeros/ones/full/
+arange/linspace/eye/…) run as one jitted program with ``out_shardings`` set, so each
+device generates only its shard; host data (``array(numpy_obj, split=k)``) is placed
+with ``jax.make_array_from_callback``, which copies each device's slab directly —
+both paths also create the padded physical layout for ragged split axes in place.
 """
 
 from __future__ import annotations
 
+import functools
+import operator
 from typing import Iterable, List, Optional, Sequence, Tuple, Type, Union
 
 import numpy as np
@@ -47,10 +54,90 @@ __all__ = [
 
 
 def __place(data: jax.Array, split: Optional[int], comm: Communication) -> jax.Array:
-    """Apply the sharding implied by ``split`` (replicates when not shardable)."""
+    """Apply the canonical (padded, sharded) placement implied by ``split``."""
     if isinstance(comm, MeshCommunication) and split is not None:
-        return comm.shard(data, split)
+        return comm.placed(data, split)
     return data
+
+
+def __distributed(split: Optional[int], comm: Communication) -> bool:
+    return (
+        split is not None
+        and isinstance(comm, MeshCommunication)
+        and comm.is_distributed()
+    )
+
+
+@functools.lru_cache(maxsize=512)
+def __sharded_builder(kind: str, pshape: Tuple[int, ...], jdtype: str, sharding):
+    """One jitted generator program per (kind, physical shape, dtype, sharding):
+    with ``out_shardings`` set, every device materialises only its own shard — the
+    TPU-native analog of the reference's local-slab allocation
+    (factories.py:665-718)."""
+    dt = np.dtype(jdtype)
+    nelem = functools.reduce(operator.mul, pshape, 1)
+
+    if kind == "full":
+
+        def f(v):
+            return jnp.full(pshape, v, dtype=dt)
+
+    elif kind == "affine":
+        # start + step * global_index along a flat iota — arange and linspace
+        if dt.kind in "iu":
+            cdt = dt
+        else:
+            cdt = np.float64 if jax.config.jax_enable_x64 else np.float32
+
+        def f(start, step):
+            idx = jnp.arange(nelem, dtype=cdt)
+            return (start + idx * step).reshape(pshape).astype(dt)
+
+    elif kind == "eye":
+
+        def f():
+            r = jax.lax.broadcasted_iota(jnp.int32, pshape, 0)
+            c = jax.lax.broadcasted_iota(jnp.int32, pshape, 1)
+            return (r == c).astype(dt)
+
+    else:  # pragma: no cover
+        raise ValueError(kind)
+
+    return jax.jit(f, out_shardings=sharding)
+
+
+def __host_placed(
+    data: np.ndarray, split: int, comm: MeshCommunication, jdtype
+) -> jax.Array:
+    """
+    Place host (numpy) data split on ``split`` without staging the global array on
+    any device: ``jax.make_array_from_callback`` copies each device's slab straight
+    from host memory (the io.py slab-read pattern generalised, and the analog of the
+    reference's per-rank local slab copy factories.py:150-433). The final shard's
+    pad (ragged axes) is zero-filled here.
+    """
+    data = np.ascontiguousarray(np.asarray(data, dtype=np.dtype(jdtype)))
+    gshape = data.shape
+    split = int(split) % data.ndim
+    pshape = comm.padded_shape(gshape, split)
+    sharding = comm.sharding(data.ndim, split)
+    n = gshape[split]
+
+    def cb(index: Tuple[slice, ...]) -> np.ndarray:
+        sl = index[split]
+        start = sl.start or 0
+        stop = pshape[split] if sl.stop is None else sl.stop
+        valid_stop = min(stop, n)
+        idx = list(index)
+        idx[split] = slice(start, max(start, valid_stop))
+        chunk = data[tuple(idx)]
+        if stop > valid_stop:  # zero-fill the pad tail of the last shard(s)
+            widths = [(0, 0)] * data.ndim
+            widths[split] = (0, stop - max(start, valid_stop))
+            chunk = np.pad(chunk, widths)
+        return chunk
+
+    return jax.make_array_from_callback(pshape, sharding, cb)
 
 
 def __sanitize_split(split: Optional[int], is_split: Optional[int], shape) -> Optional[int]:
@@ -103,6 +190,7 @@ def array(
     device = devices.sanitize_device(device if device is not None else (obj.device if isinstance(obj, DNDarray) else None))
     comm = sanitize_comm(comm if comm is not None else (obj.comm if isinstance(obj, DNDarray) else None))
 
+    host_data = None
     if isinstance(obj, DNDarray):
         data = obj.larray
         if split is None and is_split is None:
@@ -110,20 +198,34 @@ def array(
     elif isinstance(obj, (jnp.ndarray, jax.Array)):
         data = obj
     else:
-        data = jnp.asarray(np.asarray(obj) if not np.isscalar(obj) and not isinstance(obj, (list, tuple)) else obj)
+        # host data: keep it in host memory so a split placement can copy each
+        # device's slab directly without staging the global array on one device
+        host_data = np.asarray(obj)
+        data = host_data
 
     if dtype is not None:
         dtype = canonical_heat_type(dtype)
-        data = data.astype(dtype.jnp_type()) if data.dtype != dtype.jnp_type() else data
-    else:
+    elif host_data is None:
         dtype = canonical_heat_type(data.dtype)
+    else:
+        # let jnp's promotion rules (x32 by default) pick the dtype without
+        # converting the whole host buffer
+        probe = host_data[:0] if host_data.ndim else host_data
+        dtype = canonical_heat_type(jnp.asarray(probe).dtype)
 
     if ndmin > 0 and data.ndim < ndmin:
         data = data.reshape((1,) * (ndmin - data.ndim) + tuple(data.shape))
 
     split = __sanitize_split(split, is_split, data.shape)
+    gshape = tuple(data.shape)
+
+    if host_data is not None and __distributed(split, comm):
+        placed = __host_placed(data, split, comm, dtype.jnp_type())
+        return DNDarray(placed, gshape, dtype, split, device, comm, True)
+
+    data = jnp.asarray(data, dtype=dtype.jnp_type())
     data = __place(data, split, comm)
-    return DNDarray(data, tuple(data.shape), dtype, split, device, comm, True)
+    return DNDarray(data, gshape, dtype, split, device, comm, True)
 
 
 def asarray(
@@ -148,14 +250,24 @@ def __factory(
     device,
     comm,
     order: str = "C",
+    fill_value=None,
 ) -> DNDarray:
-    """Abstract factory: build the global array, apply sharding, wrap (reference
-    factories.py:665-718)."""
+    """Abstract factory: every device generates only its own shard (reference
+    factories.py:665-718 allocates only the rank-local slab)."""
     shape = sanitize_shape(shape)
     dtype = canonical_heat_type(dtype)
     split = sanitize_axis(shape, split)
     device = devices.sanitize_device(device)
     comm = sanitize_comm(comm)
+    if __distributed(split, comm) and len(shape):
+        pshape = comm.padded_shape(shape, split)
+        build = __sharded_builder(
+            "full", pshape, np.dtype(dtype.jnp_type()).str, comm.sharding(len(shape), split)
+        )
+        if fill_value is None:
+            fill_value = 1 if local_factory is jnp.ones else 0
+        data = build(fill_value)
+        return DNDarray(data, shape, dtype, split, device, comm, True)
     data = local_factory(shape, dtype=dtype.jnp_type())
     data = __place(data, split, comm)
     return DNDarray(data, shape, dtype, split, device, comm, True)
@@ -198,6 +310,25 @@ def arange(
         start, stop, step = args
     else:
         raise TypeError(f"arange takes 1 to 3 positional arguments, got {len(args)}")
+    comm_r = sanitize_comm(comm)
+    if step == 0:
+        raise ValueError("arange: step must not be zero")
+    num = max(0, int(np.ceil((stop - start) / step)))
+    if __distributed(sanitize_axis((num,), split), comm_r) and num:
+        if dtype is not None:
+            dt = canonical_heat_type(dtype)
+        else:
+            dt = canonical_heat_type(
+                jnp.asarray(np.arange(0, 1, dtype=np.result_type(start, stop, step))).dtype
+            )
+        pshape = (comm_r.padded_dim(num),)
+        build = __sharded_builder(
+            "affine", pshape, np.dtype(dt.jnp_type()).str, comm_r.sharding(1, 0)
+        )
+        data = build(start, step)
+        return DNDarray(
+            data, (num,), dt, 0, devices.sanitize_device(device), comm_r, True
+        )
     data = jnp.arange(start, stop, step, dtype=dtype.jnp_type() if dtype is not None else None)
     return array(data, dtype=dtype, split=split, device=device, comm=comm)
 
@@ -234,6 +365,16 @@ def eye(
         shape = tuple(shape)
         n, m = (shape[0], shape[0]) if len(shape) == 1 else (shape[0], shape[1])
     dtype = canonical_heat_type(dtype)
+    comm_r = sanitize_comm(comm)
+    split_s = sanitize_axis((n, m), split)
+    if __distributed(split_s, comm_r):
+        pshape = comm_r.padded_shape((n, m), split_s)
+        build = __sharded_builder(
+            "eye", pshape, np.dtype(dtype.jnp_type()).str, comm_r.sharding(2, split_s)
+        )
+        return DNDarray(
+            build(), (n, m), dtype, split_s, devices.sanitize_device(device), comm_r, True
+        )
     data = jnp.eye(n, m, dtype=dtype.jnp_type())
     return array(data, dtype=dtype, split=split, device=device, comm=comm)
 
@@ -260,7 +401,7 @@ def full(
     def local_factory(shape, dtype=None):
         return jnp.full(shape, fill_value, dtype=dtype)
 
-    return __factory(shape, dtype, split, local_factory, device, comm, order)
+    return __factory(shape, dtype, split, local_factory, device, comm, order, fill_value=fill_value)
 
 
 def full_like(a, fill_value, dtype=None, split=None, device=None, comm=None, order="C") -> DNDarray:
@@ -286,9 +427,22 @@ def linspace(
     if num <= 0:
         raise ValueError(f"number of samples 'num' must be non-negative, got {num}")
     step = (stop - start) / max(1, num - int(bool(endpoint)))
-    data = jnp.linspace(start, stop, num, endpoint=endpoint,
-                        dtype=dtype.jnp_type() if dtype is not None else None)
-    ht = array(data, dtype=dtype, split=split, device=device, comm=comm)
+    comm_r = sanitize_comm(comm)
+    if __distributed(sanitize_axis((num,), split), comm_r):
+        if dtype is not None:
+            dt = canonical_heat_type(dtype)
+        else:
+            dt = types.float64 if jax.config.jax_enable_x64 else types.float32
+        pshape = (comm_r.padded_dim(num),)
+        build = __sharded_builder(
+            "affine", pshape, np.dtype(dt.jnp_type()).str, comm_r.sharding(1, 0)
+        )
+        data = build(float(start), float(step) if num > 1 else 0.0)
+        ht = DNDarray(data, (num,), dt, 0, devices.sanitize_device(device), comm_r, True)
+    else:
+        data = jnp.linspace(start, stop, num, endpoint=endpoint,
+                            dtype=dtype.jnp_type() if dtype is not None else None)
+        ht = array(data, dtype=dtype, split=split, device=device, comm=comm)
     if retstep:
         return ht, step
     return ht
@@ -305,7 +459,20 @@ def logspace(
     device: Optional[Union[str, Device]] = None,
     comm: Optional[Communication] = None,
 ) -> DNDarray:
-    """Numbers spaced evenly on a log scale (reference factories.py:982-1044)."""
+    """Numbers spaced evenly on a log scale (reference factories.py:982-1044):
+    ``base ** linspace(start, stop)`` — rides linspace's sharded-at-birth path."""
+    comm_r = sanitize_comm(comm)
+    if __distributed(sanitize_axis((int(num),), split), comm_r):
+        fdt = types.float64 if jax.config.jax_enable_x64 else types.float32
+        lin = linspace(start, stop, num=num, endpoint=endpoint, dtype=fdt,
+                       split=split, device=device, comm=comm)
+        out = DNDarray(
+            jnp.power(jnp.asarray(base, dtype=fdt.jnp_type()), lin.parray), (int(num),), fdt,
+            0, lin.device, lin.comm, True,
+        )
+        if dtype is not None:
+            return out.astype(canonical_heat_type(dtype))
+        return out
     data = jnp.logspace(start, stop, int(num), endpoint=endpoint, base=base,
                         dtype=dtype.jnp_type() if dtype is not None else None)
     return array(data, dtype=dtype, split=split, device=device, comm=comm)

@@ -28,7 +28,7 @@ from jax.sharding import PartitionSpec as P
 
 from .. import sanitation
 from .. import types
-from ..communication import MeshCommunication, ensure_placement
+from ..communication import MeshCommunication
 from ..dndarray import DNDarray
 
 __all__ = ["qr"]
@@ -117,12 +117,12 @@ def qr(
     if calc_q:
         q_data, r_data = jnp.linalg.qr(a.larray)
         q_split = a.split if a.split == 0 else None
+        gq = tuple(q_data.shape)
         if distributed:
             # place like the metadata promises; R is replicated like the TSQR
-            # path's out_specs guarantee
-            q_data = ensure_placement(q_data, q_split, comm)
-            r_data = comm.shard(r_data, None)
-        q = DNDarray(q_data, tuple(q_data.shape), a.dtype, q_split, a.device, a.comm, True)
+            # path's out_specs guarantee (DNDarray.__init__ re-pads ragged axes)
+            r_data = jax.device_put(r_data, comm.sharding(r_data.ndim, None))
+        q = DNDarray(q_data, gq, a.dtype, q_split, a.device, a.comm, True)
         r = DNDarray(r_data, tuple(r_data.shape), a.dtype, None, a.device, a.comm, True)
         return QR(q, r)
     r_data = jnp.linalg.qr(a.larray, mode="r")

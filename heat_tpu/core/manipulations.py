@@ -21,7 +21,7 @@ from . import factories
 from . import sanitation
 from . import stride_tricks
 from . import types
-from .communication import MeshCommunication, ensure_placement
+from .communication import MeshCommunication
 from .dndarray import DNDarray
 
 __all__ = [
@@ -64,7 +64,8 @@ __all__ = [
 
 
 def __wrap(proto: DNDarray, data: jax.Array, split) -> DNDarray:
-    data = ensure_placement(data, split, proto.comm)
+    # data is the logical result; DNDarray.__init__ establishes the canonical
+    # (padded, sharded) physical placement for ragged split axes
     return DNDarray(
         data, tuple(data.shape), types.canonical_heat_type(data.dtype), split, proto.device, proto.comm, True
     )

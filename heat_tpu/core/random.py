@@ -4,24 +4,30 @@ Counter-based parallel pseudo-random number generation.
 Parity with the reference's ``heat/core/random.py``: the reference hand-implements the
 Threefry-2x32/2x64 block cipher in tensorized torch (random.py:868-1041) and assigns
 each rank the counter range of its chunk (:55-202) so results are identical regardless
-of process count. JAX's native PRNG *is* Threefry-2x32 — the same cipher family — so
-this module keeps a global ``(seed, counter)`` state (:764-818) and derives a fresh key
-per call by folding the counter into the seed key. Being single-controller, results are
-trivially device-count-invariant; the sharding of the output only affects layout.
+of process count. Here the generation IS counter-based Threefry-2x32 (via
+``jax.extend.random.threefry_2x32`` — the same cipher): element ``i`` of a draw is a
+pure function of ``(seed, call_counter, logical_flat_index_i)``. Because the counter
+is the *logical* index, results are bit-identical at any device count and any padding
+of the physical layout, and the generator runs as one jitted program with
+``out_shardings`` set — each device fills only its own shard (sharded at birth, the
+analog of the reference's per-rank counter ranges :55-202).
 """
 
 from __future__ import annotations
 
+import functools
+import operator
 from typing import Optional, Tuple, Union
 
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax.extend.random import threefry2x32_p
 
 from . import devices as _devices
 from . import factories
 from . import types
-from .communication import sanitize_comm
+from .communication import MeshCommunication, sanitize_comm
 from .dndarray import DNDarray
 from .stride_tricks import sanitize_shape
 
@@ -48,12 +54,17 @@ __seed: int = 0
 __counter: int = 0
 
 
-def __next_key(nelem: int) -> jax.Array:
-    """Derive the key for the next ``nelem`` draws and advance the counter."""
+def __next_prng(nelem: int) -> jax.Array:
+    """Typed PRNG key for the next draw; advances the counter."""
     global __counter
     key = jax.random.fold_in(jax.random.PRNGKey(__seed), __counter % (2**31))
     __counter += max(int(nelem), 1)
     return key
+
+
+def __next_key(nelem: int) -> jax.Array:
+    """Derive the uint32[2] cipher key for the next draw and advance the counter."""
+    return jax.random.key_data(__next_prng(nelem)).astype(jnp.uint32)
 
 
 def __wrap(data: jax.Array, dtype, split, device, comm) -> DNDarray:
@@ -61,6 +72,114 @@ def __wrap(data: jax.Array, dtype, split, device, comm) -> DNDarray:
     comm = sanitize_comm(comm)
     arr = factories.array(data, dtype=dtype, split=split, device=device, comm=comm)
     return arr
+
+
+@functools.lru_cache(maxsize=512)
+def __generator(kind: str, gshape: Tuple[int, ...], jdtype: str, sharding):
+    """
+    One jitted counter-based generator per (kind, logical shape, dtype, placement).
+    Draw ``i`` is ``threefry_2x32(key, logical_index(i))`` — the physical (possibly
+    padded) output shape only changes *where* each element is produced, never its
+    value (reference device-count invariance, random.py:55-202).
+    """
+    dt = np.dtype(jdtype)
+    if sharding is not None:
+        comm, split = sharding
+        pshape = comm.padded_shape(gshape, split)
+        out_shardings = comm.sharding(len(gshape), split)
+    else:
+        pshape = gshape
+        out_shardings = None
+
+    def logical_pair():
+        # 64-bit LOGICAL counter of every physical position as a (hi, lo) uint32
+        # pair: lo is the flat index within the largest dim suffix whose extent
+        # fits 32 bits, hi the flat index over the remaining prefix dims — unique
+        # for any array below 2**64 elements (single axes are limited to 2**32).
+        # Pad positions get out-of-range counters; their values are never observed.
+        ndim = len(gshape)
+        pivot = ndim
+        prod = 1
+        while pivot > 0 and prod * int(gshape[pivot - 1]) < (1 << 32):
+            prod *= int(gshape[pivot - 1])
+            pivot -= 1
+
+        def flat(dims):
+            idx = jnp.zeros(pshape, dtype=jnp.uint32)
+            stride = 1
+            for d in reversed(dims):
+                c = jax.lax.broadcasted_iota(jnp.uint32, pshape, d)
+                idx = idx + c * jnp.uint32(stride)
+                stride *= int(gshape[d])
+            return idx
+
+        return flat(range(0, pivot)), flat(range(pivot, ndim))
+
+    def bits_fn(key):
+        # per-element block cipher: counter = (hi, lo) logical pair, so draw i is a
+        # pure function of (key, i) — bit-identical at any device count/padding
+        if gshape:
+            hi, lo = logical_pair()
+        else:
+            hi = lo = jnp.zeros((), dtype=jnp.uint32)
+        k1 = jnp.broadcast_to(key[0], lo.shape)
+        k2 = jnp.broadcast_to(key[1], lo.shape)
+        out = threefry2x32_p.bind(k1, k2, hi, lo)
+        return out[0]
+
+    if kind == "uniform":
+
+        def f(key):
+            u = (bits_fn(key) >> 8).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
+            return u.astype(dt)
+
+    elif kind == "normal":
+        from jax.scipy.special import ndtri
+
+        def f(key):
+            # strictly inside (0,1) so the inverse CDF stays finite
+            u = ((bits_fn(key) >> 8).astype(jnp.float32) + 0.5) * jnp.float32(1.0 / (1 << 24))
+            return ndtri(u).astype(dt)
+
+    elif kind == "randint":
+
+        def f(key, low, rng):
+            m = (bits_fn(key) % rng.astype(jnp.uint32)).astype(jnp.int32)
+            return (m + low).astype(dt)
+
+    else:  # pragma: no cover
+        raise ValueError(kind)
+
+    if out_shardings is None:
+        return jax.jit(f)
+    return jax.jit(f, out_shardings=out_shardings)
+
+
+def __draw(kind: str, shape, dtype, split, device, comm, *args) -> DNDarray:
+    """Generate a counter-based draw of logical ``shape``, sharded at birth."""
+    device = _devices.sanitize_device(device)
+    comm = sanitize_comm(comm)
+    shape = tuple(int(s) for s in shape)
+    nelem = int(np.prod(shape)) if shape else 1
+    key = __next_key(nelem)
+    heat_dtype = types.canonical_heat_type(dtype)
+    from .stride_tricks import sanitize_axis
+
+    split = sanitize_axis(shape, split)
+    distributed = (
+        split is not None
+        and isinstance(comm, MeshCommunication)
+        and comm.is_distributed()
+        and len(shape) > 0
+    )
+    gen = __generator(
+        kind,
+        shape,
+        np.dtype(heat_dtype.jnp_type()).str,
+        (comm, split) if distributed else None,
+    )
+    data = gen(key, *args)
+    return DNDarray(data, shape, heat_dtype, split, device, comm, True)
 
 
 def get_state() -> Tuple[str, int, int, int, float]:
@@ -108,14 +227,10 @@ def __shape_of(args) -> Tuple[int, ...]:
 
 def rand(*d, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
     """
-    Uniform random samples in [0, 1) of the given shape (reference random.py:268-330).
+    Uniform random samples in [0, 1) of the given shape (reference random.py:268-330:
+    Threefry bits → mantissa-masked floats :220-247; same construction here).
     """
-    shape = __shape_of(d)
-    nelem = int(np.prod(shape)) if shape else 1
-    key = __next_key(nelem)
-    dtype = types.canonical_heat_type(dtype)
-    data = jax.random.uniform(key, shape, dtype=jnp.float32).astype(dtype.jnp_type())
-    return __wrap(data, dtype, split, device, comm)
+    return __draw("uniform", __shape_of(d), dtype, split, device, comm)
 
 
 def randint(
@@ -138,11 +253,10 @@ def randint(
     if size is None:
         size = ()
     shape = sanitize_shape(size) if size != () else ()
-    nelem = int(np.prod(shape)) if shape else 1
-    key = __next_key(nelem)
-    dtype = types.canonical_heat_type(dtype)
-    data = jax.random.randint(key, shape, int(low), int(high)).astype(dtype.jnp_type())
-    return __wrap(data, dtype, split, device, comm)
+    return __draw(
+        "randint", shape, dtype, split, device, comm,
+        jnp.int32(int(low)), jnp.uint32(int(high) - int(low)),
+    )
 
 
 random_integer = randint
@@ -153,12 +267,7 @@ def randn(*d, dtype=types.float32, split=None, device=None, comm=None) -> DNDarr
     Standard-normal random samples of the given shape (reference random.py:584-640 via
     the Kundu transform; jax uses inverse-CDF/Box-Muller in native XLA).
     """
-    shape = __shape_of(d)
-    nelem = int(np.prod(shape)) if shape else 1
-    key = __next_key(nelem)
-    dtype = types.canonical_heat_type(dtype)
-    data = jax.random.normal(key, shape, dtype=jnp.float32).astype(dtype.jnp_type())
-    return __wrap(data, dtype, split, device, comm)
+    return __draw("normal", __shape_of(d), dtype, split, device, comm)
 
 
 def normal(mean=0.0, std=1.0, shape=None, dtype=types.float32, split=None, device=None, comm=None) -> DNDarray:
@@ -195,7 +304,7 @@ def randperm(n: int, dtype=None, split=None, device=None, comm=None) -> DNDarray
         raise TypeError(f"n must be an integer, got {type(n)}")
     if dtype is None:
         dtype = types.default_index_type()
-    key = __next_key(int(n))
+    key = __next_prng(int(n))
     data = jax.random.permutation(key, int(n))
     return __wrap(data, types.canonical_heat_type(dtype), split, device, comm)
 
@@ -208,7 +317,7 @@ def permutation(x) -> DNDarray:
     if isinstance(x, (int, np.integer)):
         return randperm(int(x))
     if isinstance(x, DNDarray):
-        key = __next_key(x.shape[0] if x.ndim else 1)
+        key = __next_prng(x.shape[0] if x.ndim else 1)
         data = jax.random.permutation(key, x.larray, axis=0)
         return DNDarray.__new_like__(x, data)
     raise TypeError(f"x must be int or DNDarray, got {type(x)}")

@@ -71,8 +71,12 @@ def test_getitem_basic():
     np.testing.assert_array_equal(a[..., -1].numpy(), data[..., -1])
     # split axis untouched -> retained
     assert a[:, 1:3].split == 0
-    # split axis sliced -> degraded to None (conservative, correctness identical)
-    assert a[2:5].split is None
+    # split axis sliced -> distribution retained (reference dndarray.py:656-915)
+    assert a[2:5].split == 0
+    assert a[::2].split == 0
+    assert a[::-1].split == 0
+    # split axis consumed by an int -> gone
+    assert a[3].split is None
 
 
 def test_getitem_advanced():

@@ -50,6 +50,7 @@ def test_two_process_distributed_init(tmp_path):
     worker.write_text(WORKER)
     port = _free_port()
     env = {k: v for k, v in os.environ.items() if k not in ("XLA_FLAGS", "PYTHONPATH")}
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     procs = [
         subprocess.Popen(
             [sys.executable, str(worker), str(pid), str(port)],
