@@ -1,0 +1,300 @@
+"""
+Ragged (non-divisible) split-axis matrix: prime-ish axis lengths × mesh sizes.
+
+Round-2 contract (VERDICT item 1): a split axis of ANY length is *genuinely
+distributed* — physically sharded over the mesh via the padded physical layout —
+never silently replicated. The reference chunks any length with the remainder
+spread over low ranks (heat/core/communication.py:161-210); here the physical
+shards are all ceil(n/p) with the pad at the global end, and every op masks or
+slices the pad. These tests assert BOTH golden numerics vs numpy AND the physical
+placement (`parray.addressable_shards`).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import heat_tpu as ht
+from heat_tpu.core.communication import MeshCommunication
+
+SIZES = [7, 29, 1003, 2**17 + 1]
+MESHES = [2, 3, 5, 8]
+
+
+def _comm(p):
+    devs = jax.devices()
+    if len(devs) < p:
+        pytest.skip(f"needs {p} devices, have {len(devs)}")
+    return MeshCommunication(devices=devs[:p])
+
+
+def _assert_sharded(x, p):
+    """The array must be physically partitioned: p equal shards of ~n/p rows."""
+    shards = x.parray.addressable_shards
+    assert len(shards) == p, f"expected {p} shards, got {len(shards)}"
+    sizes = {sh.data.shape for sh in shards}
+    assert len(sizes) == 1, f"unequal physical shards: {sizes}"
+    split = x.split
+    n = x.shape[split]
+    per = next(iter(sizes))[split]
+    assert per == -(-n // p), f"shard extent {per} != ceil({n}/{p})"
+
+
+@pytest.mark.parametrize("n", SIZES)
+@pytest.mark.parametrize("p", MESHES)
+def test_creation_physically_sharded(n, p):
+    comm = _comm(p)
+    x = ht.arange(n, dtype=ht.float32, split=0, comm=comm)
+    assert x.shape == (n,)
+    _assert_sharded(x, p)
+    np.testing.assert_allclose(x.numpy(), np.arange(n, dtype=np.float32))
+
+    o = ht.ones((n, 3), split=0, comm=comm)
+    _assert_sharded(o, p)
+    assert o.shape == (n, 3)
+
+    f = ht.full((3, n), 2.5, split=1, comm=comm)
+    _assert_sharded(f, p)
+    np.testing.assert_allclose(f.numpy(), np.full((3, n), 2.5, np.float32))
+
+    e = ht.eye((n, 5), split=0, comm=comm)
+    _assert_sharded(e, p)
+    np.testing.assert_allclose(e.numpy(), np.eye(n, 5, dtype=np.float32))
+
+    ht.random.seed(11)
+    r = ht.random.rand(n, split=0, comm=comm)
+    _assert_sharded(r, p)
+    ht.random.seed(11)
+    r_ref = ht.random.rand(n)  # default comm / different device count
+    np.testing.assert_allclose(r.numpy(), r_ref.numpy())  # count-invariant draws
+
+
+@pytest.mark.parametrize("n", SIZES)
+@pytest.mark.parametrize("p", [3, 8])
+def test_reductions_golden(n, p):
+    comm = _comm(p)
+    a = np.linspace(-3, 5, n, dtype=np.float32)
+    x = ht.array(a, split=0, comm=comm)
+    _assert_sharded(x, p)
+    np.testing.assert_allclose(ht.sum(x).item(), a.sum(), rtol=1e-4)
+    np.testing.assert_allclose(ht.mean(x).item(), a.mean(), rtol=1e-5)
+    assert ht.max(x).item() == a.max()
+    assert ht.min(x).item() == a.min()
+    assert ht.argmax(x).item() == a.argmax()
+    assert ht.argmin(x).item() == a.argmin()
+    # prod over a shifted/normalised array to stay finite
+    b = 1.0 + np.linspace(0, 1, n, dtype=np.float32) / n
+    y = ht.array(b, split=0, comm=comm)
+    np.testing.assert_allclose(ht.prod(y).item(), b.prod(), rtol=1e-3)
+    # logical reductions
+    m = ht.array(a > 0, split=0, comm=comm)
+    assert bool(ht.any(m).item()) == bool((a > 0).any())
+    assert bool(ht.all(m).item()) == bool((a > 0).all())
+
+
+@pytest.mark.parametrize("n", [7, 1003])
+@pytest.mark.parametrize("p", MESHES)
+def test_elementwise_and_binary(n, p):
+    comm = _comm(p)
+    a = np.arange(n, dtype=np.float32)
+    b = np.flip(a).copy()
+    x = ht.array(a, split=0, comm=comm)
+    y = ht.array(b, split=0, comm=comm)
+    z = x * 2.0 + y
+    _assert_sharded(z, p)
+    np.testing.assert_allclose(z.numpy(), a * 2 + b)
+    # mixed split/replicated
+    w = x + ht.array(b, comm=comm)
+    np.testing.assert_allclose(w.numpy(), a + b)
+    # raw numpy operand
+    v = x + b
+    np.testing.assert_allclose(v.numpy(), a + b)
+    # unary through __local_op
+    np.testing.assert_allclose(ht.exp(x / n).numpy(), np.exp(a / n), rtol=1e-5)
+    # comparison
+    np.testing.assert_array_equal((x > y).numpy(), a > b)
+
+
+@pytest.mark.parametrize("n", [7, 1003])
+@pytest.mark.parametrize("p", [3, 8])
+def test_indexing_keeps_distribution(n, p):
+    comm = _comm(p)
+    a = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+    x = ht.array(a, split=0, comm=comm)
+    _assert_sharded(x, p)
+
+    s = x[2:-2]
+    assert s.split == 0
+    np.testing.assert_allclose(s.numpy(), a[2:-2])
+    if s.shape[0] >= p:
+        _assert_sharded(s, p)
+
+    st = x[::2]
+    assert st.split == 0
+    np.testing.assert_allclose(st.numpy(), a[::2])
+
+    rv = x[::-1]
+    assert rv.split == 0
+    np.testing.assert_allclose(rv.numpy(), a[::-1])
+
+    np.testing.assert_allclose(x[-1].numpy(), a[-1])
+    np.testing.assert_allclose(x[0, 1].numpy(), a[0, 1])
+    np.testing.assert_allclose(x[:, 1].numpy(), a[:, 1])
+    assert x[:, 1].split == 0  # split axis passes through
+
+    idx = np.array([0, n // 2, n - 1, -1])
+    g = x[idx]
+    np.testing.assert_allclose(g.numpy(), a[idx])
+    assert g.split == 0  # single 1-D advanced key on the split axis
+
+    mask = (np.arange(n) % 3) == 0
+    bm = x[mask]
+    np.testing.assert_allclose(bm.numpy(), a[mask])
+
+
+@pytest.mark.parametrize("n", [7, 1003])
+@pytest.mark.parametrize("p", [3, 8])
+def test_setitem_golden(n, p):
+    comm = _comm(p)
+    a = np.zeros((n, 2), dtype=np.float32)
+    x = ht.array(a, split=0, comm=comm)
+
+    x[1] = 5.0
+    a[1] = 5.0
+    x[3:9] = 7.0
+    a[3:9] = 7.0
+    x[-1] = 9.0
+    a[-1] = 9.0
+    x[:, 1] = 2.0
+    a[:, 1] = 2.0
+    np.testing.assert_allclose(x.numpy(), a)
+    _assert_sharded(x, p)
+
+    mask = a > 4
+    x[ht.array(mask, comm=comm)] = 0.0
+    a[mask] = 0.0
+    np.testing.assert_allclose(x.numpy(), a)
+
+    vals = np.arange(2 * n, dtype=np.float32).reshape(n, 2)
+    x[:] = ht.array(vals, split=0, comm=comm)
+    np.testing.assert_allclose(x.numpy(), vals)
+
+
+@pytest.mark.parametrize("n", [29, 1003])
+@pytest.mark.parametrize("p", [3, 8])
+def test_cum_and_axis_ops(n, p):
+    comm = _comm(p)
+    a = np.arange(n * 2, dtype=np.float32).reshape(n, 2) / n
+    x = ht.array(a, split=0, comm=comm)
+    np.testing.assert_allclose(ht.cumsum(x, axis=0).numpy(), a.cumsum(axis=0), rtol=1e-4)
+    np.testing.assert_allclose(ht.cumsum(x, axis=1).numpy(), a.cumsum(axis=1), rtol=1e-5)
+    # reduce over the non-split axis keeps the (padded) split axis sharded
+    s1 = ht.sum(x, axis=1)
+    assert s1.split == 0
+    _assert_sharded(s1, p)
+    np.testing.assert_allclose(s1.numpy(), a.sum(axis=1), rtol=1e-5)
+
+
+@pytest.mark.parametrize("n", [29, 1003])
+@pytest.mark.parametrize("p", [3, 8])
+def test_resplit_and_transpose(n, p):
+    comm = _comm(p)
+    a = np.arange(n * 4, dtype=np.float32).reshape(n, 4)
+    x = ht.array(a, split=0, comm=comm)
+    x.resplit_(1)
+    assert x.split == 1
+    assert x.pshape[1] % p == 0  # physical layout is evenly sharded
+    np.testing.assert_allclose(x.numpy(), a)
+    x.resplit_(0)
+    _assert_sharded(x, p)
+    np.testing.assert_allclose(x.numpy(), a)
+    t = ht.transpose(x, None)
+    np.testing.assert_allclose(t.numpy(), a.T)
+    x.resplit_(None)
+    assert x.split is None
+    np.testing.assert_allclose(x.numpy(), a)
+
+
+@pytest.mark.parametrize("n", [29, 1003])
+@pytest.mark.parametrize("p", [5, 8])
+def test_matmul_ragged(n, p):
+    comm = _comm(p)
+    rng = np.random.default_rng(3)
+    a = rng.standard_normal((n, 8), dtype=np.float32)
+    b = rng.standard_normal((8, 4), dtype=np.float32)
+    x = ht.array(a, split=0, comm=comm)
+    y = ht.array(b, comm=comm)
+    m = ht.matmul(x, y)
+    assert m.shape == (n, 4) and m.split == 0
+    np.testing.assert_allclose(m.numpy(), a @ b, rtol=1e-4, atol=1e-4)
+    # contraction across the ragged split axis (split=1 @ split=0)
+    xt = ht.array(a.T.copy(), split=1, comm=comm)
+    g = ht.matmul(xt, x)  # (8, n) x (n, 8) over the ragged axis
+    np.testing.assert_allclose(g.numpy(), a.T @ a, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("n", [29, 1003])
+@pytest.mark.parametrize("p", [3, 8])
+def test_manipulations_ragged(n, p):
+    comm = _comm(p)
+    a = np.arange(n, dtype=np.float32)
+    x = ht.array(a, split=0, comm=comm)
+    c = ht.concatenate([x, x], axis=0)
+    assert c.shape == (2 * n,)
+    np.testing.assert_allclose(c.numpy(), np.concatenate([a, a]))
+    v, idx = ht.sort(x[::-1])
+    np.testing.assert_allclose(v.numpy(), np.sort(a))
+    u = ht.unique(ht.array(np.floor(a / 2), split=0, comm=comm))
+    np.testing.assert_allclose(np.asarray(u.numpy()), np.unique(np.floor(a / 2)))
+    np.testing.assert_allclose(
+        ht.percentile(x, [25.0, 50.0, 75.0]).numpy(),
+        np.percentile(a, [25.0, 50.0, 75.0]),
+        rtol=1e-4,
+    )
+    r = ht.reshape(ht.array(np.arange(n * 2, dtype=np.float32), split=0, comm=comm), (n, 2))
+    np.testing.assert_allclose(r.numpy(), np.arange(n * 2, dtype=np.float32).reshape(n, 2))
+    np.testing.assert_allclose(ht.roll(x, 3).numpy(), np.roll(a, 3))
+    np.testing.assert_allclose(ht.flip(x, 0).numpy(), np.flip(a))
+    p_ = ht.pad(x, (2, 3))
+    np.testing.assert_allclose(p_.numpy(), np.pad(a, (2, 3)))
+
+
+@pytest.mark.parametrize("p", [3, 8])
+def test_tiny_axis_smaller_than_mesh(p):
+    """n < p: some shards are pure pad; everything still works."""
+    comm = _comm(p)
+    n = 2
+    a = np.array([3.0, 4.0], dtype=np.float32)
+    x = ht.array(a, split=0, comm=comm)
+    assert x.shape == (n,)
+    np.testing.assert_allclose(x.numpy(), a)
+    assert ht.sum(x).item() == 7.0
+    assert ht.max(x).item() == 4.0
+    y = x * 2
+    np.testing.assert_allclose(y.numpy(), a * 2)
+
+
+@pytest.mark.parametrize("p", [2, 8])
+def test_ragged_vector_collectives(p):
+    comm = _comm(p)
+    a = np.arange(13, dtype=np.float32)
+    g = comm.Allgatherv(a, split=0)
+    np.testing.assert_allclose(np.asarray(g), a)
+    s = comm.Scatterv(a, split=0)
+    assert len(s.addressable_shards) == p
+    np.testing.assert_allclose(np.asarray(jax.device_put(s, comm.sharding(1, None)))[:13], a)
+    m = np.arange(21, dtype=np.float32).reshape(7, 3)
+    r = comm.Alltoallv(m, split_axis=1, concat_axis=0)
+    np.testing.assert_allclose(np.asarray(r)[:7, :3], m)
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_statistics_ragged(n):
+    comm = _comm(8)
+    rng = np.random.default_rng(5)
+    a = rng.standard_normal(n).astype(np.float32)
+    x = ht.array(a, split=0, comm=comm)
+    np.testing.assert_allclose(ht.std(x).item(), a.std(), rtol=1e-3)
+    np.testing.assert_allclose(ht.var(x).item(), a.var(), rtol=1e-3)
+    np.testing.assert_allclose(ht.median(x).item(), np.median(a), rtol=1e-4)
