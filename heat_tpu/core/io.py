@@ -28,33 +28,46 @@ from .dndarray import DNDarray
 
 def _load_sharded(reader, gshape, dtype, split, device, comm) -> Optional[DNDarray]:
     """
-    Slab-wise distributed load: read each device's ``comm.chunk`` slab separately
+    Slab-wise distributed load: read each *addressable* device's slab separately
     (``reader(slices) -> np.ndarray``) and assemble the global array with
     ``jax.make_array_from_single_device_arrays`` — the reference's per-rank slab
     read (io.py:268-390) without ever materializing the full array on one host.
-    Returns None when the layout calls for a plain replicated read.
+    In a multi-controller run each host reads only its own devices' slabs. Ragged
+    split axes get the padded physical layout: even ceil(n/p) slabs, the tail
+    zero-filled. Returns None when the layout calls for a plain replicated read.
     """
     comm = sanitize_comm(comm)
-    if (
-        split is None
-        or not isinstance(comm, MeshCommunication)
-        or not comm.is_distributed()
-        or not comm.is_shardable(gshape, split)
-    ):
+    if split is None or not isinstance(comm, MeshCommunication) or not comm.is_distributed():
         return None
     from .stride_tricks import sanitize_axis
 
+    gshape = tuple(int(s) for s in gshape)
     split = sanitize_axis(gshape, split)  # same normalization/errors as factories.array
     htype = types.canonical_heat_type(dtype)
     np_dtype = np.dtype(htype.jnp_type())
     sharding = comm.sharding(len(gshape), split)
+    pshape = comm.padded_shape(gshape, split)
+    chunk = pshape[split] // comm.size
+    n = gshape[split]
+    this_process = jax.process_index()
     shards = []
     for r, dev in enumerate(comm.mesh.devices.ravel()):
-        _, _, slices = comm.chunk(gshape, split, rank=r)
+        if dev.process_index != this_process:
+            continue  # multi-controller: only this host's devices are addressable
+        start = r * chunk
+        stop_valid = min(start + chunk, n)
+        slices = tuple(
+            slice(start, max(start, stop_valid)) if d == split else slice(None)
+            for d in range(len(gshape))
+        )
         slab = np.asarray(reader(slices), dtype=np_dtype)
+        if stop_valid - start < chunk:  # zero-fill the pad tail of the last shard(s)
+            widths = [(0, 0)] * len(gshape)
+            widths[split] = (0, chunk - max(stop_valid - start, 0))
+            slab = np.pad(slab, widths)
         shards.append(jax.device_put(slab, dev))
-    arr = jax.make_array_from_single_device_arrays(gshape, sharding, shards)
-    return DNDarray(arr, tuple(gshape), htype, split, devices.sanitize_device(device), comm, True)
+    arr = jax.make_array_from_single_device_arrays(pshape, sharding, shards)
+    return DNDarray(arr, gshape, htype, split, devices.sanitize_device(device), comm, True)
 
 __all__ = ["load", "load_csv", "save_csv", "save", "supports_hdf5", "supports_netcdf"]
 
@@ -125,8 +138,18 @@ if __HDF5:
             raise TypeError(f"data must be a DNDarray, not {type(data)}")
         if not isinstance(path, str):
             raise TypeError(f"path must be str, not {type(path)}")
+        arr = data.parray
+        if hasattr(arr, "is_fully_addressable") and not arr.is_fully_addressable:
+            # multi-controller: a shard-wise write after a mode-'w' truncate would
+            # leave only this host's slabs in the file — gather collectively
+            # (numpy() runs process_allgather on every host) and let one writer
+            # produce the complete file
+            full = data.numpy()
+            if jax.process_index() == 0:
+                with h5py.File(path, mode) as handle:
+                    handle.create_dataset(dataset, data=full, **kwargs)
+            return
         with h5py.File(path, mode) as handle:
-            arr = data.larray
             if (
                 data.split is not None
                 and len(arr.sharding.device_set) > 1
@@ -134,11 +157,24 @@ if __HDF5:
             ):
                 # shard-wise write: fetch one device slab at a time (the
                 # reference's per-rank offset writes, io.py:391-470) instead of
-                # gathering the full array on the host first
+                # gathering the full array on the host first; pad rows of ragged
+                # layouts are clamped off against the logical extent
                 np_dtype = np.dtype(data.dtype.jnp_type())
                 dset = handle.create_dataset(dataset, shape=data.shape, dtype=np_dtype, **kwargs)
+                split = data.split % data.ndim
+                n = data.shape[split]
                 for shard in arr.addressable_shards:
-                    dset[shard.index] = np.asarray(shard.data)
+                    idx = list(shard.index)
+                    sl = idx[split]
+                    start = sl.start or 0
+                    if start >= n:
+                        continue  # pure-pad shard
+                    stop = n if sl.stop is None else min(sl.stop, n)
+                    idx[split] = slice(start, stop)
+                    block = np.asarray(shard.data)
+                    take = [slice(None)] * data.ndim
+                    take[split] = slice(0, stop - start)
+                    dset[tuple(idx)] = block[tuple(take)]
             else:
                 handle.create_dataset(dataset, data=data.numpy(), **kwargs)
 
@@ -171,7 +207,9 @@ if __NETCDF:
         """Save a DNDarray to NetCDF (reference io.py:591-660)."""
         if not isinstance(data, DNDarray):
             raise TypeError(f"data must be a DNDarray, not {type(data)}")
-        arr = data.numpy()
+        arr = data.numpy()  # collective in multi-controller runs
+        if jax.process_index() != 0 and not data.parray.is_fully_addressable:
+            return  # single writer
         with nc.Dataset(path, mode) as handle:
             for i, s in enumerate(arr.shape):
                 handle.createDimension(f"dim_{i}", s)

@@ -199,6 +199,11 @@ class SlabPrefetcher:
         self._cond = threading.Condition()
         self._closing = False
         self._inflight = 0
+        # consumers are serialized here: in-order delivery means concurrent
+        # next_into() calls have nothing to gain, and serializing keeps the
+        # _delivered counter and the C-side ordinal claim race-free (close()
+        # still interrupts a blocked consumer via ht_prefetch_cancel)
+        self._consumer_lock = threading.Lock()
         self._handle = lib.ht_prefetch_open(
             os.fsencode(path),
             offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
@@ -224,7 +229,10 @@ class SlabPrefetcher:
                 raise ValueError("buf must be writable")
             cap = mv.nbytes
             dest = (ctypes.c_char * cap).from_buffer(mv.cast("B"))
-            rc = self._lib.ht_prefetch_next(handle, dest, cap)
+            with self._consumer_lock:
+                rc = self._lib.ht_prefetch_next(handle, dest, cap)
+                if rc >= 0:
+                    self._delivered += 1
         finally:
             with self._cond:
                 self._inflight -= 1
@@ -238,7 +246,6 @@ class SlabPrefetcher:
             raise ValueError(f"destination buffer too small (needs {needed} bytes)")
         if rc == -4:
             raise RuntimeError("prefetcher closed concurrently")
-        self._delivered += 1
         return int(rc)
 
     def __iter__(self):

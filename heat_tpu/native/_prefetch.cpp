@@ -43,7 +43,7 @@ struct Prefetcher {
   std::atomic<int64_t> next_claim{0};
   int64_t next_consume = 0;
   bool closed = false;
-  bool consumer_active = false;
+  int consumers_active = 0;
 
   std::mutex mu;
   std::condition_variable cv_filled;
@@ -116,22 +116,25 @@ void* ht_prefetch_open(const char* path, const int64_t* offsets,
 
 // Returns: bytes copied (>=0), -1 after the last slab, -2 on read error,
 // -3 if dest_cap is too small (the slab stays consumable), -4 if the
-// prefetcher was closed concurrently. Single consumer.
+// prefetcher was closed concurrently. Concurrent consumers are safe: each call
+// claims one ordinal (in order) before its copy runs unlocked.
 int64_t ht_prefetch_next(void* handle, char* dest, int64_t dest_cap) {
   auto* p = static_cast<Prefetcher*>(handle);
   std::unique_lock<std::mutex> lk(p->mu);
   if (p->closed) return -4;
   if (p->next_consume >= p->nslabs()) return -1;
-  const int slot = static_cast<int>(p->next_consume % p->depth);
-  // consumer_active handshake: ht_prefetch_close must not free the mutex this
-  // thread sleeps on; it waits for the consumer to observe `closed` and leave
-  p->consumer_active = true;
+  const int64_t ordinal = p->next_consume;
+  const int slot = static_cast<int>(ordinal % p->depth);
+  // consumers_active handshake: ht_prefetch_close must not free the mutex a
+  // consumer sleeps on; it waits for every consumer to observe `closed` and leave
+  p->consumers_active++;
   p->cv_filled.wait(lk, [&] {
-    return p->closed ||
-           (p->slot_owner[slot] == p->next_consume && p->slot_bytes[slot] != -2);
+    return p->closed || p->next_consume != ordinal ||
+           (p->slot_owner[slot] == ordinal && p->slot_bytes[slot] != -2);
   });
   int64_t result;
-  if (p->closed) {
+  if (p->closed || p->next_consume != ordinal) {
+    // closed, or another consumer raced past this ordinal while we waited
     result = -4;
   } else {
     const int64_t bytes = p->slot_bytes[slot];
@@ -140,20 +143,23 @@ int64_t ht_prefetch_next(void* handle, char* dest, int64_t dest_cap) {
     } else if (bytes > dest_cap) {
       result = -3;
     } else {
-      // The slot is reserved for this consumer (owner == next_consume, not in
-      // flight), so the copy can run unlocked — workers keep posting
-      // completions and claiming slabs instead of stalling behind a multi-MB
-      // memcpy. close() still waits on consumer_active before freeing.
+      // Reserve the slot for this copy BEFORE unlocking: advance next_consume
+      // (so a concurrent consumer claims the NEXT ordinal, never this slot) and
+      // mark the slot consuming (owner sentinel -2, so no worker can refill it).
+      // The multi-MB memcpy then runs unlocked and workers keep posting
+      // completions instead of stalling behind it.
+      p->slot_owner[slot] = -2;
+      p->next_consume = ordinal + 1;
       lk.unlock();
       memcpy(dest, p->ring[slot].data(), bytes);
       lk.lock();
       p->slot_owner[slot] = -1;
-      p->next_consume++;
       p->cv_free.notify_all();
+      p->cv_filled.notify_all();  // wake consumers waiting on later ordinals
       result = bytes;
     }
   }
-  p->consumer_active = false;
+  p->consumers_active--;
   p->cv_consumer_done.notify_all();
   return result;
 }
@@ -178,9 +184,9 @@ void ht_prefetch_close(void* handle) {
     p->closed = true;
     p->cv_free.notify_all();
     p->cv_filled.notify_all();
-    // a consumer blocked in ht_prefetch_next still sleeps on this mutex;
-    // deleting p under it would be use-after-free — wait it out
-    p->cv_consumer_done.wait(lk, [&] { return !p->consumer_active; });
+    // consumers blocked in ht_prefetch_next still sleep on this mutex;
+    // deleting p under them would be use-after-free — wait them all out
+    p->cv_consumer_done.wait(lk, [&] { return p->consumers_active == 0; });
   }
   // drain claims so workers waiting on ordinals past the end exit
   p->next_claim.store(p->nslabs());

@@ -32,6 +32,41 @@ from ..core.dndarray import DNDarray
 __all__ = ["DataParallel", "DataParallelMultiGPU"]
 
 
+def pad_or_trim_batch(a: jax.Array, world: int, ragged: str, warn_holder) -> jax.Array:
+    """
+    Resolve a batch whose leading axis is not divisible by ``world`` devices.
+    ``ragged='cycle'`` pads by wrapping rows from the batch start (every row still
+    trains; the duplicates carry slightly more weight in that one batch — like the
+    reference's unequal per-rank chunks averaged by the gradient allreduce);
+    ``'trim'`` drops the remainder (torch DataLoader ``drop_last``). Warns once per
+    ``warn_holder`` (the owning wrapper/optimizer).
+    """
+    if ragged not in ("cycle", "trim"):
+        raise ValueError(f"ragged must be 'cycle' or 'trim', got {ragged!r}")
+    n = a.shape[0]
+    if n % world == 0:
+        return a
+    if n < world and ragged == "trim":
+        raise ValueError(f"batch of {n} rows cannot be sharded over {world} devices")
+    if not getattr(warn_holder, "_ragged_warned", False):
+        import warnings
+
+        warnings.warn(
+            f"batch of {n} rows is not divisible by the {world}-device mesh; "
+            f"policy {ragged!r} applies to every such batch ('cycle' wraps rows "
+            "from the batch start, 'trim' drops the remainder). Size batches as "
+            "a multiple of the device count for exact weighting.",
+            RuntimeWarning,
+            stacklevel=4,
+        )
+        warn_holder._ragged_warned = True
+    if ragged == "cycle":
+        target = -(-n // world) * world
+        reps = jnp.take(a, jnp.arange(target - n) % n, axis=0)
+        return jnp.concatenate([a, reps], axis=0)
+    return a[: (n // world) * world]
+
+
 class DataParallel:
     """
     Distributed data-parallel wrapper around a flax module (or a pure
@@ -85,11 +120,16 @@ class DataParallel:
         """Fully replicated sharding (for parameters)."""
         return NamedSharding(self.mesh, P())
 
-    def shard_batch(self, *arrays):
+    def shard_batch(self, *arrays, ragged: str = "cycle"):
         """
-        Place arrays with the batch axis sharded over the mesh. Non-divisible
-        batches are trimmed to the largest divisible length (drop-last semantics,
-        same policy as :meth:`DASO.shard_batch`), with a one-time warning.
+        Place arrays with the batch axis sharded over the mesh. A batch whose
+        length is not divisible by the device count is handled per ``ragged``:
+
+        - ``'cycle'`` (default): pad by wrapping rows from the batch start — every
+          row still trains (the duplicated rows carry slightly more weight in that
+          one batch, like the reference's unequal per-rank chunks averaged by the
+          gradient allreduce).
+        - ``'trim'``: drop the remainder rows (torch DataLoader ``drop_last``).
         """
         world = self.comm.size
         out = []
@@ -98,25 +138,7 @@ class DataParallel:
                 a = a.larray
             a = jnp.asarray(a)
             if a.ndim > 0:
-                n = a.shape[0]
-                if n % world != 0:
-                    keep = (n // world) * world
-                    if keep == 0:
-                        raise ValueError(
-                            f"batch of {n} rows cannot be sharded over {world} devices"
-                        )
-                    if not getattr(self, "_trim_warned", False):
-                        import warnings
-
-                        warnings.warn(
-                            f"batch of {n} rows is not divisible by the {world}-device "
-                            f"mesh; trimming to {keep} (drop-last). Size batches as a "
-                            "multiple of the device count to train on all data.",
-                            RuntimeWarning,
-                            stacklevel=3,
-                        )
-                        self._trim_warned = True
-                    a = a[:keep]
+                a = pad_or_trim_batch(a, world, ragged, self)
                 a = jax.device_put(a, self.batch_sharding(a.ndim))
             out.append(a)
         return out[0] if len(out) == 1 else tuple(out)

@@ -148,11 +148,12 @@ class DASO:
         self.last_batch = None
         self._pending_global = None
         self._pending_countdown = 0
-        self._trim_warned = False
+        self._ragged_warned = False
         self.opt_state = None
         self.params = None
         self._local_step = None
-        self._global_sync = None
+        self._global_mean = None
+        self._blend = None
 
         # hierarchical mesh: factor the world into (nodes, local)
         size = self.comm.size
@@ -231,57 +232,50 @@ class DASO:
         )
 
         def global_block(params):
+            # bf16 downcast for the wire; dispatch carries ONLY the node average —
+            # the staleness blend happens at consume time against the then-current
+            # local params (reference dp_optimizer.py:502-652 blends the received
+            # buffer into the params as they stand after the wait)
             p = jax.tree.map(lambda a: a[0], params)
-            # bf16 downcast for the wire, blend local 1/4 + global 3/4
+
             def sync(leaf):
                 cast = leaf.astype(self.downcast_type)
-                avg = jax.lax.pmean(cast, "node").astype(leaf.dtype)
-                return 0.25 * leaf + 0.75 * avg
+                return jax.lax.pmean(cast, "node").astype(leaf.dtype)
 
             p2 = jax.tree.map(sync, p)
             return jax.tree.map(lambda a: a[None], p2)
 
-        gsync = jax.jit(
+        gmean = jax.jit(
             jax.shard_map(
                 global_block, mesh=mesh, in_specs=(pspec,), out_specs=pspec, check_vma=False
             )
         )
+
+        def blend_block(current, received):
+            # local*1/4 + global*3/4 (reference dp_optimizer.py:615-637)
+            return jax.tree.map(lambda c, r: 0.25 * c + 0.75 * r.astype(c.dtype), current, received)
+
+        blend = jax.jit(blend_block)
         self._local_step = step
-        self._global_sync = gsync
+        self._global_mean = gmean
+        self._blend = blend
         return step
 
     # ------------------------------------------------------------------ train loop API
-    def shard_batch(self, *arrays):
+    def shard_batch(self, *arrays, ragged: str = "cycle"):
         """
         Shard the batch axis over the flattened (node, local) mesh. A batch whose
-        length is not divisible by the device count is trimmed to the largest
-        divisible length (drop-last semantics — the reference's per-rank
-        DataLoader slicing never produces ragged global batches either).
+        length is not divisible by the device count is handled per ``ragged``:
+        ``'cycle'`` (default) pads by wrapping rows from the batch start so every
+        row still trains; ``'trim'`` drops the remainder (drop-last). See
+        :func:`heat_tpu.nn.data_parallel.pad_or_trim_batch`.
         """
+        from ..nn.data_parallel import pad_or_trim_batch
+
         world = self.nodes * self.local_size
         out = []
         for a in arrays:
-            a = jnp.asarray(a)
-            n = a.shape[0]
-            if n % world != 0:
-                keep = (n // world) * world
-                if keep == 0:
-                    raise ValueError(
-                        f"batch of {n} rows cannot be sharded over {world} devices"
-                    )
-                if not self._trim_warned:
-                    import warnings
-
-                    warnings.warn(
-                        f"DASO batch of {n} rows is not divisible by the {world}-device "
-                        f"mesh; trimming to {keep}. This drops {n - keep} rows from "
-                        "EVERY such batch — size your batches as a multiple of the "
-                        "device count to train on all data.",
-                        RuntimeWarning,
-                        stacklevel=3,
-                    )
-                    self._trim_warned = True
-                a = a[:keep]
+            a = pad_or_trim_batch(jnp.asarray(a), world, ragged, self)
             sh = NamedSharding(self.mesh, P(("node", "local"), *([None] * (a.ndim - 1))))
             out.append(jax.device_put(a, sh))
         return tuple(out)
@@ -302,16 +296,19 @@ class DASO:
         in_cooldown = self.epoch >= self.total_epochs - self.cooldown_epochs
         if in_warmup or in_cooldown:
             # blocking averaging update every batch (reference phases 2/4)
-            self.params = self._global_sync(self.params)
+            self.params = self._blend(self.params, self._global_mean(self.params))
         else:
             if self._pending_global is not None:
                 self._pending_countdown -= 1
                 if self._pending_countdown <= 0:
-                    self.params = self._pending_global
+                    # consume-time blend: the intervening local updates live in
+                    # self.params and are RETAINED at weight 1/4 (reference
+                    # dp_optimizer.py:502-652)
+                    self.params = self._blend(self.params, self._pending_global)
                     self._pending_global = None
             if self.global_skip == 0 or self.batch % max(self.global_skip, 1) == 0:
-                # dispatch async global sync; consumed batches_to_wait later
-                self._pending_global = self._global_sync(self.params)
+                # dispatch async global mean; consumed batches_to_wait later
+                self._pending_global = self._global_mean(self.params)
                 self._pending_countdown = self.batches_to_wait
         self.batch += 1
         if self.last_batch is not None and self.batch >= self.last_batch:

@@ -137,17 +137,15 @@ def manhattan(X: DNDarray, Y: Optional[DNDarray] = None, expand: bool = False) -
 
 
 # jit/ring executables cached on (metric fn, static args) — a fresh jit wrapper per
-# call would retrace and recompile every invocation (jit keys on function identity)
-_JIT_CACHE: dict = {}
+# call would retrace and recompile every invocation (jit keys on function identity).
+# LRU-bounded: rbf's float sigma lands in the key, so hyperparameter sweeps would
+# otherwise retain one executable (and, for ring keys, the mesh) per sigma forever.
+import functools
 
 
+@functools.lru_cache(maxsize=256)
 def _jit_metric(metric: Callable, margs: tuple) -> Callable:
-    key = (metric, margs)
-    fn = _JIT_CACHE.get(key)
-    if fn is None:
-        fn = jax.jit(lambda x, y: metric(x, y, *margs))
-        _JIT_CACHE[key] = fn
-    return fn
+    return jax.jit(lambda x, y: metric(x, y, *margs))
 
 
 def _dist(
@@ -201,40 +199,37 @@ def _ring_dist(
     comm: MeshCommunication, x: jax.Array, y: jax.Array, metric: Callable, margs: tuple = ()
 ) -> jax.Array:
     """Ring systolic tile sweep via shard_map + ppermute."""
-    mesh = comm.mesh
-    axis = comm.axis_name
-    p = comm.size
-    key = ("ring", metric, margs, mesh, axis)
-    fn = _JIT_CACHE.get(key)
-    if fn is None:
-        perm = [(i, (i - 1) % p) for i in range(p)]  # rotate blocks towards lower ranks
+    return _build_ring(metric, margs, comm.mesh, comm.axis_name, comm.size)(x, y)
 
-        def ring(x_block, y_block):
-            i0 = jax.lax.axis_index(axis)
 
-            def step(carry, k):
-                y_cur = carry
-                tile = metric(x_block, y_cur, *margs)  # (m/p, n/p)
-                y_next = jax.lax.ppermute(y_cur, axis, perm)
-                return y_next, (tile, (i0 + k) % p)
+@functools.lru_cache(maxsize=256)
+def _build_ring(metric: Callable, margs: tuple, mesh, axis: str, p: int) -> Callable:
+    perm = [(i, (i - 1) % p) for i in range(p)]  # rotate blocks towards lower ranks
 
-            # p-1 rotated rounds + the final held block without the discarded rotation
-            y_last, (tiles, cols) = jax.lax.scan(step, y_block, jnp.arange(p - 1))
-            tiles = jnp.concatenate([tiles, metric(x_block, y_last, *margs)[None]], axis=0)
-            cols = jnp.concatenate([cols, ((i0 + p - 1) % p)[None]], axis=0)
-            # tiles: (p, m/p, n/p) in ring order; scatter to column order
-            order = jnp.argsort(cols)
-            tiles = jnp.take(tiles, order, axis=0)  # (p, m/p, n/p) by column block
-            return jnp.concatenate(jnp.split(tiles.reshape(p * tiles.shape[1], -1), p, axis=0), axis=1)
+    def ring(x_block, y_block):
+        i0 = jax.lax.axis_index(axis)
 
-        fn = jax.jit(
-            jax.shard_map(
-                ring,
-                mesh=mesh,
-                in_specs=(P(axis, None), P(axis, None)),
-                out_specs=P(axis, None),
-                check_vma=False,
-            )
+        def step(carry, k):
+            y_cur = carry
+            tile = metric(x_block, y_cur, *margs)  # (m/p, n/p)
+            y_next = jax.lax.ppermute(y_cur, axis, perm)
+            return y_next, (tile, (i0 + k) % p)
+
+        # p-1 rotated rounds + the final held block without the discarded rotation
+        y_last, (tiles, cols) = jax.lax.scan(step, y_block, jnp.arange(p - 1))
+        tiles = jnp.concatenate([tiles, metric(x_block, y_last, *margs)[None]], axis=0)
+        cols = jnp.concatenate([cols, ((i0 + p - 1) % p)[None]], axis=0)
+        # tiles: (p, m/p, n/p) in ring order; scatter to column order
+        order = jnp.argsort(cols)
+        tiles = jnp.take(tiles, order, axis=0)  # (p, m/p, n/p) by column block
+        return jnp.concatenate(jnp.split(tiles.reshape(p * tiles.shape[1], -1), p, axis=0), axis=1)
+
+    return jax.jit(
+        jax.shard_map(
+            ring,
+            mesh=mesh,
+            in_specs=(P(axis, None), P(axis, None)),
+            out_specs=P(axis, None),
+            check_vma=False,
         )
-        _JIT_CACHE[key] = fn
-    return fn(x, y)
+    )

@@ -97,6 +97,85 @@ def test_daso_training():
     assert out.shape == (64, 1)
 
 
+def test_daso_consume_time_blend():
+    """The global sync dispatches the node-MEAN only; at consume time it blends
+    0.25*current_local + 0.75*received — local updates made during the wait are
+    retained (reference dp_optimizer.py:502-652). Two runs that share the same
+    dispatch state but diverge in the intervening batches must consume into
+    different params (the old dispatch-time blend made them identical)."""
+    x, y = _toy_data(n=64, seed=3)
+    x2 = x + 1.0  # different intervening batch
+
+    def run(intermediate_x):
+        model = _mlp()
+        daso = ht.optim.DASO(
+            local_optimizer=optax.sgd(5e-2),
+            total_epochs=10,
+            warmup_epochs=0,
+            cooldown_epochs=0,
+            max_global_skips=4,
+        )
+        daso.batches_to_wait = 2
+        daso.global_skip = 100  # one dispatch at batch 0, none after
+        params = model.init(jax.random.PRNGKey(0), x[:2])
+        daso.init(params)
+        daso.make_train_step(_mse, model.apply)
+        daso.step(x, y)              # batch 0: local step + dispatch mean
+        daso.step(intermediate_x, y)  # batch 1: local-only (countdown 2->1)
+        daso.step(intermediate_x, y)  # batch 2: consume = blend(current, mean)
+        return jax.tree.map(lambda a: np.asarray(a), daso.merged_params)
+
+    p_a = run(x)
+    p_b = run(x2)
+    leaves_a = jax.tree.leaves(p_a)
+    leaves_b = jax.tree.leaves(p_b)
+    assert any(
+        not np.allclose(a, b) for a, b in zip(leaves_a, leaves_b)
+    ), "intervening local updates were discarded at consume time"
+
+
+def test_daso_warmup_sync_converges_replicas():
+    """Warmup-phase blocking blends pull the per-node replicas together."""
+    x, y = _toy_data(n=64, seed=4)
+    model = _mlp()
+    daso = ht.optim.DASO(
+        local_optimizer=optax.sgd(1e-2),
+        total_epochs=4,
+        warmup_epochs=4,
+        cooldown_epochs=0,
+        max_global_skips=4,
+    )
+    params = model.init(jax.random.PRNGKey(0), x[:2])
+    daso.init(params)
+    daso.make_train_step(_mse, model.apply)
+    for _ in range(6):
+        daso.step(x, y)
+    # every node slot ends close to the node-mean after repeated 3/4 blends
+    for leaf in jax.tree.leaves(daso.params):
+        arr = np.asarray(leaf)
+        mean = arr.mean(axis=0, keepdims=True)
+        np.testing.assert_allclose(arr, np.broadcast_to(mean, arr.shape), rtol=0.15, atol=0.05)
+
+
+def test_shard_batch_ragged_policies():
+    """'cycle' trains every row (wrap-around pad); 'trim' drops the remainder."""
+    daso = ht.optim.DASO(local_optimizer=optax.sgd(0.1), total_epochs=2)
+    world = daso.nodes * daso.local_size
+    if world == 1:
+        pytest.skip("needs a multi-device mesh")
+    n = world + 1  # ragged
+    a = np.arange(n, dtype=np.float32)[:, None]
+    with pytest.warns(RuntimeWarning):
+        (cyc,) = daso.shard_batch(a)
+    target = -(-n // world) * world
+    assert cyc.shape[0] == target
+    got = np.asarray(cyc)[:, 0]
+    np.testing.assert_array_equal(np.unique(got), np.unique(a))  # all rows present
+    daso._ragged_warned = True
+    (trm,) = daso.shard_batch(a, ragged="trim")
+    assert trm.shape[0] == (n // world) * world
+
+
 def test_daso_skip_logic():
     daso = ht.optim.DASO(local_optimizer=optax.sgd(0.1), total_epochs=10, max_global_skips=8)
     daso.stability.patience = 0  # force plateau on second call
