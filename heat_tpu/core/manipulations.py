@@ -343,18 +343,37 @@ def shape(a: DNDarray) -> Tuple[int, ...]:
 
 def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
     """
-    Sort along an axis; returns ``(sorted_values, original_indices)`` (reference
-    manipulations.py:2263-3050 implements a parallel sample-sort; here a sharded
-    global sort — XLA's distributed sort handles the exchange).
+    Sort along an axis; returns ``(sorted_values, original_indices)``. The
+    1-D-split case runs the exact-rank distributed sort (`_sort.py` — the
+    reference's parallel sample-sort, manipulations.py:2263-3050, re-derived for
+    static shapes: ppermute rank ring + reduce-scatter exchange, no gather);
+    other cases sort along a local axis or fall back to the global formulation.
     """
+    from . import _sort as _dsort
+
     sanitation.sanitize_in(a)
     axis = stride_tricks.sanitize_axis(a.shape, axis)
     if axis is None:
         axis = a.ndim - 1
+    idx_t = types.default_index_type()
+    if axis == 0 and _dsort.can_distribute_sort(a):
+        vals_p, idx_p = _dsort.distributed_sort_1d(a, descending=descending)
+        v = DNDarray(vals_p, a.shape, a.dtype, a.split, a.device, a.comm, True)
+        i = DNDarray(
+            idx_p.astype(idx_t.jnp_type()), a.shape, idx_t, a.split, a.device, a.comm, True
+        )
+        if out is not None:
+            if not isinstance(out, tuple) or len(out) != 2:
+                raise TypeError("out must be a tuple of two DNDarrays")
+            # logical values: out may carry a different split (or none) — its
+            # larray setter re-establishes out's own placement
+            out[0].larray = v.larray.astype(out[0].dtype.jnp_type())
+            out[1].larray = i.larray.astype(out[1].dtype.jnp_type())
+            return out
+        return v, i
     idx = jnp.argsort(a.larray, axis=axis, descending=descending, stable=True)
     vals = jnp.take_along_axis(a.larray, idx, axis=axis)
     v = __wrap(a, vals, a.split)
-    idx_t = types.default_index_type()
     i = DNDarray(
         idx.astype(idx_t.jnp_type()), tuple(idx.shape), idx_t, a.split, a.device, a.comm, True
     )
@@ -475,10 +494,80 @@ def topk(a: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool 
 def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis: Optional[int] = None):
     """
     Unique elements of the array (reference manipulations.py:3051+: local unique +
-    Allgatherv + global dedup; eager global jnp.unique here — the output shape is
-    data-dependent).
+    Allgatherv + global dedup — the same structure here: a shard_map local-unique
+    compresses each chunk BEFORE anything is gathered, so only the per-shard
+    unique values travel; the final cross-shard dedup runs on that reduced set.
+    ``return_inverse``/``axis`` fall back to the global formulation (the inverse
+    is a full-size map anyway).
     """
+    from . import _sort as _dsort
+
     sanitation.sanitize_in(a)
+    dt = np.dtype(a.dtype.jnp_type())
+    if (
+        not return_inverse
+        and axis is None
+        and _dsort.can_distribute_sort(a)
+        and not (dt.kind == "f" and bool(jnp.isnan(a.larray).any()))
+        # NaN != NaN breaks the local compression (duplicate-mask sentinels sort
+        # BELOW NaN); NaN-bearing arrays use the global path, whose NaN handling
+        # matches the replicated case
+    ):
+        comm = a.comm
+        p = comm.size
+        c = a.pshape[0] // p
+        if dt.kind == "f":
+            sentinel = np.inf
+        elif dt.kind == "b":
+            sentinel = True
+        else:
+            sentinel = np.iinfo(dt).max
+        phys = a.filled(sentinel) if a.is_padded else a.parray
+
+        from jax.sharding import PartitionSpec as _P
+
+        def local(v):
+            v = jnp.sort(v.reshape(c))
+            fresh = jnp.concatenate([jnp.ones((1,), bool), v[1:] != v[:-1]])
+            count = fresh.sum()
+            # compress: uniques first, sentinel tail (stable via sort on masked)
+            masked = jnp.where(fresh, v, jnp.asarray(sentinel, dtype=v.dtype))
+            return jnp.sort(masked), count.astype(jnp.int32).reshape(1)
+
+        fn = jax.jit(
+            jax.shard_map(
+                local, mesh=comm.mesh, in_specs=_P(comm.axis_name),
+                out_specs=(_P(comm.axis_name), _P(comm.axis_name)), check_vma=False,
+            )
+        )
+        packed, counts = fn(phys)
+        if packed.is_fully_addressable:
+            # pure D2H: copy each shard's compressed prefix off-device — only
+            # the per-shard unique values ever leave a device
+            by_rank = {}
+            for shard in packed.addressable_shards:
+                r = (shard.index[0].start or 0) // c
+                by_rank[r] = np.asarray(shard.data)
+            cnt = {}
+            for shard in counts.addressable_shards:
+                r = shard.index[0].start or 0
+                for j, v_ in enumerate(np.asarray(shard.data)):
+                    cnt[r + j] = int(v_)
+            ranks = list(by_rank)
+            ranks.sort()  # `sorted` builtin is shadowed by the keyword arg
+            parts = [by_rank[r][: cnt[r]] for r in ranks]
+        else:  # multi-controller: gather the compressed buffers collectively
+            packed_np = np.asarray(jax.device_put(packed, comm.sharding(1, None)))
+            counts_np = np.asarray(jax.device_put(counts, comm.sharding(1, None)))
+            parts = [packed_np[r * c : r * c + int(counts_np[r])] for r in range(p)]
+        vals = jnp.unique(jnp.asarray(np.concatenate(parts)))
+        if a.is_padded:
+            # pad sentinels can masquerade as a genuine extreme value: drop the
+            # trailing sentinel unless the logical data really contains it
+            has_sent = bool(jnp.any(a.larray == sentinel))
+            if not has_sent and vals.size and bool(vals[-1] == sentinel):
+                vals = vals[:-1]
+        return DNDarray(vals, tuple(vals.shape), a.dtype, None, a.device, a.comm, True)
     res = jnp.unique(a.larray, return_inverse=return_inverse, axis=axis)
     if return_inverse:
         vals, inv = res

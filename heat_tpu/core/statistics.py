@@ -244,8 +244,14 @@ def mean(x, axis=None) -> DNDarray:
 
 
 def median(x, axis=None, keepdim: bool = False) -> DNDarray:
-    """Median along an axis (reference statistics.py:867-1074 does distributed
-    selection; here a sharded global sort/select)."""
+    """Median along an axis (reference statistics.py:867-1074 distributed
+    selection — routed through the distributed-percentile path for 1-D split
+    arrays; a sharded global sort/select otherwise)."""
+    from . import _sort as _dsort
+
+    if axis in (None, 0) and isinstance(x, DNDarray) and _dsort.can_distribute_sort(x):
+        res = percentile(x, 50.0, axis=None, interpolation="linear", keepdim=keepdim)
+        return res
 
     def _med(a, ax):
         return jnp.median(a, axis=ax, keepdims=keepdim)
@@ -273,7 +279,38 @@ def percentile(x, q, axis=None, out=None, interpolation: str = "linear", keepdim
         raise ValueError(f"unsupported interpolation method {interpolation!r}")
     axis = stride_tricks.sanitize_axis(x.shape, axis)
     qv = q.larray if isinstance(q, DNDarray) else jnp.asarray(q, dtype=jnp.float32)
-    res = jnp.percentile(x.larray.astype(jnp.float32), qv, axis=axis, method=interpolation, keepdims=keepdim)
+    from . import _sort as _dsort
+
+    if axis in (None, 0) and _dsort.can_distribute_sort(x):
+        # distributed selection (reference statistics.py:867-1074/:1256+): exact-
+        # rank distributed sort, then fetch only the bracketing order statistics
+        sv_p, _ = _dsort.distributed_sort_1d(x)
+        sv = DNDarray(sv_p, x.shape, x.dtype, x.split, x.device, x.comm, True)
+        n = x.shape[0]
+        qf = jnp.asarray(qv, dtype=jnp.float32) / 100.0 * (n - 1)
+        lo = jnp.clip(jnp.floor(qf).astype(jnp.int32), 0, n - 1)
+        hi = jnp.clip(jnp.ceil(qf).astype(jnp.int32), 0, n - 1)
+        idx = jnp.stack([lo.reshape(-1), hi.reshape(-1)])  # tiny gather
+        picked = sv[idx].larray.astype(jnp.float32)
+        v_lo, v_hi = picked[0].reshape(jnp.shape(qf)), picked[1].reshape(jnp.shape(qf))
+        if interpolation == "lower":
+            res = v_lo
+        elif interpolation == "higher":
+            res = v_hi
+        elif interpolation == "midpoint":
+            res = (v_lo + v_hi) / 2.0
+        elif interpolation == "nearest":
+            # half-fraction rounds DOWN — jnp.percentile's convention (numpy
+            # rounds half to even); matching jnp keeps split and replicated
+            # arrays returning identical results
+            res = jnp.where(qf - lo.astype(jnp.float32) <= 0.5, v_lo, v_hi)
+        else:  # linear
+            frac = qf - jnp.floor(qf)
+            res = v_lo * (1.0 - frac) + v_hi * frac
+        if keepdim:
+            res = res.reshape(tuple(jnp.shape(qv)) + (1,) * x.ndim)
+    else:
+        res = jnp.percentile(x.larray.astype(jnp.float32), qv, axis=axis, method=interpolation, keepdims=keepdim)
     # the split axis survives when it is not the reduced axis; a vector q prepends
     # qv.ndim leading axes, shifting the surviving split accordingly
     split = stride_tricks.reduced_split(x.split, axis, keepdim, prepend=int(qv.ndim))

@@ -236,6 +236,33 @@ def test_collective_shims_lower_to_their_collectives():
     assert "all-reduce" in t
 
 
+# ------------------------------------------------------------- distributed sort
+def test_distributed_sort_no_full_gather():
+    """1-D sort over the split axis: exact-rank ring (collective-permute) +
+    reduce-scatter exchange — never a full-operand gather (the reference's
+    sample-sort Alltoallv, manipulations.py:2263-3050, in static shapes)."""
+    comm = _comm()
+    from heat_tpu.core._sort import _build_sort
+
+    n = comm.size * 128
+    fn = _build_sort(comm.mesh, comm.axis_name, comm.size, n, "<f4")
+    x = ht.random.rand(n, split=0, comm=comm)
+    t = fn.lower(x.parray).compile().as_text()
+    assert "collective-permute" in t
+    assert "reduce-scatter" in t
+    assert "all-gather" not in t
+
+
+def test_sort_dispatches_distributed_path():
+    comm = _comm()
+    x = ht.random.rand(comm.size * 64 + 3, split=0, comm=comm)  # ragged too
+    v, i = ht.sort(x)
+    a = x.numpy()
+    np.testing.assert_array_equal(v.numpy(), np.sort(a))
+    np.testing.assert_array_equal(a[i.numpy()], v.numpy())
+    assert v.split == 0 and len(v.parray.addressable_shards) == comm.size
+
+
 # ------------------------------------------------------------------- scoreboard
 # Ops that still fall off the sharded path. Each assertion INTENTIONALLY pins the
 # current (gathering) behavior; when the distributed formulation lands, it will

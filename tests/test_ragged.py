@@ -289,6 +289,31 @@ def test_ragged_vector_collectives(p):
     np.testing.assert_allclose(np.asarray(r)[:7, :3], m)
 
 
+@pytest.mark.parametrize("p", [3, 8])
+def test_distributed_sort_edge_values(p):
+    """NaN/inf float data and sentinel-valued int data sort and dedup exactly
+    like numpy, even on ragged (padded) axes where the pad carries sentinels."""
+    comm = _comm(p)
+    rng = np.random.default_rng(9)
+    f = rng.standard_normal(1003).astype(np.float32)
+    f[::100] = np.nan
+    f[1], f[2] = np.inf, -np.inf
+    x = ht.array(f, split=0, comm=comm)
+    v, i = ht.sort(x)
+    np.testing.assert_array_equal(v.numpy(), np.sort(f))
+    np.testing.assert_array_equal(ht.unique(x).numpy(), np.unique(f))
+    vd, _ = ht.sort(x, descending=True)
+    np.testing.assert_array_equal(
+        np.nan_to_num(vd.numpy(), nan=7e33), np.nan_to_num(np.sort(f)[::-1], nan=7e33)
+    )
+    ii = rng.integers(0, 50, size=1003).astype(np.int32)
+    ii[::7] = np.iinfo(np.int32).max  # genuine sentinel values in the data
+    w = ht.array(ii, split=0, comm=comm)
+    np.testing.assert_array_equal(ht.sort(w)[0].numpy(), np.sort(ii))
+    np.testing.assert_array_equal(ht.unique(w).numpy(), np.unique(ii))
+    np.testing.assert_array_equal(ht.sort(w, descending=True)[0].numpy(), np.sort(ii)[::-1])
+
+
 @pytest.mark.parametrize("n", SIZES)
 def test_statistics_ragged(n):
     comm = _comm(8)
