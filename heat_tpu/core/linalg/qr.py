@@ -13,12 +13,21 @@ tiled CAQR/TSQR tree over ``SquareDiagTiles`` with hand-written tile sends
   local Q is corrected with its slice of the merge Q. This is the same communication
   volume as the reference's tile tree with one tile per device, expressed as one
   all-gather over ICI.
+* ``split=1`` (column-sharded, m >= n) → a **block-column sweep** in ``shard_map``
+  (the reference's split=1 Householder sweep, qr.py:866-1042, as twice-
+  reorthogonalized block classical Gram-Schmidt, "BCGS2"): at step k the current
+  panel is broadcast (one-hot psum), every earlier column block projects it out
+  (local GEMM + psum — two passes, which restores Householder-grade
+  orthogonality), the owner keeps the panel's local QR as its Q block, and the
+  projection coefficients assemble R column-by-column. A is never gathered; per
+  step the traffic is O(m·b + n·b), b = n/p.
 * other splits → gather and factorise locally (correct, not comm-optimal).
 """
 
 from __future__ import annotations
 
 import collections
+import functools
 from typing import Optional, Tuple
 
 import numpy as np
@@ -34,6 +43,65 @@ from ..dndarray import DNDarray
 __all__ = ["qr"]
 
 QR = collections.namedtuple("QR", "Q, R")
+
+
+@functools.lru_cache(maxsize=64)
+def __build_bcgs(mesh, axis: str, p: int, m: int, n: int, jdtype: str):
+    """Compile the split=1 block Gram-Schmidt sweep for one problem shape."""
+    b = n // p
+    dt = np.dtype(jdtype)
+    hi = jax.lax.Precision.HIGHEST
+
+    def local(a_block):  # (m, b) — my column panel
+        me = jax.lax.axis_index(axis)
+
+        def step(k, carry):
+            q_me, r_me = carry  # (m,b), (n,b) my Q block + my R block-column
+            # broadcast column panel k (the owner's CURRENT data)
+            panel = jax.lax.psum(jnp.where(me == k, q_me, jnp.zeros_like(q_me)), axis)
+            active = me < k
+
+            def project(pnl):
+                c = jnp.where(
+                    active, jnp.matmul(q_me.T, pnl, precision=hi), jnp.zeros((b, b), dt)
+                )
+                proj = jax.lax.psum(jnp.matmul(q_me, c, precision=hi), axis)
+                return pnl - proj, c
+
+            p1, c1 = project(panel)
+            p2, c2 = project(p1)  # second pass: BCGS2 reorthogonalization
+            qk, rkk = jnp.linalg.qr(p2)  # redundant (m,b) QR on every shard
+            q_me = jnp.where(me == k, qk, q_me)
+            # R column-block k, assembled once: earlier shards contribute their
+            # projection coefficients at their row block, the owner contributes
+            # the panel R at row block k
+            contrib = jnp.zeros((n, b), dt)
+            contrib = jax.lax.dynamic_update_slice(
+                contrib, jnp.where(active, c1 + c2, jnp.zeros((b, b), dt)), (me * b, 0)
+            )
+            contrib = jnp.where(
+                me == k,
+                jax.lax.dynamic_update_slice(jnp.zeros((n, b), dt), rkk, (k * b, 0)),
+                contrib,
+            )
+            rcol = jax.lax.psum(contrib, axis)
+            r_me = jnp.where(me == k, rcol, r_me)
+            return q_me, r_me
+
+        q0 = a_block
+        r0 = jnp.zeros((n, b), dt)
+        q_f, r_f = jax.lax.fori_loop(0, p, step, (q0, r0))
+        return q_f, r_f
+
+    return jax.jit(
+        jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=P(None, axis),
+            out_specs=(P(None, axis), P(None, axis)),
+            check_vma=False,
+        )
+    )
 
 
 def __tsqr(a: DNDarray) -> Tuple[jax.Array, jax.Array]:
@@ -110,6 +178,25 @@ def qr(
         q_data, r_data = __tsqr(a)
         q = DNDarray(q_data, (m, n), a.dtype, 0, a.device, a.comm, True)
         r = DNDarray(r_data, (n, n), a.dtype, None, a.device, a.comm, True)
+        return QR(q, r)
+
+    use_bcgs = (
+        a.split == 1
+        and isinstance(comm, MeshCommunication)
+        and comm.is_distributed()
+        and comm.is_shardable(a.shape, 1)
+        and m >= n
+        and n // comm.size >= 1
+    )
+    if use_bcgs:
+        fn = __build_bcgs(
+            comm.mesh, comm.axis_name, comm.size, m, n, np.dtype(a.dtype.jnp_type()).str
+        )
+        q_data, r_data = fn(a.parray)
+        r = DNDarray(r_data, (n, n), a.dtype, 1, a.device, a.comm, True)
+        if not calc_q:
+            return QR(None, r)
+        q = DNDarray(q_data, (m, n), a.dtype, 1, a.device, a.comm, True)
         return QR(q, r)
 
     # local / gathered path (reference qr.py:98-106 for split=None)

@@ -556,10 +556,14 @@ def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis
             ranks = list(by_rank)
             ranks.sort()  # `sorted` builtin is shadowed by the keyword arg
             parts = [by_rank[r][: cnt[r]] for r in ranks]
-        else:  # multi-controller: gather the compressed buffers collectively
-            packed_np = np.asarray(jax.device_put(packed, comm.sharding(1, None)))
+        else:  # multi-controller: gather counts (tiny) first, then only the
+            # compressed prefixes up to the largest per-shard unique count —
+            # the collective moves O(p * max_uniques), not O(n)
             counts_np = np.asarray(jax.device_put(counts, comm.sharding(1, None)))
-            parts = [packed_np[r * c : r * c + int(counts_np[r])] for r in range(p)]
+            k = max(int(counts_np.max()), 1)
+            trimmed = packed.reshape(p, c)[:, :k]  # stays sharded on axis 0
+            packed_np = np.asarray(jax.device_put(trimmed, comm.sharding(2, None)))
+            parts = [packed_np[r, : int(counts_np[r])] for r in range(p)]
         vals = jnp.unique(jnp.asarray(np.concatenate(parts)))
         if a.is_padded:
             # pad sentinels can masquerade as a genuine extreme value: drop the

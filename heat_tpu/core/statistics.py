@@ -249,7 +249,8 @@ def median(x, axis=None, keepdim: bool = False) -> DNDarray:
     arrays; a sharded global sort/select otherwise)."""
     from . import _sort as _dsort
 
-    if axis in (None, 0) and isinstance(x, DNDarray) and _dsort.can_distribute_sort(x):
+    ax = stride_tricks.sanitize_axis(x.shape, axis) if isinstance(x, DNDarray) else axis
+    if ax in (None, 0) and isinstance(x, DNDarray) and _dsort.can_distribute_sort(x):
         res = percentile(x, 50.0, axis=None, interpolation="linear", keepdim=keepdim)
         return res
 
@@ -307,6 +308,10 @@ def percentile(x, q, axis=None, out=None, interpolation: str = "linear", keepdim
         else:  # linear
             frac = qf - jnp.floor(qf)
             res = v_lo * (1.0 - frac) + v_hi * frac
+        if np.dtype(x.dtype.jnp_type()).kind == "f":
+            # numpy/jnp propagate NaN for every q; the selection sorts NaN to the
+            # end, so poison explicitly to keep split == replicated results
+            res = jnp.where(jnp.isnan(x.larray).any(), jnp.float32(np.nan), res)
         if keepdim:
             res = res.reshape(tuple(jnp.shape(qv)) + (1,) * x.ndim)
     else:

@@ -263,6 +263,28 @@ def test_sort_dispatches_distributed_path():
     assert v.split == 0 and len(v.parray.addressable_shards) == comm.size
 
 
+# ------------------------------------------------------------- split=1 QR sweep
+def test_bcgs_qr_no_full_gather():
+    """split=1 QR (block Gram-Schmidt sweep, reference qr.py:866) keeps A
+    column-sharded: per-step panel broadcasts are psums (lowered as all-reduce
+    or small all-gathers of one m×b panel), never a gather of the full n-column
+    operand."""
+    import sys as _sys
+
+    comm = _comm()
+    qrmod = _sys.modules["heat_tpu.core.linalg.qr"]
+    build = qrmod.__dict__["__build_bcgs"]
+    n = comm.size * 128
+    m = 2 * n
+    fn = build(comm.mesh, comm.axis_name, comm.size, m, n, "<f4")
+    x = ht.random.randn(m, n, split=1, comm=comm)
+    t = fn.lower(x.parray).compile().as_text()
+    # no gather may produce the full (m, n) operand — (m, b) panels are fine
+    for dims in _gather_result_dims(t):
+        assert not (m in dims and n in dims), f"full-operand gather: {dims}"
+    assert "all-reduce" in t
+
+
 # ------------------------------------------------------------------- scoreboard
 # Ops that still fall off the sharded path. Each assertion INTENTIONALLY pins the
 # current (gathering) behavior; when the distributed formulation lands, it will

@@ -50,6 +50,27 @@ def test_qr(split):
         ht.qr(ht.ones(3))
 
 
+@pytest.mark.parametrize("shape", [(512, 512), (1024, 256), (640, 64)])
+def test_qr_split1_distributed(shape):
+    """Column-sharded QR runs the distributed block Gram-Schmidt sweep
+    (reference split=1 Householder sweep, qr.py:866): Q and R stay split=1,
+    numerics match jnp.linalg.qr grade."""
+    m, n = shape
+    rng = np.random.default_rng(7)
+    a = rng.normal(size=shape).astype(np.float32)
+    h = ht.array(a, split=1)
+    q, r = ht.qr(h)
+    if h.comm.is_distributed() and n % h.comm.size == 0:
+        assert q.split == 1 and r.split == 1
+    qn, rn = q.numpy(), r.numpy()
+    np.testing.assert_allclose(qn @ rn, a, atol=5e-4, rtol=1e-4)
+    assert np.abs(qn.T @ qn - np.eye(n)).max() < 5e-5
+    assert np.abs(np.tril(rn, -1)).max() == 0.0
+    r_only = ht.qr(h, calc_q=False)
+    assert r_only.Q is None
+    np.testing.assert_allclose(np.abs(r_only.R.numpy()), np.abs(rn), atol=1e-4)
+
+
 def test_det_inv_trace():
     rng = np.random.default_rng(6)
     a = rng.normal(size=(4, 4)).astype(np.float32) + 4 * np.eye(4, dtype=np.float32)
