@@ -45,6 +45,44 @@ from .stride_tricks import sanitize_axis
 
 __all__ = ["DNDarray", "LocalIndex"]
 
+import functools
+
+
+@functools.lru_cache(maxsize=128)
+def _build_halo_exchange(mesh, axis: str, p: int, split: int, halo_size: int,
+                         pshape: Tuple[int, ...], jdtype: str):
+    """One compiled ppermute halo-exchange program per (mesh, layout, halo)."""
+    from jax.sharding import PartitionSpec as _P
+
+    chunk = pshape[split] // p
+    fwd = [(i, (i + 1) % p) for i in range(p)]  # receiver gets its PREV's data
+    bwd = [(i, (i - 1) % p) for i in range(p)]  # receiver gets its NEXT's data
+
+    def exchange(block):
+        # block: my chunk with the split axis moved to the front
+        blk = jnp.moveaxis(block, split, 0)
+        i = jax.lax.axis_index(axis)
+        last = blk[chunk - halo_size :]
+        first = blk[:halo_size]
+        from_prev = jax.lax.ppermute(last, axis, fwd)
+        from_next = jax.lax.ppermute(first, axis, bwd)
+        from_prev = jnp.where(i == 0, jnp.zeros_like(from_prev), from_prev)
+        from_next = jnp.where(i == p - 1, jnp.zeros_like(from_next), from_next)
+        stacked = jnp.concatenate([from_prev, blk, from_next], axis=0)
+        return (
+            jnp.moveaxis(from_prev, 0, split),
+            jnp.moveaxis(from_next, 0, split),
+            stacked[None],  # (1, chunk+2h, ...) — axis 0 is the shard axis
+        )
+
+    in_spec = _P(*([None] * split), axis)
+    out_specs = (in_spec, in_spec, _P(axis))
+    return jax.jit(
+        jax.shard_map(
+            exchange, mesh=mesh, in_specs=in_spec, out_specs=out_specs, check_vma=False
+        )
+    )
+
 Scalar = Union[int, float, bool, complex]
 
 
@@ -124,6 +162,14 @@ class DNDarray:
         self.__logical = None  # cached logical view of a padded physical array
         self.__halo_next = None
         self.__halo_prev = None
+        self.__halo_stacked = None
+
+    def __invalidate(self):
+        """Drop caches derived from the physical array (logical view + halos)."""
+        self.__logical = None
+        self.__halo_prev = None
+        self.__halo_next = None
+        self.__halo_stacked = None
 
     # ------------------------------------------------------------------ constructors
     @staticmethod
@@ -172,7 +218,7 @@ class DNDarray:
         ):
             array = self.__comm.placed(array, self.__split, self.__gshape)
         self.__array = array
-        self.__logical = None
+        self.__invalidate()
 
     @property
     def parray(self) -> jax.Array:
@@ -347,17 +393,38 @@ class DNDarray:
 
     @property
     def halo_next(self) -> Optional[jax.Array]:
-        """Halo received from the next neighbor (set by :meth:`get_halo`)."""
+        """
+        Halos received from the NEXT neighbor, as one sharded array: shard ``i``
+        holds the first ``halo_size`` split-rows of shard ``i+1`` (the last
+        shard's slot is zero — non-periodic, the reference's rank p-1 has
+        ``halo_next=None``, dndarray.py:360-446). Set by :meth:`get_halo`.
+        """
         return self.__halo_next
 
     @property
     def halo_prev(self) -> Optional[jax.Array]:
-        """Halo received from the previous neighbor (set by :meth:`get_halo`)."""
+        """
+        Halos received from the PREVIOUS neighbor, as one sharded array: shard
+        ``i`` holds the last ``halo_size`` split-rows of shard ``i-1`` (shard
+        0's slot is zero — the reference's rank 0 has ``halo_prev=None``).
+        Set by :meth:`get_halo`.
+        """
         return self.__halo_prev
 
     @property
     def array_with_halos(self) -> jax.Array:
-        """The local array including any fetched halos (global view: the array itself)."""
+        """
+        After :meth:`get_halo`: the per-shard blocks with both halos attached,
+        stacked as ``(p, chunk + 2*halo, ...)`` and sharded on axis 0 — the form
+        a ``shard_map`` stencil kernel consumes per device (the reference's
+        per-rank ``[halo_prev; local; halo_next]`` concat, dndarray.py:360-446).
+        Outer boundaries are zero-filled. The split axis of the block sits at
+        position 1; trailing axes follow in order (for ``split != 0`` the block
+        is moved-axis so the halo'd axis is axis 1 — move it back after the
+        stencil). Before any ``get_halo``, the plain logical global array.
+        """
+        if self.__halo_stacked is not None:
+            return self.__halo_stacked
         return self.larray
 
     # ------------------------------------------------------------------ layout ops
@@ -405,7 +472,7 @@ class DNDarray:
             self.__array = comm.placed(self.larray, axis, self.__gshape)
         self.__split = axis
         self.__lshape_map = None
-        self.__logical = None
+        self.__invalidate()
         return self
 
     def redistribute_(self, lshape_map=None, target_map=None) -> None:
@@ -427,33 +494,49 @@ class DNDarray:
         comm = self.__comm
         if isinstance(comm, MeshCommunication) and comm.is_distributed():
             self.__array = comm.placed(self.__array, self.__split, self.__gshape)
-            self.__logical = None
+            self.__invalidate()
 
     def get_halo(self, halo_size: int) -> None:
         """
-        Fetches halos of size ``halo_size`` from neighboring ranks and stores them in
-        ``halo_next``/``halo_prev`` (reference dndarray.py:360-446 via Isend/Irecv).
-        With a global array the neighbor slabs are plain slices; sharded stencil
-        kernels should instead use ``shard_map`` + ``lax.ppermute`` directly.
+        Fetches halos of size ``halo_size`` from the neighboring shards via one
+        ``shard_map``+``ppermute`` exchange (the reference's Isend/Irecv
+        neighbor protocol, dndarray.py:360-446): fills :attr:`halo_prev` /
+        :attr:`halo_next` with the adjacent shards' boundary slabs and
+        :attr:`array_with_halos` with the stacked per-shard halo'd blocks.
+        Outer boundaries (shard 0's prev, shard p-1's next) are zero — the
+        reference leaves them ``None`` per rank.
         """
         if not isinstance(halo_size, int):
             raise TypeError(f"halo_size needs to be of Python type integer, {type(halo_size)} given")
         if halo_size < 0:
             raise ValueError(f"halo_size needs to be a positive Python integer, {halo_size} given")
-        if self.__split is None or not self.__comm.is_distributed():
+        comm = self.__comm
+        if (
+            self.__split is None
+            or not comm.is_distributed()
+            or halo_size == 0
+            or not isinstance(comm, MeshCommunication)
+        ):
+            # no exchange requested/possible: drop any previously fetched halos
+            self.__halo_prev = self.__halo_next = self.__halo_stacked = None
             return
-        split = self.__split
-        min_chunk = int(self.lshape_map[:, split].min())
-        if halo_size > min_chunk:
+        split = self.__split_axis
+        p = comm.size
+        chunk = self.pshape[split] // p
+        # the reference requires the halo to fit the smallest chunk
+        # (dndarray.py:376-384); the physical layout's even chunk is the bound
+        # here — ragged tails exchange zero-filled pad rows
+        if halo_size > chunk:
             raise ValueError(
-                f"halo_size {halo_size} needs to be smaller than the smallest local chunk {min_chunk}"
+                f"halo_size {halo_size} needs to be smaller than the local chunk {chunk}"
             )
-        idx_prev = [slice(None)] * self.ndim
-        idx_prev[split] = slice(0, halo_size)
-        idx_next = [slice(None)] * self.ndim
-        idx_next[split] = slice(self.shape[split] - halo_size, self.shape[split])
-        self.__halo_prev = self.larray[tuple(idx_next)]
-        self.__halo_next = self.larray[tuple(idx_prev)]
+        fn = _build_halo_exchange(
+            comm.mesh, comm.axis_name, p, split, halo_size, self.pshape,
+            np.dtype(self.__dtype.jnp_type()).str,
+        )
+        # zero-fill pads so ragged tails exchange zeros, not garbage
+        phys = self.filled(0) if self.is_padded else self.__array
+        self.__halo_prev, self.__halo_next, self.__halo_stacked = fn(phys)
 
     # ------------------------------------------------------------------ conversions
     def astype(self, dtype, copy: bool = True) -> "DNDarray":
@@ -470,7 +553,7 @@ class DNDarray:
                 casted, self.shape, dtype, self.split, self.device, self.comm, True
             )
         self.__array = casted
-        self.__logical = None
+        self.__invalidate()
         self.__dtype = dtype
         return self
 
@@ -735,7 +818,7 @@ class DNDarray:
             self.__array = jnp.where(
                 jkey, jnp.asarray(value, dtype=self.__array.dtype), self.__array
             )
-            self.__logical = None
+            self.__invalidate()
             return
         norm, _, fast = self.__index_plan(key)
         if fast:
@@ -746,7 +829,7 @@ class DNDarray:
             if isinstance(comm, MeshCommunication) and self.__split is not None and comm.is_distributed():
                 updated = comm.placed(updated, self.__split, self.__gshape)
             self.__array = updated
-        self.__logical = None
+        self.__invalidate()
 
     # dunder arithmetic/comparison operators are attached by the op modules
     # (arithmetics.py, relational.py, …) heat-style, see each module's tail.
