@@ -113,6 +113,40 @@ def bench_torch_cpu(data_np, iters=3):
     return iters / dt
 
 
+def bench_allreduce():
+    """
+    The second BASELINE.json north-star: "DNDarray Allreduce ICI bandwidth
+    (GB/s)" — the psum the __reduce_op path emits, measured at several buffer
+    sizes (benchmarks/allreduce_bandwidth_bench.py wired in here so the driver
+    captures both numbers in one JSON line). With one chip the psum degenerates
+    and the number is the buffer's HBM-roundtrip bandwidth; the roofline is
+    picked accordingly: TPU v5e ≈ 819 GB/s HBM, ≈ 186 GB/s accumulated ICI
+    (4 links × ~46.5 GB/s) for multi-chip.
+    """
+    import os
+    import sys
+
+    import jax
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    from allreduce_bandwidth_bench import bench_size
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    mesh = Mesh(np.asarray(devs), ("d",))
+    best = 0.0
+    for mb in (8, 64, 256):
+        best = max(best, bench_size(mesh, mb * 1024 * 1024, trials=3))
+    plat = devs[0].platform
+    if plat == "tpu":
+        roofline = 819.0 if len(devs) == 1 else 186.0 * len(devs) / 2
+        kind = "HBM roundtrip" if len(devs) == 1 else "ICI allreduce"
+    else:
+        roofline, kind = None, "host memory (CPU mesh)"
+    pct = round(100.0 * best / roofline, 1) if roofline else None
+    return round(best, 2), pct, f"{kind}, {len(devs)} device(s)"
+
+
 def main():
     rng = np.random.default_rng(0)
     data = _data(rng)
@@ -122,6 +156,10 @@ def main():
         vs = tpu_ips / torch_ips
     except Exception:
         torch_ips, vs = None, None
+    try:
+        ar_gbps, ar_pct, ar_note = bench_allreduce()
+    except Exception:
+        ar_gbps = ar_pct = ar_note = None
     print(
         json.dumps(
             {
@@ -131,6 +169,9 @@ def main():
                 "vs_baseline": round(vs, 3) if vs is not None else None,
                 "device": device,
                 "baseline_iters_per_sec_torch_cpu": round(torch_ips, 3) if torch_ips else None,
+                "allreduce_gbps": ar_gbps,
+                "allreduce_roofline_pct": ar_pct,
+                "allreduce_note": ar_note,
             }
         )
     )

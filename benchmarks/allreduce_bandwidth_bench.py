@@ -31,7 +31,14 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from _common import sync as _sync
 
 
-def bench_size(mesh, n_bytes, trials):
+def bench_size(mesh, n_bytes, trials, chain: int = 16):
+    """
+    Time ``chain`` dependent allreduces inside ONE compiled program so the fixed
+    per-dispatch cost (tens of ms on tunneled runtimes) amortizes away; report
+    per-allreduce algorithm bandwidth. Single device: the psum is an identity
+    XLA would fold, so a dependent scaling chain measures the HBM roundtrip the
+    buffer would pay instead.
+    """
     p = mesh.devices.size
     n = n_bytes // 4
     local = n // p
@@ -40,24 +47,77 @@ def bench_size(mesh, n_bytes, trials):
         NamedSharding(mesh, P("d", None)),
     )
 
-    @jax.jit
-    def allreduce(x):
-        return shard_map(
-            lambda v: jax.lax.psum(v, "d"),
-            mesh=mesh,
-            in_specs=P("d", None),
-            out_specs=P("d", None),
-        )(x)
+    if p > 1:
 
-    _sync(allreduce(x))  # compile + warmup
-    best = float("inf")
-    for _ in range(trials):
-        t0 = time.perf_counter()
-        out = allreduce(x)
-        _sync(out)
-        best = min(best, time.perf_counter() - t0)
+        def body(v):
+            # 1/p scaling keeps magnitudes stable; the collective is a real
+            # data dependency, so none of the chain folds away
+            return jax.lax.psum(v, "d") * jnp.float32(1.0 / p)
+
+        @jax.jit
+        def prog(x):
+            def local_chain(v):
+                for _ in range(chain):
+                    v = body(v)
+                return v
+
+            return shard_map(
+                local_chain, mesh=mesh, in_specs=P("d", None), out_specs=P("d", None)
+            )(x)
+
+    else:
+
+        @jax.jit
+        def prog(x):
+            for _ in range(chain):
+                # barrier defeats elementwise fusion: each step is a real HBM
+                # read+write, not one fused 16-multiply kernel
+                x = jax.lax.optimization_barrier(x * jnp.float32(1.000001))
+            return x
+
+    _sync(prog(x))  # compile + warmup
+
+    def timed(fn):
+        best = float("inf")
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            _sync(fn(x))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    # difference two chain lengths so the fixed dispatch/fetch cost cancels
+    if chain < 2:
+        t = timed(prog)
+        eff_bytes = 2 * (p - 1) / p * (local * p * 4) if p > 1 else local * 4 * 2
+        return eff_bytes / (t / chain) / 1e9
+    short_chain = max(1, chain // 8)
+    if p > 1:
+
+        @jax.jit
+        def prog_short(x):
+            def local_chain(v):
+                for _ in range(short_chain):
+                    v = body(v)
+                return v
+
+            return shard_map(
+                local_chain, mesh=mesh, in_specs=P("d", None), out_specs=P("d", None)
+            )(x)
+
+    else:
+
+        @jax.jit
+        def prog_short(x):
+            for _ in range(short_chain):
+                x = jax.lax.optimization_barrier(x * jnp.float32(1.000001))
+            return x
+
+    _sync(prog_short(x))
+    t_long, t_short = timed(prog), timed(prog_short)
+    dt = t_long - t_short
+    per_op = (dt / (chain - short_chain)) if dt > 0 else t_long / chain
     eff_bytes = 2 * (p - 1) / p * (local * p * 4) if p > 1 else local * 4 * 2
-    return eff_bytes / best / 1e9
+    return eff_bytes / per_op / 1e9
 
 
 def main():

@@ -28,11 +28,16 @@ import heat_tpu as ht
 def step(u: "ht.DNDarray", alpha: float) -> "ht.DNDarray":
     """One explicit Euler step of u_t = alpha * u_xx (Dirichlet boundaries)."""
     u.get_halo(1)
-    blocks = u.array_with_halos  # (p, c+2, ) sharded on axis 0
-    lap = blocks[:, :-2] - 2.0 * blocks[:, 1:-1] + blocks[:, 2:]  # (p, c)
-    new = blocks[:, 1:-1] + alpha * lap
-    flat = new.reshape(-1)  # (p*c,) — merging the leading sharded axis keeps placement
-    out = ht.array(flat[: u.shape[0]], is_split=0, comm=u.comm)
+    if u.split is not None and u.comm.is_distributed():
+        blocks = u.array_with_halos  # (p, c+2) sharded on axis 0
+        lap = blocks[:, :-2] - 2.0 * blocks[:, 1:-1] + blocks[:, 2:]  # (p, c)
+        new = blocks[:, 1:-1] + alpha * lap
+        flat = new.reshape(-1)  # (p*c,) — merging the leading sharded axis keeps placement
+        out = ht.array(flat[: u.shape[0]], is_split=0, comm=u.comm)
+    else:  # single device: no halos to exchange, plain local stencil
+        v = u.larray
+        lap = jnp.zeros_like(v).at[1:-1].set(v[:-2] - 2.0 * v[1:-1] + v[2:])
+        out = ht.array(v + alpha * lap, comm=u.comm)
     # pin the physical endpoints (Dirichlet u=0)
     out[0] = 0.0
     out[-1] = 0.0

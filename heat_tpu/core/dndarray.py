@@ -50,7 +50,7 @@ import functools
 
 @functools.lru_cache(maxsize=128)
 def _build_halo_exchange(mesh, axis: str, p: int, split: int, halo_size: int,
-                         pshape: Tuple[int, ...], jdtype: str):
+                         pshape: Tuple[int, ...]):
     """One compiled ppermute halo-exchange program per (mesh, layout, halo)."""
     from jax.sharding import PartitionSpec as _P
 
@@ -530,10 +530,7 @@ class DNDarray:
             raise ValueError(
                 f"halo_size {halo_size} needs to be smaller than the local chunk {chunk}"
             )
-        fn = _build_halo_exchange(
-            comm.mesh, comm.axis_name, p, split, halo_size, self.pshape,
-            np.dtype(self.__dtype.jnp_type()).str,
-        )
+        fn = _build_halo_exchange(comm.mesh, comm.axis_name, p, split, halo_size, self.pshape)
         # zero-fill pads so ragged tails exchange zeros, not garbage
         phys = self.filled(0) if self.is_padded else self.__array
         self.__halo_prev, self.__halo_next, self.__halo_stacked = fn(phys)
