@@ -31,7 +31,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from _common import sync as _sync
 
 
-def bench_size(mesh, n_bytes, trials, chain: int = 16):
+def bench_size(mesh, n_bytes, trials, chain: int = 64):
     """
     Time ``chain`` dependent allreduces inside ONE compiled program so the fixed
     per-dispatch cost (tens of ms on tunneled runtimes) amortizes away; report
@@ -46,38 +46,38 @@ def bench_size(mesh, n_bytes, trials, chain: int = 16):
         jnp.ones((p, local), jnp.float32),
         NamedSharding(mesh, P("d", None)),
     )
+    eff_bytes = 2 * (p - 1) / p * (local * p * 4) if p > 1 else local * 4 * 2
 
-    if p > 1:
+    def make_prog(k):
+        if p > 1:
 
-        def body(v):
-            # 1/p scaling keeps magnitudes stable; the collective is a real
-            # data dependency, so none of the chain folds away
-            return jax.lax.psum(v, "d") * jnp.float32(1.0 / p)
+            def body(v):
+                # 1/p scaling keeps magnitudes stable; the collective is a real
+                # data dependency, so none of the chain folds away
+                return jax.lax.psum(v, "d") * jnp.float32(1.0 / p)
 
-        @jax.jit
-        def prog(x):
             def local_chain(v):
-                for _ in range(chain):
+                for _ in range(k):
                     v = body(v)
                 return v
 
-            return shard_map(
-                local_chain, mesh=mesh, in_specs=P("d", None), out_specs=P("d", None)
-            )(x)
+            return jax.jit(
+                lambda x: shard_map(
+                    local_chain, mesh=mesh, in_specs=P("d", None), out_specs=P("d", None)
+                )(x)
+            )
 
-    else:
-
-        @jax.jit
-        def prog(x):
-            for _ in range(chain):
+        def hbm_chain(x):
+            for _ in range(k):
                 # barrier defeats elementwise fusion: each step is a real HBM
-                # read+write, not one fused 16-multiply kernel
+                # read+write, not one fused k-multiply kernel
                 x = jax.lax.optimization_barrier(x * jnp.float32(1.000001))
             return x
 
-    _sync(prog(x))  # compile + warmup
+        return jax.jit(hbm_chain)
 
     def timed(fn):
+        _sync(fn(x))  # compile + warmup
         best = float("inf")
         for _ in range(trials):
             t0 = time.perf_counter()
@@ -85,38 +85,19 @@ def bench_size(mesh, n_bytes, trials, chain: int = 16):
             best = min(best, time.perf_counter() - t0)
         return best
 
-    # difference two chain lengths so the fixed dispatch/fetch cost cancels
+    t_long = timed(make_prog(chain))
     if chain < 2:
-        t = timed(prog)
-        eff_bytes = 2 * (p - 1) / p * (local * p * 4) if p > 1 else local * 4 * 2
-        return eff_bytes / (t / chain) / 1e9
+        return eff_bytes / (t_long / chain) / 1e9
+    # difference two chain lengths so the fixed dispatch/fetch cost cancels; if
+    # the difference sinks into timing jitter, fall back to the conservative
+    # whole-chain rate instead of publishing a noise-made number
     short_chain = max(1, chain // 8)
-    if p > 1:
-
-        @jax.jit
-        def prog_short(x):
-            def local_chain(v):
-                for _ in range(short_chain):
-                    v = body(v)
-                return v
-
-            return shard_map(
-                local_chain, mesh=mesh, in_specs=P("d", None), out_specs=P("d", None)
-            )(x)
-
-    else:
-
-        @jax.jit
-        def prog_short(x):
-            for _ in range(short_chain):
-                x = jax.lax.optimization_barrier(x * jnp.float32(1.000001))
-            return x
-
-    _sync(prog_short(x))
-    t_long, t_short = timed(prog), timed(prog_short)
+    t_short = timed(make_prog(short_chain))
     dt = t_long - t_short
-    per_op = (dt / (chain - short_chain)) if dt > 0 else t_long / chain
-    eff_bytes = 2 * (p - 1) / p * (local * p * 4) if p > 1 else local * 4 * 2
+    if dt < 0.2 * t_long:
+        per_op = t_long / chain
+    else:
+        per_op = dt / (chain - short_chain)
     return eff_bytes / per_op / 1e9
 
 

@@ -237,10 +237,15 @@ DNDarray.__rmod__ = lambda self, other: mod(other, self)
 DNDarray.__pow__ = lambda self, other: pow(self, other)
 DNDarray.__rpow__ = lambda self, other: pow(other, self)
 DNDarray.__and__ = lambda self, other: bitwise_and(self, other)
+DNDarray.__rand__ = lambda self, other: bitwise_and(other, self)
 DNDarray.__or__ = lambda self, other: bitwise_or(self, other)
+DNDarray.__ror__ = lambda self, other: bitwise_or(other, self)
 DNDarray.__xor__ = lambda self, other: bitwise_xor(self, other)
+DNDarray.__rxor__ = lambda self, other: bitwise_xor(other, self)
 DNDarray.__lshift__ = lambda self, other: left_shift(self, other)
+DNDarray.__rlshift__ = lambda self, other: left_shift(other, self)
 DNDarray.__rshift__ = lambda self, other: right_shift(self, other)
+DNDarray.__rrshift__ = lambda self, other: right_shift(other, self)
 DNDarray.__invert__ = lambda self: invert(self)
 DNDarray.__neg__ = lambda self: neg(self)
 DNDarray.__pos__ = lambda self: pos(self)

@@ -130,6 +130,10 @@ class DNDarray:
     Reference parity: dndarray.py:38-86.
     """
 
+    # numpy binary ops defer to DNDarray's reflected operators instead of
+    # consuming it through __array__ (np_row + dndarray stays a DNDarray)
+    __array_priority__ = 100
+
     def __init__(
         self,
         array: jax.Array,

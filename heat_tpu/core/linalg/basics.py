@@ -290,4 +290,7 @@ def vector_norm(x: DNDarray, axis=None, keepdims: bool = False, ord=None) -> DND
 
 
 DNDarray.__matmul__ = lambda self, other: matmul(self, other)
+DNDarray.__rmatmul__ = lambda self, other: matmul(
+    other if isinstance(other, DNDarray) else factories.array(other, comm=self.comm), self
+)
 DNDarray.transpose = transpose

@@ -1,0 +1,345 @@
+"""
+Operator edge-case matrix: dtype casts, bitwise/shift ops, out=/where=
+parameters, keepdims/tuple-axis reductions, and mixed-operand binaries over
+split × even/ragged shapes — the reference's per-module edge density
+(reference heat/core/tests/test_arithmetics.py, test_logical.py,
+test_relational.py, test_types.py cast tests) on the golden harness.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import heat_tpu as ht
+from heat_tpu.core.communication import MeshCommunication
+
+
+def _comm():
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs a multi-device mesh")
+    return MeshCommunication(devices=devs)
+
+
+SPLITS = [None, 0, 1]
+SHAPES = [(16, 6), (13, 5)]
+
+
+def _mk(shape, split, comm, dtype=np.float32, lo=1, hi=9):
+    a = (np.arange(np.prod(shape)) % (hi - lo) + lo).astype(dtype).reshape(shape)
+    return a, ht.array(a.copy(), split=split, comm=comm)
+
+
+# ----------------------------------------------------------------- dtype casts
+CASTS = [
+    (ht.float32, np.float32),
+    (ht.float64, np.float64),
+    (ht.int32, np.int32),
+    (ht.int64, np.int64),
+    (ht.uint8, np.uint8),
+    (ht.bool, np.bool_),
+    (ht.bfloat16, None),
+    (ht.float16, np.float16),
+]
+
+
+@pytest.mark.parametrize("split", [None, 0])
+@pytest.mark.parametrize("ht_t,np_t", CASTS)
+def test_astype_matrix(split, ht_t, np_t):
+    comm = _comm()
+    a, x = _mk((13, 4), split, comm)
+    y = x.astype(ht_t)
+    assert y.dtype == ht_t
+    assert y.shape == x.shape and y.split == split
+    if np_t is not None and np_t is not np.bool_:
+        np.testing.assert_allclose(y.numpy().astype(np.float64), a.astype(np_t).astype(np.float64))
+    # in-place variant updates metadata
+    z = ht.array(a.copy(), split=split, comm=comm)
+    r = z.astype(ht_t, copy=False)
+    assert r is z and z.dtype == ht_t
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_scalar_casts(split):
+    comm = _comm()
+    one = ht.array(np.array([2.5], np.float32), split=split, comm=comm)
+    assert float(one) == 2.5
+    assert int(one) == 2
+    assert bool(one) is True
+    assert complex(one) == 2.5 + 0j
+    idx = ht.array(np.array([3], np.int32), split=split, comm=comm)
+    assert np.arange(10)[int(idx)] == 3  # __index__
+    with pytest.raises(ValueError):
+        float(ht.ones((2, 2), comm=comm))
+    with pytest.raises((TypeError, IndexError)):
+        np.arange(10)[one]  # float can't be an index
+
+
+# ----------------------------------------------------------- bitwise and shifts
+@pytest.mark.parametrize("split", SPLITS)
+def test_bitwise_and_shift_ops(split):
+    comm = _comm()
+    a, x = _mk((13, 5), split, comm, dtype=np.int32)
+    b, y = _mk((13, 5), split, comm, dtype=np.int32, lo=2, hi=11)
+    np.testing.assert_array_equal(ht.bitwise_and(x, y).numpy(), a & b)
+    np.testing.assert_array_equal(ht.bitwise_or(x, y).numpy(), a | b)
+    np.testing.assert_array_equal(ht.bitwise_xor(x, y).numpy(), a ^ b)
+    np.testing.assert_array_equal(ht.invert(x).numpy(), ~a)
+    np.testing.assert_array_equal(ht.left_shift(x, 2).numpy(), a << 2)
+    np.testing.assert_array_equal(ht.right_shift(x, 1).numpy(), a >> 1)
+    np.testing.assert_array_equal((x & y).numpy(), a & b)
+    np.testing.assert_array_equal((x | y).numpy(), a | b)
+    np.testing.assert_array_equal((x ^ y).numpy(), a ^ b)
+    with pytest.raises(TypeError):
+        ht.bitwise_and(x.astype(ht.float32), y)
+
+
+# ----------------------------------------------------------------- mod / floor
+@pytest.mark.parametrize("split", SPLITS)
+def test_division_family(split):
+    comm = _comm()
+    a, x = _mk((13, 5), split, comm)
+    b, y = _mk((13, 5), split, comm, lo=2, hi=7)
+    np.testing.assert_allclose(ht.div(x, y).numpy(), a / b, rtol=1e-6)
+    np.testing.assert_allclose(ht.floordiv(x, y).numpy(), a // b)
+    np.testing.assert_allclose(ht.mod(x, y).numpy(), a % b)
+    np.testing.assert_allclose(ht.fmod(x, y).numpy(), np.fmod(a, b))
+    np.testing.assert_allclose(ht.remainder(x, y).numpy(), np.remainder(a, b))
+    np.testing.assert_allclose((x // y).numpy(), a // b)
+    np.testing.assert_allclose((x % y).numpy(), a % b)
+    np.testing.assert_allclose((x ** 2).numpy(), a ** 2)
+    np.testing.assert_allclose((2 ** x).numpy().astype(np.float64), (2.0 ** a).astype(np.float64), rtol=2e-5)
+    np.testing.assert_allclose((-x).numpy(), -a)
+    np.testing.assert_allclose((+x).numpy(), +a)
+    np.testing.assert_allclose(abs(-x).numpy(), a)
+
+
+# ------------------------------------------------------------------ out= where=
+@pytest.mark.parametrize("split", [None, 0])
+def test_out_parameter(split):
+    comm = _comm()
+    a, x = _mk((13, 5), split, comm)
+    b, y = _mk((13, 5), split, comm, lo=3, hi=8)
+    out = ht.zeros((13, 5), split=split, comm=comm)
+    r = ht.add(x, y, out=out)
+    assert r is out
+    np.testing.assert_array_equal(out.numpy(), a + b)
+    out2 = ht.zeros((13, 5), split=split, comm=comm)
+    ht.exp(x / 10.0, out=out2)
+    np.testing.assert_allclose(out2.numpy(), np.exp(a / 10.0), rtol=1e-5)
+    with pytest.raises(ValueError):
+        ht.add(x, y, out=ht.zeros((2, 2), comm=comm))
+    with pytest.raises(TypeError):
+        ht.add(x, y, out="nope")
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_where_parameter(split):
+    comm = _comm()
+    a, x = _mk((13, 5), split, comm)
+    b, y = _mk((13, 5), split, comm, lo=3, hi=8)
+    mask = (np.arange(13) % 2 == 0)[:, None] & np.ones((13, 5), bool)
+    got = ht.add(x, y, where=ht.array(mask, comm=comm))
+    want = np.where(mask, a + b, 0)
+    np.testing.assert_array_equal(got.numpy(), want)
+
+
+# ------------------------------------------------------- reductions: keep/tuple
+@pytest.mark.parametrize("shape", SHAPES)
+@pytest.mark.parametrize("split", SPLITS)
+def test_reduction_keepdims_and_tuple_axes(shape, split):
+    comm = _comm()
+    a, x = _mk(shape, split, comm)
+    np.testing.assert_allclose(ht.sum(x, axis=(0, 1)).numpy(), a.sum(axis=(0, 1)), rtol=1e-5)
+    np.testing.assert_allclose(
+        ht.sum(x, axis=(0, 1), keepdim=True).numpy(), a.sum(axis=(0, 1), keepdims=True), rtol=1e-5
+    )
+    np.testing.assert_allclose(ht.sum(x, axis=-1).numpy(), a.sum(axis=-1), rtol=1e-5)
+    np.testing.assert_allclose(
+        ht.max(x, axis=0, keepdim=True).numpy(), a.max(axis=0, keepdims=True)
+    )
+    np.testing.assert_allclose(
+        ht.min(x, axis=-2, keepdim=True).numpy(), a.min(axis=0, keepdims=True)
+    )
+    np.testing.assert_allclose(ht.mean(x, axis=(0,)).numpy(), a.mean(axis=0), rtol=1e-5)
+    # split survives reduction over the other axis
+    if split == 0:
+        assert ht.sum(x, axis=1).split == 0
+        assert ht.sum(x, axis=0).split is None
+    if split == 1:
+        assert ht.sum(x, axis=0).split == 0  # shifted left
+        assert ht.sum(x, axis=0, keepdim=True).split == 1
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_logical_reductions_matrix(split):
+    comm = _comm()
+    a = (np.arange(65) % 5 > 0).reshape(13, 5)
+    x = ht.array(a, split=split, comm=comm)
+    assert bool(ht.all(x)) == a.all()
+    assert bool(ht.any(x)) == a.any()
+    np.testing.assert_array_equal(ht.all(x, axis=0).numpy(), a.all(axis=0))
+    np.testing.assert_array_equal(ht.any(x, axis=1).numpy(), a.any(axis=1))
+    np.testing.assert_array_equal(
+        ht.logical_and(x, ~x if False else x).numpy(), np.logical_and(a, a)
+    )
+    np.testing.assert_array_equal(ht.logical_not(x).numpy(), ~a)
+    np.testing.assert_array_equal(ht.logical_xor(x, x).numpy(), np.zeros_like(a))
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_isclose_family(split):
+    comm = _comm()
+    a, x = _mk((13, 5), split, comm)
+    noisy = a + 1e-7
+    y = ht.array(noisy, split=split, comm=comm)
+    assert bool(ht.allclose(x, y, atol=1e-5))
+    assert not bool(ht.allclose(x, y + 1.0))
+    np.testing.assert_array_equal(
+        ht.isclose(x, y, atol=1e-5).numpy(), np.isclose(a, noisy, atol=1e-5)
+    )
+    f = a.copy()
+    f[0, 0] = np.inf
+    f[1, 1] = -np.inf
+    f[2, 2] = np.nan
+    z = ht.array(f, split=split, comm=comm)
+    np.testing.assert_array_equal(ht.isfinite(z).numpy(), np.isfinite(f))
+    np.testing.assert_array_equal(ht.isinf(z).numpy(), np.isinf(f))
+    np.testing.assert_array_equal(ht.isnan(z).numpy(), np.isnan(f))
+    np.testing.assert_array_equal(ht.isposinf(z).numpy(), np.isposinf(f))
+    np.testing.assert_array_equal(ht.isneginf(z).numpy(), np.isneginf(f))
+
+
+# ----------------------------------------------------------- mixed-split binary
+@pytest.mark.parametrize("s1", SPLITS)
+@pytest.mark.parametrize("s2", SPLITS)
+def test_mixed_split_binary(s1, s2):
+    comm = _comm()
+    a, x = _mk((13, 5), s1, comm)
+    b, y = _mk((13, 5), s2, comm, lo=2, hi=6)
+    got = x + y
+    np.testing.assert_array_equal(got.numpy(), a + b)
+    # dominance: leftmost non-None split wins (reference _operations.py:57-71)
+    expect = s1 if s1 is not None else s2
+    assert got.split == expect
+    got2 = x * y - y
+    np.testing.assert_array_equal(got2.numpy(), a * b - b)
+
+
+@pytest.mark.parametrize("split", [0, 1])
+def test_broadcast_binary_combinations(split):
+    comm = _comm()
+    a, x = _mk((13, 5), split, comm)
+    row = np.arange(5, dtype=np.float32)
+    col = np.arange(13, dtype=np.float32)[:, None]
+    np.testing.assert_array_equal((x + row).numpy(), a + row)
+    np.testing.assert_array_equal((x * col).numpy(), a * col)
+    np.testing.assert_array_equal((row + x).numpy(), row + a)
+    hrow = ht.array(row, comm=comm)
+    np.testing.assert_array_equal((x - hrow).numpy(), a - row)
+    hcol = ht.array(col, split=0 if split == 0 else None, comm=comm)
+    np.testing.assert_array_equal((x / (hcol + 1)).numpy(), a / (col + 1))
+    # scalar operands keep weak typing
+    assert (x + 1).dtype == x.dtype
+    assert (x * 2.0).dtype == x.dtype
+
+
+# -------------------------------------------------------------------- rounding
+@pytest.mark.parametrize("split", [None, 0])
+def test_rounding_family(split):
+    comm = _comm()
+    a = np.linspace(-3.7, 3.7, 28, dtype=np.float32).reshape(7, 4)
+    x = ht.array(a, split=split, comm=comm)
+    np.testing.assert_array_equal(ht.floor(x).numpy(), np.floor(a))
+    np.testing.assert_array_equal(ht.ceil(x).numpy(), np.ceil(a))
+    np.testing.assert_array_equal(ht.trunc(x).numpy(), np.trunc(a))
+    np.testing.assert_allclose(ht.round(x).numpy(), np.round(a))
+    np.testing.assert_array_equal(ht.sign(x).numpy(), np.sign(a))
+    np.testing.assert_array_equal(ht.abs(x).numpy(), np.abs(a))
+    np.testing.assert_array_equal(ht.fabs(x).numpy(), np.fabs(a))
+    np.testing.assert_allclose(ht.clip(x, -1.0, 2.0).numpy(), np.clip(a, -1.0, 2.0))
+    frac, whole = ht.modf(x)
+    wf, ww = np.modf(a)
+    np.testing.assert_allclose(frac.numpy(), wf, atol=1e-6)
+    np.testing.assert_allclose(whole.numpy(), ww)
+
+
+# ------------------------------------------------------------------ cumulative
+@pytest.mark.parametrize("shape", SHAPES)
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("axis", [0, 1])
+def test_cumulative_matrix(shape, split, axis):
+    comm = _comm()
+    a, x = _mk(shape, split, comm)
+    np.testing.assert_allclose(ht.cumsum(x, axis=axis).numpy(), a.cumsum(axis=axis), rtol=1e-5)
+    small = a / a.max()
+    y = ht.array(small, split=split, comm=comm)
+    np.testing.assert_allclose(ht.cumprod(y, axis=axis).numpy(), small.cumprod(axis=axis), rtol=1e-4)
+
+
+# ---------------------------------------------------------------------- diff
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("n", [1, 2])
+def test_diff_matrix(split, n):
+    comm = _comm()
+    a, x = _mk((13, 5), split, comm)
+    for axis in (0, 1, -1):
+        np.testing.assert_allclose(
+            ht.diff(x, n=n, axis=axis).numpy(), np.diff(a, n=n, axis=axis)
+        )
+
+
+# ---------------------------------------------------------------- statistics
+@pytest.mark.parametrize("split", [None, 0])
+def test_statistics_edge(split):
+    comm = _comm()
+    rng = np.random.default_rng(11)
+    a = rng.standard_normal((13, 5)).astype(np.float32)
+    x = ht.array(a, split=split, comm=comm)
+    np.testing.assert_allclose(ht.average(x).numpy(), np.average(a), rtol=1e-5)
+    w = np.abs(rng.standard_normal(5)).astype(np.float32)
+    avg, wsum = ht.average(x, axis=1, weights=ht.array(w, comm=comm), returned=True)
+    np.testing.assert_allclose(avg.numpy(), np.average(a, axis=1, weights=w), rtol=1e-5)
+    np.testing.assert_allclose(ht.var(x, axis=0, ddof=1).numpy(), a.var(axis=0, ddof=1), rtol=1e-4)
+    np.testing.assert_allclose(ht.std(x, axis=1).numpy(), a.std(axis=1), rtol=1e-4)
+    np.testing.assert_allclose(ht.cov(x.resplit(None).T if False else ht.array(a.T, comm=comm)).numpy(), np.cov(a.T), rtol=1e-4)
+    i = rng.integers(0, 9, size=29)
+    y = ht.array(i, split=split if split != 1 else 0, comm=comm)
+    np.testing.assert_array_equal(ht.bincount(y).numpy(), np.bincount(i))
+    np.testing.assert_allclose(
+        ht.skew(x, axis=0, unbiased=False).numpy(),
+        ((a - a.mean(0)) ** 3).mean(0) / (((a - a.mean(0)) ** 2).mean(0) ** 1.5),
+        rtol=1e-3,
+    )
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_maximum_minimum_elementwise(split):
+    comm = _comm()
+    a, x = _mk((13, 5), split, comm)
+    b, y = _mk((13, 5), split, comm, lo=3, hi=8)
+    np.testing.assert_array_equal(ht.maximum(x, y).numpy(), np.maximum(a, b))
+    np.testing.assert_array_equal(ht.minimum(x, y).numpy(), np.minimum(a, b))
+    f = a.copy()
+    f[0, 0] = np.nan
+    z = ht.array(f, split=split, comm=comm)
+    got = ht.maximum(z, y).numpy()
+    assert np.isnan(got[0, 0])  # NaN propagates like np.maximum
+
+
+# ------------------------------------------------------------ equal / relational
+@pytest.mark.parametrize("split", SPLITS)
+def test_relational_matrix(split):
+    comm = _comm()
+    a, x = _mk((13, 5), split, comm)
+    b = a.copy()
+    b[0, 0] += 1
+    y = ht.array(b, split=split, comm=comm)
+    np.testing.assert_array_equal((x == y).numpy(), a == b)
+    np.testing.assert_array_equal((x != y).numpy(), a != b)
+    np.testing.assert_array_equal((x <= y).numpy(), a <= b)
+    np.testing.assert_array_equal((x >= y).numpy(), a >= b)
+    assert bool(ht.equal(x, x)) is True
+    assert bool(ht.equal(x, y)) is False
+    assert bool(ht.equal(x, ht.ones((2, 2), comm=comm))) is False
