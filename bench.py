@@ -33,15 +33,10 @@ def bench_tpu(data_np):
     import jax.numpy as jnp
 
     from heat_tpu.cluster.kmeans import _kmeans_step, _kmeans_iterate
-    from heat_tpu.cluster._pallas import fused_step_available, kmeans_step_fused
 
     dev = jax.devices()[0]
     x = jax.device_put(jnp.asarray(data_np), dev)
     centers = x[:K]
-    # the fused kernel streams bf16; cast once OUTSIDE the loop (an in-loop cast
-    # re-reads the f32 array every iteration) so each candidate is measured on
-    # the input layout it is designed for
-    x_bf16 = x.astype(jnp.bfloat16)
 
     def time_once(xx, step, iters):
         # the whole fixed-count Lloyd loop runs on-device as one XLA program
@@ -75,19 +70,14 @@ def bench_tpu(data_np):
             return long / t_long
         return (long - short) / dt
 
-    candidates = {"xla": (x, _kmeans_step)}
-    if fused_step_available(N, F, K):
-        candidates["pallas_fused"] = (x_bf16, kmeans_step_fused)
-    # race every candidate at full calibrated steady state: raw (or lightly
-    # differenced) short-loop timings are dominated by the fixed per-dispatch cost
-    # (~100ms on tunneled runtimes) and rank by noise. The short calibration run
-    # only sizes the differencing legs.
-    rates = {}
-    for name, (xx, step) in candidates.items():
-        calib = ITERS / time_once(xx, step, ITERS)
-        rates[name] = steady_rate(xx, step, calib)
-    best = max(rates, key=rates.get)
-    return rates[best], f"{dev} [{best}]"
+    # The two-GEMM XLA step is the sole candidate: measured at up to 104% of nominal MXU MFU
+    # on large GEMMs (benchmarks/matmul_mfu_bench.py, 86-104% across runs), XLA leaves a hand-written
+    # kernel nothing to win on this workload — a fused pallas Lloyd step raced
+    # here through round 1 and lost ~3-6x at every shape (see
+    # doc/performance.md, "Where pallas pays off").
+    calib = ITERS / time_once(x, _kmeans_step, ITERS)
+    rate = steady_rate(x, _kmeans_step, calib)
+    return rate, f"{dev} [xla]"
 
 
 def bench_torch_cpu(data_np, iters=3):
@@ -136,7 +126,7 @@ def bench_allreduce():
     mesh = Mesh(np.asarray(devs), ("d",))
     best = 0.0
     for mb in (8, 64, 256):
-        best = max(best, bench_size(mesh, mb * 1024 * 1024, trials=3))
+        best = max(best, bench_size(mesh, mb * 1024 * 1024, trials=4))
     plat = devs[0].platform
     if plat == "tpu":
         roofline = 819.0 if len(devs) == 1 else 186.0 * len(devs) / 2

@@ -1,0 +1,110 @@
+"""
+Matmul MFU microbenchmark: measured TFLOP/s of the framework's GEMM path
+against the chip's MXU peak (model-flop-utilization — the missing perf
+datapoint called out in the round-1 review).
+
+Times a dependency chain of square matmuls inside one compiled program (the
+fixed dispatch cost of tunneled runtimes amortizes over the chain, and the
+data dependency keeps XLA from eliminating any step), at both precisions the
+framework exposes:
+
+* ``bf16``: the MXU-native input type (TPU v5e peak ≈ 197 TFLOP/s);
+* ``f32`` via ``Precision.HIGHEST``: what ``ht.matmul`` pins for linalg
+  (the 6-pass bf16 algorithm; peak ≈ 1/6 of bf16 on v5e).
+
+Run: python benchmarks/matmul_mfu_bench.py [--n 4096] [--chain 16]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import sync as _sync
+
+PEAKS_TFLOPS = {
+    # chip kind -> (bf16 peak, f32-HIGHEST peak) in TFLOP/s; HIGHEST runs the
+    # 6-pass bf16 algorithm on the MXU, so its ceiling is bf16/6
+    "TPU v5 lite": (197.0, 197.0 / 6),
+    "TPU v5": (459.0, 459.0 / 6),
+    "TPU v4": (275.0, 275.0 / 6),
+}
+
+
+def _peak(device, precision):
+    kind = getattr(device, "device_kind", str(device))
+    for key, (bf16, f32) in PEAKS_TFLOPS.items():
+        if key in str(kind):
+            return bf16 if precision == "bf16" else f32
+    return None
+
+
+def bench(n, chain, precision, trials=3):
+    dtype = jnp.bfloat16 if precision == "bf16" else jnp.float32
+    prec = jax.lax.Precision.DEFAULT if precision == "bf16" else jax.lax.Precision.HIGHEST
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.standard_normal((n, n)).astype(np.float32) / np.sqrt(n), dtype=dtype)
+    b = jnp.asarray(rng.standard_normal((n, n)).astype(np.float32) / np.sqrt(n), dtype=dtype)
+
+    def make_prog(k):
+        def prog(x, y):
+            for _ in range(k):
+                x = jnp.matmul(x, y, precision=prec)
+            return x
+
+        return jax.jit(prog)
+
+    def timed(fn):
+        _sync(fn(a, b))
+        times = []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            _sync(fn(a, b))
+            times.append(time.perf_counter() - t0)
+        times.sort()
+        # jitter = gap between the two best trials (max-min overstates: the
+        # first trial routinely pays cache/tunnel warmth)
+        return times[0], (times[1] - times[0]) if len(times) > 1 else 0.0
+
+    t_long, jitter_long = timed(make_prog(chain))
+    short = max(1, chain // 8)
+    t_short, jitter_short = timed(make_prog(short))
+    dt = t_long - t_short
+    jitter = max(jitter_long, jitter_short)
+    # fall back to the whole-chain rate only when dt drowns in measured jitter
+    per_op = t_long / chain if (dt <= 0 or dt < 3.0 * jitter) else dt / (chain - short)
+    flops = 2.0 * n * n * n
+    return flops / per_op / 1e12
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--n", type=int, default=8192)
+    parser.add_argument("--chain", type=int, default=64)
+    parser.add_argument("--trials", type=int, default=5)
+    args = parser.parse_args()
+
+    dev = jax.devices()[0]
+    out = {"metric": "matmul_tflops", "n": args.n, "device": str(dev)}
+    for precision in ("bf16", "f32"):
+        tflops = bench(args.n, args.chain, precision, args.trials)
+        peak = _peak(dev, precision)
+        out[precision] = {
+            "tflops": round(tflops, 2),
+            "peak_tflops": peak,
+            "mfu_pct": round(100.0 * tflops / peak, 1) if peak else None,
+        }
+    out["value"] = out["bf16"]["tflops"]
+    out["unit"] = f"TFLOP/s (bf16 {args.n}^3 GEMM chain)"
+    out["note"] = "peaks are nominal datasheet figures; mfu slightly over 100% means the nominal number is conservative for this chip stepping"
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -145,9 +145,10 @@ class KMeans(_KCluster):
         self._initialize_cluster_centers(x)
         centers = self._cluster_centers.larray
         data = x.larray
-        # the two-GEMM XLA step wins at every measured shape (the fused pallas
-        # kernel in _pallas.py loses ~6x on v5e — see its module docstring), and
-        # on sharded data XLA inserts the psum over the sample axis
+        # the two-GEMM XLA step runs at the MXU roofline (a fused pallas Lloyd
+        # kernel raced it through round 1 and lost 3-6x on v5e — lesson recorded
+        # in doc/performance.md), and on sharded data XLA inserts the psum over
+        # the sample axis
         centers, labels, inertia, n_iter = _kmeans_fit_loop(
             data, centers, _kmeans_step, self.max_iter, float(self.tol)
         )

@@ -184,7 +184,14 @@ def _dist(
         and comm.is_shardable(y_shape, 0)
     )
     if use_ring:
-        data = _ring_dist(comm, x, yarr, metric, margs)
+        if (Y is None or Y is X) and comm.size > 2:
+            # X-only case: every shipped metric is symmetric (d(a,b)=d(b,a)), so
+            # the half-ring computes each off-diagonal tile once and sends its
+            # transpose back — ⌈(p+1)/2⌉ compute rounds instead of p (the
+            # reference's symmetry optimization, distance.py:279-346)
+            data = _build_ring_symmetric(metric, margs, comm.mesh, comm.axis_name, comm.size)(x)
+        else:
+            data = _ring_dist(comm, x, yarr, metric, margs)
     else:
         # jit so the broadcast-diff → square → reduce chain fuses into one XLA
         # computation (eager per-primitive dispatch would materialize the 3-D
@@ -231,5 +238,41 @@ def _build_ring(metric: Callable, margs: tuple, mesh, axis: str, p: int) -> Call
             in_specs=(P(axis, None), P(axis, None)),
             out_specs=P(axis, None),
             check_vma=False,
+        )
+    )
+
+
+@functools.lru_cache(maxsize=256)
+def _build_ring_symmetric(metric: Callable, margs: tuple, mesh, axis: str, p: int) -> Callable:
+    """
+    Half-ring for the symmetric cdist(X) case: round r computes the tile for
+    column block i+r and ships its TRANSPOSE back to shard i+r (which owns row
+    i+r, column i) — ⌊p/2⌋+1 metric evaluations per shard instead of p
+    (reference distance.py:279-346 sends computed tiles back the same way). For
+    even p the antipodal round is computed by both partners (equal values, no
+    conflict). Rounds are unrolled: each send-back needs its own static
+    permutation.
+    """
+    fwd = [(i, (i - 1) % p) for i in range(p)]  # after r steps, i holds block i+r
+
+    def ring(x_block):
+        i0 = jax.lax.axis_index(axis)
+        bm = x_block.shape[0]
+        diag = metric(x_block, x_block, *margs)
+        out = jnp.zeros((p,) + diag.shape, dtype=diag.dtype)
+        out = out.at[i0].set(diag)
+        y_cur = x_block
+        for r in range(1, p // 2 + 1):
+            y_cur = jax.lax.ppermute(y_cur, axis, fwd)
+            tile = metric(x_block, y_cur, *margs)  # tile (i, i+r)
+            out = out.at[(i0 + r) % p].set(tile)
+            send_back = [(i, (i + r) % p) for i in range(p)]
+            recv = jax.lax.ppermute(tile.swapaxes(0, 1), axis, send_back)  # tile (i, i-r)
+            out = out.at[(i0 - r) % p].set(recv)
+        return jnp.concatenate(jnp.split(out.reshape(p * bm, -1), p, axis=0), axis=1)
+
+    return jax.jit(
+        jax.shard_map(
+            ring, mesh=mesh, in_specs=P(axis, None), out_specs=P(axis, None), check_vma=False
         )
     )

@@ -80,3 +80,22 @@ def test_sdpa_impl_flag():
     auto = scaled_dot_product_attention(q, k, v, causal=True)
     dense = scaled_dot_product_attention(q, k, v, causal=True, impl="dense")
     np.testing.assert_allclose(np.asarray(auto), np.asarray(dense), atol=1e-6)
+
+
+def test_flash_matches_dense_on_tpu():
+    """On a real TPU the pallas flash path must agree with the dense formulation
+    (and it is the only path that compiles at very long sequence lengths — the
+    capability win recorded in doc/performance.md)."""
+    import jax as _jax
+
+    if _jax.default_backend() != "tpu" or _jax.device_count() != 1:
+        pytest.skip("needs a single real TPU device")
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((1, 256, 4, 128)).astype(np.float32), jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((1, 256, 4, 128)).astype(np.float32), jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((1, 256, 4, 128)).astype(np.float32), jnp.bfloat16)
+    dense = scaled_dot_product_attention(q, k, v, causal=True, impl="dense")
+    flash = scaled_dot_product_attention(q, k, v, causal=True, impl="flash")
+    np.testing.assert_allclose(
+        np.asarray(dense, np.float32), np.asarray(flash, np.float32), atol=2e-2
+    )

@@ -58,3 +58,38 @@ def test_cdist_input_validation():
         ht.spatial.cdist(ht.ones((2, 2, 2)))
     with pytest.raises(TypeError):
         ht.spatial.cdist(np.ones((4, 4)))
+
+
+@pytest.mark.parametrize("p", [3, 5, 8])
+@pytest.mark.parametrize("metric", ["cdist", "rbf", "manhattan"])
+def test_symmetric_half_ring_matches_full(p, metric):
+    """cdist(X) takes the half-ring (transpose send-back) path for p>2; results
+    must match the two-operand full ring and scipy-style ground truth."""
+    import jax as _jax
+    from heat_tpu.core.communication import MeshCommunication
+
+    devs = _jax.devices()
+    if len(devs) < p:
+        pytest.skip("needs more devices")
+    comm = MeshCommunication(devices=devs[:p])
+    rng = np.random.default_rng(p)
+    a = rng.standard_normal((p * 6, 4)).astype(np.float32)
+    x = ht.array(a, split=0, comm=comm)
+    if metric == "cdist":
+        got = ht.spatial.cdist(x)
+        want = np.sqrt(((a[:, None] - a[None]) ** 2).sum(-1))
+        tol = 1e-4
+    elif metric == "rbf":
+        got = ht.spatial.rbf(x, sigma=0.7)
+        want = np.exp(-((a[:, None] - a[None]) ** 2).sum(-1) / (2 * 0.7**2))
+        tol = 1e-5
+    else:
+        got = ht.spatial.manhattan(x)
+        want = np.abs(a[:, None] - a[None]).sum(-1)
+        tol = 1e-4
+    np.testing.assert_allclose(got.numpy(), want, atol=tol, rtol=tol)
+    assert got.split == 0
+    # and the explicit two-operand form agrees
+    full = ht.spatial.cdist(x, ht.array(a, split=0, comm=comm)) if metric == "cdist" else None
+    if full is not None:
+        np.testing.assert_allclose(full.numpy(), want, atol=tol, rtol=tol)
