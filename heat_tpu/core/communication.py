@@ -144,7 +144,10 @@ class MeshCommunication(Communication):
 
         Sizes differ by at most one; the remainder is spread over the lowest ranks,
         identical to the reference layout (heat/core/communication.py:161-210) so
-        chunk-dependent user code ports unchanged.
+        chunk-dependent user code ports unchanged. This is the reference-parity
+        *logical* decomposition; the padded physical placement puts ``ceil(n/p)``
+        rows on every device instead — :meth:`lshape_map` / :meth:`counts_displs`
+        report that physical geometry.
 
         Parameters
         ----------
@@ -184,19 +187,36 @@ class MeshCommunication(Communication):
         """
         Per-device counts and displacements along the split axis — the layout the
         reference feeds its vector collectives (heat/core/communication.py:211-240).
+        Derived from the *padded physical* placement (``ceil(n/p)`` rows per device,
+        clamped at the global extent) so it agrees with
+        ``parray.addressable_shards``; the reference's remainder-spread logical
+        decomposition remains available via :meth:`chunk`.
         """
-        counts, displs = [], []
-        for r in range(self.size):
-            offset, lshape, _ = self.chunk(shape, split, rank=r)
-            counts.append(lshape[split])
-            displs.append(offset)
-        return tuple(counts), tuple(displs)
+        shape = tuple(int(s) for s in shape)
+        split = int(split) % len(shape) if len(shape) else 0
+        n = shape[split]
+        c = -(-n // self.size)  # ceil
+        counts = tuple(max(0, min(c, n - r * c)) for r in range(self.size))
+        displs = tuple(min(r * c, n) for r in range(self.size))
+        return counts, displs
 
     def lshape_map(self, shape: Sequence[int], split: Optional[int]) -> np.ndarray:
-        """``(size, ndim)`` array of every device's local shape under :meth:`chunk`."""
-        return np.array(
-            [self.chunk(shape, split, rank=r)[1] for r in range(self.size)], dtype=np.int64
-        )
+        """
+        ``(size, ndim)`` array of every device's shape of *owned logical data* under
+        the padded physical layout: device ``r`` holds logical rows
+        ``[r*ceil(n/p), min((r+1)*ceil(n/p), n))`` of the split axis (tail devices
+        may own zero rows — their physical shard is pure pad). Consistent with
+        ``parray.addressable_shards`` extents minus the zero pad; the reference
+        gathers the equivalent map with an Allreduce (dndarray.py:573-605).
+        """
+        shape = tuple(int(s) for s in shape)
+        if split is None:
+            return np.array([shape] * self.size, dtype=np.int64)
+        split = int(split) % len(shape) if len(shape) else 0
+        counts, _ = self.counts_displs(shape, split)
+        out = np.tile(np.array(shape, dtype=np.int64), (self.size, 1))
+        out[:, split] = counts
+        return out
 
     # ------------------------------------------------------------------ placement
     def spec(self, ndim: int, split: Optional[int]) -> PartitionSpec:

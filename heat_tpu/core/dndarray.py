@@ -332,9 +332,12 @@ class DNDarray:
     @property
     def lshape_map(self) -> np.ndarray:
         """
-        ``(n_devices, ndim)`` array of every device's chunk shape under the split.
-        Computed analytically from the balanced chunk layout (the reference gathers it
-        with an Allreduce, dndarray.py:573-605 — no communication is needed here).
+        ``(n_devices, ndim)`` array of every device's owned-logical-data shape under
+        the split, derived from the padded physical layout (``ceil(n/p)`` rows per
+        device, clamped — consistent with ``larray``'s ``addressable_shards``; tail
+        devices of a ragged axis may own 0 rows). The reference gathers the
+        equivalent map with an Allreduce (dndarray.py:573-605 — no communication is
+        needed here); its remainder-spread decomposition is ``comm.chunk``.
         """
         if self.__lshape_map is None:
             comm = self.__comm
@@ -639,12 +642,17 @@ class DNDarray:
 
     # ------------------------------------------------------------------ indexing
     def __process_key(self, key):
-        """Convert DNDarray/list/numpy keys to jax arrays."""
+        """
+        Convert DNDarray keys to jax arrays and list keys to numpy. Host keys
+        (lists / numpy arrays) deliberately STAY on the host — they are valid
+        jnp index operands, and keeping them lets bounds validation run without
+        a device round-trip that would serialize async dispatch.
+        """
         def conv(k):
             if isinstance(k, DNDarray):
                 return k.larray
             if isinstance(k, (list, np.ndarray)) and not isinstance(k, str):
-                return jnp.asarray(k)
+                return np.asarray(k)
             return k
 
         if isinstance(key, tuple):
@@ -750,12 +758,25 @@ class DNDarray:
                 in_ax += k.ndim
                 out_ax += 1
             elif hasattr(k, "ndim"):  # integer array
+                n = gshape[in_ax]
+                if k.size:
+                    # validate against the LOGICAL extent, like the scalar-int path
+                    # and numpy — on a padded split axis jax would otherwise clamp
+                    # (get) or drop (set) out-of-bounds entries silently, and a
+                    # clamped __setitem__ corrupts the last valid element
+                    if isinstance(k, np.ndarray):  # host key: free bounds check
+                        kmin, kmax = int(k.min()), int(k.max())
+                    else:  # device key: one fetch for both bounds
+                        kmin, kmax = (int(v) for v in np.asarray(jnp.stack([k.min(), k.max()])))
+                    if kmin < -n or kmax >= n:
+                        bad = kmax if kmax >= n else kmin
+                        raise IndexError(
+                            f"index {bad} is out of bounds for axis {in_ax} with size {n}"
+                        )
                 if in_ax == split:
                     if self.is_padded:
-                        # negatives wrap and positives clamp at the LOGICAL extent
-                        # (jax's documented clamping), never exposing pad content
-                        n = gshape[split]
-                        k = jnp.clip(jnp.where(k < 0, k + n, k), 0, max(n - 1, 0))
+                        # negatives wrap at the LOGICAL extent, never exposing pad
+                        k = jnp.where(k < 0, k + n, k)
                     if n_advanced == 1 and k.ndim == 1:
                         new_split = out_ax
                 norm.append(k)
@@ -805,7 +826,7 @@ class DNDarray:
         # full-array boolean-mask assignment: .at does not take masks; use where
         jkey = self.__process_key(key)
         if (
-            isinstance(jkey, jnp.ndarray)
+            isinstance(jkey, (jnp.ndarray, np.ndarray))
             and jkey.dtype == np.bool_
             and jkey.shape == self.__gshape
         ):

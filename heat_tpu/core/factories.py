@@ -93,6 +93,20 @@ def __sharded_builder(kind: str, pshape: Tuple[int, ...], jdtype: str, sharding)
             idx = jnp.arange(nelem, dtype=cdt)
             return (start + idx * step).reshape(pshape).astype(dt)
 
+    elif kind == "affine_pinned":
+        # linspace with endpoint=True: start + i*step can miss ``stop`` by float
+        # rounding at i = num-1, diverging from jnp.linspace's replicated path —
+        # pin the last logical sample to stop exactly.
+        cdt = np.float64 if jax.config.jax_enable_x64 else np.float32
+
+        def f(start, step, last, stop_v):
+            idx = jnp.arange(nelem, dtype=cdt)
+            # the pin compares an INTEGER iota: a float32 iota rounds above 2^24
+            # and would pin interior elements to stop as well
+            ii = jnp.arange(nelem, dtype=np.int64 if jax.config.jax_enable_x64 else np.int32)
+            vals = jnp.where(ii == last, stop_v, start + idx * step)
+            return vals.reshape(pshape).astype(dt)
+
     elif kind == "eye":
 
         def f():
@@ -434,10 +448,14 @@ def linspace(
         else:
             dt = types.float64 if jax.config.jax_enable_x64 else types.float32
         pshape = (comm_r.padded_dim(num),)
+        kind = "affine_pinned" if endpoint and num > 1 else "affine"
         build = __sharded_builder(
-            "affine", pshape, np.dtype(dt.jnp_type()).str, comm_r.sharding(1, 0)
+            kind, pshape, np.dtype(dt.jnp_type()).str, comm_r.sharding(1, 0)
         )
-        data = build(float(start), float(step) if num > 1 else 0.0)
+        if kind == "affine_pinned":
+            data = build(float(start), float(step), num - 1, float(stop))
+        else:
+            data = build(float(start), float(step) if num > 1 else 0.0)
         ht = DNDarray(data, (num,), dt, 0, devices.sanitize_device(device), comm_r, True)
     else:
         data = jnp.linspace(start, stop, num, endpoint=endpoint,

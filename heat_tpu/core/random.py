@@ -117,35 +117,65 @@ def __generator(kind: str, gshape: Tuple[int, ...], jdtype: str, sharding):
 
     def bits_fn(key):
         # per-element block cipher: counter = (hi, lo) logical pair, so draw i is a
-        # pure function of (key, i) — bit-identical at any device count/padding
+        # pure function of (key, i) — bit-identical at any device count/padding.
+        # Both cipher output words are returned: one 2x32 invocation yields 64
+        # random bits per element, enough for a full f64 mantissa or an
+        # effectively unbiased bounded integer.
         if gshape:
             hi, lo = logical_pair()
         else:
             hi = lo = jnp.zeros((), dtype=jnp.uint32)
         k1 = jnp.broadcast_to(key[0], lo.shape)
         k2 = jnp.broadcast_to(key[1], lo.shape)
-        out = threefry2x32_p.bind(k1, k2, hi, lo)
-        return out[0]
+        return threefry2x32_p.bind(k1, k2, hi, lo)
+
+    wide = dt.itemsize == 8 and jax.config.jax_enable_x64
+
+    def uniform_fn(key, offset):
+        # 24-bit mantissa for ≤32-bit floats; 53-bit (27+26 from the two cipher
+        # words) for f64 under x64 — matches the reference's Threefry-2x64
+        # draw quality for 64-bit dtypes (reference random.py:220-267).
+        w0, w1 = bits_fn(key)
+        if wide:
+            m = (w0 >> 5).astype(jnp.float64) * jnp.float64(1 << 26) + (w1 >> 6).astype(
+                jnp.float64
+            )
+            return (m + offset) * jnp.float64(1.0 / (1 << 53))
+        return ((w0 >> 8).astype(jnp.float32) + offset) * jnp.float32(1.0 / (1 << 24))
 
     if kind == "uniform":
 
         def f(key):
-            u = (bits_fn(key) >> 8).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
-            return u.astype(dt)
+            return uniform_fn(key, 0.0).astype(dt)
 
     elif kind == "normal":
         from jax.scipy.special import ndtri
 
         def f(key):
             # strictly inside (0,1) so the inverse CDF stays finite
-            u = ((bits_fn(key) >> 8).astype(jnp.float32) + 0.5) * jnp.float32(1.0 / (1 << 24))
-            return ndtri(u).astype(dt)
+            return ndtri(uniform_fn(key, 0.5)).astype(dt)
 
     elif kind == "randint":
 
         def f(key, low, rng):
-            m = (bits_fn(key) % rng.astype(jnp.uint32)).astype(jnp.int32)
-            return (m + low).astype(dt)
+            # 64 random bits reduced mod rng: residual bias ≤ rng/2^64 for any
+            # 32-bit range (the reference's 2x64 cipher reduced the same way,
+            # random.py:331-420). Under x64 the reduction is a native u64 modulo
+            # (also covering ranges > 2^32); without x64 an overflow-safe
+            # double-word shift-and-subtract modulo in pure uint32 arithmetic.
+            w0, w1 = bits_fn(key)
+            if jax.config.jax_enable_x64:
+                v64 = (w0.astype(jnp.uint64) << jnp.uint64(32)) | w1.astype(jnp.uint64)
+                m = v64 % rng.astype(jnp.uint64)
+                return (m.astype(jnp.int64) + low).astype(dt)
+            rng32 = rng.astype(jnp.uint32)
+            r = w0 % rng32  # (w0·2^32 + w1) mod rng == ((w0 mod rng)·2^32 + w1) mod rng
+            for b in range(32):
+                bit = (w1 >> (31 - b)) & jnp.uint32(1)
+                # r = (2r + bit) mod rng without overflow: r < rng ≤ 2^32-1
+                dbl = jnp.where(r >= rng32 - r, r - (rng32 - r), r + r)
+                r = jnp.where(dbl + bit >= rng32, dbl + bit - rng32, dbl + bit)
+            return (r.astype(jnp.int32) + low).astype(dt)
 
     else:  # pragma: no cover
         raise ValueError(kind)
@@ -253,10 +283,14 @@ def randint(
     if size is None:
         size = ()
     shape = sanitize_shape(size) if size != () else ()
-    return __draw(
-        "randint", shape, dtype, split, device, comm,
-        jnp.int32(int(low)), jnp.uint32(int(high) - int(low)),
-    )
+    rng = int(high) - int(low)
+    if jax.config.jax_enable_x64:
+        low_a, rng_a = jnp.int64(int(low)), jnp.uint64(rng)
+    else:
+        if rng > (1 << 32) - 1:
+            raise ValueError(f"range {rng} needs 64-bit integers; enable jax x64")
+        low_a, rng_a = jnp.int32(int(low)), jnp.uint32(rng)
+    return __draw("randint", shape, dtype, split, device, comm, low_a, rng_a)
 
 
 random_integer = randint

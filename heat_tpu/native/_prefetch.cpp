@@ -41,7 +41,8 @@ struct Prefetcher {
   std::vector<int64_t> slot_bytes;  // -1 = read error
 
   std::atomic<int64_t> next_claim{0};
-  int64_t next_consume = 0;
+  int64_t next_reserve = 0;  // workers reserve ring slots strictly in this order
+  int64_t next_consume = 0;  // consumer tickets, claimed under mu at entry
   bool closed = false;
   int consumers_active = 0;
 
@@ -61,17 +62,18 @@ void worker_loop(Prefetcher* p) {
     const int slot = static_cast<int>(i % p->depth);
     {
       std::unique_lock<std::mutex> lk(p->mu);
-      // empty slot alone is not enough: ordinal i may only take its slot once
-      // consumption has advanced past i - depth, else a later ordinal could
-      // reserve the slot ahead of an earlier one and deadlock the in-order
-      // consumer
+      // slots are reserved strictly in ordinal order: an empty slot alone is
+      // not enough, because ordinals i and i+depth share slot i % depth and a
+      // later ordinal reserving first would leave the earlier one's consumer
+      // waiting forever on a slab that can no longer be produced
       p->cv_free.wait(lk, [&] {
-        return p->closed ||
-               (p->slot_owner[slot] == -1 && i - p->next_consume < p->depth);
+        return p->closed || (i == p->next_reserve && p->slot_owner[slot] == -1);
       });
       if (p->closed) return;
       p->slot_owner[slot] = i;  // reserve while reading
       p->slot_bytes[slot] = -2; // in flight
+      p->next_reserve = i + 1;
+      p->cv_free.notify_all();  // later ordinals' workers re-check their turn
     }
     const int64_t len = p->lengths[i];
     std::vector<char>& buf = p->ring[slot];
@@ -115,47 +117,53 @@ void* ht_prefetch_open(const char* path, const int64_t* offsets,
 }
 
 // Returns: bytes copied (>=0), -1 after the last slab, -2 on read error,
-// -3 if dest_cap is too small (the slab stays consumable), -4 if the
-// prefetcher was closed concurrently. Concurrent consumers are safe: each call
-// claims one ordinal (in order) before its copy runs unlocked.
+// -3 if dest_cap is too small, -4 if the prefetcher was closed concurrently.
+// Concurrent consumers each claim a unique ordinal ticket under the mutex at
+// entry — no two callers ever wait on the same ordinal, so a slow caller can
+// never be spuriously bounced by a fast one — and the multi-MB copy runs
+// unlocked. On -2/-3 the ticket is rolled back so the slab stays consumable;
+// that retry contract is only meaningful for serialized consumers (the Python
+// wrapper holds _consumer_lock). When a concurrent claimant already holds the
+// following ordinal the rollback is impossible — the slab is then DROPPED
+// (slot freed) rather than stranded, since a permanently reserved slot would
+// wedge the worker for ordinal+depth and every later consumer.
 int64_t ht_prefetch_next(void* handle, char* dest, int64_t dest_cap) {
   auto* p = static_cast<Prefetcher*>(handle);
   std::unique_lock<std::mutex> lk(p->mu);
   if (p->closed) return -4;
   if (p->next_consume >= p->nslabs()) return -1;
-  const int64_t ordinal = p->next_consume;
+  const int64_t ordinal = p->next_consume++;  // claim the ticket before waiting
   const int slot = static_cast<int>(ordinal % p->depth);
   // consumers_active handshake: ht_prefetch_close must not free the mutex a
   // consumer sleeps on; it waits for every consumer to observe `closed` and leave
   p->consumers_active++;
   p->cv_filled.wait(lk, [&] {
-    return p->closed || p->next_consume != ordinal ||
+    return p->closed ||
            (p->slot_owner[slot] == ordinal && p->slot_bytes[slot] != -2);
   });
   int64_t result;
-  if (p->closed || p->next_consume != ordinal) {
-    // closed, or another consumer raced past this ordinal while we waited
+  if (p->closed) {
     result = -4;
   } else {
     const int64_t bytes = p->slot_bytes[slot];
-    if (bytes == -1) {
-      result = -2;
-    } else if (bytes > dest_cap) {
-      result = -3;
+    if (bytes == -1 || bytes > dest_cap) {
+      result = (bytes == -1) ? -2 : -3;
+      if (p->next_consume == ordinal + 1) {
+        p->next_consume = ordinal;  // serialized consumer: slab stays consumable
+      } else {
+        p->slot_owner[slot] = -1;  // concurrent claimant raced past: drop, don't wedge
+        p->cv_free.notify_all();
+      }
     } else {
-      // Reserve the slot for this copy BEFORE unlocking: advance next_consume
-      // (so a concurrent consumer claims the NEXT ordinal, never this slot) and
-      // mark the slot consuming (owner sentinel -2, so no worker can refill it).
-      // The multi-MB memcpy then runs unlocked and workers keep posting
-      // completions instead of stalling behind it.
+      // Mark the slot consuming (owner sentinel -2, so no worker can refill
+      // it) and run the memcpy unlocked: workers keep posting completions
+      // instead of stalling behind it.
       p->slot_owner[slot] = -2;
-      p->next_consume = ordinal + 1;
       lk.unlock();
       memcpy(dest, p->ring[slot].data(), bytes);
       lk.lock();
       p->slot_owner[slot] = -1;
       p->cv_free.notify_all();
-      p->cv_filled.notify_all();  // wake consumers waiting on later ordinals
       result = bytes;
     }
   }

@@ -107,7 +107,12 @@ class DASO:
     verbose : bool
         Debug printing.
 
-    Reference parity: heat/optim/dp_optimizer.py:46-833.
+    Reference parity: heat/optim/dp_optimizer.py:46-833. The reference's
+    ``sending_chunk_size`` and ``use_mpi_groups`` knobs are deliberately absent:
+    the first chunks the flattened MPI send buffer (XLA decomposes large psums
+    itself and ICI has no message-size cliff), the second selects MPI
+    communicator groups (the ``(node, local)`` mesh axes *are* the groups here).
+    Passing either raises ``TypeError`` rather than silently doing nothing.
     """
 
     def __init__(
@@ -120,9 +125,7 @@ class DASO:
         scheduler=None,
         stability_level: float = 0.05,
         max_global_skips: int = 8,
-        sending_chunk_size: int = 10_000_000,
         downcast_type=jnp.bfloat16,
-        use_mpi_groups: bool = True,
         skip_reduction_factor: int = 2,
         local_skip_factor: int = 4,
         verbose: bool = False,

@@ -84,3 +84,34 @@ def test_sanitize_use_comm():
 
 def test_mpi_world_alias():
     assert ht.MPI_WORLD is ht.WORLD
+
+
+def test_lshape_map_matches_padded_physical_layout():
+    # ADVICE r2: lshape_map must agree with the padded physical shards
+    # (ceil(n/p) per device, clamped; tail devices may own 0 rows), not the
+    # reference's remainder-spread — code mixing it with addressable_shards
+    # sees consistent extents
+    p = WORLD.size
+    for n in (13, 16, 5, p + 1):
+        c = -(-n // p)
+        expect = [max(0, min(c, n - r * c)) for r in range(p)]
+        m = WORLD.lshape_map((n, 3), 0)
+        assert m[:, 0].tolist() == expect, (n, m[:, 0].tolist(), expect)
+        assert (m[:, 1] == 3).all()
+        counts, displs = WORLD.counts_displs((n, 3), 0)
+        assert list(counts) == expect
+        assert all(displs[r] == min(r * c, n) for r in range(p))
+
+
+def test_lshape_map_consistent_with_shards():
+    import heat_tpu as ht
+
+    a = ht.zeros((13, 3), split=0)
+    m = a.lshape_map
+    assert m[:, 0].sum() == 13
+    if hasattr(a.parray, "addressable_shards") and WORLD.is_distributed():
+        # physical shards are all ceil(13/p) rows; owned logical rows are the
+        # clamped extents lshape_map reports
+        c = -(-13 // WORLD.size)
+        for sh in a.parray.addressable_shards:
+            assert sh.data.shape[0] == c

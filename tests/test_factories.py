@@ -114,3 +114,19 @@ def test_meshgrid():
     assert ht.meshgrid() == []
     with pytest.raises(ValueError):
         ht.meshgrid(x, indexing="ab")
+
+
+def test_linspace_endpoint_pinned_distributed():
+    # ADVICE r2: the distributed affine path could miss `stop` by float
+    # rounding at i = num-1; it must now pin the endpoint exactly, matching
+    # the replicated jnp.linspace path
+    import numpy as np
+
+    for num in (7, 13, 50):
+        x = ht.linspace(0.1, 0.7, num, split=0)
+        assert float(x[-1].numpy()) == np.float32(0.7), (num, float(x[-1].numpy()))
+        y = ht.linspace(0.1, 0.7, num)  # replicated path
+        np.testing.assert_allclose(x.numpy(), y.numpy(), rtol=2e-7, atol=2e-7)
+    # endpoint=False unchanged: stop excluded
+    z = ht.linspace(0.0, 1.0, 8, endpoint=False, split=0)
+    assert float(z[-1].numpy()) < 1.0

@@ -238,3 +238,25 @@ def test_3d_indexing(split):
     if split == 1:
         assert x[:, 3:-3].split == 1
         assert x[0].split == 0
+
+
+def test_advanced_index_out_of_bounds_raises():
+    # ADVICE r2: on a padded split axis, out-of-bounds integer-array keys were
+    # clamped (getitem) or silently corrupted the last element (setitem);
+    # they must raise IndexError like numpy and the scalar-int path
+    a = ht.arange(13, split=0)  # ragged over the mesh -> padded physical layout
+    with pytest.raises(IndexError):
+        a[np.array([0, 13])]
+    with pytest.raises(IndexError):
+        a[np.array([-14])]
+    with pytest.raises(IndexError):
+        a[np.array([5, 40])] = 0.0
+    before = a.numpy().copy()
+    # in-bounds negatives still wrap at the LOGICAL extent
+    assert int(a[np.array([-1])].numpy()[0]) == 12
+    np.testing.assert_array_equal(a.numpy(), before)
+    b = ht.zeros((4, 13), split=1)
+    with pytest.raises(IndexError):
+        b[:, np.array([13])]
+    with pytest.raises(IndexError):
+        b[np.array([4]), :]

@@ -89,3 +89,53 @@ def test_aliases():
     assert ht.random.random_integer is ht.random.randint
     r = ht.random.random((3, 3))
     assert r.shape == (3, 3)
+
+
+def test_randint_non_power_of_two_uniform():
+    # the 64-bit-draw modulo reduction (bias ≤ rng/2^64): a 14-wide range over a
+    # large sample must be near-uniform — the old single-word modulo had visible
+    # structure only for enormous ranges, but this exercises the bit-loop path
+    ht.random.seed(42)
+    a = ht.random.randint(3, 17, (20000,), split=0)
+    arr = a.numpy()
+    assert arr.min() >= 3 and arr.max() < 17
+    counts = np.bincount(arr - 3, minlength=14)
+    expect = 20000 / 14
+    assert counts.min() > expect * 0.85 and counts.max() < expect * 1.15
+
+
+def test_randint_range_exceeding_uint32_requires_x64():
+    if not __import__("jax").config.jax_enable_x64:
+        with pytest.raises(ValueError):
+            ht.random.randint(0, 1 << 40, (4,))
+
+
+def test_rand_f64_53bit_and_randint_64bit_subprocess():
+    # 64-bit draw quality needs x64, which must be configured before backend
+    # init — validate in a subprocess (ADVICE r2: f64 draws were quantized to
+    # 2^-24; randint had modulo bias and truncated ranges > 2^32)
+    import subprocess
+    import sys
+
+    code = """
+import numpy as np
+import heat_tpu as ht
+ht.random.seed(3)
+a = ht.random.rand(100000, dtype=ht.float64, split=0).numpy()
+assert a.dtype == np.float64
+frac = a * (1 << 24)
+assert not np.allclose(frac, np.round(frac)), 'f64 draws quantized to 2^-24'
+b = ht.random.randint(0, 1 << 40, (2000,), dtype=ht.int64).numpy()
+assert b.dtype == np.int64 and b.max() > (1 << 36) and b.min() >= 0
+print('OK')
+"""
+    env = dict(
+        __import__("os").environ,
+        JAX_ENABLE_X64="1",
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True, text=True, timeout=300
+    )
+    assert out.returncode == 0 and "OK" in out.stdout, out.stdout + out.stderr
