@@ -302,17 +302,36 @@ def __cum_op(
 ) -> DNDarray:
     """
     Generic cumulative operation (reference _operations.py:185-281: local cumop +
-    ``Exscan`` + local combine; here the global jnp scan lowers to the same pattern).
+    ``Exscan`` + local combine). Along a distributed split axis the same pipeline
+    runs as one shard_map program (``comm.Cum``): local cumulative, exclusive
+    prefix of the per-block totals, combine — only the block totals cross the
+    mesh, where XLA's native scan-over-a-sharded-axis would all-gather the full
+    operand (HLO-proven in tests/test_hlo_contract.py).
     """
+    from .communication import MeshCommunication
     from .types import canonical_heat_type
 
     sanitation.sanitize_in(x)
     axis = stride_tricks.sanitize_axis(x.shape, axis)
     if axis is None:
         raise NotImplementedError("cumulative operations over flattened arrays: pass axis")
-    # physical compute is safe even along a padded split axis: the pad sits at the
-    # global END, so the cumulative prefix over the valid region never sees it
-    result = partial_op(x.parray, axis=axis)
+    comm = x.comm
+    opname = {jnp.cumsum: "sum", jnp.cumprod: "prod"}.get(partial_op)
+    if (
+        opname is not None
+        and x.split is not None
+        and axis == int(x.split) % max(x.ndim, 1)
+        and isinstance(comm, MeshCommunication)
+        and comm.is_distributed()
+    ):
+        # pad-safe: pad rows sit at the global END of the axis, so every valid
+        # block's offset is built from valid predecessors only; garbage totals
+        # flow exclusively into pad-only blocks
+        result = comm.Cum(x.parray, op=opname, split=axis)
+    else:
+        # physical compute is safe even along a padded split axis: the pad sits at
+        # the global END, so the cumulative prefix over the valid region never sees it
+        result = partial_op(x.parray, axis=axis)
     if dtype is not None:
         result = result.astype(canonical_heat_type(dtype).jnp_type())
     res_dtype = canonical_heat_type(result.dtype)

@@ -455,6 +455,19 @@ class MeshCommunication(Communication):
         x, split = self.__prep(x, split)
         return self.__collective("scan", split, x.ndim, op, exclusive=True)(x)
 
+    def Cum(self, x, op: str = "sum", split: int = 0):
+        """
+        Element-wise cumulative (``'sum'`` or ``'prod'``) ALONG the split axis,
+        keeping the result sharded: chunk-local cumulative + exclusive prefix of
+        the per-chunk totals + combine — the reference's local-cum + ``Exscan`` +
+        final-op pipeline (_operations.py:185-281) as one shard_map program.
+        Only the (…, 1, …) block totals cross the mesh.
+        """
+        if op not in ("sum", "prod"):
+            raise ValueError(f"Cum supports 'sum' or 'prod', got {op!r}")
+        x, split = self.__prep(x, split)
+        return self.__collective("cumop", split, x.ndim, op)(x)
+
     def Alltoall(self, x, split_axis: int, concat_axis: int):
         """
         Re-chunk: every device exchanges slices so the array goes from being split on
@@ -617,6 +630,28 @@ def _build_collective(comm: "MeshCommunication", kind: str, split: int, ndim: in
                 shifted = jax.numpy.concatenate([first[None], c[:-1]], axis=0)
                 return shifted[i]
             return c[i]
+
+        out_spec = spec_split
+    elif kind == "cumop":
+        # distributed cumulative along the split axis: local cum + exclusive
+        # prefix of per-block TOTALS + combine (the reference's local-cum +
+        # Exscan + final-op pipeline, _operations.py:185-281). Only the
+        # (..., 1, ...) block totals cross the mesh — never the operand.
+        cumfn = jax.numpy.cumsum if op == "sum" else jax.numpy.cumprod
+        neutral = 0 if op == "sum" else 1
+
+        def body(b):
+            c = cumfn(b, axis=split)
+            n_loc = b.shape[split]
+            tot = lax.slice_in_dim(c, n_loc - 1, n_loc, axis=split)
+            g = lax.all_gather(tot, ax, axis=split, tiled=True)  # (..., p, ...)
+            first = jax.numpy.full_like(lax.slice_in_dim(g, 0, 1, axis=split), neutral)
+            ex = jax.numpy.concatenate(
+                [first, lax.slice_in_dim(g, 0, p - 1, axis=split)], axis=split
+            )
+            ex = cumfn(ex, axis=split)  # ex[j] = combine of totals of blocks < j
+            off = lax.dynamic_slice_in_dim(ex, lax.axis_index(ax), 1, axis=split)
+            return c + off if op == "sum" else c * off
 
         out_spec = spec_split
     elif kind == "alltoall":

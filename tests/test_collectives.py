@@ -179,3 +179,15 @@ def test_unknown_op_raises_value_error(comm):
         comm.Allreduce(X, op="avg")
     with pytest.raises(ValueError):
         comm.Scan(X, op="Sum")
+
+
+def test_cum_shim(comm):
+    # Cum = element-wise cumulative ALONG the split axis, result stays sharded
+    # (local cum + block-total exscan + combine; reference _operations.py:185-281)
+    got = np.asarray(comm.Cum(X, op="sum", split=0))
+    np.testing.assert_allclose(got, np.cumsum(X, axis=0), rtol=1e-5, atol=1e-5)
+    x1 = np.abs(X[:, : comm.size].T.copy()) * 0.5 + 0.75
+    got = np.asarray(comm.Cum(x1, op="prod", split=1))
+    np.testing.assert_allclose(got, np.cumprod(x1, axis=1), rtol=1e-5, atol=1e-5)
+    with pytest.raises(ValueError):
+        comm.Cum(X, op="max")

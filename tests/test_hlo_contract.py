@@ -291,8 +291,25 @@ def test_bcgs_qr_no_full_gather():
 # fail here — flip it to a no-full-gather assertion then.
 
 
-def test_scoreboard_cumsum_along_split_gathers():
+@pytest.mark.parametrize("n", [M, RAGGED])
+def test_cumsum_along_split_no_full_gather(n):
+    # FLIPPED from the round-2 scoreboard: cumsum along the split axis now runs
+    # as local-cum + block-total exscan + combine (comm.Cum) — the only
+    # all-gather moves the (1, 16)-per-device block totals, never the operand
     comm = _comm()
-    x = ht.ones((M, 16), split=0, comm=comm)
-    t = _hlo(lambda r: ht.cumsum(_wrap(r, (M, 16), 0, comm), axis=0).parray, x.parray)
-    assert "all-gather" in t  # known fall-off: XLA's scan-over-sharded-axis
+    x = ht.ones((n, 16), split=0, comm=comm)
+    t = _hlo(lambda r: ht.cumsum(_wrap(r, (n, 16), 0, comm), axis=0).parray, x.parray)
+    _no_full_gather(t, n)
+    assert "all-gather" in t  # the block-totals exchange
+    y = ht.cumsum(x, axis=0)
+    assert y.split == 0
+    np.testing.assert_allclose(
+        y.numpy()[:, 0], np.arange(1, n + 1, dtype=np.float32), rtol=1e-6
+    )
+
+
+def test_cumprod_along_split_no_full_gather():
+    comm = _comm()
+    x = ht.full((M, 4), 1.0001, split=0, comm=comm)
+    t = _hlo(lambda r: ht.cumprod(_wrap(r, (M, 4), 0, comm), axis=0).parray, x.parray)
+    _no_full_gather(t, M)
