@@ -11,21 +11,65 @@ PyTorch and cannot run here (no mpi4py in this image), so the baseline is the sa
 Lloyd iteration implemented on the reference's compute engine — torch on CPU, single
 process (exactly what `mpirun -np 1 benchmarks/kmeans/heat-cpu.py` measures up to MPI
 constants). vs_baseline = (our iters/sec) / (torch-CPU iters/sec).
+
+Measurement integrity (round-3 rework; VERDICT r2 "recover and lock the north
+star"): the shared tunneled chip's throughput varies run to run (r01 measured
+10,393 iters/s with a torch-CPU baseline of 3.784; r02 8,721 with the baseline
+at 3.505 — both moved together, i.e. machine weather, not a kernel change; see
+doc/kmeans_northstar.md for the component-level profile). Every run therefore
+self-certifies:
+
+* trials are interleaved (short, long) pairs, so slow drift cancels out of the
+  differenced rate instead of biasing one leg;
+* ``jitter_pct`` reports the spread of the per-pair differenced rates — a
+  future reader can tell noise from regression without a second run;
+* ``per_iter_us`` and ``implied_hbm_gbps`` pin the number to physics: the step
+  is HBM-bound (one hoisted-bf16 pass for assignment + one for the update), so
+  implied bandwidth far off the chip's roofline means a bad measurement, not a
+  kernel change.
 """
 
 import json
+import os
 import time
+
+# virtual CPU devices for the scaling line must be configured before jax inits
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
 
 import numpy as np
 
 N, F, K = 1_048_576, 32, 8
 ITERS = 30
+PAIRS = 5  # interleaved (short, long) timing pairs
 
 
-def _data(rng):
+def _data(rng, n=N):
     centers = rng.normal(scale=5.0, size=(K, F)).astype(np.float32)
-    labels = rng.integers(0, K, size=N)
-    return centers[labels] + rng.normal(scale=0.5, size=(N, F)).astype(np.float32)
+    labels = rng.integers(0, K, size=n)
+    return centers[labels] + rng.normal(scale=0.5, size=(n, F)).astype(np.float32)
+
+
+def _differenced_rates(run, calib_rate):
+    """
+    Per-iteration device rate from interleaved (short, long) dispatch pairs.
+
+    Differencing two dispatch lengths cancels the fixed per-dispatch cost
+    (host->device RPC; tens of ms on tunneled runtimes). Interleaving the pairs
+    — rather than all-short-then-all-long — keeps slow machine drift from
+    biasing one leg. Lengths are sized from the calibration rate so the long leg
+    is several hundred ms of device time on any backend.
+    """
+    long = int(np.clip(calib_rate * 8.0, 10, 6000))
+    short = max(1, long // 10)
+    rates = []
+    for pair in range(PAIRS):
+        t_short = run(short, 1e-6 * (2 * pair + 1))
+        t_long = run(long, 1e-6 * (2 * pair + 2))
+        dt = t_long - t_short
+        rates.append((long - short) / dt if dt > 0 else long / t_long)
+    return rates
 
 
 def bench_tpu(data_np):
@@ -38,46 +82,37 @@ def bench_tpu(data_np):
     x = jax.device_put(jnp.asarray(data_np), dev)
     centers = x[:K]
 
-    def time_once(xx, step, iters):
-        # the whole fixed-count Lloyd loop runs on-device as one XLA program
-        # (KMeans.fit's while_loop path, minus the convergence test).
-        # Honest timing on async/remote runtimes: perturb the input so no cached
+    def run(iters, eps):
+        # honest timing on async/remote runtimes: perturb the input so no cached
         # result can be replayed, and read the result back to host — the clock
-        # only stops when real bytes arrive.
-        np.asarray(_kmeans_iterate(xx, centers, step, iters))  # compile + warmup
-        best = float("inf")
-        for trial in range(3):
-            c2 = centers * (1.0 + 1e-6 * (trial + 1))
-            t0 = time.perf_counter()
-            np.asarray(_kmeans_iterate(xx, c2, step, iters))
-            best = min(best, time.perf_counter() - t0)
-        return best
+        # only stops when real bytes arrive
+        c2 = centers * (1.0 + eps)
+        t0 = time.perf_counter()
+        np.asarray(_kmeans_iterate(x, c2, _kmeans_step, iters))
+        return time.perf_counter() - t0
 
-    def steady_rate(xx, step, calib_rate):
-        # Steady-state device throughput: difference two dispatch lengths so the
-        # fixed per-dispatch cost (host->device RPC; tens of ms on tunneled
-        # runtimes) cancels, leaving pure per-iteration device time. Lengths are
-        # sized from the calibration rate so the long leg is several hundred ms of
-        # device time on any backend — big enough that ±15ms dispatch jitter
-        # cannot flip rankings (a CPU fallback at ~10 iters/s measures 80 vs 8
-        # iterations, not a fixed 3000).
-        long = int(np.clip(calib_rate * 8.0, 10, 3000))
-        short = max(1, long // 10)
-        t_short = time_once(xx, step, short)
-        t_long = time_once(xx, step, long)
-        dt = t_long - t_short
-        if dt <= 0:  # clock noise swamped the difference; report the conservative rate
-            return long / t_long
-        return (long - short) / dt
-
-    # The two-GEMM XLA step is the sole candidate: measured at up to 104% of nominal MXU MFU
-    # on large GEMMs (benchmarks/matmul_mfu_bench.py, 86-104% across runs), XLA leaves a hand-written
-    # kernel nothing to win on this workload — a fused pallas Lloyd step raced
-    # here through round 1 and lost ~3-6x at every shape (see
-    # doc/performance.md, "Where pallas pays off").
-    calib = ITERS / time_once(x, _kmeans_step, ITERS)
-    rate = steady_rate(x, _kmeans_step, calib)
-    return rate, f"{dev} [xla]"
+    # The two-GEMM XLA step is the sole candidate: measured at up to 104% of
+    # nominal MXU MFU on large GEMMs (benchmarks/matmul_mfu_bench.py), XLA leaves
+    # a hand-written kernel nothing to win on this workload — a fused pallas
+    # Lloyd step was raced here in round 1 AND re-engineered and re-raced in
+    # round 3 (bf16-streaming, K-on-sublanes layout, zero lane padding, perfect
+    # label agreement) and still lost 3.2x: the skinny K=8 GEMMs collapse MXU
+    # utilization inside a kernel, while XLA's full-height GEMMs pipeline at HBM
+    # roofline (doc/kmeans_northstar.md).
+    np.asarray(_kmeans_iterate(x, centers, _kmeans_step, ITERS))  # compile+warm
+    calib = ITERS / run(ITERS, 1e-7)
+    rates = _differenced_rates(run, calib)
+    best = max(rates)
+    jitter_pct = 100.0 * (max(rates) - min(rates)) / best
+    per_iter_us = 1e6 / best
+    # physics floor: the step cannot move fewer bytes than ONE pass over the
+    # hoisted bf16 copy of x plus the int32 labels write — implied bandwidth at
+    # this minimal model above the chip's HBM roofline means the measurement is
+    # wrong, not that the kernel got faster (819 GB/s nominal on v5e puts the
+    # ceiling at ~11.5k iters/s for this shape)
+    bytes_floor = N * F * 2 + N * 4
+    implied_gbps = bytes_floor * best / 1e9
+    return best, jitter_pct, per_iter_us, implied_gbps, f"{dev} [xla]"
 
 
 def bench_torch_cpu(data_np, iters=3):
@@ -85,7 +120,7 @@ def bench_torch_cpu(data_np, iters=3):
 
     x = torch.from_numpy(data_np)
     c = x[:K].clone()
-    # one warmup
+
     def step(x, c):
         # same quadratic-expansion formulation as the TPU path (fair GEMM-based compare)
         d2 = (x * x).sum(1, keepdim=True) - 2.0 * (x @ c.T) + (c * c).sum(1)[None, :]
@@ -113,7 +148,6 @@ def bench_allreduce():
     picked accordingly: TPU v5e ≈ 819 GB/s HBM, ≈ 186 GB/s accumulated ICI
     (4 links × ~46.5 GB/s) for multi-chip.
     """
-    import os
     import sys
 
     import jax
@@ -124,9 +158,10 @@ def bench_allreduce():
 
     devs = jax.devices()
     mesh = Mesh(np.asarray(devs), ("d",))
-    best = 0.0
-    for mb in (8, 64, 256):
-        best = max(best, bench_size(mesh, mb * 1024 * 1024, trials=4))
+    # 256 MB only: the differenced-chain method needs the long leg's device time
+    # (tens of ms) to dominate dispatch jitter — small buffers make dt fragile
+    # and a max-over-sizes then reports whichever noise inflated most
+    best = bench_size(mesh, 256 * 1024 * 1024, trials=4)
     plat = devs[0].platform
     if plat == "tpu":
         roofline = 819.0 if len(devs) == 1 else 186.0 * len(devs) / 2
@@ -137,10 +172,52 @@ def bench_allreduce():
     return round(best, 2), pct, f"{kind}, {len(devs)} device(s)"
 
 
+def bench_scaling_8dev():
+    """
+    Multichip evidence within the single-chip constraint (VERDICT r2 #10): the
+    SAME Lloyd step over the full dataset, once sharded over the 8-virtual-
+    device CPU mesh (per-iteration psum of the (k,f) partial sums — the
+    collectives are real) and once on a single CPU device. Both runs use the
+    same host silicon (XLA multithreads the single-device program across cores
+    too), so the ratio isolates the *sharding + collective* overhead rather
+    than core contention.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from heat_tpu.cluster.kmeans import _kmeans_step, _kmeans_iterate
+
+    cpus = jax.devices("cpu")
+    if len(cpus) < 8:
+        return None, None
+    n8 = 1 << 18  # bounded host work: the line must cost seconds, not minutes
+    data = _data(np.random.default_rng(1), n=n8)
+    mesh = Mesh(np.asarray(cpus[:8]), ("d",))
+    xs = jax.device_put(jnp.asarray(data), NamedSharding(mesh, P("d", None)))
+    c0 = jax.device_put(jnp.asarray(data[:K]), NamedSharding(mesh, P(None, None)))
+    x1 = jax.device_put(jnp.asarray(data), cpus[0])
+    c1 = jax.device_put(jnp.asarray(data[:K]), cpus[0])
+
+    def rate(x, c, iters=40):
+        np.asarray(_kmeans_iterate(x, c, _kmeans_step, iters))
+        best = float("inf")
+        for t in range(3):
+            t0 = time.perf_counter()
+            np.asarray(_kmeans_iterate(x, c * (1.0 + 1e-6 * (t + 1)), _kmeans_step, iters))
+            best = min(best, time.perf_counter() - t0)
+        return iters / best
+
+    r8 = rate(xs, c0)  # 8-device sharded, full N
+    r1 = rate(x1, c1)  # 1 device, full N
+    overhead_pct = 100.0 * (r1 / r8 - 1.0)
+    return round(r8, 1), round(overhead_pct, 1)
+
+
 def main():
     rng = np.random.default_rng(0)
     data = _data(rng)
-    tpu_ips, device = bench_tpu(data)
+    tpu_ips, jitter_pct, per_iter_us, implied_gbps, device = bench_tpu(data)
     try:
         torch_ips = bench_torch_cpu(data)
         vs = tpu_ips / torch_ips
@@ -150,6 +227,10 @@ def main():
         ar_gbps, ar_pct, ar_note = bench_allreduce()
     except Exception:
         ar_gbps = ar_pct = ar_note = None
+    try:
+        scale8_ips, scale8_overhead = bench_scaling_8dev()
+    except Exception:
+        scale8_ips = scale8_overhead = None
     print(
         json.dumps(
             {
@@ -158,10 +239,15 @@ def main():
                 "unit": "iters/s (n=1048576, f=32, k=8, fp32)",
                 "vs_baseline": round(vs, 3) if vs is not None else None,
                 "device": device,
+                "jitter_pct": round(jitter_pct, 2),
+                "per_iter_us": round(per_iter_us, 2),
+                "implied_hbm_gbps": round(implied_gbps, 1),
                 "baseline_iters_per_sec_torch_cpu": round(torch_ips, 3) if torch_ips else None,
                 "allreduce_gbps": ar_gbps,
                 "allreduce_roofline_pct": ar_pct,
                 "allreduce_note": ar_note,
+                "dp8_cpu_iters_per_sec": scale8_ips,
+                "dp8_cpu_sharding_overhead_pct": scale8_overhead,
             }
         )
     )

@@ -49,6 +49,12 @@ def bench_size(mesh, n_bytes, trials, chain: int = 64):
     eff_bytes = 2 * (p - 1) / p * (local * p * 4) if p > 1 else local * 4 * 2
 
     def make_prog(k):
+        # Every program takes a fresh ``eps`` perturbation and returns a SCALAR
+        # sum: identical repeated executions can be replayed/elided on the
+        # tunneled runtime (observed as unphysical >1 TB/s rates), and a scalar
+        # fetch forces completion without a bulk result transfer contaminating
+        # the next trial's clock. The extra input-scale and final-sum passes are
+        # identical in both chain lengths, so they cancel in the difference.
         if p > 1:
 
             def body(v):
@@ -56,54 +62,55 @@ def bench_size(mesh, n_bytes, trials, chain: int = 64):
                 # data dependency, so none of the chain folds away
                 return jax.lax.psum(v, "d") * jnp.float32(1.0 / p)
 
-            def local_chain(v):
+            def local_chain(v, eps):
+                v = v * (jnp.float32(1.0) + eps)
                 for _ in range(k):
                     v = body(v)
                 return v
 
-            return jax.jit(
-                lambda x: shard_map(
-                    local_chain, mesh=mesh, in_specs=P("d", None), out_specs=P("d", None)
-                )(x)
+            sm = shard_map(
+                local_chain, mesh=mesh, in_specs=(P("d", None), P()), out_specs=P("d", None)
             )
+            return jax.jit(lambda x, eps: jnp.sum(sm(x, eps)))
 
-        def hbm_chain(x):
+        def hbm_chain(x, eps):
+            y = x * (jnp.float32(1.0) + eps)
             for _ in range(k):
                 # barrier defeats elementwise fusion: each step is a real HBM
                 # read+write, not one fused k-multiply kernel
-                x = jax.lax.optimization_barrier(x * jnp.float32(1.000001))
-            return x
+                y = jax.lax.optimization_barrier(y * jnp.float32(1.000001))
+            return jnp.sum(y)
 
         return jax.jit(hbm_chain)
 
-    def timed(fn):
-        _sync(fn(x))  # compile + warmup
-        times = []
-        for _ in range(trials):
-            t0 = time.perf_counter()
-            _sync(fn(x))
-            times.append(time.perf_counter() - t0)
-        times.sort()
-        # jitter = gap between the two best trials (max-min overstates: the
-        # first trial routinely pays cache/tunnel warmth)
-        return times[0], (times[1] - times[0]) if len(times) > 1 else 0.0
+    def once(fn, eps):
+        t0 = time.perf_counter()
+        _sync(fn(x, jnp.float32(eps)))
+        return time.perf_counter() - t0
 
-    t_long, jitter_long = timed(make_prog(chain))
+    f_long = make_prog(chain)
     if chain < 2:
+        once(f_long, 0.0)  # compile + warmup
+        t_long = min(once(f_long, 1e-7 * (i + 1)) for i in range(trials))
         return eff_bytes / (t_long / chain) / 1e9
-    # difference two chain lengths so the fixed dispatch/fetch cost cancels;
-    # only fall back to the conservative whole-chain rate when the difference
-    # sinks into the MEASURED trial jitter (a dispatch-dominated t_long is
-    # exactly the case differencing exists for, so comparing dt against t_long
-    # would throw away signal)
+    # difference two chain lengths so the fixed dispatch/fetch cost cancels.
+    # The legs are timed as INTERLEAVED (short, long) pairs: timing each leg
+    # separately best-of-N lets machine drift between the legs shrink (or grow)
+    # dt and report unphysical rates — a paired difference drifts together, and
+    # the median pair rejects the outliers
     short_chain = max(1, chain // 8)
-    t_short, jitter_short = timed(make_prog(short_chain))
-    dt = t_long - t_short
-    jitter = max(jitter_long, jitter_short)
-    if dt <= 0 or dt < 3.0 * jitter:
-        per_op = t_long / chain
-    else:
-        per_op = dt / (chain - short_chain)
+    f_short = make_prog(short_chain)
+    once(f_long, 0.0)
+    once(f_short, 0.0)  # compile + warmup both
+    per_ops = []
+    for i in range(max(trials, 3)):
+        t_short = once(f_short, 1e-7 * (2 * i + 1))
+        t_long = once(f_long, 1e-7 * (2 * i + 2))
+        dt = t_long - t_short
+        per_ops.append(
+            dt / (chain - short_chain) if dt > 0 else t_long / chain
+        )
+    per_op = sorted(per_ops)[len(per_ops) // 2]
     return eff_bytes / per_op / 1e9
 
 
