@@ -53,34 +53,81 @@ def bench(n, chain, precision, trials=3):
     b = jnp.asarray(rng.standard_normal((n, n)).astype(np.float32) / np.sqrt(n), dtype=dtype)
 
     def make_prog(k):
-        def prog(x, y):
+        def prog(x, y, eps):
+            # perturbed input + scalar output: identical repeated executions can
+            # be replayed/elided on the tunneled runtime, and a bulk result
+            # fetch would contaminate the next trial's clock
+            x = x * (jnp.asarray(1, dtype) + eps)
             for _ in range(k):
                 x = jnp.matmul(x, y, precision=prec)
-            return x
+            return jnp.sum(x.astype(jnp.float32))
 
         return jax.jit(prog)
 
-    def timed(fn):
-        _sync(fn(a, b))
-        times = []
-        for _ in range(trials):
-            t0 = time.perf_counter()
-            _sync(fn(a, b))
-            times.append(time.perf_counter() - t0)
-        times.sort()
-        # jitter = gap between the two best trials (max-min overstates: the
-        # first trial routinely pays cache/tunnel warmth)
-        return times[0], (times[1] - times[0]) if len(times) > 1 else 0.0
+    def once(fn, eps):
+        t0 = time.perf_counter()
+        _sync(fn(a, b, jnp.asarray(eps, dtype)))
+        return time.perf_counter() - t0
 
-    t_long, jitter_long = timed(make_prog(chain))
-    short = max(1, chain // 8)
-    t_short, jitter_short = timed(make_prog(short))
-    dt = t_long - t_short
-    jitter = max(jitter_long, jitter_short)
-    # fall back to the whole-chain rate only when dt drowns in measured jitter
-    per_op = t_long / chain if (dt <= 0 or dt < 3.0 * jitter) else dt / (chain - short)
+    f_long, f_short = make_prog(chain), make_prog(max(1, chain // 8))
+    once(f_long, 0.0)
+    once(f_short, 0.0)  # compile + warmup
+    per_ops = []
+    for i in range(max(trials, 3)):
+        # interleaved pairs: drift between separately-timed legs would bias dt
+        t_short = once(f_short, 1e-4 * (2 * i + 1))
+        t_long = once(f_long, 1e-4 * (2 * i + 2))
+        dt = t_long - t_short
+        per_ops.append(dt / (chain - max(1, chain // 8)) if dt > 0 else t_long / chain)
+    per_op = sorted(per_ops)[len(per_ops) // 2]
     flops = 2.0 * n * n * n
     return flops / per_op / 1e12
+
+
+def bench_mesh(n=2048, devices=8):
+    """
+    Mesh-sharded matmul evidence (VERDICT r2 #10): a megatron-layout GEMM —
+    A row-sharded over ``x``, B column-sharded over ``y`` on a 2-D mesh — jitted
+    with those shardings; asserts the compiled HLO really contains collectives
+    and reports achieved GFLOP/s (host FLOPs on the virtual CPU mesh; the point
+    is the sharding path, not the silicon).
+    """
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    cpus = jax.devices("cpu")
+    if len(cpus) < devices:
+        return None
+    mesh = Mesh(np.asarray(cpus[:devices]).reshape(2, devices // 2), ("x", "y"))
+    rng = np.random.default_rng(0)
+    a = jax.device_put(
+        jnp.asarray(rng.standard_normal((n, n)).astype(np.float32)),
+        NamedSharding(mesh, P("x", None)),
+    )
+    b = jax.device_put(
+        jnp.asarray(rng.standard_normal((n, n)).astype(np.float32)),
+        NamedSharding(mesh, P(None, "y")),
+    )
+
+    @jax.jit
+    def mm(a, b, eps):
+        return jnp.sum(jnp.matmul(a * (1.0 + eps), b) ** 2)
+
+    hlo = mm.lower(a, b, jnp.float32(0.0)).compile().as_text()
+    has_collective = any(
+        c in hlo for c in ("all-reduce", "all-gather", "all-to-all", "collective-permute")
+    )
+    _sync(mm(a, b, jnp.float32(0.0)))
+    best = float("inf")
+    for i in range(3):
+        t0 = time.perf_counter()
+        _sync(mm(a, b, jnp.float32(1e-6 * (i + 1))))
+        best = min(best, time.perf_counter() - t0)
+    return {
+        "gflops": round(2.0 * n**3 / best / 1e9, 1),
+        "n": n,
+        "mesh": "2x4 cpu",
+        "collectives_in_hlo": has_collective,
+    }
 
 
 def main():
@@ -88,6 +135,7 @@ def main():
     parser.add_argument("--n", type=int, default=8192)
     parser.add_argument("--chain", type=int, default=64)
     parser.add_argument("--trials", type=int, default=5)
+    parser.add_argument("--mesh", action="store_true", help="also run the 2-D-mesh sharded GEMM")
     args = parser.parse_args()
 
     dev = jax.devices()[0]
@@ -103,6 +151,8 @@ def main():
     out["value"] = out["bf16"]["tflops"]
     out["unit"] = f"TFLOP/s (bf16 {args.n}^3 GEMM chain)"
     out["note"] = "peaks are nominal datasheet figures; mfu slightly over 100% means the nominal number is conservative for this chip stepping"
+    if args.mesh:
+        out["mesh_sharded"] = bench_mesh()
     print(json.dumps(out))
 
 

@@ -9,7 +9,7 @@ data-dependent, which fights XLA's static shapes; the TPU-native redesign keeps
 the same structure but computes each element's **exact global rank** so every
 exchange has a static shape:
 
-1. local stable sort of each shard's chunk;
+1. local stable sort of each shard's chunk along the split axis;
 2. a ring of ``ppermute`` steps (p-1 hops) circulates the sorted chunks; each
    shard counts, per element, how many elements of every other chunk precede it
    — ``searchsorted`` with ``side='right'`` for lower shard ids and ``'left'``
@@ -19,23 +19,29 @@ exchange has a static shape:
    ``psum_scatter`` (reduce-scatter over ICI) delivers to each shard exactly its
    c = N/p slot-ordered output rows — no merge pass needed.
 
-Pad sentinels (ragged axes) carry the dtype's extreme value and the largest
-global indices, so they take the final ranks and the result lands back in the
+N-D arrays sort along the split axis by flattening the non-split axes into
+independent columns of the same machinery (the reference's sample-sort handles
+any axis the same way, manipulations.py:2263-2301). 64-bit dtypes ride the same
+path under x64: the float total-order transform has a u64 form and integer keys
+are width-agnostic.
+
+Pad sentinels (ragged axes) carry the key-space maximum and the largest global
+indices, so they take the final ranks and the result lands back in the
 canonical padded physical layout.
 
-Honest cost note: the exchange materialises a transient full-length (N,) scatter
-buffer per device and the reduce-scatter moves O(N) bytes per device — compute
-and the final layout are fully distributed, peak memory is not (3 transient
-N-length buffers). The O(N/p) exchange needs ``ragged_all_to_all`` (each shard's
-destination ranks are ascending, so its sends are p contiguous segments), which
-XLA:TPU implements but XLA:CPU — the test mesh — has no thunk for; swap the
-exchange when deploying sorts at HBM-limit scale.
+Honest cost note: the exchange materialises a transient full-length (N, R)
+scatter buffer per device and the reduce-scatter moves O(N) bytes per device —
+compute and the final layout are fully distributed, peak memory is not (3
+transient N-length buffers). The O(N/p) exchange needs ``ragged_all_to_all``
+(each shard's destination ranks are ascending, so its sends are p contiguous
+segments), which XLA:TPU implements but XLA:CPU — the test mesh — has no thunk
+for; swap the exchange when deploying sorts at HBM-limit scale.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 import jax
@@ -44,45 +50,70 @@ from jax.sharding import PartitionSpec as P
 
 from .communication import MeshCommunication
 
-__all__ = ["distributed_sort_1d", "can_distribute_sort"]
+__all__ = ["distributed_sort", "distributed_sort_1d", "can_distribute_sort"]
 
 
-def can_distribute_sort(a) -> bool:
-    """Whether ``a`` (a DNDarray) takes the distributed 1-D sort path."""
+def can_distribute_sort(a, axis: Optional[int] = 0) -> bool:
+    """
+    Whether sorting ``a`` (a DNDarray) along ``axis`` takes the distributed
+    exact-rank path: the axis must be the split axis of a genuinely distributed
+    array, with at least one row per device, and the dtype must have a total
+    order expressible as integer keys (bool/int of any width; floats at 4 bytes,
+    or 8 under x64).
+    """
     comm = a.comm
     dt = np.dtype(a.dtype.jnp_type())
-    return (
-        a.ndim == 1
-        and a.split is not None
-        and isinstance(comm, MeshCommunication)
-        and comm.is_distributed()
-        and a.pshape[0] >= comm.size
-        and (dt.kind in "biu" or (dt.kind == "f" and dt.itemsize <= 4))
-    )
+    if a.split is None or a.ndim == 0:
+        return False
+    split = int(a.split) % a.ndim
+    if axis is None:
+        if a.ndim != 1:
+            return False
+        axis = 0
+    if int(axis) % a.ndim != split:
+        return False
+    if not (isinstance(comm, MeshCommunication) and comm.is_distributed()):
+        return False
+    if a.pshape[split] < comm.size:
+        return False
+    if dt.kind in "biu":
+        return True
+    if dt.kind == "f":
+        return dt.itemsize <= 4 or bool(jax.config.jax_enable_x64)
+    return False
 
 
 def _float_to_key(v: jax.Array, descending: bool) -> jax.Array:
     """
-    Map floats to uint32 keys whose unsigned order is a TOTAL order matching
+    Map floats to unsigned keys whose unsigned order is a TOTAL order matching
     numpy's sort order: -inf < … < -0 = +0 < … < +inf < NaN (all NaNs
-    canonicalized, so negative-payload NaNs don't sort first), with uint32-max
-    reserved above everything for the pad sentinel. Descending complements the
-    key, which puts NaN first — the order of a flipped ascending sort.
+    canonicalized, so negative-payload NaNs don't sort first), with the unsigned
+    maximum reserved above everything for the pad sentinel. Descending
+    complements the key, which puts NaN first — the order of a flipped
+    ascending sort. f32 uses u32 keys; f64 (under x64) the identical u64 form.
     """
-    f = v.astype(jnp.float32)
-    f = jnp.where(jnp.isnan(f), jnp.float32(np.nan), f)  # canonical +NaN bits
-    u = jax.lax.bitcast_convert_type(f, jnp.uint32)
-    key = jnp.where(u >> 31, ~u, u | jnp.uint32(0x80000000))
-    # canonical +NaN maps to 0xFFC00000 < 0xFFFFFFFE: cap below the sentinel
-    key = jnp.minimum(key, jnp.uint32(0xFFFFFFFE))
+    wide = np.dtype(v.dtype).itemsize == 8
+    ft, ut = (jnp.float64, jnp.uint64) if wide else (jnp.float32, jnp.uint32)
+    bits = 64 if wide else 32
+    f = v.astype(ft)
+    f = jnp.where(jnp.isnan(f), np.asarray(np.nan, ft), f)  # canonical +NaN bits
+    u = jax.lax.bitcast_convert_type(f, ut)
+    sign = jnp.asarray(1 << (bits - 1), ut)
+    key = jnp.where((u >> (bits - 1)).astype(bool), ~u, u | sign)
+    # canonical +NaN maps below the all-ones sentinel: cap just under it
+    key = jnp.minimum(key, jnp.asarray(np.iinfo(np.dtype(ut)).max - 1, ut))
     return ~key if descending else key
 
 
 def _key_to_float(k: jax.Array, dtype, descending: bool) -> jax.Array:
+    wide = np.dtype(k.dtype).itemsize == 8
+    ft, ut = (jnp.float64, jnp.uint64) if wide else (jnp.float32, jnp.uint32)
+    bits = 64 if wide else 32
     if descending:
         k = ~k
-    u = jnp.where(k >> 31, k ^ jnp.uint32(0x80000000), ~k)
-    return jax.lax.bitcast_convert_type(u, jnp.float32).astype(dtype)
+    sign = jnp.asarray(1 << (bits - 1), ut)
+    u = jnp.where((k >> (bits - 1)).astype(bool), k ^ sign, ~k)
+    return jax.lax.bitcast_convert_type(u, ft).astype(dtype)
 
 
 def _sort_key(v: jax.Array, descending: bool) -> jax.Array:
@@ -101,53 +132,64 @@ def _unkey(k: jax.Array, dtype, descending: bool) -> jax.Array:
 
 
 @functools.lru_cache(maxsize=128)
-def _build_sort(mesh, axis: str, p: int, n_phys: int, jdtype: str):
-    """Compile the exact-rank sort for one (mesh, physical length, dtype)."""
+def _build_sort(mesh, axis_name: str, p: int, pshape: Tuple[int, ...], axis: int, jdtype: str):
+    """Compile the exact-rank sort for one (mesh, physical shape, sort axis, dtype)."""
+    n_phys = pshape[axis]
     c = n_phys // p
+    ndim = len(pshape)
+    rest = tuple(s for d, s in enumerate(pshape) if d != axis)
+    R = int(np.prod(rest, dtype=np.int64)) if rest else 1
     perm = [(i, (i + 1) % p) for i in range(p)]
 
+    # column-wise searchsorted over the flattened non-split axes
+    _ss_l = jax.vmap(lambda o, s: jnp.searchsorted(o, s, side="left"), in_axes=1, out_axes=1)
+    _ss_r = jax.vmap(lambda o, s: jnp.searchsorted(o, s, side="right"), in_axes=1, out_axes=1)
+
     def local(v):
-        v = v.reshape(c)
-        order = jnp.argsort(v, stable=True)
-        sv = v[order]
-        me = jax.lax.axis_index(axis)
+        vm = jnp.moveaxis(v, axis, 0).reshape(c, R)
+        order = jnp.argsort(vm, axis=0, stable=True)  # (c, R)
+        sv = jnp.take_along_axis(vm, order, axis=0)
+        me = jax.lax.axis_index(axis_name)
         sidx = (me * c + order).astype(jnp.int32)
 
         def step(carry, _):
-            other_v = jax.lax.ppermute(carry[0], axis, perm)
-            other_id = jax.lax.ppermute(carry[1], axis, perm)
-            lo = jnp.searchsorted(other_v, sv, side="left")
-            hi = jnp.searchsorted(other_v, sv, side="right")
+            other_v = jax.lax.ppermute(carry[0], axis_name, perm)
+            other_id = jax.lax.ppermute(carry[1], axis_name, perm)
+            lo = _ss_l(other_v, sv)
+            hi = _ss_r(other_v, sv)
             # ties: lower shard ids precede me, higher follow — unique ranks
             cnt = jnp.where(other_id < me, hi, lo)
             return (other_v, other_id), cnt
 
         _, cnts = jax.lax.scan(step, (sv, me), None, length=p - 1)
-        rank = jnp.arange(c) + cnts.sum(axis=0)
+        rank = jnp.arange(c)[:, None] + cnts.sum(axis=0)  # (c, R)
 
         # exchange: scatter to rank slots, reduce-scatter my window back
-        buf_v = jnp.zeros((n_phys,), dtype=sv.dtype).at[rank].set(sv)
-        buf_i = jnp.zeros((n_phys,), dtype=jnp.int32).at[rank].set(sidx)
-        out_v = jax.lax.psum_scatter(buf_v, axis, scatter_dimension=0, tiled=True)
-        out_i = jax.lax.psum_scatter(buf_i, axis, scatter_dimension=0, tiled=True)
-        return out_v, out_i
+        cols = jnp.arange(R)[None, :]
+        buf_v = jnp.zeros((n_phys, R), dtype=sv.dtype).at[rank, cols].set(sv)
+        buf_i = jnp.zeros((n_phys, R), dtype=jnp.int32).at[rank, cols].set(sidx)
+        out_v = jax.lax.psum_scatter(buf_v, axis_name, scatter_dimension=0, tiled=True)
+        out_i = jax.lax.psum_scatter(buf_i, axis_name, scatter_dimension=0, tiled=True)
+        back = lambda o: jnp.moveaxis(o.reshape((c,) + rest), 0, axis)
+        return back(out_v), back(out_i)
 
+    spec = P(*([None] * axis + [axis_name]))
     return jax.jit(
-        jax.shard_map(
-            local, mesh=mesh, in_specs=P(axis), out_specs=(P(axis), P(axis)), check_vma=False
-        )
+        jax.shard_map(local, mesh=mesh, in_specs=spec, out_specs=(spec, spec), check_vma=False)
     )
 
 
-def distributed_sort_1d(a, descending: bool = False) -> Tuple[jax.Array, jax.Array]:
+def distributed_sort(a, axis: int = 0, descending: bool = False) -> Tuple[jax.Array, jax.Array]:
     """
-    Sort a 1-D split DNDarray over the mesh; returns ``(values, indices)`` as
-    *physical* (padded, sharded) arrays in the canonical layout — pad sentinels
-    take the final slots (they carry the maximal key AND the largest global
-    indices, so they rank after every valid element, NaN included), valid data
-    the prefix.
+    Sort a split DNDarray along its split axis over the mesh; returns
+    ``(values, indices)`` as *physical* (padded, sharded) arrays in the
+    canonical layout — pad sentinels take the final slots along the sort axis
+    (they carry the maximal key AND the largest global indices, so they rank
+    after every valid element, NaN included), valid data the prefix. Indices are
+    global positions along the sort axis (argsort semantics).
     """
     comm: MeshCommunication = a.comm
+    axis = int(axis) % a.ndim
     dt = np.dtype(a.dtype.jnp_type())
     phys = a.parray
     if dt.kind == "b":
@@ -156,13 +198,102 @@ def distributed_sort_1d(a, descending: bool = False) -> Tuple[jax.Array, jax.Arr
     if a.is_padded:
         # pad sentinel in KEY space: the unsigned/int maximum outranks every
         # valid key (for floats the total-order transform caps valid keys below
-        # uint32-max, so even NaN stays under the sentinel)
+        # the unsigned maximum, so even NaN stays under the sentinel)
         kdt = np.dtype(key.dtype)
         sentinel = np.iinfo(kdt).max if kdt.kind in "iu" else np.inf
-        n = a.shape[0]
-        mask = jnp.arange(key.shape[0]) < n
+        n = a.shape[axis]
+        mask = (jnp.arange(key.shape[axis]) < n).reshape(
+            tuple(-1 if d == axis else 1 for d in range(a.ndim))
+        )
         key = jnp.where(mask, key, jnp.asarray(sentinel, dtype=key.dtype))
-    fn = _build_sort(comm.mesh, comm.axis_name, comm.size, phys.shape[0], np.dtype(key.dtype).str)
+    fn = _build_sort(
+        comm.mesh, comm.axis_name, comm.size, tuple(phys.shape), axis, np.dtype(key.dtype).str
+    )
     out_k, out_i = fn(key)
-    out_v = _unkey(out_k, jnp.float32 if dt.kind == "f" else out_k.dtype, descending)
+    if dt.kind == "f":
+        out_v = _unkey(out_k, dt, descending)
+    else:
+        out_v = _unkey(out_k, out_k.dtype, descending)
+    return out_v.astype(dt), out_i
+
+
+def distributed_sort_1d(a, descending: bool = False) -> Tuple[jax.Array, jax.Array]:
+    """1-D convenience wrapper over :func:`distributed_sort` (round-2 API)."""
+    return distributed_sort(a, axis=0, descending=descending)
+
+
+def can_distribute_topk(a, dim: int, k: int) -> bool:
+    """
+    Whether ``topk`` along ``dim`` takes the distributed path: ``dim`` must be
+    the split axis of a key-able distributed array and ``k`` must fit in one
+    shard's chunk (each shard's local top-k then provably contains its global
+    winners; k > c degenerates to a gather and uses the global formulation).
+    """
+    if not can_distribute_sort(a, dim):
+        return False
+    comm: MeshCommunication = a.comm
+    c = a.pshape[int(dim) % a.ndim] // comm.size
+    return 0 < k <= c
+
+
+@functools.lru_cache(maxsize=128)
+def _build_topk(mesh, axis_name: str, p: int, pshape: Tuple[int, ...], dim: int, k: int, jdtype: str):
+    """Compile local-topk + allgather(p*k candidates) + reselect — the
+    reference's distributed topk (manipulations.py topk: local torch.topk +
+    Allgather + re-select), with only p*k candidates crossing the mesh."""
+    c = pshape[dim] // p
+
+    def local(kv):
+        km = jnp.moveaxis(kv, dim, -1)  # (..., c)
+        lv, lp = jax.lax.top_k(km, k)  # per-shard candidates (keys descending)
+        me = jax.lax.axis_index(axis_name)
+        gi = (me * c + lp).astype(jnp.int32)
+        gv = jax.lax.all_gather(lv, axis_name, axis=km.ndim - 1, tiled=True)  # (..., p*k)
+        gidx = jax.lax.all_gather(gi, axis_name, axis=km.ndim - 1, tiled=True)
+        fv, fp = jax.lax.top_k(gv, k)  # ties pick the lowest gathered index = lowest shard
+        fidx = jnp.take_along_axis(gidx, fp, axis=-1)
+        return jnp.moveaxis(fv, -1, dim), jnp.moveaxis(fidx, -1, dim)
+
+    spec = P(*([None] * dim + [axis_name]))
+    return jax.jit(
+        jax.shard_map(local, mesh=mesh, in_specs=spec, out_specs=(P(), P()), check_vma=False)
+    )
+
+
+def distributed_topk(a, dim: int, k: int, largest: bool = True) -> Tuple[jax.Array, jax.Array]:
+    """
+    The k largest (smallest) elements along the split axis; returns replicated
+    ``(values, global_indices)`` with the ``dim`` extent reduced to ``k``,
+    values sorted the torch way (descending for largest, ascending for
+    smallest). Runs entirely as local-topk + a p*k-candidate allgather.
+    """
+    comm: MeshCommunication = a.comm
+    dim = int(dim) % a.ndim
+    dt = np.dtype(a.dtype.jnp_type())
+    phys = a.parray
+    if dt.kind == "b":
+        phys = phys.astype(jnp.uint8)
+    # keys make top_k dtype-agnostic: largest=True wants ascending keys (top_k
+    # takes the maxima), largest=False complemented keys (minima win)
+    key = _sort_key(phys, not largest)
+    if a.is_padded:
+        # pad sentinel at the key-space MINIMUM: pads always lose; ties between
+        # a valid extreme and a pad resolve to the valid one (lower gathered
+        # index — pads live in the trailing shards' trailing slots)
+        kdt = np.dtype(key.dtype)
+        sentinel = np.iinfo(kdt).min if kdt.kind in "iu" else -np.inf
+        n = a.shape[dim]
+        mask = (jnp.arange(key.shape[dim]) < n).reshape(
+            tuple(-1 if d == dim else 1 for d in range(a.ndim))
+        )
+        key = jnp.where(mask, key, jnp.asarray(sentinel, dtype=key.dtype))
+    fn = _build_topk(
+        comm.mesh, comm.axis_name, comm.size, tuple(phys.shape), dim, int(k),
+        np.dtype(key.dtype).str,
+    )
+    out_k, out_i = fn(key)
+    if dt.kind == "f":
+        out_v = _unkey(out_k, dt, not largest)
+    else:
+        out_v = _unkey(out_k, out_k.dtype, not largest)
     return out_v.astype(dt), out_i

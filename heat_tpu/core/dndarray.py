@@ -759,11 +759,13 @@ class DNDarray:
                 out_ax += 1
             elif hasattr(k, "ndim"):  # integer array
                 n = gshape[in_ax]
-                if k.size:
+                if k.size and not isinstance(k, jax.core.Tracer):
                     # validate against the LOGICAL extent, like the scalar-int path
                     # and numpy — on a padded split axis jax would otherwise clamp
                     # (get) or drop (set) out-of-bounds entries silently, and a
-                    # clamped __setitem__ corrupts the last valid element
+                    # clamped __setitem__ corrupts the last valid element. Traced
+                    # keys (indexing inside jit) cannot be validated eagerly and
+                    # keep jax's documented clamp/drop semantics.
                     if isinstance(k, np.ndarray):  # host key: free bounds check
                         kmin, kmax = int(k.min()), int(k.max())
                     else:  # device key: one fetch for both bounds

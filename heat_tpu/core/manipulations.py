@@ -343,11 +343,12 @@ def shape(a: DNDarray) -> Tuple[int, ...]:
 
 def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
     """
-    Sort along an axis; returns ``(sorted_values, original_indices)``. The
-    1-D-split case runs the exact-rank distributed sort (`_sort.py` — the
-    reference's parallel sample-sort, manipulations.py:2263-3050, re-derived for
-    static shapes: ppermute rank ring + reduce-scatter exchange, no gather);
-    other cases sort along a local axis or fall back to the global formulation.
+    Sort along an axis; returns ``(sorted_values, original_indices)``. Sorting
+    along the split axis (any ndim, 4- and 8-byte dtypes) runs the exact-rank
+    distributed sort (`_sort.py` — the reference's parallel sample-sort,
+    manipulations.py:2263-3050, re-derived for static shapes: ppermute rank
+    ring + reduce-scatter exchange, no gather); other cases sort along a local
+    axis or fall back to the global formulation.
     """
     from . import _sort as _dsort
 
@@ -356,8 +357,8 @@ def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):
     if axis is None:
         axis = a.ndim - 1
     idx_t = types.default_index_type()
-    if axis == 0 and _dsort.can_distribute_sort(a):
-        vals_p, idx_p = _dsort.distributed_sort_1d(a, descending=descending)
+    if _dsort.can_distribute_sort(a, axis):
+        vals_p, idx_p = _dsort.distributed_sort(a, axis, descending=descending)
         v = DNDarray(vals_p, a.shape, a.dtype, a.split, a.device, a.comm, True)
         i = DNDarray(
             idx_p.astype(idx_t.jnp_type()), a.shape, idx_t, a.split, a.device, a.comm, True
@@ -467,11 +468,26 @@ def tile(x: DNDarray, reps) -> DNDarray:
 def topk(a: DNDarray, k: int, dim: int = -1, largest: bool = True, sorted: bool = True, out=None):
     """
     The ``k`` largest (or smallest) elements along a dimension; returns
-    ``(values, indices)`` (reference manipulations.py topk: local top-k + allgather +
-    re-select; here a global lax.top_k).
+    ``(values, indices)``. Along the split axis (k ≤ chunk) this runs the
+    reference's distributed formulation — local top-k + allgather of the p·k
+    candidates + re-select (reference manipulations.py topk) — as one shard_map
+    program; otherwise a global lax.top_k.
     """
+    from . import _sort as _dsort
+
     sanitation.sanitize_in(a)
     dim = stride_tricks.sanitize_axis(a.shape, dim)
+    if _dsort.can_distribute_topk(a, dim, k):
+        vals_p, idx_p = _dsort.distributed_topk(a, dim, k, largest=largest)
+        gshape = tuple(k if d == dim else s for d, s in enumerate(a.shape))
+        v = DNDarray(vals_p, gshape, a.dtype, None, a.device, a.comm, True)
+        idx_t = types.default_index_type()
+        i = DNDarray(idx_p.astype(idx_t.jnp_type()), gshape, idx_t, None, a.device, a.comm, True)
+        if out is not None:
+            out[0].larray = v.larray.astype(out[0].dtype.jnp_type())
+            out[1].larray = i.larray.astype(out[1].dtype.jnp_type())
+            return out
+        return v, i
     moved = jnp.moveaxis(a.larray, dim, -1)
     if largest:
         vals, idx = jax.lax.top_k(moved, k)
@@ -507,7 +523,8 @@ def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis
     if (
         not return_inverse
         and axis is None
-        and _dsort.can_distribute_sort(a)
+        and a.ndim == 1
+        and _dsort.can_distribute_sort(a, 0)
         and not (dt.kind == "f" and bool(jnp.isnan(a.larray).any()))
         # NaN != NaN breaks the local compression (duplicate-mask sentinels sort
         # BELOW NaN); NaN-bearing arrays use the global path, whose NaN handling

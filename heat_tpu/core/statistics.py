@@ -250,9 +250,8 @@ def median(x, axis=None, keepdim: bool = False) -> DNDarray:
     from . import _sort as _dsort
 
     ax = stride_tricks.sanitize_axis(x.shape, axis) if isinstance(x, DNDarray) else axis
-    if ax in (None, 0) and isinstance(x, DNDarray) and _dsort.can_distribute_sort(x):
-        res = percentile(x, 50.0, axis=None, interpolation="linear", keepdim=keepdim)
-        return res
+    if isinstance(x, DNDarray) and isinstance(ax, (int, type(None))) and _dsort.can_distribute_sort(x, ax):
+        return percentile(x, 50.0, axis=ax, interpolation="linear", keepdim=keepdim)
 
     def _med(a, ax):
         return jnp.median(a, axis=ax, keepdims=keepdim)
@@ -282,18 +281,29 @@ def percentile(x, q, axis=None, out=None, interpolation: str = "linear", keepdim
     qv = q.larray if isinstance(q, DNDarray) else jnp.asarray(q, dtype=jnp.float32)
     from . import _sort as _dsort
 
-    if axis in (None, 0) and _dsort.can_distribute_sort(x):
+    if isinstance(axis, (int, type(None))) and _dsort.can_distribute_sort(x, axis):
         # distributed selection (reference statistics.py:867-1074/:1256+): exact-
-        # rank distributed sort, then fetch only the bracketing order statistics
-        sv_p, _ = _dsort.distributed_sort_1d(x)
+        # rank distributed sort along the split axis, then fetch only the
+        # bracketing order statistics (a tiny cross-shard gather), for any ndim
+        ax = 0 if axis is None else int(axis) % x.ndim
+        sv_p, _ = _dsort.distributed_sort(x, ax)
         sv = DNDarray(sv_p, x.shape, x.dtype, x.split, x.device, x.comm, True)
-        n = x.shape[0]
+        n = x.shape[ax]
+        rest = tuple(s for d, s in enumerate(x.shape) if d != ax)
         qf = jnp.asarray(qv, dtype=jnp.float32) / 100.0 * (n - 1)
         lo = jnp.clip(jnp.floor(qf).astype(jnp.int32), 0, n - 1)
         hi = jnp.clip(jnp.ceil(qf).astype(jnp.int32), 0, n - 1)
-        idx = jnp.stack([lo.reshape(-1), hi.reshape(-1)])  # tiny gather
-        picked = sv[idx].larray.astype(jnp.float32)
-        v_lo, v_hi = picked[0].reshape(jnp.shape(qf)), picked[1].reshape(jnp.shape(qf))
+        nq = int(np.prod(jnp.shape(qf), dtype=np.int64)) if jnp.shape(qf) else 1
+        idx = jnp.concatenate([lo.reshape(-1), hi.reshape(-1)])  # (2*nq,) tiny gather
+        key = (slice(None),) * ax + (idx,)
+        # single advanced key on the split axis: the DNDarray getitem keeps the
+        # order and gathers only 2*nq rows
+        picked = sv[key].larray.astype(jnp.float32)
+        pm = jnp.moveaxis(picked, ax, 0).reshape((2, nq) + rest)
+        qshape = tuple(jnp.shape(qf))
+        v_lo, v_hi = pm[0].reshape(qshape + rest), pm[1].reshape(qshape + rest)
+        lo_b = lo.astype(jnp.float32).reshape(qshape + (1,) * len(rest))
+        qf_b = qf.reshape(qshape + (1,) * len(rest))
         if interpolation == "lower":
             res = v_lo
         elif interpolation == "higher":
@@ -304,16 +314,18 @@ def percentile(x, q, axis=None, out=None, interpolation: str = "linear", keepdim
             # half-fraction rounds DOWN — jnp.percentile's convention (numpy
             # rounds half to even); matching jnp keeps split and replicated
             # arrays returning identical results
-            res = jnp.where(qf - lo.astype(jnp.float32) <= 0.5, v_lo, v_hi)
+            res = jnp.where(qf_b - lo_b <= 0.5, v_lo, v_hi)
         else:  # linear
-            frac = qf - jnp.floor(qf)
+            frac = qf_b - jnp.floor(qf_b)
             res = v_lo * (1.0 - frac) + v_hi * frac
         if np.dtype(x.dtype.jnp_type()).kind == "f":
             # numpy/jnp propagate NaN for every q; the selection sorts NaN to the
             # end, so poison explicitly to keep split == replicated results
-            res = jnp.where(jnp.isnan(x.larray).any(), jnp.float32(np.nan), res)
+            nan_mask = jnp.isnan(x.larray).any(axis=ax).reshape((1,) * len(qshape) + rest)
+            res = jnp.where(nan_mask, jnp.float32(np.nan), res)
         if keepdim:
-            res = res.reshape(tuple(jnp.shape(qv)) + (1,) * x.ndim)
+            kshape = tuple(1 if d == ax else s for d, s in enumerate(x.shape))
+            res = res.reshape(qshape + kshape)
     else:
         res = jnp.percentile(x.larray.astype(jnp.float32), qv, axis=axis, method=interpolation, keepdims=keepdim)
     # the split axis survives when it is not the reduced axis; a vector q prepends

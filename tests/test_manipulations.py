@@ -160,3 +160,83 @@ def test_diagonal_batch_split_remap():
     d = ht.diagonal(a, dim1=0, dim2=1)  # batch axis 2 survives, shifts to 0
     assert d.split == 0
     assert d.shape == (8, 2)
+
+
+def test_sort_nd_along_split():
+    # VERDICT r2 #3a: N-D sorts along the split axis take the exact-rank
+    # distributed path (divisible + ragged, both split positions, descending)
+    rng = np.random.default_rng(7)
+    for shape, split in [((16, 5), 0), ((13, 5), 0), ((5, 16), 1), ((5, 13), 1), ((4, 13, 3), 1)]:
+        a_np = rng.normal(size=shape).astype(np.float32)
+        a = ht.array(a_np, split=split)
+        v, i = ht.sort(a, axis=split)
+        np.testing.assert_array_equal(v.numpy(), np.sort(a_np, axis=split))
+        np.testing.assert_array_equal(
+            np.take_along_axis(a_np, i.numpy(), axis=split), np.sort(a_np, axis=split)
+        )
+        assert v.split == split
+        vd, _ = ht.sort(a, axis=split, descending=True)
+        np.testing.assert_array_equal(vd.numpy(), -np.sort(-a_np, axis=split))
+
+
+def test_sort_8byte_dtypes_x64_subprocess():
+    # VERDICT r2 #3b: f64/i64 sorts stay distributed under x64 (u64 key
+    # transform); x64 must be configured before backend init -> subprocess
+    import os
+    import subprocess
+    import sys
+
+    code = """
+import numpy as np
+import heat_tpu as ht
+rng = np.random.default_rng(1)
+a_np = rng.normal(size=(13, 4))
+a = ht.array(a_np, split=0)
+assert a.dtype is ht.float64
+v, i = ht.sort(a, axis=0)
+np.testing.assert_array_equal(v.numpy(), np.sort(a_np, axis=0))
+b_np = rng.integers(-2**40, 2**40, size=16)
+b = ht.array(b_np, split=0)
+v, i = ht.sort(b, axis=0)
+np.testing.assert_array_equal(v.numpy(), np.sort(b_np))
+from heat_tpu.core._sort import can_distribute_sort
+assert can_distribute_sort(a, 0) and can_distribute_sort(b, 0)
+print('OK')
+"""
+    env = dict(
+        os.environ,
+        PYTHONPATH="",
+        JAX_ENABLE_X64="1",
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True, text=True, timeout=300
+    )
+    assert out.returncode == 0 and "OK" in out.stdout, out.stdout + out.stderr
+
+
+def test_topk_distributed_along_split():
+    rng = np.random.default_rng(8)
+    for shape, split in [((24, 5), 0), ((26, 3), 0), ((5, 24), 1)]:
+        a_np = rng.normal(size=shape).astype(np.float32)
+        a = ht.array(a_np, split=split)
+        for k in (1, 2):
+            for largest in (True, False):
+                v, i = ht.topk(a, k, dim=split, largest=largest)
+                sign = -1 if largest else 1
+                e_idx = np.take(
+                    np.argsort(sign * a_np, axis=split, kind="stable"), range(k), axis=split
+                )
+                e_val = np.take_along_axis(a_np, e_idx, axis=split)
+                np.testing.assert_array_equal(v.numpy(), e_val)
+                np.testing.assert_array_equal(
+                    np.take_along_axis(a_np, i.numpy(), axis=split), e_val
+                )
+    # tie-breaking matches torch: lowest global index wins
+    b_np = np.array([5, 1, 5, 3, 5, 2, 5, 0, 5, 4, 5, 9, 5, 7, 5, 8], np.int32)
+    b = ht.array(b_np, split=0)
+    v, i = ht.topk(b, 3, dim=0)
+    assert v.numpy().tolist() == [9, 8, 7]
+    v, i = ht.topk(b, 2, dim=0, largest=False)
+    assert v.numpy().tolist() == [0, 1]

@@ -132,3 +132,43 @@ def test_average_split_remap():
     r.resplit_(r.split)  # must not raise
     r2 = ht.average(ht.ones((4, 6), split=0), axis=0)
     assert r2.split is None
+
+
+def test_percentile_axiswise_distributed():
+    # VERDICT r2 #3c: axis-wise percentile/median on split data ride the
+    # distributed sort + bracketing-order-statistic selection
+    rng = np.random.default_rng(9)
+    a_np = rng.normal(size=(13, 5)).astype(np.float32)
+    a = ht.array(a_np, split=0)
+    for interp in ("linear", "lower", "higher", "midpoint", "nearest"):
+        r = ht.percentile(a, 30.0, axis=0, interpolation=interp)
+        np.testing.assert_allclose(
+            r.numpy(), np.percentile(a_np, 30.0, axis=0, method=interp),
+            rtol=1e-5, atol=1e-6, err_msg=interp,
+        )
+    # vector q, keepdim, median, split=1
+    r = ht.percentile(a, [10.0, 50.0, 90.0], axis=0)
+    e = np.percentile(a_np, [10, 50, 90], axis=0)
+    np.testing.assert_allclose(r.numpy(), e, rtol=1e-5, atol=1e-6)
+    assert r.shape == e.shape
+    r = ht.percentile(a, 50.0, axis=0, keepdim=True)
+    e = np.percentile(a_np, 50.0, axis=0, keepdims=True)
+    np.testing.assert_allclose(r.numpy(), e, rtol=1e-5, atol=1e-6)
+    assert r.shape == e.shape
+    np.testing.assert_allclose(
+        ht.median(a, axis=0).numpy(), np.median(a_np, axis=0), rtol=1e-5, atol=1e-6
+    )
+    b = ht.array(a_np.T.copy(), split=1)
+    r = ht.percentile(b, [25.0, 75.0], axis=1)
+    np.testing.assert_allclose(
+        r.numpy(), np.percentile(a_np.T, [25, 75], axis=1), rtol=1e-5, atol=1e-6
+    )
+    # NaN slices poison only their own column
+    d_np = a_np.copy()
+    d_np[3, 2] = np.nan
+    d = ht.array(d_np, split=0)
+    np.testing.assert_allclose(
+        ht.percentile(d, 50.0, axis=0).numpy(),
+        np.percentile(d_np, 50.0, axis=0),
+        rtol=1e-5, atol=1e-6, equal_nan=True,
+    )
