@@ -712,10 +712,13 @@ class DNDarray:
         new_split = None
         fast = True
         norm = []
+        entries = []  # (kind, covers_split, bdim) per expanded key, for the
+        # multi-advanced-key placement rules below
         for k in expanded:
             if k is None:
                 norm.append(None)
                 out_ax += 1
+                entries.append(("none", False, 0))
             elif isinstance(k, slice):
                 if in_ax == split:
                     start, stop, step = k.indices(gshape[split])
@@ -723,8 +726,10 @@ class DNDarray:
                     # must stay "before the start", not wrap to the last element
                     norm.append(slice(start, None if (step < 0 and stop < 0) else stop, step))
                     new_split = out_ax
+                    entries.append(("slice", True, 0))
                 else:
                     norm.append(k)
+                    entries.append(("slice", False, 0))
                 in_ax += 1
                 out_ax += 1
             elif isinstance(k, (bool, np.bool_)):
@@ -732,6 +737,7 @@ class DNDarray:
                 fast = False
                 norm.append(k)
                 out_ax += 1
+                entries.append(("other", False, 0))
             elif isinstance(k, (int, np.integer)):
                 kk = int(k)
                 if kk < 0:
@@ -741,6 +747,7 @@ class DNDarray:
                         f"index {int(k)} is out of bounds for axis {in_ax} with size {gshape[in_ax]}"
                     )
                 norm.append(kk)
+                entries.append(("int", in_ax == split, 0))
                 in_ax += 1
             elif hasattr(k, "dtype") and k.dtype == np.bool_:
                 covers = range(in_ax, in_ax + k.ndim)
@@ -755,6 +762,7 @@ class DNDarray:
                 # only in the canonical 1-advanced-key case below
                 if n_advanced == 1 and split in covers:
                     new_split = out_ax
+                entries.append(("adv", split in covers, 1))
                 in_ax += k.ndim
                 out_ax += 1
             elif hasattr(k, "ndim"):  # integer array
@@ -782,6 +790,7 @@ class DNDarray:
                     if n_advanced == 1 and k.ndim == 1:
                         new_split = out_ax
                 norm.append(k)
+                entries.append(("adv", in_ax == split, int(k.ndim)))
                 in_ax += 1
                 out_ax += k.ndim if n_advanced == 1 else 1
             else:
@@ -789,10 +798,45 @@ class DNDarray:
                 norm.append(k)
                 in_ax += 1
                 out_ax += 1
+                entries.append(("other", False, 0))
         if n_advanced > 1:
-            # multiple advanced keys: numpy may move result axes to the front —
-            # conservatively replicate instead of tracking the permutation
+            # Multiple advanced keys (reference's fully distributed multi-key
+            # getitem, dndarray.py:656-915): the keys broadcast into ONE block
+            # of B axes, placed at the first advanced key's position when the
+            # advanced keys are contiguous (scalar ints between them do not
+            # separate, numpy rules) and at the FRONT otherwise. The result
+            # stays distributed: along the block's leading axis when the split
+            # axis was consumed by an advanced key, or along the surviving
+            # slice axis when a slice kept it.
             new_split = None
+            if fast:
+                adv = [j for j, e in enumerate(entries) if e[0] == "adv"]
+                between = entries[adv[0] : adv[-1] + 1]
+                contiguous = all(e[0] in ("adv", "int") for e in between)
+                B = max(e[2] for e in entries if e[0] == "adv")
+                split_in_adv = any(e[1] for e in entries if e[0] == "adv")
+                split_slice = next(
+                    (j for j, e in enumerate(entries) if e[0] == "slice" and e[1]), None
+                )
+                if contiguous:
+                    block_start = sum(
+                        1 for e in entries[: adv[0]] if e[0] in ("slice", "none")
+                    )
+                else:
+                    block_start = 0
+                if B >= 1 and split_in_adv:
+                    new_split = block_start
+                elif split_slice is not None:
+                    # output position of the surviving split slice
+                    pos = B  # block axes precede it when moved to front
+                    if contiguous:
+                        pos = B if adv[0] < split_slice else 0
+                    for j, e in enumerate(entries[:split_slice]):
+                        if e[0] in ("slice", "none") and not (
+                            contiguous and adv[0] <= j <= adv[-1]
+                        ):
+                            pos += 1
+                    new_split = pos
         return tuple(norm), new_split, fast
 
     def __getitem__(self, key) -> "DNDarray":
@@ -800,9 +844,11 @@ class DNDarray:
         Global indexing: accepts ints, slices, ellipsis, newaxis, boolean masks,
         integer arrays and DNDarrays (reference's fully distributed ``__getitem__``,
         dndarray.py:656-915). Distribution is preserved whenever the split axis is
-        consumed by a slice (including stepped/negative slices) or by the single
-        advanced key (1-D integer array / boolean mask); the result is re-placed on
-        its inferred split axis.
+        consumed by a slice (including stepped/negative slices), by the single
+        advanced key (1-D integer array / boolean mask), or by one of SEVERAL
+        advanced keys — the result is then distributed along the broadcast
+        block's leading axis (numpy's block-placement rules); in every case the
+        result is re-placed on its inferred split axis.
         """
         norm, new_split, fast = self.__index_plan(key)
         if fast:
