@@ -475,6 +475,8 @@ class MeshCommunication(Communication):
         rotation, communication.py:1199-1475) — one ``lax.all_to_all`` over ICI.
         """
         x = jax.numpy.asarray(x)
+        if x.ndim == 0:
+            raise ValueError("collectives operate on arrays with a split axis, got a scalar")
         split_axis = int(split_axis) % x.ndim
         concat_axis = int(concat_axis) % x.ndim
         if split_axis == concat_axis:
@@ -495,6 +497,8 @@ class MeshCommunication(Communication):
         ``split_axis`` — XLA emits the all-to-all.
         """
         x = jax.numpy.asarray(x)
+        if x.ndim == 0:
+            raise ValueError("collectives operate on arrays with a split axis, got a scalar")
         split_axis = int(split_axis) % x.ndim
         concat_axis = int(concat_axis) % x.ndim
         if split_axis == concat_axis:

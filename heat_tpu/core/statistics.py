@@ -88,6 +88,9 @@ def average(x, axis=None, weights=None, returned: bool = False):
     sanitation.sanitize_in(x)
     w = weights.larray if isinstance(weights, DNDarray) else weights
     axis = stride_tricks.sanitize_axis(x.shape, axis)
+    if w is not None and not bool(jnp.all(jnp.sum(jnp.asarray(w)) != 0)):
+        # numpy raises here; jnp.average silently returns nan/inf
+        raise ZeroDivisionError("Weights sum to zero, can't be normalized")
     avg, wsum = jnp.average(x.larray, axis=axis, weights=w, returned=True)
     split = stride_tricks.reduced_split(x.split, axis)
     res = DNDarray(avg, tuple(avg.shape), types.canonical_heat_type(avg.dtype), split, x.device, x.comm, True)

@@ -3,10 +3,14 @@ Tile decompositions.
 
 Parity with the reference's ``heat/core/tiling.py`` (``SplitTiles`` :14-330,
 ``SquareDiagTiles`` :331-1257). In the reference these drive hand-written
-communication schedules (``resplit_``'s Isend/Irecv mesh, tiled QR); on TPU XLA owns
-physical tiling, so these classes are *metadata* views: they expose the same tile-grid
-geometry (one tile per device per dimension, square tiles on the diagonal) computed
-from the balanced chunk layout, and tile get/set operate on the global array.
+communication schedules (``resplit_``'s Isend/Irecv mesh, tiled QR); on TPU XLA
+owns physical tiling, so these classes are *metadata* views over the padded
+physical layout — but the full reference API surfaces: tile grids, per-process
+tile maps, owner lookup, device-local tile addressing
+(``local_get``/``local_set``/``local_to_global``), cross-tiling
+``match_tiles``, and tile get/set on the global array. User code written
+against the reference's tile API ports; only the implicit ``comm.rank`` of the
+per-rank methods becomes an explicit ``rank`` argument (single controller).
 """
 
 from __future__ import annotations
@@ -34,9 +38,13 @@ class SplitTiles:
         size = comm.size if isinstance(comm, MeshCommunication) else 1
         ends = []
         for dim, g in enumerate(arr.shape):
-            bounds = [comm.chunk(arr.shape, dim, rank=r)[1][dim] for r in range(size)] if isinstance(
-                comm, MeshCommunication
-            ) else [g]
+            # padded physical geometry — consistent with the device shards and
+            # lshape_map (tail tiles of a ragged axis may be empty)
+            bounds = (
+                list(comm.counts_displs(arr.shape, dim)[0])
+                if isinstance(comm, MeshCommunication)
+                else [g]
+            )
             ends.append(np.cumsum(bounds))
         self.__tile_ends_per_dim = ends
         # tile_locations: which device owns each tile along the split axis
@@ -89,61 +97,126 @@ class SplitTiles:
 class SquareDiagTiles:
     """
     Tile grid with square tiles on the diagonal for tiled QR (reference
-    tiling.py:331-1257). Geometry only: per-device tile row/column maps with square
-    diagonal blocks sized by the split-axis chunking.
+    tiling.py:331-1257) — the full reference API (``tile_map``,
+    ``tile_rows_per_process``, ``get_start_stop``, ``local_get``/``local_set``/
+    ``local_to_global``, ``match_tiles``) on the padded physical layout.
+
+    Single-controller notes: where the reference's per-rank methods implicitly
+    use ``comm.rank``, the equivalents here take an explicit ``rank`` (device
+    slot) parameter, defaulting to 0; ``__getitem__`` returns the tile data for
+    its unique owning device (the reference returns ``None`` on other ranks —
+    there is no "other rank" under one controller). Cross-process tile slices
+    raise ``ValueError`` exactly like the reference.
     """
 
-    def __init__(self, arr: DNDarray, tiles_per_proc: int = 1):
-        if arr.ndim != 2:
-            raise ValueError("SquareDiagTiles requires a 2-D DNDarray")
+    def __init__(self, arr: DNDarray, tiles_per_proc: int = 2):
+        if not isinstance(arr, DNDarray):
+            raise TypeError(f"arr must be a DNDarray, is currently a {type(arr)}")
+        if not isinstance(tiles_per_proc, int):
+            raise TypeError(f"tiles_per_proc must be an int, is currently a {type(tiles_per_proc)}")
         if tiles_per_proc < 1:
-            raise ValueError("tiles_per_proc must be >= 1")
+            raise ValueError(f"Tiles per process must be >= 1, currently: {tiles_per_proc}")
+        if arr.ndim != 2:
+            raise ValueError(f"Arr must be 2 dimensional, current shape {arr.shape}")
         self.__arr = arr
         comm = arr.comm
         size = comm.size if isinstance(comm, MeshCommunication) else 1
         split = arr.split if arr.split is not None else 0
-        # split-axis chunk boundaries subdivided tiles_per_proc ways
-        bounds = []
-        for r in range(size):
-            _, lshape, _ = (
-                comm.chunk(arr.shape, split, rank=r)
-                if isinstance(comm, MeshCommunication)
-                else (0, arr.shape, None)
-            )
-            n = lshape[split]
-            base, rem = divmod(n, tiles_per_proc)
-            bounds.extend([base + 1] * rem + [base] * (tiles_per_proc - rem))
-        row_sizes = np.asarray([b for b in bounds if b > 0], dtype=np.int64)
-        # square diagonal: column boundaries mirror row boundaries up to the smaller dim
         m, n = arr.shape
-        col_sizes = []
-        acc = 0
-        for b in row_sizes:
-            if acc + b <= n:
-                col_sizes.append(b)
+        # split-axis chunk boundaries (padded physical layout — consistent with
+        # the device shards) subdivided tiles_per_proc ways; owner per piece
+        if isinstance(comm, MeshCommunication):
+            counts, _ = comm.counts_displs(arr.shape, split)
+        else:
+            counts = [arr.shape[split]]
+        split_sizes, owners = [], []
+        for r, cnt in enumerate(counts):
+            base, rem = divmod(int(cnt), tiles_per_proc)
+            for b in [base + 1] * rem + [base] * (tiles_per_proc - rem):
+                if b > 0:
+                    split_sizes.append(b)
+                    owners.append(r)
+        split_sizes = np.asarray(split_sizes, dtype=np.int64)
+        # square diagonal: the other dimension mirrors the split boundaries up
+        # to its extent, a remainder tile absorbing what is left
+        other = n if split == 0 else m
+        mirror, acc = [], 0
+        for b in split_sizes:
+            if acc + b <= other:
+                mirror.append(b)
                 acc += b
-        if acc < n:
-            col_sizes.append(n - acc)
+        if acc < other:
+            mirror.append(other - acc)
+        mirror = np.asarray(mirror, dtype=np.int64)
+        if split == 0:
+            row_sizes, col_sizes = split_sizes, mirror
+        else:
+            row_sizes, col_sizes = mirror, split_sizes
+        self.__split = split
+        self.__size = size
+        self.__row_sizes = row_sizes
+        self.__col_sizes = col_sizes
         self.__row_indices = np.concatenate([[0], np.cumsum(row_sizes)])[:-1]
         self.__col_indices = np.concatenate([[0], np.cumsum(col_sizes)])[:-1]
-        self.__row_sizes = row_sizes
-        self.__col_sizes = np.asarray(col_sizes, dtype=np.int64)
         self.__tiles_per_proc = tiles_per_proc
+        # per-process tile counts along the split axis; the mirrored axis is
+        # whole on every process
+        per_proc = [0] * size
+        for o in owners:
+            per_proc[o] += 1
+        if split == 0:
+            self.__row_per_proc_list = per_proc
+            self.__col_per_proc_list = [len(col_sizes)] * size
+        else:
+            self.__row_per_proc_list = [len(row_sizes)] * size
+            self.__col_per_proc_list = per_proc
+        self.__owners = owners  # owner of each split-axis tile piece
+        self.__build_tile_map()
 
+    def __build_tile_map(self) -> None:
+        rows, cols = len(self.__row_sizes), len(self.__col_sizes)
+        tm = np.zeros((rows, cols, 3), dtype=np.int64)
+        tm[..., 0] = self.__row_indices[:, None]
+        tm[..., 1] = self.__col_indices[None, :]
+        # owner: by tile row for split=0, by tile column for split=1 (mirrored
+        # tiles beyond the split pieces belong to the last owner)
+        owners = self.__owners
+        own = lambda i: owners[i] if i < len(owners) else (owners[-1] if owners else 0)
+        if self.__split == 0:
+            for i in range(rows):
+                tm[i, :, 2] = own(i)
+        else:
+            for j in range(cols):
+                tm[:, j, 2] = own(j)
+        self.__tile_map = tm
+
+    # ------------------------------------------------------------------ properties
     @property
     def arr(self) -> DNDarray:
         """The tiled array."""
         return self.__arr
 
     @property
-    def row_indices(self) -> np.ndarray:
-        """Start row of each tile row."""
-        return self.__row_indices
+    def lshape_map(self) -> np.ndarray:
+        """``(size, 2)`` per-device local shapes (reference tiling.py:738)."""
+        return self.__arr.lshape_map
 
     @property
-    def col_indices(self) -> np.ndarray:
-        """Start column of each tile column."""
-        return self.__col_indices
+    def last_diagonal_process(self) -> int:
+        """Device owning the last diagonal tile (reference tiling.py:747)."""
+        d = min(len(self.__row_sizes), len(self.__col_sizes)) - 1
+        tm = self.__tile_map
+        return int(tm[d, d, 2])
+
+    @property
+    def row_indices(self):
+        """Start row of each tile row (list, reference tiling.py:754)."""
+        return [int(r) for r in self.__row_indices]
+
+    @property
+    def col_indices(self):
+        """Start column of each tile column (list, reference tiling.py:732)."""
+        return [int(c) for c in self.__col_indices]
 
     @property
     def tile_rows(self) -> int:
@@ -155,20 +228,192 @@ class SquareDiagTiles:
         """Number of tile columns."""
         return len(self.__col_sizes)
 
-    def get_tile(self, row: int, col: int):
-        """The data of tile (row, col) (reference local_get/local_to_global)."""
-        r0 = int(self.__row_indices[row])
-        c0 = int(self.__col_indices[col])
-        r1 = r0 + int(self.__row_sizes[row])
-        c1 = c0 + int(self.__col_sizes[col])
+    @property
+    def tile_rows_per_process(self):
+        """Tile rows owned by each device (reference tiling.py:818)."""
+        return list(self.__row_per_proc_list)
+
+    @property
+    def tile_columns_per_process(self):
+        """Tile columns owned by each device (reference tiling.py:768)."""
+        return list(self.__col_per_proc_list)
+
+    @property
+    def tile_map(self) -> np.ndarray:
+        """``(tile_rows, tile_cols, 3)`` array of ``(row_start, col_start,
+        owner_device)`` per tile (reference tiling.py:775)."""
+        return self.__tile_map.copy()
+
+    # ------------------------------------------------------------------ indexing
+    def __key_bounds(self, key):
+        """Resolve a tile key to global (r0, r1, c0, c1) and the owner set."""
+        if not isinstance(key, (int, tuple, slice)):
+            raise TypeError(f"key must be an int, tuple, or slice, is currently {type(key)}")
+        if isinstance(key, (int, slice)):
+            key = (key, slice(None))
+        key = tuple(key)
+        if len(key) == 1:
+            key = (key[0], slice(None))
+        row_ends = np.concatenate([self.__row_indices[1:], [self.__arr.shape[0]]])
+        col_ends = np.concatenate([self.__col_indices[1:], [self.__arr.shape[1]]])
+
+        def rng(k, starts, ends):
+            if isinstance(k, (int, np.integer)):
+                k = int(k)
+                return int(starts[k]), int(ends[k]), slice(k, k + 1)
+            start = k.start if k.start is not None else 0
+            stop = k.stop if k.stop is not None else len(starts)
+            stop = min(stop, len(starts))
+            return int(starts[start]), int(ends[stop - 1]), slice(start, stop)
+
+        r0, r1, rsel = rng(key[0], self.__row_indices, row_ends)
+        c0, c1, csel = rng(key[1], self.__col_indices, col_ends)
+        owners = np.unique(self.__tile_map[rsel, csel, 2])
+        return r0, r1, c0, c1, owners
+
+    def get_start_stop(self, key):
+        """
+        ``(dim0 start, dim0 stop, dim1 start, dim1 stop)`` of the tile(s) under
+        ``key``, relative to the OWNING device's chunk (reference
+        tiling.py:824-889). The key must resolve to tiles of one device.
+        """
+        r0, r1, c0, c1, owners = self.__key_bounds(key)
+        if len(owners) > 1:
+            raise ValueError(f"Tile/s must be located on one process. currently on: {owners}")
+        comm = self.__arr.comm
+        if isinstance(comm, MeshCommunication):
+            _, displs = comm.counts_displs(self.__arr.shape, self.__split)
+            off = displs[int(owners[0])]
+        else:
+            off = 0
+        if self.__split == 0:
+            return r0 - off, r1 - off, c0, c1
+        return r0, r1, c0 - off, c1 - off
+
+    def __getitem__(self, key):
+        """
+        The data of the tile(s) under ``key`` — a global-view slice of the
+        owning device's region (reference tiling.py:890-938; returns data
+        instead of rank-conditional ``None`` under one controller). Raises on
+        cross-device slices like the reference.
+        """
+        r0, r1, c0, c1, owners = self.__key_bounds(key)
+        if len(owners) > 1:
+            raise ValueError("Slicing across splits is not allowed")
         return self.__arr.larray[r0:r1, c0:c1]
 
-    def set_tile(self, row: int, col: int, value) -> None:
-        """Overwrite tile (row, col)."""
+    def __setitem__(self, key, value) -> None:
+        """Write ``value`` into the tile(s) under ``key`` (reference
+        tiling.py:1212-1257)."""
         if isinstance(value, DNDarray):
             value = value.larray
-        r0 = int(self.__row_indices[row])
-        c0 = int(self.__col_indices[col])
-        r1 = r0 + int(self.__row_sizes[row])
-        c1 = c0 + int(self.__col_sizes[col])
+        r0, r1, c0, c1, owners = self.__key_bounds(key)
+        if len(owners) > 1:
+            raise ValueError("setting across splits is not allowed")
         self.__arr.larray = self.__arr.larray.at[r0:r1, c0:c1].set(value)
+
+    # ------------------------------------------------------------------ local API
+    def local_to_global(self, key, rank: int):
+        """
+        Convert device-local tile indices to global tile indices (reference
+        tiling.py:1022-1083): tile row/column ``k`` *of device* ``rank`` maps to
+        global tile index ``k + tiles-before-rank`` along the split axis.
+        """
+        if isinstance(key, (int, slice)):
+            key = [key, slice(0, None)]
+        else:
+            key = list(key)
+        per = self.__row_per_proc_list if self.__split == 0 else self.__col_per_proc_list
+        prev = sum(per[:rank])
+        loc = per[rank]
+        d = 0 if self.__split == 0 else 1
+        k = key[d]
+        if isinstance(k, (int, np.integer)):
+            key[d] = int(k) + prev
+        elif isinstance(k, slice):
+            start = k.start + prev if k.start is not None else prev
+            stop = k.stop + prev if k.stop is not None else prev + loc
+            stop = stop if stop - start < loc else start + loc
+            key[d] = slice(start, stop)
+        return tuple(key)
+
+    def local_get(self, key, rank: int = 0):
+        """The tile(s) under device-local ``key`` of device ``rank`` (reference
+        tiling.py:939-958)."""
+        return self.__getitem__(self.local_to_global(key, rank))
+
+    def local_set(self, key, value, rank: int = 0) -> None:
+        """Write ``value`` to the tile(s) under device-local ``key`` of device
+        ``rank`` (reference tiling.py:959-1021)."""
+        self.__setitem__(self.local_to_global(key, rank), value)
+
+    # ------------------------------------------------------------------ match
+    def match_tiles(self, tiles_to_match: "SquareDiagTiles") -> None:
+        """
+        Overwrite this tiling's geometry to match another's (reference
+        tiling.py:1084-1211) — intended for a square Q matching A/R's tiling:
+        row and column boundaries both follow the matched split boundaries of
+        the shorter dimension. Under XLA the reference's accompanying
+        ``redistribute_`` collapses into the canonical placement, so only the
+        metadata moves.
+        """
+        if not isinstance(tiles_to_match, SquareDiagTiles):
+            raise TypeError(
+                f"tiles_to_match must be a SquareDiagTiles object, currently: {type(tiles_to_match)}"
+            )
+        base, match = self.__arr, tiles_to_match.__arr
+        msplit = match.split if match.split is not None else 0
+        m, n = base.shape
+        if msplit == 0:
+            src = (
+                tiles_to_match.__row_sizes if match.shape[0] >= match.shape[1]
+                else tiles_to_match.__col_sizes
+            )
+            src_owners = tiles_to_match.__owners
+        else:
+            src = (
+                tiles_to_match.__row_sizes if match.shape[0] <= match.shape[1]
+                else tiles_to_match.__col_sizes
+            )
+            src_owners = tiles_to_match.__owners
+        # a square base (Q) takes the source boundaries on BOTH axes, clipped
+        # to its own extents with a remainder tile
+        def fit(sizes, extent):
+            out, acc = [], 0
+            for b in sizes:
+                if acc + b <= extent:
+                    out.append(int(b))
+                    acc += b
+            if acc < extent:
+                out.append(extent - acc)
+            return np.asarray(out, dtype=np.int64)
+
+        self.__row_sizes = fit(src, m)
+        self.__col_sizes = fit(src, n)
+        self.__row_indices = np.concatenate([[0], np.cumsum(self.__row_sizes)])[:-1]
+        self.__col_indices = np.concatenate([[0], np.cumsum(self.__col_sizes)])[:-1]
+        owners = list(src_owners[: len(self.__row_sizes if self.__split == 0 else self.__col_sizes)])
+        while owners and len(owners) < (
+            len(self.__row_sizes) if self.__split == 0 else len(self.__col_sizes)
+        ):
+            owners.append(owners[-1])
+        self.__owners = owners or [0]
+        per = [0] * self.__size
+        for o in self.__owners:
+            per[o] += 1
+        if self.__split == 0:
+            self.__row_per_proc_list = per
+            self.__col_per_proc_list = [len(self.__col_sizes)] * self.__size
+        else:
+            self.__row_per_proc_list = [len(self.__row_sizes)] * self.__size
+            self.__col_per_proc_list = per
+        self.__build_tile_map()
+
+    # round-2 convenience API (kept)
+    def get_tile(self, row: int, col: int):
+        """The data of tile (row, col) — alias of ``self[row, col]``."""
+        return self[row, col]
+
+    def set_tile(self, row: int, col: int, value) -> None:
+        """Overwrite tile (row, col) — alias of ``self[row, col] = value``."""
+        self[row, col] = value

@@ -17,7 +17,7 @@ import pytest
 WORKER = textwrap.dedent(
     """
     import os, sys
-    pid = int(sys.argv[1]); port = sys.argv[2]
+    pid = int(sys.argv[1]); port = sys.argv[2]; tmp = sys.argv[3]
     os.environ["JAX_PLATFORMS"] = "cpu"
     import heat_tpu as ht
     from heat_tpu.core.communication import distributed_init
@@ -34,6 +34,37 @@ WORKER = textwrap.dedent(
     assert float(m.numpy()[0, 0]) == 8.0             # cross-host gather in numpy()
     ar = comm.Allreduce(np.ones((4, 2), np.float32))
     assert float(np.asarray(ar)[0, 0]) == 4.0        # named collective across hosts
+
+    # VERDICT r2 #9 multi-controller branches:
+    # unique (manipulations.py multi-host compressed-gather branch)
+    u = ht.unique(ht.array(np.tile(np.arange(6, dtype=np.float32), 4), split=0))
+    assert sorted(np.asarray(u.larray).tolist()) == list(range(6)), u.larray
+
+    # ragged distributed sort across hosts
+    s_np = np.asarray([7, 1, 5, 3, 9, 0, 2, 8, 6, 4, 11, 10, 13], np.float32)
+    sv, si = ht.sort(ht.array(s_np, split=0))
+    assert (sv.numpy() == np.sort(s_np)).all()
+
+    if ht.io.supports_hdf5():
+        # split-io save + sharded load round-trip (io.py multi-host slab branch)
+        a = ht.arange(24, split=0, dtype=ht.float32) * 0.5
+        ht.save(a, f"{tmp}/mh.h5", "data")
+        b = ht.load(f"{tmp}/mh.h5", dataset="data", split=0)
+        assert b.shape == (24,)
+        assert abs(float(ht.sum(b).item()) - float(ht.sum(a).item())) < 1e-5
+
+        # checkpoint save/restore across 2 processes
+        from heat_tpu.utils.checkpoint import save_checkpoint, load_checkpoint
+        state = {"w": ht.arange(12, split=0, dtype=ht.float32), "step": 3}
+        save_checkpoint(f"{tmp}/ck_{pid}.h5", state)
+        back = load_checkpoint(
+            f"{tmp}/ck_{pid}.h5",
+            {"w": ht.zeros(12, split=0, dtype=ht.float32), "step": 0},
+            comm=comm,
+        )
+        assert back["step"] == 3
+        assert back["w"].split == 0
+        assert abs(float(ht.sum(back["w"]).item()) - 66.0) < 1e-5
     print(f"worker{pid} ok", flush=True)
     """
 )
@@ -53,7 +84,7 @@ def test_two_process_distributed_init(tmp_path):
     env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     procs = [
         subprocess.Popen(
-            [sys.executable, str(worker), str(pid), str(port)],
+            [sys.executable, str(worker), str(pid), str(port), str(tmp_path)],
             env=env,
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
