@@ -569,6 +569,23 @@ class DNDarray:
             raise ValueError("only one-element DNDarrays can be converted to Python scalars")
         return self.larray.reshape(()).item()
 
+    def fill_diagonal(self, value: float) -> "DNDarray":
+        """
+        Fill the main diagonal of a 2-D array in place; returns self (reference
+        dndarray.py:616-652 — there a per-rank offset loop over the chunk map;
+        here one functional scatter on the physical array, in-bounds positions
+        are identical logical/physical since the pad sits at the global end).
+        """
+        if self.ndim != 2:
+            raise ValueError("Only 2D tensors supported at the moment")
+        k = int(np.minimum(self.shape[0], self.shape[1]))
+        idx = jnp.arange(k)
+        self.__array = self.__array.at[idx, idx].set(
+            jnp.asarray(value, dtype=self.__array.dtype)
+        )
+        self.__invalidate()
+        return self
+
     def numpy(self) -> np.ndarray:
         """The global logical array as a numpy array (parity: dndarray.py:995 — there
         a resplit(None) gather; here a device fetch). In a multi-controller run the

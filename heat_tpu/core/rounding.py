@@ -15,7 +15,7 @@ from . import _operations
 from . import sanitation
 from .dndarray import DNDarray
 
-__all__ = ["abs", "absolute", "ceil", "clip", "fabs", "floor", "modf", "round", "sgn", "sign", "trunc"]
+__all__ = ["abs", "absolute", "ceil", "clip", "fabs", "floor", "modf", "nan_to_num", "round", "sgn", "sign", "trunc"]
 
 
 def abs(x, out=None, dtype=None) -> DNDarray:
@@ -104,6 +104,14 @@ def sign(x, out=None) -> DNDarray:
         res = jnp.sign(jnp.real(x.larray)).astype(x.dtype.jnp_type())
         return DNDarray.__new_like__(x, res)
     return _operations.__local_op(jnp.sign, x, out)
+
+
+def nan_to_num(x, nan: float = 0.0, posinf=None, neginf=None, out=None) -> DNDarray:
+    """Replace NaN/±inf with finite numbers, numpy semantics (beyond the
+    reference snapshot, which lacks this symbol; numpy-API completion)."""
+    return _operations.__local_op(
+        jnp.nan_to_num, x, out, nan=nan, posinf=posinf, neginf=neginf
+    )
 
 
 def trunc(x, out=None) -> DNDarray:

@@ -90,3 +90,46 @@ def test_get_set_params():
     with pytest.raises(ValueError):
         km.set_params(bogus=1)
     assert "KMeans" in repr(km)
+
+
+def test_estimator_contracts():
+    # BaseEstimator API surface across the ML families (reference
+    # core/base.py + per-estimator tests): get/set_params round-trip and
+    # unfitted predict errors
+    rng = np.random.default_rng(41)
+    x = ht.array(rng.normal(size=(32, 4)).astype(np.float32), split=0)
+    ests = [
+        ht.cluster.KMeans(n_clusters=3),
+        ht.cluster.KMedians(n_clusters=3),
+        ht.cluster.KMedoids(n_clusters=3),
+    ]
+    for est in ests:
+        params = est.get_params()
+        assert params["n_clusters"] == 3
+        est.set_params(n_clusters=2)
+        assert est.get_params()["n_clusters"] == 2
+        est.set_params(**params)
+        with pytest.raises((RuntimeError, AttributeError, ValueError)):
+            est.predict(x)  # not fitted
+
+    km = ht.cluster.KMeans(n_clusters=2, max_iter=10).fit(x)
+    labels = km.predict(x)
+    assert set(np.unique(labels.numpy())).issubset({0, 1})
+    assert km.cluster_centers_.shape == (2, 4)
+
+
+def test_kmeans_init_modes_converge():
+    rng = np.random.default_rng(42)
+    centers = np.array([[6.0, 6.0], [-6.0, -6.0], [6.0, -6.0]], np.float32)
+    blobs = np.concatenate(
+        [c + rng.normal(scale=0.4, size=(40, 2)).astype(np.float32) for c in centers]
+    )
+    x = ht.array(blobs, split=0)
+    for init in ("random", "kmeans++", "batchparallel"):
+        km = ht.cluster.KMeans(n_clusters=3, init=init, max_iter=50, random_state=0)
+        km.fit(x)
+        # every true blob center is within 1.0 of a fitted center
+        got = km.cluster_centers_.numpy()
+        for c in centers:
+            assert np.min(np.linalg.norm(got - c, axis=1)) < 1.0, (init, got)
+        assert km.n_iter_ <= 50

@@ -156,3 +156,27 @@ def test_io_failure_paths(tmp_path):
         assert r.shape[0] in (2, 3)
     except (ValueError, IOError, RuntimeError):
         pass  # a clear error is acceptable; silent corruption is not
+
+
+def test_csv_matrix(tmp_path):
+    # separators, headers, dtype inference, 1-D columns (reference
+    # test_io.py CSV coverage on the native threaded reader)
+    p = tmp_path / "m.csv"
+    p.write_text("# c1;c2;c3\n1.5;2;3\n4;5.5;6\n7;8;9.5\n")
+    r = ht.load_csv(str(p), sep=";", header_lines=1)
+    np.testing.assert_allclose(
+        r.numpy(), np.array([[1.5, 2, 3], [4, 5.5, 6], [7, 8, 9.5]], np.float32)
+    )
+    # split load of a taller file
+    rows = "\n".join(",".join(str(i * 3 + j) for j in range(3)) for i in range(17))
+    p2 = tmp_path / "tall.csv"
+    p2.write_text(rows + "\n")
+    r2 = ht.load_csv(str(p2), split=0)
+    assert r2.shape == (17, 3) and r2.split == 0
+    np.testing.assert_allclose(r2.numpy()[:, 0], np.arange(17) * 3)
+    # save round-trip with a ragged split
+    a = ht.arange(13, split=0).astype(ht.float32).reshape((13, 1))
+    out = tmp_path / "rt.csv"
+    ht.save_csv(a, str(out))
+    back = ht.load_csv(str(out))
+    np.testing.assert_allclose(back.numpy().reshape(-1), np.arange(13))

@@ -240,3 +240,64 @@ def test_topk_distributed_along_split():
     assert v.numpy().tolist() == [9, 8, 7]
     v, i = ht.topk(b, 2, dim=0, largest=False)
     assert v.numpy().tolist() == [0, 1]
+
+
+def test_concatenate_edge_matrix():
+    rng = np.random.default_rng(31)
+    p = ht.get_comm().size
+    a_np = rng.normal(size=(2 * p, 3)).astype(np.float32)
+    b_np = rng.normal(size=(p + 1, 3)).astype(np.float32)  # ragged partner
+    for sa, sb in [(0, 0), (0, None), (None, 0), (1, 1)]:
+        got = ht.concatenate(
+            [ht.array(a_np, split=sa), ht.array(b_np, split=sb)], axis=0
+        )
+        np.testing.assert_array_equal(got.numpy(), np.concatenate([a_np, b_np]))
+    # dtype promotion across operands
+    c = ht.concatenate([ht.ones(4, dtype=ht.int32), ht.ones(4, dtype=ht.float32)])
+    assert c.dtype is ht.float32
+    with pytest.raises((ValueError, TypeError)):
+        ht.concatenate([ht.ones((2, 3)), ht.ones((2, 4))], axis=0)
+
+
+def test_pad_modes_on_split_axis():
+    rng = np.random.default_rng(32)
+    a_np = rng.normal(size=(13, 3)).astype(np.float32)
+    a = ht.array(a_np, split=0)
+    for width in [(1, 2), ((1, 2), (0, 0)), 2]:
+        got = ht.pad(a, width)
+        np.testing.assert_array_equal(
+            got.numpy(),
+            np.pad(a_np, width if not isinstance(width, int) else 2),
+        )
+    got = ht.pad(a, ((1, 1), (1, 1)), constant_values=7.0)
+    np.testing.assert_array_equal(
+        got.numpy(), np.pad(a_np, ((1, 1), (1, 1)), constant_values=7.0)
+    )
+
+
+def test_roll_flip_rot90_split_matrix():
+    rng = np.random.default_rng(33)
+    a_np = rng.normal(size=(13, 6)).astype(np.float32)
+    for split in (0, 1):
+        a = ht.array(a_np, split=split)
+        for shift, axis in [(3, 0), (-2, 1), (5, None)]:
+            np.testing.assert_array_equal(
+                ht.roll(a, shift, axis=axis).numpy(), np.roll(a_np, shift, axis=axis)
+            )
+        np.testing.assert_array_equal(ht.flip(a, 0).numpy(), np.flip(a_np, 0))
+        np.testing.assert_array_equal(ht.fliplr(a).numpy(), np.fliplr(a_np))
+        np.testing.assert_array_equal(ht.flipud(a).numpy(), np.flipud(a_np))
+    r = ht.rot90(ht.array(a_np, split=0))
+    np.testing.assert_array_equal(r.numpy(), np.rot90(a_np))
+
+
+def test_reshape_across_splits():
+    a_np = np.arange(48, dtype=np.float32)
+    a = ht.array(a_np, split=0)
+    for shape in [(6, 8), (8, 6), (2, 4, 6), (48,), (-1, 12)]:
+        got = ht.reshape(a, shape)
+        np.testing.assert_array_equal(got.numpy(), a_np.reshape(shape))
+    b = ht.array(a_np.reshape(6, 8), split=1)
+    np.testing.assert_array_equal(ht.reshape(b, (48,)).numpy(), a_np)
+    with pytest.raises((ValueError, TypeError)):
+        ht.reshape(a, (7, 7))

@@ -95,7 +95,9 @@ def test_two_process_distributed_init(tmp_path):
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=240)
+            # generous: the workers compile a dozen sharded programs and the
+            # suite may be saturating every host core around this test
+            out, _ = p.communicate(timeout=600)
             outs.append(out)
     finally:
         for p in procs:  # a hung worker must not outlive the test

@@ -235,3 +235,102 @@ def test_where_kwarg():
     a = ht.array(np.array([1.0, 2.0, 3.0]))
     res = ht.add(a, a, where=ht.array(np.array([True, False, True])))
     np.testing.assert_array_equal(res.numpy(), [2.0, 0.0, 6.0])
+
+
+def test_division_semantics_matrix():
+    # zero-division, mod sign conventions, floor_divide — numpy semantics
+    # (reference test_arithmetics.py edge blocks)
+    a_np = np.array([5.0, -5.0, 0.0, 7.5], np.float32)
+    b_np = np.array([2.0, 0.0, 0.0, -2.0], np.float32)
+    a, b = ht.array(a_np, split=0), ht.array(b_np, split=0)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        np.testing.assert_array_equal(ht.divide(a, b).numpy(), a_np / b_np)
+        fd = ht.floor_divide(a, b).numpy()
+        fd_np = np.floor_divide(a_np, b_np)
+        finite_div = b_np != 0
+        np.testing.assert_array_equal(fd[finite_div], fd_np[finite_div])
+        # x/0 in floor_divide: numpy says ±inf/nan, XLA says nan — both
+        # non-finite; only the finiteness contract is portable
+        assert not np.isfinite(fd[~finite_div]).any()
+    # mod follows the divisor's sign (python/numpy), fmod the dividend's (C)
+    x_np = np.array([5.0, -5.0, 5.0, -5.0], np.float32)
+    y_np = np.array([3.0, 3.0, -3.0, -3.0], np.float32)
+    x, y = ht.array(x_np, split=0), ht.array(y_np, split=0)
+    np.testing.assert_array_equal(ht.mod(x, y).numpy(), np.mod(x_np, y_np))
+    np.testing.assert_array_equal(ht.fmod(x, y).numpy(), np.fmod(x_np, y_np))
+    # integer division truncation vs floor
+    i_np = np.array([7, -7, 7, -7], np.int32)
+    j_np = np.array([2, 2, -2, -2], np.int32)
+    i, j = ht.array(i_np, split=0), ht.array(j_np, split=0)
+    np.testing.assert_array_equal(
+        ht.floor_divide(i, j).numpy(), np.floor_divide(i_np, j_np)
+    )
+
+
+def test_inplace_operator_surface():
+    a_np = np.arange(8, dtype=np.float32)
+    a = ht.array(a_np.copy(), split=0)
+    a += 2
+    a *= 3
+    a -= 1
+    a /= 2
+    e = a_np.copy()
+    e += 2; e *= 3; e -= 1; e /= 2
+    np.testing.assert_allclose(a.numpy(), e, rtol=1e-6)
+    assert a.split == 0
+    b = ht.array((a_np + 1).copy(), split=0)
+    b //= 2
+    b **= 2
+    e2 = (a_np + 1).copy(); e2 //= 2; e2 **= 2
+    np.testing.assert_allclose(b.numpy(), e2, rtol=1e-6)
+    c = ht.array(np.arange(8, dtype=np.int32), split=0)
+    c %= 3
+    c <<= 1
+    c >>= 1
+    c &= 3
+    c |= 4
+    c ^= 1
+    e3 = np.arange(8, dtype=np.int32)
+    e3 %= 3; e3 <<= 1; e3 >>= 1; e3 &= 3; e3 |= 4; e3 ^= 1
+    np.testing.assert_array_equal(c.numpy(), e3)
+
+
+def test_where_nonzero_matrix():
+    rng = np.random.default_rng(61)
+    for shape, split in [((13,), 0), ((6, 5), 0), ((6, 5), 1)]:
+        a_np = rng.normal(size=shape).astype(np.float32)
+        a = ht.array(a_np, split=split)
+        nz = ht.nonzero(a > 0).numpy()
+        want = np.stack(np.nonzero(a_np > 0), axis=1)  # heat's (k, ndim) layout
+        np.testing.assert_array_equal(nz.reshape(want.shape) if nz.ndim == 1 else nz, want)
+        w3 = ht.where(a > 0, a, -a)
+        np.testing.assert_allclose(w3.numpy(), np.abs(a_np), rtol=1e-6)
+
+
+def test_diff_gradient_edges():
+    rng = np.random.default_rng(62)
+    a_np = rng.normal(size=(13, 5)).astype(np.float32)
+    for split in (0, 1, None):
+        a = ht.array(a_np, split=split)
+        for n in (1, 2):
+            for axis in (0, 1):
+                np.testing.assert_allclose(
+                    ht.diff(a, n=n, axis=axis).numpy(),
+                    np.diff(a_np, n=n, axis=axis),
+                    rtol=1e-5, atol=1e-5,
+                )
+
+
+def test_clip_round_nan_propagation():
+    a_np = np.array([1.5, np.nan, -2.5, np.inf, -np.inf], np.float32)
+    a = ht.array(a_np, split=0)
+    np.testing.assert_array_equal(
+        ht.clip(a, -2.0, 2.0).numpy(), np.clip(a_np, -2.0, 2.0)
+    )
+    assert np.isnan(ht.round(a).numpy()[1])
+    assert bool(ht.isnan(a).numpy()[1])
+    assert bool(ht.isinf(a).numpy()[3])
+    assert not bool(ht.isfinite(a).numpy()[4])
+    np.testing.assert_array_equal(
+        ht.nan_to_num(a).numpy(), np.nan_to_num(a_np)
+    )

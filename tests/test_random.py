@@ -139,3 +139,60 @@ print('OK')
         [sys.executable, "-c", code], env=env, capture_output=True, text=True, timeout=300
     )
     assert out.returncode == 0 and "OK" in out.stdout, out.stdout + out.stderr
+
+
+def test_uniform_distribution_quality():
+    # empirical CDF of rand must match U(0,1): KS-style bound over 50k draws
+    ht.random.seed(101)
+    u = np.sort(ht.random.rand(50000, split=0).numpy())
+    n = len(u)
+    ecdf = np.arange(1, n + 1) / n
+    ks = np.max(np.abs(ecdf - u))
+    assert ks < 1.63 / np.sqrt(n) * 2, ks  # ~alpha=0.01 with generous slack
+    # moments
+    assert abs(u.mean() - 0.5) < 0.01
+    assert abs(u.var() - 1.0 / 12) < 0.005
+
+
+def test_normal_distribution_quality():
+    ht.random.seed(102)
+    z = ht.random.randn(50000, split=0).numpy()
+    assert abs(z.mean()) < 0.02
+    assert abs(z.std() - 1.0) < 0.02
+    assert abs((z < 0).mean() - 0.5) < 0.01
+    # tails: P(|z| > 3) ~ 0.0027
+    assert 0.0005 < (np.abs(z) > 3).mean() < 0.008
+
+
+def test_device_count_invariance_subprocess():
+    # the counter-based design's core claim: identical draws at ANY device
+    # count (reference random.py:55-202 rank-range invariance)
+    import os
+    import subprocess
+    import sys
+
+    code = """
+import numpy as np
+import heat_tpu as ht
+ht.random.seed(77)
+a = ht.random.rand(1000, split=0).numpy()
+ht.random.seed(77)
+b = ht.random.randint(0, 1000, (500,), split=0).numpy()
+np.save(r'{out}', np.concatenate([a, b.astype(np.float64)]))
+"""
+    outs = []
+    for ndev in (1, 4):
+        out_file = f"/tmp/rng_inv_{ndev}.npy"
+        env = dict(
+            os.environ,
+            PYTHONPATH="",
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS=f"--xla_force_host_platform_device_count={ndev}",
+        )
+        r = subprocess.run(
+            [sys.executable, "-c", code.format(out=out_file)],
+            env=env, capture_output=True, text=True, timeout=300,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        outs.append(np.load(out_file))
+    np.testing.assert_array_equal(outs[0], outs[1])

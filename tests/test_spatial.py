@@ -93,3 +93,38 @@ def test_symmetric_half_ring_matches_full(p, metric):
     full = ht.spatial.cdist(x, ht.array(a, split=0, comm=comm)) if metric == "cdist" else None
     if full is not None:
         np.testing.assert_allclose(full.numpy(), want, atol=tol, rtol=tol)
+
+
+def test_cdist_deep_matrix():
+    # shapes x splits x metrics x expansion grid vs scipy-style ground truth
+    rng = np.random.default_rng(51)
+    p = ht.get_comm().size
+    for n, m, f in [(2 * p, 3 * p, 4), (13, 9, 3), (p, p, 8)]:
+        x_np = rng.normal(size=(n, f)).astype(np.float32)
+        y_np = rng.normal(size=(m, f)).astype(np.float32)
+        d_true = np.sqrt(((x_np[:, None] - y_np[None]) ** 2).sum(-1))
+        for sx, sy in [(0, 0), (0, None), (None, 0), (None, None)]:
+            for quad in (False, True):
+                d = ht.spatial.cdist(
+                    ht.array(x_np, split=sx), ht.array(y_np, split=sy),
+                    quadratic_expansion=quad,
+                )
+                np.testing.assert_allclose(d.numpy(), d_true, rtol=2e-2, atol=2e-2)
+    # manhattan ground truth
+    x_np = rng.normal(size=(2 * p, 3)).astype(np.float32)
+    m_true = np.abs(x_np[:, None] - x_np[None]).sum(-1)
+    got = ht.spatial.manhattan(ht.array(x_np, split=0), ht.array(x_np, split=0))
+    np.testing.assert_allclose(got.numpy(), m_true, rtol=1e-4, atol=1e-4)
+    # rbf kernel value range
+    k = ht.spatial.rbf(ht.array(x_np, split=0), sigma=2.0)
+    kn = k.numpy()
+    assert np.allclose(np.diag(kn), 1.0, atol=1e-5)
+    assert (kn <= 1.0 + 1e-6).all() and (kn >= 0).all()
+
+
+def test_self_cdist_zero_diagonal_and_symmetry():
+    rng = np.random.default_rng(52)
+    x_np = rng.normal(size=(17, 5)).astype(np.float32)
+    d = ht.spatial.cdist(ht.array(x_np, split=0)).numpy()
+    assert np.allclose(np.diag(d), 0.0, atol=1e-5)
+    np.testing.assert_allclose(d, d.T, rtol=1e-5, atol=1e-5)

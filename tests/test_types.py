@@ -108,3 +108,44 @@ def test_iscomplex_isreal():
     assert types.isreal(x).numpy().tolist() == [False, True]
     y = ht.ones((2,))
     assert types.isreal(y).numpy().all()
+
+
+def test_promotion_matrix_exhaustive():
+    # full promote_types grid vs TORCH's promotion table — the reference
+    # delegates local compute to torch, whose int+float -> float32 rule
+    # differs from numpy (int32+float32 -> float64 there)
+    import torch
+
+    from heat_tpu.core import types as t
+
+    grid = [
+        (ht.uint8, torch.uint8), (ht.int8, torch.int8), (ht.int16, torch.int16),
+        (ht.int32, torch.int32), (ht.float32, torch.float32), (ht.bool, torch.bool),
+    ]
+    for h1, n1 in grid:
+        for h2, n2 in grid:
+            got = t.promote_types(h1, h2)
+            want = torch.promote_types(n1, n2)
+            assert str(want).split(".")[-1].replace("bool", "bool_") in (
+                np.dtype(got.char()).name.replace("bool", "bool_")
+            ), (h1, h2, got, want)
+
+
+def test_can_cast_rules():
+    from heat_tpu.core import types as t
+
+    assert t.can_cast(ht.uint8, ht.int32)
+    assert not t.can_cast(ht.float32, ht.int32)
+    assert t.can_cast(ht.float32, ht.int32, casting="unsafe")
+    assert not t.can_cast(ht.int32, ht.uint8, casting="safe")
+    assert t.can_cast(ht.int32, ht.int32, casting="no")
+    assert not t.can_cast(ht.int32, ht.float32, casting="no")
+
+
+def test_finfo_iinfo_surface():
+    fi = ht.finfo(ht.float32)
+    assert fi.bits == 32 and fi.max > 1e38 and fi.eps < 1e-6
+    ii = ht.iinfo(ht.int16)
+    assert ii.bits == 16 and ii.max == 32767 and ii.min == -32768
+    bf = ht.finfo(ht.bfloat16)
+    assert bf.bits == 16
