@@ -43,6 +43,8 @@ __all__ = [
     "positive",
     "pow",
     "power",
+    "nanprod",
+    "nansum",
     "prod",
     "remainder",
     "right_shift",
@@ -199,6 +201,17 @@ def prod(a, axis=None, out=None, keepdim=None) -> DNDarray:
     """Product of elements over the given axis (reference arithmetics.py prod →
     __reduce_op with MPI.PROD; here a sharded jnp.prod)."""
     return _operations.__reduce_op(a, jnp.prod, axis=axis, out=out, keepdims=bool(keepdim))
+
+
+def nansum(a, axis=None, out=None, keepdim=None) -> DNDarray:
+    """Sum treating NaN as zero (numpy-API completion beyond the reference
+    snapshot; rides the same sharded reduce template, NaN-aware neutral)."""
+    return _operations.__reduce_op(a, jnp.nansum, axis=axis, out=out, keepdims=bool(keepdim))
+
+
+def nanprod(a, axis=None, out=None, keepdim=None) -> DNDarray:
+    """Product treating NaN as one (numpy-API completion)."""
+    return _operations.__reduce_op(a, jnp.nanprod, axis=axis, out=out, keepdims=bool(keepdim))
 
 
 def right_shift(t1, t2) -> DNDarray:

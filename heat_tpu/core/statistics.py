@@ -41,6 +41,9 @@ __all__ = [
     "maximum",
     "mean",
     "median",
+    "nanmax",
+    "nanmean",
+    "nanmin",
     "min",
     "minimum",
     "percentile",
@@ -238,12 +241,13 @@ def maximum(x1, x2, out=None) -> DNDarray:
     return _operations.__binary_op(jnp.maximum, x1, x2, out)
 
 
-def mean(x, axis=None) -> DNDarray:
+def mean(x, axis=None, keepdims: bool = False) -> DNDarray:
     """
     Arithmetic mean along an axis (reference statistics.py:741-866: per-rank partial
     moments merged via Allreduce; here the sharded jnp.mean lowers to the same psum).
+    ``keepdims`` extends the reference's signature to numpy's.
     """
-    return __moment(x, axis, False, lambda a, ax: jnp.mean(a, axis=ax))
+    return __moment(x, axis, keepdims, lambda a, ax: jnp.mean(a, axis=ax, keepdims=keepdims))
 
 
 def median(x, axis=None, keepdim: bool = False) -> DNDarray:
@@ -260,6 +264,22 @@ def median(x, axis=None, keepdim: bool = False) -> DNDarray:
         return jnp.median(a, axis=ax, keepdims=keepdim)
 
     return __moment(x, axis, keepdim, _med)
+
+
+def nanmax(x, axis=None, out=None, keepdim=None) -> DNDarray:
+    """Maximum ignoring NaN (numpy-API completion beyond the reference
+    snapshot; same sharded reduce template)."""
+    return _operations.__reduce_op(x, jnp.nanmax, axis=axis, out=out, keepdims=bool(keepdim))
+
+
+def nanmin(x, axis=None, out=None, keepdim=None) -> DNDarray:
+    """Minimum ignoring NaN (numpy-API completion)."""
+    return _operations.__reduce_op(x, jnp.nanmin, axis=axis, out=out, keepdims=bool(keepdim))
+
+
+def nanmean(x, axis=None, keepdims: bool = False) -> DNDarray:
+    """Mean ignoring NaN (numpy-API completion)."""
+    return __moment(x, axis, keepdims, lambda a, ax: jnp.nanmean(a, axis=ax, keepdims=keepdims))
 
 
 def min(x, axis=None, out=None, keepdim=None) -> DNDarray:

@@ -99,3 +99,52 @@ def test_flash_matches_dense_on_tpu():
     np.testing.assert_allclose(
         np.asarray(dense, np.float32), np.asarray(flash, np.float32), atol=2e-2
     )
+
+
+@pytest.mark.parametrize("heads,dim", [(1, 16), (4, 8)])
+def test_ring_attention_shape_grid(comm, heads, dim):
+    # head-count x head-dim grid, both causal modes, vs the dense reference
+    if comm.size < 2:
+        pytest.skip("needs a multi-device mesh")
+    p = comm.size
+    seq = 4 * p
+    rng = np.random.default_rng(71)
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(1, seq, heads, dim)).astype(np.float32) * 0.3)
+        for _ in range(3)
+    )
+    for causal in (False, True):
+        dense = scaled_dot_product_attention(q, k, v, causal=causal)
+        ring = ht.nn.ring_attention(q, k, v, comm=comm, causal=causal)
+        np.testing.assert_allclose(np.asarray(ring), np.asarray(dense), rtol=2e-4, atol=2e-4)
+
+
+def test_attention_scale_override(comm):
+    if comm.size < 2:
+        pytest.skip("needs a multi-device mesh")
+    p = comm.size
+    rng = np.random.default_rng(72)
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(1, 4 * p, 2, 8)).astype(np.float32) * 0.3)
+        for _ in range(3)
+    )
+    s1 = ht.nn.ring_attention(q, k, v, comm=comm, scale=1.0)
+    s2 = ht.nn.ring_attention(q, k, v, comm=comm, scale=0.125)
+    assert not np.allclose(np.asarray(s1), np.asarray(s2))
+    d2 = scaled_dot_product_attention(q, k, v, causal=False, scale=0.125)
+    np.testing.assert_allclose(np.asarray(s2), np.asarray(d2), rtol=2e-4, atol=2e-4)
+
+
+def test_attention_numerical_stability_large_logits(comm):
+    # the online-softmax running max must survive +-40 logits without overflow
+    if comm.size < 2:
+        pytest.skip("needs a multi-device mesh")
+    p = comm.size
+    rng = np.random.default_rng(73)
+    q = jnp.asarray(rng.normal(size=(1, 2 * p, 1, 8)).astype(np.float32) * 20.0)
+    k = jnp.asarray(rng.normal(size=(1, 2 * p, 1, 8)).astype(np.float32) * 20.0)
+    v = jnp.asarray(rng.normal(size=(1, 2 * p, 1, 8)).astype(np.float32))
+    out = np.asarray(ht.nn.ring_attention(q, k, v, comm=comm))
+    assert np.isfinite(out).all()
+    dense = np.asarray(scaled_dot_product_attention(q, k, v, causal=False))
+    np.testing.assert_allclose(out, dense, rtol=1e-3, atol=1e-3)

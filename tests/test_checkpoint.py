@@ -1,6 +1,8 @@
 """Checkpoint/resume subsystem (capability superset: SURVEY §5 — the reference has
 building blocks only, no framework-level checkpointing)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -65,3 +67,73 @@ def test_restore_empty_dir_raises(tmp_path):
     mgr = CheckpointManager(str(tmp_path / "none"))
     with pytest.raises(FileNotFoundError):
         mgr.restore({"x": 0})
+
+
+def test_checkpoint_leaf_kinds_roundtrip(tmp_path):
+    # every supported leaf kind in one tree: split DNDarray, replicated
+    # DNDarray, jax array, numpy array (64-bit host dtype), scalars, None
+    from heat_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+    import jax.numpy as jnp
+
+    state = {
+        "split": ht.arange(13, split=0).astype(ht.float32),
+        "repl": ht.ones((3, 2)),
+        "jarr": jnp.arange(4, dtype=jnp.int32),
+        "narr": np.arange(5, dtype=np.int64),
+        "lr": 0.125,
+        "name": "run-7",
+        "flag": True,
+    }
+    p = str(tmp_path / "kinds.h5")
+    save_checkpoint(p, state)
+    target = {
+        "split": ht.zeros(13, split=0, dtype=ht.float32),
+        "repl": ht.zeros((3, 2)),
+        "jarr": jnp.zeros(4, jnp.int32),
+        "narr": np.zeros(5, np.int64),
+        "lr": 0.0,
+        "name": "",
+        "flag": False,
+    }
+    back = load_checkpoint(p, target)
+    np.testing.assert_array_equal(back["split"].numpy(), np.arange(13, dtype=np.float32))
+    assert back["split"].split == 0
+    assert back["repl"].split is None
+    np.testing.assert_array_equal(np.asarray(back["jarr"]), np.arange(4))
+    np.testing.assert_array_equal(back["narr"], np.arange(5, dtype=np.int64))
+    assert back["narr"].dtype == np.int64  # exact 64-bit host round-trip
+    assert back["lr"] == 0.125 and back["name"] == "run-7" and back["flag"] is True
+
+
+def test_checkpoint_unsupported_leaf_and_collision(tmp_path):
+    from heat_tpu.utils.checkpoint import save_checkpoint
+
+    with pytest.raises(TypeError):
+        save_checkpoint(str(tmp_path / "bad.h5"), {"f": lambda: None})
+    with pytest.raises(ValueError):
+        save_checkpoint(
+            str(tmp_path / "clash.h5"), {"a": {"b": 1}, "a/b": 2}
+        )
+    # a failed save must not leave tmp litter or clobber an existing file
+    p = str(tmp_path / "keep.h5")
+    save_checkpoint(p, {"x": 1})
+    with pytest.raises(TypeError):
+        save_checkpoint(p, {"x": object()})
+    from heat_tpu.utils.checkpoint import load_checkpoint
+
+    assert load_checkpoint(p, {"x": 0})["x"] == 1
+    leftovers = [f for f in os.listdir(tmp_path) if f.endswith(".ckpt.tmp")]
+    assert leftovers == []
+
+
+def test_manager_step_ordering_and_restore_specific(tmp_path):
+    from heat_tpu.utils.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=3)
+    for step in (1, 5, 3, 9, 7):
+        mgr.save(step, {"v": float(step)})
+    assert mgr.latest_step() == max(mgr.all_steps())
+    assert len(mgr.all_steps()) == 3
+    got = mgr.restore({"v": 0.0}, step=sorted(mgr.all_steps())[0])
+    assert got["v"] == float(sorted(mgr.all_steps())[0])

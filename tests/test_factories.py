@@ -130,3 +130,72 @@ def test_linspace_endpoint_pinned_distributed():
     # endpoint=False unchanged: stop excluded
     z = ht.linspace(0.0, 1.0, 8, endpoint=False, split=0)
     assert float(z[-1].numpy()) < 1.0
+
+
+def test_arange_dtype_inference_grid():
+    for args, want in [
+        ((5,), np.int32),
+        ((0.0, 5.0, 1.0), np.float32),
+        ((0, 10, 2), np.int32),
+    ]:
+        a = ht.arange(*args)
+        assert np.dtype(a.dtype.char()) == want, (args, a.dtype)
+        np.testing.assert_array_equal(a.numpy(), np.arange(*args).astype(want))
+    for split in (None, 0):
+        a = ht.arange(17, split=split, dtype=ht.float32)
+        np.testing.assert_array_equal(a.numpy(), np.arange(17, dtype=np.float32))
+    with pytest.raises(ValueError):
+        ht.arange(0, 10, 0)
+
+
+def test_eye_rectangular_and_split_grid():
+    for shape in (5, (3, 7), (7, 3)):
+        for split in (None, 0, 1):
+            if isinstance(shape, int) and split == 1:
+                continue
+            e = ht.eye(shape, split=split)
+            n, m = (shape, shape) if isinstance(shape, int) else (
+                (shape[0], shape[0]) if len(shape) == 1 else shape
+            )
+            np.testing.assert_array_equal(e.numpy(), np.eye(n, m, dtype=np.float32))
+
+
+def test_like_family_and_meshgrid():
+    a = ht.array(np.arange(12.0, dtype=np.float32).reshape(3, 4), split=0)
+    for fn, val in [(ht.zeros_like, 0.0), (ht.ones_like, 1.0)]:
+        r = fn(a)
+        assert r.shape == a.shape and r.split == a.split
+        assert float(r.numpy().ravel()[0]) == val
+    f = ht.full_like(a, 7.5)
+    assert (f.numpy() == 7.5).all()
+    e = ht.empty_like(a)
+    assert e.shape == a.shape
+    xs, ys = ht.meshgrid(ht.arange(3), ht.arange(4))
+    nx, ny = np.meshgrid(np.arange(3), np.arange(4))
+    np.testing.assert_array_equal(xs.numpy(), nx)
+    np.testing.assert_array_equal(ys.numpy(), ny)
+
+
+def test_logspace_geomspace_grid():
+    np.testing.assert_allclose(
+        ht.logspace(0, 3, 7, split=0).numpy(), np.logspace(0, 3, 7), rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        ht.logspace(0, 3, 7, base=2.0).numpy(), np.logspace(0, 3, 7, base=2.0), rtol=1e-4
+    )
+    if hasattr(ht, "geomspace"):
+        np.testing.assert_allclose(
+            ht.geomspace(1.0, 256.0, 9).numpy(), np.geomspace(1.0, 256.0, 9), rtol=1e-4
+        )
+
+
+def test_asarray_copy_semantics():
+    a_np = np.arange(4.0, dtype=np.float32)
+    a = ht.asarray(a_np)
+    assert a.shape == (4,)
+    b = ht.array(a)  # wrapping a DNDarray
+    np.testing.assert_array_equal(b.numpy(), a_np)
+    c = ht.array([[True, False], [False, True]])
+    assert c.dtype is ht.bool
+    d = ht.array(np.arange(4), dtype=ht.float32, split=0)
+    assert d.dtype is ht.float32

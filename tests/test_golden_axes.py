@@ -142,3 +142,80 @@ def test_integer_ops_matrix(split):
     _chk(ht.diff(xi, axis=1), np.diff(ai, axis=1))
     got, _ = ht.topk(xi.astype(ht.float32), 3, dim=1)
     _chk(got, -np.sort(-ai.astype(np.float32), axis=1)[:, :3])
+
+
+REDUCERS = [
+    ("sum", lambda h, **k: ht.sum(h, **k), np.sum, {}),
+    ("prod", lambda h, **k: ht.prod(h, **k), np.prod, {}),
+    ("max", lambda h, **k: ht.max(h, **k), np.max, {}),
+    ("min", lambda h, **k: ht.min(h, **k), np.min, {}),
+    ("mean", lambda h, **k: ht.mean(h, **k), np.mean, {}),
+]
+
+
+@pytest.mark.parametrize("name,hfn,nfn,kw", REDUCERS)
+@pytest.mark.parametrize("split", [None, 0, 1, 2])
+@pytest.mark.parametrize("axis", [None, 0, 1, 2, (0, 1), (1, 2), (0, 2)])
+def test_reduction_multiaxis_matrix(name, hfn, nfn, kw, split, axis):
+    rng = np.random.default_rng(123)
+    a_np = (rng.uniform(0.5, 1.5, size=(5, 7, 3))).astype(np.float32)
+    a = ht.array(a_np, split=split)
+    kd_variants = [False, True] if name in ("sum", "max", "mean") else [False]
+    for keepdim in kd_variants:
+        extra = {"keepdim": keepdim} if keepdim else {}
+        if name == "mean":
+            got = hfn(a, axis=axis, keepdims=keepdim) if keepdim else hfn(a, axis=axis)
+        else:
+            got = hfn(a, axis=axis, **extra)
+        want = nfn(a_np, axis=axis, keepdims=keepdim)
+        np.testing.assert_allclose(
+            got.numpy(), want, rtol=1e-4, atol=1e-5,
+            err_msg=f"{name} split={split} axis={axis} keepdim={keepdim}",
+        )
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+def test_logical_reductions_matrix(split):
+    rng = np.random.default_rng(124)
+    a_np = rng.integers(0, 2, size=(6, 8)).astype(bool)
+    a = ht.array(a_np, split=split)
+    for axis in (None, 0, 1):
+        np.testing.assert_array_equal(
+            ht.all(a, axis=axis).numpy(), np.all(a_np, axis=axis)
+        )
+        np.testing.assert_array_equal(
+            ht.any(a, axis=axis).numpy(), np.any(a_np, axis=axis)
+        )
+    np.testing.assert_array_equal(
+        ht.logical_and(a, ~a).numpy(), np.logical_and(a_np, ~a_np)
+    )
+    np.testing.assert_array_equal(
+        ht.logical_xor(a, a).numpy(), np.logical_xor(a_np, a_np)
+    )
+    assert bool(ht.all(ht.logical_or(a, ~a)).numpy())
+
+
+def test_isclose_allclose_tolerance_grid():
+    a = ht.array(np.array([1.0, 1.0001, np.nan, np.inf], np.float32), split=0)
+    b = ht.array(np.array([1.0, 1.0002, np.nan, np.inf], np.float32), split=0)
+    np.testing.assert_array_equal(
+        ht.isclose(a, b, atol=1e-3).numpy(), [True, True, False, True]
+    )
+    np.testing.assert_array_equal(
+        ht.isclose(a, b, atol=1e-3, equal_nan=True).numpy(), [True, True, True, True]
+    )
+    assert not bool(ht.allclose(a, b, atol=1e-6))
+    assert bool(ht.allclose(a, b, atol=1e-2, equal_nan=True))
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_nan_reductions_matrix(split):
+    a_np = np.array([[1.0, np.nan, 3.0], [np.nan, 5.0, 6.0]], np.float32)
+    a = ht.array(a_np, split=split)
+    np.testing.assert_allclose(ht.nansum(a).numpy(), np.nansum(a_np), rtol=1e-6)
+    np.testing.assert_allclose(
+        ht.nansum(a, axis=0).numpy(), np.nansum(a_np, axis=0), rtol=1e-6
+    )
+    if hasattr(ht, "nanmax"):
+        np.testing.assert_allclose(ht.nanmax(a).numpy(), np.nanmax(a_np), rtol=1e-6)
+        np.testing.assert_allclose(ht.nanmin(a).numpy(), np.nanmin(a_np), rtol=1e-6)

@@ -271,3 +271,68 @@ def test_square_diag_tiles_full_api():
         qt.match_tiles("nope")
     with pytest.raises(TypeError):
         SquareDiagTiles(a, tiles_per_proc=1.5)
+
+
+def test_printoptions_modes():
+    # printing modes + context manager (reference core/printing tests)
+    from heat_tpu.core import printing
+
+    a = ht.arange(2000, split=0).astype(ht.float32)
+    s = str(a)
+    assert "..." in s  # threshold summarization
+    printing.set_printoptions(threshold=10**6)
+    try:
+        s_full = str(ht.arange(50, split=0))
+        assert "..." not in s_full
+    finally:
+        printing.set_printoptions(threshold=1000)
+    printing.set_printoptions(precision=2)
+    try:
+        s2 = str(ht.array(np.array([1.23456789], np.float32)))
+        assert "1.23" in s2 and "1.2345" not in s2
+    finally:
+        printing.set_printoptions(precision=4)
+    # print0 emits only once per logical controller
+    printing.print0("ok")
+    opts = printing.get_printoptions()
+    assert "precision" in opts
+
+
+def test_profiling_utils_smoke(tmp_path):
+    from heat_tpu.utils import profiling
+
+    t = profiling.Timer()
+    out = ht.sum(ht.ones((64, 64), split=0))
+    dt = t.lap(out.larray)
+    assert dt > 0
+    with profiling.annotate("block"):
+        _ = ht.ones(8).numpy()
+
+
+def test_sanitation_contract():
+    from heat_tpu.core import sanitation
+
+    a = ht.ones((4, 4), split=0)
+    sanitation.sanitize_in(a)
+    with pytest.raises(TypeError):
+        sanitation.sanitize_in(np.ones(3))
+    out = ht.zeros((4, 4), split=0)
+    sanitation.sanitize_out(out, (4, 4), 0, a.device)
+    with pytest.raises(ValueError):
+        sanitation.sanitize_out(out, (5, 5), 0, a.device)
+    with pytest.raises(TypeError):
+        sanitation.sanitize_out("zz", (4, 4), 0, a.device)
+
+
+def test_stride_tricks_surface():
+    from heat_tpu.core import stride_tricks
+
+    assert stride_tricks.sanitize_axis((4, 5), -1) == 1
+    assert stride_tricks.sanitize_axis((4, 5), None) is None
+    with pytest.raises(ValueError):
+        stride_tricks.sanitize_axis((4, 5), 2)
+    assert stride_tricks.broadcast_shapes((3, 1), (1, 4)) == (3, 4)
+    with pytest.raises(ValueError):
+        stride_tricks.broadcast_shapes((3, 2), (4, 2))
+    assert stride_tricks.sanitize_shape(5) == (5,)
+    assert stride_tricks.sanitize_shape((2, 3)) == (2, 3)

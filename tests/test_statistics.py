@@ -211,3 +211,82 @@ def test_percentile_multi_q_2d_grid():
     e = np.percentile(a_np, q, axis=0)
     assert r.shape == e.shape
     np.testing.assert_allclose(r.numpy(), e, rtol=1e-4, atol=1e-5)
+
+
+def test_histogram_family_matrix():
+    rng = np.random.default_rng(81)
+    a_np = rng.normal(size=200).astype(np.float32)
+    a = ht.array(a_np, split=0)
+    for bins in (10, 16):
+        h, e = ht.histogram(a, bins=bins)
+        hn, en = np.histogram(a_np, bins=bins)
+        np.testing.assert_array_equal(h.numpy(), hn)
+        np.testing.assert_allclose(e.numpy(), en, rtol=1e-5)
+    h, e = ht.histogram(a, bins=8, range=(-2.0, 2.0))
+    hn, en = np.histogram(a_np, bins=8, range=(-2.0, 2.0))
+    np.testing.assert_array_equal(h.numpy(), hn)
+    # histc parity (torch-style)
+    if hasattr(ht, "histc"):
+        hc = ht.histc(a, bins=8, min=-2.0, max=2.0)
+        np.testing.assert_array_equal(hc.numpy(), hn)
+
+
+def test_bucketize_digitize_matrix():
+    rng = np.random.default_rng(82)
+    a_np = rng.uniform(0, 10, size=37).astype(np.float32)
+    bounds = np.array([2.0, 4.0, 6.0, 8.0], np.float32)
+    a = ht.array(a_np, split=0)
+    for right in (False, True):
+        got = ht.digitize(a, ht.array(bounds), right=right)
+        np.testing.assert_array_equal(got.numpy(), np.digitize(a_np, bounds, right=right))
+    got = ht.bucketize(a, ht.array(bounds))
+    np.testing.assert_array_equal(got.numpy(), np.digitize(a_np, bounds, right=False))
+
+
+def test_cov_kurtosis_skew_grid():
+    rng = np.random.default_rng(83)
+    m_np = rng.normal(size=(5, 40)).astype(np.float32)
+    m = ht.array(m_np, split=1)
+    np.testing.assert_allclose(ht.cov(m).numpy(), np.cov(m_np), rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(
+        ht.cov(m, ddof=0).numpy(), np.cov(m_np, ddof=0), rtol=1e-3, atol=1e-4
+    )
+    from scipy import stats as sps  # scipy ships with the image? guard below
+
+    x_np = rng.normal(size=300).astype(np.float32)
+    x = ht.array(x_np, split=0)
+    np.testing.assert_allclose(
+        float(ht.kurtosis(x).numpy()), float(sps.kurtosis(x_np, bias=False)), rtol=1e-2, atol=1e-2
+    )
+    np.testing.assert_allclose(
+        float(ht.skew(x).numpy()), float(sps.skew(x_np, bias=False)), rtol=1e-2, atol=1e-2
+    )
+
+
+def test_argextrema_ties_and_keepdims():
+    a_np = np.array([[3, 1, 3], [0, 3, 0]], np.float32)
+    a = ht.array(a_np, split=0)
+    assert int(ht.argmax(a).numpy()) == int(np.argmax(a_np))
+    assert int(ht.argmin(a).numpy()) == int(np.argmin(a_np))
+    np.testing.assert_array_equal(ht.argmax(a, axis=1).numpy(), np.argmax(a_np, axis=1))
+    np.testing.assert_array_equal(ht.argmin(a, axis=0).numpy(), np.argmin(a_np, axis=0))
+    np.testing.assert_array_equal(
+        ht.max(a, axis=0, keepdim=True).numpy(), a_np.max(axis=0, keepdims=True)
+    )
+    np.testing.assert_array_equal(
+        ht.min(a, axis=1, keepdim=True).numpy(), a_np.min(axis=1, keepdims=True)
+    )
+
+
+def test_var_std_ddof_matrix():
+    rng = np.random.default_rng(84)
+    a_np = rng.normal(size=(13, 6)).astype(np.float32)
+    for split in (0, 1, None):
+        a = ht.array(a_np, split=split)
+        for axis in (None, 0, 1):
+            np.testing.assert_allclose(
+                ht.var(a, axis=axis).numpy(), a_np.var(axis=axis), rtol=1e-4, atol=1e-5
+            )
+            np.testing.assert_allclose(
+                ht.std(a, axis=axis).numpy(), a_np.std(axis=axis), rtol=1e-4, atol=1e-5
+            )
