@@ -249,7 +249,7 @@ def distributed_sort(a, axis: int = 0, descending: bool = False) -> Tuple[jax.Ar
         )
         key = jnp.where(mask, key, jnp.asarray(sentinel, dtype=key.dtype))
     fn = _build_sort(
-        comm.mesh, comm.axis_name, comm.size, tuple(phys.shape), axis, np.dtype(key.dtype).str
+        comm.mesh, comm.axis_name, comm.size, tuple(phys.shape), axis, np.dtype(key.dtype).name
     )
     out_k, out_i = fn(key)
     if dt.kind == "f":
@@ -331,7 +331,7 @@ def distributed_topk(a, dim: int, k: int, largest: bool = True) -> Tuple[jax.Arr
         key = jnp.where(mask, key, jnp.asarray(sentinel, dtype=key.dtype))
     fn = _build_topk(
         comm.mesh, comm.axis_name, comm.size, tuple(phys.shape), dim, int(k),
-        np.dtype(key.dtype).str,
+        np.dtype(key.dtype).name,
     )
     out_k, out_i = fn(key)
     if dt.kind == "f":

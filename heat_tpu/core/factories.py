@@ -276,7 +276,7 @@ def __factory(
     if __distributed(split, comm) and len(shape):
         pshape = comm.padded_shape(shape, split)
         build = __sharded_builder(
-            "full", pshape, np.dtype(dtype.jnp_type()).str, comm.sharding(len(shape), split)
+            "full", pshape, np.dtype(dtype.jnp_type()).name, comm.sharding(len(shape), split)
         )
         if fill_value is None:
             fill_value = 1 if local_factory is jnp.ones else 0
@@ -337,7 +337,7 @@ def arange(
             )
         pshape = (comm_r.padded_dim(num),)
         build = __sharded_builder(
-            "affine", pshape, np.dtype(dt.jnp_type()).str, comm_r.sharding(1, 0)
+            "affine", pshape, np.dtype(dt.jnp_type()).name, comm_r.sharding(1, 0)
         )
         data = build(start, step)
         return DNDarray(
@@ -384,7 +384,7 @@ def eye(
     if __distributed(split_s, comm_r):
         pshape = comm_r.padded_shape((n, m), split_s)
         build = __sharded_builder(
-            "eye", pshape, np.dtype(dtype.jnp_type()).str, comm_r.sharding(2, split_s)
+            "eye", pshape, np.dtype(dtype.jnp_type()).name, comm_r.sharding(2, split_s)
         )
         return DNDarray(
             build(), (n, m), dtype, split_s, devices.sanitize_device(device), comm_r, True
@@ -450,7 +450,7 @@ def linspace(
         pshape = (comm_r.padded_dim(num),)
         kind = "affine_pinned" if endpoint and num > 1 else "affine"
         build = __sharded_builder(
-            kind, pshape, np.dtype(dt.jnp_type()).str, comm_r.sharding(1, 0)
+            kind, pshape, np.dtype(dt.jnp_type()).name, comm_r.sharding(1, 0)
         )
         if kind == "affine_pinned":
             data = build(float(start), float(step), num - 1, float(stop))

@@ -191,7 +191,7 @@ def qr(
     )
     if use_bcgs:
         fn = __build_bcgs(
-            comm.mesh, comm.axis_name, comm.size, m, n, np.dtype(a.dtype.jnp_type()).str
+            comm.mesh, comm.axis_name, comm.size, m, n, np.dtype(a.dtype.jnp_type()).name
         )
         q_data, r_data = fn(a.parray)
         r = DNDarray(r_data, (n, n), a.dtype, 1, a.device, a.comm, True)

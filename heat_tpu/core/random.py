@@ -205,7 +205,7 @@ def __draw(kind: str, shape, dtype, split, device, comm, *args) -> DNDarray:
     gen = __generator(
         kind,
         shape,
-        np.dtype(heat_dtype.jnp_type()).str,
+        np.dtype(heat_dtype.jnp_type()).name,
         (comm, split) if distributed else None,
     )
     data = gen(key, *args)

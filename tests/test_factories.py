@@ -199,3 +199,20 @@ def test_asarray_copy_semantics():
     assert c.dtype is ht.bool
     d = ht.array(np.arange(4), dtype=ht.float32, split=0)
     assert d.dtype is ht.float32
+
+
+def test_half_dtype_sharded_factories():
+    # regression (r3): sharded builders keyed dtypes via np.dtype(...).str,
+    # which mangles bfloat16 to raw-void '|V2' and broke every distributed
+    # bf16/f16 factory; keys are canonical dtype NAMES now
+    p = ht.get_comm().size
+    for dt in (ht.bfloat16, ht.float16):
+        a = ht.ones((4 * p, 2), split=0, dtype=dt)
+        assert a.dtype is dt
+        assert float(np.asarray(a.numpy()).astype(np.float32).sum()) == 8.0 * p
+        z = ht.zeros((4 * p,), split=0, dtype=dt)
+        assert float(np.asarray(z.numpy()).astype(np.float32).sum()) == 0.0
+        f = ht.full((4 * p,), 2.0, split=0, dtype=dt)
+        assert float(np.asarray(f.numpy()).astype(np.float32)[0]) == 2.0
+        r = ht.arange(4 * p, split=0, dtype=dt)
+        assert r.dtype is dt
