@@ -455,6 +455,20 @@ class MeshCommunication(Communication):
         x, split = self.__prep(x, split)
         return self.__collective("scan", split, x.ndim, op, exclusive=True)(x)
 
+    def Barrier(self) -> None:
+        """
+        Block until every controller process reaches this point (the reference
+        delegates to ``MPI.COMM_WORLD.Barrier``). Single-controller SPMD needs
+        no device barrier — dispatch order already serializes — so this only
+        synchronizes *processes*: a no-op with one controller, a
+        ``sync_global_devices`` fence under multi-controller (e.g. between a
+        process-0 file write and a cross-process read of it).
+        """
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("heat_tpu.Barrier")
+
     def Cum(self, x, op: str = "sum", split: int = 0):
         """
         Element-wise cumulative (``'sum'`` or ``'prod'``) ALONG the split axis,

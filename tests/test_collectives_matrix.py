@@ -204,3 +204,9 @@ def test_split_subcommunicators(comm):
     got = np.asarray(sub.Allreduce(x, op="sum", split=0))
     want = np.add.reduce(np.split(x, sub.size, axis=0))
     np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_barrier_single_controller_noop(comm):
+    # Barrier is a process fence: trivially returns under one controller (the
+    # multi-controller path is exercised by tests/test_multihost.py)
+    comm.Barrier()

@@ -424,3 +424,74 @@ def test_ring_and_dense_exchange_agree():
     np.testing.assert_array_equal(np.asarray(rv), np.asarray(dv_))
     np.testing.assert_array_equal(np.asarray(ri), np.asarray(di))
     np.testing.assert_array_equal(np.asarray(rv), np.sort(np.asarray(v)))
+
+
+def test_daso_hierarchical_step_collectives():
+    """DASO's compiled step must reduce gradients over the LOCAL mesh axis only
+    (node groups drift); the global sync is a separate bf16 program over the
+    node axis (reference dp_optimizer.py:432-652)."""
+    import optax
+
+    comm = _comm()
+    if comm.size < 4:
+        pytest.skip("needs >= 4 devices for a 2-D (node, local) mesh")
+    import heat_tpu.optim as optim
+
+    daso = optim.DASO(local_optimizer=optax.sgd(1e-2), total_epochs=2, comm=comm)
+    assert daso.nodes > 1 and daso.local_size > 1
+    import flax.linen as fnn
+
+    class M(fnn.Module):
+        @fnn.compact
+        def __call__(self, x):
+            return fnn.Dense(1)(x)
+
+    m = M()
+    x = jnp.ones((8, 4), jnp.float32)
+    y = jnp.ones((8, 1), jnp.float32)
+    params = m.init(jax.random.PRNGKey(0), x)
+
+    def mse(p, apply_fn, xx, yy):
+        return jnp.mean((apply_fn(p, xx) - yy) ** 2)
+
+    daso.init(params)
+    daso.make_train_step(mse, m.apply)
+    t = daso._local_step.lower(daso.params, daso.opt_state, x, y).compile().as_text()
+    assert "all-reduce" in t  # the local-axis gradient pmean
+    # global sync program exists and reduces in bf16 over nodes
+    tg = daso._global_mean.lower(daso.params).compile().as_text()
+    assert "all-reduce" in tg
+    assert "bf16" in tg
+
+
+def test_dp8_training_step_single_allreduce():
+    """The plain DataParallel step: ONE gradient all-reduce, no gathers of the
+    batch (reference nn/data_parallel.py gradient hooks -> compiled psum)."""
+    import optax
+    import flax.linen as fnn
+
+    comm = _comm()
+
+    class M(fnn.Module):
+        @fnn.compact
+        def __call__(self, x):
+            return fnn.Dense(2)(fnn.relu(fnn.Dense(8)(x)))
+
+    dp = ht.nn.DataParallel(M(), optimizer=optax.sgd(1e-2), comm=comm)
+    x = np.ones((8 * comm.size, 4), np.float32)
+    dp.init(0, x[:2])
+
+    def mse(p, apply_fn, xx, yy):
+        return jnp.mean((apply_fn(p, xx) - yy) ** 2)
+
+    dp.make_train_step(mse)
+    y = np.zeros((8 * comm.size, 2), np.float32)
+    xs = dp._shard_batch(x) if hasattr(dp, "_shard_batch") else x
+    t = dp._step.lower(dp.params, dp.opt_state, dp._place(x), dp._place(y)).compile().as_text() if hasattr(dp, "_place") else None
+    if t is not None:
+        assert "all-reduce" in t
+        _no_full_gather(t, 8 * comm.size)
+    else:
+        # API shape differs: at minimum the training step must run sharded
+        loss = dp.train_step(x, y)
+        assert np.isfinite(float(loss))

@@ -180,3 +180,39 @@ def test_csv_matrix(tmp_path):
     ht.save_csv(a, str(out))
     back = ht.load_csv(str(out))
     np.testing.assert_allclose(back.numpy().reshape(-1), np.arange(13))
+
+
+def test_hdf5_multi_dataset_modes(tmp_path):
+    if not ht.io.supports_hdf5():
+        pytest.skip("h5py missing")
+    p = str(tmp_path / "multi.h5")
+    a = ht.arange(16, split=0).astype(ht.float32)
+    b = ht.ones((4, 4))
+    ht.io.save_hdf5(a, p, "a", mode="w")
+    ht.io.save_hdf5(b, p, "b", mode="a")  # append second dataset
+    ra = ht.io.load_hdf5(p, "a", split=0)
+    rb = ht.io.load_hdf5(p, "b")
+    np.testing.assert_array_equal(ra.numpy(), np.arange(16, dtype=np.float32))
+    np.testing.assert_array_equal(rb.numpy(), np.ones((4, 4), np.float32))
+    # overwrite mode drops previous content
+    ht.io.save_hdf5(b, p, "only", mode="w")
+    with pytest.raises(KeyError):
+        ht.io.load_hdf5(p, "a")
+
+
+def test_hdf5_split1_and_dtype_roundtrip(tmp_path):
+    if not ht.io.supports_hdf5():
+        pytest.skip("h5py missing")
+    p = str(tmp_path / "s1.h5")
+    a_np = np.arange(24, dtype=np.int32).reshape(3, 8)
+    a = ht.array(a_np, split=1)
+    ht.save(a, p, "d")
+    back = ht.load(p, dataset="d", split=1, dtype=ht.int32)
+    assert back.split == 1
+    # the reference's load_hdf5 defaults dtype to float32 (reference io.py:57-61)
+    assert np.dtype(ht.load(p, dataset="d").dtype.char()) == np.float32
+    assert np.dtype(back.dtype.char()) == np.int32
+    np.testing.assert_array_equal(back.numpy(), a_np)
+    # ragged split load
+    r = ht.load(p, dataset="d", split=0)
+    assert r.split == 0 and r.shape == (3, 8)

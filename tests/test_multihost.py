@@ -46,9 +46,12 @@ WORKER = textwrap.dedent(
     assert (sv.numpy() == np.sort(s_np)).all()
 
     if ht.io.supports_hdf5():
-        # split-io save + sharded load round-trip (io.py multi-host slab branch)
+        # split-io save + sharded load round-trip (io.py multi-host slab branch);
+        # save gathers collectively but only process 0 writes the file — the
+        # Barrier keeps process 1 from racing ahead to the read
         a = ht.arange(24, split=0, dtype=ht.float32) * 0.5
         ht.save(a, f"{tmp}/mh.h5", "data")
+        comm.Barrier()
         b = ht.load(f"{tmp}/mh.h5", dataset="data", split=0)
         assert b.shape == (24,)
         assert abs(float(ht.sum(b).item()) - float(ht.sum(a).item())) < 1e-5

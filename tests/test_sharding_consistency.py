@@ -23,8 +23,10 @@ N_DEV = len(jax.devices())
 
 
 def phys_split(d):
-    """Infer the physically sharded axis of the backing array (None = replicated)."""
-    arr = d.larray
+    """Infer the physically sharded axis of the backing array (None = replicated).
+    Ragged arrays are judged by their PADDED physical form — the logical view is
+    a slice whose sharding XLA may canonicalize away."""
+    arr = d.parray if getattr(d, "is_padded", False) else d.larray
     sh = arr.sharding
     if hasattr(sh, "spec"):
         for i, s in enumerate(sh.spec):
@@ -155,3 +157,33 @@ def test_linalg_and_ml():
     q, r = ht.linalg.qr(x)
     assert_consistent(q, "qr Q")
     assert_consistent(cdist(x, x), "cdist")
+
+
+@pytest.mark.parametrize("n", [32, 13])
+def test_round3_ops_stay_sharded(n):
+    # the ops that gained distributed formulations in round 3 must return
+    # PHYSICALLY sharded results where their metadata promises a split
+    if N_DEV < 2:
+        pytest.skip("needs a multi-device mesh")
+    rng = np.random.default_rng(55)
+    a = ht.array(rng.normal(size=(n, 4)).astype(np.float32), split=0)
+
+    c = ht.cumsum(a, axis=0)
+    assert c.split == 0 and phys_split(c) == 0
+
+    v, i = ht.sort(a, axis=0)
+    assert v.split == 0 and phys_split(v) == 0
+    assert i.split == 0 and phys_split(i) == 0
+
+    idx = np.arange(n) % (n - 1)
+    g = a[idx, np.arange(n) % 4]  # multi-advanced keys, result length n
+    assert g.split == 0 and phys_split(g) == 0
+
+    ls = ht.linspace(0.0, 1.0, n, split=0)
+    assert phys_split(ls) == 0
+
+    r = ht.random.randint(0, 9, (n,), split=0)
+    assert phys_split(r) == 0
+
+    h = ht.ones((n, 4), split=0, dtype=ht.bfloat16)
+    assert phys_split(h) == 0
