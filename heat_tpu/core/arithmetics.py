@@ -43,6 +43,8 @@ __all__ = [
     "positive",
     "pow",
     "power",
+    "copysign",
+    "hypot",
     "nanprod",
     "nansum",
     "prod",
@@ -201,6 +203,17 @@ def prod(a, axis=None, out=None, keepdim=None) -> DNDarray:
     """Product of elements over the given axis (reference arithmetics.py prod →
     __reduce_op with MPI.PROD; here a sharded jnp.prod)."""
     return _operations.__reduce_op(a, jnp.prod, axis=axis, out=out, keepdims=bool(keepdim))
+
+
+def hypot(t1, t2, out=None) -> DNDarray:
+    """Element-wise ``sqrt(t1**2 + t2**2)`` without intermediate overflow
+    (numpy-API completion beyond the reference snapshot)."""
+    return _operations.__binary_op(jnp.hypot, t1, t2, out)
+
+
+def copysign(t1, t2, out=None) -> DNDarray:
+    """Magnitude of ``t1`` with the sign of ``t2`` (numpy-API completion)."""
+    return _operations.__binary_op(jnp.copysign, t1, t2, out)
 
 
 def nansum(a, axis=None, out=None, keepdim=None) -> DNDarray:

@@ -285,3 +285,57 @@ def test_solver_edge_cases():
     # lanczos returns factors with the promised shapes
     V, T = ht.linalg.lanczos(ht.array(a_np, split=0), m=5)
     assert V.shape == (n, 5) and T.shape == (5, 5)
+
+
+def test_linalg_basics_surface_matrix():
+    rng = np.random.default_rng(101)
+    a_np = rng.normal(size=(4, 4)).astype(np.float32)
+    b_np = rng.normal(size=(4, 4)).astype(np.float32)
+    v_np = rng.normal(size=4).astype(np.float32)
+    w_np = rng.normal(size=4).astype(np.float32)
+    for split in (None, 0):
+        a, b = ht.array(a_np, split=split), ht.array(b_np, split=split)
+        v, w = ht.array(v_np, split=split), ht.array(w_np, split=split)
+        np.testing.assert_allclose(ht.linalg.det(a).numpy(), np.linalg.det(a_np), rtol=1e-3)
+        np.testing.assert_allclose(
+            ht.linalg.inv(a).numpy(), np.linalg.inv(a_np), rtol=1e-2, atol=1e-3
+        )
+        np.testing.assert_allclose(float(ht.linalg.vdot(v, w).numpy()), float(np.vdot(v_np, w_np)), rtol=1e-4)
+        np.testing.assert_allclose(ht.linalg.outer(v, w).numpy(), np.outer(v_np, w_np), rtol=1e-4)
+        np.testing.assert_allclose(float(ht.linalg.trace(a)), float(np.trace(a_np)), rtol=1e-4)
+        np.testing.assert_allclose(
+            float(ht.linalg.norm(v).numpy()), float(np.linalg.norm(v_np)), rtol=1e-4
+        )
+        np.testing.assert_allclose(
+            float(ht.linalg.matrix_norm(a).numpy()), float(np.linalg.norm(a_np)), rtol=1e-4
+        )
+        np.testing.assert_allclose(ht.linalg.tril(a).numpy(), np.tril(a_np), rtol=1e-6)
+        np.testing.assert_allclose(ht.linalg.triu(a).numpy(), np.triu(a_np), rtol=1e-6)
+        np.testing.assert_allclose(
+            ht.linalg.transpose(a).numpy(), a_np.T, rtol=1e-6
+        )
+    c1 = ht.array(np.array([1.0, 0.0, 0.0], np.float32))
+    c2 = ht.array(np.array([0.0, 1.0, 0.0], np.float32))
+    np.testing.assert_allclose(ht.linalg.cross(c1, c2).numpy(), [0.0, 0.0, 1.0], atol=1e-6)
+
+
+def test_svd_reconstruction_and_rsvd():
+    rng = np.random.default_rng(102)
+    p = ht.get_comm().size
+    m, n = 8 * p, 6
+    a_np = rng.normal(size=(m, n)).astype(np.float32)
+    a = ht.array(a_np, split=0)
+    res = ht.linalg.svd(a)
+    U, S, Vt = res
+    np.testing.assert_allclose(
+        U.numpy() @ np.diag(S.numpy()) @ Vt.numpy(), a_np, rtol=1e-2, atol=1e-2
+    )
+    s_np = np.linalg.svd(a_np, compute_uv=False)
+    np.testing.assert_allclose(np.sort(S.numpy())[::-1], s_np, rtol=1e-2, atol=1e-2)
+    # rsvd captures a low-rank matrix almost exactly
+    lr_np = (rng.normal(size=(m, 3)) @ rng.normal(size=(3, n))).astype(np.float32)
+    lr = ht.array(lr_np, split=0)
+    Ur, Sr, Vtr = ht.linalg.rsvd(lr, rank=3, n_oversamples=4)
+    np.testing.assert_allclose(
+        Ur.numpy() @ np.diag(Sr.numpy()) @ Vtr.numpy(), lr_np, rtol=5e-2, atol=5e-2
+    )

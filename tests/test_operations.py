@@ -334,3 +334,37 @@ def test_clip_round_nan_propagation():
     np.testing.assert_array_equal(
         ht.nan_to_num(a).numpy(), np.nan_to_num(a_np)
     )
+
+
+@requires_complex
+def test_complex_math_matrix():
+    z_np = np.array([1 + 2j, -3 + 0.5j, 0 - 1j, 2.5 + 0j], np.complex64)
+    for split in (None, 0):
+        z = ht.array(z_np, split=split)
+        np.testing.assert_allclose(ht.real(z).numpy(), z_np.real, rtol=1e-6)
+        np.testing.assert_allclose(ht.imag(z).numpy(), z_np.imag, rtol=1e-6)
+        np.testing.assert_allclose(ht.conj(z).numpy(), np.conj(z_np), rtol=1e-6)
+        np.testing.assert_allclose(ht.angle(z).numpy(), np.angle(z_np), rtol=1e-5)
+        np.testing.assert_allclose(
+            ht.angle(z, deg=True).numpy(), np.degrees(np.angle(z_np)), rtol=1e-5
+        )
+        np.testing.assert_allclose(ht.abs(z).numpy(), np.abs(z_np), rtol=1e-5)
+        s = ht.sum(z)
+        np.testing.assert_allclose(np.asarray(s.larray), z_np.sum(), rtol=1e-5)
+    assert ht.conjugate is ht.conj or ht.conjugate(z).numpy() is not None
+
+
+def test_power_and_hypot_edges():
+    a_np = np.array([0.0, 2.0, -2.0, 9.0], np.float32)
+    a = ht.array(a_np, split=0)
+    np.testing.assert_allclose(ht.pow(a, 2).numpy(), a_np**2, rtol=1e-6)
+    np.testing.assert_allclose(ht.pow(a, 0).numpy(), np.ones_like(a_np), rtol=1e-6)
+    np.testing.assert_allclose((a ** 0.5).numpy(), a_np**0.5, rtol=1e-5, equal_nan=True)
+    b = ht.array(np.array([3.0, 4.0, 5.0, 12.0], np.float32), split=0)
+    c = ht.array(np.array([4.0, 3.0, 12.0, 5.0], np.float32), split=0)
+    np.testing.assert_allclose(
+        ht.hypot(b, c).numpy(), np.hypot(b.numpy(), c.numpy()), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        ht.copysign(b, -c).numpy(), np.copysign(b.numpy(), -c.numpy()), rtol=1e-6
+    )
