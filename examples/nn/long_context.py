@@ -1,0 +1,83 @@
+"""
+Long-context attention demo: sequence parallelism over the device mesh.
+
+The dense softmax(QK^T)V materialises an (S, S) score matrix — at S = 32k that
+is 4 GB of f32 per head and does not fit. The framework ships two sequence-
+parallel formulations that never materialise it (SURVEY §5 long-context;
+generalizing the reference's ring `_dist` pattern, distance.py:279-346):
+
+* ``ht.nn.ring_attention`` — blocks of K/V rotate around the mesh with
+  ``ppermute`` while each device holds its Q block and folds incoming tiles
+  into an online softmax (running max + normalizer). Communication is the
+  ring; memory is O(S·d / p) per device.
+* ``ht.nn.ulysses_attention`` — all-to-all re-shards from sequence-split to
+  head-split, runs dense per-head attention locally, and all-to-alls back.
+
+Run (virtual mesh):
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/nn/long_context.py --seq 4096
+Run (real TPU): python examples/nn/long_context.py --seq 32768
+"""
+
+import argparse
+import time
+
+import numpy as np
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--seq", type=int, default=4096)
+    parser.add_argument("--heads", type=int, default=4)
+    parser.add_argument("--dim", type=int, default=64)
+    parser.add_argument("--causal", action="store_true")
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    import heat_tpu as ht
+    from heat_tpu.core.communication import get_comm
+
+    comm = get_comm()
+    p = comm.size
+    s = (args.seq // max(p, 1)) * max(p, 1)
+    print(f"devices={p}  seq={s}  heads={args.heads}  head_dim={args.dim}")
+
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    shape = (1, s, args.heads, args.dim)
+    q, k, v = (jax.random.normal(kk, shape, jnp.float32) * 0.3 for kk in ks)
+
+    def timed(name, fn, *a, **kw):
+        out = fn(*a, **kw)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        out = fn(*a, **kw)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        print(f"  {name:24s} {dt * 1e3:8.1f} ms")
+        return np.asarray(out)
+
+    print("sequence-parallel attention:")
+    ring = timed(
+        "ring_attention", ht.nn.ring_attention, q, k, v, comm=comm, causal=args.causal
+    )
+    uly = timed(
+        "ulysses_attention", ht.nn.ulysses_attention, q, k, v, comm=comm,
+        causal=args.causal,
+    )
+    np.testing.assert_allclose(ring, uly, rtol=2e-3, atol=2e-3)
+
+    if s <= 8192:  # the dense reference still fits at small S
+        dense = timed(
+            "dense reference", ht.nn.scaled_dot_product_attention, q, k, v,
+            causal=args.causal,
+        )
+        np.testing.assert_allclose(ring, dense, rtol=2e-3, atol=2e-3)
+        print("  ring == ulysses == dense (rtol 2e-3)")
+    else:
+        print("  ring == ulysses (dense would not fit at this length)")
+
+
+if __name__ == "__main__":
+    main()

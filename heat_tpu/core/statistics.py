@@ -350,7 +350,15 @@ def percentile(x, q, axis=None, out=None, interpolation: str = "linear", keepdim
             kshape = tuple(1 if d == ax else s for d, s in enumerate(x.shape))
             res = res.reshape(qshape + kshape)
     else:
-        res = jnp.percentile(x.larray.astype(jnp.float32), qv, axis=axis, method=interpolation, keepdims=keepdim)
+        # jnp.percentile only takes rank<=1 q; numpy allows any q shape —
+        # flatten around the call and restore the q dimensions in front
+        qf = jnp.asarray(qv)
+        res = jnp.percentile(
+            x.larray.astype(jnp.float32), qf.reshape(-1) if qf.ndim > 1 else qf,
+            axis=axis, method=interpolation, keepdims=keepdim,
+        )
+        if qf.ndim > 1:
+            res = res.reshape(tuple(qf.shape) + tuple(res.shape[1:]))
     # the split axis survives when it is not the reduced axis; a vector q prepends
     # qv.ndim leading axes, shifting the surviving split accordingly
     split = stride_tricks.reduced_split(x.split, axis, keepdim, prepend=int(qv.ndim))

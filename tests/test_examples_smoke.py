@@ -57,3 +57,8 @@ def test_benchmark_scripts_run(script, extra):
 def test_stencil_demo_runs():
     # halo-exchange stencil demo (the get_halo ppermute machinery end-to-end)
     _run(["examples/stencil/demo_heat_equation.py"])
+
+
+def test_long_context_demo_runs():
+    out = _run(["examples/nn/long_context.py", "--seq", "1024"])
+    assert "ring == ulysses" in out
