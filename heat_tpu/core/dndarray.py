@@ -802,8 +802,14 @@ class DNDarray:
                         )
                 if in_ax == split:
                     if self.is_padded:
-                        # negatives wrap at the LOGICAL extent, never exposing pad
+                        # negatives wrap at the LOGICAL extent, never exposing pad.
+                        # Traced keys skip the eager bounds check above, so they
+                        # additionally clamp at n-1 — jax's documented clamping,
+                        # applied to the logical extent instead of the physical
+                        # one (which would expose pad rows)
                         k = jnp.where(k < 0, k + n, k)
+                        if isinstance(k, jax.core.Tracer):
+                            k = jnp.clip(k, 0, max(n - 1, 0))
                     if n_advanced == 1 and k.ndim == 1:
                         new_split = out_ax
                 norm.append(k)

@@ -91,10 +91,11 @@ def average(x, axis=None, weights=None, returned: bool = False):
     sanitation.sanitize_in(x)
     w = weights.larray if isinstance(weights, DNDarray) else weights
     axis = stride_tricks.sanitize_axis(x.shape, axis)
-    if w is not None and not bool(jnp.all(jnp.sum(jnp.asarray(w)) != 0)):
-        # numpy raises here; jnp.average silently returns nan/inf
-        raise ZeroDivisionError("Weights sum to zero, can't be normalized")
     avg, wsum = jnp.average(x.larray, axis=axis, weights=w, returned=True)
+    if w is not None and bool(jnp.any(wsum == 0)):
+        # numpy raises when any normalization slice sums to zero; jnp.average
+        # silently returns nan/inf — wsum already carries the per-slice sums
+        raise ZeroDivisionError("Weights sum to zero, can't be normalized")
     split = stride_tricks.reduced_split(x.split, axis)
     res = DNDarray(avg, tuple(avg.shape), types.canonical_heat_type(avg.dtype), split, x.device, x.comm, True)
     if returned:
@@ -313,11 +314,16 @@ def percentile(x, q, axis=None, out=None, interpolation: str = "linear", keepdim
         sv = DNDarray(sv_p, x.shape, x.dtype, x.split, x.device, x.comm, True)
         n = x.shape[ax]
         rest = tuple(s for d, s in enumerate(x.shape) if d != ax)
-        qf = jnp.asarray(qv, dtype=jnp.float32) / 100.0 * (n - 1)
-        lo = jnp.clip(jnp.floor(qf).astype(jnp.int32), 0, n - 1)
-        hi = jnp.clip(jnp.ceil(qf).astype(jnp.int32), 0, n - 1)
-        nq = int(np.prod(jnp.shape(qf), dtype=np.int64)) if jnp.shape(qf) else 1
-        idx = jnp.concatenate([lo.reshape(-1), hi.reshape(-1)])  # (2*nq,) tiny gather
+        # bracketing indices on the HOST when q is a host value: a host key
+        # keeps the getitem bounds check free of device round-trips (a jnp idx
+        # forces a blocking fetch per percentile call); traced q (percentile
+        # under jit) stays in jnp and getitem skips the eager check
+        xp = jnp if isinstance(qv, jax.core.Tracer) else np
+        qf = xp.asarray(qv, dtype=xp.float32) / 100.0 * (n - 1)
+        lo = xp.clip(xp.floor(qf).astype(xp.int32), 0, n - 1)
+        hi = xp.clip(xp.ceil(qf).astype(xp.int32), 0, n - 1)
+        nq = int(np.prod(np.shape(qf), dtype=np.int64)) if np.shape(qf) else 1
+        idx = xp.concatenate([lo.reshape(-1), hi.reshape(-1)])  # (2*nq,) tiny gather
         key = (slice(None),) * ax + (idx,)
         # single advanced key on the split axis: the DNDarray getitem keeps the
         # order and gathers only 2*nq rows

@@ -333,7 +333,11 @@ class SquareDiagTiles:
         elif isinstance(k, slice):
             start = k.start + prev if k.start is not None else prev
             stop = k.stop + prev if k.stop is not None else prev + loc
-            stop = stop if stop - start < loc else start + loc
+            # clamp to the device's own tile range: the reference clamps the
+            # WIDTH (stop = start + loc), which lets a mid-start over-long
+            # slice spill into the next rank's tiles — clamping the END keeps
+            # 'local' meaning local
+            stop = min(stop, prev + loc)
             key[d] = slice(start, stop)
         return tuple(key)
 

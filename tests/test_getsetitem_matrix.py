@@ -302,3 +302,24 @@ def test_multi_advanced_keys_stay_distributed():
     e = a_np.copy()
     e[i1, i2] = 99.0
     np.testing.assert_array_equal(a.numpy(), e)
+
+
+def test_traced_key_clamps_to_logical_extent():
+    # review r3: traced keys skip the eager bounds check, but on a padded
+    # split axis they must clamp at the LOGICAL end — never read pad rows
+    import jax
+    import jax.numpy as jnp
+
+    a = ht.arange(13, split=0).astype(ht.float32)  # ragged -> padded physical
+
+    def f(raw, key):
+        from heat_tpu.core.dndarray import DNDarray
+        from heat_tpu.core.communication import get_comm
+        import heat_tpu.core.devices as dv
+
+        d = DNDarray(raw, (13,), ht.float32, 0, dv.cpu, get_comm(), True)
+        return d[key].larray
+
+    out = jax.jit(f)(a.parray, jnp.array([12, 13, 50]))
+    # all out-of-bounds entries clamp to the last LOGICAL element (12.0)
+    np.testing.assert_array_equal(np.asarray(out), [12.0, 12.0, 12.0])

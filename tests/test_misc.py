@@ -336,3 +336,19 @@ def test_stride_tricks_surface():
         stride_tricks.broadcast_shapes((3, 2), (4, 2))
     assert stride_tricks.sanitize_shape(5) == (5,)
     assert stride_tricks.sanitize_shape((2, 3)) == (2, 3)
+
+
+def test_local_to_global_clamps_to_own_tiles():
+    # review r3: an over-long local slice must clamp to the device's OWN tile
+    # range, not spill into the next rank's tiles
+    from heat_tpu.core.tiling import SquareDiagTiles
+
+    p = ht.get_comm().size
+    if p < 2:
+        pytest.skip("needs a multi-device mesh")
+    a = ht.zeros((4 * p, 10), split=0)
+    t = SquareDiagTiles(a, tiles_per_proc=2)
+    g = t.local_to_global((slice(1, 99), 0), rank=0)
+    assert g[0] == slice(1, 2)  # rank 0 owns global tiles [0, 2)
+    # and the clamped request resolves on one device
+    _ = t[g]

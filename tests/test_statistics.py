@@ -290,3 +290,20 @@ def test_var_std_ddof_matrix():
             np.testing.assert_allclose(
                 ht.std(a, axis=axis).numpy(), a_np.std(axis=axis), rtol=1e-4, atol=1e-5
             )
+
+
+def test_average_per_slice_zero_weights():
+    # review r3: the zero-weight guard must follow numpy's PER-SLICE rule
+    a_np = np.arange(4.0, dtype=np.float32).reshape(2, 2)
+    a = ht.array(a_np, split=0)
+    # total sums to zero but every slice is fine -> numpy computes normally
+    w_ok = np.array([[1.0, 2.0], [-1.0, -2.0]], np.float32)
+    np.testing.assert_allclose(
+        ht.average(a, axis=1, weights=ht.array(w_ok)).numpy(),
+        np.average(a_np, axis=1, weights=w_ok),
+        rtol=1e-6,
+    )
+    # one slice sums to zero while the total does not -> numpy raises
+    w_bad = np.array([[1.0, -1.0], [1.0, 1.0]], np.float32)
+    with pytest.raises(ZeroDivisionError):
+        ht.average(a, axis=1, weights=ht.array(w_bad))
