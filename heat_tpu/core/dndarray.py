@@ -610,6 +610,25 @@ class DNDarray:
         arr = self.numpy()
         return arr.astype(dtype) if dtype is not None else arr
 
+    def __dlpack__(self, **kwargs):
+        """
+        Tensor interchange (the analog of the reference's ``__torch_proxy__``,
+        dndarray.py:86+ — there a torch-view hook, here the standard DLPack
+        protocol): ``torch.from_dlpack(dndarray)`` consumes the logical array.
+        Zero-copy for single-shard arrays; sharded arrays gather to one buffer
+        first (DLPack addresses a single contiguous tensor by design).
+        """
+        return self.__dlpack_buffer().__dlpack__(**kwargs)
+
+    def __dlpack_device__(self):
+        return self.__dlpack_buffer().__dlpack_device__()
+
+    def __dlpack_buffer(self) -> jax.Array:
+        arr = self.larray
+        if hasattr(arr, "sharding") and len(getattr(arr.sharding, "device_set", [None])) > 1:
+            arr = jax.device_put(arr, tuple(arr.sharding.device_set)[0])
+        return arr
+
     def tolist(self, keepsplit: bool = False) -> list:
         """The array as a (nested) Python list (parity: dndarray.py tolist)."""
         return self.numpy().tolist()

@@ -223,3 +223,15 @@ def test_halo_roundtrip_values():
     hn = a.halo_next
     hp = a.halo_prev
     assert hn is not None or hp is not None
+
+
+def test_dlpack_torch_interchange():
+    # the reference exposes torch interop via __torch_proxy__; here the
+    # standard DLPack protocol: torch consumes a DNDarray directly
+    import torch
+
+    a = ht.arange(6, split=0).astype(ht.float32)
+    t = torch.from_dlpack(a)
+    assert t.tolist() == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
+    t2 = torch.from_dlpack(ht.ones((2, 3)))
+    assert tuple(t2.shape) == (2, 3)
