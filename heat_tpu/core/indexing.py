@@ -16,7 +16,7 @@ from . import sanitation
 from .dndarray import DNDarray
 from . import types
 
-__all__ = ["nonzero", "where"]
+__all__ = ["count_nonzero", "nonzero", "where"]
 
 
 def nonzero(x) -> DNDarray:
@@ -49,3 +49,14 @@ def where(cond, x=None, y=None) -> DNDarray:
     if split is not None and res.ndim != cond.ndim:
         split = None
     return DNDarray(res, tuple(res.shape), types.canonical_heat_type(res.dtype), split, cond.device, cond.comm, True)
+
+
+def count_nonzero(x, axis=None, keepdims: bool = False) -> DNDarray:
+    """Number of nonzero elements along an axis (numpy-API completion; rides the
+    sharded reduce template — the neutral-element table already knows
+    ``jnp.count_nonzero``)."""
+    from . import _operations
+
+    return _operations.__reduce_op(
+        x, jnp.count_nonzero, axis=axis, keepdims=keepdims
+    )

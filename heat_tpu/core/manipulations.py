@@ -25,6 +25,7 @@ from .communication import MeshCommunication
 from .dndarray import DNDarray
 
 __all__ = [
+    "argsort",
     "balance",
     "broadcast_to",
     "column_stack",
@@ -39,6 +40,7 @@ __all__ = [
     "flipud",
     "hsplit",
     "hstack",
+    "isin",
     "moveaxis",
     "pad",
     "ravel",
@@ -49,12 +51,15 @@ __all__ = [
     "roll",
     "rot90",
     "row_stack",
+    "searchsorted",
     "shape",
     "sort",
     "split",
     "squeeze",
     "stack",
     "swapaxes",
+    "take",
+    "take_along_axis",
     "tile",
     "topk",
     "unique",
@@ -339,6 +344,67 @@ def shape(a: DNDarray) -> Tuple[int, ...]:
     """Global shape of the array (reference manipulations.py shape)."""
     sanitation.sanitize_in(a)
     return a.shape
+
+
+def argsort(a: DNDarray, axis: int = -1, descending: bool = False):
+    """Indices that would sort the array (numpy-API completion beyond the
+    reference snapshot): the index half of :func:`sort`, riding the exact-rank
+    distributed machinery along split axes."""
+    return sort(a, axis=axis, descending=descending)[1]
+
+
+def searchsorted(a: DNDarray, v, side: str = "left", sorter=None) -> DNDarray:
+    """Insertion indices keeping ``a`` sorted (numpy-API completion). ``a`` is
+    gathered (it is the small sorted haystack in typical use); ``v`` stays local."""
+    if side not in ("left", "right"):
+        raise ValueError(f"side must be 'left' or 'right', got {side!r}")
+    sanitation.sanitize_in(a)
+    vv = v.larray if isinstance(v, DNDarray) else jnp.asarray(v)
+    srt = sorter.larray if isinstance(sorter, DNDarray) else sorter
+    res = jnp.searchsorted(a.larray, vv, side=side, sorter=srt)
+    idx_t = types.default_index_type()
+    vsplit = v.split if isinstance(v, DNDarray) else None
+    return DNDarray(
+        res.astype(idx_t.jnp_type()), tuple(res.shape), idx_t, vsplit, a.device, a.comm, True
+    )
+
+
+def take(a: DNDarray, indices, axis=None) -> DNDarray:
+    """Take elements along an axis (numpy-API completion): routed through the
+    distribution-preserving advanced-indexing machinery."""
+    sanitation.sanitize_in(a)
+    idx = indices.larray if isinstance(indices, DNDarray) else indices
+    idx = np.asarray(idx) if not isinstance(idx, jnp.ndarray) else idx
+    if axis is None:
+        flat = reshape(a, (-1,) if a.ndim != 1 else a.shape)
+        return flat[idx.reshape(-1)] if np.ndim(idx) != 0 else flat[int(idx)]
+    axis = stride_tricks.sanitize_axis(a.shape, axis)
+    key = tuple([slice(None)] * axis + [idx])
+    return a[key]
+
+
+def take_along_axis(a: DNDarray, indices, axis: int) -> DNDarray:
+    """Take values along an axis using an index array of matching rank
+    (numpy-API completion; local formulation)."""
+    sanitation.sanitize_in(a)
+    idx = indices.larray if isinstance(indices, DNDarray) else jnp.asarray(indices)
+    res = jnp.take_along_axis(a.larray, idx, axis=axis)
+    split_meta = a.split if (a.split is None or int(a.split) % a.ndim != int(axis) % a.ndim) else None
+    return __wrap(a, res, split_meta)
+
+
+def isin(element: DNDarray, test_elements, invert: bool = False) -> DNDarray:
+    """Whether each element is contained in ``test_elements`` (numpy-API
+    completion; elementwise against the replicated test set)."""
+    sanitation.sanitize_in(element)
+    t = test_elements.larray if isinstance(test_elements, DNDarray) else jnp.asarray(test_elements)
+    res = jnp.isin(element.larray, t, invert=invert)
+    from . import types as _t
+
+    return DNDarray(
+        res, tuple(res.shape), _t.canonical_heat_type(res.dtype), element.split,
+        element.device, element.comm, True,
+    )
 
 
 def sort(a: DNDarray, axis: int = -1, descending: bool = False, out=None):

@@ -133,3 +133,67 @@ def test_kmeans_init_modes_converge():
         for c in centers:
             assert np.min(np.linalg.norm(got - c, axis=1)) < 1.0, (init, got)
         assert km.n_iter_ <= 50
+
+
+def test_kmedians_kmedoids_recover_blobs():
+    rng = np.random.default_rng(43)
+    centers = np.array([[8.0, 0.0], [-8.0, 0.0], [0.0, 8.0]], np.float32)
+    blobs = np.concatenate(
+        [c + rng.normal(scale=0.5, size=(50, 2)).astype(np.float32) for c in centers]
+    )
+    x = ht.array(blobs, split=0)
+    for cls, attr in (
+        (ht.cluster.KMedians, "cluster_centers_"),
+        (ht.cluster.KMedoids, "cluster_centers_"),
+    ):
+        est = cls(n_clusters=3, max_iter=60, random_state=1, init="kmeans++")
+        est.fit(x)
+        got = getattr(est, attr).numpy()
+        for c in centers:
+            assert np.min(np.linalg.norm(got - c, axis=1)) < 1.5, (cls.__name__, got)
+        labels = est.predict(x).numpy().reshape(-1)
+        # each blob is dominated by one label
+        for b in range(3):
+            seg = labels[b * 50 : (b + 1) * 50]
+            assert np.bincount(seg, minlength=3).max() >= 40, (cls.__name__, seg)
+
+
+def test_kmedoids_centers_are_data_points():
+    rng = np.random.default_rng(44)
+    x_np = rng.normal(size=(40, 3)).astype(np.float32)
+    x = ht.array(x_np, split=0)
+    km = ht.cluster.KMedoids(n_clusters=4, max_iter=30, random_state=2).fit(x)
+    centers = km.cluster_centers_.numpy()
+    for c in centers:
+        d = np.abs(x_np - c).sum(axis=1).min()
+        assert d < 1e-5, "a medoid must be an actual sample"
+
+
+def test_functional_value_and_iteration_metadata():
+    rng = np.random.default_rng(45)
+    x = ht.array(rng.normal(size=(64, 2)).astype(np.float32), split=0)
+    km = ht.cluster.KMeans(n_clusters=2, max_iter=50, tol=1e-6, random_state=3).fit(x)
+    # inertia equals the sum of squared distances to assigned centers
+    labels = km.predict(x).numpy().reshape(-1)
+    centers = km.cluster_centers_.numpy()
+    inertia_true = sum(
+        ((x.numpy()[labels == k] - centers[k]) ** 2).sum() for k in range(2)
+    )
+    assert abs(km.inertia_ - inertia_true) / max(inertia_true, 1e-9) < 1e-3
+    assert 1 <= km.n_iter_ <= 50
+
+
+def test_spectral_parameters_and_predict():
+    rng = np.random.default_rng(46)
+    a = rng.normal(size=(30, 2)).astype(np.float32) + 4
+    b = rng.normal(size=(30, 2)).astype(np.float32) - 4
+    x = ht.array(np.concatenate([a, b]), split=0)
+    sp = ht.cluster.Spectral(n_clusters=2, gamma=1.0, n_lanczos=20)
+    labels = sp.fit_predict(x).numpy().reshape(-1)
+    first, second = labels[:30], labels[30:]
+    purity = max(
+        (first == 0).mean() + (second == 1).mean(),
+        (first == 1).mean() + (second == 0).mean(),
+    ) / 2
+    assert purity > 0.9
+    assert sp.get_params()["n_clusters"] == 2

@@ -301,3 +301,47 @@ def test_reshape_across_splits():
     np.testing.assert_array_equal(ht.reshape(b, (48,)).numpy(), a_np)
     with pytest.raises((ValueError, TypeError)):
         ht.reshape(a, (7, 7))
+
+
+def test_numpy_completion_surface():
+    # argsort/searchsorted/take/take_along_axis/isin/count_nonzero (numpy-API
+    # completions; argsort rides the distributed sort along split axes)
+    rng = np.random.default_rng(77)
+    a_np = rng.normal(size=(13, 4)).astype(np.float32)
+    a = ht.array(a_np, split=0)
+    r = ht.argsort(a, axis=0)
+    np.testing.assert_array_equal(r.numpy(), np.argsort(a_np, axis=0, kind="stable"))
+    assert r.split == 0  # distributed path
+    h = ht.array(np.array([1.0, 3.0, 5.0, 7.0], np.float32))
+    for side in ("left", "right"):
+        np.testing.assert_array_equal(
+            ht.searchsorted(h, ht.array(np.array([0.0, 3.0, 8.0], np.float32)), side=side).numpy(),
+            np.searchsorted([1, 3, 5, 7], [0, 3, 8], side=side),
+        )
+    with pytest.raises(ValueError):
+        ht.searchsorted(h, h, side="middle")
+    np.testing.assert_array_equal(
+        ht.take(a, np.array([2, 0, 5]), axis=0).numpy(), np.take(a_np, [2, 0, 5], axis=0)
+    )
+    assert ht.take(a, np.array([2, 0, 5]), axis=0).split == 0
+    np.testing.assert_array_equal(
+        ht.take(a, np.array([1, 3]), axis=1).numpy(), np.take(a_np, [1, 3], axis=1)
+    )
+    np.testing.assert_array_equal(
+        ht.take(a, np.array([5, 2])).numpy(), np.take(a_np, [5, 2])
+    )
+    idx = np.argsort(a_np, axis=1)
+    np.testing.assert_array_equal(
+        ht.take_along_axis(a, idx, axis=1).numpy(), np.take_along_axis(a_np, idx, axis=1)
+    )
+    e = ht.array(np.array([1, 2, 3, 4, 5], np.int32), split=0)
+    np.testing.assert_array_equal(
+        ht.isin(e, [2, 4]).numpy(), np.isin([1, 2, 3, 4, 5], [2, 4])
+    )
+    np.testing.assert_array_equal(
+        ht.isin(e, [2, 4], invert=True).numpy(), np.isin([1, 2, 3, 4, 5], [2, 4], invert=True)
+    )
+    assert int(ht.count_nonzero(ht.array(np.array([0, 1, 0, 3]), split=0)).numpy()) == 2
+    np.testing.assert_array_equal(
+        ht.count_nonzero(a > 0, axis=0).numpy(), np.count_nonzero(a_np > 0, axis=0)
+    )

@@ -345,3 +345,52 @@ def test_relational_matrix(split):
     assert bool(ht.equal(x, x)) is True
     assert bool(ht.equal(x, y)) is False
     assert bool(ht.equal(x, ht.ones((2, 2), comm=comm))) is False
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_comparison_dunder_matrix(split):
+    a_np = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+    b_np = np.array([4.0, 2.0, 1.0, 4.0], np.float32)
+    a, b = ht.array(a_np, split=split), ht.array(b_np, split=split)
+    for op in ("__lt__", "__le__", "__gt__", "__ge__", "__eq__", "__ne__"):
+        got = getattr(a, op)(b)
+        want = getattr(a_np, op)(b_np)
+        np.testing.assert_array_equal(got.numpy(), want, err_msg=op)
+        assert got.dtype is ht.bool
+        # scalar operand both ways
+        gs = getattr(a, op)(2.0)
+        np.testing.assert_array_equal(gs.numpy(), getattr(a_np, op)(2.0), err_msg=op)
+    # reflected against numpy scalars / arrays
+    np.testing.assert_array_equal((2.0 < a).numpy(), 2.0 < a_np)
+    np.testing.assert_array_equal((b_np >= a).numpy(), b_np >= a_np)
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_int_dunder_matrix(split):
+    a_np = np.array([6, 7, 12, 3], np.int32)
+    b_np = np.array([2, 3, 5, 3], np.int32)
+    a, b = ht.array(a_np, split=split), ht.array(b_np, split=split)
+    for op in ("__and__", "__or__", "__xor__", "__lshift__", "__rshift__",
+               "__mod__", "__floordiv__"):
+        got = getattr(a, op)(b)
+        want = getattr(a_np, op)(b_np)
+        np.testing.assert_array_equal(got.numpy(), want, err_msg=op)
+    np.testing.assert_array_equal((~a).numpy(), ~a_np)
+    np.testing.assert_array_equal((-a).numpy(), -a_np)
+    np.testing.assert_array_equal((+a).numpy(), +a_np)
+    np.testing.assert_array_equal(abs(ht.array(-a_np, split=split)).numpy(), a_np)
+    # reflected integer ops
+    np.testing.assert_array_equal((10 % a).numpy(), 10 % a_np)
+    np.testing.assert_array_equal((2 ** b).numpy(), 2 ** b_np)
+
+
+def test_mixed_dtype_binary_promotion_matrix():
+    i = ht.array(np.array([1, 2, 3], np.int32), split=0)
+    f = ht.array(np.array([0.5, 1.5, 2.5], np.float32), split=0)
+    b = ht.array(np.array([True, False, True]), split=0)
+    assert (i + f).dtype is ht.float32
+    assert (b + b).dtype is ht.bool or np.issubdtype(np.dtype((b + b).dtype.char()), np.integer)
+    assert (b + i).dtype is ht.int32
+    assert (i * 2.5).dtype is ht.float32  # weak python scalar keeps array dtype class
+    assert (f + 1).dtype is ht.float32
+    np.testing.assert_allclose((i + f).numpy(), [1.5, 3.5, 5.5], rtol=1e-6)

@@ -196,3 +196,35 @@ np.save(r'{out}', np.concatenate([a, b.astype(np.float64)]))
         assert r.returncode == 0, r.stdout + r.stderr
         outs.append(np.load(out_file))
     np.testing.assert_array_equal(outs[0], outs[1])
+
+
+def test_randperm_uniformity_and_permutation_array():
+    # every permutation position must be ~uniform over many draws
+    ht.random.seed(200)
+    n, reps = 8, 300
+    counts = np.zeros((n, n), np.int64)  # counts[pos, val]
+    for _ in range(reps):
+        p = ht.random.randperm(n).numpy()
+        counts[np.arange(n), p] += 1
+    expect = reps / n
+    assert counts.min() > expect * 0.4 and counts.max() < expect * 1.8, counts
+    # permutation of a 2-D array shuffles rows, preserving row contents
+    a_np = np.arange(20.0, dtype=np.float32).reshape(5, 4)
+    perm = ht.random.permutation(ht.array(a_np, split=0))
+    pn = perm.numpy()
+    assert sorted(pn[:, 0].tolist()) == sorted(a_np[:, 0].tolist())
+    for row in pn:
+        assert row.tolist() in a_np.tolist()
+
+
+def test_state_counter_advances_per_draw():
+    ht.random.seed(5)
+    s0 = ht.random.get_state()
+    ht.random.rand(100)
+    s1 = ht.random.get_state()
+    assert s1[2] > s0[2]  # counter advanced
+    ht.random.set_state(("Threefry", 5, s0[2]))
+    a = ht.random.rand(100).numpy()
+    ht.random.set_state(("Threefry", 5, s0[2]))
+    b = ht.random.rand(100).numpy()
+    np.testing.assert_array_equal(a, b)
