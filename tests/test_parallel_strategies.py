@@ -44,8 +44,8 @@ def test_tp_2d_mesh_matmul_values():
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     devs = jax.devices()
-    if len(devs) < 4:
-        pytest.skip("needs >= 4 devices")
+    if len(devs) < 4 or len(devs) % 2 != 0:
+        pytest.skip("needs an even device count >= 4 for the 2-D mesh")
     mesh = Mesh(np.asarray(devs).reshape(2, len(devs) // 2), ("dp", "tp"))
     rng = np.random.default_rng(66)
     x_np = rng.normal(size=(8, 16)).astype(np.float32)
