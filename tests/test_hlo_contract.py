@@ -454,8 +454,9 @@ def test_daso_hierarchical_step_collectives():
             return fnn.Dense(1)(x)
 
     m = M()
-    x = jnp.ones((8, 4), jnp.float32)
-    y = jnp.ones((8, 1), jnp.float32)
+    nb = max(8, comm.size)  # batch must cover the full (node, local) mesh
+    x = jnp.ones((nb, 4), jnp.float32)
+    y = jnp.ones((nb, 1), jnp.float32)
     params = m.init(jax.random.PRNGKey(0), x)
 
     def mse(p, apply_fn, xx, yy):
