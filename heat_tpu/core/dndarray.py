@@ -615,8 +615,11 @@ class DNDarray:
         Tensor interchange (the analog of the reference's ``__torch_proxy__``,
         dndarray.py:86+ — there a torch-view hook, here the standard DLPack
         protocol): ``torch.from_dlpack(dndarray)`` consumes the logical array.
-        Zero-copy for single-shard arrays; sharded arrays gather to one buffer
-        first (DLPack addresses a single contiguous tensor by design).
+        Zero-copy for single-shard CPU/GPU arrays; sharded arrays gather to one
+        buffer first (DLPack addresses a single contiguous tensor by design),
+        and TPU-backed arrays stage through host memory (one device->host copy
+        — jax only exports DLPack capsules for CPU/GPU buffers), so
+        ``torch.from_dlpack`` works on the framework's primary platform too.
         """
         return self.__dlpack_buffer().__dlpack__(**kwargs)
 
@@ -624,9 +627,19 @@ class DNDarray:
         return self.__dlpack_buffer().__dlpack_device__()
 
     def __dlpack_buffer(self) -> jax.Array:
+        # torch.from_dlpack calls __dlpack_device__ then __dlpack__ back to
+        # back — cache the staged buffer so a sharded/TPU array is gathered
+        # and host-staged once per interchange, not twice
+        cached = getattr(self, "_DNDarray__dlpack_cache", None)
+        if cached is not None and cached[0] is self.__array:
+            return cached[1]
         arr = self.larray
         if hasattr(arr, "sharding") and len(getattr(arr.sharding, "device_set", [None])) > 1:
             arr = jax.device_put(arr, tuple(arr.sharding.device_set)[0])
+        dev = next(iter(arr.devices())) if hasattr(arr, "devices") else None
+        if dev is not None and dev.platform not in ("cpu", "gpu", "cuda", "rocm"):
+            arr = jax.device_put(arr, jax.devices("cpu")[0])
+        self.__dlpack_cache = (self.__array, arr)
         return arr
 
     def tolist(self, keepsplit: bool = False) -> list:

@@ -1,0 +1,217 @@
+"""
+Distributed determinant / inverse via blocked panel elimination over the mesh.
+
+The reference runs an *unblocked* Gauss-Jordan elimination over the split
+matrix — a Python loop over all n columns with per-element ``.item()`` host
+round-trips and row ``Bcast``s (reference heat/core/linalg/basics.py:160-423).
+The TPU-native redesign blocks the elimination at device-panel granularity so
+every step is MXU work:
+
+* the (n, n) split-0 matrix lives as p row panels of (m, n), m = n/p (the
+  padded physical layout; ragged n is embedded into the padded square
+  ``blockdiag(A, I_pad)`` whose det/inv trivially recover A's);
+* step k of p: the owner's diagonal block ``D_k`` is psum-broadcast, factored
+  locally with partially-pivoted LU (``jax.scipy.linalg.lu_factor`` — *better*
+  pivoting than the reference, which only swaps rows when a diagonal entry is
+  near zero), the scaled pivot panel ``D_k^{-1} A_k`` is psum-broadcast, and
+  every other panel applies one rank-m GEMM update;
+* ``det`` right-looks (trailing columns only) and accumulates
+  ``prod_k det(D_k)`` from the LU diagonals and pivot parities; ``inv`` runs
+  the full Gauss-Jordan on the augmented identity panels.
+
+Per-device memory stays O(n^2/p) — the full matrix is never gathered (asserted
+on compiled HLO in tests/test_hlo_contract.py). Communication per step is two
+(m, n) psums riding ICI; total volume 2·n^2 per device, the same order as one
+all-gather, but the peak live footprint is panel-sized.
+
+Pivoting is *block-local*: a singular diagonal block of a nonsingular matrix
+(the one case needing cross-panel row swaps) yields non-finite/zero results;
+the callers in ``basics.det``/``basics.inv`` detect that on the host and fall
+back to the replicated path with a warning, mirroring the QR fallback policy.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+GEMM_PRECISION = jax.lax.Precision.HIGHEST
+
+
+def can_distribute_elimination(a) -> bool:
+    """Whether det/inv take the distributed panel path: a 2-D square matrix,
+    split on rows or columns, on a real multi-device mesh, with at least one
+    logical row per device (smaller matrices gather trivially)."""
+    return (
+        a.ndim == 2
+        and a.split in (0, 1)
+        and a.comm.is_distributed()
+        and a.shape[0] >= a.comm.size
+    )
+
+
+def _block_det_sign(piv: jax.Array, m: int) -> jax.Array:
+    """Parity of a LAPACK-style ipiv vector: each ``piv[i] != i`` is one swap."""
+    swaps = jnp.sum(piv != jnp.arange(m, dtype=piv.dtype))
+    return jnp.where(swaps % 2 == 0, 1.0, -1.0)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_panel_det(mesh, axis_name: str, p: int, m: int, dtype_name: str):
+    """shard_map program: blocked right-looking LU determinant of a (p*m, p*m)
+    row-split matrix. Returns a replicated scalar."""
+    n = p * m
+    dt = jnp.dtype(dtype_name)
+
+    rdt = jnp.finfo(dt).dtype if jnp.issubdtype(dt, jnp.complexfloating) else dt
+
+    def local(a):  # (m, n) local row panel
+        idx = jax.lax.axis_index(axis_name)
+        # determinant as (unit, log|det|, bad): the raw product of n diagonal
+        # entries overflows f32 for modest n (exactly as numpy's does — the
+        # caller re-materializes unit * exp(logabs), inf and all), while the
+        # ``bad`` flag separates *block-singular pivoting failures* (zero or
+        # non-finite LU diagonals) from honest overflow/underflow
+        unit = jnp.ones((), dtype=dt)
+        logabs = jnp.zeros((), dtype=rdt)
+        bad = jnp.zeros((), dtype=bool)
+        for k in range(p):
+            c0, c1 = k * m, (k + 1) * m
+            # owner's diagonal block, broadcast to all (psum of a one-hot sum)
+            own = (idx == k).astype(dt)
+            d_blk = jax.lax.psum(own * a[:, c0:c1], axis_name)  # (m, m)
+            lu, piv = jax.scipy.linalg.lu_factor(d_blk)
+            diag = jnp.diagonal(lu)
+            absd = jnp.abs(diag)
+            bad = bad | ~jnp.all(jnp.isfinite(diag)) | jnp.any(absd == 0)
+            safe = jnp.where(absd == 0, jnp.ones((), rdt), absd)
+            unit = unit * _block_det_sign(piv, m).astype(dt) * jnp.prod(diag / safe)
+            logabs = logabs + jnp.sum(jnp.log(safe))
+            if k + 1 < p:
+                # scaled pivot panel D^{-1} A_k over the trailing columns
+                pa = jax.lax.psum(
+                    own * jax.scipy.linalg.lu_solve((lu, piv), a[:, c1:]), axis_name
+                )  # (m, n - c1)
+                f = a[:, c0:c1]  # my block column k
+                upd = a[:, c1:] - jnp.matmul(f, pa, precision=GEMM_PRECISION)
+                # panels <= k are already reduced; leave them untouched
+                a = a.at[:, c1:].set(jnp.where(idx > k, upd, a[:, c1:]))
+        return unit, logabs, bad
+
+    spec = P(axis_name, None)
+    return jax.jit(
+        jax.shard_map(
+            local, mesh=mesh, in_specs=spec, out_specs=(P(), P(), P()), check_vma=False
+        )
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _build_panel_inv(mesh, axis_name: str, p: int, m: int, dtype_name: str):
+    """shard_map program: blocked Gauss-Jordan inverse of a (p*m, p*m)
+    row-split matrix. Returns the row-split inverse."""
+    n = p * m
+    dt = jnp.dtype(dtype_name)
+
+    def panel_mm(x, y, idx):
+        """Row panel of X @ Y for row-split X, Y: SUMMA over the mesh — step k
+        psum-broadcasts Y's panel k and accumulates one (m, m) x (m, n) GEMM."""
+        acc = jnp.zeros_like(x)
+        for k in range(p):
+            own = (idx == k).astype(dt)
+            yk = jax.lax.psum(own * y, axis_name)  # (m, n)
+            acc = acc + jnp.matmul(x[:, k * m : (k + 1) * m], yk, precision=GEMM_PRECISION)
+        return acc
+
+    def local(a):  # (m, n) local row panel
+        idx = jax.lax.axis_index(axis_name)
+        a0 = a
+        # my rows of the identity: row r of panel idx is global row idx*m + r
+        rows = idx * m + jnp.arange(m)
+        eye = (rows[:, None] == jnp.arange(n)[None, :]).astype(dt)
+        b = eye
+        for k in range(p):
+            c0, c1 = k * m, (k + 1) * m
+            own = (idx == k).astype(dt)
+            d_blk = jax.lax.psum(own * a[:, c0:c1], axis_name)
+            lu_piv = jax.scipy.linalg.lu_factor(d_blk)
+            # scaled pivot panels D^{-1} [A_k | B_k], broadcast to all
+            pa = jax.lax.psum(own * jax.scipy.linalg.lu_solve(lu_piv, a), axis_name)
+            pb = jax.lax.psum(own * jax.scipy.linalg.lu_solve(lu_piv, b), axis_name)
+            f = a[:, c0:c1]
+            is_owner = idx == k
+            a = jnp.where(is_owner, pa, a - jnp.matmul(f, pa, precision=GEMM_PRECISION))
+            b = jnp.where(is_owner, pb, b - jnp.matmul(f, pb, precision=GEMM_PRECISION))
+        # one Newton (Schulz) refinement step, X <- X + X (I - A X): sequential
+        # block elimination amplifies f32 rounding ~1000x over a pivoted LU;
+        # squaring the residual wins that accuracy back for 2 extra SUMMA
+        # passes (4 n^3 / p flops per device), still gather-free
+        r = eye - panel_mm(a0, b, idx)
+        b = b + panel_mm(b, r, idx)
+        return b
+
+    spec = P(axis_name, None)
+    return jax.jit(
+        jax.shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False)
+    )
+
+
+def _embed_padded_square(a) -> Tuple[jax.Array, int, int]:
+    """
+    Physical (n', n) row panels -> padded square blockdiag(A, I) of shape
+    (n', n') with n' = p * ceil(n/p). Pure elementwise/pad ops — the SPMD
+    partitioner keeps everything panel-local. det(X) == det(A); inv(X)'s top
+    left (n, n) block is inv(A).
+    """
+    phys = a.parray  # (n', n), pad-row content unspecified
+    n = a.shape[0]
+    n_phys = phys.shape[0]
+    rows = jnp.arange(n_phys)[:, None]
+    x = jnp.where(rows < n, phys, jnp.zeros((), dtype=phys.dtype))
+    if n_phys > n:
+        x = jnp.pad(x, ((0, 0), (0, n_phys - n)))
+        cols = jnp.arange(n_phys)[None, :]
+        pad_eye = (rows == cols) & (rows >= n)
+        x = jnp.where(pad_eye, jnp.ones((), dtype=x.dtype), x)
+    return x, n, n_phys
+
+
+def distributed_det(a) -> Tuple[jax.Array, bool]:
+    """
+    Determinant of a 2-D split matrix via blocked panel LU; never gathers the
+    full operand. Returns ``(det, bad)``: ``bad`` is True when a diagonal
+    block's LU hit a zero/non-finite pivot — block-local pivoting cannot reach
+    across panels, so the caller must fall back to tell a genuinely singular
+    matrix from a pivoting failure. ``det`` overflows/underflows exactly like
+    numpy's raw-product determinant.
+    """
+    if a.split == 1:
+        from . import basics
+
+        a = basics.transpose(a)  # det(A) == det(A^T); transpose is local + remap
+    comm = a.comm
+    x, _, n_phys = _embed_padded_square(a)
+    fn = _build_panel_det(
+        comm.mesh, comm.axis_name, comm.size, n_phys // comm.size, np.dtype(x.dtype).name
+    )
+    unit, logabs, bad = fn(x)
+    return unit * jnp.exp(logabs).astype(unit.dtype), bool(bad)
+
+
+def distributed_inv(a) -> jax.Array:
+    """Inverse of a 2-D split matrix via blocked Gauss-Jordan; never gathers
+    the full operand. Returns the *logical* (n, n) inverse of ``a`` (or of
+    ``a^T`` when split=1 — the caller re-transposes). May contain non-finite
+    entries when a diagonal block is singular — callers fall back."""
+    comm = a.comm
+    x, n, n_phys = _embed_padded_square(a)
+    fn = _build_panel_inv(
+        comm.mesh, comm.axis_name, comm.size, n_phys // comm.size, np.dtype(x.dtype).name
+    )
+    out = fn(x)
+    return out[:n, :n]

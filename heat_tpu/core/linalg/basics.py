@@ -12,12 +12,14 @@ latency-hiding scheduler.
 
 from __future__ import annotations
 
+import warnings
 from typing import List, Optional, Tuple, Union
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import _elimination
 from .. import factories
 from .. import sanitation
 from .. import stride_tricks
@@ -72,15 +74,47 @@ def cross(a: DNDarray, b: DNDarray, axisa: int = -1, axisb: int = -1, axisc: int
 
 
 def det(a: DNDarray) -> DNDarray:
-    """Determinant of a square matrix (reference linalg/basics.py:160-245 does
-    distributed row-block elimination with Bcast; here jnp.linalg.det — XLA's LU)."""
+    """
+    Determinant of a square matrix (reference linalg/basics.py:160-245 runs an
+    unblocked distributed Gauss-Jordan with row Bcasts).
+
+    A 2-D matrix split on rows or columns takes the **distributed blocked-LU
+    path** (``_elimination.distributed_det``): device-panel elimination via
+    ``shard_map`` — per step one psum-broadcast diagonal block, one local
+    partially-pivoted LU, one MXU GEMM trailing update — so the full operand is
+    never gathered to one device (HLO-asserted in tests/test_hlo_contract.py).
+    Pivoting is block-local; the rare singular-diagonal-block case is detected
+    (zero/non-finite result) and falls back to the replicated ``jnp.linalg.det``
+    with a warning, like the QR fallback. Batch-split stacks partition
+    trivially along the batch axis and use the local path directly.
+    """
     sanitation.sanitize_in(a)
     if a.ndim < 2 or a.shape[-1] != a.shape[-2]:
         raise ValueError("a must be a square matrix (or batch thereof)")
     if not types.heat_type_is_inexact(a.dtype):
         a = a.astype(types.float32)
-    data = jnp.linalg.det(a.larray)
-    return DNDarray(jnp.asarray(data), tuple(jnp.shape(data)), types.canonical_heat_type(jnp.asarray(data).dtype), None, a.device, a.comm, True)
+
+    def __wrap_det(data):
+        data = jnp.asarray(data)
+        return DNDarray(
+            data, tuple(data.shape), types.canonical_heat_type(data.dtype), None, a.device, a.comm, True
+        )
+
+    if _elimination.can_distribute_elimination(a):
+        data, bad = _elimination.distributed_det(a)
+        if not bad:
+            return __wrap_det(data)
+        # a zero/non-finite LU pivot inside a diagonal block: either the matrix
+        # is genuinely singular or only that block is (block-local pivoting
+        # can't reach across panels) — only the replicated LU can tell the two
+        # apart
+        warnings.warn(
+            "distributed det hit a singular diagonal block (singular matrix or "
+            "block-pivoting failure); falling back to the replicated "
+            "determinant, which gathers the full matrix to every device",
+            UserWarning,
+        )
+    return __wrap_det(jnp.linalg.det(a.larray))
 
 
 def dot(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None) -> Union[DNDarray, float]:
@@ -105,14 +139,42 @@ def dot(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None) -> Union[DNDar
 
 
 def inv(a: DNDarray) -> DNDarray:
-    """Multiplicative inverse of a square matrix (reference linalg/basics.py:331-423
-    distributed Gauss-Jordan; here jnp.linalg.inv)."""
+    """
+    Multiplicative inverse of a square matrix (reference linalg/basics.py:331-423
+    runs an unblocked distributed Gauss-Jordan).
+
+    A 2-D matrix split on rows or columns takes the **distributed blocked
+    Gauss-Jordan path** (``_elimination.distributed_inv``): shard_map
+    device-panel elimination on the augmented identity — per step two (m, n)
+    psum-broadcasts and two MXU GEMM updates — so the full operand is never
+    gathered (HLO-asserted in tests/test_hlo_contract.py). A split=1 input is
+    inverted as ``inv(A) = inv(A^T)^T`` (transpose is a local permute + split
+    remap). Block-local pivoting: singular diagonal blocks yield non-finite
+    entries, detected on the host with a warned fallback to the replicated
+    ``jnp.linalg.inv`` — a genuinely singular matrix raises like the reference.
+    """
     sanitation.sanitize_in(a)
     if a.ndim < 2 or a.shape[-1] != a.shape[-2]:
         raise ValueError("a must be a square matrix (or batch thereof)")
     if not types.heat_type_is_inexact(a.dtype):
         a = a.astype(types.float32)
+    if _elimination.can_distribute_elimination(a):
+        if a.split == 1:
+            # inv(A) = inv(A^T)^T; transpose is a local permute + split remap,
+            # so the recursion lands on the split=0 panel path (or its fallback)
+            return transpose(inv(transpose(a)))
+        data = _elimination.distributed_inv(a)
+        if bool(jnp.all(jnp.isfinite(data))):
+            return __wrap(a, data, a.split)
+        warnings.warn(
+            "distributed inv produced non-finite entries (singular matrix or "
+            "singular diagonal block); falling back to the replicated inverse, "
+            "which gathers the full matrix to every device",
+            UserWarning,
+        )
     data = jnp.linalg.inv(a.larray)
+    if not bool(jnp.all(jnp.isfinite(data))):
+        raise RuntimeError("Inverse does not exist")
     return __wrap(a, data, a.split)
 
 

@@ -371,16 +371,25 @@ def searchsorted(a: DNDarray, v, side: str = "left", sorter=None) -> DNDarray:
 
 def take(a: DNDarray, indices, axis=None) -> DNDarray:
     """Take elements along an axis (numpy-API completion): routed through the
-    distribution-preserving advanced-indexing machinery."""
+    distribution-preserving advanced-indexing machinery. Multi-dimensional
+    index arrays gather flat and reshape back, so the result keeps numpy's
+    indices-shaped output (``a.shape[:axis] + indices.shape + a.shape[axis+1:]``)."""
     sanitation.sanitize_in(a)
     idx = indices.larray if isinstance(indices, DNDarray) else indices
     idx = np.asarray(idx) if not isinstance(idx, jnp.ndarray) else idx
+    idx_shape = tuple(np.shape(idx))
     if axis is None:
         flat = reshape(a, (-1,) if a.ndim != 1 else a.shape)
-        return flat[idx.reshape(-1)] if np.ndim(idx) != 0 else flat[int(idx)]
+        if np.ndim(idx) == 0:
+            return flat[int(idx)]
+        res = flat[idx.reshape(-1)]
+        return reshape(res, idx_shape) if len(idx_shape) != 1 else res
     axis = stride_tricks.sanitize_axis(a.shape, axis)
-    key = tuple([slice(None)] * axis + [idx])
-    return a[key]
+    key = tuple([slice(None)] * axis + [idx.reshape(-1) if np.ndim(idx) > 1 else idx])
+    res = a[key]
+    if np.ndim(idx) > 1:
+        res = reshape(res, a.shape[:axis] + idx_shape + a.shape[axis + 1 :])
+    return res
 
 
 def take_along_axis(a: DNDarray, indices, axis: int) -> DNDarray:

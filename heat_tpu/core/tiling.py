@@ -111,13 +111,13 @@ class SquareDiagTiles:
 
     def __init__(self, arr: DNDarray, tiles_per_proc: int = 2):
         if not isinstance(arr, DNDarray):
-            raise TypeError(f"arr must be a DNDarray, is currently a {type(arr)}")
+            raise TypeError(f"expected a DNDarray for arr, got {type(arr)}")
         if not isinstance(tiles_per_proc, int):
-            raise TypeError(f"tiles_per_proc must be an int, is currently a {type(tiles_per_proc)}")
+            raise TypeError(f"expected an int for tiles_per_proc, got {type(tiles_per_proc)}")
         if tiles_per_proc < 1:
-            raise ValueError(f"Tiles per process must be >= 1, currently: {tiles_per_proc}")
+            raise ValueError(f"tiles_per_proc needs at least 1 tile per device, got {tiles_per_proc}")
         if arr.ndim != 2:
-            raise ValueError(f"Arr must be 2 dimensional, current shape {arr.shape}")
+            raise ValueError(f"SquareDiagTiles needs a 2-D matrix, got shape {arr.shape}")
         self.__arr = arr
         comm = arr.comm
         size = comm.size if isinstance(comm, MeshCommunication) else 1
@@ -248,7 +248,7 @@ class SquareDiagTiles:
     def __key_bounds(self, key):
         """Resolve a tile key to global (r0, r1, c0, c1) and the owner set."""
         if not isinstance(key, (int, tuple, slice)):
-            raise TypeError(f"key must be an int, tuple, or slice, is currently {type(key)}")
+            raise TypeError(f"tile keys may be int, tuple, or slice — got {type(key)}")
         if isinstance(key, (int, slice)):
             key = (key, slice(None))
         key = tuple(key)

@@ -4,6 +4,7 @@ import numpy as np
 import pytest
 
 import heat_tpu as ht
+import heat_tpu.testing as htt
 
 SPLITS = [None, 0, 1]
 
@@ -21,14 +22,15 @@ def test_array_split(split):
     a = ht.array(data, split=split)
     assert a.split == split
     assert a.shape == (16, 2)
-    np.testing.assert_array_equal(a.numpy(), data)
+    # public helper: checks per-shard placement, not just the gathered values
+    htt.assert_array_equal(a, data)
 
 
 def test_array_is_split():
     data = np.arange(8.0)
     a = ht.array(data, is_split=0)
     assert a.split == 0
-    np.testing.assert_array_equal(a.numpy(), data)
+    htt.assert_array_equal(a, data)
 
 
 def test_array_dtype_ndmin():

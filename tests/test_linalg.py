@@ -1,6 +1,8 @@
 """Tests for linear algebra (parity model: reference
 heat/core/linalg/tests/test_{basics,qr,solver}.py)."""
 
+import warnings
+
 import numpy as np
 import pytest
 
@@ -80,6 +82,50 @@ def test_det_inv_trace():
     assert abs(ht.trace(h) - np.trace(a)) < 1e-4
     with pytest.raises(ValueError):
         ht.det(ht.ones((2, 3)))
+
+
+@pytest.mark.parametrize("n", [64, 67])  # even and ragged over the mesh
+@pytest.mark.parametrize("split", [0, 1, None])
+def test_det_inv_distributed(n, split):
+    """Split matrices run the blocked panel elimination (no full gather —
+    tests/test_hlo_contract.py pins the HLO); values must match numpy."""
+    rng = np.random.default_rng(7)
+    a = (rng.normal(size=(n, n)).astype(np.float32) + 3 * np.eye(n, dtype=np.float32)) / 2.2
+    h = ht.array(a, split=split)
+    ref64 = np.linalg.det(a.astype(np.float64))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # the distributed path must not fall back
+        d = ht.det(h)
+        iv = ht.inv(h)
+    assert d.split is None
+    np.testing.assert_allclose(float(d.larray), ref64, rtol=2e-3)
+    assert iv.split == split
+    np.testing.assert_allclose(
+        iv.numpy(), np.linalg.inv(a.astype(np.float64)), rtol=5e-3, atol=5e-4
+    )
+
+
+def test_det_inv_batched_split():
+    """Stacks split along a batch axis stay on the local (vmapped) path."""
+    rng = np.random.default_rng(8)
+    a = rng.normal(size=(8, 5, 5)).astype(np.float32) + 3 * np.eye(5, dtype=np.float32)
+    h = ht.array(a, split=0)
+    np.testing.assert_allclose(ht.det(h).numpy(), np.linalg.det(a), rtol=2e-3)
+    np.testing.assert_allclose(ht.inv(h).numpy(), np.linalg.inv(a), rtol=5e-3, atol=1e-4)
+
+
+def test_det_inv_singular_fallback():
+    """A singular matrix: det warns (block pivot hit zero) but returns 0;
+    inv raises like the reference (basics.py:331-423 'Inverse does not exist')."""
+    ones = ht.ones((32, 32), split=0)
+    if ones.comm.is_distributed():
+        with pytest.warns(UserWarning, match="falling back"):
+            d = ht.det(ones)
+    else:
+        d = ht.det(ones)
+    assert float(d.larray) == 0.0
+    with pytest.raises(RuntimeError, match="Inverse does not exist"):
+        ht.inv(ones)
 
 
 def test_norms():

@@ -4,6 +4,7 @@ import numpy as np
 import pytest
 
 import heat_tpu as ht
+import heat_tpu.testing as htt
 
 SPLITS = [None, 0, 1]
 
@@ -12,6 +13,17 @@ def _arr(split):
     rng = np.random.default_rng(2)
     a = rng.normal(size=(8, 6)).astype(np.float32)
     return ht.array(a, split=split), a
+
+
+def test_moments_func_equal_matrix():
+    """The public assert_func_equal sweep (heat_tpu.testing): every split x
+    the x64-aware dtype matrix, shard placement included."""
+    htt.assert_func_equal(
+        (7, 5), lambda x: ht.mean(x), np.mean, rtol=1e-4, atol=1e-5,
+        data_types=(np.float32,),
+    )
+    htt.assert_func_equal((9, 4), lambda x: ht.sum(x, axis=0), lambda x: np.sum(x, axis=0), rtol=1e-4, atol=1e-4)
+    htt.assert_func_equal((11,), lambda x: ht.max(x), np.max)
 
 
 @pytest.mark.parametrize("split", SPLITS)

@@ -1,0 +1,127 @@
+"""
+The public testing-utilities surface (heat_tpu/testing.py — VERDICT r3 #8,
+parity with reference heat/core/tests/test_suites/basic_test.py and its own
+test file test_suites/test_basic_test.py): the helpers must be importable from
+the installed package and must actually detect value, shape, and placement
+errors — a green helper that can't fail protects nothing.
+"""
+
+import unittest
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+import heat_tpu.testing as htt
+
+
+def test_importable_from_package():
+    # the installed-package path, not a tests/-private helper
+    import importlib
+
+    mod = importlib.import_module("heat_tpu.testing")
+    for name in mod.__all__:
+        assert hasattr(mod, name)
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+def test_assert_array_equal_passes(split):
+    a = np.arange(42, dtype=np.float32).reshape(6, 7)
+    htt.assert_array_equal(ht.array(a, split=split), a)
+
+
+@pytest.mark.parametrize("shape", [(13, 3), (8, 5), (7,)])
+def test_assert_array_equal_ragged_and_1d(shape):
+    a = np.arange(np.prod(shape), dtype=np.float32).reshape(shape)
+    htt.assert_array_equal(ht.array(a, split=0), a)
+
+
+def test_assert_array_equal_detects_value_mismatch():
+    a = np.ones((5, 4), dtype=np.float32)
+    with pytest.raises(AssertionError):
+        htt.assert_array_equal(ht.array(a, split=0), a * 2)
+
+
+def test_assert_array_equal_detects_shape_mismatch():
+    a = np.ones((5, 4), dtype=np.float32)
+    with pytest.raises(AssertionError):
+        htt.assert_array_equal(ht.array(a), np.ones((4, 5), dtype=np.float32))
+
+
+def test_assert_array_equal_rejects_non_dndarray():
+    with pytest.raises(AssertionError):
+        htt.assert_array_equal(np.ones(3), np.ones(3))
+
+
+def test_assert_func_equal_elementwise_and_reduction():
+    htt.assert_func_equal((4, 6), ht.exp, np.exp, rtol=1e-4, data_types=(np.float32,))
+    htt.assert_func_equal(
+        (9,), lambda x: ht.sum(x), np.sum, rtol=1e-4, data_types=(np.int32, np.float32)
+    )
+
+
+def test_assert_func_equal_detects_wrong_function():
+    with pytest.raises(AssertionError):
+        htt.assert_func_equal(
+            (4, 4), ht.exp, np.log1p, rtol=1e-4, data_types=(np.float32,)
+        )
+
+
+def test_assert_func_equal_for_tensor_with_args():
+    a = np.arange(24, dtype=np.float32).reshape(4, 6)
+    htt.assert_func_equal_for_tensor(
+        a,
+        lambda x, **kw: ht.sum(x, **kw),
+        np.sum,
+        heat_args={"axis": 0},
+        numpy_args={"axis": 0},
+        rtol=1e-5,
+    )
+
+
+def test_default_dtypes_x64_aware():
+    import jax
+
+    dts = htt.default_dtypes()
+    if jax.config.read("jax_enable_x64"):
+        assert np.float64 in dts and np.int64 in dts
+    else:
+        # no silently-truncating 64-bit entries on the default path
+        assert np.float64 not in dts and np.int64 not in dts
+    assert np.float32 in dts and np.int32 in dts
+
+
+def test_all_splits():
+    assert htt.all_splits(2) == (None, 0, 1)
+    assert htt.all_splits(0) == (None,)
+
+
+def test_random_array_seeded():
+    a = htt.random_array((5, 5), np.int32, seed=3)
+    b = htt.random_array((5, 5), np.int32, seed=3)
+    np.testing.assert_array_equal(a, b)
+    assert a.dtype == np.int32
+    f = htt.random_array((5, 5), np.float32, seed=3)
+    assert f.dtype == np.float32
+
+
+class TestCaseSurface(htt.TestCase):
+    """The unittest base class works as the reference's does
+    (basic_test.py:12; tested like test_suites/test_basic_test.py)."""
+
+    def test_comm_and_device(self):
+        assert self.get_size() >= 1
+        assert self.get_rank() == 0  # single controller
+        assert self.comm is not None
+        assert self.device is not None
+
+    def test_methods_delegate(self):
+        a = np.arange(12, dtype=np.float32).reshape(3, 4)
+        self.assert_array_equal(ht.array(a, split=1), a)
+        self.assert_func_equal_for_tensor(a, ht.sqrt, np.sqrt, rtol=1e-4)
+
+
+def test_testcase_runs_under_unittest():
+    suite = unittest.TestLoader().loadTestsFromTestCase(TestCaseSurface)
+    result = unittest.TextTestRunner(verbosity=0).run(suite)
+    assert result.wasSuccessful()
