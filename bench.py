@@ -151,6 +151,20 @@ def _gated_rates(run, calib_rate, bytes_per_iter, roofline_gbps, long_seconds=0.
     return valid, total, discarded
 
 
+def _perturb(eps, quantum):
+    """
+    Map a (possibly tiny) eps to a perturbation factor that SURVIVES the
+    workload's dtype rounding: ``1 + round(eps / 1e-7) * quantum``, with
+    ``quantum`` at least one representable step of the dtype near 1.0
+    (bf16 ~ 2^-7, f32 ~ 2^-18 used here with margin). The raw eps values
+    (1e-7..3e-5) round to exactly 1.0 in bf16 — and the sizing probes even in
+    f32 — which would make "perturbed" executions bit-identical and
+    replayable on the tunneled runtime (the exact artifact the eps machinery
+    exists to prevent). Distinct eps inputs stay distinct factors.
+    """
+    return 1.0 + round(eps / 1e-7) * quantum
+
+
 def _spread_pct(rates):
     """Relative inter-quartile spread (robust to a single stalled pair)."""
     if len(rates) < 2:
@@ -173,8 +187,9 @@ def bench_tpu(data_np):
     def run(iters, eps):
         # honest timing on async/remote runtimes: perturb the input so no cached
         # result can be replayed, and read the result back to host — the clock
-        # only stops when real bytes arrive
-        c2 = centers * (1.0 + eps)
+        # only stops when real bytes arrive. The perturbation is quantized to
+        # f32-representable steps (raw 1e-7-scale eps would round back to 1.0)
+        c2 = centers * np.float32(_perturb(eps, 2.0**-18))
         t0 = time.perf_counter()
         np.asarray(_kmeans_iterate(x, c2, _kmeans_step, iters))
         return time.perf_counter() - t0
@@ -298,9 +313,9 @@ def bench_matmul_mfu():
     prog_jit = jax.jit(prog, static_argnums=3)
 
     def run(steps, eps):
-        # bf16 has an 8-bit mantissa: a 1e-6 relative perturbation rounds away
-        # (identical executions could be replayed), so scale it to ~1e-2
-        scale = jnp.bfloat16(1.0 + eps * 1e4)
+        # bf16 spacing near 1.0 is 2^-8; quantize the perturbation to whole
+        # bf16 steps so every distinct eps is a distinct executed program
+        scale = jnp.bfloat16(_perturb(eps, 2.0**-7))
         t0 = time.perf_counter()
         float(prog_jit(a, b, scale, steps))
         return time.perf_counter() - t0
@@ -341,7 +356,7 @@ def bench_cdist():
     x = jax.device_put(jnp.asarray(rng.standard_normal((n, f)).astype(np.float32)), dev)
     mask = jax.device_put(jnp.asarray(rng.random((n, n)).astype(np.float32)), dev)
 
-    def prog(x, mask, eps, steps):
+    def prog(x, mask, fac, steps):
         def body(carry, _):
             s, xx = carry
             d2 = (
@@ -350,17 +365,24 @@ def bench_cdist():
                 + (xx * xx).sum(1)[None, :]
             )
             # perturb the carry so every scan step (and every call) computes
-            # fresh values — nothing can be replayed or hoisted
-            return (s + (d2 * mask).sum(), xx * (1.0 + eps * 1e-3)), None
+            # fresh values — nothing can be replayed, and the body is not
+            # loop-invariant even if the factor were constant-folded
+            return (s + (d2 * mask).sum(), xx * step_scale), None
 
-        (s, _), _ = jax.lax.scan(body, (jnp.float32(0.0), x * (1.0 + eps)), None, length=steps)
+        # per-step factor derived from the traced per-call factor: never
+        # exactly 1.0 (>= 2^-20 above it — representable in f32), distinct
+        # per call, and ~1.0028 total drift over a 1000-step leg
+        step_scale = (fac - 1.0) * 0.25 + jnp.float32(1.0 + 2.0**-20)
+        (s, _), _ = jax.lax.scan(body, (jnp.float32(0.0), x * fac), None, length=steps)
         return s
 
     prog_jit = jax.jit(prog, static_argnums=3)
 
     def run(steps, eps):
+        # f32 spacing near 1.0 is 2^-23; quantize to 2^-18 steps so the raw
+        # 1e-7-scale eps values do not round back to exactly 1.0
         t0 = time.perf_counter()
-        float(prog_jit(x, mask, jnp.float32(eps), steps))
+        float(prog_jit(x, mask, jnp.float32(_perturb(eps, 2.0**-18)), steps))
         return time.perf_counter() - t0
 
     run(2, 0.0)  # compile + warm

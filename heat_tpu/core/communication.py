@@ -635,15 +635,23 @@ def _build_collective(comm: "MeshCommunication", kind: str, split: int, ndim: in
 
         def body(b):
             g = lax.all_gather(b, ax, axis=0)  # (p, ...chunk)
-            c = cum(g)
+            is_bool = g.dtype == jax.numpy.bool_
+            # cummax/cummin reject bool; ride uint8 and restore (MPI's MAX/MIN
+            # are defined on C_BOOL — reference dtype table communication.py:130)
+            c = cum(g.astype(jax.numpy.uint8) if is_bool else g)
+            if is_bool:
+                c = c.astype(jax.numpy.bool_)
             i = lax.axis_index(ax)
             if exclusive:
                 neutral = {"sum": 0, "prod": 1}.get(op)
                 if neutral is None:  # max/min exclusive scan: use own-dtype extremes
-                    info = (
-                        jax.numpy.finfo if jax.numpy.issubdtype(b.dtype, jax.numpy.floating) else jax.numpy.iinfo
-                    )(b.dtype)
-                    neutral = info.min if op == "max" else info.max
+                    if b.dtype == jax.numpy.bool_:
+                        neutral = op == "min"
+                    else:
+                        info = (
+                            jax.numpy.finfo if jax.numpy.issubdtype(b.dtype, jax.numpy.floating) else jax.numpy.iinfo
+                        )(b.dtype)
+                        neutral = info.min if op == "max" else info.max
                 first = jax.numpy.full_like(b, neutral)
                 shifted = jax.numpy.concatenate([first[None], c[:-1]], axis=0)
                 return shifted[i]

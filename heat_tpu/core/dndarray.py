@@ -621,7 +621,12 @@ class DNDarray:
         — jax only exports DLPack capsules for CPU/GPU buffers), so
         ``torch.from_dlpack`` works on the framework's primary platform too.
         """
-        return self.__dlpack_buffer().__dlpack__(**kwargs)
+        capsule = self.__dlpack_buffer().__dlpack__(**kwargs)
+        # the capsule owns the exported buffer from here; dropping the staging
+        # cache keeps a multi-GB gathered/host copy from living as long as
+        # this DNDarray does
+        self.__dlpack_cache = None
+        return capsule
 
     def __dlpack_device__(self):
         return self.__dlpack_buffer().__dlpack_device__()
@@ -629,7 +634,8 @@ class DNDarray:
     def __dlpack_buffer(self) -> jax.Array:
         # torch.from_dlpack calls __dlpack_device__ then __dlpack__ back to
         # back — cache the staged buffer so a sharded/TPU array is gathered
-        # and host-staged once per interchange, not twice
+        # and host-staged once per interchange (cleared again when __dlpack__
+        # hands the buffer off)
         cached = getattr(self, "_DNDarray__dlpack_cache", None)
         if cached is not None and cached[0] is self.__array:
             return cached[1]

@@ -96,12 +96,45 @@ def _as_numpy(expected) -> np.ndarray:
     return np.asarray(expected)
 
 
-def assert_array_equal(heat_array: DNDarray, expected_array, rtol=1e-5, atol=1e-8) -> None:
+def _assert_dtype_matches(got_dtype, expected_dtype) -> None:
+    """
+    Float/complex widths must match the expected dtype canonicalized to the
+    active x64 mode (a silent f64->f32 truncation is the regression class the
+    x64 work targets); integer/bool widths only need the same kind, because
+    numpy's reduction promotion (``np.sum(int32) -> int64``) legitimately
+    differs from jnp's width-preserving reductions. Sub-32-bit floats
+    (bf16/f16) are exempt — comparing them against an f32/f64 oracle at a
+    widened rtol is the caller's explicit choice.
+    """
+    got = np.dtype(got_dtype)
+    exp = np.dtype(expected_dtype)
+    if got.kind in "biu" or exp.kind in "biu":
+        assert (got.kind in "biu") == (exp.kind in "biu"), (
+            f"dtype kind mismatch: got {got}, expected {exp}"
+        )
+        return
+    if got.kind in "fc":
+        if got.itemsize < 4:  # bf16/f16 vs a wider oracle: caller's choice
+            return
+        exp_canonical = exp
+        if not _x64_enabled() and exp.itemsize == 8:
+            exp_canonical = np.dtype(np.float32 if exp.kind == "f" else np.complex64)
+        assert got == exp_canonical, (
+            f"dtype mismatch: got {got}, expected {exp} "
+            f"(canonical under x64={'on' if _x64_enabled() else 'off'}: {exp_canonical})"
+        )
+
+
+def assert_array_equal(
+    heat_array: DNDarray, expected_array, rtol=1e-5, atol=1e-8, check_dtype: bool = True
+) -> None:
     """
     Assert a :class:`DNDarray` equals an expected numpy/torch array — three
     levels, mirroring reference basic_test.py:68-140:
 
-    1. metadata: type and global shape;
+    1. metadata: type, global shape, and dtype (float/complex widths must
+       match the x64-canonicalized expectation — a silent f64->f32 downcast
+       fails here, not in the rtol; disable with ``check_dtype=False``);
     2. placement: each device's addressable shard matches the corresponding
        slice of ``expected_array`` under the padded physical layout
        (``lshape_map`` geometry — the shard *content* really lives where the
@@ -115,6 +148,8 @@ def assert_array_equal(heat_array: DNDarray, expected_array, rtol=1e-5, atol=1e-
     assert tuple(heat_array.shape) == tuple(expected.shape), (
         f"global shapes do not match: {tuple(heat_array.shape)} vs {tuple(expected.shape)}"
     )
+    if check_dtype:
+        _assert_dtype_matches(heat_array.larray.dtype, expected.dtype)
     split = heat_array.split
     if split is not None and heat_array.comm.is_distributed():
         lmap = heat_array.lshape_map  # per-device logical rows (physical layout)

@@ -115,3 +115,125 @@ def test_lshape_map_consistent_with_shards():
         c = -(-13 // WORLD.size)
         for sh in a.parray.addressable_shards:
             assert sh.data.shape[0] == c
+
+
+# ---------------------------------------------------------- chunk math families
+# Reference test_communication.py:23-120 sweeps chunk offsets over dims and
+# splits; these families pin the same arithmetic for every axis and rank.
+
+
+@pytest.mark.parametrize("shape", [(12,), (7, 9), (4, 10, 6), (5, 3, 8, 2)])
+def test_chunk_every_axis_partitions(shape):
+    """chunk() covers [0, n) exactly once on every split axis of 1-D..4-D
+    shapes, with non-split extents untouched."""
+    for split in range(len(shape)):
+        prev_end = 0
+        for r in range(WORLD.size):
+            offset, lshape, slices = WORLD.chunk(shape, split, rank=r)
+            assert offset == prev_end
+            prev_end = offset + lshape[split]
+            for d in range(len(shape)):
+                if d == split:
+                    assert slices[d] == slice(offset, offset + lshape[d])
+                else:
+                    assert lshape[d] == shape[d]
+                    assert slices[d] == slice(None)
+        assert prev_end == shape[split]
+
+
+@pytest.mark.parametrize("split", [-1, -2])
+def test_chunk_negative_split(split):
+    shape = (6, 8)
+    pos = split % len(shape)
+    for r in range(WORLD.size):
+        assert WORLD.chunk(shape, split, rank=r) == WORLD.chunk(shape, pos, rank=r)
+
+
+def test_chunk_default_rank_is_zero():
+    shape = (WORLD.size * 3 + 1, 2)
+    assert WORLD.chunk(shape, 0) == WORLD.chunk(shape, 0, rank=0)
+
+
+def test_chunk_reference_remainder_spread():
+    """chunk() keeps the REFERENCE layout: the first n % p ranks carry one
+    extra row (reference communication.py:161-210) — deliberately different
+    from the padded-physical counts_displs/lshape_map geometry (see
+    PARITY.md layout-divergence note)."""
+    p = WORLD.size
+    n = 2 * p + max(1, p - 1)  # remainder of p-1 (or 1 for p == 1)
+    rem = n % p
+    sizes = [WORLD.chunk((n,), 0, rank=r)[1][0] for r in range(p)]
+    assert all(s == n // p + 1 for s in sizes[:rem])
+    assert all(s == n // p for s in sizes[rem:])
+
+
+def test_chunk_vs_counts_displs_divergence_documented():
+    """The two deliberately different geometries for the same array (ADVICE
+    r3): chunk = remainder-spread, counts_displs = padded ceil(n/p) with a
+    clamped tail. Pin both so neither silently drifts into the other."""
+    p = WORLD.size
+    if p < 2:
+        pytest.skip("identical layouts on one device")
+    n = p + 1  # maximal divergence: chunk spreads, padded clamps the tail
+    chunk_sizes = [WORLD.chunk((n,), 0, rank=r)[1][0] for r in range(p)]
+    counts, _ = WORLD.counts_displs((n,), 0)
+    c = -(-n // p)
+    assert chunk_sizes == [2] + [1] * (p - 1)
+    assert list(counts) == [max(0, min(c, n - r * c)) for r in range(p)]
+    assert sum(chunk_sizes) == sum(counts) == n
+
+
+@pytest.mark.parametrize("shape,split", [((20, 3), 0), ((3, 20), 1), ((4, 5, 6), 2)])
+def test_counts_displs_properties(shape, split):
+    counts, displs = WORLD.counts_displs(shape, split)
+    assert len(counts) == len(displs) == WORLD.size
+    assert sum(counts) == shape[split]
+    assert displs[0] == 0
+    assert all(c >= 0 for c in counts)
+    assert all(
+        displs[i + 1] == displs[i] + counts[i] for i in range(len(counts) - 1)
+    )
+
+
+def test_counts_displs_zero_tail():
+    """Axes shorter than the mesh: padded layout gives the tail devices zero
+    logical rows — counts must say so (the zero-count v-collective edge)."""
+    p = WORLD.size
+    if p < 2:
+        pytest.skip("needs a multi-device mesh")
+    counts, displs = WORLD.counts_displs((1, 4), 0)
+    assert counts[0] == 1 and all(c == 0 for c in counts[1:])
+    assert all(d == 1 for d in displs[1:])
+
+
+def test_spec_and_sharding_shapes():
+    from jax.sharding import PartitionSpec
+
+    for ndim in (1, 2, 3):
+        assert WORLD.spec(ndim, None) == PartitionSpec()
+        for split in range(ndim):
+            s = WORLD.spec(ndim, split)
+            assert len([a for a in s if a is not None]) == 1
+            assert s[split] is not None
+    sh = WORLD.sharding(2, 0)
+    assert sh.mesh.devices.size == WORLD.size
+
+
+def test_barrier_single_controller_noop():
+    # single controller: must return immediately (multi-controller behavior is
+    # exercised in tests/test_multihost.py)
+    WORLD.Barrier()
+
+
+def test_split_by_color_groups():
+    p = WORLD.size
+    if p < 4 or p % 2:
+        pytest.skip("needs an even mesh of >= 4 devices")
+    # alternating colors: device 0's color selects the even slots
+    sub = WORLD.Split(color=[i % 2 for i in range(p)])
+    assert sub.size == p // 2
+    import jax.numpy as jnp
+
+    out = sub.Allreduce(jnp.ones((sub.size, 2)), op="sum")
+    assert out.shape == (1, 2)
+    assert float(out[0, 0]) == sub.size

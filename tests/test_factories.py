@@ -102,8 +102,15 @@ def test_like_factories():
 
 
 def test_empty():
-    e = ht.empty((2, 3), dtype=ht.float64)
-    assert e.shape == (2, 3)
+    import jax
+
+    # f64 runs under real x64 — no silent truncation on the default suite
+    with jax.enable_x64(True):
+        e = ht.empty((2, 3), dtype=ht.float64)
+        assert e.shape == (2, 3)
+        assert e.larray.dtype == np.float64
+    e32 = ht.empty((4,), dtype=ht.float32)
+    assert e32.shape == (4,)
 
 
 def test_meshgrid():

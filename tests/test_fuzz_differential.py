@@ -1,0 +1,341 @@
+"""
+Differential fuzz harness vs numpy (VERDICT r3 #4).
+
+A seeded generator composes random op chains — factory -> elementwise /
+reduction / manipulation / indexing steps — over random (split, dtype,
+even/ragged shape) and checks every intermediate against a numpy shadow
+computation: values (dtype-aware tolerance), global shape, and per-shard
+placement (via ``heat_tpu.testing.assert_array_equal``, so a lying ``split``
+is caught, not just a wrong value). numpy semantics ARE the reference's
+contract — the reference API is numpy-compatible by design (SURVEY.md §2.2).
+
+* Reproducible: the chain is fully determined by its seed; a failure message
+  prints the seed and the op trace so the exact chain replays with
+  ``run_chain(seed)``.
+* Teeth: ``test_planted_numeric_bug_is_caught`` and
+  ``test_planted_metadata_bug_is_caught`` monkeypatch a deliberately wrong op
+  (a 1e-3 value skew; an off-by-one split announcement) and assert the
+  harness actually fails the chain.
+
+The default run covers ``N_CHAINS`` seeds; CI's fuzz job widens it via the
+``HEAT_TPU_FUZZ_CHAINS`` env var (ci.yaml).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+import heat_tpu.testing as htt
+from heat_tpu.core.dndarray import DNDarray
+
+N_CHAINS = int(os.environ.get("HEAT_TPU_FUZZ_CHAINS", "24"))
+OPS_PER_CHAIN = 6
+
+TOL = dict(rtol=2e-4, atol=2e-5)  # f32 chains accumulate a few ulp per step
+
+
+# --------------------------------------------------------------------- op table
+# Each op: (name, applicable?, ht_fn, np_fn). Ops receive (h, a, rng) and
+# return the new (h, a). Inapplicable ops are skipped at draw time, so any
+# seed yields a valid chain.
+
+
+def _rand_axis(a, rng):
+    return int(rng.integers(0, a.ndim)) if a.ndim else 0
+
+
+def _clip_small(a):
+    return np.clip(a, -4.0, 4.0)
+
+
+OPS = []
+
+
+def op(name, applicable=lambda a: True):
+    def deco(fn):
+        OPS.append((name, applicable, fn))
+        return fn
+
+    return deco
+
+
+# ----- elementwise unary
+@op("abs")
+def _abs(h, a, rng):
+    return ht.abs(h), np.abs(a)
+
+
+@op("neg", lambda a: a.dtype != np.bool_)
+def _neg(h, a, rng):
+    return -h, -a
+
+
+@op("exp", lambda a: a.dtype.kind == "f")
+def _exp(h, a, rng):
+    return ht.exp(ht.clip(h, -4.0, 4.0)), np.exp(_clip_small(a))
+
+
+@op("sqrt_abs", lambda a: a.dtype.kind == "f")
+def _sqrt(h, a, rng):
+    return ht.sqrt(ht.abs(h)), np.sqrt(np.abs(a))
+
+
+@op("log1p_abs", lambda a: a.dtype.kind == "f")
+def _log1p(h, a, rng):
+    return ht.log1p(ht.abs(h)), np.log1p(np.abs(a))
+
+
+@op("round", lambda a: a.dtype.kind == "f")
+def _round(h, a, rng):
+    return ht.round(h), np.round(a)
+
+
+@op("sign", lambda a: a.dtype != np.bool_)
+def _sign(h, a, rng):
+    return ht.sign(h), np.sign(a)
+
+
+# ----- elementwise binary (scalar or broadcast second operand)
+@op("add_scalar", lambda a: a.dtype != np.bool_)
+def _add_s(h, a, rng):
+    s = float(rng.integers(-3, 4))
+    if a.dtype.kind in "iu":
+        s = int(s)
+    return h + s, a + s
+
+
+@op("mul_scalar", lambda a: a.dtype != np.bool_)
+def _mul_s(h, a, rng):
+    s = int(rng.integers(1, 4))
+    return h * s, a * s
+
+
+@op("sub_self", lambda a: a.dtype != np.bool_)
+def _sub_self(h, a, rng):
+    return h - h, a - a
+
+
+@op("maximum_flip", lambda a: a.dtype != np.bool_ and a.ndim >= 1)
+def _max_flip(h, a, rng):
+    ax = _rand_axis(a, rng)
+    return ht.maximum(h, ht.flip(h, ax)), np.maximum(a, np.flip(a, ax))
+
+
+@op("compare_lt", lambda a: a.dtype != np.bool_)
+def _lt(h, a, rng):
+    return h < 1, a < 1
+
+
+# ----- reductions
+@op("sum_axis", lambda a: a.ndim >= 1 and a.dtype != np.bool_)
+def _sum(h, a, rng):
+    ax = _rand_axis(a, rng)
+    keep = bool(rng.integers(0, 2))
+    # torch-style keepdim= is the reference's spelling (arithmetics.py:946+)
+    return ht.sum(h, axis=ax, keepdim=keep), np.sum(a, axis=ax, keepdims=keep)
+
+
+@op("mean_axis", lambda a: a.ndim >= 1 and a.dtype.kind == "f")
+def _mean(h, a, rng):
+    ax = _rand_axis(a, rng)
+    return ht.mean(h, axis=ax), np.mean(a, axis=ax)
+
+
+@op("max_axis", lambda a: a.ndim >= 1 and a.dtype != np.bool_)
+def _maxax(h, a, rng):
+    ax = _rand_axis(a, rng)
+    return ht.max(h, axis=ax), np.max(a, axis=ax)
+
+
+@op("any_all", lambda a: a.ndim >= 1)
+def _any(h, a, rng):
+    if rng.integers(0, 2):
+        return ht.any(h, axis=0), np.any(a, axis=0)
+    return ht.all(h, axis=0), np.all(a, axis=0)
+
+
+@op("cumsum", lambda a: a.ndim >= 1 and a.dtype != np.bool_)
+def _cumsum(h, a, rng):
+    ax = _rand_axis(a, rng)
+    return ht.cumsum(h, axis=ax), np.cumsum(a, axis=ax)
+
+
+# ----- manipulations
+@op("transpose", lambda a: a.ndim >= 2)
+def _transpose(h, a, rng):
+    return ht.transpose(h), a.T
+
+
+@op("flip", lambda a: a.ndim >= 1)
+def _flip(h, a, rng):
+    ax = _rand_axis(a, rng)
+    return ht.flip(h, ax), np.flip(a, ax)
+
+
+@op("reshape_flat", lambda a: a.ndim >= 1 and a.size > 0)
+def _reshape(h, a, rng):
+    return ht.reshape(h, (-1,)), a.reshape(-1)
+
+
+@op("expand_squeeze", lambda a: a.ndim >= 1)
+def _expand(h, a, rng):
+    ax = int(rng.integers(0, a.ndim + 1))
+    return ht.squeeze(ht.expand_dims(h, ax), ax), a
+
+
+@op("roll", lambda a: a.ndim >= 1)
+def _roll(h, a, rng):
+    ax = _rand_axis(a, rng)
+    k = int(rng.integers(-3, 4))
+    return ht.roll(h, k, axis=ax), np.roll(a, k, axis=ax)
+
+
+@op("sort_values", lambda a: a.ndim >= 1 and a.dtype != np.bool_ and a.shape[-1] > 0)
+def _sort(h, a, rng):
+    v, _ = ht.sort(h, axis=a.ndim - 1)
+    return v, np.sort(a, axis=a.ndim - 1, kind="stable")
+
+
+@op("concat_self", lambda a: a.ndim >= 1)
+def _concat(h, a, rng):
+    ax = _rand_axis(a, rng)
+    return ht.concatenate([h, h], axis=ax), np.concatenate([a, a], axis=ax)
+
+
+# ----- indexing
+@op("slice_step", lambda a: a.ndim >= 1 and a.shape[0] >= 2)
+def _slice(h, a, rng):
+    n = a.shape[0]
+    start = int(rng.integers(0, n // 2))
+    step = int(rng.integers(1, 3))
+    return h[start::step], a[start::step]
+
+
+@op("fancy_rows", lambda a: a.ndim >= 1 and a.shape[0] >= 2)
+def _fancy(h, a, rng):
+    idx = rng.integers(0, a.shape[0], size=3)
+    return h[idx.tolist()], a[idx]
+
+
+@op("where", lambda a: a.dtype.kind == "f")
+def _where(h, a, rng):
+    return ht.where(h > 0, h, -h), np.where(a > 0, a, -a)
+
+
+# ------------------------------------------------------------------ the engine
+DTYPES = [np.float32, np.int32, np.bool_]
+
+
+def _factory(rng):
+    ndim = int(rng.integers(1, 4))
+    p = ht.WORLD.size
+    dims = []
+    for _ in range(ndim):
+        kind = rng.integers(0, 3)
+        if kind == 0:
+            dims.append(int(rng.integers(1, 4)) * p)  # even over the mesh
+        elif kind == 1:
+            dims.append(int(rng.choice([5, 7, 11, 13])))  # ragged prime
+        else:
+            dims.append(int(rng.integers(1, 9)))
+    shape = tuple(dims)
+    dtype = DTYPES[int(rng.integers(0, len(DTYPES)))]
+    if dtype == np.bool_:
+        a = rng.integers(0, 2, size=shape).astype(np.bool_)
+    elif dtype == np.int32:
+        a = rng.integers(-5, 6, size=shape).astype(np.int32)
+    else:
+        a = rng.standard_normal(shape).astype(np.float32)
+    split = [None, *range(ndim)][int(rng.integers(0, ndim + 1))]
+    return ht.array(a.copy(), split=split), a
+
+
+def _compare(h, a, trace, seed):
+    msg = f"fuzz seed={seed}, chain: {' -> '.join(trace)}"
+    if isinstance(h, DNDarray):
+        assert tuple(h.shape) == tuple(np.shape(a)), f"shape diverged; {msg}"
+        if h.split is not None:
+            assert 0 <= h.split < max(h.ndim, 1), f"invalid split metadata; {msg}"
+        try:
+            htt.assert_array_equal(h, np.asarray(a), **TOL)
+        except AssertionError as e:
+            raise AssertionError(f"{e}\n{msg}") from e
+    else:  # scalar extraction
+        np.testing.assert_allclose(np.asarray(h), np.asarray(a), err_msg=msg, **TOL)
+
+
+def run_chain(seed, n_ops=OPS_PER_CHAIN):
+    """Run one seeded chain; raises AssertionError with the seed + op trace on
+    the first divergence from numpy."""
+    rng = np.random.default_rng(seed)
+    h, a = _factory(rng)
+    trace = [f"factory{a.shape}/{a.dtype}/split={h.split}"]
+    _compare(h, a, trace, seed)
+    for _ in range(n_ops):
+        if not isinstance(h, DNDarray) or h.ndim == 0 or h.size == 0:
+            break  # chain collapsed to a scalar/empty; done
+        candidates = [(n, fn) for n, ok, fn in OPS if ok(a)]
+        name, fn = candidates[int(rng.integers(0, len(candidates)))]
+        h, a = fn(h, a, rng)
+        trace.append(name)
+        _compare(h, a, trace, seed)
+    return trace
+
+
+@pytest.mark.parametrize("seed", range(N_CHAINS))
+def test_fuzz_chain(seed):
+    run_chain(seed)
+
+
+def test_chain_is_reproducible():
+    t1 = run_chain(12345)
+    t2 = run_chain(12345)
+    assert t1 == t2
+
+
+# ------------------------------------------------------------- planted bugs
+def test_planted_numeric_bug_is_caught(monkeypatch):
+    """A 1e-3 multiplicative skew in one elementwise op must fail a chain."""
+    real_abs = ht.abs
+
+    def bad_abs(x, *args, **kw):
+        return real_abs(x, *args, **kw) * 1.001
+
+    monkeypatch.setattr(ht, "abs", bad_abs)
+    caught = 0
+    for seed in range(40):
+        try:
+            run_chain(seed)
+        except AssertionError:
+            caught += 1
+    assert caught > 0, "numeric plant survived every chain"
+
+
+def test_planted_metadata_bug_is_caught(monkeypatch):
+    """An op that lies about its result's split (claims replicated while the
+    values are one shard's worth) must fail the placement/shape checks."""
+    real_flip = ht.flip
+
+    def bad_flip(x, axis):
+        r = real_flip(x, axis)
+        if r.split is not None and r.comm.is_distributed():
+            # metadata lie: rewrap the PHYSICAL first chunk as the whole array
+            chunk = r.parray.shape[r.split] // r.comm.size
+            sl = tuple(
+                slice(0, chunk) if d == r.split else slice(None) for d in range(r.ndim)
+            )
+            return DNDarray(
+                r.parray[sl], r.shape, r.dtype, None, r.device, r.comm, True
+            )
+        return r
+
+    monkeypatch.setattr(ht, "flip", bad_flip)
+    caught = 0
+    for seed in range(40):
+        try:
+            run_chain(seed)
+        except (AssertionError, ValueError, TypeError):
+            caught += 1
+    assert caught > 0, "metadata plant survived every chain"

