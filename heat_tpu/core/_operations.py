@@ -33,6 +33,20 @@ from .dndarray import DNDarray
 __all__ = []
 
 
+def resolve_keepdims(keepdim=None, keepdims=None) -> bool:
+    """
+    Normalize the two keep-dimensions spellings every reducer accepts: the
+    reference's torch-style ``keepdim`` (arithmetics.py:860+) and numpy's
+    ``keepdims``. Explicitly conflicting values raise instead of silently
+    preferring one.
+    """
+    if keepdim is not None and keepdims is not None and bool(keepdim) != bool(keepdims):
+        raise ValueError(
+            f"conflicting keepdim={keepdim!r} and keepdims={keepdims!r}; pass one"
+        )
+    return bool(keepdim if keepdim is not None else (keepdims or False))
+
+
 def __neutral_for(partial_op: Callable, dtype) -> Optional[object]:
     """Neutral element with which pad rows are filled before ``partial_op`` reduces
     across the split axis (None = no fill known; caller falls back to the logical
@@ -189,15 +203,31 @@ def __local_op(
     x: DNDarray,
     out: Optional[DNDarray] = None,
     no_cast: bool = False,
+    force_logical: bool = False,
     **kwargs,
 ) -> DNDarray:
     """
     Generic elementwise local operation (reference _operations.py:282-355): no
     communication, split/layout of the input is retained.
+
+    ``force_logical``: compute on the logical view even when the result shape
+    would match the physical one — for ops that are shape-preserving but NOT
+    elementwise along the split axis (e.g. ``diff`` with prepend/append, whose
+    shrink+extend cancels out), where the pad rows would otherwise leak into
+    logical positions.
     """
     from .types import canonical_heat_type
 
     sanitation.sanitize_in(x)
+    if force_logical and x.is_padded:
+        result = operation(x.larray, **kwargs)
+        gshape = tuple(result.shape)
+        res_dtype = canonical_heat_type(result.dtype)
+        if out is not None:
+            sanitation.sanitize_out(out, gshape, x.split, x.device)
+            out.larray = result.astype(out.dtype.jnp_type())
+            return out
+        return DNDarray(result, gshape, res_dtype, x.split, x.device, x.comm, True)
     # compute on the physical array: elementwise ops keep the pad in the pad region
     result = operation(x.parray, **kwargs)
     if tuple(result.shape) == tuple(x.parray.shape):
@@ -270,6 +300,17 @@ def __reduce_op(
         operand = x.parray
     result = partial_op(operand, axis=axis, keepdims=keepdims, **kwargs)
     result = jnp.asarray(result)
+    if (
+        partial_op in (jnp.max, jnp.min)
+        and np.dtype(operand.dtype).kind in "fc"
+        and split_reduced
+    ):
+        # numpy/torch max/min propagate NaN; a single-device jnp reduce does
+        # too, but the SPMD partitioner's cross-shard pmax/pmin combine drops
+        # it — re-assert propagation with an explicit any-NaN pass (floats
+        # only; the pad fill is +-inf, never NaN, so the pad cannot poison it)
+        hasnan = jnp.any(jnp.isnan(operand), axis=axis, keepdims=keepdims)
+        result = jnp.where(hasnan, jnp.asarray(jnp.nan, result.dtype), result)
 
     # the logical result shape (the physical one may carry the pad through)
     if axis is None:

@@ -129,7 +129,11 @@ def diff(a, n: int = 1, axis: int = -1, prepend=None, append=None) -> DNDarray:
         kw["prepend"] = prepend.larray if isinstance(prepend, DNDarray) else prepend
     if append is not None:
         kw["append"] = append.larray if isinstance(append, DNDarray) else append
-    return _operations.__local_op(jnp.diff, a, None, n=n, axis=axis, **kw)
+    # prepend/append can cancel diff's shrink, making the result PHYSICAL-shaped
+    # while the appended values sit after the pad — force the logical view then
+    return _operations.__local_op(
+        jnp.diff, a, None, force_logical=bool(kw), n=n, axis=axis, **kw
+    )
 
 
 def div(t1, t2, out=None, where=None) -> DNDarray:
@@ -199,10 +203,10 @@ def pow(t1, t2, out=None, where=None) -> DNDarray:
 power = pow
 
 
-def prod(a, axis=None, out=None, keepdim=None) -> DNDarray:
+def prod(a, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Product of elements over the given axis (reference arithmetics.py prod →
     __reduce_op with MPI.PROD; here a sharded jnp.prod)."""
-    return _operations.__reduce_op(a, jnp.prod, axis=axis, out=out, keepdims=bool(keepdim))
+    return _operations.__reduce_op(a, jnp.prod, axis=axis, out=out, keepdims=_operations.resolve_keepdims(keepdim, keepdims))
 
 
 def hypot(t1, t2, out=None) -> DNDarray:
@@ -216,15 +220,15 @@ def copysign(t1, t2, out=None) -> DNDarray:
     return _operations.__binary_op(jnp.copysign, t1, t2, out)
 
 
-def nansum(a, axis=None, out=None, keepdim=None) -> DNDarray:
+def nansum(a, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Sum treating NaN as zero (numpy-API completion beyond the reference
     snapshot; rides the same sharded reduce template, NaN-aware neutral)."""
-    return _operations.__reduce_op(a, jnp.nansum, axis=axis, out=out, keepdims=bool(keepdim))
+    return _operations.__reduce_op(a, jnp.nansum, axis=axis, out=out, keepdims=_operations.resolve_keepdims(keepdim, keepdims))
 
 
-def nanprod(a, axis=None, out=None, keepdim=None) -> DNDarray:
+def nanprod(a, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Product treating NaN as one (numpy-API completion)."""
-    return _operations.__reduce_op(a, jnp.nanprod, axis=axis, out=out, keepdims=bool(keepdim))
+    return _operations.__reduce_op(a, jnp.nanprod, axis=axis, out=out, keepdims=_operations.resolve_keepdims(keepdim, keepdims))
 
 
 def right_shift(t1, t2) -> DNDarray:
@@ -241,10 +245,10 @@ def sub(t1, t2, out=None, where=None) -> DNDarray:
 subtract = sub
 
 
-def sum(a, axis=None, out=None, keepdim=None) -> DNDarray:
+def sum(a, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Sum of elements over the given axis (reference arithmetics.py sum →
     __reduce_op with MPI.SUM at _operations.py:441; lowers to psum over ICI here)."""
-    return _operations.__reduce_op(a, jnp.sum, axis=axis, out=out, keepdims=bool(keepdim))
+    return _operations.__reduce_op(a, jnp.sum, axis=axis, out=out, keepdims=_operations.resolve_keepdims(keepdim, keepdims))
 
 
 # ---------------------------------------------------------------------- operators

@@ -636,9 +636,14 @@ def _build_collective(comm: "MeshCommunication", kind: str, split: int, ndim: in
         def body(b):
             g = lax.all_gather(b, ax, axis=0)  # (p, ...chunk)
             is_bool = g.dtype == jax.numpy.bool_
-            # cummax/cummin reject bool; ride uint8 and restore (MPI's MAX/MIN
-            # are defined on C_BOOL — reference dtype table communication.py:130)
-            c = cum(g.astype(jax.numpy.uint8) if is_bool else g)
+            if is_bool:
+                # cummax/cummin reject bool (MPI's MAX/MIN are defined on
+                # C_BOOL — reference dtype table communication.py:130): ride
+                # uint8 there; sum/prod promote to int32 so a cumsum of >=256
+                # True chunks cannot wrap back through 0
+                carrier = jax.numpy.uint8 if op in ("max", "min") else jax.numpy.int32
+                g = g.astype(carrier)
+            c = cum(g)
             if is_bool:
                 c = c.astype(jax.numpy.bool_)
             i = lax.axis_index(ax)

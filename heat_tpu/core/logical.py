@@ -33,10 +33,10 @@ __all__ = [
 ]
 
 
-def all(x, axis=None, out=None, keepdim=None) -> DNDarray:
+def all(x, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Whether all elements evaluate to True over the given axis (reference
     logical.py all → MPI.LAND)."""
-    return _operations.__reduce_op(x, jnp.all, axis=axis, out=out, keepdims=bool(keepdim))
+    return _operations.__reduce_op(x, jnp.all, axis=axis, out=out, keepdims=_operations.resolve_keepdims(keepdim, keepdims))
 
 
 def allclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False) -> bool:
@@ -47,10 +47,10 @@ def allclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = F
     return bool(jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan))
 
 
-def any(x, axis=None, out=None, keepdim=None) -> DNDarray:
+def any(x, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Whether any element evaluates to True over the given axis (reference
     logical.py any → MPI.LOR)."""
-    return _operations.__reduce_op(x, jnp.any, axis=axis, out=out, keepdims=bool(keepdim))
+    return _operations.__reduce_op(x, jnp.any, axis=axis, out=out, keepdims=_operations.resolve_keepdims(keepdim, keepdims))
 
 
 def isclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False) -> DNDarray:

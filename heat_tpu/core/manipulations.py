@@ -120,6 +120,12 @@ def concatenate(arrays: Sequence[DNDarray], axis: int = 0) -> DNDarray:
     for a in arrays[1:]:
         if a.ndim != proto.ndim:
             raise ValueError("all input arrays must have the same number of dimensions")
+        for d in range(proto.ndim):
+            if d != axis and a.shape[d] != proto.shape[d]:
+                raise ValueError(
+                    "array shapes must match except along the concatenation axis: "
+                    f"{tuple(proto.shape)} vs {tuple(a.shape)} on axis {d}"
+                )
     out_dtype = arrays[0].dtype
     for a in arrays[1:]:
         out_dtype = types.promote_types(out_dtype, a.dtype)

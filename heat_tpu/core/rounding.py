@@ -39,11 +39,28 @@ def ceil(x, out=None) -> DNDarray:
 
 
 def clip(x, min, max, out=None) -> DNDarray:
-    """Clip values to the interval [min, max] (reference rounding.py clip)."""
+    """Clip values to the interval [min, max]; bounds may be scalars or
+    (broadcastable) arrays, DNDarrays included (reference rounding.py clip).
+    Scalar bounds keep the single fused local op (one dispatch — the common,
+    hot form); array bounds ride the binary-op template so they broadcast and
+    distribution-match exactly like any other operand."""
+    import numbers
+
     sanitation.sanitize_in(x)
     if min is None and max is None:
         raise ValueError("either min or max must be set")
-    return _operations.__local_op(jnp.clip, x, out, min=min, max=max)
+    if all(b is None or isinstance(b, numbers.Number) for b in (min, max)):
+        return _operations.__local_op(jnp.clip, x, out, min=min, max=max)
+    res = x
+    if min is not None:
+        res = _operations.__binary_op(jnp.maximum, res, min)
+    if max is not None:
+        res = _operations.__binary_op(jnp.minimum, res, max)
+    if out is not None:
+        sanitation.sanitize_out(out, res.shape, res.split, res.device)
+        out.larray = res.larray.astype(out.dtype.jnp_type())
+        return out
+    return res
 
 
 def fabs(x, out=None) -> DNDarray:

@@ -62,7 +62,7 @@ def argmax(x, axis=None, out=None, **kwargs) -> DNDarray:
     Indices of the maximum values along an axis; flattened-index result for
     ``axis=None`` (reference statistics.py argmax via the packed (value,index)
     MPI_ARGMAX op, :1218)."""
-    res = _operations.__reduce_op(x, jnp.argmax, axis=axis, out=None, keepdims=kwargs.get("keepdim", False))
+    res = _operations.__reduce_op(x, jnp.argmax, axis=axis, out=None, keepdims=_operations.resolve_keepdims(kwargs.get("keepdim"), kwargs.get("keepdims")))
     res = res.astype(types.default_index_type(), copy=False)
     if out is not None:
         sanitation.sanitize_out(out, res.shape, res.split, res.device)
@@ -73,7 +73,7 @@ def argmax(x, axis=None, out=None, **kwargs) -> DNDarray:
 
 def argmin(x, axis=None, out=None, **kwargs) -> DNDarray:
     """Indices of the minimum values along an axis (reference statistics.py argmin)."""
-    res = _operations.__reduce_op(x, jnp.argmin, axis=axis, out=None, keepdims=kwargs.get("keepdim", False))
+    res = _operations.__reduce_op(x, jnp.argmin, axis=axis, out=None, keepdims=_operations.resolve_keepdims(kwargs.get("keepdim"), kwargs.get("keepdims")))
     res = res.astype(types.default_index_type(), copy=False)
     if out is not None:
         sanitation.sanitize_out(out, res.shape, res.split, res.device)
@@ -232,9 +232,9 @@ def skew(x, axis=None, unbiased: bool = True) -> DNDarray:
     return __moment(x, axis, False, _skew)
 
 
-def max(x, axis=None, out=None, keepdim=None) -> DNDarray:
+def max(x, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Maximum along an axis (reference statistics.py max → MPI.MAX reduce)."""
-    return _operations.__reduce_op(x, jnp.max, axis=axis, out=out, keepdims=bool(keepdim))
+    return _operations.__reduce_op(x, jnp.max, axis=axis, out=out, keepdims=_operations.resolve_keepdims(keepdim, keepdims))
 
 
 def maximum(x1, x2, out=None) -> DNDarray:
@@ -242,13 +242,16 @@ def maximum(x1, x2, out=None) -> DNDarray:
     return _operations.__binary_op(jnp.maximum, x1, x2, out)
 
 
-def mean(x, axis=None, keepdims: bool = False) -> DNDarray:
+def mean(x, axis=None, keepdims: bool = False, keepdim: Optional[bool] = None) -> DNDarray:
     """
     Arithmetic mean along an axis (reference statistics.py:741-866: per-rank partial
     moments merged via Allreduce; here the sharded jnp.mean lowers to the same psum).
-    ``keepdims`` extends the reference's signature to numpy's.
+    ``keepdims`` extends the reference's signature to numpy's; the torch-style
+    ``keepdim`` spelling the neighboring reducers use (``sum``/``prod``,
+    reference arithmetics.py:860+) is accepted as an alias.
     """
-    return __moment(x, axis, keepdims, lambda a, ax: jnp.mean(a, axis=ax, keepdims=keepdims))
+    keep = _operations.resolve_keepdims(keepdim, keepdims or None)
+    return __moment(x, axis, keep, lambda a, ax: jnp.mean(a, axis=ax, keepdims=keep))
 
 
 def median(x, axis=None, keepdim: bool = False) -> DNDarray:
@@ -267,15 +270,15 @@ def median(x, axis=None, keepdim: bool = False) -> DNDarray:
     return __moment(x, axis, keepdim, _med)
 
 
-def nanmax(x, axis=None, out=None, keepdim=None) -> DNDarray:
+def nanmax(x, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Maximum ignoring NaN (numpy-API completion beyond the reference
     snapshot; same sharded reduce template)."""
-    return _operations.__reduce_op(x, jnp.nanmax, axis=axis, out=out, keepdims=bool(keepdim))
+    return _operations.__reduce_op(x, jnp.nanmax, axis=axis, out=out, keepdims=_operations.resolve_keepdims(keepdim, keepdims))
 
 
-def nanmin(x, axis=None, out=None, keepdim=None) -> DNDarray:
+def nanmin(x, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Minimum ignoring NaN (numpy-API completion)."""
-    return _operations.__reduce_op(x, jnp.nanmin, axis=axis, out=out, keepdims=bool(keepdim))
+    return _operations.__reduce_op(x, jnp.nanmin, axis=axis, out=out, keepdims=_operations.resolve_keepdims(keepdim, keepdims))
 
 
 def nanmean(x, axis=None, keepdims: bool = False) -> DNDarray:
@@ -283,9 +286,9 @@ def nanmean(x, axis=None, keepdims: bool = False) -> DNDarray:
     return __moment(x, axis, keepdims, lambda a, ax: jnp.nanmean(a, axis=ax, keepdims=keepdims))
 
 
-def min(x, axis=None, out=None, keepdim=None) -> DNDarray:
+def min(x, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
     """Minimum along an axis (reference statistics.py min → MPI.MIN reduce)."""
-    return _operations.__reduce_op(x, jnp.min, axis=axis, out=out, keepdims=bool(keepdim))
+    return _operations.__reduce_op(x, jnp.min, axis=axis, out=out, keepdims=_operations.resolve_keepdims(keepdim, keepdims))
 
 
 def minimum(x1, x2, out=None) -> DNDarray:
@@ -381,19 +384,22 @@ def percentile(x, q, axis=None, out=None, interpolation: str = "linear", keepdim
 
 def std(x, axis=None, ddof: int = 0, **kwargs) -> DNDarray:
     """Standard deviation along an axis with ``ddof`` delta degrees of freedom
-    (reference statistics.py std)."""
+    (reference statistics.py std). Accepts both ``keepdim`` (torch-style, the
+    reference's spelling) and ``keepdims`` (numpy's)."""
     if not isinstance(ddof, int) or ddof < 0:
         raise ValueError(f"ddof must be a non-negative integer, got {ddof}")
-    return __moment(x, axis, kwargs.get("keepdim", False), lambda a, ax: jnp.std(a, axis=ax, ddof=ddof))
+    keep = _operations.resolve_keepdims(kwargs.get("keepdim"), kwargs.get("keepdims"))
+    return __moment(x, axis, keep, lambda a, ax: jnp.std(a, axis=ax, ddof=ddof, keepdims=keep))
 
 
 def var(x, axis=None, ddof: int = 0, **kwargs) -> DNDarray:
     """Variance along an axis with ``ddof`` delta degrees of freedom (reference
     statistics.py:1704-1847: pairwise moment merging over Allreduce; sharded jnp.var
-    here)."""
+    here). Accepts both ``keepdim`` and ``keepdims`` spellings."""
     if not isinstance(ddof, int) or ddof < 0:
         raise ValueError(f"ddof must be a non-negative integer, got {ddof}")
-    return __moment(x, axis, kwargs.get("keepdim", False), lambda a, ax: jnp.var(a, axis=ax, ddof=ddof))
+    keep = _operations.resolve_keepdims(kwargs.get("keepdim"), kwargs.get("keepdims"))
+    return __moment(x, axis, keep, lambda a, ax: jnp.var(a, axis=ax, ddof=ddof, keepdims=keep))
 
 
 DNDarray.argmax = argmax

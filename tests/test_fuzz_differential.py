@@ -30,7 +30,11 @@ import heat_tpu as ht
 import heat_tpu.testing as htt
 from heat_tpu.core.dndarray import DNDarray
 
-N_CHAINS = int(os.environ.get("HEAT_TPU_FUZZ_CHAINS", "24"))
+from _accel import ON_ACCELERATOR
+
+# real-accelerator runs dispatch eagerly through the tunnel (~100 ms/op): keep
+# a representative slice there, full width on the CPU mesh / CI
+N_CHAINS = int(os.environ.get("HEAT_TPU_FUZZ_CHAINS", "6" if ON_ACCELERATOR else "24"))
 OPS_PER_CHAIN = 6
 
 TOL = dict(rtol=2e-4, atol=2e-5)  # f32 chains accumulate a few ulp per step
@@ -296,6 +300,15 @@ def test_chain_is_reproducible():
 
 
 # ------------------------------------------------------------- planted bugs
+# The plants prove the HARNESS catches bugs — a property of the harness, not
+# of the backend numerics; the CPU-mesh proof covers it without spending
+# ~80 tunnel-dispatched chains on the real chip.
+pytestmark_plants = pytest.mark.skipif(
+    ON_ACCELERATOR, reason="harness-teeth proof runs on the CPU mesh"
+)
+
+
+@pytestmark_plants
 def test_planted_numeric_bug_is_caught(monkeypatch):
     """A 1e-3 multiplicative skew in one elementwise op must fail a chain."""
     real_abs = ht.abs
@@ -313,6 +326,7 @@ def test_planted_numeric_bug_is_caught(monkeypatch):
     assert caught > 0, "numeric plant survived every chain"
 
 
+@pytestmark_plants
 def test_planted_metadata_bug_is_caught(monkeypatch):
     """An op that lies about its result's split (claims replicated while the
     values are one shard's worth) must fail the placement/shape checks."""

@@ -1,0 +1,281 @@
+"""
+Manipulations edge families: per-op argument sweeps over every split, modeled
+on the reference's per-op density (reference
+heat/core/tests/test_manipulations.py, 3,617 LoC — each public op gets a
+family of shape/argument/error cases at every split value). Oracles are numpy
+(the reference's API contract); sweeps run through the public
+``heat_tpu.testing`` helpers so each case also checks per-shard placement.
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+import heat_tpu.testing as htt
+
+SPLITS = [None, 0, 1]
+
+
+def _arr(split, shape=(6, 8), dtype=np.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    if np.issubdtype(dtype, np.integer):
+        a = rng.integers(0, 9, size=shape).astype(dtype)
+    else:
+        a = rng.standard_normal(shape).astype(dtype)
+    return ht.array(a.copy(), split=split), a
+
+
+# ---------------------------------------------------------------------- pad
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize(
+    "width",
+    [1, (2, 1), ((1, 2), (3, 0)), ((0, 3), (0, 0))],
+)
+def test_pad_width_forms(split, width):
+    """Scalar, per-side, and per-axis-per-side widths (reference pad family
+    manipulations.py:1128 — only edge ranks pad on the split axis)."""
+    h, a = _arr(split)
+    np.testing.assert_array_equal(
+        ht.pad(h, width).numpy(), np.pad(a, width, mode="constant")
+    )
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_pad_constant_values(split):
+    h, a = _arr(split)
+    np.testing.assert_array_equal(
+        ht.pad(h, ((1, 1), (2, 2)), constant_values=7.5).numpy(),
+        np.pad(a, ((1, 1), (2, 2)), constant_values=7.5),
+    )
+
+
+def test_pad_3d_and_errors():
+    h, a = _arr(0, shape=(4, 3, 5))
+    w = ((1, 0), (0, 2), (1, 1))
+    np.testing.assert_array_equal(ht.pad(h, w).numpy(), np.pad(a, w))
+    with pytest.raises((ValueError, NotImplementedError)):
+        ht.pad(h, ((1, 1),) * 4)
+
+
+# ------------------------------------------------------------------- repeat
+@pytest.mark.parametrize("split", SPLITS)
+def test_repeat_forms(split):
+    h, a = _arr(split, shape=(4, 5))
+    np.testing.assert_array_equal(ht.repeat(h, 3).numpy(), np.repeat(a, 3))
+    np.testing.assert_array_equal(ht.repeat(h, 2, axis=0).numpy(), np.repeat(a, 2, axis=0))
+    np.testing.assert_array_equal(ht.repeat(h, 2, axis=1).numpy(), np.repeat(a, 2, axis=1))
+
+
+def test_repeat_array_repeats():
+    h, a = _arr(None, shape=(4,))
+    reps = [1, 0, 2, 3]
+    np.testing.assert_array_equal(ht.repeat(h, reps).numpy(), np.repeat(a, reps))
+
+
+# --------------------------------------------------------------------- tile
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("reps", [2, (2, 1), (1, 3), (2, 1, 2)])
+def test_tile_reps_forms(split, reps):
+    """Including reps longer than ndim (numpy prepends axes)."""
+    h, a = _arr(split, shape=(3, 4))
+    np.testing.assert_array_equal(ht.tile(h, reps).numpy(), np.tile(a, reps))
+
+
+# -------------------------------------------------------------------- rot90
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("k", [0, 1, 2, 3, 4, -1])
+def test_rot90_k_sweep(split, k):
+    h, a = _arr(split, shape=(3, 5))
+    np.testing.assert_array_equal(ht.rot90(h, k).numpy(), np.rot90(a, k))
+
+
+def test_rot90_axes_and_errors():
+    h, a = _arr(0, shape=(3, 4, 5))
+    np.testing.assert_array_equal(
+        ht.rot90(h, 1, axes=(1, 2)).numpy(), np.rot90(a, 1, axes=(1, 2))
+    )
+    with pytest.raises(ValueError):
+        ht.rot90(h, 1, axes=(0, 0))
+
+
+# ------------------------------------------------------------ diag/diagonal
+@pytest.mark.parametrize("offset", [-2, -1, 0, 1, 3])
+def test_diag_both_directions(offset):
+    v, av = _arr(0, shape=(5,))
+    np.testing.assert_array_equal(ht.diag(v, offset).numpy(), np.diag(av, offset))
+    m, am = _arr(0, shape=(5, 6))
+    np.testing.assert_array_equal(ht.diag(m, offset).numpy(), np.diag(am, offset))
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_diagonal_dims(split):
+    h, a = _arr(split, shape=(4, 5))
+    for off in (-1, 0, 2):
+        np.testing.assert_array_equal(
+            ht.diagonal(h, off).numpy(), np.diagonal(a, off)
+        )
+    h3, a3 = _arr(0, shape=(3, 4, 5))
+    np.testing.assert_array_equal(
+        ht.diagonal(h3, 0, 1, 2).numpy(), np.diagonal(a3, 0, 1, 2)
+    )
+
+
+# ----------------------------------------------------- split family + stack
+@pytest.mark.parametrize("split", SPLITS)
+def test_split_by_count_and_indices(split):
+    h, a = _arr(split, shape=(6, 8))
+    for got, exp in zip(ht.split(h, 3, axis=0), np.split(a, 3, axis=0)):
+        np.testing.assert_array_equal(got.numpy(), exp)
+    for got, exp in zip(ht.split(h, [2, 5], axis=1), np.split(a, [2, 5], axis=1)):
+        np.testing.assert_array_equal(got.numpy(), exp)
+    with pytest.raises(ValueError):
+        ht.split(h, 4, axis=0)  # 6 rows not divisible by 4
+
+
+def test_dsplit_hsplit_vsplit():
+    h, a = _arr(0, shape=(4, 6, 8))
+    for fn, nfn, arg in (
+        (ht.dsplit, np.dsplit, 2),
+        (ht.hsplit, np.hsplit, 3),
+        (ht.vsplit, np.vsplit, 2),
+    ):
+        for got, exp in zip(fn(h, arg), nfn(a, arg)):
+            np.testing.assert_array_equal(got.numpy(), exp)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_stack_family(split):
+    h1, a1 = _arr(split, seed=1)
+    h2, a2 = _arr(split, seed=2)
+    np.testing.assert_array_equal(ht.stack([h1, h2]).numpy(), np.stack([a1, a2]))
+    np.testing.assert_array_equal(
+        ht.stack([h1, h2], axis=2).numpy(), np.stack([a1, a2], axis=2)
+    )
+    np.testing.assert_array_equal(ht.hstack([h1, h2]).numpy(), np.hstack([a1, a2]))
+    np.testing.assert_array_equal(ht.vstack([h1, h2]).numpy(), np.vstack([a1, a2]))
+    np.testing.assert_array_equal(
+        ht.column_stack([h1, h2]).numpy(), np.column_stack([a1, a2])
+    )
+    np.testing.assert_array_equal(ht.row_stack([h1, h2]).numpy(), np.vstack([a1, a2]))
+
+
+def test_stack_1d_edge():
+    v1, a1 = _arr(0, shape=(7,), seed=3)
+    v2, a2 = _arr(0, shape=(7,), seed=4)
+    np.testing.assert_array_equal(
+        ht.column_stack([v1, v2]).numpy(), np.column_stack([a1, a2])
+    )
+    np.testing.assert_array_equal(ht.vstack([v1, v2]).numpy(), np.vstack([a1, a2]))
+
+
+def test_concatenate_promotes_dtype():
+    f, af = _arr(0, dtype=np.float32, seed=5)
+    i, ai = _arr(0, dtype=np.int32, seed=6)
+    got = ht.concatenate([f, i], axis=0)
+    assert got.dtype == ht.float32
+    np.testing.assert_allclose(got.numpy(), np.concatenate([af, ai.astype(np.float32)], 0))
+    with pytest.raises(ValueError):
+        ht.concatenate([f, ht.ones((3, 3))], axis=0)
+
+
+# --------------------------------------------------- axis moves and squeeze
+@pytest.mark.parametrize("split", SPLITS)
+def test_moveaxis_swapaxes(split):
+    h, a = _arr(split, shape=(3, 4, 5) if split != 1 else (3, 4, 5))
+    np.testing.assert_array_equal(
+        ht.moveaxis(h, 0, 2).numpy(), np.moveaxis(a, 0, 2)
+    )
+    np.testing.assert_array_equal(
+        ht.moveaxis(h, [0, 1], [1, 0]).numpy(), np.moveaxis(a, [0, 1], [1, 0])
+    )
+    np.testing.assert_array_equal(ht.swapaxes(h, 0, 2).numpy(), np.swapaxes(a, 0, 2))
+
+
+def test_squeeze_errors_on_non_unit_axis():
+    h, a = _arr(0, shape=(4, 1, 5))
+    np.testing.assert_array_equal(ht.squeeze(h, 1).numpy(), np.squeeze(a, 1))
+    np.testing.assert_array_equal(ht.squeeze(h).numpy(), np.squeeze(a))
+    with pytest.raises(ValueError):
+        ht.squeeze(h, 0)
+
+
+# ---------------------------------------------------------- roll multi-axis
+@pytest.mark.parametrize("split", SPLITS)
+def test_roll_forms(split):
+    h, a = _arr(split)
+    np.testing.assert_array_equal(ht.roll(h, 3).numpy(), np.roll(a, 3))
+    np.testing.assert_array_equal(
+        ht.roll(h, (2, -1), axis=(0, 1)).numpy(), np.roll(a, (2, -1), axis=(0, 1))
+    )
+    np.testing.assert_array_equal(
+        ht.roll(h, -7, axis=0).numpy(), np.roll(a, -7, axis=0)
+    )
+
+
+# ----------------------------------------------------------- reshape depth
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("shape", [(12, 4), (4, 12), (2, 24), (48,), (2, 2, 12)])
+def test_reshape_shapes(split, shape):
+    h, a = _arr(split, shape=(6, 8))
+    np.testing.assert_array_equal(ht.reshape(h, shape).numpy(), a.reshape(shape))
+
+
+def test_reshape_minus_one_and_new_split():
+    h, a = _arr(0, shape=(6, 8))
+    np.testing.assert_array_equal(ht.reshape(h, (-1, 16)).numpy(), a.reshape(-1, 16))
+    r = ht.reshape(h, (12, 4), new_split=1)
+    assert r.split == 1
+    np.testing.assert_array_equal(r.numpy(), a.reshape(12, 4))
+    with pytest.raises((ValueError, TypeError)):
+        ht.reshape(h, (7, 7))
+
+
+# ---------------------------------------------------------- unique breadth
+@pytest.mark.parametrize("split", [None, 0])
+def test_unique_inverse_roundtrip(split):
+    a = np.array([3, 1, 3, 2, 1, 1, 9, 2], np.float32)
+    h = ht.array(a, split=split)
+    u = ht.unique(h, sorted=True)
+    np.testing.assert_array_equal(np.sort(u.numpy()), np.unique(a))
+    u2, inv = ht.unique(h, sorted=True, return_inverse=True)
+    np.testing.assert_array_equal(u2.numpy()[inv.numpy()], a)  # the defining property
+
+
+def test_unique_axis():
+    a = np.array([[1, 2], [3, 4], [1, 2], [3, 4], [5, 6]], np.float32)
+    h = ht.array(a, split=0)
+    u = ht.unique(h, sorted=True, axis=0)
+    np.testing.assert_array_equal(
+        np.sort(u.numpy(), axis=0), np.unique(a, axis=0)
+    )
+
+
+# ------------------------------------------------------------- topk breadth
+@pytest.mark.parametrize("split", [None, 0, 1])
+@pytest.mark.parametrize("largest", [True, False])
+def test_topk_both_directions(split, largest):
+    h, a = _arr(split, shape=(6, 9), seed=7)
+    v, i = ht.topk(h, 3, dim=1, largest=largest)
+    exp = np.sort(a, axis=1)[:, ::-1][:, :3] if largest else np.sort(a, axis=1)[:, :3]
+    np.testing.assert_allclose(v.numpy(), exp, rtol=1e-6)
+    np.testing.assert_allclose(np.take_along_axis(a, i.numpy(), 1), exp, rtol=1e-6)
+
+
+# ----------------------------------------------------- flip family breadth
+@pytest.mark.parametrize("split", SPLITS)
+def test_flip_family(split):
+    h, a = _arr(split)
+    np.testing.assert_array_equal(ht.fliplr(h).numpy(), np.fliplr(a))
+    np.testing.assert_array_equal(ht.flipud(h).numpy(), np.flipud(a))
+    np.testing.assert_array_equal(ht.flip(h, (0, 1)).numpy(), np.flip(a, (0, 1)))
+
+
+# -------------------------------------------------------- expand_dims sweep
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("axis", [0, 1, 2, -1])
+def test_expand_dims_sweep(split, axis):
+    h, a = _arr(split)
+    got = ht.expand_dims(h, axis)
+    np.testing.assert_array_equal(got.numpy(), np.expand_dims(a, axis))
+    if split is not None:
+        assert got.split is not None  # distribution survives the new axis

@@ -1,0 +1,237 @@
+"""
+Operator-semantics families: the argument conventions that differ between
+implementations and therefore need pinning — sign of mod vs fmod, floordiv on
+negatives, diff's prepend/append, round's half-even ties, clip forms, modf's
+pair, allclose/isclose NaN handling, `equal`'s scalar-AND contract (reference
+heat/core/tests/{test_arithmetics, test_rounding, test_logical,
+test_relational}.py families). numpy is the oracle throughout, at every split.
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+SPLITS = [None, 0]
+
+
+# ----------------------------------------------------------- mod / fmod signs
+@pytest.mark.parametrize("split", SPLITS)
+def test_mod_follows_divisor_sign(split):
+    """mod/remainder: numpy semantics (result has the divisor's sign);
+    fmod: C semantics (result has the dividend's sign) — the reference keeps
+    both (arithmetics.py mod/fmod/remainder)."""
+    a = np.array([7, -7, 7, -7, 0, 5], np.float32)
+    b = np.array([3, 3, -3, -3, 3, -2], np.float32)
+    ha, hb = ht.array(a, split=split), ht.array(b, split=split)
+    np.testing.assert_allclose(ht.mod(ha, hb).numpy(), np.mod(a, b), rtol=1e-6)
+    np.testing.assert_allclose(ht.remainder(ha, hb).numpy(), np.remainder(a, b), rtol=1e-6)
+    np.testing.assert_allclose(ht.fmod(ha, hb).numpy(), np.fmod(a, b), rtol=1e-6)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_floordiv_negatives(split):
+    a = np.array([7, -7, 7, -7, 1], np.float32)
+    b = np.array([2, 2, -2, -2, 3], np.float32)
+    ha, hb = ht.array(a, split=split), ht.array(b, split=split)
+    np.testing.assert_allclose(ht.floordiv(ha, hb).numpy(), np.floor_divide(a, b), rtol=1e-6)
+    np.testing.assert_allclose((ha // hb).numpy(), a // b, rtol=1e-6)
+
+
+def test_integer_mod_matches_numpy():
+    a = np.array([7, -7, 7, -7], np.int32)
+    b = np.array([3, 3, -3, -3], np.int32)
+    np.testing.assert_array_equal(
+        ht.mod(ht.array(a, split=0), ht.array(b, split=0)).numpy(), np.mod(a, b)
+    )
+
+
+# ------------------------------------------------------------------- diff
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("n", [1, 2, 3])
+def test_diff_orders(split, n):
+    """Higher-order diffs along the split axis cross shard boundaries — the
+    reference sends boundary rows between neighbors (arithmetics.py diff)."""
+    a = np.cumsum(np.arange(16, dtype=np.float32) % 5).reshape(8, 2)
+    h = ht.array(a, split=split)
+    np.testing.assert_allclose(ht.diff(h, n=n, axis=0).numpy(), np.diff(a, n=n, axis=0), rtol=1e-5)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_diff_prepend_append(split):
+    a = np.arange(12, dtype=np.float32).reshape(6, 2) ** 2
+    h = ht.array(a, split=split)
+    np.testing.assert_allclose(
+        ht.diff(h, axis=0, prepend=0).numpy(), np.diff(a, axis=0, prepend=0), rtol=1e-6
+    )
+    app = np.full((1, 2), 7.0, np.float32)
+    np.testing.assert_allclose(
+        ht.diff(h, axis=0, append=app).numpy(), np.diff(a, axis=0, append=app), rtol=1e-6
+    )
+
+
+# ---------------------------------------------------------------- rounding
+@pytest.mark.parametrize("split", SPLITS)
+def test_round_half_even_and_decimals(split):
+    a = np.array([0.5, 1.5, 2.5, -0.5, -1.5, 2.675, -2.675, 3.14159], np.float32)
+    h = ht.array(a, split=split)
+    np.testing.assert_allclose(ht.round(h).numpy(), np.round(a), rtol=1e-6)
+    np.testing.assert_allclose(ht.round(h, 2).numpy(), np.round(a, 2), atol=1e-6)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_clip_forms(split):
+    a = np.linspace(-5, 5, 12).astype(np.float32)
+    h = ht.array(a, split=split)
+    np.testing.assert_allclose(ht.clip(h, -2, 2).numpy(), np.clip(a, -2, 2), rtol=1e-6)
+    lo = np.full_like(a, -1.0)
+    np.testing.assert_allclose(
+        ht.clip(h, ht.array(lo, split=split), 3).numpy(), np.clip(a, lo, 3), rtol=1e-6
+    )
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_modf_pair(split):
+    a = np.array([1.75, -1.75, 0.0, 3.5, -0.25], np.float32)
+    h = ht.array(a, split=split)
+    frac, integ = ht.modf(h)
+    nf, ni = np.modf(a)
+    np.testing.assert_allclose(frac.numpy(), nf, rtol=1e-6)
+    np.testing.assert_allclose(integ.numpy(), ni, rtol=1e-6)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_trunc_floor_ceil_negatives(split):
+    a = np.array([1.7, -1.7, 2.5, -2.5, 0.0], np.float32)
+    h = ht.array(a, split=split)
+    np.testing.assert_array_equal(ht.trunc(h).numpy(), np.trunc(a))
+    np.testing.assert_array_equal(ht.floor(h).numpy(), np.floor(a))
+    np.testing.assert_array_equal(ht.ceil(h).numpy(), np.ceil(a))
+    np.testing.assert_array_equal(ht.sign(h).numpy(), np.sign(a))
+
+
+# ------------------------------------------------------- allclose / isclose
+@pytest.mark.parametrize("split", SPLITS)
+def test_isclose_nan_handling(split):
+    a = np.array([1.0, np.nan, np.inf, 1.0], np.float32)
+    b = np.array([1.0 + 1e-9, np.nan, np.inf, 2.0], np.float32)
+    ha, hb = ht.array(a, split=split), ht.array(b, split=split)
+    np.testing.assert_array_equal(ht.isclose(ha, hb).numpy(), np.isclose(a, b))
+    np.testing.assert_array_equal(
+        ht.isclose(ha, hb, equal_nan=True).numpy(), np.isclose(a, b, equal_nan=True)
+    )
+    assert ht.allclose(ha, hb) is False
+    assert ht.allclose(ha, ha, equal_nan=True) is True
+    assert ht.allclose(ha, ha) is False  # nan != nan by default
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_isclose_tolerances(split):
+    a = np.array([1.0, 100.0], np.float32)
+    b = np.array([1.001, 100.1], np.float32)
+    ha, hb = ht.array(a, split=split), ht.array(b, split=split)
+    np.testing.assert_array_equal(
+        ht.isclose(ha, hb, rtol=1e-2).numpy(), np.isclose(a, b, rtol=1e-2)
+    )
+    np.testing.assert_array_equal(
+        ht.isclose(ha, hb, rtol=0, atol=0.05).numpy(), np.isclose(a, b, rtol=0, atol=0.05)
+    )
+
+
+# ------------------------------------------------------------------ equal
+@pytest.mark.parametrize("split", SPLITS)
+def test_equal_scalar_and(split):
+    """`ht.equal` returns ONE python bool — the global AND (the reference
+    allreduces a scalar AND, relational.py equal)."""
+    a = np.arange(12, dtype=np.float32)
+    h = ht.array(a, split=split)
+    assert ht.equal(h, ht.array(a.copy(), split=split)) is True
+    b = a.copy()
+    b[-1] += 1
+    assert ht.equal(h, ht.array(b, split=split)) is False
+    assert ht.equal(h, h) is True
+
+
+# -------------------------------------------------------- nan propagation
+@pytest.mark.parametrize("split", SPLITS)
+def test_nan_propagation_reductions(split):
+    a = np.array([1.0, np.nan, 3.0, 4.0], np.float32)
+    h = ht.array(a, split=split)
+    assert np.isnan(float(ht.sum(h).larray))
+    assert np.isnan(float(ht.max(h).larray))
+    np.testing.assert_allclose(float(ht.nansum(h).larray), np.nansum(a), rtol=1e-6)
+    np.testing.assert_array_equal(ht.isnan(h).numpy(), np.isnan(a))
+    np.testing.assert_array_equal(ht.isfinite(h).numpy(), np.isfinite(a))
+
+
+# ---------------------------------------------------------- bitwise/shift
+@pytest.mark.parametrize("split", SPLITS)
+def test_bitwise_family(split):
+    a = np.array([0b1100, 0b1010, 0b0001, 0b1111], np.int32)
+    b = np.array([0b1010, 0b0110, 0b0001, 0b0000], np.int32)
+    ha, hb = ht.array(a, split=split), ht.array(b, split=split)
+    np.testing.assert_array_equal(ht.bitwise_and(ha, hb).numpy(), a & b)
+    np.testing.assert_array_equal(ht.bitwise_or(ha, hb).numpy(), a | b)
+    np.testing.assert_array_equal(ht.bitwise_xor(ha, hb).numpy(), a ^ b)
+    np.testing.assert_array_equal(ht.invert(ha).numpy(), ~a)
+    np.testing.assert_array_equal(ht.left_shift(ha, 2).numpy(), a << 2)
+    np.testing.assert_array_equal(ht.right_shift(ha, 1).numpy(), a >> 1)
+    with pytest.raises(TypeError):
+        ht.bitwise_and(ht.array(a.astype(np.float32)), hb)
+
+
+# ----------------------------------------------------------- pow semantics
+@pytest.mark.parametrize("split", SPLITS)
+def test_pow_edge_values(split):
+    a = np.array([2.0, -2.0, 0.0, 4.0], np.float32)
+    h = ht.array(a, split=split)
+    np.testing.assert_allclose((h**2).numpy(), a**2, rtol=1e-6)
+    np.testing.assert_allclose((h**0).numpy(), a**0, rtol=1e-6)
+    np.testing.assert_allclose((2.0**h).numpy(), 2.0**a, rtol=1e-5)
+    np.testing.assert_allclose(ht.pow(h, 0.5).numpy(), a**0.5, rtol=1e-5, equal_nan=True)
+
+
+# ------------------------------------------- keepdim/keepdims normalization
+@pytest.mark.parametrize("split", SPLITS)
+def test_keepdims_spellings_everywhere(split):
+    """Every reducer accepts BOTH the torch-style keepdim (the reference's
+    spelling) and numpy's keepdims, with identical results — and std/var
+    really keep the dim (r4 review: they silently dropped it)."""
+    a = np.random.default_rng(0).standard_normal((6, 4)).astype(np.float32)
+    h = ht.array(a, split=split)
+    cases = [
+        (ht.sum, np.sum, {}),
+        (ht.prod, np.prod, {}),
+        (ht.max, np.max, {}),
+        (ht.min, np.min, {}),
+        (ht.mean, np.mean, {}),
+        (ht.any, np.any, {}),
+        (ht.all, np.all, {}),
+        (ht.std, lambda x, **kw: x.std(**kw), {}),
+        (ht.var, lambda x, **kw: x.var(**kw), {}),
+    ]
+    for fn, nfn, extra in cases:
+        for spelled in ({"keepdim": True}, {"keepdims": True}):
+            got = fn(h, axis=0, **spelled, **extra)
+            exp = nfn(a, axis=0, keepdims=True)
+            assert tuple(got.shape) == tuple(np.shape(exp)), (fn.__name__, spelled)
+            np.testing.assert_allclose(
+                got.numpy().astype(np.float64), np.asarray(exp, np.float64),
+                rtol=1e-4, atol=1e-5, err_msg=f"{fn.__name__} {spelled}",
+            )
+
+
+def test_keepdims_conflict_raises():
+    h = ht.ones((4, 3), split=0)
+    with pytest.raises(ValueError, match="conflicting"):
+        ht.sum(h, axis=0, keepdim=True, keepdims=False)
+
+
+def test_std_var_keepdims_split_metadata():
+    """keepdims reductions over a non-split axis keep a VALID split index."""
+    h = ht.ones((3, 8), split=1)
+    r = ht.std(h, axis=0, keepdims=True)
+    assert tuple(r.shape) == (1, 8)
+    assert r.split in (None, 1)
+    if r.split is not None:
+        assert 0 <= r.split < r.ndim

@@ -1,0 +1,227 @@
+"""
+Statistics edge families: per-op argument sweeps over every split, modeled on
+the reference's density (reference heat/core/tests/test_statistics.py,
+1,347 LoC — interpolation modes, weighted averages, moment corrections, tie
+handling, keepdim shapes). Oracles are numpy/scipy-free closed forms.
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+SPLITS = [None, 0, 1]
+
+
+def _arr(split, shape=(8, 6), seed=0):
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal(shape).astype(np.float32)
+    return ht.array(a.copy(), split=split), a
+
+
+# ---------------------------------------------------------------- percentile
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize(
+    "interp", ["linear", "lower", "higher", "nearest", "midpoint"]
+)
+def test_percentile_interpolations(split, interp):
+    """All five interpolation modes of the reference percentile
+    (statistics.py:1256+) at every split."""
+    h, a = _arr(split, shape=(13, 5))
+    for q in (0, 25, 50.0, 90, 100):
+        got = ht.percentile(h, q, interpolation=interp)
+        exp = np.percentile(a.astype(np.float64), q, method=interp)
+        np.testing.assert_allclose(np.asarray(got.larray), exp, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("axis", [0, 1])
+def test_percentile_axis_and_vector_q(split, axis):
+    h, a = _arr(split, shape=(9, 7), seed=1)
+    q = [10, 50, 75]
+    got = ht.percentile(h, q, axis=axis)
+    exp = np.percentile(a.astype(np.float64), q, axis=axis)
+    np.testing.assert_allclose(got.numpy(), exp, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_percentile_keepdim(split):
+    h, a = _arr(split, shape=(12, 4), seed=2)
+    got = ht.percentile(h, 50, axis=0, keepdim=True)
+    assert tuple(got.shape) == (1, 4)
+    np.testing.assert_allclose(
+        got.numpy(), np.percentile(a.astype(np.float64), 50, axis=0, keepdims=True),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+# -------------------------------------------------------------------- median
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("n", [9, 10])  # odd and even counts
+def test_median_parity(split, n):
+    h, a = _arr(split, shape=(n, 4), seed=3)
+    np.testing.assert_allclose(
+        ht.median(h, axis=0).numpy(), np.median(a.astype(np.float64), axis=0),
+        rtol=1e-5, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(ht.median(h).larray), np.median(a.astype(np.float64)),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+# ------------------------------------------------------------------- average
+@pytest.mark.parametrize("split", SPLITS)
+def test_average_weighted_and_returned(split):
+    """Weighted average + the (average, sum_of_weights) tuple form (reference
+    statistics.py average family)."""
+    h, a = _arr(split, shape=(6, 5), seed=4)
+    w = np.abs(np.random.default_rng(5).standard_normal(6)).astype(np.float32) + 0.1
+    hw = ht.array(w.copy())
+    got = ht.average(h, axis=0, weights=hw)
+    np.testing.assert_allclose(got.numpy(), np.average(a, axis=0, weights=w), rtol=1e-5)
+    avg, sow = ht.average(h, axis=0, weights=hw, returned=True)
+    np.testing.assert_allclose(np.asarray(sow.larray).ravel()[0], w.sum(), rtol=1e-6)
+    np.testing.assert_allclose(ht.average(h).larray, np.average(a), rtol=1e-5, atol=1e-6)
+
+
+def test_average_errors():
+    h, _ = _arr(0)
+    with pytest.raises((ValueError, TypeError)):
+        ht.average(h, axis=0, weights=ht.ones(3))  # wrong weight length
+
+
+# ------------------------------------------------------------------ bincount
+def test_bincount_weights_minlength():
+    x = np.array([0, 1, 1, 3, 2, 1, 7], np.int32)
+    h = ht.array(x, split=0)
+    np.testing.assert_array_equal(ht.bincount(h).numpy(), np.bincount(x))
+    np.testing.assert_array_equal(
+        ht.bincount(h, minlength=12).numpy(), np.bincount(x, minlength=12)
+    )
+    w = np.arange(7, dtype=np.float32)
+    np.testing.assert_allclose(
+        ht.bincount(h, weights=ht.array(w, split=0)).numpy(),
+        np.bincount(x, weights=w),
+        rtol=1e-6,
+    )
+
+
+# ----------------------------------------------------------- histc/histogram
+@pytest.mark.parametrize("split", [None, 0])
+def test_histc_histogram(split):
+    rng = np.random.default_rng(6)
+    a = rng.uniform(0, 10, 64).astype(np.float32)
+    h = ht.array(a, split=split)
+    got = ht.histc(h, bins=8, min=0.0, max=10.0)
+    exp, _ = np.histogram(a, bins=8, range=(0.0, 10.0))
+    np.testing.assert_array_equal(got.numpy(), exp)
+    gh, edges = ht.histogram(h, bins=5, range=(0.0, 10.0))
+    eh, eedges = np.histogram(a, bins=5, range=(0.0, 10.0))
+    np.testing.assert_array_equal(gh.numpy(), eh)
+    np.testing.assert_allclose(np.asarray(edges.larray), eedges, rtol=1e-6)
+
+
+# ----------------------------------------------------------------------- cov
+@pytest.mark.parametrize("split", [None, 0])
+@pytest.mark.parametrize("rowvar", [True, False])
+def test_cov_forms(split, rowvar):
+    h, a = _arr(split, shape=(5, 8), seed=7)
+    np.testing.assert_allclose(
+        ht.cov(h, rowvar=rowvar).numpy(),
+        np.cov(a.astype(np.float64), rowvar=rowvar),
+        rtol=1e-4, atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        ht.cov(h, rowvar=rowvar, ddof=0).numpy(),
+        np.cov(a.astype(np.float64), rowvar=rowvar, ddof=0),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_cov_two_operands():
+    h1, a1 = _arr(0, shape=(1, 10), seed=8)
+    h2, a2 = _arr(0, shape=(1, 10), seed=9)
+    np.testing.assert_allclose(
+        ht.cov(h1, h2).numpy(), np.cov(a1, a2), rtol=1e-4, atol=1e-5
+    )
+
+
+# ------------------------------------------------------------ kurtosis/skew
+@pytest.mark.parametrize("split", SPLITS)
+def test_kurtosis_skew_closed_form(split):
+    """Against the closed-form standardized moments (the reference's own
+    definition, statistics.py kurtosis/skew)."""
+    h, a = _arr(split, shape=(64, 3), seed=10)
+    a64 = a.astype(np.float64)
+
+    def m(k, ax=0):
+        c = a64 - a64.mean(axis=ax, keepdims=True)
+        return (c**k).mean(axis=ax)
+
+    skew_biased = m(3) / m(2) ** 1.5
+    kurt_biased = m(4) / m(2) ** 2 - 3.0  # Fisher
+    np.testing.assert_allclose(
+        ht.skew(h, axis=0, unbiased=False).numpy(), skew_biased, rtol=1e-3, atol=1e-4
+    )
+    np.testing.assert_allclose(
+        ht.kurtosis(h, axis=0, unbiased=False).numpy(), kurt_biased, rtol=1e-3, atol=1e-4
+    )
+    # Fischer=False returns Pearson (no -3)
+    np.testing.assert_allclose(
+        ht.kurtosis(h, axis=0, unbiased=False, Fischer=False).numpy(),
+        kurt_biased + 3.0,
+        rtol=1e-3, atol=1e-4,
+    )
+
+
+# ------------------------------------------------------------ argmax/argmin
+@pytest.mark.parametrize("split", SPLITS)
+def test_argmax_argmin_ties_first_wins(split):
+    """numpy tie semantics: first occurrence wins — including across shard
+    boundaries (the reference's packed (value, index) custom MPI op,
+    statistics.py:1218)."""
+    a = np.array([[1, 5, 5], [5, 1, 5], [5, 5, 1], [1, 1, 1]], np.float32)
+    a = np.tile(a, (2, 1))
+    h = ht.array(a, split=split)
+    np.testing.assert_array_equal(ht.argmax(h, axis=0).numpy(), np.argmax(a, axis=0))
+    np.testing.assert_array_equal(ht.argmax(h, axis=1).numpy(), np.argmax(a, axis=1))
+    np.testing.assert_array_equal(ht.argmin(h, axis=0).numpy(), np.argmin(a, axis=0))
+    assert int(np.asarray(ht.argmax(h).larray)) == int(np.argmax(a))
+    assert int(np.asarray(ht.argmin(h).larray)) == int(np.argmin(a))
+
+
+# ----------------------------------------------------------- var/std breadth
+@pytest.mark.parametrize("split", SPLITS)
+@pytest.mark.parametrize("ddof", [0, 1])
+def test_var_std_tuple_axis(split, ddof):
+    h, a = _arr(split, shape=(6, 5), seed=11)
+    np.testing.assert_allclose(
+        np.asarray(ht.var(h, ddof=ddof).larray),
+        a.astype(np.float64).var(ddof=ddof),
+        rtol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(ht.std(h, ddof=ddof).larray),
+        a.astype(np.float64).std(ddof=ddof),
+        rtol=1e-4,
+    )
+
+
+# --------------------------------------------------------- maximum/minimum
+@pytest.mark.parametrize("split", SPLITS)
+def test_maximum_minimum_broadcast(split):
+    h, a = _arr(split, seed=12)
+    row = np.float32(0.25)
+    np.testing.assert_allclose(ht.maximum(h, row).numpy(), np.maximum(a, row), rtol=1e-6)
+    h2, a2 = _arr(split, seed=13)
+    np.testing.assert_allclose(ht.minimum(h, h2).numpy(), np.minimum(a, a2), rtol=1e-6)
+
+
+# ------------------------------------------------------------- mean keepdim
+@pytest.mark.parametrize("split", SPLITS)
+def test_mean_keepdim_shapes(split):
+    h, a = _arr(split, seed=14)
+    got = ht.mean(h, axis=1, keepdim=True)
+    assert tuple(got.shape) == (a.shape[0], 1)
+    np.testing.assert_allclose(got.numpy(), a.mean(axis=1, keepdims=True), rtol=1e-5)
