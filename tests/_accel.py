@@ -51,3 +51,14 @@ COMPLEX_SUPPORTED = (
 requires_complex = pytest.mark.skipif(
     not COMPLEX_SUPPORTED, reason="backend has no complex-dtype support (e.g. TPU v5e)"
 )
+
+
+# TPU-family chips have no native f64: under x64 they run software-emulated
+# doubles whose ulp behavior differs from IEEE and whose linalg custom calls
+# (LU) have no f64 lowering at all. GPU f64 is native — scope the skip to the
+# TPU family exactly like COMPLEX_SUPPORTED above, so GPU keeps x64 coverage.
+NATIVE_F64 = jax.default_backend() not in ("tpu", "axon")
+
+requires_native_f64 = pytest.mark.skipif(
+    not NATIVE_F64, reason="TPU-family f64 is emulated (no native doubles/f64 LU)"
+)

@@ -179,7 +179,13 @@ def test_functional_value_and_iteration_metadata():
     inertia_true = sum(
         ((x.numpy()[labels == k] - centers[k]) ** 2).sum() for k in range(2)
     )
-    assert abs(km.inertia_ - inertia_true) / max(inertia_true, 1e-9) < 1e-3
+    # the fit loop's GEMMs deliberately run at the fast TPU default (one bf16
+    # pass, doc/performance.md) — the inertia functional is ~1e-2-relative on
+    # a real accelerator, libm-tight on the CPU mesh
+    from _accel import ON_ACCELERATOR
+
+    rel = abs(km.inertia_ - inertia_true) / max(inertia_true, 1e-9)
+    assert rel < (5e-2 if ON_ACCELERATOR else 1e-3)
     assert 1 <= km.n_iter_ <= 50
 
 

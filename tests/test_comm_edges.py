@@ -533,7 +533,7 @@ def test_split_validation_matrix(comm):
     with pytest.raises(ValueError, match="exactly one"):
         comm.Split(devices=[0], color=[0] * p)
     with pytest.raises(ValueError, match="length"):
-        comm.Split(color=[0])
+        comm.Split(color=[0] * (p + 1))  # wrong length at ANY mesh size
     if p >= 2:
         with pytest.raises(ValueError, match="duplicate"):
             comm.Split(devices=[0, 0])

@@ -1,0 +1,138 @@
+"""
+External-oracle parity: the ML estimators against scikit-learn / scipy on
+identical data (both are baked into the environment). This is a stronger
+check than the reference's own ML tests (which assert convergence and
+hand-computed values, reference heat/cluster/tests + naive_bayes/tests):
+algorithmic output is pinned to an independent production implementation.
+"""
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+
+
+def _blobs(n=240, f=5, k=3, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(scale=6.0, size=(k, f)).astype(np.float32)
+    labels = rng.integers(0, k, size=n)
+    x = centers[labels] + rng.normal(scale=0.4, size=(n, f)).astype(np.float32)
+    return x.astype(np.float32), labels, centers
+
+
+def test_kmeans_matches_sklearn_lloyd():
+    """Same explicit init + Lloyd iterations -> same centroids/assignment
+    (Lloyd is deterministic given the init)."""
+    from sklearn.cluster import KMeans as SkKMeans
+
+    x, _, centers = _blobs()
+    init = x[:3].copy()
+    sk = SkKMeans(n_clusters=3, init=init, n_init=1, max_iter=50, tol=1e-6, algorithm="lloyd").fit(
+        x.astype(np.float64)
+    )
+    km = ht.cluster.KMeans(n_clusters=3, init=ht.array(init), max_iter=50, tol=1e-6).fit(
+        ht.array(x, split=0)
+    )
+    got = np.asarray(km.cluster_centers_.numpy(), np.float64)
+    # centroid sets match up to permutation
+    from scipy.spatial.distance import cdist as sp_cdist
+
+    d = sp_cdist(got, sk.cluster_centers_)
+    assert d.min(axis=1).max() < 1e-2, d
+    # labels agree up to the same permutation
+    perm = d.argmin(axis=1)
+    ht_labels = np.asarray(km.predict(ht.array(x, split=0)).numpy()).ravel()
+    np.testing.assert_array_equal(perm[ht_labels], sk.predict(x.astype(np.float64)))
+
+
+def test_gaussian_nb_matches_sklearn():
+    from sklearn.naive_bayes import GaussianNB as SkNB
+
+    x, y, _ = _blobs(seed=1)
+    xt, yt = x[:200], y[:200]
+    xq = x[200:]
+    sk = SkNB().fit(xt.astype(np.float64), yt)
+    nb = ht.naive_bayes.GaussianNB().fit(ht.array(xt, split=0), ht.array(yt.astype(np.int32), split=0))
+    np.testing.assert_allclose(np.asarray(nb.theta_.numpy()), sk.theta_, rtol=1e-4, atol=1e-5)
+    # the reference (heat 1.1.1 era, sklearn <1.0 naming) calls it sigma_
+    np.testing.assert_allclose(np.asarray(nb.sigma_.numpy()), sk.var_, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(nb.class_prior_.numpy()), sk.class_prior_, rtol=1e-6
+    )
+    got = np.asarray(nb.predict(ht.array(xq, split=0)).numpy()).ravel()
+    np.testing.assert_array_equal(got, sk.predict(xq.astype(np.float64)))
+
+
+def test_knn_matches_sklearn():
+    from sklearn.neighbors import KNeighborsClassifier as SkKNN
+
+    x, y, _ = _blobs(seed=2)
+    xt, yt = x[:200], y[:200]
+    xq = x[200:]
+    sk = SkKNN(n_neighbors=5).fit(xt.astype(np.float64), yt)
+    knn = ht.classification.KNeighborsClassifier(n_neighbors=5)
+    knn.fit(ht.array(xt, split=0), ht.array(yt.astype(np.int32), split=0))
+    got = np.asarray(knn.predict(ht.array(xq, split=0)).numpy()).ravel()
+    sk_pred = sk.predict(xq.astype(np.float64))
+    # k-NN votes can tie; demand >= 97% agreement rather than bitwise equality
+    assert (got == sk_pred).mean() >= 0.97
+
+
+def test_cdist_matches_scipy():
+    from scipy.spatial.distance import cdist as sp_cdist
+
+    rng = np.random.default_rng(3)
+    a = rng.standard_normal((40, 6)).astype(np.float32)
+    b = rng.standard_normal((25, 6)).astype(np.float32)
+    ha, hb = ht.array(a, split=0), ht.array(b)
+    np.testing.assert_allclose(
+        ht.spatial.cdist(ha, hb).numpy(), sp_cdist(a, b), rtol=1e-4, atol=1e-4
+    )
+    np.testing.assert_allclose(
+        ht.spatial.manhattan(ha, hb).numpy(), sp_cdist(a, b, metric="cityblock"),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_laplacian_matches_sklearn_rbf_graph():
+    """Fully-connected RBF similarity graph Laplacian vs the direct formula on
+    sklearn's rbf_kernel."""
+    from sklearn.metrics.pairwise import rbf_kernel
+
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((30, 4)).astype(np.float32)
+    sigma = 1.7
+    lap = ht.graph.Laplacian(
+        lambda a: ht.spatial.rbf(a, sigma=sigma), definition="simple", mode="fully_connected"
+    )
+    got = np.asarray(lap.construct(ht.array(x, split=0)).numpy(), np.float64)
+    # rbf(x) uses exp(-d^2 / (2 sigma^2)); sklearn's gamma = 1/(2 sigma^2)
+    s = rbf_kernel(x.astype(np.float64), gamma=1.0 / (2 * sigma**2))
+    np.fill_diagonal(s, 0.0)  # no self-loops in the graph form
+    expected = np.diag(s.sum(axis=1)) - s
+    np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-3)
+
+
+def test_lasso_matches_sklearn_direction():
+    """Coordinate-descent Lasso: sparsity pattern and signs match sklearn's at
+    matched regularization (objective scalings differ by convention, so the
+    support/sign structure — what Lasso is FOR — is the invariant checked)."""
+    from sklearn.linear_model import Lasso as SkLasso
+
+    rng = np.random.default_rng(5)
+    n, f = 120, 8
+    x = rng.standard_normal((n, f)).astype(np.float32)
+    true_w = np.zeros(f, np.float32)
+    true_w[[1, 4]] = [2.5, -3.0]
+    y = x @ true_w + 0.01 * rng.standard_normal(n).astype(np.float32)
+    sk = SkLasso(alpha=0.1, fit_intercept=True).fit(x.astype(np.float64), y)
+    las = ht.regression.Lasso(lam=0.1, max_iter=200)
+    las.fit(ht.array(x, split=0), ht.array(y.reshape(-1, 1), split=0))
+    got = np.asarray(las.coef_.numpy()).ravel()
+    sk_w = sk.coef_
+    on = np.abs(sk_w) > 1e-3
+    assert (np.abs(got[on]) > 1e-3).all(), (got, sk_w)
+    assert (np.sign(got[on]) == np.sign(sk_w[on])).all()
+    # the true zeros stay (near) zero
+    off = ~on
+    assert (np.abs(got[off]) < 0.5).all(), (got, sk_w)

@@ -14,6 +14,8 @@ import jax
 
 import heat_tpu as ht
 
+from _accel import requires_native_f64
+
 
 @pytest.fixture(autouse=True)
 def _x64():
@@ -43,6 +45,7 @@ def test_i64_beyond_i32_range():
     assert int(ht.sum(a).larray) == int(vals.sum())
 
 
+@requires_native_f64
 @pytest.mark.parametrize("split", [None, 0, 1])
 def test_f64_elementwise_and_reduction_matrix(split):
     rng = np.random.default_rng(0)
@@ -54,6 +57,7 @@ def test_f64_elementwise_and_reduction_matrix(split):
     np.testing.assert_allclose(ht.cumsum(h, axis=0).numpy(), np.cumsum(a, 0), rtol=1e-13)
 
 
+@requires_native_f64
 def test_f64_distributed_sort():
     """The exact-rank distributed sort's u64 total-order transform path."""
     rng = np.random.default_rng(1)
@@ -117,6 +121,7 @@ def test_c128_when_supported():
     np.testing.assert_allclose(ht.conj(h).numpy(), a.conj(), rtol=1e-15)
 
 
+@requires_native_f64
 def test_f64_det_inv_distributed():
     """The round-4 blocked elimination path under x64 (the CPU-mesh numerics
     it was validated against)."""
