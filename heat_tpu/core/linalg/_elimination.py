@@ -111,53 +111,153 @@ def _build_panel_det(mesh, axis_name: str, p: int, m: int, dtype_name: str):
     )
 
 
-@functools.lru_cache(maxsize=None)
-def _build_panel_inv(mesh, axis_name: str, p: int, m: int, dtype_name: str):
-    """shard_map program: blocked Gauss-Jordan inverse of a (p*m, p*m)
-    row-split matrix. Returns the row-split inverse."""
-    n = p * m
-    dt = jnp.dtype(dtype_name)
+def _make_panel_ops(axis_name: str, p: int, m: int, dt):
+    """The two building blocks every panel program shares: the blocked
+    Gauss-Jordan elimination sweep (applied to A and a companion panel B) and
+    the SUMMA row-panel matmul."""
 
     def panel_mm(x, y, idx):
-        """Row panel of X @ Y for row-split X, Y: SUMMA over the mesh — step k
-        psum-broadcasts Y's panel k and accumulates one (m, m) x (m, n) GEMM."""
-        acc = jnp.zeros_like(x)
+        """Row panel of X @ Y for row-split X (width p*m) and row-split Y (any
+        width): SUMMA over the mesh — step k psum-broadcasts Y's panel k and
+        accumulates one (m, m) x (m, width) GEMM."""
+        acc = jnp.zeros_like(y)
         for k in range(p):
             own = (idx == k).astype(dt)
-            yk = jax.lax.psum(own * y, axis_name)  # (m, n)
+            yk = jax.lax.psum(own * y, axis_name)
             acc = acc + jnp.matmul(x[:, k * m : (k + 1) * m], yk, precision=GEMM_PRECISION)
         return acc
 
-    def local(a):  # (m, n) local row panel
-        idx = jax.lax.axis_index(axis_name)
-        a0 = a
-        # my rows of the identity: row r of panel idx is global row idx*m + r
-        rows = idx * m + jnp.arange(m)
-        eye = (rows[:, None] == jnp.arange(n)[None, :]).astype(dt)
-        b = eye
+    def eliminate(a, b, idx):
+        """
+        Two-phase blocked LU solve of ``A X = B`` (forward elimination of the
+        below-diagonal blocks with pivot-row scaling, then backward
+        substitution of the above-diagonal ones) — the numerically stabler
+        split of the work: single-sweep Gauss-Jordan contaminates every row
+        each step and pays an extra cond(A) power in forward error, which
+        measured ~0.5 relative by n=4096 f32 on cond~1e4 inputs.
+        Returns B's reduced panels (= A^{-1} B up to LU-class rounding).
+        """
+        # forward: row-block k is scaled to a unit diagonal block; only rows
+        # BELOW it eliminate their block column
         for k in range(p):
             c0, c1 = k * m, (k + 1) * m
             own = (idx == k).astype(dt)
             d_blk = jax.lax.psum(own * a[:, c0:c1], axis_name)
             lu_piv = jax.scipy.linalg.lu_factor(d_blk)
-            # scaled pivot panels D^{-1} [A_k | B_k], broadcast to all
             pa = jax.lax.psum(own * jax.scipy.linalg.lu_solve(lu_piv, a), axis_name)
             pb = jax.lax.psum(own * jax.scipy.linalg.lu_solve(lu_piv, b), axis_name)
             f = a[:, c0:c1]
-            is_owner = idx == k
-            a = jnp.where(is_owner, pa, a - jnp.matmul(f, pa, precision=GEMM_PRECISION))
-            b = jnp.where(is_owner, pb, b - jnp.matmul(f, pb, precision=GEMM_PRECISION))
-        # one Newton (Schulz) refinement step, X <- X + X (I - A X): sequential
-        # block elimination amplifies f32 rounding ~1000x over a pivoted LU;
-        # squaring the residual wins that accuracy back for 2 extra SUMMA
-        # passes (4 n^3 / p flops per device), still gather-free
-        r = eye - panel_mm(a0, b, idx)
-        b = b + panel_mm(b, r, idx)
+            below = idx > k
+            a = jnp.where(
+                idx == k, pa, jnp.where(below, a - jnp.matmul(f, pa, precision=GEMM_PRECISION), a)
+            )
+            b = jnp.where(
+                idx == k, pb, jnp.where(below, b - jnp.matmul(f, pb, precision=GEMM_PRECISION), b)
+            )
+        # backward: A is now unit-block-upper-triangular; substitute upward
+        for k in range(p - 1, 0, -1):
+            own = (idx == k).astype(dt)
+            pb = jax.lax.psum(own * b, axis_name)
+            f = a[:, k * m : (k + 1) * m]
+            b = jnp.where(
+                idx < k, b - jnp.matmul(f, pb, precision=GEMM_PRECISION), b
+            )
         return b
+
+    return panel_mm, eliminate
+
+
+def _refine(x, b, a, binv, panel_mm, idx, axis_name):
+    """Two residual-GUARDED iterative-refinement steps: x' = x + M (b - A x)
+    with M ~ A^{-1}; each kept only if it shrinks the residual (refinement
+    diverges when ||I - A M|| >= 1, and an unguarded step was measured
+    turning a 0.5-relative solution into 293). Returns ``(x, rel_residual)``
+    — the caller decides whether the certified residual is good enough."""
+    # all norms are computed max-abs-scaled: raw sum(b*b) overflows f32 for
+    # |b| ~ 1e19+, which would zero the certified residual and silently
+    # disable the ill-conditioning fallback for large-magnitude systems
+    tiny = jnp.asarray(1e-30, b.dtype if b.dtype != jnp.bool_ else jnp.float32)
+    scale = jnp.maximum(jax.lax.pmax(jnp.max(jnp.abs(b)), axis_name), tiny)
+
+    def fro2(t):
+        t = t / scale
+        return jax.lax.psum(jnp.sum(t * t), axis_name)
+
+    r = b - panel_mm(a, x, idx)
+    nr = fro2(r)
+    for _ in range(2):
+        x1 = x + panel_mm(binv, r, idx)
+        r1 = b - panel_mm(a, x1, idx)
+        n1 = fro2(r1)
+        better = n1 < nr
+        x = jnp.where(better, x1, x)
+        r = jnp.where(better, r1, r)
+        nr = jnp.where(better, n1, nr)
+    nb = fro2(b)
+    return x, jnp.sqrt(nr / jnp.maximum(nb, tiny))
+
+
+def _inv_panels(a, idx, axis_name: str, p: int, m: int, dt):
+    """Inverse panels of a row-split (p*m, p*m) matrix with a certified
+    relative residual ||I - A X||_F / ||I||_F: two-phase block elimination
+    plus residual-guarded refinement (SUMMA passes, gather-free). Block-local
+    pivoting bounds accuracy at ~cond(A)*eps*growth — the residual tells the
+    caller when that was not enough."""
+    n = p * m
+    panel_mm, eliminate = _make_panel_ops(axis_name, p, m, dt)
+    rows = idx * m + jnp.arange(m)
+    eye = (rows[:, None] == jnp.arange(n)[None, :]).astype(dt)
+    binv = eliminate(a, eye, idx)
+    return _refine(binv, eye, a, binv, panel_mm, idx, axis_name)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_panel_solve(mesh, axis_name: str, p: int, m: int, k: int, dtype_name: str):
+    """shard_map program: solve A X = B for a (p*m, p*m) row-split A and a
+    (p*m, k) row-split B via two-phase block elimination of the augmented
+    [B | I] plus residual-guarded iterative refinement. Returns
+    ``(x_panels, rel_residual)`` — the certified residual lets the caller
+    fall back when block-local pivoting was not enough for this matrix.
+    Gather-free throughout."""
+    dt = jnp.dtype(dtype_name)
+
+    def local(a, b):  # (m, n) and (m, k) local row panels
+        idx = jax.lax.axis_index(axis_name)
+        panel_mm, eliminate = _make_panel_ops(axis_name, p, m, dt)
+        # one elimination over the augmented [B | I]: the identity columns
+        # yield the approximate inverse the refinement step uses as its
+        # correction operator, sharing A's reduction work with the solve
+        n_ = p * m
+        rows = idx * m + jnp.arange(m)
+        eye = (rows[:, None] == jnp.arange(n_)[None, :]).astype(dt)
+        out = eliminate(a, jnp.concatenate([b, eye], axis=1), idx)
+        x, binv = out[:, :k], out[:, k:]
+        return _refine(x, b, a, binv, panel_mm, idx, axis_name)
 
     spec = P(axis_name, None)
     return jax.jit(
-        jax.shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False)
+        jax.shard_map(
+            local, mesh=mesh, in_specs=(spec, spec), out_specs=(spec, P()), check_vma=False
+        )
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _build_panel_inv(mesh, axis_name: str, p: int, m: int, dtype_name: str):
+    """shard_map program: two-phase block-elimination inverse of a (p*m, p*m)
+    row-split matrix with guarded refinement. Returns ``(inverse_panels,
+    rel_residual)``."""
+    dt = jnp.dtype(dtype_name)
+
+    def local(a):  # (m, n) local row panel
+        idx = jax.lax.axis_index(axis_name)
+        return _inv_panels(a, idx, axis_name, p, m, dt)
+
+    spec = P(axis_name, None)
+    return jax.jit(
+        jax.shard_map(
+            local, mesh=mesh, in_specs=spec, out_specs=(spec, P()), check_vma=False
+        )
     )
 
 
@@ -188,30 +288,68 @@ def distributed_det(a) -> Tuple[jax.Array, bool]:
     block's LU hit a zero/non-finite pivot — block-local pivoting cannot reach
     across panels, so the caller must fall back to tell a genuinely singular
     matrix from a pivoting failure. ``det`` overflows/underflows exactly like
-    numpy's raw-product determinant.
+    numpy's raw-product determinant (materialized from the slogdet pair).
     """
+    unit, logabs, bad = distributed_slogdet(a)
+    return unit * jnp.exp(logabs).astype(unit.dtype), bad
+
+
+def distributed_slogdet(a) -> Tuple[jax.Array, jax.Array, bool]:
+    """(sign, log|det|, bad) of a 2-D split matrix via the same blocked panel
+    LU as :func:`distributed_det` — the pair is what the kernel natively
+    accumulates, so no overflow is possible (numpy.linalg.slogdet parity)."""
     if a.split == 1:
         from . import basics
 
-        a = basics.transpose(a)  # det(A) == det(A^T); transpose is local + remap
+        a = basics.transpose(a)
     comm = a.comm
     x, _, n_phys = _embed_padded_square(a)
     fn = _build_panel_det(
         comm.mesh, comm.axis_name, comm.size, n_phys // comm.size, np.dtype(x.dtype).name
     )
     unit, logabs, bad = fn(x)
-    return unit * jnp.exp(logabs).astype(unit.dtype), bool(bad)
+    return unit, logabs, bool(bad)
 
 
-def distributed_inv(a) -> jax.Array:
-    """Inverse of a 2-D split matrix via blocked Gauss-Jordan; never gathers
-    the full operand. Returns the *logical* (n, n) inverse of ``a`` (or of
-    ``a^T`` when split=1 — the caller re-transposes). May contain non-finite
-    entries when a diagonal block is singular — callers fall back."""
+def distributed_solve(a, b_phys: jax.Array, k: int) -> Tuple[jax.Array, jax.Array]:
+    """
+    Solve ``A X = B`` for a 2-D split-0 matrix ``a`` and right-hand side
+    panels ``b_phys`` ((n', k), row-split, pad rows zero); returns
+    ``(x, rel_residual)`` — the logical (n, k) solution and the certified
+    relative residual ``||B - A X||_F / ||B||_F``. Gather-free: the same
+    per-step psum-broadcast panels as the inverse, with the (m, k) RHS panel
+    riding the augmented elimination. Block-local pivoting bounds accuracy
+    at ~cond(A)*eps*growth; callers fall back on a poor residual (or on
+    non-finite entries from a singular diagonal block).
+    """
+    comm = a.comm
+    x, n, n_phys = _embed_padded_square(a)
+    # bucket the RHS width to the next power of two: k is user-controlled, so
+    # caching compiled programs per exact k would trace/retain one executable
+    # per distinct width (zero-padded columns solve to zero and are sliced off)
+    k = int(k)
+    k_pad = 1 << max(k - 1, 0).bit_length() if k > 1 else 1
+    b_run = b_phys.astype(x.dtype)
+    if k_pad != k:
+        b_run = jnp.pad(b_run, ((0, 0), (0, k_pad - k)))
+    fn = _build_panel_solve(
+        comm.mesh, comm.axis_name, comm.size, n_phys // comm.size, k_pad,
+        np.dtype(x.dtype).name,
+    )
+    out, rel = fn(x, b_run)
+    return out[:n, :k], rel
+
+
+def distributed_inv(a) -> Tuple[jax.Array, jax.Array]:
+    """Inverse of a 2-D split matrix via two-phase block elimination; never
+    gathers the full operand. Returns ``(inverse, rel_residual)`` — the
+    *logical* (n, n) inverse and the certified ``||I - A X||_F / ||I||_F``.
+    Callers fall back on a poor residual or non-finite entries (singular
+    diagonal block / ill-conditioning beyond block-local pivoting)."""
     comm = a.comm
     x, n, n_phys = _embed_padded_square(a)
     fn = _build_panel_inv(
         comm.mesh, comm.axis_name, comm.size, n_phys // comm.size, np.dtype(x.dtype).name
     )
-    out = fn(x)
-    return out[:n, :n]
+    out, rel = fn(x)
+    return out[:n, :n], rel

@@ -359,6 +359,24 @@ def test_det_inv_no_full_gather(kind):
     assert "all-reduce" in t or "reduce-scatter" in t
 
 
+def test_solve_no_full_gather():
+    """4096x4096 split-0 solve with 8 right-hand sides: the RHS panels ride
+    the same psum-broadcasts as the elimination — no full-operand gather."""
+    comm = _comm()
+    from heat_tpu.core.linalg import _elimination as el
+
+    n, k = 4096, 8
+    m = n // comm.size
+    if n % comm.size:
+        pytest.skip("4096 not divisible by this mesh size")
+    fn = el._build_panel_solve(comm.mesh, comm.axis_name, comm.size, m, k, "float32")
+    aval_a = jax.ShapeDtypeStruct((n, n), jnp.float32, sharding=comm.sharding(2, 0))
+    aval_b = jax.ShapeDtypeStruct((n, k), jnp.float32, sharding=comm.sharding(2, 0))
+    t = fn.lower(aval_a, aval_b).compile().as_text()
+    _no_full_gather(t, n)
+    assert "all-reduce" in t or "reduce-scatter" in t
+
+
 def test_det_inv_dispatch_distributed():
     """ht.det/ht.inv on a split square matrix actually route through the panel
     programs (and the ragged embed keeps them on that path)."""

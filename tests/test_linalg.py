@@ -114,6 +114,80 @@ def test_det_inv_batched_split():
     np.testing.assert_allclose(ht.inv(h).numpy(), np.linalg.inv(a), rtol=5e-3, atol=1e-4)
 
 
+@pytest.mark.parametrize("n", [48, 51])  # even and ragged over the mesh
+@pytest.mark.parametrize("split", [0, 1, None])
+def test_solve_distributed(n, split):
+    """solve rides the blocked panel elimination for split matrices (numpy-API
+    completion — the reference has only iterative cg/lanczos solvers)."""
+    rng = np.random.default_rng(11)
+    a_np = rng.standard_normal((n, n)).astype(np.float32) + 3 * np.eye(n, dtype=np.float32)
+    b1 = rng.standard_normal(n).astype(np.float32)
+    bk = rng.standard_normal((n, 3)).astype(np.float32)
+    a = ht.array(a_np, split=split)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # the distributed path must not fall back
+        x1 = ht.solve(a, ht.array(b1, split=0 if split == 0 else None))
+        xk = ht.solve(a, ht.array(bk, split=0 if split == 0 else None))
+    assert x1.shape == (n,) and xk.shape == (n, 3)
+    np.testing.assert_allclose(
+        x1.numpy(), np.linalg.solve(a_np.astype(np.float64), b1), rtol=5e-3, atol=1e-3
+    )
+    np.testing.assert_allclose(
+        xk.numpy(), np.linalg.solve(a_np.astype(np.float64), bk), rtol=5e-3, atol=1e-3
+    )
+
+
+def test_solve_inv_illconditioned_certified_fallback():
+    """Block-local pivoting bounds the panel path at ~cond*eps*growth; the
+    kernels certify their own residual and an ill-conditioned system must
+    fall back (warned) to the fully-pivoted replicated path instead of
+    returning a silently bad answer."""
+    if not ht.get_comm().is_distributed():
+        pytest.skip("needs a multi-device mesh")
+    rng = np.random.default_rng(13)
+    n = 64
+    # condition the matrix badly on purpose: geometric singular-value decay
+    u, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    v, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    s = np.logspace(0, -6, n)  # cond = 1e6 >> f32 comfort
+    a_np = (u * s) @ v.T
+    a_np = a_np.astype(np.float32)
+    b_np = rng.standard_normal(n).astype(np.float32)
+    with pytest.warns(UserWarning, match="falling back"):
+        x = ht.solve(ht.array(a_np, split=0), ht.array(b_np))
+    # the fallback is backward-stable: residual small against ||A|| ||x||
+    # (at cond 1e6 no two f32 backends agree on x itself)
+    xn = x.numpy()
+    resid = np.abs(a_np @ xn - b_np).max() / max(np.abs(xn).max() * np.abs(a_np).max(), 1e-30)
+    assert resid < 1e-5, resid
+
+
+def test_solve_validation_and_singular():
+    with pytest.raises(ValueError):
+        ht.solve(ht.ones((3, 4)), ht.ones(3))
+    with pytest.raises(ValueError):
+        ht.solve(ht.ones((4, 4)), ht.ones(5))
+    with pytest.raises(RuntimeError, match="[Ss]ingular"):
+        ht.solve(ht.ones((8, 8), split=0), ht.ones(8))
+
+
+@pytest.mark.parametrize("split", [0, 1, None])
+def test_slogdet_matches_numpy_no_overflow(split):
+    """slogdet of a matrix whose raw det overflows f32: the (sign, log) pair
+    must still be exact (the panel kernel accumulates it natively)."""
+    rng = np.random.default_rng(12)
+    n = 96
+    a_np = rng.standard_normal((n, n)).astype(np.float32) + 3 * np.eye(n, dtype=np.float32)
+    s_np, l_np = np.linalg.slogdet(a_np.astype(np.float64))
+    assert l_np > 88.7  # raw f32 det would be inf
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        s, l = ht.slogdet(ht.array(a_np, split=split))
+    assert float(s.larray) == s_np
+    # f32 log accumulation across p blocks: ~1e-5-relative per-block rounding
+    np.testing.assert_allclose(float(l.larray), l_np, rtol=1e-4)
+
+
 def test_det_inv_singular_fallback():
     """A singular matrix: det warns (block pivot hit zero) but returns 0;
     inv raises like the reference (basics.py:331-423 'Inverse does not exist')."""
