@@ -235,3 +235,76 @@ def test_std_var_keepdims_split_metadata():
     assert r.split in (None, 1)
     if r.split is not None:
         assert 0 <= r.split < r.ndim
+
+
+# ------------------------------------------------------ trig / exponential
+@pytest.mark.parametrize("split", SPLITS)
+def test_atan2_quadrants(split):
+    """All four quadrants plus the axes — the sign conventions that separate
+    atan2 from atan (reference trigonometrics.py atan2)."""
+    y = np.array([1.0, 1.0, -1.0, -1.0, 0.0, 1.0, 0.0, -0.0], np.float32)
+    x = np.array([1.0, -1.0, 1.0, -1.0, 1.0, 0.0, -1.0, -1.0], np.float32)
+    hy, hx = ht.array(y, split=split), ht.array(x, split=split)
+    np.testing.assert_allclose(ht.atan2(hy, hx).numpy(), np.arctan2(y, x), rtol=1e-6)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_degrees_radians_roundtrip(split):
+    a = np.array([0.0, 90.0, -180.0, 270.0, 45.5], np.float32)
+    h = ht.array(a, split=split)
+    np.testing.assert_allclose(ht.deg2rad(h).numpy(), np.deg2rad(a), rtol=1e-6)
+    np.testing.assert_allclose(ht.radians(h).numpy(), np.radians(a), rtol=1e-6)
+    back = ht.rad2deg(ht.deg2rad(h))
+    np.testing.assert_allclose(back.numpy(), a, rtol=1e-5)
+    np.testing.assert_allclose(ht.degrees(ht.radians(h)).numpy(), a, rtol=1e-5)
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_logaddexp_extremes(split):
+    """-inf identities and the overflow-free property logaddexp exists for."""
+    a = np.array([0.0, -np.inf, 50.0, -50.0], np.float32)
+    b = np.array([0.0, 3.0, 50.0, 50.0], np.float32)
+    ha, hb = ht.array(a, split=split), ht.array(b, split=split)
+    np.testing.assert_allclose(
+        ht.logaddexp(ha, hb).numpy(), np.logaddexp(a, b), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        ht.logaddexp2(ha, hb).numpy(), np.logaddexp2(a, b), rtol=1e-5
+    )
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_expm1_log1p_small_x_precision(split):
+    """The tiny-x regime is these functions' reason to exist: plain
+    exp(x)-1 / log(1+x) would round to 0 in f32."""
+    a = np.array([1e-7, -1e-7, 1e-6, 0.0], np.float32)
+    h = ht.array(a, split=split)
+    np.testing.assert_allclose(ht.expm1(h).numpy(), np.expm1(a), rtol=1e-6)
+    np.testing.assert_allclose(ht.log1p(h).numpy(), np.log1p(a), rtol=1e-6)
+    got = ht.expm1(h).numpy()
+    assert got[0] != 0.0 and got[1] != 0.0  # not the naive cancellation
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_log_domain_edges(split):
+    a = np.array([1.0, 0.0, -1.0, np.inf], np.float32)
+    h = ht.array(a, split=split)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        np.testing.assert_allclose(
+            ht.log(h).numpy(), np.log(a), rtol=1e-6, equal_nan=True
+        )
+        np.testing.assert_allclose(
+            ht.sqrt(h).numpy(), np.sqrt(a), rtol=1e-6, equal_nan=True
+        )
+
+
+@pytest.mark.parametrize("split", SPLITS)
+def test_hyperbolic_inverses_domain(split):
+    a = np.array([0.0, 0.5, -0.5, 0.99], np.float32)
+    h = ht.array(a, split=split)
+    np.testing.assert_allclose(ht.arctanh(h).numpy(), np.arctanh(a), rtol=1e-5)
+    b = np.array([1.0, 1.5, 10.0], np.float32)  # arccosh domain starts at 1
+    np.testing.assert_allclose(
+        ht.arccosh(ht.array(b, split=split)).numpy(), np.arccosh(b), rtol=1e-5
+    )
+    np.testing.assert_allclose(ht.arcsinh(h).numpy(), np.arcsinh(a), rtol=1e-5)
