@@ -149,3 +149,81 @@ def test_finfo_iinfo_surface():
     assert ii.bits == 16 and ii.max == 32767 and ii.min == -32768
     bf = ht.finfo(ht.bfloat16)
     assert bf.bits == 16
+
+
+# -------------------------------------------------- exhaustive promotion table
+TYPE_NAMES = [
+    "bool", "uint8", "int8", "int16", "int32", "int64",
+    "float16", "float32", "float64", "complex64", "complex128", "bfloat16",
+]
+
+
+def test_promote_types_matches_jax_table_exhaustively():
+    """The full 12x12 promotion table equals jax's (the compute engine's
+    truth): what promote_types PROMISES is exactly what a jnp binary op will
+    produce. Run under x64 so the 64-bit rows are real."""
+    import jax
+    import jax.numpy as jnp
+
+    with jax.enable_x64(True):
+        for a in TYPE_NAMES:
+            for b in TYPE_NAMES:
+                got = types.promote_types(getattr(ht, a), getattr(ht, b))
+                exp = jnp.promote_types(a, b)
+                got_np = np.dtype(got.jnp_type())
+                assert got_np == np.dtype(exp), (a, b, got_np, exp)
+
+
+def test_promotion_divergence_from_numpy_is_the_torch_jax_class():
+    """Documented divergence: numpy widens int x float (int32 + float32 ->
+    float64); jax/torch — and therefore this framework, whose compute engine
+    cannot execute a silently-upgraded f64 on TPU — keep the float width.
+    Every OTHER pair agrees with numpy. Pin both facts so neither drifts."""
+    import jax
+
+    with jax.enable_x64(True):
+        diverged = []
+        for a in TYPE_NAMES:
+            if a == "bfloat16":
+                continue  # numpy has no bf16
+            for b in TYPE_NAMES:
+                if b == "bfloat16":
+                    continue
+                got = np.dtype(types.promote_types(getattr(ht, a), getattr(ht, b)).jnp_type())
+                exp = np.promote_types(a, b)
+                if got != exp:
+                    diverged.append((a, b))
+                    # the divergence must be exactly the width-preserving
+                    # int x float/complex class: one side integer, the other
+                    # inexact, and our answer is the inexact side's dtype
+                    ints = {"uint8", "int8", "int16", "int32", "int64"}
+                    fl = a if a not in ints else b
+                    assert (a in ints) != (b in ints), (a, b)
+                    assert got == np.dtype(fl), (a, b, got)
+        assert len(diverged) > 0  # the class exists (numpy really differs)
+
+
+def test_result_type_arrays_and_scalars():
+    a32 = ht.ones(3, dtype=ht.float32)
+    i8 = ht.ones(3, dtype=ht.int8)
+    assert types.result_type(a32, i8) is ht.float32
+    # python scalars are weakly typed (jax semantics): they do not widen arrays
+    assert types.result_type(a32, 2) is ht.float32
+    assert types.result_type(i8, 2) is ht.int8
+
+
+def test_can_cast_hierarchy():
+    assert types.can_cast(ht.uint8, ht.int16)
+    assert types.can_cast(ht.int16, ht.float32)
+    assert not types.can_cast(ht.float32, ht.int32, casting="safe")
+    assert types.can_cast(ht.float32, ht.int32, casting="unsafe")
+
+
+def test_finfo_iinfo_values():
+    fi = types.finfo(ht.float32)
+    assert fi.max == np.finfo(np.float32).max
+    assert fi.eps == np.finfo(np.float32).eps
+    ii = types.iinfo(ht.int16)
+    assert ii.min == -(2**15) and ii.max == 2**15 - 1
+    bi = types.finfo(ht.bfloat16)
+    assert bi.eps == 0.0078125  # 2^-7: the 8-bit-mantissa step
