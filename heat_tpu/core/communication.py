@@ -200,6 +200,29 @@ class MeshCommunication(Communication):
         displs = tuple(min(r * c, n) for r in range(self.size))
         return counts, displs
 
+    def counts_displs_shape(
+        self, shape: Sequence[int], axis: int, rank: Optional[int] = None
+    ) -> Tuple[Tuple[int, ...], Tuple[int, ...], Tuple[int, ...]]:
+        """
+        Reference-name entry point (heat/core/communication.py:211-240,
+        ``counts_displs_shape``): remainder-spread counts and displacements for
+        a variable-sized all-to-all, plus the receive-buffer shape under the
+        all-equal-inputs assumption (``size * counts[rank]`` along ``axis``).
+        Unlike :meth:`counts_displs` (padded physical placement), this uses the
+        reference's own remainder-spread decomposition, so ported user code
+        sees identical numbers. ``rank`` defaults to this controller's rank.
+        """
+        shape = tuple(int(s) for s in shape)
+        axis = int(axis) % len(shape) if len(shape) else 0
+        n = shape[axis]
+        base, rem = divmod(n, self.size)
+        counts = tuple(base + (1 if r < rem else 0) for r in range(self.size))
+        displs = tuple(sum(counts[:r]) for r in range(self.size))
+        r = self.rank if rank is None else int(rank)
+        output_shape = list(shape)
+        output_shape[axis] = self.size * counts[r]
+        return counts, displs, tuple(output_shape)
+
     def lshape_map(self, shape: Sequence[int], split: Optional[int]) -> np.ndarray:
         """
         ``(size, ndim)`` array of every device's shape of *owned logical data* under

@@ -372,6 +372,37 @@ class DNDarray:
         """The axis the array is split on (``None`` = replicated)."""
         return self.__split
 
+    def stride(self) -> Tuple[int, ...]:
+        """
+        Steps (in elements) per dimension when traversing the local data,
+        torch-like usage ``a.stride()`` (reference dndarray.py:308 forwards to
+        ``torch.Tensor.stride``). jax arrays carry no stride attribute — XLA
+        buffers are C-contiguous by construction — so the C-order strides are
+        computed from :attr:`lshape`.
+        """
+        strides = []
+        step = 1
+        for dim in reversed(self.lshape):
+            strides.append(step)
+            step *= int(dim)
+        return tuple(reversed(strides))
+
+    @property
+    def strides(self) -> Tuple[int, ...]:
+        """
+        Steps (in bytes) per dimension when traversing the local data,
+        numpy-like (reference dndarray.py:315: element strides scaled by the
+        storage element size).
+        """
+        return tuple(s * self.itemsize for s in self.stride())
+
+    def is_distributed(self) -> bool:
+        """
+        Whether the array's data is split across multiple devices (reference
+        dndarray.py:956: ``split is not None`` on a >1-process communicator).
+        """
+        return self.__split is not None and self.__comm.is_distributed()
+
     @property
     def lloc(self) -> LocalIndex:
         """Local item setter/getter on the underlying array (parity: dndarray.py lloc)."""

@@ -55,6 +55,18 @@ def can_distribute_elimination(a) -> bool:
     )
 
 
+def acceptance_tol(dtype) -> float:
+    """Residual acceptance threshold for the distributed inv/solve paths,
+    scaled with the working precision (~3*sqrt(eps) of the real counterpart
+    dtype; ~1e-3 for f32, ~4.5e-8 for f64). A dtype-independent constant would
+    let an f64 solve ship f32-class accuracy instead of falling back to the
+    replicated LAPACK path."""
+    dt = jnp.dtype(dtype)
+    if jnp.issubdtype(dt, jnp.complexfloating):
+        dt = jnp.finfo(dt).dtype
+    return float(3.0 * np.sqrt(np.finfo(dt).eps))
+
+
 def _block_det_sign(piv: jax.Array, m: int) -> jax.Array:
     """Parity of a LAPACK-style ipiv vector: each ``piv[i] != i`` is one swap."""
     swaps = jnp.sum(piv != jnp.arange(m, dtype=piv.dtype))
@@ -176,11 +188,15 @@ def _refine(x, b, a, binv, panel_mm, idx, axis_name):
     # all norms are computed max-abs-scaled: raw sum(b*b) overflows f32 for
     # |b| ~ 1e19+, which would zero the certified residual and silently
     # disable the ill-conditioning fallback for large-magnitude systems
-    tiny = jnp.asarray(1e-30, b.dtype if b.dtype != jnp.bool_ else jnp.float32)
+    wdt = b.dtype if b.dtype != jnp.bool_ else jnp.float32
+    # norms live in the REAL counterpart dtype: sum(t*t) of a complex residual
+    # is complex, which breaks the better/< guards and the caller's float(rel)
+    rdt = jnp.finfo(wdt).dtype if jnp.issubdtype(wdt, jnp.complexfloating) else wdt
+    tiny = jnp.asarray(1e-30, rdt)
     scale = jnp.maximum(jax.lax.pmax(jnp.max(jnp.abs(b)), axis_name), tiny)
 
     def fro2(t):
-        t = t / scale
+        t = jnp.abs(t / scale)
         return jax.lax.psum(jnp.sum(t * t), axis_name)
 
     r = b - panel_mm(a, x, idx)

@@ -166,7 +166,7 @@ def inv(a: DNDarray) -> DNDarray:
             # so the recursion lands on the split=0 panel path (or its fallback)
             return transpose(inv(transpose(a)))
         data, rel = _elimination.distributed_inv(a)
-        if bool(jnp.all(jnp.isfinite(data))) and float(rel) < 1e-3:
+        if bool(jnp.all(jnp.isfinite(data))) and float(rel) < _elimination.acceptance_tol(data.dtype):
             return __wrap(a, data, a.split)
         # non-finite: singular diagonal block. Finite but poor certified
         # residual: the matrix is too ill-conditioned for block-local
@@ -306,7 +306,7 @@ def solve(a: DNDarray, b: DNDarray) -> DNDarray:
         # identity-extended system maps them to a zero solution block
         b_phys = a.comm.placed(b2.larray, 0, gshape=b2.shape, fill=0)
         data, rel = _elimination.distributed_solve(a, b_phys, int(b2.shape[1]))
-        if bool(jnp.all(jnp.isfinite(data))) and float(rel) < 1e-3:
+        if bool(jnp.all(jnp.isfinite(data))) and float(rel) < _elimination.acceptance_tol(data.dtype):
             if vector_rhs:
                 data = data[:, 0]
             # a is split 0 on this path (split=1 was resharded above)

@@ -242,15 +242,16 @@ def maximum(x1, x2, out=None) -> DNDarray:
     return _operations.__binary_op(jnp.maximum, x1, x2, out)
 
 
-def mean(x, axis=None, keepdims: bool = False, keepdim: Optional[bool] = None) -> DNDarray:
+def mean(x, axis=None, keepdims: Optional[bool] = None, keepdim: Optional[bool] = None) -> DNDarray:
     """
     Arithmetic mean along an axis (reference statistics.py:741-866: per-rank partial
     moments merged via Allreduce; here the sharded jnp.mean lowers to the same psum).
     ``keepdims`` extends the reference's signature to numpy's; the torch-style
     ``keepdim`` spelling the neighboring reducers use (``sum``/``prod``,
-    reference arithmetics.py:860+) is accepted as an alias.
+    reference arithmetics.py:860+) is accepted as an alias. Passing both with
+    conflicting values raises, like the other reducers.
     """
-    keep = _operations.resolve_keepdims(keepdim, keepdims or None)
+    keep = _operations.resolve_keepdims(keepdim, keepdims)
     return __moment(x, axis, keep, lambda a, ax: jnp.mean(a, axis=ax, keepdims=keep))
 
 

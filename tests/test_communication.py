@@ -237,3 +237,21 @@ def test_split_by_color_groups():
     out = sub.Allreduce(jnp.ones((sub.size, 2)), op="sum")
     assert out.shape == (1, 2)
     assert float(out[0, 0]) == sub.size
+
+
+def test_counts_displs_shape_reference_math():
+    """Reference-name alias (heat/core/communication.py:211-240): remainder-
+    spread counts (NOT the padded physical placement of counts_displs),
+    cumsum displacements, and the all-equal-inputs receive shape."""
+    comm = ht.WORLD
+    p = comm.size
+    shape = (p * 3 + 1, 7)  # ragged along axis 0
+    counts, displs, out_shape = comm.counts_displs_shape(shape, 0)
+    assert len(counts) == p and sum(counts) == shape[0]
+    assert max(counts) - min(counts) <= 1  # remainder-spread, first ranks +1
+    assert counts[0] == 4 if p > 1 else counts[0] == shape[0]
+    assert displs == tuple(sum(counts[:r]) for r in range(p))
+    assert out_shape == (p * counts[comm.rank], 7)
+    # explicit-rank receive shape
+    _, _, tail_shape = comm.counts_displs_shape(shape, 0, rank=p - 1)
+    assert tail_shape == (p * counts[p - 1], 7)

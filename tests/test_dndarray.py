@@ -360,3 +360,17 @@ def test_halo_wider_than_shard_raises_or_clamps():
     except ValueError:
         return  # explicit rejection is fine (reference raises too)
     assert h.array_with_halos is not None
+
+
+def test_stride_strides_is_distributed():
+    """The last 3 public surface methods (reference dndarray.py:308,315,956):
+    torch-like element strides via a.stride(), numpy-like byte strides via
+    a.strides, and the split-and-multi-device predicate."""
+    a = ht.zeros((4, 6, 5), dtype=ht.float32, split=0)
+    assert a.stride() == (30, 5, 1)  # C-order over lshape (== logical shape)
+    assert a.strides == (120, 20, 4)  # elements * 4-byte itemsize
+    i = ht.zeros((3, 2), dtype=ht.int64)
+    assert i.stride() == (2, 1) and i.strides == (16, 8)
+    assert ht.zeros(()).stride() == () and ht.zeros(()).strides == ()
+    assert a.is_distributed() == (ht.WORLD.size > 1)
+    assert not ht.zeros((4, 4), split=None).is_distributed()

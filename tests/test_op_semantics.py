@@ -225,6 +225,12 @@ def test_keepdims_conflict_raises():
     h = ht.ones((4, 3), split=0)
     with pytest.raises(ValueError, match="conflicting"):
         ht.sum(h, axis=0, keepdim=True, keepdims=False)
+    # mean historically collapsed keepdims=False to None and silently kept
+    # dims (ADVICE r4 low): it must raise like the other reducers
+    with pytest.raises(ValueError, match="conflicting"):
+        ht.mean(h, axis=0, keepdim=True, keepdims=False)
+    assert ht.mean(h, axis=0, keepdims=False).shape == (3,)
+    assert ht.mean(h, axis=0, keepdims=True).shape == (1, 3)
 
 
 def test_std_var_keepdims_split_metadata():

@@ -122,6 +122,31 @@ def test_c128_when_supported():
 
 
 @requires_native_f64
+def test_f64_acceptance_tol_scales_with_dtype():
+    """ADVICE r4 low: the panel solve's residual gate must scale with the
+    working precision. A cond~1e12 f64 system certifies a ~3e-6 panel
+    residual — silently accepted by a flat 1e-3 gate, but ~8 digits short of
+    what f64 LAPACK delivers. It must warn-fallback and come back
+    backward-stable at f64 grade."""
+    from heat_tpu.core.linalg import _elimination
+
+    assert _elimination.acceptance_tol(np.float64) < 1e-6 < _elimination.acceptance_tol(np.float32) * 1e3
+    if not ht.get_comm().is_distributed():
+        pytest.skip("needs a multi-device mesh")
+    rng = np.random.default_rng(13)
+    n = 64
+    u, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    v, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    a_np = (u * np.logspace(0, -12, n)) @ v.T
+    b_np = rng.standard_normal(n)
+    with pytest.warns(UserWarning, match="falling back"):
+        x = ht.solve(ht.array(a_np, split=0), ht.array(b_np, split=0))
+    xn = x.numpy()
+    resid = np.abs(a_np @ xn - b_np).max() / (np.abs(xn).max() * np.abs(a_np).max())
+    assert resid < 1e-12, resid  # f64-grade backward stability, not f32-grade
+
+
+@requires_native_f64
 def test_f64_det_inv_distributed():
     """The round-4 blocked elimination path under x64 (the CPU-mesh numerics
     it was validated against)."""
