@@ -22,9 +22,9 @@ printing it:
 
 * trials are interleaved (short, long) pairs, so slow drift cancels out of the
   differenced rate instead of biasing one leg;
-* every pair is gated: a pair whose implied HBM bandwidth exceeds 1.05x the
-  chip's nominal roofline is *discarded* as a measurement artifact (the step
-  cannot move fewer bytes than one pass over the hoisted bf16 data);
+* every pair is gated against a physical traffic model and ceiling — a pair
+  implying traffic the silicon cannot sustain is *discarded* as a measurement
+  artifact;
 * gating continues over extra rounds until >= 3 valid pairs exist (or the
   pair budget runs out);
 * the headline ``value`` is the **median of the valid pairs** — never a max;
@@ -39,6 +39,29 @@ printing it:
   #9): ``matmul_mfu_tflops`` against the MXU peak and ``cdist_gbps`` against
   the HBM roofline, so chip weather can be told apart from a regression on
   more than one workload.
+
+Round-5 rework (VERDICT r4 #1 and #4; scripts/kmeans_hlo_audit.py):
+
+* The rounds-1-4 KMeans bytes model (one bf16 HBM pass + labels, 71.3 MB/iter
+  against nominal 819 GB/s — the "75% of HBM roofline" number) was a category
+  error: the compiled loop pins the bf16 copy of x, x_norm and the label
+  buffers in VMEM (HBM temp of the whole 30-iteration program: 2.3 MB), so
+  steady-state HBM traffic per iteration is ~zero. The audited per-iteration
+  traffic is 148.9 MB of VMEM (two GEMM-operand passes over bf16 x + three
+  label passes + the min-distance write) — doc/kmeans_hlo_audit.md.
+* The headline is therefore expressed against a *measured same-session* HBM
+  stream probe (``hbm_stream_gbps``): ``kmeans_vs_hbm_stream`` is the ratio
+  of the step's implied VMEM rate to that probe — >1 is operation no
+  HBM-bound formulation could reach. Pairs are gated at a 4x-of-stream
+  ceiling (no TPU generation streams VMEM faster than 4x its HBM); rates
+  below 1x of stream are possible (loaded chip) and are reported, not gated —
+  ``faster_than_hbm`` carries the claim.
+* The allreduce metric now obeys its own gate: the 1-chip fallback is an HBM
+  read+write roundtrip whose byte model is directly comparable to the HBM
+  roofline, so its pairs are gated at the same 1.05x ceiling as every other
+  metric (r4 shipped 114.2% with only a note). The ICI number it stands in
+  for is explicitly not measurable at n=1 (``ici_gbps: null``); the 8-device
+  dryrun psum (MULTICHIP_r05.json) is the multi-device correctness proxy.
 """
 
 import json
@@ -173,14 +196,73 @@ def _spread_pct(rates):
     return 100.0 * float(q75 - q25) / float(np.median(rates))
 
 
-def bench_tpu(data_np):
+def bench_hbm_stream():
+    """
+    Measured same-session HBM read-stream probe (VERDICT r4 #1: express the
+    headline against a measured stream rate, not the nominal 819). A 512 MB
+    f32 buffer — 4x too large for VMEM residency — is summed once per scan
+    step with a per-step scale factor (nothing replayable, scalar fetch);
+    bytes/step = one full read of the buffer. Gated at 1.05x the nominal HBM
+    roofline like every other metric.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    roofline = _lookup(dev, HBM_ROOFLINES_GBPS)
+    n_elem = 128 * 1024 * 1024  # 512 MB f32
+    rng = np.random.default_rng(3)
+    x = jax.device_put(
+        jnp.asarray(rng.random(n_elem, dtype=np.float32)), dev
+    )
+
+    def prog(x, fac, steps):
+        def body(carry, _):
+            s, f = carry
+            return (s + jnp.sum(x * f, dtype=jnp.float32), f * jnp.float32(1.0 + 2.0**-20)), None
+
+        (s, _), _ = jax.lax.scan(body, (jnp.float32(0.0), fac), None, length=steps)
+        return s
+
+    pj = jax.jit(prog, static_argnums=2)
+
+    def run(steps, eps):
+        t0 = time.perf_counter()
+        float(pj(x, jnp.float32(_perturb(eps, 2.0**-18)), steps))
+        return time.perf_counter() - t0
+
+    run(2, 0.0)  # compile + warm
+    calib = 2.0 / run(2, 1e-7)
+    bytes_per_step = n_elem * 4
+    valid, total, discarded = _gated_rates(run, calib, bytes_per_step, roofline)
+    if not valid:
+        return None, None, False
+    rate = float(np.median(valid))
+    gbps = bytes_per_step * rate / 1e9
+    pct = round(100.0 * gbps / roofline, 1) if roofline else None
+    return round(gbps, 1), pct, len(valid) >= MIN_VALID
+
+
+# Audited per-iteration traffic of the compiled Lloyd step at the bench shape
+# (scripts/kmeans_hlo_audit.py, doc/kmeans_hlo_audit.md): two GEMM-operand
+# passes over the VMEM-resident bf16 x + three s32 label passes + one bf16
+# min-distance write. Steady-state HBM traffic is ~0 (working set pinned in
+# VMEM; HBM temp of the whole program: 2.3 MB).
+KM_VMEM_BYTES_PER_ITER = 2 * (N * F * 2) + 3 * (N * 4) + N * 2
+# VMEM streams at most this multiple of the HBM stream rate on any TPU
+# generation — the physical corridor ceiling for the pair gate now that the
+# (fictitious) HBM ceiling no longer applies.
+VMEM_OVER_HBM_MAX = 4.0
+
+
+def bench_tpu(data_np, stream_gbps=None):
     import jax
     import jax.numpy as jnp
 
     from heat_tpu.cluster.kmeans import _kmeans_step, _kmeans_iterate
 
     dev = jax.devices()[0]
-    roofline = _lookup(dev, HBM_ROOFLINES_GBPS)
+    nominal_hbm = _lookup(dev, HBM_ROOFLINES_GBPS)
     x = jax.device_put(jnp.asarray(data_np), dev)
     centers = x[:K]
 
@@ -204,28 +286,43 @@ def bench_tpu(data_np):
     # roofline (doc/kmeans_northstar.md).
     np.asarray(_kmeans_iterate(x, centers, _kmeans_step, ITERS))  # compile+warm
     calib = ITERS / run(ITERS, 1e-7)
-    # physics floor: the step cannot move fewer bytes than ONE pass over the
-    # hoisted bf16 copy of x plus the int32 labels write — implied bandwidth at
-    # this minimal model above the chip's HBM roofline means the measurement is
-    # wrong, not that the kernel got faster (819 GB/s nominal on v5e puts the
-    # ceiling at ~11.5k iters/s for this shape)
-    bytes_floor = N * F * 2 + N * 4
-    valid, total, discarded = _gated_rates(run, calib, bytes_floor, roofline)
+    # Pair gate (r5): the audited traffic model is VMEM, so the ceiling is the
+    # physical corridor VMEM_OVER_HBM_MAX x the *measured same-session* HBM
+    # stream. _gated_rates discards pairs implying > 1.05x its roofline
+    # argument, so the corridor ceiling is passed pre-divided by 1.05.
+    ceiling = (
+        VMEM_OVER_HBM_MAX * stream_gbps / 1.05
+        if stream_gbps
+        else (VMEM_OVER_HBM_MAX * nominal_hbm / 1.05 if nominal_hbm else None)
+    )
+    valid, total, discarded = _gated_rates(
+        run, calib, KM_VMEM_BYTES_PER_ITER, ceiling
+    )
     if valid:
         value = float(np.median(valid))
     else:  # every pair gated out — report the calibration rate, flagged invalid
         value = calib
-    implied_gbps = bytes_floor * value / 1e9
+    implied_vmem_gbps = KM_VMEM_BYTES_PER_ITER * value / 1e9
+    vs_stream = implied_vmem_gbps / stream_gbps if stream_gbps else None
+    jitter = _spread_pct(valid)
     measurement_valid = (
-        len(valid) >= MIN_VALID and (roofline is None or implied_gbps <= roofline)
+        len(valid) >= MIN_VALID
+        and jitter < 10.0
+        and (vs_stream is None or vs_stream <= VMEM_OVER_HBM_MAX)
     )
     return {
         "value": value,
-        "jitter_pct": _spread_pct(valid),
+        "jitter_pct": jitter,
         "per_iter_us": 1e6 / value,
-        "implied_hbm_gbps": implied_gbps,
-        "hbm_roofline_pct": (
-            round(100.0 * implied_gbps / roofline, 1) if roofline else None
+        "vmem_traffic_model_mb": round(KM_VMEM_BYTES_PER_ITER / 1e6, 1),
+        "implied_vmem_gbps": implied_vmem_gbps,
+        "kmeans_vs_hbm_stream": round(vs_stream, 2) if vs_stream else None,
+        # >1: the step moves its traffic faster than the chip's measured HBM
+        # stream — possible only because the working set is VMEM-resident
+        "faster_than_hbm": bool(vs_stream and vs_stream > 1.0),
+        "hbm_note": (
+            "steady-state HBM/iter ~0: bf16 x + labels are VMEM-resident "
+            "across the fori_loop (audit: doc/kmeans_hlo_audit.md)"
         ),
         "measurement_valid": bool(measurement_valid),
         "pairs_valid": len(valid),
@@ -417,18 +514,27 @@ def bench_allreduce():
 
     devs = jax.devices()
     mesh = Mesh(np.asarray(devs), ("d",))
-    # 256 MB only: the differenced-chain method needs the long leg's device time
-    # (tens of ms) to dominate dispatch jitter — small buffers make dt fragile
-    # and a max-over-sizes then reports whichever noise inflated most
-    best = bench_size(mesh, 256 * 1024 * 1024, trials=4)
     plat = devs[0].platform
     if plat == "tpu":
-        roofline = 819.0 if len(devs) == 1 else 186.0 * len(devs) / 2
+        roofline = (
+            _lookup(devs[0], HBM_ROOFLINES_GBPS) or 819.0
+            if len(devs) == 1
+            else 186.0 * len(devs) / 2
+        )
         kind = "HBM roundtrip" if len(devs) == 1 else "ICI allreduce"
     else:
         roofline, kind = None, "host memory (CPU mesh)"
+    # 256 MB only: the differenced-chain method needs the long leg's device time
+    # (tens of ms) to dominate dispatch jitter — small buffers make dt fragile
+    # and a max-over-sizes then reports whichever noise inflated most.
+    # Pairs are gated at 1.05x the roofline (the roundtrip bytes model counts
+    # both directions, so its rate is directly comparable to the HBM roofline).
+    best, n_valid, n_discarded = bench_size(
+        mesh, 256 * 1024 * 1024, trials=4, ceiling_gbps=roofline, return_stats=True
+    )
     pct = round(100.0 * best / roofline, 1) if roofline else None
-    return round(best, 2), pct, f"{kind}, {len(devs)} device(s)"
+    ar_valid = n_valid >= 2 and (roofline is None or best <= 1.05 * roofline)
+    return round(best, 2), pct, f"{kind}, {len(devs)} device(s)", ar_valid
 
 
 def bench_scaling_8dev():
@@ -476,7 +582,13 @@ def bench_scaling_8dev():
 def main():
     rng = np.random.default_rng(0)
     data = _data(rng)
-    km = bench_tpu(data)
+    try:
+        stream_gbps, stream_pct, stream_valid = bench_hbm_stream()
+    except Exception:
+        stream_gbps = stream_pct = stream_valid = None
+    # a probe the bench itself flagged invalid must not set the headline's
+    # gate ceiling or its vs-stream ratio — fall back to the nominal roofline
+    km = bench_tpu(data, stream_gbps=stream_gbps if stream_valid else None)
     try:
         torch_ips = bench_torch_cpu(data)
         vs = km["value"] / torch_ips
@@ -491,9 +603,9 @@ def main():
     except Exception:
         cdist_gbps = cdist_pct = cdist_valid = None
     try:
-        ar_gbps, ar_pct, ar_note = bench_allreduce()
+        ar_gbps, ar_pct, ar_note, ar_valid = bench_allreduce()
     except Exception:
-        ar_gbps = ar_pct = ar_note = None
+        ar_gbps = ar_pct = ar_note = ar_valid = None
     try:
         scale8_ips, scale8_overhead = bench_scaling_8dev()
     except Exception:
@@ -509,8 +621,14 @@ def main():
                 "measurement_valid": km["measurement_valid"],
                 "jitter_pct": round(km["jitter_pct"], 2),
                 "per_iter_us": round(km["per_iter_us"], 2),
-                "implied_hbm_gbps": round(km["implied_hbm_gbps"], 1),
-                "hbm_roofline_pct": km["hbm_roofline_pct"],
+                "vmem_traffic_model_mb": km["vmem_traffic_model_mb"],
+                "implied_vmem_gbps": round(km["implied_vmem_gbps"], 1),
+                "kmeans_vs_hbm_stream": km["kmeans_vs_hbm_stream"],
+                "faster_than_hbm": km["faster_than_hbm"],
+                "hbm_note": km["hbm_note"],
+                "hbm_stream_gbps": stream_gbps,
+                "hbm_stream_roofline_pct": stream_pct,
+                "hbm_stream_valid": stream_valid,
                 "pairs_valid": km["pairs_valid"],
                 "pairs_discarded": km["pairs_discarded"],
                 "baseline_iters_per_sec_torch_cpu": round(torch_ips, 3) if torch_ips else None,
@@ -523,6 +641,12 @@ def main():
                 "allreduce_gbps": ar_gbps,
                 "allreduce_roofline_pct": ar_pct,
                 "allreduce_note": ar_note,
+                "allreduce_valid": ar_valid,
+                # the BASELINE.json metric is ICI bandwidth: not measurable on
+                # one chip — the 8-device dryrun's psum (MULTICHIP_r05.json)
+                # is the multi-device correctness-side proxy
+                "ici_gbps": None,
+                "ici_note": "not measurable at n_devices=1; psum proven in multichip dryrun",
                 "dp8_cpu_iters_per_sec": scale8_ips,
                 "dp8_cpu_sharding_overhead_pct": scale8_overhead,
             }
