@@ -105,17 +105,17 @@ def __build_bcgs(mesh, axis: str, p: int, m: int, n: int, jdtype: str):
     )
 
 
-def __tsqr(a: DNDarray) -> Tuple[jax.Array, jax.Array]:
-    """Tall-skinny QR over the row-sharded global array via shard_map."""
-    comm: MeshCommunication = a.comm
-    mesh = comm.mesh
-    axis = comm.axis_name
-    p = comm.size
-    m, n = a.shape
+@functools.lru_cache(maxsize=64)
+def _build_tsqr(mesh, axis: str, p: int):
+    """Compile the single-level TSQR sweep: per-device panel QR, an all-gather
+    of the (n, n) R factors ONLY (never the operand), a redundant (p*n, n) QR,
+    and the local correction GEMM. Builder-shaped so the AOT multi-chip suite
+    (tests/test_tpu_aot.py) can compile it against a v5e topology."""
 
     def local(block):
         q1, r1 = jnp.linalg.qr(block)  # (m/p, n), (n, n)
         r_stack = jax.lax.all_gather(r1, axis)  # (p, n, n)
+        n = r1.shape[0]
         q2, r = jnp.linalg.qr(r_stack.reshape(p * n, n))  # (p*n, n), (n, n)
         i = jax.lax.axis_index(axis)
         q2_block = jax.lax.dynamic_slice_in_dim(q2, i * n, n, axis=0)  # (n, n)
@@ -123,14 +123,21 @@ def __tsqr(a: DNDarray) -> Tuple[jax.Array, jax.Array]:
         q = jnp.matmul(q1, q2_block, precision=jax.lax.Precision.HIGHEST)
         return q, r
 
-    fn = jax.shard_map(
-        local,
-        mesh=mesh,
-        in_specs=P(axis, None),
-        out_specs=(P(axis, None), P(None, None)),
-        check_vma=False,
+    return jax.jit(
+        jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=P(axis, None),
+            out_specs=(P(axis, None), P(None, None)),
+            check_vma=False,
+        )
     )
-    return fn(a.larray)
+
+
+def __tsqr(a: DNDarray) -> Tuple[jax.Array, jax.Array]:
+    """Tall-skinny QR over the row-sharded global array via shard_map."""
+    comm: MeshCommunication = a.comm
+    return _build_tsqr(comm.mesh, comm.axis_name, comm.size)(a.larray)
 
 
 def qr(
