@@ -114,7 +114,8 @@ def _data(rng, n=N):
 
 
 def _gated_rates(
-    run, calib_rate, bytes_per_iter, roofline_gbps, long_seconds=0.8, min_valid=None
+    run, calib_rate, bytes_per_iter, roofline_gbps, long_seconds=0.8, min_valid=None,
+    gates=None,
 ):
     """
     Physics-gated per-iteration rates from interleaved (short, long) pairs.
@@ -137,8 +138,20 @@ def _gated_rates(
     stopping). The headline passes a larger target so one transient
     host-load patch cannot dominate its median.
 
+    ``gates`` generalises the single roofline to several: a list of
+    ``(units_per_iter, ceiling_units_per_sec)`` pairs (ceiling ``None`` =
+    ungated); a pair is discarded if ANY gate is exceeded. The default is the
+    single ``(bytes_per_iter, roofline_gbps)`` gate. linalg_bench passes a
+    dual MXU-flops + HBM-bytes gate through this same loop so both bench
+    surfaces share one measurement semantics.
+
     Returns ``(valid_rates, n_total_pairs, n_discarded)``.
     """
+    gate_list = (
+        gates
+        if gates is not None
+        else [(bytes_per_iter, None if roofline_gbps is None else roofline_gbps * 1e9)]
+    )
     # ``calib_rate`` comes from an un-differenced run and is dispatch-polluted
     # (the ~100 ms tunnel RPC makes it a 10-100x *under*estimate of the device
     # rate for millisecond workloads), so the legs it suggests can be far too
@@ -180,7 +193,7 @@ def _gated_rates(
                     f"rate={rate:.1f}/s implied={implied:.1f}",
                     file=sys.stderr,
                 )
-            if roofline_gbps is not None and implied > 1.05 * roofline_gbps:
+            if any(c is not None and u * rate > 1.05 * c for u, c in gate_list):
                 discarded += 1  # measurement artifact, not a faster kernel
             elif not np.isfinite(rate) or rate <= 0:
                 discarded += 1
@@ -634,8 +647,11 @@ def main():
             from linalg_bench import bench_linalg
 
             linalg = bench_linalg()
-        except Exception:
-            linalg = {}
+        except Exception as e:
+            # explicit null-valued keys, like the neighbouring benches: a
+            # crashed anchor must be distinguishable from a BENCH_FAST skip
+            linalg = {f"{op}_valid": None for op in ("qr", "svd", "solve", "det")}
+            linalg["linalg_error"] = repr(e)[:160]
     print(
         json.dumps(
             {

@@ -44,13 +44,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from bench import (  # noqa: E402  (repo-root bench.py: shared gate machinery)
     HBM_ROOFLINES_GBPS,
     MXU_PEAKS_TFLOPS,
-    MIN_VALID,
+    _gated_rates,
     _lookup,
     _perturb,
     _spread_pct,
 )
 
-MAX_PAIRS = 10
 LONG_SECONDS = 0.5  # target device time of the differenced pair
 
 
@@ -93,27 +92,17 @@ def bench_op(name, op, x_np, flops_floor, mxu_peak, hbm_roofline):
         return time.perf_counter() - t0
 
     run(1, 0.0)  # compile + warm (single executable for all leg lengths)
-    # size the long leg so the differenced time dominates dispatch jitter
-    per_step = max(run(8, 1e-7) - run(2, 2e-7), 1e-3) / 6.0
-    long = int(np.clip(LONG_SECONDS / per_step, 12, 400))
-    short = max(2, long // 8)
-    valid, discarded = [], 0
-    for pair in range(MAX_PAIRS):
-        t_s = run(short, 1e-6 * (2 * pair + 1))
-        t_l = run(long, 1e-6 * (2 * pair + 2))
-        dt = t_l - t_s
-        rate = (long - short) / dt if dt > 0 else float("inf")
-        ok = np.isfinite(rate) and rate > 0
-        if ok and mxu_peak is not None and flops_floor * rate / 1e12 > 1.05 * mxu_peak:
-            ok = False
-        if ok and hbm_roofline is not None and bytes_floor * rate / 1e9 > 1.05 * hbm_roofline:
-            ok = False
-        if ok:
-            valid.append(rate)
-        else:
-            discarded += 1
-        if len(valid) >= MIN_VALID and pair >= 3:
-            break
+    # un-differenced rate estimate seeds the shared leg-sizing loop
+    calib = 6.0 / max(run(8, 1e-7) - run(2, 2e-7), 1e-3)
+    # dual physics gate through bench.py's shared pair loop (one measurement
+    # semantics for the headline and these anchors)
+    gates = [
+        (flops_floor, None if mxu_peak is None else mxu_peak * 1e12),
+        (bytes_floor, None if hbm_roofline is None else hbm_roofline * 1e9),
+    ]
+    valid, total, discarded = _gated_rates(
+        run, calib, bytes_floor, hbm_roofline, long_seconds=LONG_SECONDS, gates=gates
+    )
     if not valid:
         return {f"{name}_valid": False, f"{name}_pairs_discarded": discarded}
     rate = float(np.median(valid))
@@ -123,7 +112,7 @@ def bench_op(name, op, x_np, flops_floor, mxu_peak, hbm_roofline):
         f"{name}_mxu_pct": round(100.0 * tflops / mxu_peak, 1) if mxu_peak else None,
         f"{name}_ms": round(1e3 / rate, 2),
         f"{name}_jitter_pct": round(_spread_pct(valid), 2),
-        f"{name}_valid": len(valid) >= MIN_VALID,
+        f"{name}_valid": True,
         f"{name}_pairs_discarded": discarded,
     }
 

@@ -86,6 +86,7 @@ def __binary_op(
     applies the jnp callable on the global arrays.
     """
     from . import factories
+    from . import types
     from .types import canonical_heat_type, result_type
 
     fn_kwargs = fn_kwargs or {}
@@ -100,6 +101,10 @@ def __binary_op(
         t1 = factories.array(t1)
 
     promoted = result_type(t1, t2)
+    if operation is jnp.true_divide and not types.heat_type_is_inexact(promoted):
+        # true division of exact (int/bool) operands is float (reference
+        # arithmetics.py div == torch.true_divide promotion)
+        promoted = types.promote_types(promoted, types.float32)
 
     arrays = []
     dnd_ops = []

@@ -697,6 +697,8 @@ def _build_collective(comm: "MeshCommunication", kind: str, split: int, ndim: in
         def body(b):
             c = cumfn(b, axis=split)
             n_loc = b.shape[split]
+            if n_loc == 0:  # 0-size split axis: nothing to exchange
+                return c
             tot = lax.slice_in_dim(c, n_loc - 1, n_loc, axis=split)
             g = lax.all_gather(tot, ax, axis=split, tiled=True)  # (..., p, ...)
             first = jax.numpy.full_like(lax.slice_in_dim(g, 0, 1, axis=split), neutral)

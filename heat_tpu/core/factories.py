@@ -273,7 +273,9 @@ def __factory(
     split = sanitize_axis(shape, split)
     device = devices.sanitize_device(device)
     comm = sanitize_comm(comm)
-    if __distributed(split, comm) and len(shape):
+    # 0-size arrays take the local path: XLA canonicalises an empty output to a
+    # replicated sharding, which trips the out_shardings assertion in the builder
+    if __distributed(split, comm) and len(shape) and all(shape):
         pshape = comm.padded_shape(shape, split)
         build = __sharded_builder(
             "full", pshape, np.dtype(dtype.jnp_type()).name, comm.sharding(len(shape), split)
@@ -381,7 +383,7 @@ def eye(
     dtype = canonical_heat_type(dtype)
     comm_r = sanitize_comm(comm)
     split_s = sanitize_axis((n, m), split)
-    if __distributed(split_s, comm_r):
+    if __distributed(split_s, comm_r) and n and m:
         pshape = comm_r.padded_shape((n, m), split_s)
         build = __sharded_builder(
             "eye", pshape, np.dtype(dtype.jnp_type()).name, comm_r.sharding(2, split_s)
@@ -442,7 +444,7 @@ def linspace(
         raise ValueError(f"number of samples 'num' must be non-negative, got {num}")
     step = (stop - start) / max(1, num - int(bool(endpoint)))
     comm_r = sanitize_comm(comm)
-    if __distributed(sanitize_axis((num,), split), comm_r):
+    if __distributed(sanitize_axis((num,), split), comm_r) and num:
         if dtype is not None:
             dt = canonical_heat_type(dtype)
         else:

@@ -1,0 +1,1466 @@
+"""
+Surface-sweep differential fuzzer (VERDICT r4 #6).
+
+The chain fuzzer (test_fuzz_differential.py) composes deep op chains over a
+small op table; this module is the *width* counterpart: one spec per public
+``ht.*`` callable, each swept over a randomized case matrix of
+
+  shape      — even-over-mesh, ragged prime (5/7/11/13), tiny, and 0-size axes
+  split      — None or any axis
+  dtype      — float32, int32, bool, complex64 (where the backend has it),
+               and float64 under a genuine ``jax.enable_x64`` context
+
+with a numpy (or scipy, for the stats heads) shadow oracle and the
+three-level comparator ``heat_tpu.testing.assert_array_equal`` (dtype,
+per-shard placement, values). numpy semantics ARE the reference's contract —
+its API is numpy-compatible by design (SURVEY.md §2.2); where the reference
+deliberately follows torch instead (topk/histc/bucketize/nonzero), the oracle
+encodes the torch convention, cited in the spec.
+
+* Reproducible: every case is determined by (op name, case index) via
+  ``crc32`` — a failure message names both, and ``run_case(name, i)`` replays.
+* Coverage is enforced: ``test_surface_coverage`` computes the fraction of
+  top-level ``ht.*`` functions exercised by this sweep plus the chain
+  fuzzer's table and fails below 80% (VERDICT r4 #6 acceptance bar).
+* Teeth: ``test_planted_bug_is_caught`` skews one op and asserts the sweep
+  fails it.
+
+Case count scales via ``HEAT_TPU_FUZZ_CASES`` (CI's fuzz job raises it so the
+total sweep lands at ~10^4 cases, ci.yaml).
+"""
+
+import inspect
+import os
+import types
+import zlib
+
+import numpy as np
+import pytest
+import scipy.stats as sps
+
+import jax
+
+import heat_tpu as ht
+import heat_tpu.testing as htt
+from heat_tpu.core.dndarray import DNDarray
+
+from _accel import COMPLEX_SUPPORTED, ON_ACCELERATOR, tol
+
+# real-accelerator dispatch is ~100 ms/op through the tunnel: keep a thin slice
+# there, full width on the CPU mesh / CI
+N_CASES = int(os.environ.get("HEAT_TPU_FUZZ_CASES", "2" if ON_ACCELERATOR else "5"))
+
+P = ht.WORLD.size
+
+
+# ------------------------------------------------------------------- registry
+class Spec:
+    __slots__ = ("name", "fn", "dtypes", "min_ndim", "empty_ok", "kind", "check_dtype")
+
+    def __init__(self, name, fn, dtypes, min_ndim, empty_ok, kind, check_dtype):
+        self.name, self.fn, self.dtypes = name, fn, dtypes
+        self.min_ndim, self.empty_ok, self.kind = min_ndim, empty_ok, kind
+        self.check_dtype = check_dtype
+
+
+SPECS = {}
+
+SKIP = object()  # a spec returns this when the drawn input doesn't suit it
+
+
+def reg(name, fn, dtypes="f", min_ndim=1, empty_ok=True, kind="arr", check_dtype=True):
+    assert name not in SPECS, name
+    assert callable(getattr(ht, name)), name
+    SPECS[name] = Spec(name, fn, dtypes, min_ndim, empty_ok, kind, check_dtype)
+
+
+# dtype letters: f=float, i=int, b=bool, c=complex. Drawn per case; the x64
+# case upgrades f->float64 inside jax.enable_x64.
+def _np_dtype(letter, x64):
+    return {
+        "f": np.float64 if x64 else np.float32,
+        "i": np.int32,
+        "b": np.bool_,
+        "c": np.complex64,
+    }[letter]
+
+
+def unary(name, dtypes="f", np_fn=None, prep=None, **kw):
+    """fn(x) with a same-named numpy oracle (or np_fn); prep conditions the
+    drawn data into the op's domain (numpy-level, before wrapping)."""
+    npf = np_fn if np_fn is not None else getattr(np, name)
+    htf = getattr(ht, name)
+
+    def fn(rng, h, a):
+        return htf(h), npf(a)
+
+    reg(name, fn, dtypes, **kw)
+    if prep is not None:
+        PREP[name] = prep
+
+
+def binary(name, dtypes="f", np_fn=None, other="like", **kw):
+    """fn(x, y): y is a same-shape array ("like"), a broadcastable row
+    ("bcast"), a positive array ("pos"), or a small non-negative int array
+    ("shift")."""
+    npf = np_fn if np_fn is not None else getattr(np, name)
+    htf = getattr(ht, name)
+
+    def fn(rng, h, a):
+        b = _second_operand(rng, a, other)
+        split = h.split if b.shape == a.shape else None
+        hb = ht.array(b, split=split)
+        return htf(h, hb), npf(a, b)
+
+    reg(name, fn, dtypes, **kw)
+
+
+def reduction(name, dtypes="f", np_fn=None, axis_none_ok=True, **kw):
+    """fn(x, axis=...) over a randomly drawn non-empty axis (or full)."""
+    npf = np_fn if np_fn is not None else getattr(np, name)
+    htf = getattr(ht, name)
+
+    def fn(rng, h, a):
+        ax = _nonempty_axis(rng, a, none_ok=axis_none_ok)
+        if ax is SKIP:
+            return SKIP
+        return htf(h, axis=ax), npf(a, axis=ax)
+
+    kw.setdefault("empty_ok", True)
+    reg(name, fn, dtypes, **kw)
+
+
+PREP = {}
+
+
+def _second_operand(rng, a, other):
+    if other == "like":
+        b = rng.standard_normal(a.shape)
+    elif other == "bcast":
+        b = rng.standard_normal(a.shape[-1:] if a.ndim else ())
+    elif other == "pos":
+        b = np.abs(rng.standard_normal(a.shape)) + 0.5
+    elif other == "shift":
+        return rng.integers(0, 5, size=a.shape).astype(a.dtype)
+    else:  # pragma: no cover
+        raise ValueError(other)
+    if a.dtype.kind in "iu":
+        b = np.round(b * 3).astype(a.dtype)
+        if other == "pos":
+            b = np.abs(b) + 1
+    elif a.dtype.kind == "b":
+        b = (b > 0).astype(np.bool_)
+    elif a.dtype.kind == "c":
+        b = (b + 1j * rng.standard_normal(b.shape)).astype(a.dtype)
+    else:
+        b = b.astype(a.dtype)
+    return b
+
+
+def _nonempty_axis(rng, a, none_ok=True):
+    """An axis with nonzero extent; None (full reduction) only when the whole
+    array is nonempty."""
+    axes = [d for d in range(a.ndim) if a.shape[d] > 0]
+    if none_ok and a.size > 0 and rng.integers(0, 4) == 0:
+        return None
+    if not axes:
+        return SKIP
+    return int(axes[rng.integers(0, len(axes))])
+
+
+def _rand_axis(rng, a):
+    return int(rng.integers(0, a.ndim)) if a.ndim else 0
+
+
+# =========================================================== elementwise unary
+_clip4 = lambda a: np.clip(a, -4.0, 4.0)
+_unit = lambda a: np.tanh(a) * 0.99  # into (-1, 1) for arc domains
+_pos = lambda a: np.abs(a) + 0.5
+
+for n in ["sin", "cos", "tan", "sinh", "cosh", "tanh"]:
+    unary(n, prep=_clip4)
+for n, npn in [("arcsin", None), ("arccos", None), ("arctanh", None),
+               ("asin", "arcsin"), ("acos", "arccos"), ("atanh", "arctanh")]:
+    unary(n, np_fn=getattr(np, npn) if npn else None, prep=_unit)
+for n, npn in [("arccosh", None), ("acosh", "arccosh")]:
+    unary(n, np_fn=getattr(np, npn) if npn else None, prep=lambda a: 1.0 + np.abs(a))
+for n, npn in [("arctan", None), ("arcsinh", None), ("atan", "arctan"),
+               ("asinh", "arcsinh")]:
+    unary(n, np_fn=getattr(np, npn) if npn else None)
+for n in ["deg2rad", "rad2deg", "degrees", "radians"]:
+    unary(n)
+for n in ["exp", "exp2", "expm1"]:
+    unary(n, prep=_clip4)
+for n in ["log", "log2", "log10"]:
+    unary(n, prep=_pos)
+unary("log1p", prep=lambda a: np.abs(a))
+unary("sqrt", prep=lambda a: np.abs(a))
+unary("square", dtypes="fi")
+unary("fabs")
+for n in ["floor", "ceil", "trunc"]:
+    unary(n)
+unary("round", dtypes="f")
+unary("abs", dtypes="fi")
+unary("absolute", dtypes="fi", np_fn=np.abs)
+unary("neg", dtypes="fi", np_fn=np.negative)
+unary("negative", dtypes="fi")
+unary("pos", dtypes="fi", np_fn=np.positive)
+unary("positive", dtypes="fi")
+unary("sign", dtypes="fi")
+unary("sgn", dtypes="fi", np_fn=np.sign)
+unary("signbit")
+
+# NaN/Inf probes get NaN and +-Inf planted into the drawn data
+_naninf = lambda a: _plant_naninf(a)
+
+
+def _plant_naninf(a):
+    a = a.copy().reshape(-1)
+    if a.size >= 3:
+        a[0], a[1], a[2] = np.nan, np.inf, -np.inf
+    return a
+
+
+for n in ["isfinite", "isnan", "isinf", "isneginf", "isposinf"]:
+    unary(n, prep=_naninf)
+unary("nan_to_num", prep=_naninf)
+unary("bitwise_not", dtypes="ib", np_fn=np.bitwise_not)
+unary("invert", dtypes="ib")
+unary("logical_not", dtypes="bif")
+
+_cplx = "c" if COMPLEX_SUPPORTED else "f"
+unary("conj", dtypes=_cplx)
+unary("conjugate", dtypes=_cplx)
+unary("real", dtypes=_cplx)
+unary("angle", dtypes=_cplx)
+# imag/iscomplex/isreal of a real array are trivially 0/False/True; the
+# complex-dtype case is the one that matters, so keep them complex-gated
+if COMPLEX_SUPPORTED:
+    unary("imag", dtypes="c")
+    unary("iscomplex", dtypes="c")
+    unary("isreal", dtypes="c")
+
+# ========================================================== elementwise binary
+for n in ["add", "sub", "mul", "div"]:
+    binary(n, dtypes="fi",
+           np_fn={"sub": np.subtract, "mul": np.multiply, "div": np.divide}.get(n),
+           other="pos" if n == "div" else "like")
+binary("subtract", dtypes="fi")
+binary("multiply", dtypes="fi")
+binary("divide", dtypes="f", other="pos")
+binary("floordiv", dtypes="fi", np_fn=np.floor_divide, other="pos")
+binary("floor_divide", dtypes="fi", other="pos")
+binary("mod", dtypes="fi", np_fn=np.mod, other="pos")
+binary("fmod", dtypes="fi", other="pos")
+binary("remainder", dtypes="fi", other="pos")
+binary("pow", dtypes="f", np_fn=np.power, other="shift")
+binary("power", dtypes="f", other="shift")
+binary("arctan2", dtypes="f")
+binary("atan2", dtypes="f", np_fn=np.arctan2)
+binary("hypot", dtypes="f")
+binary("copysign", dtypes="f")
+binary("logaddexp", dtypes="f")
+binary("logaddexp2", dtypes="f")
+binary("maximum", dtypes="fi")
+binary("minimum", dtypes="fi")
+binary("left_shift", dtypes="i", other="shift")
+binary("right_shift", dtypes="i", other="shift")
+for n in ["bitwise_and", "bitwise_or", "bitwise_xor"]:
+    binary(n, dtypes="ib")
+for n in ["logical_and", "logical_or", "logical_xor"]:
+    binary(n, dtypes="b")
+for n, npn in [("eq", "equal"), ("ne", "not_equal"), ("lt", "less"),
+               ("le", "less_equal"), ("gt", "greater"), ("ge", "greater_equal")]:
+    binary(n, dtypes="fi", np_fn=getattr(np, npn))
+for n in ["not_equal", "less", "less_equal", "greater", "greater_equal"]:
+    binary(n, dtypes="fi")
+binary("isclose", dtypes="f")
+
+
+def _allclose(rng, h, a):
+    b = a + (1e-9 if a.dtype.kind == "f" else 0)
+    return ht.allclose(h, ht.array(b, split=h.split)), np.allclose(a, b)
+
+
+def _equal(rng, h, a):
+    # whole-array equality -> python bool (reference relational.py equal ==
+    # torch.equal semantics; elementwise spelling is ht.eq)
+    same = bool(rng.integers(0, 2))
+    b = a if same else _second_operand(rng, a, "like")
+    return ht.equal(h, ht.array(b, split=h.split)), np.array_equal(a, b)
+
+
+reg("equal", _equal, "fi")
+
+
+reg("allclose", _allclose, "fi")
+
+# ================================================================= reductions
+reduction("sum", dtypes="fi")
+reduction("prod", dtypes="f")
+reduction("nansum", dtypes="f")
+reduction("nanprod", dtypes="f")
+reduction("max", dtypes="fi", axis_none_ok=False, empty_ok=False)
+reduction("min", dtypes="fi", axis_none_ok=False, empty_ok=False)
+reduction("nanmax", dtypes="f", axis_none_ok=False, empty_ok=False)
+reduction("nanmin", dtypes="f", axis_none_ok=False, empty_ok=False)
+reduction("mean", dtypes="f")
+reduction("nanmean", dtypes="f")
+reduction("median", dtypes="f", axis_none_ok=False, empty_ok=False)
+reduction("std", dtypes="f")
+reduction("var", dtypes="f")
+reduction("argmax", dtypes="f", axis_none_ok=False, empty_ok=False)
+reduction("argmin", dtypes="f", axis_none_ok=False, empty_ok=False)
+reduction("any", dtypes="b")
+reduction("all", dtypes="b")
+reduction("count_nonzero", dtypes="fib")
+
+
+def _cum(name, npf):
+    htf = getattr(ht, name)
+
+    def fn(rng, h, a):
+        ax = _rand_axis(rng, a)
+        return htf(h, axis=ax), npf(a, axis=ax)
+
+    reg(name, fn, "fi")
+
+
+_cum("cumsum", np.cumsum)
+_cum("cumprod", np.cumprod)
+_cum("cumproduct", np.cumprod)
+
+
+def _average(rng, h, a):
+    ax = _nonempty_axis(rng, a, none_ok=False)
+    if ax is SKIP:
+        return SKIP
+    w = np.abs(np.random.default_rng(0).standard_normal(a.shape[ax])) + 0.1
+    w = w.astype(a.dtype)
+    return (
+        ht.average(h, axis=ax, weights=ht.array(w)),
+        np.average(a, axis=ax, weights=w),
+    )
+
+
+reg("average", _average, "f", empty_ok=False)
+
+
+def _skew(rng, h, a):
+    ax = _nonempty_axis(rng, a, none_ok=False)
+    if ax is SKIP or a.shape[ax] < 3:
+        return SKIP
+    return ht.skew(h, axis=ax, unbiased=False), sps.skew(a, axis=ax, bias=True)
+
+
+def _kurtosis(rng, h, a):
+    ax = _nonempty_axis(rng, a, none_ok=False)
+    if ax is SKIP or a.shape[ax] < 4:
+        return SKIP
+    return (
+        ht.kurtosis(h, axis=ax, unbiased=False),
+        sps.kurtosis(a, axis=ax, fisher=True, bias=True),
+    )
+
+
+reg("skew", _skew, "f", empty_ok=False, check_dtype=False)
+reg("kurtosis", _kurtosis, "f", empty_ok=False, check_dtype=False)
+
+
+def _percentile(rng, h, a):
+    ax = _nonempty_axis(rng, a, none_ok=False)
+    if ax is SKIP:
+        return SKIP
+    q = float(rng.integers(0, 101))
+    return (
+        ht.percentile(h, q, axis=ax),
+        np.percentile(a.astype(np.float64), q, axis=ax, method="linear"),
+    )
+
+
+reg("percentile", _percentile, "f", empty_ok=False, check_dtype=False)
+
+
+def _cov(rng, h, a):
+    n, m = int(rng.integers(2, 7)), int(rng.integers(3, 9))
+    x = rng.standard_normal((n, m)).astype(np.float32)
+    hx = ht.array(x, split=int(rng.integers(0, 2)) if rng.integers(0, 2) else None)
+    return ht.cov(hx), np.cov(x)
+
+
+reg("cov", _cov, "f", kind="none", check_dtype=False)
+
+# ============================================================== manipulations
+def _axed(name, npf=None, dtypes="fib"):
+    htf = getattr(ht, name)
+    npf = npf or getattr(np, name)
+
+    def fn(rng, h, a):
+        ax = _rand_axis(rng, a)
+        return htf(h, ax), npf(a, ax)
+
+    reg(name, fn, dtypes)
+
+
+_axed("flip")
+
+
+def _roll(rng, h, a):
+    ax = _rand_axis(rng, a)
+    k = int(rng.integers(-3, 4))
+    return ht.roll(h, k, axis=ax), np.roll(a, k, axis=ax)
+
+
+reg("roll", _roll, "fib")
+
+
+def _fliplr(rng, h, a):
+    return ht.fliplr(h), np.fliplr(a)
+
+
+def _flipud(rng, h, a):
+    return ht.flipud(h), np.flipud(a)
+
+
+reg("fliplr", _fliplr, "fib", min_ndim=2)
+reg("flipud", _flipud, "fib")
+
+
+def _rot90(rng, h, a):
+    k = int(rng.integers(-1, 3))
+    return ht.rot90(h, k), np.rot90(a, k)
+
+
+reg("rot90", _rot90, "fi", min_ndim=2)
+
+
+def _squeeze(rng, h, a):
+    ax = int(rng.integers(0, a.ndim + 1))
+    return ht.squeeze(ht.expand_dims(h, ax), ax), a
+
+
+reg("squeeze", _squeeze, "fib")
+
+
+def _expand_dims(rng, h, a):
+    ax = int(rng.integers(0, a.ndim + 1))
+    return ht.expand_dims(h, ax), np.expand_dims(a, ax)
+
+
+reg("expand_dims", _expand_dims, "fib")
+
+
+def _reshape(rng, h, a):
+    return ht.reshape(h, (-1,)), a.reshape(-1)
+
+
+reg("reshape", _reshape, "fib")
+reg("ravel", lambda rng, h, a: (ht.ravel(h), np.ravel(a)), "fib")
+reg("flatten", lambda rng, h, a: (ht.flatten(h), a.reshape(-1)), "fib")
+
+
+def _moveaxis(rng, h, a):
+    if a.ndim < 2:
+        return SKIP
+    s = _rand_axis(rng, a)
+    d = _rand_axis(rng, a)
+    return ht.moveaxis(h, s, d), np.moveaxis(a, s, d)
+
+
+def _swapaxes(rng, h, a):
+    if a.ndim < 2:
+        return SKIP
+    s = _rand_axis(rng, a)
+    d = _rand_axis(rng, a)
+    return ht.swapaxes(h, s, d), np.swapaxes(a, s, d)
+
+
+reg("moveaxis", _moveaxis, "fib", min_ndim=2)
+reg("swapaxes", _swapaxes, "fib", min_ndim=2)
+reg("transpose", lambda rng, h, a: (ht.transpose(h), a.T), "fib")
+
+
+def _repeat(rng, h, a):
+    r = int(rng.integers(1, 4))
+    ax = _rand_axis(rng, a)
+    return ht.repeat(h, r, axis=ax), np.repeat(a, r, axis=ax)
+
+
+reg("repeat", _repeat, "fi")
+
+
+def _tile(rng, h, a):
+    reps = tuple(int(rng.integers(1, 3)) for _ in range(a.ndim))
+    return ht.tile(h, reps), np.tile(a, reps)
+
+
+reg("tile", _tile, "fi")
+
+
+def _pad(rng, h, a):
+    w = tuple((int(rng.integers(0, 3)), int(rng.integers(0, 3))) for _ in range(a.ndim))
+    return ht.pad(h, w), np.pad(a, w)
+
+
+reg("pad", _pad, "fi")
+
+
+def _broadcast_to(rng, h, a):
+    tgt = (3,) + a.shape
+    return ht.broadcast_to(h, tgt), np.broadcast_to(a, tgt)
+
+
+reg("broadcast_to", _broadcast_to, "fi")
+
+
+def _concat(rng, h, a):
+    ax = _rand_axis(rng, a)
+    return ht.concatenate([h, h], axis=ax), np.concatenate([a, a], axis=ax)
+
+
+reg("concatenate", _concat, "fib")
+
+
+def _stack(rng, h, a):
+    ax = int(rng.integers(0, a.ndim + 1))
+    return ht.stack([h, h], axis=ax), np.stack([a, a], axis=ax)
+
+
+reg("stack", _stack, "fib")
+
+def _mk_stack(name, npf):
+    htf = getattr(ht, name)
+
+    def fn(rng, h, a):
+        return htf([h, h]), npf([a, a])
+
+    reg(name, fn, "fi")
+
+
+_mk_stack("hstack", np.hstack)
+_mk_stack("vstack", np.vstack)
+_mk_stack("column_stack", np.column_stack)
+_mk_stack("row_stack", np.vstack)
+
+
+def _split(rng, h, a):
+    n = 2 * int(rng.integers(1, 9))
+    x = rng.standard_normal((n, int(rng.integers(1, 5)))).astype(np.float32)
+    hx = ht.array(x, split=int(rng.integers(0, 2)) if rng.integers(0, 2) else None)
+    return ht.split(hx, 2, axis=0), np.split(x, 2, axis=0)
+
+
+reg("split", _split, "fi", kind="none")
+
+
+def _mk_xsplit(name, npf, need_dim):
+    htf = getattr(ht, name)
+    axis = {"hsplit": 1, "vsplit": 0, "dsplit": 2}[name]
+
+    def fn(rng, h, a):
+        if a.ndim < need_dim or a.shape[axis] == 0 or a.shape[axis] % 2:
+            return SKIP
+        return htf(h, 2), npf(a, 2)
+
+    reg(name, fn, "fi", min_ndim=need_dim, empty_ok=False)
+
+
+_mk_xsplit("hsplit", np.hsplit, 2)
+_mk_xsplit("vsplit", np.vsplit, 2)
+_mk_xsplit("dsplit", np.dsplit, 3)
+
+
+def _sort(rng, h, a):
+    ax = _rand_axis(rng, a)
+    desc = bool(rng.integers(0, 2))
+    v, idx = ht.sort(h, axis=ax, descending=desc)
+    ref = np.sort(a, axis=ax, kind="stable")
+    if desc:
+        ref = np.flip(ref, axis=ax)
+    return v, ref
+
+
+reg("sort", _sort, "fi")
+
+
+def _argsort(rng, h, a):
+    ax = _rand_axis(rng, a)
+    idx = ht.argsort(h, axis=ax)
+    # indices are only well-defined for unique values; compare through gather
+    gathered = np.take_along_axis(a, idx.numpy().astype(np.int64), axis=ax)
+    return ht.array(gathered, split=None), np.sort(a, axis=ax, kind="stable")
+
+
+reg("argsort", _argsort, "fi", check_dtype=False)
+
+
+def _topk(rng, h, a):
+    # torch convention (reference manipulations: topk mirrors torch.topk)
+    if a.shape[-1] == 0:
+        return SKIP
+    k = int(rng.integers(1, a.shape[-1] + 1))
+    v, idx = ht.topk(h, k, dim=-1, largest=True, sorted=True)
+    ref = np.flip(np.sort(a, axis=-1), axis=-1)[..., :k]
+    return v, ref
+
+
+reg("topk", _topk, "fi", empty_ok=False)
+
+
+def _unique(rng, h, a):
+    return ht.unique(h, sorted=True), np.unique(a)
+
+
+reg("unique", _unique, "fi", check_dtype=False)
+
+
+def _searchsorted(rng, h, a):
+    if a.ndim != 1:
+        return SKIP
+    srt = np.sort(a.astype(np.float64)).astype(a.dtype)
+    v = rng.standard_normal(4).astype(a.dtype) if a.dtype.kind == "f" else rng.integers(
+        -5, 6, 4
+    ).astype(a.dtype)
+    side = "right" if rng.integers(0, 2) else "left"
+    return (
+        ht.searchsorted(ht.array(srt), ht.array(v), side=side),
+        np.searchsorted(srt, v, side=side),
+    )
+
+
+reg("searchsorted", _searchsorted, "fi", check_dtype=False, kind="vec")
+
+
+def _digitize(rng, h, a):
+    bins = np.array([-2.0, -0.5, 0.5, 2.0], dtype=np.float32)
+    right = bool(rng.integers(0, 2))
+    return ht.digitize(h, ht.array(bins), right=right), np.digitize(
+        np.asarray(a, np.float32), bins, right=right
+    )
+
+
+reg("digitize", _digitize, "f", check_dtype=False)
+
+
+def _bucketize(rng, h, a):
+    # torch convention: right=False counts boundaries <= x (reference
+    # statistics.py bucketize == torch.bucketize == searchsorted flip)
+    bins = np.array([-2.0, -0.5, 0.5, 2.0], dtype=np.float32)
+    right = bool(rng.integers(0, 2))
+    return ht.bucketize(h, ht.array(bins), right=right), np.searchsorted(
+        bins, np.asarray(a, np.float32), side="right" if right else "left"
+    )
+
+
+reg("bucketize", _bucketize, "f", check_dtype=False)
+
+
+def _bincount(rng, h, a):
+    if a.ndim != 1:
+        return SKIP
+    v = np.abs(a).astype(np.int32) % 7
+    return ht.bincount(ht.array(v, split=h.split)), np.bincount(v)
+
+
+reg("bincount", _bincount, "i", check_dtype=False, kind="vec")
+
+
+def _histc(rng, h, a):
+    # torch convention (reference statistics.py histc == torch.histc)
+    return ht.histc(h, bins=8, min=-2.0, max=2.0), np.histogram(
+        a, bins=8, range=(-2.0, 2.0)
+    )[0].astype(np.float32)
+
+
+reg("histc", _histc, "f", check_dtype=False)
+
+
+def _histogram(rng, h, a):
+    hist, edges = ht.histogram(h, bins=6)
+    nh, ne = np.histogram(a, bins=6)
+    return (hist, edges), (nh, ne)
+
+
+reg("histogram", _histogram, "f", empty_ok=False, check_dtype=False)
+
+
+def _isin(rng, h, a):
+    test = rng.integers(-3, 4, 4).astype(a.dtype)
+    return ht.isin(h, ht.array(test)), np.isin(a, test)
+
+
+reg("isin", _isin, "i")
+
+
+def _nonzero(rng, h, a):
+    # torch convention: an (n, ndim) index matrix for ndim>=2 (reference
+    # indexing.py nonzero == torch.nonzero); numpy tuple-stack as oracle
+    r = ht.nonzero(h)
+    if a.ndim == 1:
+        ref = np.nonzero(a)[0]
+    else:
+        ref = np.stack(np.nonzero(a), axis=1) if a.size else np.zeros((0, a.ndim))
+    return r, ref
+
+
+reg("nonzero", _nonzero, "fib", check_dtype=False)
+
+
+def _where(rng, h, a):
+    return ht.where(h > 0, h, -h), np.where(a > 0, a, -a)
+
+
+reg("where", _where, "f")
+
+
+def _take(rng, h, a):
+    if a.shape[0] == 0:
+        return SKIP
+    idx = rng.integers(0, a.shape[0], 5)
+    return ht.take(h, ht.array(idx.astype(np.int32)), axis=0), np.take(a, idx, axis=0)
+
+
+reg("take", _take, "fi", empty_ok=False)
+
+
+def _take_along_axis(rng, h, a):
+    ax = _rand_axis(rng, a)
+    if a.shape[ax] == 0:
+        return SKIP
+    idx = np.argsort(a.astype(np.float64), axis=ax)
+    return (
+        ht.take_along_axis(h, ht.array(idx.astype(np.int32)), axis=ax),
+        np.take_along_axis(a, idx, axis=ax),
+    )
+
+
+reg("take_along_axis", _take_along_axis, "f", empty_ok=False)
+
+
+def _clip(rng, h, a):
+    return ht.clip(h, -1.0, 1.0), np.clip(a, -1.0, 1.0)
+
+
+reg("clip", _clip, "f")
+
+
+def _diff(rng, h, a):
+    ax = _rand_axis(rng, a)
+    if a.shape[ax] < 2:
+        return SKIP
+    if rng.integers(0, 2):
+        return ht.diff(h, axis=ax), np.diff(a, axis=ax)
+    return ht.diff(h, axis=ax, append=h), np.diff(a, axis=ax, append=a)
+
+
+reg("diff", _diff, "fi", empty_ok=False)
+
+
+def _modf(rng, h, a):
+    frac, whole = ht.modf(h)
+    nf, nw = np.modf(a)
+    return (frac, whole), (nf, nw)
+
+
+reg("modf", _modf, "f")
+
+
+def _diag(rng, h, a):
+    if a.ndim > 2:
+        return SKIP
+    off = int(rng.integers(-1, 2))
+    return ht.diag(h, off), np.diag(a, off)
+
+
+reg("diag", _diag, "fi", empty_ok=False)
+
+
+def _diagonal(rng, h, a):
+    if a.ndim < 2:
+        return SKIP
+    off = int(rng.integers(-1, 2))
+    return ht.diagonal(h, off), np.diagonal(a, off)
+
+
+reg("diagonal", _diagonal, "fi", min_ndim=2, empty_ok=False)
+
+
+def _tri(name, npf):
+    htf = getattr(ht, name)
+
+    def fn(rng, h, a):
+        if a.ndim < 2:
+            return SKIP
+        k = int(rng.integers(-1, 2))
+        return htf(h, k), npf(a, k)
+
+    reg(name, fn, "fi", min_ndim=2)
+
+
+_tri("tril", np.tril)
+_tri("triu", np.triu)
+
+
+def _trace(rng, h, a):
+    if a.ndim < 2 or min(a.shape[:2]) == 0:
+        return SKIP
+    return ht.trace(h), np.trace(a)
+
+
+reg("trace", _trace, "fi", min_ndim=2, empty_ok=False, check_dtype=False)
+
+
+def _identityish(name):
+    htf = getattr(ht, name)
+
+    def fn(rng, h, a):
+        return htf(h), a
+
+    reg(name, fn, "fib")
+
+
+_identityish("copy")
+_identityish("balance")
+
+
+def _resplit(rng, h, a):
+    tgt = [None, *range(a.ndim)][int(rng.integers(0, a.ndim + 1))]
+    return ht.resplit(h, tgt), a
+
+
+reg("resplit", _resplit, "fib")
+
+
+def _redistribute(rng, h, a):
+    return ht.redistribute(h), a
+
+
+reg("redistribute", _redistribute, "fib")
+
+# ===================================================================== linalg
+
+
+def _sqmat(rng, n, dtype, x64=False):
+    """A well-conditioned square matrix."""
+    a = rng.standard_normal((n, n)) + n * np.eye(n)
+    return a.astype(dtype)
+
+
+def _matmul(rng, h, a):
+    if a.ndim != 2 or 0 in a.shape:
+        return SKIP
+    b = rng.standard_normal((a.shape[1], 3)).astype(a.dtype)
+    return ht.matmul(h, ht.array(b)), a @ b
+
+
+reg("matmul", _matmul, "f", min_ndim=2, empty_ok=False)
+
+
+def _dot(rng, h, a):
+    if a.ndim != 1 or a.size == 0:
+        return SKIP
+    b = rng.standard_normal(a.shape).astype(a.dtype)
+    return ht.dot(h, ht.array(b, split=h.split)), np.dot(a, b)
+
+
+reg("dot", _dot, "f", empty_ok=False, kind="vec")
+
+
+def _outer(rng, h, a):
+    if a.ndim != 1 or a.size == 0:
+        return SKIP
+    b = rng.standard_normal(3).astype(a.dtype)
+    return ht.outer(h, ht.array(b)), np.outer(a, b)
+
+
+reg("outer", _outer, "f", empty_ok=False, kind="vec")
+
+
+def _vdot(rng, h, a):
+    if a.ndim != 1 or a.size == 0:
+        return SKIP
+    b = rng.standard_normal(a.shape).astype(a.dtype)
+    return ht.vdot(h, ht.array(b, split=h.split)), np.vdot(a, b)
+
+
+reg("vdot", _vdot, "f", empty_ok=False, kind="vec")
+
+
+def _vecdot(rng, h, a):
+    if a.ndim < 1 or a.shape[-1] == 0:
+        return SKIP
+    b = rng.standard_normal(a.shape).astype(a.dtype)
+    return (
+        ht.vecdot(h, ht.array(b, split=h.split)),
+        np.einsum("...i,...i->...", a, b),
+    )
+
+
+reg("vecdot", _vecdot, "f", empty_ok=False)
+
+
+def _cross(rng, h, a):
+    n = int(rng.integers(1, 9))
+    x = rng.standard_normal((n, 3)).astype(np.float32)
+    b = rng.standard_normal((n, 3)).astype(np.float32)
+    split = 0 if rng.integers(0, 2) else None
+    return ht.cross(ht.array(x, split=split), ht.array(b, split=split)), np.cross(x, b)
+
+
+reg("cross", _cross, "f", kind="none")
+
+
+def _projection(rng, h, a):
+    if a.ndim != 1 or a.size == 0:
+        return SKIP
+    b = rng.standard_normal(a.shape).astype(a.dtype) + 0.5
+    ref = (np.dot(a, b) / np.dot(b, b)) * b
+    return ht.projection(h, ht.array(b, split=h.split)), ref
+
+
+reg("projection", _projection, "f", empty_ok=False, kind="vec")
+
+
+def _linalg_sq(name, npf):
+    htf = getattr(ht, name)
+
+    def fn(rng, h, a):
+        n = int(rng.integers(2, 7))
+        m = _sqmat(rng, n, a.dtype)
+        split = int(rng.integers(0, 2)) if rng.integers(0, 2) else None
+        hm = ht.array(m, split=split)
+        return htf(hm), npf(m.astype(np.float64))
+
+    reg(name, fn, "f", check_dtype=False)
+
+
+_linalg_sq("det", np.linalg.det)
+_linalg_sq("inv", np.linalg.inv)
+
+
+def _slogdet(rng, h, a):
+    n = int(rng.integers(2, 7))
+    m = _sqmat(rng, n, a.dtype)
+    hm = ht.array(m, split=0 if rng.integers(0, 2) else None)
+    s, ld = ht.slogdet(hm)
+    ns, nld = np.linalg.slogdet(m.astype(np.float64))
+    return (s, ld), (ns, nld)
+
+
+reg("slogdet", _slogdet, "f", check_dtype=False)
+
+
+def _solve(rng, h, a):
+    n = int(rng.integers(2, 7))
+    m = _sqmat(rng, n, a.dtype)
+    b = rng.standard_normal((n, 2)).astype(a.dtype)
+    hm = ht.array(m, split=0 if rng.integers(0, 2) else None)
+    return ht.solve(hm, ht.array(b)), np.linalg.solve(
+        m.astype(np.float64), b.astype(np.float64)
+    )
+
+
+reg("solve", _solve, "f", check_dtype=False)
+
+
+def _cg(rng, h, a):
+    n = int(rng.integers(3, 7))
+    r = rng.standard_normal((n, n))
+    spd = (r @ r.T + n * np.eye(n)).astype(np.float32)
+    b = rng.standard_normal(n).astype(np.float32)
+    x0 = np.zeros(n, dtype=np.float32)
+    got = ht.cg(ht.array(spd), ht.array(b), ht.array(x0))
+    ref = np.linalg.solve(spd.astype(np.float64), b.astype(np.float64))
+    return got, ref
+
+
+reg("cg", _cg, "f", check_dtype=False)
+
+
+def _qr(rng, h, a):
+    m, n = int(rng.integers(3, 9)), int(rng.integers(2, 5))
+    if m < n:
+        m, n = n, m
+    x = rng.standard_normal((m, n)).astype(np.float32)
+    hx = ht.array(x, split=0 if rng.integers(0, 2) else None)
+    q, r = ht.qr(hx)
+    qn, rn = q.numpy(), r.numpy()
+    np.testing.assert_allclose(qn @ rn, x, rtol=5e-4, atol=5e-5)
+    np.testing.assert_allclose(qn.T @ qn, np.eye(qn.shape[1]), atol=5e-4)
+    return ht.array(qn @ rn), x  # reconstruction comparison drives the engine
+
+
+reg("qr", _qr, "f", check_dtype=False)
+
+
+def _svd(rng, h, a):
+    m, n = int(rng.integers(3, 9)), int(rng.integers(2, 5))
+    x = rng.standard_normal((m, n)).astype(np.float32)
+    hx = ht.array(x, split=0 if rng.integers(0, 2) else None)
+    u, s, vt = ht.svd(hx)
+    rec = u.numpy() @ np.diag(s.numpy()) @ vt.numpy()
+    np.testing.assert_allclose(
+        np.sort(s.numpy())[::-1], np.linalg.svd(x, compute_uv=False), rtol=5e-4, atol=5e-4
+    )
+    return ht.array(rec), x
+
+
+reg("svd", _svd, "f", check_dtype=False)
+
+
+def _rsvd(rng, h, a):
+    m, n, r = 12, 6, 3
+    lo = rng.standard_normal((m, r)).astype(np.float32)
+    hi = rng.standard_normal((r, n)).astype(np.float32)
+    x = lo @ hi
+    u, s, vt = ht.rsvd(ht.array(x, split=0), rank=r, random_state=0)
+    rec = u.numpy() @ np.diag(s.numpy()) @ vt.numpy()
+    return ht.array(rec), x
+
+
+reg("rsvd", _rsvd, "f", check_dtype=False)
+
+
+def _lanczos(rng, h, a):
+    n, m = 8, 4
+    r = rng.standard_normal((n, n))
+    spd = (r @ r.T + n * np.eye(n)).astype(np.float32)
+    V, T = ht.lanczos(ht.array(spd), m)
+    Vn, Tn = V.numpy(), T.numpy()
+    np.testing.assert_allclose(Vn.T @ Vn, np.eye(Vn.shape[1]), atol=1e-3)
+    return ht.array(Vn.T @ (spd @ Vn)), Tn
+
+
+reg("lanczos", _lanczos, "f", check_dtype=False)
+
+
+def _norm(rng, h, a):
+    return ht.norm(h), np.linalg.norm(np.asarray(a, np.float64).reshape(-1))
+
+
+reg("norm", _norm, "f", empty_ok=False, check_dtype=False)
+
+
+def _vector_norm(rng, h, a):
+    ax = _nonempty_axis(rng, a, none_ok=False)
+    if ax is SKIP:
+        return SKIP
+    return (
+        ht.vector_norm(h, axis=ax),
+        np.linalg.norm(np.asarray(a, np.float64), axis=ax),
+    )
+
+
+reg("vector_norm", _vector_norm, "f", empty_ok=False, check_dtype=False)
+
+
+def _matrix_norm(rng, h, a):
+    n, m = int(rng.integers(1, 8)), int(rng.integers(1, 8))
+    x = rng.standard_normal((n, m)).astype(np.float32)
+    hx = ht.array(x, split=int(rng.integers(0, 2)) if rng.integers(0, 2) else None)
+    return ht.matrix_norm(hx, axis=(0, 1)), np.linalg.norm(
+        np.asarray(x, np.float64), "fro"
+    )
+
+
+reg("matrix_norm", _matrix_norm, "f", kind="none", check_dtype=False)
+
+# ================================================================== factories
+
+
+def _factory_spec(name, fn, **kw):
+    reg(name, fn, dtypes="f", kind="none", **kw)
+
+
+def _arange(rng, h, a):
+    n = int(rng.integers(1, 17))
+    return ht.arange(n, split=0), np.arange(n)
+
+
+def _linspace(rng, h, a):
+    n = int(rng.integers(2, 17))
+    return ht.linspace(-2.0, 3.0, n, split=0), np.linspace(-2.0, 3.0, n, dtype=np.float32)
+
+
+def _logspace(rng, h, a):
+    n = int(rng.integers(2, 9))
+    return ht.logspace(0.0, 2.0, n), np.logspace(0.0, 2.0, n, dtype=np.float32)
+
+
+def _eye(rng, h, a):
+    n = int(rng.integers(1, 9))
+    return ht.eye(n, split=0), np.eye(n, dtype=np.float32)
+
+
+_factory_spec("arange", _arange, check_dtype=False)
+_factory_spec("linspace", _linspace, check_dtype=False)
+_factory_spec("logspace", _logspace, check_dtype=False)
+_factory_spec("eye", _eye, check_dtype=False)
+
+
+def _shape_draw(rng):
+    nd = int(rng.integers(1, 4))
+    return tuple(int(rng.integers(1, 5)) for _ in range(nd))
+
+
+def _mk_filled(name, npf, val=None):
+    htf = getattr(ht, name)
+
+    def fn(rng, h, a):
+        shp = _shape_draw(rng)
+        split = int(rng.integers(0, len(shp))) if rng.integers(0, 2) else None
+        if val is None:
+            return htf(shp, split=split), npf(shp, dtype=np.float32)
+        return htf(shp, val, split=split), npf(shp, val, dtype=np.float32)
+
+    _factory_spec(name, fn)
+
+
+_mk_filled("ones", np.ones)
+_mk_filled("zeros", np.zeros)
+_mk_filled("full", np.full, val=2.5)
+
+
+def _empty(rng, h, a):
+    shp = _shape_draw(rng)
+    e = ht.empty(shp, split=0)
+    assert tuple(e.shape) == shp and e.split == 0
+    return ht.zeros(shp), np.zeros(shp, dtype=np.float32)
+
+
+_factory_spec("empty", _empty)
+
+
+def _mk_like(name, npf):
+    htf = getattr(ht, name)
+
+    def fn(rng, h, a):
+        return htf(h), npf(a)
+
+    reg(name, fn, "fi")
+
+
+_mk_like("ones_like", np.ones_like)
+_mk_like("zeros_like", np.zeros_like)
+
+
+def _full_like(rng, h, a):
+    return ht.full_like(h, 3), np.full_like(a, 3)
+
+
+reg("full_like", _full_like, "fi")
+
+
+def _empty_like(rng, h, a):
+    e = ht.empty_like(h)
+    assert tuple(e.shape) == a.shape
+    return ht.zeros_like(h), np.zeros_like(a)
+
+
+reg("empty_like", _empty_like, "fi")
+
+
+def _meshgrid(rng, h, a):
+    x = np.arange(3, dtype=np.float32)
+    y = np.arange(4, dtype=np.float32)
+    gh = ht.meshgrid(ht.array(x), ht.array(y))
+    gn = np.meshgrid(x, y)
+    return tuple(gh), tuple(gn)
+
+
+_factory_spec("meshgrid", _meshgrid)
+
+
+def _array(rng, h, a):
+    return ht.array(a, split=h.split), a
+
+
+def _asarray(rng, h, a):
+    return ht.asarray(a), a
+
+
+def _from_numpy(rng, h, a):
+    return ht.from_numpy(a), a
+
+
+reg("array", _array, "fib")
+reg("asarray", _asarray, "fib")
+reg("from_numpy", _from_numpy, "fib")
+
+# ============================================================== type helpers
+
+
+def _type_smoke(name, fn):
+    reg(name, fn, dtypes="f", kind="none")
+
+
+def _promote(rng, h, a):
+    assert ht.promote_types(ht.float32, ht.int32) is ht.float32
+    assert ht.promote_types(ht.uint8, ht.int8) is ht.int16
+    return None, None
+
+
+def _result_type(rng, h, a):
+    assert ht.result_type(ht.int32, ht.float32) is ht.float32
+    with jax.enable_x64(True):
+        assert ht.result_type(ht.float32, ht.float64) is ht.float64
+    return None, None
+
+
+def _can_cast(rng, h, a):
+    assert ht.can_cast(ht.int32, ht.float64)
+    assert not ht.can_cast(ht.float64, ht.int32, casting="safe")
+    return None, None
+
+
+def _issubdtype(rng, h, a):
+    assert ht.issubdtype(ht.float32, ht.floating)
+    assert not ht.issubdtype(ht.int32, ht.floating)
+    return None, None
+
+
+def _heat_type_of(rng, h, a):
+    assert ht.heat_type_of(np.float32(1.0)) is ht.float32
+    return None, None
+
+
+def _heat_type_is_exact(rng, h, a):
+    assert ht.heat_type_is_exact(ht.int32) and not ht.heat_type_is_exact(ht.float32)
+    return None, None
+
+
+def _heat_type_is_inexact(rng, h, a):
+    assert ht.heat_type_is_inexact(ht.float32) and not ht.heat_type_is_inexact(ht.int32)
+    return None, None
+
+
+def _canonical(rng, h, a):
+    assert ht.canonical_heat_type(np.float32) is ht.float32
+    return None, None
+
+
+def _broadcast_shape(rng, h, a):
+    assert ht.broadcast_shape((4, 1), (3,)) == np.broadcast_shapes((4, 1), (3,))
+    return None, None
+
+
+def _broadcast_shapes(rng, h, a):
+    assert ht.broadcast_shapes((2, 1), (1, 5), (2, 5)) == np.broadcast_shapes(
+        (2, 1), (1, 5), (2, 5)
+    )
+    return None, None
+
+
+def _shape(rng, h, a):
+    assert ht.shape(h) == a.shape
+    return None, None
+
+
+_type_smoke("promote_types", _promote)
+_type_smoke("result_type", _result_type)
+_type_smoke("can_cast", _can_cast)
+_type_smoke("issubdtype", _issubdtype)
+_type_smoke("heat_type_of", _heat_type_of)
+_type_smoke("heat_type_is_exact", _heat_type_is_exact)
+_type_smoke("heat_type_is_inexact", _heat_type_is_inexact)
+_type_smoke("canonical_heat_type", _canonical)
+_type_smoke("broadcast_shape", _broadcast_shape)
+_type_smoke("broadcast_shapes", _broadcast_shapes)
+reg("shape", _shape, "fib")
+
+
+# ================================================================== the engine
+def _draw_input(rng, spec, x64, dtype_letter):
+    """Draw (h, a) for a spec: random ndim/shape (ragged primes, even-over-
+    mesh, tiny, occasional 0-size axis), random split, requested dtype."""
+    if spec.kind == "vec":
+        nd = 1
+    else:
+        nd = int(rng.integers(max(spec.min_ndim, 1), 4))
+    dims = []
+    for _ in range(nd):
+        kind = rng.integers(0, 4)
+        if kind == 0:
+            dims.append(int(rng.integers(1, 4)) * P)  # even over the mesh
+        elif kind == 1:
+            dims.append(int(rng.choice([5, 7, 11, 13])))  # ragged prime
+        elif kind == 2 and spec.empty_ok:
+            dims.append(0)  # 0-size axis
+        else:
+            dims.append(int(rng.integers(1, 9)))
+    shape = tuple(dims)
+    dt = _np_dtype(dtype_letter, x64)
+    if dtype_letter == "b":
+        a = rng.integers(0, 2, size=shape).astype(np.bool_)
+    elif dtype_letter == "i":
+        a = rng.integers(-5, 6, size=shape).astype(dt)
+    elif dtype_letter == "c":
+        a = (rng.standard_normal(shape) + 1j * rng.standard_normal(shape)).astype(dt)
+    else:
+        a = rng.standard_normal(shape).astype(dt)
+    if spec.name in PREP:
+        a = np.asarray(PREP[spec.name](a), dtype=dt)
+    split = [None, *range(a.ndim)][int(rng.integers(0, a.ndim + 1))]
+    return ht.array(a.copy(), split=split), a
+
+
+# specs whose internals run in float32 regardless of the input dtype schedule
+# (they build their own f32 operands) — the x64 tight tolerance never applies
+_F32_INTERNAL = frozenset({"cg", "rsvd", "lanczos", "svd", "qr", "skew",
+                           "kurtosis", "cov", "cross", "matrix_norm", "split"})
+
+
+def _tolkw(spec, dtype_letter, x64):
+    if spec.name in _F32_INTERNAL:
+        return dict(rtol=5e-3, atol=5e-4)
+    if x64 and dtype_letter == "f":
+        if spec.name in {"percentile", "std", "var", "logspace", "linspace"}:
+            return dict(rtol=1e-6, atol=1e-8)
+        return dict(rtol=1e-8, atol=1e-10)
+    if spec.name in {"det", "inv", "solve", "slogdet", "norm", "vector_norm",
+                     "matrix_norm", "percentile", "std", "var", "matmul", "dot",
+                     "vdot", "vecdot", "outer", "projection", "mean", "nanmean",
+                     "average", "prod", "cumprod", "cumproduct", "logaddexp",
+                     "logaddexp2", "hypot", "logspace", "linspace"}:
+        return dict(rtol=2e-4, atol=2e-5)
+    return tol(spec.name)
+
+
+def _check(out_h, out_np, tolkw, spec, msg):
+    if out_h is None and out_np is None:
+        return
+    if isinstance(out_h, (tuple, list)):
+        assert isinstance(out_np, (tuple, list)) and len(out_h) == len(out_np), msg
+        for oh, on in zip(out_h, out_np):
+            _check(oh, on, tolkw, spec, msg)
+        return
+    if isinstance(out_h, DNDarray):
+        try:
+            htt.assert_array_equal(
+                out_h, np.asarray(out_np), check_dtype=spec.check_dtype, **tolkw
+            )
+        except AssertionError as e:
+            raise AssertionError(f"{e}\n{msg}") from e
+    else:
+        np.testing.assert_allclose(
+            np.asarray(out_h), np.asarray(out_np), err_msg=msg, **tolkw
+        )
+
+
+def run_case(name, i):
+    """Replay case ``i`` of op ``name`` — fully determined by (name, i)."""
+    spec = SPECS[name]
+    rng = np.random.default_rng([zlib.crc32(name.encode()), i])
+    # dtype schedule: case 0 first float candidate, case 1 the x64 float
+    # variant, later cases cycle the op's full dtype set
+    letters = list(spec.dtypes)
+    x64 = False
+    if i == 1 and "f" in letters and not ON_ACCELERATOR:
+        letter, x64 = "f", True
+    else:
+        letter = letters[i % len(letters)]
+    if letter == "c" and not COMPLEX_SUPPORTED:
+        letter = "f" if "f" in letters else letters[0]
+    ctx = jax.enable_x64(True) if x64 else None
+    msg = f"surface fuzz op={name} case={i} dtype={letter} x64={x64}"
+    try:
+        if ctx is not None:
+            ctx.__enter__()
+        if spec.kind == "none":
+            out = spec.fn(rng, None, None)
+        else:
+            h, a = _draw_input(rng, spec, x64, letter)
+            out = spec.fn(rng, h, a)
+        if out is SKIP:
+            return "skip"
+        _check(out[0], out[1], _tolkw(spec, letter, x64), spec, msg)
+    finally:
+        if ctx is not None:
+            ctx.__exit__(None, None, None)
+    return "ok"
+
+
+@pytest.mark.parametrize("name", sorted(SPECS))
+def test_surface_op(name):
+    ran = 0
+    for i in range(N_CASES):
+        if run_case(name, i) == "ok":
+            ran += 1
+    assert ran > 0, f"every drawn case for {name} self-skipped — widen its draw"
+
+
+# ------------------------------------------------------------------- coverage
+# ht.* callables the sweep deliberately does not drive: IO round-trips,
+# printing, comm/device configuration, and estimator/sanitation helpers all
+# have dedicated suites (test_io.py, test_misc.py, test_communication.py,
+# test_sanitation.py) — a differential fuzzer adds nothing over those.
+EXCLUDED = frozenset({
+    "load", "load_csv", "load_hdf5", "save", "save_csv", "save_hdf5",
+    "supports_hdf5", "supports_netcdf",
+    "print0", "local_printing", "global_printing", "get_printoptions",
+    "set_printoptions",
+    "use_comm", "use_device", "get_comm", "get_device", "distributed_init",
+    "is_classifier", "is_estimator", "is_regressor", "is_transformer",
+    "scalar_to_1d",
+})
+
+# chain-fuzzer table contributions (test_fuzz_differential.py OPS) that the
+# sweep doesn't re-register under the same public name
+CHAIN_COVERED = frozenset({"exp", "sqrt", "log1p", "round", "sign", "sum",
+                           "mean", "max", "any", "all", "cumsum", "transpose",
+                           "flip", "reshape", "squeeze", "expand_dims", "roll",
+                           "sort", "concatenate", "where", "maximum", "abs",
+                           "clip"})
+
+
+def _toplevel_functions():
+    out = []
+    for s in sorted(dir(ht)):
+        if s.startswith("_"):
+            continue
+        o = getattr(ht, s)
+        if callable(o) and not inspect.isclass(o) and not isinstance(o, types.ModuleType):
+            out.append(s)
+    return out
+
+def test_surface_coverage():
+    """VERDICT r4 #6 acceptance bar: the fuzz layer exercises >=80% of the
+    top-level ``ht.*`` callables (sanitation helpers excluded: they are the
+    validation layer the fuzzed ops already route through)."""
+    fns = [f for f in _toplevel_functions() if not f.startswith("sanitize_")]
+    covered = (set(SPECS) | CHAIN_COVERED) & set(fns)
+    frac = len(covered) / len(fns)
+    missing = sorted(set(fns) - set(SPECS) - CHAIN_COVERED - EXCLUDED)
+    assert frac >= 0.80, (
+        f"surface fuzz coverage {frac:.1%} < 80% — unswept ops: {missing}"
+    )
+
+
+def test_case_is_reproducible():
+    assert run_case("add", 0) == run_case("add", 0)
+
+
+@pytest.mark.skipif(ON_ACCELERATOR, reason="harness-teeth proof runs on the CPU mesh")
+def test_planted_bug_is_caught(monkeypatch):
+    """A 1e-3 skew planted into ht.add must fail its sweep."""
+    real_add = ht.add
+
+    def bad_add(x, y, *a, **k):
+        return real_add(x, y, *a, **k) * 1.001
+
+    monkeypatch.setattr(ht, "add", bad_add)
+    # rebuild the spec closure against the patched symbol
+    spec = SPECS["add"]
+    caught = 0
+    for i in range(8):
+        try:
+            b_rng = np.random.default_rng([zlib.crc32(b"add"), i])
+            h, a = _draw_input(b_rng, spec, False, "f")
+            if a.size == 0:
+                continue
+            b = _second_operand(b_rng, a, "like")
+            _check(bad_add(h, ht.array(b, split=h.split)), a + b,
+                   _tolkw(spec, "f", False), spec, "plant")
+        except AssertionError:
+            caught += 1
+    assert caught > 0, "numeric plant survived every case"

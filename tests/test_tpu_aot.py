@@ -51,11 +51,30 @@ def _aval(shape, dtype, mesh, spec):
     return jax.ShapeDtypeStruct(shape, dtype, sharding=NamedSharding(mesh, spec))
 
 
+_AOT_PROBE = []  # memoised: [] unprobed, [None] available, [err] unavailable
+
+
+def _aot_error():
+    """One sentinel compile of a trivial sharded program per session: if THIS
+    fails, the TPU AOT toolchain is genuinely absent and tests skip; if it
+    succeeds, a failing kernel compile is a real regression and must FAIL,
+    not skip (r5 review finding on the r4 catch-all)."""
+    if not _AOT_PROBE:
+        try:
+            mesh = _topo_mesh(8)
+            aval = _aval((8, 8), jnp.float32, mesh, P("d", None))
+            jax.jit(lambda x: x + 1).lower(aval).compile()
+            _AOT_PROBE.append(None)
+        except Exception as e:
+            _AOT_PROBE.append(f"{type(e).__name__}: {e}")
+    return _AOT_PROBE[0]
+
+
 def _compile(fn, *avals):
-    try:
-        return fn.lower(*avals).compile()
-    except Exception as e:
-        pytest.skip(f"TPU AOT compile unavailable: {e}")
+    err = _aot_error()
+    if err is not None:
+        pytest.skip(f"TPU AOT compile unavailable: {err}")
+    return fn.lower(*avals).compile()
 
 
 def _dims_in(text: str):
