@@ -440,7 +440,7 @@ def linspace(
 ):
     """Evenly spaced numbers over an interval (reference factories.py:896-981)."""
     num = int(num)
-    if num <= 0:
+    if num < 0:  # num == 0 is a valid empty result, as in numpy
         raise ValueError(f"number of samples 'num' must be non-negative, got {num}")
     step = (stop - start) / max(1, num - int(bool(endpoint)))
     comm_r = sanitize_comm(comm)

@@ -1,0 +1,68 @@
+"""
+Generated API reference (VERDICT r4 #7): the committed doc/api tree must
+exist, index every public top-level callable, and match a fresh render
+(scripts/gen_api_docs.py is the autodoc; CI re-renders and diffs too).
+"""
+
+import importlib.util
+import inspect
+import os
+import types
+
+import heat_tpu as ht
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+API = os.path.join(REPO, "doc", "api")
+
+
+def _gen():
+    spec = importlib.util.spec_from_file_location(
+        "gen_api_docs", os.path.join(REPO, "scripts", "gen_api_docs.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_api_tree_exists_and_indexes_toplevel_surface():
+    index = open(os.path.join(API, "index.md")).read()
+    # environment-dependent exports are documented as notes, not sections
+    env_dep = {s for v in _gen().ENV_DEPENDENT.values() for s in v}
+    missing = []
+    for s in sorted(set(dir(ht)) - env_dep):
+        o = getattr(ht, s)
+        if (
+            s.startswith("_")
+            or isinstance(o, types.ModuleType)
+            or not (callable(o) or inspect.isclass(o))
+        ):
+            continue
+        if f"[`{s}`]" not in index:
+            missing.append(s)
+    assert not missing, f"public symbols absent from doc/api/index.md: {missing}"
+
+
+def test_api_tree_matches_fresh_render():
+    """The committed tree is the current render — a changed public docstring
+    or signature without `python scripts/gen_api_docs.py` fails here (and in
+    CI's docs job)."""
+    pages = _gen().render()
+    stale = []
+    for rel, content in pages.items():
+        path = os.path.join(API, rel)
+        if not os.path.exists(path) or open(path).read() != content:
+            stale.append(rel)
+    on_disk = {f for f in os.listdir(API) if f.endswith(".md")}
+    stale += [f"{o} (orphan)" for o in sorted(on_disk - set(pages))]
+    assert not stale, (
+        f"doc/api is stale: {stale[:6]} — re-run python scripts/gen_api_docs.py"
+    )
+
+
+def test_api_pages_have_substance():
+    n_sections = sum(
+        open(os.path.join(API, f)).read().count("\n### ")
+        for f in os.listdir(API)
+        if f.endswith(".md")
+    )
+    assert n_sections >= 700, f"only {n_sections} symbol sections rendered"

@@ -65,8 +65,10 @@ def test_linspace_logspace():
     np.testing.assert_allclose(
         ht.logspace(0, 2, 4).numpy(), np.logspace(0, 2, 4).astype(np.float32), rtol=1e-5
     )
+    # num == 0 is a valid empty result (numpy semantics); negative raises
+    assert ht.linspace(0, 1, 0).shape == (0,)
     with pytest.raises(ValueError):
-        ht.linspace(0, 1, 0)
+        ht.linspace(0, 1, -1)
 
 
 @pytest.mark.parametrize("split", [None, 0])

@@ -215,9 +215,9 @@ _naninf = lambda a: _plant_naninf(a)
 
 
 def _plant_naninf(a):
-    a = a.copy().reshape(-1)
+    a = a.copy()  # keep the drawn shape: the probes must see every split axis
     if a.size >= 3:
-        a[0], a[1], a[2] = np.nan, np.inf, -np.inf
+        a.flat[0], a.flat[1], a.flat[2] = np.nan, np.inf, -np.inf
     return a
 
 
@@ -1429,8 +1429,11 @@ def test_surface_coverage():
     validation layer the fuzzed ops already route through)."""
     fns = [f for f in _toplevel_functions() if not f.startswith("sanitize_")]
     covered = (set(SPECS) | CHAIN_COVERED) & set(fns)
-    frac = len(covered) / len(fns)
-    missing = sorted(set(fns) - set(SPECS) - CHAIN_COVERED - EXCLUDED)
+    # EXCLUDED ops are out of the denominator too: they're covered by
+    # dedicated suites, not "missing" fuzz targets
+    fuzzable = [f for f in fns if f not in EXCLUDED]
+    frac = len(covered & set(fuzzable)) / len(fuzzable)
+    missing = sorted(set(fuzzable) - set(SPECS) - CHAIN_COVERED)
     assert frac >= 0.80, (
         f"surface fuzz coverage {frac:.1%} < 80% — unswept ops: {missing}"
     )
