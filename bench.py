@@ -652,6 +652,16 @@ def main():
             # crashed anchor must be distinguishable from a BENCH_FAST skip
             linalg = {f"{op}_valid": None for op in ("qr", "svd", "solve", "det")}
             linalg["linalg_error"] = repr(e)[:160]
+    # out-of-core input pipeline (VERDICT r4 #8): native prefetcher vs h5py
+    io_pipe = {}
+    if os.environ.get("BENCH_FAST") != "1":
+        try:
+            _add_benchmarks_path()
+            from io_pipeline_bench import bench_io_pipeline
+
+            io_pipe = bench_io_pipeline()
+        except Exception as e:
+            io_pipe = {"io_pipeline_valid": None, "io_pipeline_error": repr(e)[:160]}
     print(
         json.dumps(
             {
@@ -692,6 +702,7 @@ def main():
                 "dp8_cpu_iters_per_sec": scale8_ips,
                 "dp8_cpu_sharding_overhead_pct": scale8_overhead,
                 **linalg,
+                **io_pipe,
             }
         )
     )

@@ -1,20 +1,37 @@
-// Threaded slab prefetcher: reads an ordered list of (offset, length) byte
-// ranges from a file into a bounded ring of buffers using native worker
-// threads, delivering slabs to the consumer strictly in order.
+// Slab prefetcher: delivers an ordered list of (offset, length) byte ranges
+// from one file into caller buffers, with native threads warming the page
+// cache ahead of the consumer.
 //
 // Role in the framework: the host-side IO runtime feeding the TPU input
 // pipeline (the reference's out-of-core HDF5 path, heat
 // utils/data/partial_dataset.py:20-230, does this with Python threads that
-// serialize on the GIL for every byte; here the reads run as plain pread(2)
-// with the GIL released, so disk latency overlaps Python-side work and device
-// puts). Exposed through a plain C ABI for ctypes — no pybind11.
+// serialize on the GIL for every byte; here the data path runs with the GIL
+// released). Exposed through a plain C ABI for ctypes — no pybind11.
 //
-// Concurrency design: workers claim slab ordinals from an atomic counter and
-// write into slot (ordinal % depth); a slot is reusable once the consumer has
-// copied the previous occupant out. Consumer-side ht_prefetch_next() blocks
-// until the next ordinal's slot is filled, copies into the caller's buffer,
-// frees the slot. Errors are per-slab and surface on the consuming call.
+// Design (second generation): the file is mmap'd once and the consumer's
+// ht_prefetch_next() is a SINGLE memcpy from the mapping into the caller's
+// buffer — no intermediate ring copy (the first-generation ring doubled every
+// byte, which on memory-backed storage made the native path slower than a
+// plain read). Worker threads don't move data at all: they claim slab
+// ordinals and touch the slab's pages (one volatile read per page, sequential
+// so kernel readahead engages), bounded to `depth` slabs ahead of the
+// consumer. On disk/NFS-backed files the faults are absorbed in the workers
+// ahead of time; on tmpfs/page-cache-resident files the touches are no-ops
+// and the consumer runs at memcpy speed. The consumer never waits for a
+// warmer: warming is opportunistic acceleration, correctness comes from the
+// mapping itself.
+//
+// Error contract (same codes as gen-1, the ctypes wrapper depends on them):
+// next() returns bytes >= 0, -1 after the last slab, -2 when the slab lies
+// beyond EOF, -3 when the destination is too small, -4 when closed
+// concurrently. -2/-3 roll the ticket back for the serialized consumer so the
+// slab stays observable. EOF is re-checked with fstat before every copy, so a
+// file truncated after open surfaces as -2 at slab granularity; the residual
+// narrow race (truncation DURING a copy or a device-level read error on
+// fault-in) is a SIGBUS — inherent to any mmap consumer — which the input
+// pipeline accepts for the regular-file datasets it reads.
 
+#include <algorithm>
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
@@ -24,72 +41,55 @@
 #include <vector>
 
 #include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
 #include <unistd.h>
 
 namespace {
 
 struct Prefetcher {
   int fd = -1;
+  const char* map = nullptr;
+  int64_t file_size = 0;
   std::vector<int64_t> offsets;
   std::vector<int64_t> lengths;
   int depth = 0;
 
-  std::vector<std::vector<char>> ring;
-  // state per ring slot ordinal: filled[i % depth] corresponds to ordinal
-  // slot_owner[s]; -1 = empty
-  std::vector<int64_t> slot_owner;
-  std::vector<int64_t> slot_bytes;  // -1 = read error
-
   std::atomic<int64_t> next_claim{0};
-  int64_t next_reserve = 0;  // workers reserve ring slots strictly in this order
   int64_t next_consume = 0;  // consumer tickets, claimed under mu at entry
+  int64_t consumed = 0;      // slabs fully delivered; anchors the warm window
   bool closed = false;
   int consumers_active = 0;
 
   std::mutex mu;
-  std::condition_variable cv_filled;
-  std::condition_variable cv_free;
+  std::condition_variable cv_window;  // warmers wait for the depth window
   std::condition_variable cv_consumer_done;
   std::vector<std::thread> workers;
 
   int64_t nslabs() const { return static_cast<int64_t>(offsets.size()); }
 };
 
-void worker_loop(Prefetcher* p) {
+void warm_loop(Prefetcher* p) {
+  constexpr int64_t kPage = 4096;
+  volatile char sink = 0;
   for (;;) {
     const int64_t i = p->next_claim.fetch_add(1);
     if (i >= p->nslabs()) return;
-    const int slot = static_cast<int>(i % p->depth);
     {
       std::unique_lock<std::mutex> lk(p->mu);
-      // slots are reserved strictly in ordinal order: an empty slot alone is
-      // not enough, because ordinals i and i+depth share slot i % depth and a
-      // later ordinal reserving first would leave the earlier one's consumer
-      // waiting forever on a slab that can no longer be produced
-      p->cv_free.wait(lk, [&] {
-        return p->closed || (i == p->next_reserve && p->slot_owner[slot] == -1);
-      });
+      p->cv_window.wait(lk, [&] { return p->closed || i < p->consumed + p->depth; });
       if (p->closed) return;
-      p->slot_owner[slot] = i;  // reserve while reading
-      p->slot_bytes[slot] = -2; // in flight
-      p->next_reserve = i + 1;
-      p->cv_free.notify_all();  // later ordinals' workers re-check their turn
     }
-    const int64_t len = p->lengths[i];
-    std::vector<char>& buf = p->ring[slot];
-    if (static_cast<int64_t>(buf.size()) < len) buf.resize(len);
-    int64_t done = 0;
-    bool ok = true;
-    while (done < len) {
-      const ssize_t r = pread(p->fd, buf.data() + done, len - done, p->offsets[i] + done);
-      if (r <= 0) { ok = false; break; }
-      done += r;
-    }
-    {
-      std::lock_guard<std::mutex> lk(p->mu);
-      p->slot_bytes[slot] = ok ? len : -1;
-      p->cv_filled.notify_all();
-    }
+    const int64_t off = p->offsets[i];
+    // clamp to the CURRENT size too: touching past a post-open truncation
+    // would SIGBUS (same per-slab re-check as the consumer)
+    struct stat st;
+    const int64_t cur =
+        (fstat(p->fd, &st) == 0) ? static_cast<int64_t>(st.st_size) : 0;
+    const int64_t end =
+        std::min(off + p->lengths[i], std::min(p->file_size, cur));
+    for (int64_t a = off; a < end; a += kPage) sink ^= p->map[a];
+    (void)sink;
   }
 }
 
@@ -103,69 +103,77 @@ void* ht_prefetch_open(const char* path, const int64_t* offsets,
   if (nslabs < 0 || depth < 1 || nthreads < 1) return nullptr;
   int fd = open(path, O_RDONLY);
   if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
   auto* p = new Prefetcher();
   p->fd = fd;
+  p->file_size = static_cast<int64_t>(st.st_size);
+  if (p->file_size > 0) {
+    void* m = mmap(nullptr, p->file_size, PROT_READ, MAP_SHARED, fd, 0);
+    if (m == MAP_FAILED) {
+      close(fd);
+      delete p;
+      return nullptr;
+    }
+    p->map = static_cast<const char*>(m);
+    // slabs are consumed front to back; tell the kernel
+    madvise(m, p->file_size, MADV_SEQUENTIAL);
+  }
   p->offsets.assign(offsets, offsets + nslabs);
   p->lengths.assign(lengths, lengths + nslabs);
   p->depth = depth;
-  p->ring.resize(depth);
-  p->slot_owner.assign(depth, -1);
-  p->slot_bytes.assign(depth, -2);
-  if (nthreads > depth) nthreads = depth;  // more workers than slots can deadlock-spin
-  for (int t = 0; t < nthreads; ++t) p->workers.emplace_back(worker_loop, p);
+  if (nthreads > depth) nthreads = depth;  // warmers past the window just park
+  if (p->map != nullptr) {
+    for (int t = 0; t < nthreads; ++t) p->workers.emplace_back(warm_loop, p);
+  }
   return p;
 }
 
-// Returns: bytes copied (>=0), -1 after the last slab, -2 on read error,
-// -3 if dest_cap is too small, -4 if the prefetcher was closed concurrently.
+// Returns: bytes copied (>=0), -1 after the last slab, -2 when the slab lies
+// beyond EOF, -3 if dest_cap is too small, -4 if closed concurrently.
 // Concurrent consumers each claim a unique ordinal ticket under the mutex at
-// entry — no two callers ever wait on the same ordinal, so a slow caller can
-// never be spuriously bounced by a fast one — and the multi-MB copy runs
-// unlocked. On -2/-3 the ticket is rolled back so the slab stays consumable;
-// that retry contract is only meaningful for serialized consumers (the Python
-// wrapper holds _consumer_lock). When a concurrent claimant already holds the
-// following ordinal the rollback is impossible — the slab is then DROPPED
-// (slot freed) rather than stranded, since a permanently reserved slot would
-// wedge the worker for ordinal+depth and every later consumer.
+// entry, and the multi-MB memcpy runs unlocked. On -2/-3 the ticket is rolled
+// back so the slab stays consumable; that retry contract is only meaningful
+// for serialized consumers (the Python wrapper holds _consumer_lock). When a
+// concurrent claimant already holds the following ordinal the rollback is
+// impossible — the slab is then DROPPED rather than re-observable.
 int64_t ht_prefetch_next(void* handle, char* dest, int64_t dest_cap) {
   auto* p = static_cast<Prefetcher*>(handle);
   std::unique_lock<std::mutex> lk(p->mu);
   if (p->closed) return -4;
   if (p->next_consume >= p->nslabs()) return -1;
-  const int64_t ordinal = p->next_consume++;  // claim the ticket before waiting
-  const int slot = static_cast<int>(ordinal % p->depth);
-  // consumers_active handshake: ht_prefetch_close must not free the mutex a
-  // consumer sleeps on; it waits for every consumer to observe `closed` and leave
+  const int64_t ordinal = p->next_consume++;  // claim the ticket before copying
+  // consumers_active handshake: ht_prefetch_close must not unmap under a
+  // consumer's memcpy; it waits for every consumer to leave
   p->consumers_active++;
-  p->cv_filled.wait(lk, [&] {
-    return p->closed ||
-           (p->slot_owner[slot] == ordinal && p->slot_bytes[slot] != -2);
-  });
+  const int64_t off = p->offsets[ordinal];
+  const int64_t len = p->lengths[ordinal];
+  // re-validate against the CURRENT size: a file truncated since open must
+  // surface as -2 (recoverable), not fault the mapping
+  struct stat st;
+  const int64_t cur_size =
+      (fstat(p->fd, &st) == 0) ? static_cast<int64_t>(st.st_size) : 0;
   int64_t result;
-  if (p->closed) {
-    result = -4;
+  if (off + len > std::min(p->file_size, cur_size)) {
+    result = -2;  // truncated/short file: the gen-1 IO-error contract
+  } else if (len > dest_cap) {
+    result = -3;
   } else {
-    const int64_t bytes = p->slot_bytes[slot];
-    if (bytes == -1 || bytes > dest_cap) {
-      result = (bytes == -1) ? -2 : -3;
-      if (p->next_consume == ordinal + 1) {
-        p->next_consume = ordinal;  // serialized consumer: slab stays consumable
-      } else {
-        p->slot_owner[slot] = -1;  // concurrent claimant raced past: drop, don't wedge
-        p->cv_free.notify_all();
-      }
-    } else {
-      // Mark the slot consuming (owner sentinel -2, so no worker can refill
-      // it) and run the memcpy unlocked: workers keep posting completions
-      // instead of stalling behind it.
-      p->slot_owner[slot] = -2;
-      lk.unlock();
-      memcpy(dest, p->ring[slot].data(), bytes);
-      lk.lock();
-      p->slot_owner[slot] = -1;
-      p->cv_free.notify_all();
-      result = bytes;
+    lk.unlock();
+    if (len > 0) memcpy(dest, p->map + off, len);
+    lk.lock();
+    result = p->closed ? -4 : len;
+  }
+  if (result == -2 || result == -3) {
+    if (p->next_consume == ordinal + 1) {
+      p->next_consume = ordinal;  // serialized consumer: slab stays observable
     }
+  } else if (result >= 0) {
+    p->consumed++;
+    p->cv_window.notify_all();  // advance the warmers' window
   }
   p->consumers_active--;
   p->cv_consumer_done.notify_all();
@@ -174,15 +182,14 @@ int64_t ht_prefetch_next(void* handle, char* dest, int64_t dest_cap) {
 
 // Phase one of a two-phase shutdown: mark closed and wake everyone, without
 // freeing. A consumer entering ht_prefetch_next after this sees `closed` and
-// returns -4 immediately; the Python wrapper drains in-flight consumers between
-// cancel and close so ht_prefetch_close never races a consumer that holds the
-// pointer but has not yet entered.
+// returns -4 immediately; the Python wrapper drains in-flight consumers
+// between cancel and close so ht_prefetch_close never races a consumer that
+// holds the pointer but has not yet entered.
 void ht_prefetch_cancel(void* handle) {
   auto* p = static_cast<Prefetcher*>(handle);
   std::lock_guard<std::mutex> lk(p->mu);
   p->closed = true;
-  p->cv_free.notify_all();
-  p->cv_filled.notify_all();
+  p->cv_window.notify_all();
 }
 
 void ht_prefetch_close(void* handle) {
@@ -190,17 +197,16 @@ void ht_prefetch_close(void* handle) {
   {
     std::unique_lock<std::mutex> lk(p->mu);
     p->closed = true;
-    p->cv_free.notify_all();
-    p->cv_filled.notify_all();
-    // consumers blocked in ht_prefetch_next still sleep on this mutex;
-    // deleting p under them would be use-after-free — wait them all out
+    p->cv_window.notify_all();
+    // consumers mid-memcpy still hold the mapping; unmapping under them would
+    // be a use-after-free — wait them all out
     p->cv_consumer_done.wait(lk, [&] { return p->consumers_active == 0; });
   }
-  // drain claims so workers waiting on ordinals past the end exit
   p->next_claim.store(p->nslabs());
   for (auto& t : p->workers) {
     if (t.joinable()) t.join();
   }
+  if (p->map != nullptr) munmap(const_cast<char*>(p->map), p->file_size);
   close(p->fd);
   delete p;
 }

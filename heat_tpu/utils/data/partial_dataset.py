@@ -179,8 +179,16 @@ class PartialH5Dataset:
         self.next_start = end
 
     def __advance_window(self, name: str, slab: np.ndarray) -> None:
-        self._window[name] = np.concatenate([self._window[name][self.load_len:], slab], axis=0) \
-            if self._window[name].shape[0] >= self.load_len else slab
+        # REBIND, never mutate in place: the loader iterator holds basic-slice
+        # VIEWS of the current window on the consumer thread while this runs on
+        # the background thread — an in-place shift would tear those batches.
+        # Rebinding a freshly built array keeps every in-flight view coherent.
+        w = self._window[name]
+        self._window[name] = (
+            np.concatenate([w[self.load_len:], slab], axis=0)
+            if w.shape[0] >= self.load_len
+            else slab
+        )
 
     def __close_prefetchers(self) -> None:
         if self._prefetchers is not None:

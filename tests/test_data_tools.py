@@ -65,3 +65,35 @@ def test_partial_h5_dataset_window_iteration(tmp_path):
         ds.close()
     # double-close must be safe (drain lifecycle)
     ds.close()
+
+
+@pytest.mark.parametrize("use_native", [True, False])
+def test_partial_h5_window_advance_values(tmp_path, monkeypatch, use_native):
+    """Value-exact window semantics through both read paths: steady-state
+    in-place advance (drop oldest load_len rows, append slab) and the ragged
+    final slab that shrinks the window (reference partial_dataset.py:120-180)."""
+    h5py = pytest.importorskip("h5py")
+    import heat_tpu.native as native_mod
+
+    if not use_native:
+        monkeypatch.setattr(native_mod, "available", lambda: False)
+    path = str(tmp_path / "adv.h5")
+    n, f = 50, 3
+    data = np.arange(n * f, dtype=np.float32).reshape(n, f)
+    with h5py.File(path, "w") as fh:
+        fh.create_dataset("data", data=data)
+    ds = ht.utils.data.PartialH5Dataset(
+        path, use_gpu=False, dataset_names=["data"], initial_load=32, load_length=16
+    )
+    try:
+        if not use_native:
+            assert ds._prefetchers is None  # forced onto the h5py path
+        elif native_mod.available():
+            assert ds._prefetchers is not None  # native pread path engaged
+        np.testing.assert_array_equal(ds._window["data"], data[:32])
+        ds.load_next_group(); ds.load_queue.join()
+        np.testing.assert_array_equal(ds._window["data"], data[16:48])
+        ds.load_next_group(); ds.load_queue.join()  # ragged slab: rows 48:50
+        np.testing.assert_array_equal(ds._window["data"], data[32:50])
+    finally:
+        ds.close()
