@@ -26,6 +26,7 @@ TRANSCENDENTALS = frozenset(
     {"exp", "expm1", "exp2", "log", "log2", "log10", "log1p", "sqrt",
      "sin", "cos", "tan", "sinh", "cosh", "tanh",
      "arcsin", "arccos", "arctan", "arcsinh", "arccosh", "arctanh",
+     "asin", "acos", "atan", "asinh", "acosh", "atanh",  # torch-alias spellings
      "logaddexp", "logaddexp2", "atan2", "arctan2", "pow", "power"}
 )
 

@@ -233,12 +233,12 @@ unary("conj", dtypes=_cplx)
 unary("conjugate", dtypes=_cplx)
 unary("real", dtypes=_cplx)
 unary("angle", dtypes=_cplx)
-# imag/iscomplex/isreal of a real array are trivially 0/False/True; the
-# complex-dtype case is the one that matters, so keep them complex-gated
-if COMPLEX_SUPPORTED:
-    unary("imag", dtypes="c")
-    unary("iscomplex", dtypes="c")
-    unary("isreal", dtypes="c")
+# imag/iscomplex/isreal: the complex-dtype case is the interesting one where
+# the backend has complex; the real-dtype identities (0 / False / True) still
+# exercise shape/split propagation everywhere else
+unary("imag", dtypes=_cplx + "f")
+unary("iscomplex", dtypes=_cplx + "f")
+unary("isreal", dtypes=_cplx + "f")
 
 # ========================================================== elementwise binary
 for n in ["add", "sub", "mul", "div"]:
@@ -558,11 +558,15 @@ def _mk_xsplit(name, npf, need_dim):
     axis = {"hsplit": 1, "vsplit": 0, "dsplit": 2}[name]
 
     def fn(rng, h, a):
-        if a.ndim < need_dim or a.shape[axis] == 0 or a.shape[axis] % 2:
-            return SKIP
-        return htf(h, 2), npf(a, 2)
+        # self-drawn input: the split axis must be even, which a generic draw
+        # misses too often at low case counts
+        shp = [int(rng.integers(1, 6)) for _ in range(need_dim)]
+        shp[axis] = 2 * int(rng.integers(1, 5))
+        x = rng.standard_normal(tuple(shp)).astype(np.float32)
+        split = int(rng.integers(0, need_dim)) if rng.integers(0, 2) else None
+        return htf(ht.array(x, split=split), 2), npf(x, 2)
 
-    reg(name, fn, "fi", min_ndim=need_dim, empty_ok=False)
+    reg(name, fn, "fi", kind="none")
 
 
 _mk_xsplit("hsplit", np.hsplit, 2)
@@ -1307,10 +1311,16 @@ def _draw_input(rng, spec, x64, dtype_letter):
 # specs whose internals run in float32 regardless of the input dtype schedule
 # (they build their own f32 operands) — the x64 tight tolerance never applies
 _F32_INTERNAL = frozenset({"cg", "rsvd", "lanczos", "svd", "qr", "skew",
-                           "kurtosis", "cov", "cross", "matrix_norm", "split"})
+                           "kurtosis", "cov", "cross", "matrix_norm", "split",
+                           "hsplit", "vsplit", "dsplit"})
 
 
 def _tolkw(spec, dtype_letter, x64):
+    if spec.name == "rsvd" and ON_ACCELERATOR:
+        # the randomized range-finder's sketch GEMMs deliberately run at
+        # Precision.DEFAULT (svd.py:128-136) — bf16 passes on the MXU — so
+        # exact-rank reconstruction carries ~1e-3-level roundoff there
+        return dict(rtol=2e-2, atol=2e-3)
     if spec.name in _F32_INTERNAL:
         return dict(rtol=5e-3, atol=5e-4)
     if x64 and dtype_letter == "f":
