@@ -37,7 +37,11 @@ from _accel import ON_ACCELERATOR
 N_CHAINS = int(os.environ.get("HEAT_TPU_FUZZ_CHAINS", "6" if ON_ACCELERATOR else "24"))
 OPS_PER_CHAIN = 6
 
-TOL = dict(rtol=2e-4, atol=2e-5)  # f32 chains accumulate a few ulp per step
+# f32 chains accumulate a few ulp per step on the CPU mesh; accelerator VPU
+# transcendentals (~2.2e-4 relative) get amplified by cancellation-type chain
+# steps (sorted-neighbor diff, log near 0), so the accelerator bound is the
+# amplified one — the CPU mesh remains the tight primary bug-finder
+TOL = dict(rtol=5e-3, atol=1e-4) if ON_ACCELERATOR else dict(rtol=2e-4, atol=2e-5)
 
 
 # --------------------------------------------------------------------- op table
@@ -226,6 +230,75 @@ def _fancy(h, a, rng):
 @op("where", lambda a: a.dtype.kind == "f")
 def _where(h, a, rng):
     return ht.where(h > 0, h, -h), np.where(a > 0, a, -a)
+
+
+# ----- round-5 widening: ops whose bugs only surface mid-chain (resplit state,
+# pad interactions, index-then-reduce compositions)
+@op("resplit", lambda a: a.ndim >= 1)
+def _resplit(h, a, rng):
+    tgt = [None, *range(a.ndim)][int(rng.integers(0, a.ndim + 1))]
+    return ht.resplit(h, tgt), a
+
+
+@op("pad_const", lambda a: a.ndim >= 1 and a.dtype.kind in "fi")
+def _pad(h, a, rng):
+    w = tuple((int(rng.integers(0, 2)), int(rng.integers(0, 2))) for _ in range(a.ndim))
+    return ht.pad(h, w), np.pad(a, w)
+
+
+@op("clip_band", lambda a: a.dtype.kind == "f")
+def _clip(h, a, rng):
+    lo = float(rng.uniform(-2, 0))
+    return ht.clip(h, lo, lo + 2.0), np.clip(a, lo, lo + 2.0)
+
+
+@op("diff", lambda a: a.ndim >= 1 and a.dtype.kind in "fi" and min(a.shape) >= 2)
+def _diff(h, a, rng):
+    ax = _rand_axis(a, rng)
+    return ht.diff(h, axis=ax), np.diff(a, axis=ax)
+
+
+@op("take_rows", lambda a: a.ndim >= 1 and a.shape[0] >= 2)
+def _take(h, a, rng):
+    idx = rng.integers(0, a.shape[0], 4).astype(np.int32)
+    return ht.take(h, ht.array(idx), axis=0), np.take(a, idx, axis=0)
+
+
+@op("repeat2", lambda a: a.ndim >= 1 and a.dtype.kind in "fi")
+def _repeat(h, a, rng):
+    ax = _rand_axis(a, rng)
+    return ht.repeat(h, 2, axis=ax), np.repeat(a, 2, axis=ax)
+
+
+@op("argmax_gather", lambda a: a.ndim >= 1 and a.dtype.kind == "f" and min(a.shape) >= 1)
+def _argmax(h, a, rng):
+    ax = _rand_axis(a, rng)
+    i = ht.argmax(h, axis=ax)
+    gathered = np.take_along_axis(
+        a, np.expand_dims(i.numpy().astype(np.int64), ax), axis=ax
+    ).squeeze(ax)
+    return ht.array(gathered), np.max(a, axis=ax)
+
+
+@op("swapaxes", lambda a: a.ndim >= 2)
+def _swap(h, a, rng):
+    i = _rand_axis(a, rng)
+    j = _rand_axis(a, rng)
+    return ht.swapaxes(h, i, j), np.swapaxes(a, i, j)
+
+
+@op("tril", lambda a: a.ndim >= 2 and a.dtype.kind in "fi")
+def _tril(h, a, rng):
+    return ht.tril(h), np.tril(a)
+
+
+@op("nan_guard", lambda a: a.dtype.kind == "f")
+def _nanguard(h, a, rng):
+    # oracle must mirror the full NaN flow: log(|NaN|)=NaN -> nan_to_num -> 0,
+    # exactly like the heat side (a where= mask would leave -inf for NaN input)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        ref = np.nan_to_num(np.log(np.abs(a)))
+    return ht.nan_to_num(ht.log(ht.abs(h))), ref
 
 
 # ------------------------------------------------------------------ the engine

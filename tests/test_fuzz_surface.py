@@ -558,15 +558,19 @@ def _mk_xsplit(name, npf, need_dim):
     axis = {"hsplit": 1, "vsplit": 0, "dsplit": 2}[name]
 
     def fn(rng, h, a):
-        # self-drawn input: the split axis must be even, which a generic draw
-        # misses too often at low case counts
-        shp = [int(rng.integers(1, 6)) for _ in range(need_dim)]
-        shp[axis] = 2 * int(rng.integers(1, 5))
-        x = rng.standard_normal(tuple(shp)).astype(np.float32)
-        split = int(rng.integers(0, need_dim)) if rng.integers(0, 2) else None
-        return htf(ht.array(x, split=split), 2), npf(x, 2)
+        # the split axis must be even: trim an odd tail (keeps the generic
+        # draw's dtype/x64/ragged/split coverage, never self-skips)
+        m = a.shape[axis] - a.shape[axis] % 2
+        if m == 0:  # extent-1 axis: double it instead of skipping
+            h = ht.concatenate([h, h], axis=axis)
+            a = np.concatenate([a, a], axis=axis)
+            m = 2
+        sl = tuple(
+            slice(0, m) if d == axis else slice(None) for d in range(a.ndim)
+        )
+        return htf(h[sl], 2), npf(a[sl], 2)
 
-    reg(name, fn, "fi", kind="none")
+    reg(name, fn, "fi", min_ndim=need_dim, empty_ok=False)
 
 
 _mk_xsplit("hsplit", np.hsplit, 2)
@@ -1311,8 +1315,7 @@ def _draw_input(rng, spec, x64, dtype_letter):
 # specs whose internals run in float32 regardless of the input dtype schedule
 # (they build their own f32 operands) — the x64 tight tolerance never applies
 _F32_INTERNAL = frozenset({"cg", "rsvd", "lanczos", "svd", "qr", "skew",
-                           "kurtosis", "cov", "cross", "matrix_norm", "split",
-                           "hsplit", "vsplit", "dsplit"})
+                           "kurtosis", "cov", "cross", "matrix_norm", "split"})
 
 
 def _tolkw(spec, dtype_letter, x64):
