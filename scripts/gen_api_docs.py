@@ -48,6 +48,14 @@ ENV_DEPENDENT = {
         "tpu": "present when a TPU backend is probed",
         "gpu": "present when a GPU backend is probed",
     },
+    # the comm singletons repr their mesh size, which is ? before the lazy
+    # device probe and the probed count after — init-order-dependent
+    "heat_tpu.core.communication": {
+        "WORLD": "MeshCommunication over all probed devices",
+        "SELF": "single-device MeshCommunication",
+        "MPI_WORLD": "alias of WORLD (reference-name parity)",
+        "MPI_SELF": "alias of SELF (reference-name parity)",
+    },
 }
 
 

@@ -306,11 +306,14 @@ def test_log_domain_edges(split):
 
 @pytest.mark.parametrize("split", SPLITS)
 def test_hyperbolic_inverses_domain(split):
+    from _accel import tol
+
+    kw = tol("arctanh")  # VPU polynomial approximations on real accelerators
     a = np.array([0.0, 0.5, -0.5, 0.99], np.float32)
     h = ht.array(a, split=split)
-    np.testing.assert_allclose(ht.arctanh(h).numpy(), np.arctanh(a), rtol=1e-5)
+    np.testing.assert_allclose(ht.arctanh(h).numpy(), np.arctanh(a), **kw)
     b = np.array([1.0, 1.5, 10.0], np.float32)  # arccosh domain starts at 1
     np.testing.assert_allclose(
-        ht.arccosh(ht.array(b, split=split)).numpy(), np.arccosh(b), rtol=1e-5
+        ht.arccosh(ht.array(b, split=split)).numpy(), np.arccosh(b), **kw
     )
-    np.testing.assert_allclose(ht.arcsinh(h).numpy(), np.arcsinh(a), rtol=1e-5)
+    np.testing.assert_allclose(ht.arcsinh(h).numpy(), np.arcsinh(a), **kw)
