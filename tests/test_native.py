@@ -1,6 +1,8 @@
 """Native C++ runtime helpers (heat_tpu/native): the threaded CSV parser and its
 integration with ht.load_csv (reference io.py:713-925 byte-range parallel CSV)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -177,3 +179,30 @@ def test_partial_h5_compressed_falls_back(tmp_path):
     ds._load_next()
     np.testing.assert_array_equal(ds._window["data"][-10:], np.arange(40.0, 60.0).reshape(10, 2))
     ds.close()
+
+
+def test_prefetch_post_open_truncation_is_recoverable(tmp_path):
+    """A file truncated AFTER open must surface as IOError (-2 via the
+    per-slab fstat re-check, _prefetch.cpp), never fault the mapping — and the
+    rolled-back ticket must stay consumable once the file is restored.
+
+    Deterministic by construction: slab 0 lies entirely inside the
+    post-truncation range (the warmer may touch it at any time, safely), and
+    with depth=1 the warmer cannot reach slab 1 before the first consume —
+    by which time the truncation has already happened, so its fstat clamp
+    skips the touch. No window ever touches past the live EOF."""
+    data = bytes(range(256)) * 64  # 16 KiB
+    p = tmp_path / "trunc.bin"
+    p.write_bytes(data)
+    pf = native.SlabPrefetcher(str(p), [0, 8192], [4096, 8192], depth=1, nthreads=1)
+    os.truncate(p, 4096)  # before any consume: slab 1 now lies beyond EOF
+    buf = np.empty(8192, dtype=np.uint8)
+    assert pf.next_into(buf) == 4096
+    with pytest.raises(IOError):
+        pf.next_into(buf)
+    # -2 rolls the ticket back (serialized consumer): restoring the file
+    # makes the same slab deliverable on retry
+    p.write_bytes(data)
+    assert pf.next_into(buf) == 8192
+    assert bytes(buf[:16]) == data[8192 : 8192 + 16]
+    pf.close()
