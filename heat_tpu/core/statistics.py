@@ -306,7 +306,13 @@ def percentile(x, q, axis=None, out=None, interpolation: str = "linear", keepdim
     if interpolation not in ("linear", "lower", "higher", "midpoint", "nearest"):
         raise ValueError(f"unsupported interpolation method {interpolation!r}")
     axis = stride_tricks.sanitize_axis(x.shape, axis)
-    qv = q.larray if isinstance(q, DNDarray) else jnp.asarray(q, dtype=jnp.float32)
+    # working float dtype: f32 stays f32, f64 stays f64 under x64, exact
+    # dtypes promote to the default float (the WEAK float operand is what
+    # gives int64 -> f64 under x64; a strong jnp.float32 would pin ints to
+    # f32) — a hardcoded f32 here silently downcast f64 split-axis medians
+    # (caught by the x64 surface fuzz)
+    ft = jnp.result_type(x.dtype.jnp_type(), float)
+    qv = q.larray if isinstance(q, DNDarray) else jnp.asarray(q, dtype=ft)
     from . import _sort as _dsort
 
     if isinstance(axis, (int, type(None))) and _dsort.can_distribute_sort(x, axis):
@@ -323,7 +329,7 @@ def percentile(x, q, axis=None, out=None, interpolation: str = "linear", keepdim
         # forces a blocking fetch per percentile call); traced q (percentile
         # under jit) stays in jnp and getitem skips the eager check
         xp = jnp if isinstance(qv, jax.core.Tracer) else np
-        qf = xp.asarray(qv, dtype=xp.float32) / 100.0 * (n - 1)
+        qf = xp.asarray(qv, dtype=np.dtype(ft)) / 100.0 * (n - 1)
         lo = xp.clip(xp.floor(qf).astype(xp.int32), 0, n - 1)
         hi = xp.clip(xp.ceil(qf).astype(xp.int32), 0, n - 1)
         nq = int(np.prod(np.shape(qf), dtype=np.int64)) if np.shape(qf) else 1
@@ -331,11 +337,11 @@ def percentile(x, q, axis=None, out=None, interpolation: str = "linear", keepdim
         key = (slice(None),) * ax + (idx,)
         # single advanced key on the split axis: the DNDarray getitem keeps the
         # order and gathers only 2*nq rows
-        picked = sv[key].larray.astype(jnp.float32)
+        picked = sv[key].larray.astype(ft)
         pm = jnp.moveaxis(picked, ax, 0).reshape((2, nq) + rest)
         qshape = tuple(jnp.shape(qf))
         v_lo, v_hi = pm[0].reshape(qshape + rest), pm[1].reshape(qshape + rest)
-        lo_b = lo.astype(jnp.float32).reshape(qshape + (1,) * len(rest))
+        lo_b = lo.astype(ft).reshape(qshape + (1,) * len(rest))
         qf_b = qf.reshape(qshape + (1,) * len(rest))
         if interpolation == "lower":
             res = v_lo
@@ -355,7 +361,7 @@ def percentile(x, q, axis=None, out=None, interpolation: str = "linear", keepdim
             # numpy/jnp propagate NaN for every q; the selection sorts NaN to the
             # end, so poison explicitly to keep split == replicated results
             nan_mask = jnp.isnan(x.larray).any(axis=ax).reshape((1,) * len(qshape) + rest)
-            res = jnp.where(nan_mask, jnp.float32(np.nan), res)
+            res = jnp.where(nan_mask, jnp.asarray(np.nan, dtype=ft), res)
         if keepdim:
             kshape = tuple(1 if d == ax else s for d, s in enumerate(x.shape))
             res = res.reshape(qshape + kshape)
@@ -364,7 +370,7 @@ def percentile(x, q, axis=None, out=None, interpolation: str = "linear", keepdim
         # flatten around the call and restore the q dimensions in front
         qf = jnp.asarray(qv)
         res = jnp.percentile(
-            x.larray.astype(jnp.float32), qf.reshape(-1) if qf.ndim > 1 else qf,
+            x.larray.astype(ft), qf.reshape(-1) if qf.ndim > 1 else qf,
             axis=axis, method=interpolation, keepdims=keepdim,
         )
         if qf.ndim > 1:

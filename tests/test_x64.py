@@ -158,3 +158,24 @@ def test_f64_det_inv_distributed():
     np.testing.assert_allclose(float(d.larray), np.linalg.det(a), rtol=1e-10)
     iv = ht.linalg.inv(h)
     np.testing.assert_allclose(iv.numpy(), np.linalg.inv(a), rtol=1e-9, atol=1e-10)
+
+
+def test_median_percentile_split_axis_keep_f64():
+    """The distributed-selection median/percentile must compute in f64 under
+    x64 — a hardcoded f32 working dtype rounded split-axis medians to 7
+    digits (caught by the x64 surface-fuzz case at mesh size 3)."""
+    a = 1.0 + np.arange(21, dtype=np.float64).reshape(7, 3) * 2.0**-40
+    h = ht.array(a, split=0)
+    m = ht.median(h, axis=0)
+    assert m.larray.dtype == np.float64
+    np.testing.assert_array_equal(m.numpy(), np.median(a, axis=0))
+    p = ht.percentile(h, 31.25, axis=0)
+    assert p.larray.dtype == np.float64
+    np.testing.assert_allclose(
+        p.numpy(), np.percentile(a, 31.25, axis=0), rtol=0, atol=2.0**-52
+    )
+    # int64 input: the WEAK-float working dtype must give an exact f64 median
+    iv = np.array([0, 2**40 + 1, 2**53, 5, 7], dtype=np.int64)
+    im = ht.median(ht.array(iv, split=0), axis=0)
+    assert im.larray.dtype == np.float64
+    assert float(im.numpy()) == float(np.median(iv))
