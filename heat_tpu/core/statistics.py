@@ -158,14 +158,30 @@ def cov(m, y=None, rowvar: bool = True, bias: bool = False, ddof: Optional[int] 
     return DNDarray(res, tuple(res.shape), types.canonical_heat_type(res.dtype), None, m.device, m.comm, True)
 
 
+def __f64_edges(data, nbins, lo=None, hi=None):
+    """Equal-width bin edges built on the host in float64 and cast to the
+    working dtype — numpy computes edges in f64, and jnp's f32 edge
+    arithmetic can land an exact-edge sample one bin off (fuzz cases 49/93).
+    An f32 data value that IS an f64 edge stays bit-exact through the cast."""
+    if lo is None:
+        if data.size == 0:
+            lo, hi = 0.0, 1.0
+        else:
+            lo, hi = float(jnp.min(data)), float(jnp.max(data))
+    if lo == hi:
+        lo, hi = lo - 0.5, hi + 0.5
+    edges64 = np.linspace(lo, hi, int(nbins) + 1, dtype=np.float64)
+    return jnp.asarray(edges64.astype(np.result_type(data.dtype, np.float32)))
+
+
 def histc(input, bins: int = 100, min: float = 0.0, max: float = 0.0, out=None) -> DNDarray:
     """Histogram with equal-width bins in [min, max] (torch semantics; reference
     statistics.py histc)."""
     sanitation.sanitize_in(input)
     lo, hi = float(min), float(max)
     if lo == 0.0 and hi == 0.0:
-        lo, hi = float(jnp.min(input.larray)), float(jnp.max(input.larray))
-    hist, _ = jnp.histogram(input.larray, bins=bins, range=(lo, hi))
+        lo, hi = None, None  # derive from the data, in f64, like histogram
+    hist, _ = jnp.histogram(input.larray, bins=__f64_edges(input.larray, bins, lo, hi))
     hist = hist.astype(input.dtype.jnp_type())
     res = DNDarray(hist, tuple(hist.shape), input.dtype, None, input.device, input.comm, True)
     if out is not None:
@@ -179,6 +195,9 @@ def histogram(a, bins=10, range=None, normed=None, weights=None, density=None):
     (reference statistics.py histogram)."""
     sanitation.sanitize_in(a)
     w = weights.larray if isinstance(weights, DNDarray) else weights
+    if isinstance(bins, (int, np.integer)):
+        lo, hi = (float(range[0]), float(range[1])) if range is not None else (None, None)
+        bins = __f64_edges(a.larray, bins, lo, hi)
     hist, edges = jnp.histogram(a.larray, bins=bins, range=range, weights=w, density=density or normed)
     h = DNDarray(hist, tuple(hist.shape), types.canonical_heat_type(hist.dtype), None, a.device, a.comm, True)
     e = DNDarray(edges, tuple(edges.shape), types.canonical_heat_type(edges.dtype), None, a.device, a.comm, True)

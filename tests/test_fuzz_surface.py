@@ -685,7 +685,14 @@ reg("histc", _histc, "f", check_dtype=False)
 
 def _histogram(rng, h, a):
     hist, edges = ht.histogram(h, bins=6)
-    nh, ne = np.histogram(a, bins=6)
+    # edges must equal numpy's f64-derived edges (to f32 rounding); counts are
+    # compared THROUGH those returned edges — numpy's int-bins path places
+    # exact-edge samples by comparing against its f64 edges, which no f32
+    # device placement can reproduce (a sample ON an edge may land one bin
+    # over, mega-fuzz cases 49/93), while explicit-edge placement is
+    # deterministic in both libraries
+    ne = np.histogram_bin_edges(a, bins=6)
+    nh, _ = np.histogram(a, bins=edges.numpy())
     return (hist, edges), (nh, ne)
 
 
