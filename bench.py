@@ -64,6 +64,12 @@ Round-5 rework (VERDICT r4 #1 and #4; scripts/kmeans_hlo_audit.py):
   metric (r4 shipped 114.2% with only a note). The ICI number it stands in
   for is explicitly not measurable at n=1 (``ici_gbps: null``); the 8-device
   dryrun psum (MULTICHIP_r05.json) is the multi-device correctness proxy.
+
+Observability: the bench runs under ``heat_tpu.monitoring.capture()`` and the
+output line carries a ``telemetry`` block — per-phase wall-time spans, jit
+compile-cache misses (count + total compile seconds), collective/placement
+counters, and device memory where the backend reports it. The phase spans sit
+OUTSIDE every timed leg, so the headline statistics are untouched.
 """
 
 import json
@@ -608,60 +614,80 @@ def bench_scaling_8dev():
 
 
 def main():
+    # Observability (heat_tpu/monitoring/): the whole bench runs under
+    # capture() with one span per phase, and the output line carries a compact
+    # `telemetry` block (jit compile-cache misses, collective/placement
+    # counters, per-phase wall time, device memory where the backend reports
+    # it). The timed kernels themselves are plain jitted XLA programs — the
+    # phase-level spans add nothing inside any timed leg.
+    from heat_tpu import monitoring
+    from heat_tpu.monitoring import events as _mev
+
     rng = np.random.default_rng(0)
     data = _data(rng)
-    try:
-        stream_gbps, stream_pct, stream_valid = bench_hbm_stream()
-    except Exception:
-        stream_gbps = stream_pct = stream_valid = None
-    # a probe the bench itself flagged invalid must not set the headline's
-    # gate ceiling or its vs-stream ratio — fall back to the nominal roofline
-    km = bench_tpu(data, stream_gbps=stream_gbps if stream_valid else None)
-    try:
-        torch_ips = bench_torch_cpu(data)
-        vs = km["value"] / torch_ips
-    except Exception:
-        torch_ips, vs = None, None
-    try:
-        mfu_tflops, mfu_pct, mfu_valid = bench_matmul_mfu()
-    except Exception:
-        mfu_tflops = mfu_pct = mfu_valid = None
-    try:
-        cdist_gbps, cdist_pct, cdist_valid = bench_cdist()
-    except Exception:
-        cdist_gbps = cdist_pct = cdist_valid = None
-    try:
-        ar_gbps, ar_pct, ar_note, ar_valid = bench_allreduce()
-    except Exception:
-        ar_gbps = ar_pct = ar_note = ar_valid = None
-    try:
-        scale8_ips, scale8_overhead = bench_scaling_8dev()
-    except Exception:
-        scale8_ips = scale8_overhead = None
-    # gated linalg anchors (VERDICT r4 #3): ~2 min of compile on the tunneled
-    # chip; BENCH_FAST=1 skips them for quick interactive runs
-    linalg = {}
-    if os.environ.get("BENCH_FAST") != "1":
+    with monitoring.capture():
         try:
-            _add_benchmarks_path()
-            from linalg_bench import bench_linalg
-
-            linalg = bench_linalg()
-        except Exception as e:
-            # explicit null-valued keys, like the neighbouring benches: a
-            # crashed anchor must be distinguishable from a BENCH_FAST skip
-            linalg = {f"{op}_valid": None for op in ("qr", "svd", "solve", "det")}
-            linalg["linalg_error"] = repr(e)[:160]
-    # out-of-core input pipeline (VERDICT r4 #8): native prefetcher vs h5py
-    io_pipe = {}
-    if os.environ.get("BENCH_FAST") != "1":
+            with _mev.span("bench.hbm_stream"):
+                stream_gbps, stream_pct, stream_valid = bench_hbm_stream()
+        except Exception:
+            stream_gbps = stream_pct = stream_valid = None
+        # a probe the bench itself flagged invalid must not set the headline's
+        # gate ceiling or its vs-stream ratio — fall back to the nominal roofline
+        with _mev.span("bench.kmeans"):
+            km = bench_tpu(data, stream_gbps=stream_gbps if stream_valid else None)
         try:
-            _add_benchmarks_path()
-            from io_pipeline_bench import bench_io_pipeline
+            with _mev.span("bench.torch_cpu_baseline"):
+                torch_ips = bench_torch_cpu(data)
+            vs = km["value"] / torch_ips
+        except Exception:
+            torch_ips, vs = None, None
+        try:
+            with _mev.span("bench.matmul_mfu"):
+                mfu_tflops, mfu_pct, mfu_valid = bench_matmul_mfu()
+        except Exception:
+            mfu_tflops = mfu_pct = mfu_valid = None
+        try:
+            with _mev.span("bench.cdist"):
+                cdist_gbps, cdist_pct, cdist_valid = bench_cdist()
+        except Exception:
+            cdist_gbps = cdist_pct = cdist_valid = None
+        try:
+            with _mev.span("bench.allreduce"):
+                ar_gbps, ar_pct, ar_note, ar_valid = bench_allreduce()
+        except Exception:
+            ar_gbps = ar_pct = ar_note = ar_valid = None
+        try:
+            with _mev.span("bench.scaling_8dev"):
+                scale8_ips, scale8_overhead = bench_scaling_8dev()
+        except Exception:
+            scale8_ips = scale8_overhead = None
+        # gated linalg anchors (VERDICT r4 #3): ~2 min of compile on the tunneled
+        # chip; BENCH_FAST=1 skips them for quick interactive runs
+        linalg = {}
+        if os.environ.get("BENCH_FAST") != "1":
+            try:
+                _add_benchmarks_path()
+                from linalg_bench import bench_linalg
 
-            io_pipe = bench_io_pipeline()
-        except Exception as e:
-            io_pipe = {"io_pipeline_valid": None, "io_pipeline_error": repr(e)[:160]}
+                with _mev.span("bench.linalg"):
+                    linalg = bench_linalg()
+            except Exception as e:
+                # explicit null-valued keys, like the neighbouring benches: a
+                # crashed anchor must be distinguishable from a BENCH_FAST skip
+                linalg = {f"{op}_valid": None for op in ("qr", "svd", "solve", "det")}
+                linalg["linalg_error"] = repr(e)[:160]
+        # out-of-core input pipeline (VERDICT r4 #8): native prefetcher vs h5py
+        io_pipe = {}
+        if os.environ.get("BENCH_FAST") != "1":
+            try:
+                _add_benchmarks_path()
+                from io_pipeline_bench import bench_io_pipeline
+
+                with _mev.span("bench.io_pipeline"):
+                    io_pipe = bench_io_pipeline()
+            except Exception as e:
+                io_pipe = {"io_pipeline_valid": None, "io_pipeline_error": repr(e)[:160]}
+        telemetry = monitoring.report.telemetry()
     print(
         json.dumps(
             {
@@ -703,6 +729,7 @@ def main():
                 "dp8_cpu_sharding_overhead_pct": scale8_overhead,
                 **linalg,
                 **io_pipe,
+                "telemetry": telemetry,
             }
         )
     )

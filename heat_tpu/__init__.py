@@ -13,6 +13,7 @@ from . import datasets
 from . import classification
 from . import cluster
 from . import graph
+from . import monitoring
 from . import naive_bayes
 from . import nn
 from . import optim

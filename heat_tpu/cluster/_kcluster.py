@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import heat_tpu as ht
 from ..core.base import BaseEstimator, ClusteringMixin
 from ..core.dndarray import DNDarray
+from ..monitoring import events as _ev
 
 __all__ = ["_KCluster"]
 
@@ -91,6 +92,11 @@ class _KCluster(BaseEstimator, ClusteringMixin):
         """
         if self.random_state is not None:
             ht.random.seed(self.random_state)
+        strategy = self.init if isinstance(self.init, str) else "explicit"
+        with _ev.span("kcluster.init_centers", strategy=strategy):
+            self.__init_centers(x)
+
+    def __init_centers(self, x: DNDarray) -> None:
         n = x.shape[0]
         if isinstance(self.init, DNDarray):
             if self.init.shape != (self.n_clusters, x.shape[1]):
@@ -125,8 +131,9 @@ class _KCluster(BaseEstimator, ClusteringMixin):
     def _assign_to_cluster(self, x: DNDarray) -> DNDarray:
         """Label each sample with the nearest centroid (reference
         _kcluster.py:196-224)."""
-        d = self._metric(x.larray, self._cluster_centers.larray)
-        labels = jnp.argmin(d, axis=1)
+        with _ev.span("kcluster.assign", n=int(x.shape[0])):
+            d = self._metric(x.larray, self._cluster_centers.larray)
+            labels = jnp.argmin(d, axis=1)
         return ht.array(labels, split=x.split, device=x.device, comm=x.comm)
 
     def _update_centroids(self, x: DNDarray, matching_centroids: DNDarray) -> DNDarray:

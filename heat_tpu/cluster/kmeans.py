@@ -19,6 +19,8 @@ import jax.numpy as jnp
 import heat_tpu as ht
 from ._kcluster import _KCluster
 from ..core.dndarray import DNDarray
+from ..monitoring import events as _ev
+from ..monitoring.registry import REGISTRY as _REG, STATE as _MON
 from ..spatial.distance import _quadratic_expand
 
 __all__ = ["KMeans"]
@@ -145,15 +147,55 @@ class KMeans(_KCluster):
         self._initialize_cluster_centers(x)
         centers = self._cluster_centers.larray
         data = x.larray
-        # the two-GEMM XLA step runs at the MXU roofline (a fused pallas Lloyd
-        # kernel raced it through round 1 and lost 3-6x on v5e — lesson recorded
-        # in doc/performance.md), and on sharded data XLA inserts the psum over
-        # the sample axis
-        centers, labels, inertia, n_iter = _kmeans_fit_loop(
-            data, centers, _kmeans_step, self.max_iter, float(self.tol)
-        )
+        if _MON.enabled:
+            centers, labels, inertia, n_iter = self._fit_observed(x, data, centers)
+        else:
+            # the two-GEMM XLA step runs at the MXU roofline (a fused pallas Lloyd
+            # kernel raced it through round 1 and lost 3-6x on v5e — lesson recorded
+            # in doc/performance.md), and on sharded data XLA inserts the psum over
+            # the sample axis
+            centers, labels, inertia, n_iter = _kmeans_fit_loop(
+                data, centers, _kmeans_step, self.max_iter, float(self.tol)
+            )
         self._cluster_centers = ht.array(centers, device=x.device, comm=x.comm)
         self._labels = ht.array(labels, split=x.split, device=x.device, comm=x.comm)
         self._inertia = float(inertia)
         self._n_iter = int(n_iter)
         return self
+
+    def _fit_observed(self, x: DNDarray, data: jax.Array, centers: jax.Array):
+        """
+        Monitoring-enabled fit: the same Lloyd condition/step as
+        ``_kmeans_fit_loop`` driven from the host, emitting one ``kmeans.step``
+        span per iteration (wall time, device-synchronized via the shift
+        readback, and the convergence delta as an attribute). The fused
+        on-device loop stays the production path — this loop trades the
+        avoided host round-trip for per-iteration visibility, exactly when the
+        operator asked for it.
+        """
+        with _ev.span(
+            "kmeans.fit", n=int(data.shape[0]), k=int(self.n_clusters)
+        ) as fit_sp:
+            shift = float("inf")
+            n_iter = 0
+            tol = float(self.tol)
+            while n_iter < self.max_iter and shift > tol:
+                with _ev.span("kmeans.step", iteration=n_iter) as sp:
+                    centers, _, shift_dev, _ = _kmeans_step(data, centers)
+                    # blocking readback = the device-time mark for the step
+                    shift = float(shift_dev)
+                    sp.set(shift=shift)
+                n_iter += 1
+            # labels w.r.t. the final centers, like the fused loop
+            _, labels, _, _ = _kmeans_step(data, centers)
+            # the final inertia reduce runs through the framework's own
+            # generic-dispatch ops (same sum(min(d2, axis=1)) the fused loop
+            # computes), so a monitored fit's snapshot also counts op
+            # dispatches — the reference computes its inertia at this level too
+            d2 = jnp.maximum(_quadratic_expand(data, centers), 0.0)
+            d2_dnd = ht.array(d2, split=x.split, device=x.device, comm=x.comm)
+            inertia = ht.sum(ht.min(d2_dnd, axis=1)).item()
+            fit_sp.set(n_iter=n_iter, converged=shift <= tol)
+        _REG.counter("kmeans.fits").inc()
+        _REG.counter("kmeans.iterations").inc(n_iter)
+        return centers, labels, inertia, n_iter

@@ -30,6 +30,11 @@ from . import stride_tricks
 from .communication import sanitize_comm
 from .dndarray import DNDarray
 
+# observability: the disabled path costs exactly one truthiness check per
+# dispatch (an attribute load on a slotted state object — no dict/string work)
+from ..monitoring.registry import STATE as _MON
+from ..monitoring import instrument as _instr
+
 __all__ = []
 
 
@@ -89,6 +94,8 @@ def __binary_op(
     from . import types
     from .types import canonical_heat_type, result_type
 
+    if _MON.enabled:
+        _instr.op_dispatch("binary")
     fn_kwargs = fn_kwargs or {}
 
     scalars = (builtins.int, builtins.float, builtins.bool, builtins.complex, np.number, np.bool_)
@@ -105,6 +112,8 @@ def __binary_op(
         # true division of exact (int/bool) operands is float (reference
         # arithmetics.py div == torch.true_divide promotion)
         promoted = types.promote_types(promoted, types.float32)
+        if _MON.enabled:
+            _instr.dtype_fallback("true_divide")
 
     arrays = []
     dnd_ops = []
@@ -181,6 +190,8 @@ def __binary_op(
         # comparison ops legitimately return bool; numeric ops are cast to the
         # heat-promoted type
         if operation not in (jnp.equal, jnp.not_equal):
+            if _MON.enabled:
+                _instr.dtype_fallback("binary_cast")
             result = result.astype(promoted.jnp_type())
     res_dtype = canonical_heat_type(result.dtype)
 
@@ -223,6 +234,8 @@ def __local_op(
     """
     from .types import canonical_heat_type
 
+    if _MON.enabled:
+        _instr.op_dispatch("local")
     sanitation.sanitize_in(x)
     if force_logical and x.is_padded:
         result = operation(x.larray, **kwargs)
@@ -274,6 +287,8 @@ def __reduce_op(
     """
     from .types import canonical_heat_type
 
+    if _MON.enabled:
+        _instr.op_dispatch("reduce")
     sanitation.sanitize_in(x)
     axis = stride_tricks.sanitize_axis(x.shape, axis)
 
@@ -357,6 +372,8 @@ def __cum_op(
     from .communication import MeshCommunication
     from .types import canonical_heat_type
 
+    if _MON.enabled:
+        _instr.op_dispatch("cum")
     sanitation.sanitize_in(x)
     axis = stride_tricks.sanitize_axis(x.shape, axis)
     if axis is None:

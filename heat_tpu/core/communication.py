@@ -23,6 +23,10 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+# observability: disabled-path cost is one truthiness check (see monitoring/)
+from ..monitoring.registry import STATE as _MON
+from ..monitoring import instrument as _instr
+
 __all__ = [
     "Communication",
     "MeshCommunication",
@@ -327,6 +331,8 @@ class MeshCommunication(Communication):
         (reference dndarray.py:1033-1362) — XLA emits the slice-exchange
         collectives.
         """
+        if _MON.enabled:
+            _instr.placement()
         if split is None or data.ndim == 0:
             return jax.device_put(data, self.sharding(data.ndim, None))
         split = int(split) % data.ndim
@@ -367,6 +373,8 @@ class MeshCommunication(Communication):
     # publishes the per-device layout for code that wants it.
 
     def __collective(self, kind: str, split: int, ndim: int, op: str = "", **kw):
+        if _MON.enabled:
+            _instr.collective(kind)
         key = (kind, op, self.mesh, self.__axis_name, split, ndim, tuple(sorted(kw.items())))
         fn = _COLLECTIVE_CACHE.get(key)
         if fn is None:

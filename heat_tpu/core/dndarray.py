@@ -43,6 +43,10 @@ from .communication import Communication, MeshCommunication, sanitize_comm
 from .devices import Device
 from .stride_tricks import sanitize_axis
 
+# observability: disabled-path cost is one truthiness check (see monitoring/)
+from ..monitoring.registry import STATE as _MON
+from ..monitoring import instrument as _instr
+
 __all__ = ["DNDarray", "LocalIndex"]
 
 import functools
@@ -505,6 +509,11 @@ class DNDarray:
             return self
         comm = self.__comm
         if isinstance(comm, MeshCommunication) and comm.is_distributed():
+            if _MON.enabled:
+                # a genuine split change on a distributed mesh: XLA emits the
+                # all-to-all/all-gather — the event every "how many resharding
+                # collectives did this run cost?" question counts
+                _instr.resharding(self.__split, axis)
             # go through the logical view: the old axis's pad is dropped, the new
             # axis's pad (if ragged) is established by placed()
             self.__array = comm.placed(self.larray, axis, self.__gshape)
@@ -531,6 +540,8 @@ class DNDarray:
                 )
         comm = self.__comm
         if isinstance(comm, MeshCommunication) and comm.is_distributed():
+            if _MON.enabled:
+                _instr.resharding(self.__split, self.__split)
             self.__array = comm.placed(self.__array, self.__split, self.__gshape)
             self.__invalidate()
 

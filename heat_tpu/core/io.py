@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import csv as csv_mod
 import os
+import time as _time
 from typing import Optional, Tuple, Union
 
 import numpy as np
@@ -24,6 +25,10 @@ from . import factories
 from . import types
 from .communication import MeshCommunication, sanitize_comm
 from .dndarray import DNDarray
+
+# observability: load/save record bytes moved + duration when enabled
+from ..monitoring.registry import STATE as _MON
+from ..monitoring import instrument as _instr
 
 
 def _load_sharded(reader, gshape, dtype, split, device, comm) -> Optional[DNDarray]:
@@ -120,14 +125,18 @@ if __HDF5:
             raise TypeError(f"path must be str, not {type(path)}")
         if not isinstance(dataset, str):
             raise TypeError(f"dataset must be str, not {type(dataset)}")
+        t0 = _time.perf_counter()
         with h5py.File(path, "r") as handle:
             dset = handle[dataset]
             gshape = tuple(int(s) for s in dset.shape)
             res = _load_sharded(lambda sl: dset[sl], gshape, dtype, split, device, comm)
-            if res is not None:
-                return res
-            data = np.asarray(dset)
-        return factories.array(data, dtype=dtype, split=split, device=device, comm=comm)
+            if res is None:
+                data = np.asarray(dset)
+        if res is None:
+            res = factories.array(data, dtype=dtype, split=split, device=device, comm=comm)
+        if _MON.enabled:
+            _instr.record_io("load_hdf5", path, res.nbytes, _time.perf_counter() - t0)
+        return res
 
     def save_hdf5(data: DNDarray, path: str, dataset: str, mode: str = "w", **kwargs) -> None:
         """
@@ -138,6 +147,14 @@ if __HDF5:
             raise TypeError(f"data must be a DNDarray, not {type(data)}")
         if not isinstance(path, str):
             raise TypeError(f"path must be str, not {type(path)}")
+        t0 = _time.perf_counter()
+        try:
+            _save_hdf5_body(data, path, dataset, mode, **kwargs)
+        finally:
+            if _MON.enabled:
+                _instr.record_io("save_hdf5", path, data.nbytes, _time.perf_counter() - t0)
+
+    def _save_hdf5_body(data: DNDarray, path: str, dataset: str, mode: str, **kwargs) -> None:
         arr = data.parray
         if hasattr(arr, "is_fully_addressable") and not arr.is_fully_addressable:
             # multi-controller: a shard-wise write after a mode-'w' truncate would
@@ -192,21 +209,26 @@ if __NETCDF:
     ) -> DNDarray:
         """Load a NetCDF variable into a (split) DNDarray (reference io.py:471-590);
         slab-wise per device like :func:`load_hdf5`."""
+        t0 = _time.perf_counter()
         with nc.Dataset(path, "r") as handle:
             var = handle.variables[variable]
             gshape = tuple(int(s) for s in var.shape)
             res = _load_sharded(
                 lambda sl: np.asarray(var[sl]), gshape, dtype, split, device, comm
             )
-            if res is not None:
-                return res
-            data = np.asarray(var[:])
-        return factories.array(data, dtype=dtype, split=split, device=device, comm=comm)
+            if res is None:
+                data = np.asarray(var[:])
+        if res is None:
+            res = factories.array(data, dtype=dtype, split=split, device=device, comm=comm)
+        if _MON.enabled:
+            _instr.record_io("load_netcdf", path, res.nbytes, _time.perf_counter() - t0)
+        return res
 
     def save_netcdf(data: DNDarray, path: str, variable: str, mode: str = "w", **kwargs) -> None:
         """Save a DNDarray to NetCDF (reference io.py:591-660)."""
         if not isinstance(data, DNDarray):
             raise TypeError(f"data must be a DNDarray, not {type(data)}")
+        t0 = _time.perf_counter()
         arr = data.numpy()  # collective in multi-controller runs
         if jax.process_index() != 0 and not data.parray.is_fully_addressable:
             return  # single writer
@@ -215,6 +237,8 @@ if __NETCDF:
                 handle.createDimension(f"dim_{i}", s)
             var = handle.createVariable(variable, arr.dtype, tuple(f"dim_{i}" for i in range(arr.ndim)))
             var[:] = arr
+        if _MON.enabled:
+            _instr.record_io("save_netcdf", path, arr.nbytes, _time.perf_counter() - t0)
 
 
 def load(path: str, *args, **kwargs) -> DNDarray:
@@ -263,6 +287,7 @@ def load_csv(
         raise TypeError(f"separator must be str, not {type(sep)}")
     if not isinstance(header_lines, int):
         raise TypeError(f"header_lines must be int, not {type(header_lines)}")
+    t0 = _time.perf_counter()
     # native fast path: threaded C++ parser (heat_tpu/native/_csv.cpp — the
     # reference's per-rank byte-range line-aligned split, io.py:713-925, run
     # across host threads); falls back to the Python parser on any mismatch
@@ -291,7 +316,10 @@ def load_csv(
         data = np.asarray(rows)
         if data.size == 0:
             data = np.empty((0, 0))  # match the native parser's empty shape
-    return factories.array(data, dtype=dtype, split=split, device=device, comm=comm)
+    res = factories.array(data, dtype=dtype, split=split, device=device, comm=comm)
+    if _MON.enabled:
+        _instr.record_io("load_csv", path, res.nbytes, _time.perf_counter() - t0)
+    return res
 
 
 def save_csv(
@@ -311,6 +339,7 @@ def save_csv(
         raise TypeError(f"data must be a DNDarray, not {type(data)}")
     if data.ndim > 2:
         raise ValueError("CSV supports at most 2 dimensions")
+    t0 = _time.perf_counter()
     arr = data.numpy()
     if arr.ndim == 1:
         arr = arr.reshape(-1, 1)
@@ -326,6 +355,9 @@ def save_csv(
                 )
             )
             handle.write("\n")
+    if _MON.enabled:
+        # written volume = the text file's actual size, not the array bytes
+        _instr.record_io("save_csv", path, os.path.getsize(path), _time.perf_counter() - t0)
 
 
 def save(data: DNDarray, path: str, *args, **kwargs) -> None:

@@ -162,14 +162,28 @@ def __f64_edges(data, nbins, lo=None, hi=None):
     """Equal-width bin edges built on the host in float64 and cast to the
     working dtype — numpy computes edges in f64, and jnp's f32 edge
     arithmetic can land an exact-edge sample one bin off (fuzz cases 49/93).
-    An f32 data value that IS an f64 edge stays bit-exact through the cast."""
+    An f32 data value that IS an f64 edge stays bit-exact through the cast.
+
+    Range validation matches numpy/torch (ADVICE r5): non-finite bounds —
+    supplied or data-derived — and decreasing ranges raise ``ValueError``
+    instead of producing garbage or decreasing edges; an equal range is
+    expanded by ±0.5 first (numpy ``_get_outer_edges`` semantics), so only a
+    genuinely reversed range rejects."""
     if lo is None:
         if data.size == 0:
             lo, hi = 0.0, 1.0
         else:
             lo, hi = float(jnp.min(data)), float(jnp.max(data))
+        if not (np.isfinite(lo) and np.isfinite(hi)):
+            raise ValueError(
+                f"autodetected range of [{lo}, {hi}] is not finite"
+            )
+    elif not (np.isfinite(lo) and np.isfinite(hi)):
+        raise ValueError(f"supplied range of [{lo}, {hi}] is not finite")
     if lo == hi:
         lo, hi = lo - 0.5, hi + 0.5
+    if lo > hi:
+        raise ValueError("max must be larger than min in range parameter.")
     edges64 = np.linspace(lo, hi, int(nbins) + 1, dtype=np.float64)
     return jnp.asarray(edges64.astype(np.result_type(data.dtype, np.float32)))
 
@@ -195,7 +209,11 @@ def histogram(a, bins=10, range=None, normed=None, weights=None, density=None):
     (reference statistics.py histogram)."""
     sanitation.sanitize_in(a)
     w = weights.larray if isinstance(weights, DNDarray) else weights
-    if isinstance(bins, (int, np.integer)):
+    if isinstance(bins, (int, np.integer)) and not isinstance(a.larray, jax.core.Tracer):
+        # f64 host-side edges for exact-edge parity with numpy. Under jit/vmap
+        # the data is a Tracer and float(jnp.min/max) would raise
+        # ConcretizationTypeError (ADVICE r5) — fall back to the pure-jnp path
+        # below, which traces fine (accepting jnp's f32 edge arithmetic there).
         lo, hi = (float(range[0]), float(range[1])) if range is not None else (None, None)
         bins = __f64_edges(a.larray, bins, lo, hi)
     hist, edges = jnp.histogram(a.larray, bins=bins, range=range, weights=w, density=density or normed)

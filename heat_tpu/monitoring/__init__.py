@@ -1,0 +1,94 @@
+"""
+Runtime observability: metrics registry, structured event spans, and the
+instrumentation hooks wired into the framework's hot paths.
+
+The reference framework has none of this (SURVEY §5: bare ``time.perf_counter``
+benchmark loops); ``heat_tpu.monitoring`` is the telemetry layer a production
+deployment operates on. Zero dependencies beyond the standard library (jax is
+only touched lazily, for the compile listener and device-memory gauges), and
+near-zero cost when disabled: instrumented hot paths pay a single truthiness
+check per dispatch.
+
+Quick start::
+
+    import heat_tpu as ht
+    from heat_tpu import monitoring
+
+    with monitoring.capture():
+        model = ht.cluster.KMeans(n_clusters=8).fit(x)
+    print(monitoring.report.render())
+    snap = monitoring.report.snapshot()   # plain dict: counters/gauges/spans
+
+or set ``HEAT_TPU_MONITORING=1`` to collect for the whole process.
+
+Modules:
+
+* :mod:`~heat_tpu.monitoring.registry` — ``Counter``/``Gauge``/``Histogram``,
+  the process-global ``REGISTRY``, and the ``enabled()``/``capture()`` gate;
+* :mod:`~heat_tpu.monitoring.events` — ``span()``/``event()`` structured
+  records with nesting, wall time, optional device-time marks
+  (``jax.block_until_ready``), JSON-lines export;
+* :mod:`~heat_tpu.monitoring.instrument` — the hook functions the hot paths
+  call (op dispatches, dtype fallbacks, reshardings, collectives, jit
+  compile-cache misses, device memory, IO volume, step throughput);
+* :mod:`~heat_tpu.monitoring.report` — human-readable tables and the compact
+  ``telemetry`` block ``bench.py`` embeds in its output line.
+"""
+
+from __future__ import annotations
+
+from . import registry
+from . import events
+from . import instrument
+from . import report
+
+from .registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    REGISTRY,
+    capture,
+    disable,
+    enable,
+    enabled,
+)
+from .events import span, event, export_jsonl
+from .report import render, telemetry
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "REGISTRY",
+    "capture",
+    "disable",
+    "enable",
+    "enabled",
+    "event",
+    "export_jsonl",
+    "render",
+    "reset",
+    "snapshot",
+    "span",
+    "telemetry",
+]
+
+# env-var enablement must also run the one-time enable hooks (jax compile
+# listener registration) that capture()/enable() would run
+if registry.STATE.enabled:
+    registry._run_enable_hooks()
+
+
+def snapshot() -> dict:
+    """Full observability snapshot (metrics + span summary + memory gauges);
+    see :func:`heat_tpu.monitoring.report.snapshot`."""
+    return report.snapshot()
+
+
+def reset() -> None:
+    """Clear all metrics and recorded events (test isolation / between
+    benchmark phases)."""
+    registry.reset()
+    events.clear()

@@ -1,0 +1,205 @@
+"""
+Structured span/event recorder.
+
+Complements the aggregate metrics of ``registry.py`` with per-occurrence
+records: a :func:`span` context manager captures wall time (and, via
+:meth:`_Span.mark`, optional device-time marks that ``jax.block_until_ready``
+a value before stamping), nesting (parent/depth via a thread-local stack) and
+arbitrary attributes. Records are held in memory and exportable as JSON lines
+(:func:`export_jsonl`) — the shape every log shipper ingests.
+
+Disabled mode (``registry.STATE.enabled`` False) returns a shared no-op span
+object and records nothing — callers need no branching of their own, though
+per-dispatch hot paths still guard with ``if _MON.enabled:`` so the disabled
+cost stays a single truthiness check.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .registry import STATE
+
+__all__ = ["span", "event", "record", "records", "export_jsonl", "clear", "dropped"]
+
+#: Bound on resident records; overflow is counted, not stored (a long training
+#: run with per-step spans must not grow memory without bound).
+MAX_RECORDS = 65536
+
+_RECORDS: List[dict] = []
+_DROPPED = 0
+_LOCK = threading.Lock()
+_TLS = threading.local()
+
+
+def _stack() -> list:
+    st = getattr(_TLS, "stack", None)
+    if st is None:
+        st = _TLS.stack = []
+    return st
+
+
+def _append(rec: dict) -> None:
+    global _DROPPED
+    with _LOCK:
+        if len(_RECORDS) < MAX_RECORDS:
+            _RECORDS.append(rec)
+        else:
+            _DROPPED += 1
+
+
+class _NullSpan:
+    """Shared do-nothing span handed out while collection is disabled."""
+
+    __slots__ = ()
+    wall_s = 0.0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+    def mark(self, name, block_on=None):
+        return self
+
+
+_NULL = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "attrs", "marks", "t0", "t0_wall", "depth", "parent", "wall_s")
+
+    def __init__(self, name: str, attrs: Dict[str, Any]):
+        self.name = name
+        self.attrs = attrs
+        self.marks: List[dict] = []
+        self.wall_s = 0.0
+
+    def __enter__(self):
+        st = _stack()
+        self.parent = st[-1].name if st else None
+        self.depth = len(st)
+        st.append(self)
+        self.t0_wall = time.time()
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.wall_s = time.perf_counter() - self.t0
+        st = _stack()
+        if st and st[-1] is self:
+            st.pop()
+        rec = {
+            "type": "span",
+            "name": self.name,
+            "t_start": self.t0_wall,
+            "wall_s": self.wall_s,
+            "depth": self.depth,
+            "parent": self.parent,
+        }
+        if exc_type is not None:
+            rec["error"] = exc_type.__name__
+        if self.attrs:
+            rec["attrs"] = self.attrs
+        if self.marks:
+            rec["marks"] = self.marks
+        _append(rec)
+        return False
+
+    def set(self, **attrs) -> "_Span":
+        """Attach attributes (e.g. a convergence delta) to the span record."""
+        self.attrs.update(attrs)
+        return self
+
+    def mark(self, name: str, block_on=None) -> "_Span":
+        """Stamp an intra-span mark; with ``block_on``, the stamp is a
+        *device-time* mark — taken only after ``jax.block_until_ready`` drains
+        the async dispatch queue up to that value."""
+        if block_on is not None:
+            import jax
+
+            jax.block_until_ready(block_on)
+        self.marks.append({"name": name, "at_s": time.perf_counter() - self.t0})
+        return self
+
+
+def span(name: str, **attrs):
+    """Context manager recording a named span with wall time and attributes.
+
+    >>> with span("kmeans.step", iteration=3) as sp:
+    ...     shift = step(...)
+    ...     sp.mark("device_done", block_on=shift).set(shift=float(shift))
+    """
+    if not STATE.enabled:
+        return _NULL
+    return _Span(name, attrs)
+
+
+def event(name: str, **attrs) -> None:
+    """Record a point-in-time event (no duration)."""
+    if not STATE.enabled:
+        return
+    st = _stack()
+    rec = {
+        "type": "event",
+        "name": name,
+        "t_start": time.time(),
+        "depth": len(st),
+        "parent": st[-1].name if st else None,
+    }
+    if attrs:
+        rec["attrs"] = attrs
+    _append(rec)
+
+
+def record(name: str, wall_s: float, **attrs) -> None:
+    """Record a pre-timed span (for callers that measured the duration
+    themselves, e.g. around a jitted train step)."""
+    if not STATE.enabled:
+        return
+    st = _stack()
+    rec = {
+        "type": "span",
+        "name": name,
+        "t_start": time.time() - wall_s,
+        "wall_s": wall_s,
+        "depth": len(st),
+        "parent": st[-1].name if st else None,
+    }
+    if attrs:
+        rec["attrs"] = attrs
+    _append(rec)
+
+
+def records(name: Optional[str] = None) -> List[dict]:
+    """Copy of the recorded spans/events, optionally filtered by name."""
+    with _LOCK:
+        recs = list(_RECORDS)
+    if name is not None:
+        recs = [r for r in recs if r["name"] == name]
+    return recs
+
+
+def dropped() -> int:
+    """Number of records discarded after :data:`MAX_RECORDS` was reached."""
+    return _DROPPED
+
+
+def export_jsonl() -> str:
+    """All records as JSON lines (one record per line)."""
+    return "\n".join(json.dumps(r, sort_keys=True, default=str) for r in records())
+
+
+def clear() -> None:
+    """Drop all recorded spans/events (test isolation)."""
+    global _DROPPED
+    with _LOCK:
+        _RECORDS.clear()
+        _DROPPED = 0

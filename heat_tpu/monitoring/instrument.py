@@ -1,0 +1,154 @@
+"""
+Instrumentation hooks the runtime's hot paths report through.
+
+This module is the single funnel between the framework's hot paths and the
+metrics registry / event recorder, so the instrumented call sites stay
+one-liners (``if _MON.enabled: _instr.op_dispatch("binary")``) and the metric
+naming stays consistent:
+
+* ``ops.dispatch`` (labelled binary/reduce/local/cum) — every generic-template
+  dispatch in ``core/_operations.py``;
+* ``ops.dtype_fallback`` — results XLA returned in a dtype the heat promotion
+  rules disagreed with (the cast-back fallback), plus the exact→float
+  true-division promotion;
+* ``comm.resharding`` (labelled ``old->new``) — split changes that force XLA
+  collectives (``DNDarray.resplit_``/``redistribute_``);
+* ``comm.placement`` — canonical (padded, sharded) placements applied by
+  ``MeshCommunication.placed``;
+* ``comm.collective`` (labelled by kind) — explicit collective shim
+  invocations (Allreduce/Allgather/…);
+* ``jit.compiles`` + ``jit.compile_seconds`` — actual XLA backend compiles,
+  i.e. jit cache *misses*, via a ``jax.monitoring`` duration listener
+  (registered once, on first enablement; the listener itself is gated on
+  ``STATE.enabled`` so a disabled process pays nothing);
+* ``memory.bytes_in_use[...]`` gauges — sampled from
+  ``device.memory_stats()`` where the backend provides it;
+* ``io.bytes_read``/``io.bytes_written`` + ``io.seconds`` — parallel-IO
+  load/save volume and latency;
+* per-step spans for the algorithm/train loops (kmeans, lasso, data-parallel,
+  DASO) via :func:`step_event` and ``events.span``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from . import events
+from .registry import REGISTRY, STATE, _ON_ENABLE
+
+__all__ = [
+    "op_dispatch",
+    "dtype_fallback",
+    "resharding",
+    "placement",
+    "collective",
+    "record_io",
+    "step_event",
+    "sample_memory",
+]
+
+#: The jax.monitoring duration event emitted once per actual XLA compile —
+#: each one is a jit compile-cache miss (hits re-use the executable and never
+#: reach the backend).
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_listener_registered = False
+
+
+def _register_jax_listener() -> None:
+    """Idempotently hook ``jax.monitoring`` compile-duration events. Run as an
+    on-enable hook so a process that never enables monitoring never registers
+    (and never imports jax from here)."""
+    global _listener_registered
+    if _listener_registered:
+        return
+    _listener_registered = True
+    try:
+        import jax.monitoring as _jm
+
+        def _on_duration(name, duration, **kw):
+            if STATE.enabled and name == _COMPILE_EVENT:
+                REGISTRY.counter("jit.compiles").inc()
+                REGISTRY.histogram("jit.compile_seconds").observe(duration)
+
+        _jm.register_event_duration_secs_listener(_on_duration)
+    except Exception:  # jax too old/new for the listener API: degrade silently
+        pass
+
+
+_ON_ENABLE.append(_register_jax_listener)
+
+
+def op_dispatch(kind: str) -> None:
+    """One generic-template dispatch (kind: binary/reduce/local/cum)."""
+    REGISTRY.counter("ops.dispatch").inc(label=kind)
+
+
+def dtype_fallback(kind: str) -> None:
+    """One dtype-promotion fallback (result cast back to the heat-promoted
+    type, or an exact→float division promotion)."""
+    REGISTRY.counter("ops.dtype_fallback").inc(label=kind)
+
+
+def resharding(old_split: Optional[int], new_split: Optional[int]) -> None:
+    """One split change that forces XLA resharding collectives."""
+    REGISTRY.counter("comm.resharding").inc(label=f"{old_split}->{new_split}")
+    events.event("comm.resharding", old_split=old_split, new_split=new_split)
+
+
+def placement() -> None:
+    """One canonical (padded, sharded) placement applied by the mesh comm."""
+    REGISTRY.counter("comm.placement").inc()
+
+
+def collective(kind: str) -> None:
+    """One explicit collective shim invocation (allreduce/allgather/…)."""
+    REGISTRY.counter("comm.collective").inc(label=kind)
+
+
+def record_io(op: str, path: str, nbytes: int, seconds: float) -> None:
+    """One IO load/save: volume counters + latency histogram + an event
+    carrying path/bytes/duration."""
+    direction = "io.bytes_read" if op.startswith("load") else "io.bytes_written"
+    REGISTRY.counter(direction).inc(int(nbytes))
+    REGISTRY.counter("io.calls").inc(label=op)
+    REGISTRY.histogram("io.seconds").observe(seconds)
+    events.record(f"io.{op}", seconds, path=path, bytes=int(nbytes))
+
+
+def step_event(name: str, seconds: float, rows: Optional[int] = None, **attrs) -> None:
+    """One training/algorithm step measured by the caller: step counter,
+    latency histogram, optional row throughput, and a span record."""
+    REGISTRY.counter(f"{name}.steps").inc()
+    REGISTRY.histogram(f"{name}.seconds").observe(seconds)
+    if rows is not None:
+        REGISTRY.counter(f"{name}.rows").inc(int(rows))
+        if seconds > 0:
+            attrs["rows_per_s"] = rows / seconds
+        attrs["rows"] = rows
+    events.record(name, seconds, **attrs)
+
+
+def sample_memory() -> dict:
+    """Sample ``device.memory_stats()`` into gauges for every local device
+    that reports them (TPU/GPU backends; CPU returns nothing). Returns the
+    sampled ``{gauge_name: bytes}`` dict."""
+    out = {}
+    try:
+        import jax
+
+        for dev in jax.local_devices():
+            try:
+                stats = dev.memory_stats()
+            except Exception:
+                stats = None
+            if not stats:
+                continue
+            for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+                if key in stats:
+                    name = f"memory.{key}[{dev.id}]"
+                    REGISTRY.gauge(name).set(int(stats[key]))
+                    out[name] = int(stats[key])
+    except Exception:
+        pass
+    return out

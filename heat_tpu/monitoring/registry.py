@@ -1,0 +1,268 @@
+"""
+Process-local metrics registry: counters, gauges and log-scale histograms.
+
+The reference framework has no observability at all (SURVEY §5: its benchmarks
+are bare ``time.perf_counter`` loops); this registry is the accumulation core of
+the ``heat_tpu.monitoring`` subsystem. Zero dependencies, and near-zero cost
+when disabled: every instrumented hot path guards with a single truthiness
+check on :data:`STATE` (``if _MON.enabled:``) — no dict lookup, no string
+formatting, no function call happens on the disabled path.
+
+Enablement
+----------
+* env var ``HEAT_TPU_MONITORING`` (any value except ``""``/``0``/``false``/
+  ``off``) turns collection on at import;
+* :func:`capture` turns it on for a ``with`` block (re-entrant, restores the
+  previous state);
+* :func:`enable`/:func:`disable` flip it programmatically.
+
+``snapshot()`` returns a plain (JSON-serialisable) dict; nothing here ever
+touches a device.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "REGISTRY",
+    "STATE",
+    "capture",
+    "disable",
+    "enable",
+    "enabled",
+    "reset",
+    "snapshot",
+]
+
+
+class _State:
+    """Mutable enablement flag read by every instrumented hot path.
+
+    A dedicated slotted object (rather than a module global) so hot paths can
+    bind it once at import (``from ...registry import STATE as _MON``) and pay
+    exactly one attribute load + truthiness test per dispatch when disabled.
+    """
+
+    __slots__ = ("enabled",)
+
+    def __init__(self, enabled: bool = False):
+        self.enabled = enabled
+
+
+def _env_enabled() -> bool:
+    val = os.environ.get("HEAT_TPU_MONITORING", "")
+    return val.strip().lower() not in ("", "0", "false", "off")
+
+
+STATE = _State(_env_enabled())
+
+#: Hooks run exactly once, on first enablement (e.g. registering the
+#: ``jax.monitoring`` compile listener — see ``instrument.py``). Appending is
+#: done at import time by the instrument module; running is idempotent.
+_ON_ENABLE = []
+_hooks_ran = False
+_lock = threading.Lock()
+
+
+def _run_enable_hooks() -> None:
+    global _hooks_ran
+    with _lock:
+        if _hooks_ran:
+            return
+        _hooks_ran = True
+        hooks = list(_ON_ENABLE)
+    for hook in hooks:
+        hook()
+
+
+def enable() -> None:
+    """Turn metric/event collection on (process-wide)."""
+    _run_enable_hooks()
+    STATE.enabled = True
+
+
+def disable() -> None:
+    """Turn metric/event collection off. Accumulated data is retained."""
+    STATE.enabled = False
+
+
+def enabled() -> bool:
+    """Whether collection is currently on (env var or :func:`capture`)."""
+    return STATE.enabled
+
+
+@contextlib.contextmanager
+def capture():
+    """Enable collection for the duration of the ``with`` block.
+
+    Re-entrant; restores the previous enablement on exit (so nesting inside an
+    env-var-enabled process is a no-op rather than a disable).
+    """
+    prev = STATE.enabled
+    enable()
+    try:
+        yield REGISTRY
+    finally:
+        STATE.enabled = prev
+
+
+class Counter:
+    """Monotonically increasing count, optionally broken down by label.
+
+    Increments are plain ``+=`` under the GIL — the registry trades perfect
+    cross-thread atomicity for zero locking on the hot path (a lost increment
+    under free-threading race is acceptable for telemetry).
+    """
+
+    __slots__ = ("name", "value", "labels")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+        self.labels: Dict[str, int] = {}
+
+    def inc(self, n: int = 1, label: Optional[str] = None) -> None:
+        """Add ``n`` (and attribute it to ``label`` when given)."""
+        self.value += n
+        if label is not None:
+            self.labels[label] = self.labels.get(label, 0) + n
+
+    def get(self, label: Optional[str] = None) -> int:
+        return self.value if label is None else self.labels.get(label, 0)
+
+    def _snapshot(self):
+        if self.labels:
+            return {"total": self.value, "labels": dict(self.labels)}
+        return self.value
+
+
+class Gauge:
+    """Last-written value (e.g. live HBM bytes)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0.0
+
+    def set(self, value) -> None:
+        self.value = value
+
+    def get(self):
+        return self.value
+
+    def _snapshot(self):
+        return self.value
+
+
+#: Default histogram buckets: log-scale decades 1e-7..1e2 — sized for
+#: durations in seconds, from microsecond kernels to minute-long fits.
+DEFAULT_BUCKETS: Tuple[float, ...] = tuple(10.0**e for e in range(-7, 3))
+
+
+class Histogram:
+    """Fixed log-scale-bucket histogram (upper-bound buckets + overflow).
+
+    ``counts[i]`` counts observations ``<= bounds[i]``; the final slot counts
+    overflow. ``sum``/``count`` allow mean recovery without the buckets.
+    """
+
+    __slots__ = ("name", "bounds", "counts", "count", "sum")
+
+    def __init__(self, name: str, bounds: Optional[Sequence[float]] = None):
+        self.name = name
+        self.bounds = tuple(sorted(bounds)) if bounds is not None else DEFAULT_BUCKETS
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.count = 0
+        self.sum = 0.0
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        self.count += 1
+        self.sum += value
+        for i, b in enumerate(self.bounds):
+            if value <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def _snapshot(self):
+        return {
+            "buckets": list(self.bounds),
+            "counts": list(self.counts),
+            "count": self.count,
+            "sum": self.sum,
+        }
+
+
+class MetricsRegistry:
+    """Name-keyed collection of counters/gauges/histograms.
+
+    Metric creation takes a lock (rare); increments on already-created metrics
+    are lock-free. Instrumented code should fetch the metric once per event:
+    ``REGISTRY.counter("ops.dispatch").inc()``.
+    """
+
+    def __init__(self):
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str) -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            with self._lock:
+                c = self._counters.setdefault(name, Counter(name))
+        return c
+
+    def gauge(self, name: str) -> Gauge:
+        g = self._gauges.get(name)
+        if g is None:
+            with self._lock:
+                g = self._gauges.setdefault(name, Gauge(name))
+        return g
+
+    def histogram(self, name: str, bounds: Optional[Sequence[float]] = None) -> Histogram:
+        h = self._histograms.get(name)
+        if h is None:
+            with self._lock:
+                h = self._histograms.setdefault(name, Histogram(name, bounds))
+        return h
+
+    def snapshot(self) -> dict:
+        """Plain-dict view of every metric (JSON-serialisable)."""
+        return {
+            "counters": {k: v._snapshot() for k, v in sorted(self._counters.items())},
+            "gauges": {k: v._snapshot() for k, v in sorted(self._gauges.items())},
+            "histograms": {k: v._snapshot() for k, v in sorted(self._histograms.items())},
+        }
+
+    def reset(self) -> None:
+        """Drop every metric (test isolation / between benchmark phases)."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._histograms.clear()
+
+
+#: The process-global registry all instrumentation records into.
+REGISTRY = MetricsRegistry()
+
+
+def snapshot() -> dict:
+    """Module-level alias of ``REGISTRY.snapshot()``."""
+    return REGISTRY.snapshot()
+
+
+def reset() -> None:
+    """Module-level alias of ``REGISTRY.reset()``."""
+    REGISTRY.reset()

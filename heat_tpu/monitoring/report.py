@@ -1,0 +1,113 @@
+"""
+Reporting: human-readable tables and compact JSON telemetry.
+
+* :func:`snapshot` — the full observability state as one plain dict: every
+  metric, a per-name span summary, and freshly sampled device-memory gauges.
+* :func:`render` — the same as an aligned text table for terminals.
+* :func:`telemetry` — a compact single-level dict sized for embedding in a
+  benchmark's one-line JSON output (``bench.py`` attaches it as the
+  ``telemetry`` block).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict
+
+from . import events as _events
+from . import instrument as _instrument
+from .registry import REGISTRY
+
+__all__ = ["snapshot", "render", "telemetry", "export_json"]
+
+
+def _span_summary() -> Dict[str, dict]:
+    """Per-name aggregation of the recorded spans: count + total/max wall."""
+    out: Dict[str, dict] = {}
+    for rec in _events.records():
+        if rec.get("type") != "span":
+            continue
+        s = out.setdefault(rec["name"], {"count": 0, "wall_s": 0.0, "max_wall_s": 0.0})
+        s["count"] += 1
+        s["wall_s"] += rec.get("wall_s", 0.0)
+        s["max_wall_s"] = max(s["max_wall_s"], rec.get("wall_s", 0.0))
+    return out
+
+
+def snapshot() -> dict:
+    """Full observability snapshot as a plain (JSON-serialisable) dict."""
+    _instrument.sample_memory()
+    return {
+        "metrics": REGISTRY.snapshot(),
+        "spans": _span_summary(),
+        "events_recorded": len(_events.records()),
+        "events_dropped": _events.dropped(),
+    }
+
+
+def export_json(indent: int = None) -> str:
+    """The :func:`snapshot` dict serialised to JSON."""
+    return json.dumps(snapshot(), sort_keys=True, default=str, indent=indent)
+
+
+def render() -> str:
+    """Human-readable table of the current snapshot."""
+    snap = snapshot()
+    lines = ["== heat_tpu monitoring =="]
+    counters = snap["metrics"]["counters"]
+    if counters:
+        lines.append("-- counters --")
+        for name, val in counters.items():
+            if isinstance(val, dict):
+                lines.append(f"  {name:<28} {val['total']}")
+                for lab, n in sorted(val["labels"].items()):
+                    lines.append(f"    {lab:<26} {n}")
+            else:
+                lines.append(f"  {name:<28} {val}")
+    gauges = snap["metrics"]["gauges"]
+    if gauges:
+        lines.append("-- gauges --")
+        for name, val in gauges.items():
+            lines.append(f"  {name:<28} {val}")
+    hists = snap["metrics"]["histograms"]
+    if hists:
+        lines.append("-- histograms --")
+        for name, h in hists.items():
+            mean = h["sum"] / h["count"] if h["count"] else 0.0
+            lines.append(f"  {name:<28} n={h['count']} mean={mean:.6g} sum={h['sum']:.6g}")
+    if snap["spans"]:
+        lines.append("-- spans --")
+        for name, s in sorted(snap["spans"].items()):
+            lines.append(
+                f"  {name:<28} n={s['count']} total={s['wall_s']:.4f}s "
+                f"max={s['max_wall_s']:.4f}s"
+            )
+    lines.append(
+        f"-- events: {snap['events_recorded']} recorded, "
+        f"{snap['events_dropped']} dropped --"
+    )
+    return "\n".join(lines)
+
+
+def telemetry() -> dict:
+    """Compact telemetry block for benchmark output lines: non-zero counters,
+    span counts/totals, compile stats, and device memory (where reported)."""
+    snap = snapshot()
+    counters = {}
+    for name, val in snap["metrics"]["counters"].items():
+        counters[name] = val["total"] if isinstance(val, dict) else val
+    spans = {
+        name: {"n": s["count"], "wall_s": round(s["wall_s"], 4)}
+        for name, s in sorted(snap["spans"].items())
+    }
+    out = {
+        "counters": {k: v for k, v in counters.items() if v},
+        "spans": spans,
+    }
+    mem = {k: v for k, v in snap["metrics"]["gauges"].items() if k.startswith("memory.")}
+    if mem:
+        out["memory"] = mem
+    comp = snap["metrics"]["histograms"].get("jit.compile_seconds")
+    if comp and comp["count"]:
+        out["jit_compile_seconds_total"] = round(comp["sum"], 3)
+    return out

@@ -173,6 +173,18 @@ class SlabPrefetcher:
     end. The ring depth bounds memory: at most ``depth`` slabs are resident.
     Single-consumer; use as a context manager or call :meth:`close`.
 
+    **Regular files only.** The fast path ``mmap``\\ s the file once and copies
+    each slab straight out of the mapping (``_prefetch.cpp``). A file that is
+    truncated *between* slabs surfaces as ``IOError`` (EOF is re-checked per
+    slab), but a NON-ATOMIC replacement of the file mid-epoch — truncating or
+    rewriting the inode the mapping still points at while a copy is in flight —
+    raises ``SIGBUS`` and kills the process, where the old ``pread``-based path
+    raised a catchable ``IOError``. This is inherent to any mmap consumer.
+    Replace datasets atomically (write a temp file, then ``os.replace`` — the
+    mapping then keeps reading the old inode safely) or close the prefetcher
+    around dataset swaps. Pipes/sockets/char devices are not mappable and are
+    rejected at open.
+
     Raises RuntimeError when the native library is unavailable — callers gate on
     :func:`available` and keep a Python fallback (see
     ``utils/data/partial_dataset.py``).

@@ -28,6 +28,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.communication import MeshCommunication, sanitize_comm
 from ..core.dndarray import DNDarray
+from ..monitoring import instrument as _instr
+from ..monitoring.registry import STATE as _MON
 
 __all__ = ["DataParallel", "DataParallelMultiGPU"]
 
@@ -203,7 +205,22 @@ class DataParallel:
         batch = self.shard_batch(*batch)
         if not isinstance(batch, tuple):
             batch = (batch,)
-        self.params, self.opt_state, loss = self._train_step(self.params, self.opt_state, *batch)
+        if _MON.enabled:
+            # per-step throughput span: the device-time mark (block on the
+            # loss) makes rows/s honest under async dispatch
+            import time as _time
+
+            rows = int(batch[0].shape[0]) if getattr(batch[0], "ndim", 0) else 0
+            t0 = _time.perf_counter()
+            self.params, self.opt_state, loss = self._train_step(
+                self.params, self.opt_state, *batch
+            )
+            jax.block_until_ready(loss)
+            _instr.step_event("dp.train_step", _time.perf_counter() - t0, rows=rows)
+        else:
+            self.params, self.opt_state, loss = self._train_step(
+                self.params, self.opt_state, *batch
+            )
         if self.blocking:
             jax.block_until_ready(loss)
         return loss

@@ -31,6 +31,8 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.communication import MeshCommunication, sanitize_comm
+from ..monitoring import instrument as _instr
+from ..monitoring.registry import REGISTRY as _REG, STATE as _MON
 from .utils import DetectMetricPlateau
 
 __all__ = ["DataParallelOptimizer", "DASO"]
@@ -293,13 +295,28 @@ class DASO:
         if self._local_step is None:
             raise RuntimeError("call make_train_step(loss_fn, apply_fn) first")
         x, y = self.shard_batch(x, y)
-        self.params, self.opt_state, loss = self._local_step(self.params, self.opt_state, x, y)
+        if _MON.enabled:
+            import time as _time
+
+            rows = int(x.shape[0]) if getattr(x, "ndim", 0) else 0
+            t0 = _time.perf_counter()
+            self.params, self.opt_state, loss = self._local_step(
+                self.params, self.opt_state, x, y
+            )
+            jax.block_until_ready(loss)
+            _instr.step_event("daso.step", _time.perf_counter() - t0, rows=rows)
+        else:
+            self.params, self.opt_state, loss = self._local_step(
+                self.params, self.opt_state, x, y
+            )
 
         in_warmup = self.epoch < self.warmup_epochs
         in_cooldown = self.epoch >= self.total_epochs - self.cooldown_epochs
         if in_warmup or in_cooldown:
             # blocking averaging update every batch (reference phases 2/4)
             self.params = self._blend(self.params, self._global_mean(self.params))
+            if _MON.enabled:
+                _REG.counter("daso.global_syncs").inc(label="blocking")
         else:
             if self._pending_global is not None:
                 self._pending_countdown -= 1
@@ -309,10 +326,14 @@ class DASO:
                     # dp_optimizer.py:502-652)
                     self.params = self._blend(self.params, self._pending_global)
                     self._pending_global = None
+                    if _MON.enabled:
+                        _REG.counter("daso.global_blends").inc()
             if self.global_skip == 0 or self.batch % max(self.global_skip, 1) == 0:
                 # dispatch async global mean; consumed batches_to_wait later
                 self._pending_global = self._global_mean(self.params)
                 self._pending_countdown = self.batches_to_wait
+                if _MON.enabled:
+                    _REG.counter("daso.global_syncs").inc(label="async")
         self.batch += 1
         if self.last_batch is not None and self.batch >= self.last_batch:
             self.batch = 0

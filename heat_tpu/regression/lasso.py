@@ -17,6 +17,8 @@ import jax.numpy as jnp
 import heat_tpu as ht
 from ..core.base import BaseEstimator, RegressionMixin
 from ..core.dndarray import DNDarray
+from ..monitoring import events as _ev
+from ..monitoring.registry import REGISTRY as _REG, STATE as _MON
 
 __all__ = ["Lasso"]
 
@@ -123,12 +125,21 @@ class Lasso(BaseEstimator, RegressionMixin):
 
         sweep_jit = jax.jit(sweep)
         n_iter = 0
-        for n_iter in range(1, self.max_iter + 1):
-            new_theta = sweep_jit(theta)
-            diff = float(jnp.max(jnp.abs(new_theta - theta)))
-            theta = new_theta
-            if diff < self.tol:
-                break
+        with _ev.span("lasso.fit", n=int(n), features=int(f)) as fit_sp:
+            for n_iter in range(1, self.max_iter + 1):
+                # per-sweep step span: the diff readback is the device sync the
+                # loop performs anyway, so the span costs no extra blocking
+                with _ev.span("lasso.sweep", iteration=n_iter) as sp:
+                    new_theta = sweep_jit(theta)
+                    diff = float(jnp.max(jnp.abs(new_theta - theta)))
+                    sp.set(delta=diff)
+                theta = new_theta
+                if diff < self.tol:
+                    break
+            fit_sp.set(n_iter=n_iter)
+        if _MON.enabled:
+            _REG.counter("lasso.fits").inc()
+            _REG.counter("lasso.sweeps").inc(n_iter)
         self.n_iter = n_iter
         self.__theta = ht.array(theta.reshape(-1, 1), device=x.device, comm=x.comm)
         return self

@@ -59,6 +59,22 @@ ENV_DEPENDENT = {
 }
 
 
+def _external_origin(obj):
+    """Top-level package name when ``obj``'s source lives outside this repo
+    (an optax/flax re-export whose docstring/signature we do not own), else
+    None. Externally-resolved symbols are listed by name instead of rendered —
+    their upstream docstrings change with the render host's installed
+    versions, which used to break the docs-freshness gate for unrelated PRs."""
+    try:
+        f = inspect.getsourcefile(inspect.unwrap(obj))
+    except (TypeError, OSError):
+        return None
+    if not f or f.startswith(REPO):
+        return None
+    mod = getattr(obj, "__module__", None) or ""
+    return mod.split(".")[0] or "external"
+
+
 def _modules():
     """Every importable heat_tpu module that exports an ``__all__``."""
     mods = []
@@ -140,6 +156,10 @@ def _symbol_section(name, obj, lines):
     else:
         lines.append(f"### `{name}`\n")
         lines.append(f"Constant: `{re.sub(r' at 0x[0-9a-f]+', '', repr(obj))}`\n")
+        # no docstring for plain constants: inspect.getdoc falls through to
+        # the builtin type's docstring (float/int), whose wording varies by
+        # Python version — rendering it made the freshness gate host-dependent
+        return
     src = _src(obj)
     if src:
         lines.append(f"*Source: `{src}`*\n")
@@ -165,12 +185,28 @@ def render():
             lines.append(mdoc.split("\n\n")[0] + "\n")
         env_dep = ENV_DEPENDENT.get(mname, {})
         exported = sorted(set(mod.__all__) - set(env_dep))
-        for sym in exported:
+        external = {}
+        for sym in list(exported):
             obj = getattr(mod, sym, None)
             if obj is None:
                 continue
+            origin = _external_origin(obj)
+            if origin is not None:
+                external[sym] = origin
+                exported.remove(sym)
+                continue
             _symbol_section(sym, obj, lines)
             symbol_index.setdefault(sym, mname)
+        if external:
+            lines.append("### Re-exported symbols\n")
+            lines.append(
+                "Defined by an external dependency and re-exported here "
+                "(not rendered: their docstrings/signatures track the "
+                "installed upstream version, not this repo):\n"
+            )
+            for sym in sorted(external):
+                lines.append(f"- `{sym}` — from `{external[sym]}`")
+            lines.append("")
         if env_dep:
             lines.append("### Optional symbols\n")
             lines.append(

@@ -60,9 +60,13 @@ def test_api_tree_matches_fresh_render():
 
 
 def test_api_pages_have_substance():
+    # floor recalibrated from 700 when externally-resolved re-exports (the
+    # whole optax surface through heat_tpu.optim/lr_scheduler, ~334 sections)
+    # stopped being rendered: their upstream docstrings made the freshness
+    # gate break on unrelated PRs. The in-repo surface alone renders ~456.
     n_sections = sum(
         open(os.path.join(API, f)).read().count("\n### ")
         for f in os.listdir(API)
         if f.endswith(".md")
     )
-    assert n_sections >= 700, f"only {n_sections} symbol sections rendered"
+    assert n_sections >= 400, f"only {n_sections} symbol sections rendered"
