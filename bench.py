@@ -661,8 +661,11 @@ def main():
                 scale8_ips, scale8_overhead = bench_scaling_8dev()
         except Exception:
             scale8_ips = scale8_overhead = None
-        # gated linalg anchors (VERDICT r4 #3): ~2 min of compile on the tunneled
-        # chip; BENCH_FAST=1 skips them for quick interactive runs
+        # gated linalg anchors (VERDICT r4 #3) incl. the MXU-blocked
+        # qr/solve/svd counterparts and their same-process speedup vs the
+        # jnp.linalg baseline (benchmarks/linalg_bench.py); ~2 min of compile
+        # on the tunneled chip; BENCH_FAST=1 skips them for quick interactive
+        # runs
         linalg = {}
         if os.environ.get("BENCH_FAST") != "1":
             try:
@@ -674,7 +677,13 @@ def main():
             except Exception as e:
                 # explicit null-valued keys, like the neighbouring benches: a
                 # crashed anchor must be distinguishable from a BENCH_FAST skip
-                linalg = {f"{op}_valid": None for op in ("qr", "svd", "solve", "det")}
+                linalg = {
+                    f"{op}_valid": None
+                    for op in (
+                        "qr", "svd", "solve", "det",
+                        "qr_blocked", "svd_blocked", "solve_blocked",
+                    )
+                }
                 linalg["linalg_error"] = repr(e)[:160]
         # out-of-core input pipeline (VERDICT r4 #8): native prefetcher vs h5py
         io_pipe = {}

@@ -14,6 +14,13 @@ structure does not wall-clock meaningfully on a virtual CPU mesh):
 * ``solve``  — (4096, 4096) LU solve with 64 right-hand sides
 * ``det``    — (4096, 4096) via slogdet (LU)
 
+plus the MXU-blocked counterparts (``heat_tpu/core/linalg/blocked.py``) at the
+SAME shapes and flop floors — ``qr_blocked``/``svd_blocked``/``solve_blocked``
+— each reported with the identical pair-gating/jitter machinery and a
+``{op}_blocked_speedup`` ratio against the ``jnp.linalg`` baseline measured in
+the same process (same chip, same session, same gates; equal flop floors make
+the speedup a pure ratio of the two gated rates).
+
 Integrity machinery is the same as bench.py's headline: interleaved
 (short, long) scan-chain pairs with per-step perturbation and scalar fetch,
 median of valid pairs, and a dual physics gate per pair — a pair is
@@ -122,59 +129,120 @@ def bench_op(name, op, x_np, flops_floor, mxu_peak, hbm_roofline):
     }
 
 
-def bench_linalg(ops=("qr", "svd", "solve", "det")):
+DEFAULT_OPS = ("qr", "svd", "solve", "det", "qr_blocked", "svd_blocked", "solve_blocked")
+
+
+def _speedup(out, name):
+    """blocked-vs-baseline rate ratio: the two anchors share one flop floor,
+    so the tflops ratio IS the wall-clock speedup (same process, same gates)."""
+    blk, base = out.get(f"{name}_blocked_tflops"), out.get(f"{name}_tflops")
+    if blk and base:
+        out[f"{name}_blocked_speedup"] = round(blk / base, 2)
+
+
+def bench_linalg(ops=DEFAULT_OPS):
     """All linalg anchors as one flat dict (imported by bench.py main)."""
     import jax
     import jax.numpy as jnp
+
+    from heat_tpu.core.linalg import blocked
 
     dev = jax.devices()[0]
     mxu = _lookup(dev, MXU_PEAKS_TFLOPS)
     hbm = _lookup(dev, HBM_ROOFLINES_GBPS)
     rng = np.random.default_rng(7)
     out = {}
-    if "qr" in ops:
+    if "qr" in ops or "qr_blocked" in ops:
         m, n = 65536, 512
         a = rng.normal(size=(m, n)).astype(np.float32)
         # Householder factor-only count (R consumed; XLA may DCE Q): 2mn^2 - (2/3)n^3
-        out.update(
-            bench_op(
-                "qr",
-                lambda x: jnp.abs(jnp.linalg.qr(x)[1]).sum(),
-                a,
-                2 * m * n * n - (2 / 3) * n**3,
-                mxu,
-                hbm,
+        flops = 2 * m * n * n - (2 / 3) * n**3
+        if "qr" in ops:
+            out.update(
+                bench_op(
+                    "qr",
+                    lambda x: jnp.abs(jnp.linalg.qr(x)[1]).sum(),
+                    a,
+                    flops,
+                    mxu,
+                    hbm,
+                )
             )
-        )
-    if "svd" in ops:
+        if "qr_blocked" in ops:
+            # use_blocked=True pins the compact-WY kernel regardless of the
+            # ambient HEAT_TPU_BLOCKED_LINALG so the pair is always a contrast
+            out.update(
+                bench_op(
+                    "qr_blocked",
+                    lambda x: jnp.abs(
+                        blocked.local_qr(x, calc_q=False, use_blocked=True)
+                    ).sum(),
+                    a,
+                    flops,
+                    mxu,
+                    hbm,
+                )
+            )
+            _speedup(out, "qr")
+    if "svd" in ops or "svd_blocked" in ops:
         m, n = 16384, 512
         a = rng.normal(size=(m, n)).astype(np.float32)
         # lower bound: one QR-grade pass (2mn^2); the true bidiagonalize+
-        # iterate work is >= 2x this
-        out.update(
-            bench_op(
-                "svd",
-                lambda x: jnp.linalg.svd(x, full_matrices=False)[1].sum(),
-                a,
-                2 * m * n * n,
-                mxu,
-                hbm,
+        # iterate (or QR+QDWH+eigh) work is >= 2x this
+        flops = 2 * m * n * n
+        if "svd" in ops:
+            out.update(
+                bench_op(
+                    "svd",
+                    lambda x: jnp.linalg.svd(x, full_matrices=False)[1].sum(),
+                    a,
+                    flops,
+                    mxu,
+                    hbm,
+                )
             )
-        )
-    if "solve" in ops or "det" in ops:
+        if "svd_blocked" in ops:
+            panel = blocked.default_panel_width(m, n)
+            l0 = 1e-6
+            out.update(
+                bench_op(
+                    "svd_blocked",
+                    lambda x: blocked._svd_impl(x, panel, l0, False).sum(),
+                    a,
+                    flops,
+                    mxu,
+                    hbm,
+                )
+            )
+            _speedup(out, "svd")
+    if "solve" in ops or "det" in ops or "solve_blocked" in ops:
         n, k = 4096, 64
         a = rng.normal(size=(n, n)).astype(np.float32) + 10 * np.eye(n, dtype=np.float32)
+        solve_flops = (2 / 3) * n**3 + 2 * n * n * k
         if "solve" in ops:
             out.update(
                 bench_op(
                     "solve",
                     lambda x: jnp.linalg.solve(x, x[:, :k]).sum(),
                     a,
-                    (2 / 3) * n**3 + 2 * n * n * k,
+                    solve_flops,
                     mxu,
                     hbm,
                 )
             )
+        if "solve_blocked" in ops:
+            panel = blocked.default_panel_width(n, n)
+
+            def _solve_blocked(x):
+                lu, piv = blocked._lu_impl(x, panel)
+                import jax.scipy.linalg as jsl
+
+                return jsl.lu_solve((lu, piv), x[:, :k]).sum()
+
+            out.update(
+                bench_op("solve_blocked", _solve_blocked, a, solve_flops, mxu, hbm)
+            )
+            _speedup(out, "solve")
         if "det" in ops:
             out.update(
                 bench_op(

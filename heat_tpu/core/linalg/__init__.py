@@ -1,5 +1,6 @@
 """Linear algebra subpackage (parity: reference heat/core/linalg/__init__.py)."""
 
+from . import blocked
 from .basics import *
 from .qr import *
 from .solver import *

@@ -40,6 +40,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from . import blocked
+
 GEMM_PRECISION = jax.lax.Precision.HIGHEST
 
 
@@ -73,10 +75,21 @@ def _block_det_sign(piv: jax.Array, m: int) -> jax.Array:
     return jnp.where(swaps % 2 == 0, 1.0, -1.0)
 
 
-@functools.lru_cache(maxsize=None)
-def _build_panel_det(mesh, axis_name: str, p: int, m: int, dtype_name: str):
+def _build_panel_det(mesh, axis_name: str, p: int, m: int, dtype_name: str, use_blocked=None):
     """shard_map program: blocked right-looking LU determinant of a (p*m, p*m)
-    row-split matrix. Returns a replicated scalar."""
+    row-split matrix. Returns a replicated scalar.
+
+    ``use_blocked`` routes the diagonal-block factor through the MXU-blocked
+    right-looking LU (blocked.py) when the block is above its crossover
+    (None = read ``HEAT_TPU_BLOCKED_LINALG`` now); part of the compile cache
+    key so an env flip never reuses the other kernel's program."""
+    if use_blocked is None:
+        use_blocked = blocked.kernels_enabled()
+    return _build_panel_det_cached(mesh, axis_name, p, m, dtype_name, bool(use_blocked))
+
+
+@functools.lru_cache(maxsize=None)
+def _build_panel_det_cached(mesh, axis_name: str, p: int, m: int, dtype_name: str, use_blocked: bool):
     n = p * m
     dt = jnp.dtype(dtype_name)
 
@@ -97,7 +110,7 @@ def _build_panel_det(mesh, axis_name: str, p: int, m: int, dtype_name: str):
             # owner's diagonal block, broadcast to all (psum of a one-hot sum)
             own = (idx == k).astype(dt)
             d_blk = jax.lax.psum(own * a[:, c0:c1], axis_name)  # (m, m)
-            lu, piv = jax.scipy.linalg.lu_factor(d_blk)
+            lu, piv = blocked.lu_factor_local(d_blk, use_blocked=use_blocked)
             diag = jnp.diagonal(lu)
             absd = jnp.abs(diag)
             bad = bad | ~jnp.all(jnp.isfinite(diag)) | jnp.any(absd == 0)
@@ -123,10 +136,11 @@ def _build_panel_det(mesh, axis_name: str, p: int, m: int, dtype_name: str):
     )
 
 
-def _make_panel_ops(axis_name: str, p: int, m: int, dt):
+def _make_panel_ops(axis_name: str, p: int, m: int, dt, use_blocked: bool = False):
     """The two building blocks every panel program shares: the blocked
     Gauss-Jordan elimination sweep (applied to A and a companion panel B) and
-    the SUMMA row-panel matmul."""
+    the SUMMA row-panel matmul. ``use_blocked`` routes the per-step diagonal
+    block factor through the MXU-blocked LU (blocked.py)."""
 
     def panel_mm(x, y, idx):
         """Row panel of X @ Y for row-split X (width p*m) and row-split Y (any
@@ -155,7 +169,7 @@ def _make_panel_ops(axis_name: str, p: int, m: int, dt):
             c0, c1 = k * m, (k + 1) * m
             own = (idx == k).astype(dt)
             d_blk = jax.lax.psum(own * a[:, c0:c1], axis_name)
-            lu_piv = jax.scipy.linalg.lu_factor(d_blk)
+            lu_piv = blocked.lu_factor_local(d_blk, use_blocked=use_blocked)
             pa = jax.lax.psum(own * jax.scipy.linalg.lu_solve(lu_piv, a), axis_name)
             pb = jax.lax.psum(own * jax.scipy.linalg.lu_solve(lu_piv, b), axis_name)
             f = a[:, c0:c1]
@@ -213,33 +227,40 @@ def _refine(x, b, a, binv, panel_mm, idx, axis_name):
     return x, jnp.sqrt(nr / jnp.maximum(nb, tiny))
 
 
-def _inv_panels(a, idx, axis_name: str, p: int, m: int, dt):
+def _inv_panels(a, idx, axis_name: str, p: int, m: int, dt, use_blocked: bool = False):
     """Inverse panels of a row-split (p*m, p*m) matrix with a certified
     relative residual ||I - A X||_F / ||I||_F: two-phase block elimination
     plus residual-guarded refinement (SUMMA passes, gather-free). Block-local
     pivoting bounds accuracy at ~cond(A)*eps*growth — the residual tells the
     caller when that was not enough."""
     n = p * m
-    panel_mm, eliminate = _make_panel_ops(axis_name, p, m, dt)
+    panel_mm, eliminate = _make_panel_ops(axis_name, p, m, dt, use_blocked)
     rows = idx * m + jnp.arange(m)
     eye = (rows[:, None] == jnp.arange(n)[None, :]).astype(dt)
     binv = eliminate(a, eye, idx)
     return _refine(binv, eye, a, binv, panel_mm, idx, axis_name)
 
 
-@functools.lru_cache(maxsize=None)
-def _build_panel_solve(mesh, axis_name: str, p: int, m: int, k: int, dtype_name: str):
+def _build_panel_solve(mesh, axis_name: str, p: int, m: int, k: int, dtype_name: str, use_blocked=None):
     """shard_map program: solve A X = B for a (p*m, p*m) row-split A and a
     (p*m, k) row-split B via two-phase block elimination of the augmented
     [B | I] plus residual-guarded iterative refinement. Returns
     ``(x_panels, rel_residual)`` — the certified residual lets the caller
     fall back when block-local pivoting was not enough for this matrix.
-    Gather-free throughout."""
+    Gather-free throughout. ``use_blocked`` (cache-keyed) selects the
+    MXU-blocked diagonal-block LU."""
+    if use_blocked is None:
+        use_blocked = blocked.kernels_enabled()
+    return _build_panel_solve_cached(mesh, axis_name, p, m, k, dtype_name, bool(use_blocked))
+
+
+@functools.lru_cache(maxsize=None)
+def _build_panel_solve_cached(mesh, axis_name: str, p: int, m: int, k: int, dtype_name: str, use_blocked: bool):
     dt = jnp.dtype(dtype_name)
 
     def local(a, b):  # (m, n) and (m, k) local row panels
         idx = jax.lax.axis_index(axis_name)
-        panel_mm, eliminate = _make_panel_ops(axis_name, p, m, dt)
+        panel_mm, eliminate = _make_panel_ops(axis_name, p, m, dt, use_blocked)
         # one elimination over the augmented [B | I]: the identity columns
         # yield the approximate inverse the refinement step uses as its
         # correction operator, sharing A's reduction work with the solve
@@ -258,16 +279,23 @@ def _build_panel_solve(mesh, axis_name: str, p: int, m: int, k: int, dtype_name:
     )
 
 
-@functools.lru_cache(maxsize=None)
-def _build_panel_inv(mesh, axis_name: str, p: int, m: int, dtype_name: str):
+def _build_panel_inv(mesh, axis_name: str, p: int, m: int, dtype_name: str, use_blocked=None):
     """shard_map program: two-phase block-elimination inverse of a (p*m, p*m)
     row-split matrix with guarded refinement. Returns ``(inverse_panels,
-    rel_residual)``."""
+    rel_residual)``. ``use_blocked`` (cache-keyed) selects the MXU-blocked
+    diagonal-block LU."""
+    if use_blocked is None:
+        use_blocked = blocked.kernels_enabled()
+    return _build_panel_inv_cached(mesh, axis_name, p, m, dtype_name, bool(use_blocked))
+
+
+@functools.lru_cache(maxsize=None)
+def _build_panel_inv_cached(mesh, axis_name: str, p: int, m: int, dtype_name: str, use_blocked: bool):
     dt = jnp.dtype(dtype_name)
 
     def local(a):  # (m, n) local row panel
         idx = jax.lax.axis_index(axis_name)
-        return _inv_panels(a, idx, axis_name, p, m, dt)
+        return _inv_panels(a, idx, axis_name, p, m, dt, use_blocked)
 
     spec = P(axis_name, None)
     return jax.jit(

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from . import _elimination
+from . import blocked
 from .. import factories
 from .. import sanitation
 from .. import stride_tricks
@@ -116,6 +117,10 @@ def det(a: DNDarray) -> DNDarray:
             "determinant, which gathers the full matrix to every device",
             UserWarning,
         )
+    # local/replicated path: MXU-blocked LU (blocked.py) above the crossover,
+    # the old jnp.linalg.det bit-for-bit below it or with the gate off
+    if a.larray.ndim == 2:
+        return __wrap_det(blocked.det(a.larray))
     return __wrap_det(jnp.linalg.det(a.larray))
 
 
@@ -179,7 +184,7 @@ def inv(a: DNDarray) -> DNDarray:
             "device",
             UserWarning,
         )
-    data = jnp.linalg.inv(a.larray)
+    data = blocked.inv(a.larray) if a.larray.ndim == 2 else jnp.linalg.inv(a.larray)
     if not bool(jnp.all(jnp.isfinite(data))):
         raise RuntimeError("Inverse does not exist")
     return __wrap(a, data, a.split)
@@ -262,7 +267,10 @@ def slogdet(a: DNDarray) -> Tuple[DNDarray, DNDarray]:
             "slogdet, which gathers the full matrix to every device",
             UserWarning,
         )
-    s, l = jnp.linalg.slogdet(a.larray)
+    if a.larray.ndim == 2:
+        s, l = blocked.slogdet(a.larray)
+    else:
+        s, l = jnp.linalg.slogdet(a.larray)
     return __wrap_pair(s, l)
 
 
@@ -318,7 +326,7 @@ def solve(a: DNDarray, b: DNDarray) -> DNDarray:
             "device",
             UserWarning,
         )
-    data = jnp.linalg.solve(a.larray, b.larray)
+    data = blocked.solve(a.larray, b.larray)
     if not bool(jnp.all(jnp.isfinite(data))):
         raise RuntimeError("Singular matrix: solve has no solution")
     return __wrap(a, data, b.split if b.split is not None and b.split < data.ndim else None)

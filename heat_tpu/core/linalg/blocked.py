@@ -1,0 +1,635 @@
+"""
+MXU-blocked local dense factorizations: compact-WY QR, right-looking blocked
+LU, and a polar-based SVD.
+
+Why this module exists: BENCH_r05 put the matmul anchor at 98% MFU while every
+local dense factorization sat at 0.3-2.2% MXU — the ``jnp.linalg.*`` kernels
+XLA lowers on TPU are column-at-a-time and leave the systolic array idle, and
+they sit on the hot path of the distributed layer (TSQR local blocks and BCGS2
+panel QRs in ``qr.py``, the diagonal-block LU in ``_elimination.py``, the
+local solves behind ``basics.solve/det/inv``). The restructuring here is the
+standard communication-avoiding recipe:
+
+* **QR** — blocked Householder with compact-WY accumulation (Demmel, Grigori,
+  Hoemmen & Langou, "Communication-optimal parallel and sequential QR and LU
+  factorizations", SISC 2012): factor a narrow panel with the slow-but-small
+  Householder sweep, accumulate the panel's reflectors into the
+  ``I - V T Vᵀ`` representation (LAPACK ``larft``), and apply the block
+  reflector to the trailing matrix as two large GEMMs at
+  ``Precision.HIGHEST``. O(n³) work becomes O(n²·b) slow panel work plus
+  GEMM-shaped everything-else.
+* **LU** — right-looking blocked LU with partial pivoting *within* panels
+  (ibid.): ``lax.linalg.lu`` on the (m-k, b) panel, one triangular solve for
+  the block row, one rank-b GEMM update of the trailing submatrix. The
+  returned ``(lu, piv)`` pair is bit-compatible with
+  ``jax.scipy.linalg.lu_factor``'s, so ``lu_solve`` consumes it directly —
+  this backs ``solve``/``det``/``slogdet``/``inv`` and the diagonal-block
+  factor of the distributed elimination.
+* **SVD** — QR tall inputs down to square, then QDWH polar iteration
+  (Nakatsukasa & Higham, "Stable and efficient spectral divide and conquer",
+  SISC 2013): at most 6 dynamically-weighted Halley steps, each a tall QR or
+  a Cholesky solve plus GEMMs, followed by ``eigh`` of the small symmetric
+  polar factor. Every flop that can be a GEMM is a GEMM.
+
+Dispatch policy (``doc/blocked_linalg_notes.md`` has the measured table):
+
+* ``HEAT_TPU_BLOCKED_LINALG=0`` disables the module everywhere — every entry
+  point then calls the exact ``jnp.linalg`` expression the pre-blocked code
+  used, bit for bit. The flag is read per call (eager paths) or captured into
+  the compiled-builder cache key (``qr.py``/``_elimination.py`` shard_map
+  programs), so flipping it mid-process never serves a stale kernel.
+* Below a per-op crossover size (``CROSSOVER``) the ``jnp.linalg`` kernel wins
+  on latency and the dispatcher falls back automatically; panel width is
+  autotuned by shape (``default_panel_width``).
+
+Observability: each eager entry point runs under a PR-1 ``monitoring`` span
+with the panel geometry attached, and per-phase flop counters
+(``linalg.blocked.<op>.panel_flops`` / ``.update_flops`` / ``.qform_flops``,
+``linalg.blocked.svd.polar_iters``) make the MXU story visible in
+``monitoring.report``/``bench.py`` telemetry.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...monitoring.registry import STATE as _MON, REGISTRY as _REG
+from ...monitoring import events as _ev
+
+__all__ = [
+    "CROSSOVER",
+    "kernels_enabled",
+    "default_panel_width",
+    "qr",
+    "local_qr",
+    "lu_factor",
+    "solve",
+    "det",
+    "slogdet",
+    "inv",
+    "polar",
+    "svd",
+]
+
+#: All trailing-update / accumulation GEMMs run at full input precision — the
+#: factorizations feed residual-certified solvers and orthogonality tests; a
+#: one-pass bf16 GEMM here would cost ~1e-2 relative error (see
+#: basics.GEMM_PRECISION, same policy).
+GEMM_PRECISION = jax.lax.Precision.HIGHEST
+
+#: Minimum ``min(m, n)`` at which the blocked kernel beats the corresponding
+#: ``jnp.linalg`` lowering (measured on v5e, doc/blocked_linalg_notes.md);
+#: below it the panel machinery is pure overhead and the dispatcher falls
+#: back automatically.
+CROSSOVER = {"qr": 128, "lu": 256, "svd": 128}
+
+
+def kernels_enabled() -> bool:
+    """Whether the blocked kernels are globally enabled (default on).
+
+    ``HEAT_TPU_BLOCKED_LINALG=0`` (or ``false``/``off``) restores the
+    pre-blocked ``jnp.linalg`` paths bit for bit. Read per call — eager entry
+    points honor a mid-process flip; compiled shard_map builders capture the
+    value into their cache key instead (see ``qr.py``/``_elimination.py``).
+    """
+    val = os.environ.get("HEAT_TPU_BLOCKED_LINALG", "")
+    return val.strip().lower() not in ("0", "false", "off")
+
+
+def default_panel_width(m: int, n: int) -> int:
+    """Autotuned-by-shape panel width (doc/blocked_linalg_notes.md table).
+
+    The trailing-update GEMM contracts over the panel width, so MXU-aligned
+    widths (128/256) win once the factorization is large enough to amortize
+    the O(2mnb) slow-panel work; small problems take narrow panels to keep
+    the sequential Householder sweep short.
+    """
+    k = min(m, n)
+    if k < 256:
+        return 32
+    if k < 512:
+        return 64
+    if k < 8192:
+        return 128
+    return 256
+
+
+def _size_ok(op: str, m: int, n: int, dtype) -> bool:
+    """Crossover + dtype eligibility, independent of the env flag (compiled
+    builders capture the flag separately, into their cache key)."""
+    if jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating):
+        return False  # complex Householder/QDWH not implemented; jnp handles
+    return min(m, n) >= CROSSOVER[op]
+
+
+def _use_blocked(op: str, m: int, n: int, dtype) -> bool:
+    return kernels_enabled() and _size_ok(op, m, n, dtype)
+
+
+def _f32_compute_dtype(dtype):
+    """Working dtype: half precisions are factored in f32 (a bf16 Householder
+    pivot is numerically meaningless) and the factors cast back on exit."""
+    dt = jnp.dtype(dtype)
+    if dt in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)):
+        return jnp.dtype(jnp.float32)
+    return dt
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _count(name: str, value) -> None:
+    if _MON.enabled:
+        _REG.counter(name).inc(int(value))
+
+
+# --------------------------------------------------------------------- flop models
+def _qr_flops(m: int, n: int, want_q: bool) -> Tuple[int, int, int]:
+    """(panel, update, qform) modeled flops of the blocked Householder QR."""
+    k = min(m, n)
+    b = default_panel_width(m, n)
+    panel = update = 0
+    for off in range(0, k, b):
+        w = min(b, k - off)
+        rows = m - off
+        panel += 2 * rows * w * w
+        trail = n - off - w
+        update += 4 * rows * w * trail  # two (rows,w)x(rows,trail) GEMMs
+    qform = (4 * m * n * k - 2 * k * k * (m + n)) if want_q else 0
+    return panel, update, max(qform, 0)
+
+
+def _lu_flops(m: int, n: int) -> Tuple[int, int, int]:
+    """(panel, trsm, update) modeled flops of the right-looking blocked LU."""
+    k = min(m, n)
+    b = default_panel_width(m, n)
+    panel = trsm = update = 0
+    for off in range(0, k, b):
+        w = min(b, k - off)
+        rows = m - off
+        trail = n - off - w
+        panel += rows * w * w
+        trsm += w * w * trail
+        update += 2 * (rows - w) * w * trail
+    return panel, trsm, update
+
+
+# ------------------------------------------------------------------ panel QR (WY)
+def _householder_panel(a):
+    """Householder QR of one (rows, w) panel — the slow-but-small path.
+
+    Returns ``(V, T, R)``: ``V`` (rows, w) unit-lower-trapezoidal Householder
+    vectors, ``T`` (w, w) upper-triangular compact-WY factor with
+    ``Q_panel = I - V T Vᵀ`` (LAPACK ``geqr2`` + ``larft``), and ``R`` (w, w)
+    the panel's triangular factor. A ``fori_loop`` over the w columns keeps
+    the trace size O(1) per panel; all row masking is against a static iota.
+    """
+    rows, w = a.shape
+    dt = a.dtype
+    ridx = jnp.arange(rows)
+    cidx = jnp.arange(w)
+
+    def step(j, carry):
+        a, v_mat, t_mat = carry
+        col = jax.lax.dynamic_slice_in_dim(a, j, 1, axis=1)[:, 0]
+        below = ridx > j
+        at_j = (ridx == j).astype(dt)
+        alpha = jnp.sum(jnp.where(ridx == j, col, 0))
+        tail = jnp.where(below, col, 0)
+        sigma = jnp.sum(tail * tail)
+        norm_x = jnp.sqrt(alpha * alpha + sigma)
+        beta = jnp.where(alpha >= 0, -norm_x, norm_x)
+        denom = alpha - beta
+        degenerate = (sigma == 0) | (denom == 0)
+        safe_denom = jnp.where(degenerate, jnp.ones((), dt), denom)
+        v = jnp.where(below, col / safe_denom, jnp.zeros((), dt)) + at_j
+        safe_beta = jnp.where(degenerate, jnp.ones((), dt), beta)
+        tau = jnp.where(degenerate, jnp.zeros((), dt), (beta - alpha) / safe_beta)
+        # apply H_j = I - tau v vᵀ to the whole panel (one skinny GEMV pair)
+        w_row = jnp.matmul(v[None, :], a, precision=GEMM_PRECISION)[0]
+        a = a - tau * v[:, None] * w_row[None, :]
+        # larft forward accumulation: T[:j, j] = -tau T[:j, :j] (V[:, :j]ᵀ v)
+        vtv = jnp.matmul(v_mat.T, v[:, None], precision=GEMM_PRECISION)
+        tcol = -tau * jnp.matmul(t_mat, vtv, precision=GEMM_PRECISION)
+        tcol = jnp.where(cidx[:, None] < j, tcol, 0) + tau * (cidx[:, None] == j)
+        t_mat = jax.lax.dynamic_update_slice(t_mat, tcol.astype(dt), (0, j))
+        v_mat = jax.lax.dynamic_update_slice(v_mat, v[:, None], (0, j))
+        return a, v_mat, t_mat
+
+    a, v_mat, t_mat = jax.lax.fori_loop(
+        0, w, step, (a, jnp.zeros((rows, w), dt), jnp.zeros((w, w), dt))
+    )
+    return v_mat, t_mat, jnp.triu(a[:w, :])
+
+
+def _qr_impl(a, panel: int, want_q: bool):
+    """Blocked compact-WY QR of a 2-D array (trace-level; callers jit).
+
+    Returns ``(q, r)`` with thin ``q`` (m, k) and ``r`` (k, n), k = min(m, n)
+    — the ``jnp.linalg.qr`` "reduced" convention — or just ``r`` when
+    ``want_q`` is False.
+    """
+    m, n = a.shape
+    dt = a.dtype
+    k_total = min(m, n)
+    offs = list(range(0, k_total, panel))
+    factors = []
+    r = a
+    for off in offs:
+        w = min(panel, k_total - off)
+        sub = r[off:, off:]
+        v_mat, t_mat, r_p = _householder_panel(sub[:, :w])
+        # trailing update as two big GEMMs: C -= V (Tᵀ (Vᵀ C))
+        c = sub[:, w:]
+        if c.shape[1]:
+            wk = jnp.matmul(v_mat.T, c, precision=GEMM_PRECISION)
+            wk = jnp.matmul(t_mat.T, wk, precision=GEMM_PRECISION)
+            c = c - jnp.matmul(v_mat, wk, precision=GEMM_PRECISION)
+        top = jnp.concatenate(
+            [jnp.pad(r_p, ((0, m - off - w), (0, 0))), c], axis=1
+        )
+        r = r.at[off:, off:].set(top)
+        factors.append((off, v_mat, t_mat))
+    r_final = jnp.triu(r[:k_total, :])
+    if not want_q:
+        return r_final
+    # form thin Q by applying the block reflectors to I in reverse order
+    q = jnp.eye(m, k_total, dtype=dt)
+    for off, v_mat, t_mat in reversed(factors):
+        qs = q[off:, :]
+        wk = jnp.matmul(v_mat.T, qs, precision=GEMM_PRECISION)
+        wk = jnp.matmul(t_mat, wk, precision=GEMM_PRECISION)
+        q = q.at[off:, :].set(qs - jnp.matmul(v_mat, wk, precision=GEMM_PRECISION))
+    return q, r_final
+
+
+@functools.lru_cache(maxsize=256)
+def _qr_jit(m: int, n: int, dtype_name: str, panel: int, want_q: bool):
+    return jax.jit(lambda a: _qr_impl(a, panel, want_q))
+
+
+def local_qr(a, calc_q: bool = True, use_blocked: Optional[bool] = None, panel: Optional[int] = None):
+    """Trace-safe local QR used inside compiled programs (TSQR/BCGS2 blocks,
+    QDWH iterations): blocked compact-WY when allowed, ``jnp.linalg.qr``
+    otherwise.
+
+    ``use_blocked`` must be passed explicitly by lru-cached shard_map builders
+    (the env flag is part of their cache key); ``None`` reads the env flag at
+    trace time — only correct for non-cached callers.
+    """
+    m, n = a.shape
+    if use_blocked is None:
+        use_blocked = kernels_enabled()
+    if not use_blocked or not _size_ok("qr", m, n, a.dtype):
+        if calc_q:
+            q, r = jnp.linalg.qr(a)
+            return q, r
+        return jnp.linalg.qr(a, mode="r")
+    cdt = _f32_compute_dtype(a.dtype)
+    x = a.astype(cdt)
+    out = _qr_impl(x, panel or default_panel_width(m, n), calc_q)
+    if calc_q:
+        q, r = out
+        return q.astype(a.dtype), r.astype(a.dtype)
+    return out.astype(a.dtype)
+
+
+def qr(a, calc_q: bool = True, panel: Optional[int] = None):
+    """Blocked compact-WY QR (eager entry point): ``(q, r)`` thin factors, or
+    ``r`` alone when ``calc_q`` is False. Falls back to the exact pre-blocked
+    ``jnp.linalg.qr`` expression when disabled, below crossover, or complex.
+    """
+    a = jnp.asarray(a)
+    m, n = a.shape
+    if not _use_blocked("qr", m, n, a.dtype):
+        if calc_q:
+            q, r = jnp.linalg.qr(a)
+            return q, r
+        return jnp.linalg.qr(a, mode="r")
+    b = panel or default_panel_width(m, n)
+    pf, uf, qf = _qr_flops(m, n, calc_q)
+    if _MON.enabled and not _is_tracer(a):
+        _REG.counter("linalg.blocked.dispatch").inc(label="qr")
+        _count("linalg.blocked.qr.panel_flops", pf)
+        _count("linalg.blocked.qr.update_flops", uf)
+        _count("linalg.blocked.qr.qform_flops", qf)
+        with _ev.span("linalg.blocked.qr", m=m, n=n, panel=b, flops=pf + uf + qf):
+            return _qr_dispatch(a, m, n, b, calc_q)
+    return _qr_dispatch(a, m, n, b, calc_q)
+
+
+def _qr_dispatch(a, m, n, b, calc_q):
+    cdt = _f32_compute_dtype(a.dtype)
+    out = _qr_jit(m, n, np.dtype(cdt).name, b, calc_q)(a.astype(cdt))
+    if calc_q:
+        return out[0].astype(a.dtype), out[1].astype(a.dtype)
+    return out.astype(a.dtype)
+
+
+# ------------------------------------------------------------------- blocked LU
+def _lu_impl(a, panel: int):
+    """Right-looking blocked LU with partial pivoting within panels.
+
+    Returns ``(lu, piv)`` in ``jax.scipy.linalg.lu_factor`` format: ``lu``
+    holds L (unit lower, implicit diagonal) and U packed together, ``piv`` is
+    the 0-based LAPACK ipiv sequence of length min(m, n) —
+    ``jax.scipy.linalg.lu_solve`` consumes the pair directly. Pivot search is
+    confined to the current panel's rows (standard getrf blocking: the panel
+    spans ALL remaining rows, so this is full partial pivoting, not
+    block-local pivoting).
+    """
+    m, n = a.shape
+    k_total = min(m, n)
+    lu = a
+    pivs = []
+    for off in range(0, k_total, panel):
+        w = min(panel, k_total - off)
+        pan = lu[off:, off : off + w]  # (m-off, w): all remaining rows
+        p_lu, p_piv, p_perm = jax.lax.linalg.lu(pan)
+        pivs.append(p_piv[:w].astype(jnp.int32) + off)
+        # permute the OTHER columns of the remaining rows by the panel's perm
+        left = lu[off:, :off][p_perm, :]
+        right = lu[off:, off + w :][p_perm, :]
+        if off:
+            lu = lu.at[off:, :off].set(left)
+        lu = lu.at[off:, off : off + w].set(p_lu)
+        if right.shape[1]:
+            # block row: U12 = L11⁻¹ A12 (small triangular solve) ...
+            l11 = p_lu[:w, :w]
+            u12 = jax.scipy.linalg.solve_triangular(
+                l11, right[:w], lower=True, unit_diagonal=True
+            )
+            lu = lu.at[off : off + w, off + w :].set(u12)
+            # ... then ONE rank-w MXU GEMM over the whole trailing submatrix
+            if right.shape[0] > w:
+                l21 = p_lu[w:, :w]
+                a22 = right[w:] - jnp.matmul(l21, u12, precision=GEMM_PRECISION)
+                lu = lu.at[off + w :, off + w :].set(a22)
+    piv = (
+        jnp.concatenate(pivs)
+        if pivs
+        else jnp.zeros((0,), jnp.int32)
+    )
+    return lu, piv
+
+
+@functools.lru_cache(maxsize=256)
+def _lu_jit(m: int, n: int, dtype_name: str, panel: int):
+    return jax.jit(lambda a: _lu_impl(a, panel))
+
+
+def lu_factor_local(a, use_blocked: Optional[bool] = None, panel: Optional[int] = None):
+    """Trace-safe LU used inside compiled programs (the diagonal-block factor
+    of ``_elimination.py``): blocked right-looking when allowed,
+    ``jax.scipy.linalg.lu_factor`` otherwise. Same ``(lu, piv)`` contract
+    either way."""
+    m, n = a.shape
+    if use_blocked is None:
+        use_blocked = kernels_enabled()
+    if not use_blocked or not _size_ok("lu", m, n, a.dtype):
+        return jax.scipy.linalg.lu_factor(a)
+    return _lu_impl(a, panel or default_panel_width(m, n))
+
+
+def lu_factor(a, panel: Optional[int] = None):
+    """Blocked LU factorization (eager entry point), LAPACK ``(lu, piv)``
+    contract; falls back to ``jax.scipy.linalg.lu_factor`` when disabled or
+    below crossover."""
+    a = jnp.asarray(a)
+    m, n = a.shape
+    if not _use_blocked("lu", m, n, a.dtype):
+        return jax.scipy.linalg.lu_factor(a)
+    b = panel or default_panel_width(m, n)
+    pf, tf, uf = _lu_flops(m, n)
+    if _MON.enabled and not _is_tracer(a):
+        _REG.counter("linalg.blocked.dispatch").inc(label="lu")
+        _count("linalg.blocked.lu.panel_flops", pf)
+        _count("linalg.blocked.lu.trsm_flops", tf)
+        _count("linalg.blocked.lu.update_flops", uf)
+        with _ev.span("linalg.blocked.lu", m=m, n=n, panel=b, flops=pf + tf + uf):
+            return _lu_jit(m, n, np.dtype(_f32_compute_dtype(a.dtype)).name, b)(
+                a.astype(_f32_compute_dtype(a.dtype))
+            )
+    cdt = _f32_compute_dtype(a.dtype)
+    return _lu_jit(m, n, np.dtype(cdt).name, b)(a.astype(cdt))
+
+
+def solve(a, b):
+    """``x = a⁻¹ b`` through the blocked LU; bit-for-bit
+    ``jnp.linalg.solve(a, b)`` when disabled or below crossover."""
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    if a.ndim != 2 or not _use_blocked("lu", a.shape[0], a.shape[1], a.dtype):
+        return jnp.linalg.solve(a, b)
+    if _MON.enabled and not _is_tracer(a):
+        with _ev.span("linalg.blocked.solve", n=a.shape[0], nrhs=int(b.shape[1]) if b.ndim > 1 else 1):
+            lu, piv = lu_factor(a)
+            return jax.scipy.linalg.lu_solve((lu, piv), b.astype(lu.dtype)).astype(b.dtype)
+    lu, piv = lu_factor(a)
+    return jax.scipy.linalg.lu_solve((lu, piv), b.astype(lu.dtype)).astype(b.dtype)
+
+
+def _slogdet_from_lu(lu, piv):
+    diag = jnp.diagonal(lu)
+    swaps = jnp.sum(piv != jnp.arange(piv.shape[0], dtype=piv.dtype))
+    parity = jnp.where(swaps % 2 == 0, 1.0, -1.0).astype(lu.dtype)
+    sign = parity * jnp.prod(jnp.sign(diag))
+    logabs = jnp.sum(jnp.log(jnp.abs(diag)))
+    return sign, logabs
+
+
+def slogdet(a):
+    """``(sign, logabsdet)`` via the blocked LU (2-D square only); falls back
+    to ``jnp.linalg.slogdet`` when disabled or below crossover."""
+    a = jnp.asarray(a)
+    if a.ndim != 2 or not _use_blocked("lu", a.shape[0], a.shape[1], a.dtype):
+        return jnp.linalg.slogdet(a)
+    lu, piv = lu_factor(a)
+    sign, logabs = _slogdet_from_lu(lu, piv)
+    return sign.astype(a.dtype), logabs.astype(_f32_compute_dtype(a.dtype))
+
+
+def det(a):
+    """Determinant via the blocked LU (2-D square only); bit-for-bit
+    ``jnp.linalg.det`` when disabled or below crossover."""
+    a = jnp.asarray(a)
+    if a.ndim != 2 or not _use_blocked("lu", a.shape[0], a.shape[1], a.dtype):
+        return jnp.linalg.det(a)
+    sign, logabs = slogdet(a)
+    return sign * jnp.exp(logabs).astype(sign.dtype)
+
+
+def inv(a):
+    """Inverse via the blocked LU + n-RHS ``lu_solve``; bit-for-bit
+    ``jnp.linalg.inv`` when disabled or below crossover."""
+    a = jnp.asarray(a)
+    if a.ndim != 2 or not _use_blocked("lu", a.shape[0], a.shape[1], a.dtype):
+        return jnp.linalg.inv(a)
+    lu, piv = lu_factor(a)
+    eye = jnp.eye(a.shape[0], dtype=lu.dtype)
+    return jax.scipy.linalg.lu_solve((lu, piv), eye).astype(a.dtype)
+
+
+# --------------------------------------------------------------- QDWH polar / SVD
+def _qdwh_schedule(l0: float, eps: float):
+    """Static QDWH weight schedule (Nakatsukasa & Higham 2013, eq. 3.5).
+
+    The lower-bound recurrence ``l ← l (a + b l²)/(1 + c l²)`` is pure scalar
+    math, so the per-iteration weights (a, b, c) — and the QR-vs-Cholesky
+    variant choice — are computed in Python at trace time. Converges in at
+    most 6 iterations from l0 = 1e-16.
+    """
+    l = l0
+    sched = []
+    for _ in range(12):
+        l2 = max(l * l, 1e-300)
+        d = (4.0 * (1.0 - l2) / (l2 * l2)) ** (1.0 / 3.0)
+        sq = math.sqrt(1.0 + d)
+        a_w = sq + 0.5 * math.sqrt(max(8.0 - 4.0 * d + 8.0 * (2.0 - l2) / (l2 * sq), 0.0))
+        b_w = (a_w - 1.0) ** 2 / 4.0
+        c_w = a_w + b_w - 1.0
+        sched.append((a_w, b_w, c_w))
+        l = l * (a_w + b_w * l2) / (1.0 + c_w * l2)
+        if abs(1.0 - l) < 10.0 * eps:
+            break
+    return sched
+
+
+def _polar_impl(a, panel: int, l0: float):
+    """QDWH polar factor of a square matrix: ``a = u_p @ h`` with ``u_p``
+    orthogonal and ``h`` symmetric PSD. Every iteration is a tall blocked QR
+    (c large) or a Cholesky solve (c small) plus GEMMs — pure MXU work."""
+    n = a.shape[0]
+    dt = a.dtype
+    eps = float(jnp.finfo(dt).eps)
+    alpha = jnp.maximum(jnp.linalg.norm(a), jnp.asarray(1e-30, dt))
+    x = (a / alpha).astype(dt)
+    eye = jnp.eye(n, dtype=dt)
+    for a_w, b_w, c_w in _qdwh_schedule(l0, eps):
+        bc = b_w / c_w
+        if c_w > 100.0:
+            # QR variant: [sqrt(c) X; I] = [Q1; Q2] R;  X' = (b/c) X + k Q1 Q2ᵀ
+            y = jnp.concatenate([jnp.sqrt(jnp.asarray(c_w, dt)) * x, eye], axis=0)
+            q, _ = _qr_impl(y, panel, True)
+            q1, q2 = q[:n], q[n:]
+            k_w = (a_w - bc) / math.sqrt(c_w)
+            x = bc * x + k_w * jnp.matmul(q1, q2.T, precision=GEMM_PRECISION)
+        else:
+            # Cholesky variant: Z = I + c XᵀX;  X' = (b/c) X + (a - b/c) X Z⁻¹
+            z = eye + c_w * jnp.matmul(x.T, x, precision=GEMM_PRECISION)
+            w = jnp.linalg.cholesky(z)
+            v = jax.scipy.linalg.solve_triangular(w, x.T, lower=True)
+            v = jax.scipy.linalg.solve_triangular(w.T, v, lower=False)
+            x = bc * x + (a_w - bc) * v.T
+    u_p = x
+    h = jnp.matmul(u_p.T, a, precision=GEMM_PRECISION)
+    h = 0.5 * (h + h.T)
+    return u_p, h
+
+
+def _default_l0(dtype) -> float:
+    # a crude lower bound on sigma_min/sigma_max costs only iterations, and
+    # the schedule converges from 1e-16 in <= 6 of them; one value per dtype
+    # keeps the compiled-program cache small
+    return 1e-16 if jnp.dtype(dtype) == jnp.dtype(jnp.float64) else 1e-6
+
+
+@functools.lru_cache(maxsize=128)
+def _polar_jit(n: int, dtype_name: str, panel: int, l0: float):
+    return jax.jit(lambda a: _polar_impl(a, panel, l0))
+
+
+def polar(a, panel: Optional[int] = None):
+    """QDWH polar decomposition ``a = u @ h`` of a square matrix (eager)."""
+    a = jnp.asarray(a)
+    n = a.shape[0]
+    cdt = _f32_compute_dtype(a.dtype)
+    b = panel or default_panel_width(2 * n, n)
+    u, h = _polar_jit(n, np.dtype(cdt).name, b, _default_l0(cdt))(a.astype(cdt))
+    return u.astype(a.dtype), h.astype(a.dtype)
+
+
+def _svd_square_impl(a, panel: int, l0: float):
+    """SVD of a square matrix via QDWH polar + eigh of the symmetric factor."""
+    u_p, h = _polar_impl(a, panel, l0)
+    lam, v = jnp.linalg.eigh(h)  # ascending
+    lam, v = lam[::-1], v[:, ::-1]
+    s = jnp.abs(lam)
+    # a (numerically tiny) negative eigenvalue flips into the left vectors so
+    # the product U diag(S) Vᵀ stays exactly u_p @ h
+    signs = jnp.where(lam < 0, -1.0, 1.0).astype(a.dtype)
+    u = jnp.matmul(u_p, v, precision=GEMM_PRECISION) * signs[None, :]
+    return u, s, v.T
+
+
+def _svd_impl(a, panel: int, l0: float, compute_uv: bool):
+    """Tall/square SVD: blocked-QR reduction to square, then QDWH + eigh."""
+    m, n = a.shape
+    if m > n:
+        q, r = _qr_impl(a, panel, True)
+        u_r, s, vh = _svd_square_impl(r, panel, l0)
+        if not compute_uv:
+            return s
+        return jnp.matmul(q, u_r, precision=GEMM_PRECISION), s, vh
+    out = _svd_square_impl(a, panel, l0)
+    if not compute_uv:
+        return out[1]
+    return out
+
+
+@functools.lru_cache(maxsize=128)
+def _svd_jit(m: int, n: int, dtype_name: str, panel: int, l0: float, compute_uv: bool):
+    return jax.jit(lambda a: _svd_impl(a, panel, l0, compute_uv))
+
+
+def svd(a, full_matrices: bool = False, compute_uv: bool = True, panel: Optional[int] = None):
+    """Polar-based SVD (eager entry point): tall inputs are blocked-QR'd down
+    to square, the square factor takes the QDWH polar route, and ``eigh`` of
+    the small symmetric polar factor yields the singular triplets. Wide
+    inputs go through the transpose. Falls back to the exact pre-blocked
+    ``jnp.linalg.svd`` expression when disabled, below crossover,
+    ``full_matrices=True``, or complex.
+    """
+    a = jnp.asarray(a)
+    m, n = a.shape
+    if full_matrices or not _use_blocked("svd", m, n, a.dtype):
+        if not compute_uv:
+            return jnp.linalg.svd(a, compute_uv=False)
+        return jnp.linalg.svd(a, full_matrices=full_matrices)
+    if n > m:
+        # wide: svd(aᵀ) = (V, S, Uᵀ) — swap and transpose the factors
+        out = svd(a.T, full_matrices=False, compute_uv=compute_uv, panel=panel)
+        if not compute_uv:
+            return out
+        ut, s, vht = out
+        return vht.T, s, ut.T
+    cdt = _f32_compute_dtype(a.dtype)
+    b = panel or default_panel_width(m, n)
+    l0 = _default_l0(cdt)
+    n_iters = len(_qdwh_schedule(l0, float(jnp.finfo(cdt).eps)))
+    if _MON.enabled and not _is_tracer(a):
+        _REG.counter("linalg.blocked.dispatch").inc(label="svd")
+        _count("linalg.blocked.svd.polar_iters", n_iters)
+        pf, uf, qf = _qr_flops(m, n, True)
+        _count("linalg.blocked.svd.qr_flops", (pf + uf + qf) if m > n else 0)
+        # per polar iteration: QR variant ~ (10/3 + 2) n³, Cholesky ~ 4 n³
+        _count("linalg.blocked.svd.polar_flops", int(n_iters * 5 * n**3))
+        with _ev.span("linalg.blocked.svd", m=m, n=n, panel=b, polar_iters=n_iters):
+            return _svd_dispatch(a, m, n, cdt, b, l0, compute_uv)
+    return _svd_dispatch(a, m, n, cdt, b, l0, compute_uv)
+
+
+def _svd_dispatch(a, m, n, cdt, b, l0, compute_uv):
+    out = _svd_jit(m, n, np.dtype(cdt).name, b, l0, compute_uv)(a.astype(cdt))
+    if not compute_uv:
+        return out.astype(_f32_compute_dtype(a.dtype))
+    u, s, vh = out
+    return u.astype(a.dtype), s.astype(_f32_compute_dtype(a.dtype)), vh.astype(a.dtype)

@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from . import blocked
 from .. import sanitation
 from .. import types
 from ..communication import MeshCommunication
@@ -46,9 +47,20 @@ __all__ = ["qr"]
 QR = collections.namedtuple("QR", "Q, R")
 
 
+def __build_bcgs(mesh, axis: str, p: int, m: int, n: int, jdtype: str, use_blocked=None):
+    """Compile the split=1 block Gram-Schmidt sweep for one problem shape.
+
+    ``use_blocked`` selects the MXU-blocked compact-WY kernel for the local
+    panel QRs (None reads ``HEAT_TPU_BLOCKED_LINALG`` now); it is part of the
+    compile cache key so flipping the env var mid-process never reuses a
+    program built for the other kernel."""
+    if use_blocked is None:
+        use_blocked = blocked.kernels_enabled()
+    return __build_bcgs_cached(mesh, axis, p, m, n, jdtype, bool(use_blocked))
+
+
 @functools.lru_cache(maxsize=64)
-def __build_bcgs(mesh, axis: str, p: int, m: int, n: int, jdtype: str):
-    """Compile the split=1 block Gram-Schmidt sweep for one problem shape."""
+def __build_bcgs_cached(mesh, axis: str, p: int, m: int, n: int, jdtype: str, use_blocked: bool):
     b = n // p
     dt = np.dtype(jdtype)
     hi = jax.lax.Precision.HIGHEST
@@ -71,7 +83,9 @@ def __build_bcgs(mesh, axis: str, p: int, m: int, n: int, jdtype: str):
 
             p1, c1 = project(panel)
             p2, c2 = project(p1)  # second pass: BCGS2 reorthogonalization
-            qk, rkk = jnp.linalg.qr(p2)  # redundant (m,b) QR on every shard
+            # redundant (m,b) panel QR on every shard — compact-WY blocked
+            # above the crossover (blocked.py), jnp.linalg.qr below it
+            qk, rkk = blocked.local_qr(p2, use_blocked=use_blocked)
             q_me = jnp.where(me == k, qk, q_me)
             # R column-block k, assembled once: earlier shards contribute their
             # projection coefficients at their row block, the owner contributes
@@ -105,18 +119,31 @@ def __build_bcgs(mesh, axis: str, p: int, m: int, n: int, jdtype: str):
     )
 
 
-@functools.lru_cache(maxsize=64)
-def _build_tsqr(mesh, axis: str, p: int):
+def _build_tsqr(mesh, axis: str, p: int, use_blocked=None):
     """Compile the single-level TSQR sweep: per-device panel QR, an all-gather
     of the (n, n) R factors ONLY (never the operand), a redundant (p*n, n) QR,
     and the local correction GEMM. Builder-shaped so the AOT multi-chip suite
-    (tests/test_tpu_aot.py) can compile it against a v5e topology."""
+    (tests/test_tpu_aot.py) can compile it against a v5e topology.
 
+    ``use_blocked`` (None = read ``HEAT_TPU_BLOCKED_LINALG`` now) routes the
+    local panel and merge QRs through the MXU-blocked compact-WY kernel; it is
+    part of the compile cache key."""
+    if use_blocked is None:
+        use_blocked = blocked.kernels_enabled()
+    return _build_tsqr_cached(mesh, axis, p, bool(use_blocked))
+
+
+@functools.lru_cache(maxsize=64)
+def _build_tsqr_cached(mesh, axis: str, p: int, use_blocked: bool):
     def local(block):
-        q1, r1 = jnp.linalg.qr(block)  # (m/p, n), (n, n)
+        # local row-block QR: the TSQR building block BENCH_r05 measured at
+        # 1.1% MXU on the jnp lowering — blocked compact-WY above the crossover
+        q1, r1 = blocked.local_qr(block, use_blocked=use_blocked)  # (m/p, n), (n, n)
         r_stack = jax.lax.all_gather(r1, axis)  # (p, n, n)
         n = r1.shape[0]
-        q2, r = jnp.linalg.qr(r_stack.reshape(p * n, n))  # (p*n, n), (n, n)
+        q2, r = blocked.local_qr(
+            r_stack.reshape(p * n, n), use_blocked=use_blocked
+        )  # (p*n, n), (n, n)
         i = jax.lax.axis_index(axis)
         q2_block = jax.lax.dynamic_slice_in_dim(q2, i * n, n, axis=0)  # (n, n)
         # full-precision correction GEMM: a bf16 pass here degrades Q's orthogonality
@@ -235,7 +262,7 @@ def qr(
             stacklevel=2,
         )
     if calc_q:
-        q_data, r_data = jnp.linalg.qr(a.larray)
+        q_data, r_data = blocked.qr(a.larray)
         q_split = a.split if a.split == 0 else None
         gq = tuple(q_data.shape)
         if distributed:
@@ -245,7 +272,7 @@ def qr(
         q = DNDarray(q_data, gq, a.dtype, q_split, a.device, a.comm, True)
         r = DNDarray(r_data, tuple(r_data.shape), a.dtype, None, a.device, a.comm, True)
         return QR(q, r)
-    r_data = jnp.linalg.qr(a.larray, mode="r")
+    r_data = blocked.qr(a.larray, calc_q=False)
     if distributed:
         r_data = comm.shard(r_data, None)
     r = DNDarray(r_data, tuple(r_data.shape), a.dtype, None, a.device, a.comm, True)

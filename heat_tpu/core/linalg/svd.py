@@ -18,6 +18,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from . import blocked
 from .. import sanitation
 from .. import types
 from ..dndarray import DNDarray
@@ -51,7 +52,9 @@ def svd(a: DNDarray, full_matrices: bool = False, compute_uv: bool = True):
     m, n = a.shape
     if a.split == 0 and m >= n and compute_uv and not full_matrices:
         q, r = _qr(a)
-        u_r, s, vh = jnp.linalg.svd(r.larray, full_matrices=False)
+        # small-R SVD: QDWH polar + eigh (blocked.py) above the crossover,
+        # the old jnp.linalg.svd bit-for-bit below it or with the gate off
+        u_r, s, vh = blocked.svd(r.larray, full_matrices=False)
         u = matmul(q, DNDarray(u_r, (n, n), a.dtype, None, a.device, a.comm, True))
         return SVD(
             u,
@@ -64,9 +67,9 @@ def svd(a: DNDarray, full_matrices: bool = False, compute_uv: bool = True):
         ut, s, vht = svd(transpose(a, (1, 0)), full_matrices=False, compute_uv=True)
         return SVD(transpose(vht, (1, 0)), s, transpose(ut, (1, 0)))
     if not compute_uv:
-        s = jnp.linalg.svd(a.larray, compute_uv=False)
+        s = blocked.svd(a.larray, compute_uv=False)
         return DNDarray(s, tuple(s.shape), types.canonical_heat_type(s.dtype), None, a.device, a.comm, True)
-    u, s, vh = jnp.linalg.svd(a.larray, full_matrices=full_matrices)
+    u, s, vh = blocked.svd(a.larray, full_matrices=full_matrices)
     return SVD(
         DNDarray(u, tuple(u.shape), a.dtype, None, a.device, a.comm, True),
         DNDarray(s, tuple(s.shape), types.canonical_heat_type(s.dtype), None, a.device, a.comm, True),
@@ -136,7 +139,7 @@ def rsvd(
         y = matmul(a, matmul(at, y, precision=fast), precision=fast)
     q = _qr(y).Q  # (m, l) orthonormal, distributed for split=0
     b = matmul(transpose(q, (1, 0)), a)  # (l, n) small, contraction over rows
-    u_b, s, vh = jnp.linalg.svd(b.resplit(None).larray, full_matrices=False)
+    u_b, s, vh = blocked.svd(b.resplit(None).larray, full_matrices=False)
     u = matmul(q, DNDarray(u_b[:, :rank], (l, rank), a.dtype, None, a.device, a.comm, True))
     return SVD(
         u,

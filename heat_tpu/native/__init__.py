@@ -112,7 +112,7 @@ def _load():
             lib.ht_prefetch_open.argtypes = [
                 ctypes.c_char_p,
                 ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
-                ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ]
             lib.ht_prefetch_open.restype = ctypes.c_void_p
             lib.ht_prefetch_next.argtypes = [
@@ -173,27 +173,49 @@ class SlabPrefetcher:
     end. The ring depth bounds memory: at most ``depth`` slabs are resident.
     Single-consumer; use as a context manager or call :meth:`close`.
 
-    **Regular files only.** The fast path ``mmap``\\ s the file once and copies
-    each slab straight out of the mapping (``_prefetch.cpp``). A file that is
-    truncated *between* slabs surfaces as ``IOError`` (EOF is re-checked per
-    slab), but a NON-ATOMIC replacement of the file mid-epoch — truncating or
-    rewriting the inode the mapping still points at while a copy is in flight —
-    raises ``SIGBUS`` and kills the process, where the old ``pread``-based path
-    raised a catchable ``IOError``. This is inherent to any mmap consumer.
-    Replace datasets atomically (write a temp file, then ``os.replace`` — the
-    mapping then keeps reading the old inode safely) or close the prefetcher
-    around dataset swaps. Pipes/sockets/char devices are not mappable and are
-    rejected at open.
+    **Regular files only** (mmap mode, the default). The fast path ``mmap``\\ s
+    the file once and copies each slab straight out of the mapping
+    (``_prefetch.cpp``). A file that is truncated *between* slabs surfaces as
+    ``IOError`` (EOF is re-checked per slab), but a NON-ATOMIC replacement of
+    the file mid-epoch — truncating or rewriting the inode the mapping still
+    points at while a copy is in flight — raises ``SIGBUS`` and kills the
+    process, where a ``pread``-based path raises a catchable ``IOError``. This
+    is inherent to any mmap consumer. Replace datasets atomically (write a
+    temp file, then ``os.replace`` — the mapping then keeps reading the old
+    inode safely) or close the prefetcher around dataset swaps.
+    Pipes/sockets/char devices are not mappable and are rejected at open.
+
+    **pread mode** (``use_pread=True``, or process-wide via
+    ``HEAT_TPU_PREFETCH_PREAD=1``): routes delivery back to the gen-1 read
+    path for network/volatile storage where mmap fault-in can SIGBUS — each
+    slab is ``pread`` into the caller's buffer (truncation and device errors
+    surface as catchable ``IOError``), and the warm threads issue
+    ``posix_fadvise(WILLNEED)`` readahead instead of touching pages. Slightly
+    slower on page-cache-resident files (an extra kernel crossing per slab),
+    strictly safer on storage that can change or fail underneath the reader.
 
     Raises RuntimeError when the native library is unavailable — callers gate on
     :func:`available` and keep a Python fallback (see
     ``utils/data/partial_dataset.py``).
     """
 
-    def __init__(self, path: str, offsets, lengths, depth: int = 4, nthreads: int = 2):
+    def __init__(
+        self,
+        path: str,
+        offsets,
+        lengths,
+        depth: int = 4,
+        nthreads: int = 2,
+        use_pread: bool | None = None,
+    ):
         lib = _load()
         if lib is None:
             raise RuntimeError("native library unavailable")
+        if use_pread is None:
+            use_pread = os.environ.get(
+                "HEAT_TPU_PREFETCH_PREAD", ""
+            ).strip().lower() not in ("", "0", "false", "off")
+        self.use_pread = bool(use_pread)
         offsets = np.ascontiguousarray(offsets, dtype=np.int64)
         lengths = np.ascontiguousarray(lengths, dtype=np.int64)
         if offsets.shape != lengths.shape or offsets.ndim != 1:
@@ -223,6 +245,7 @@ class SlabPrefetcher:
             self._n,
             int(depth),
             int(nthreads),
+            1 if self.use_pread else 0,
         )
         if not self._handle:
             raise RuntimeError(f"could not open {path!r} for prefetch")

@@ -30,6 +30,14 @@
 // narrow race (truncation DURING a copy or a device-level read error on
 // fault-in) is a SIGBUS — inherent to any mmap consumer — which the input
 // pipeline accepts for the regular-file datasets it reads.
+//
+// pread mode (use_pread != 0 at open; HEAT_TPU_PREFETCH_PREAD=1 from Python):
+// the gen-1 read path for network/volatile storage where mmap fault-in can
+// SIGBUS — no mapping is created, the consumer pread()s each slab into the
+// caller's buffer (a short read or IO error surfaces as the catchable -2,
+// never a signal), and the warm threads issue posix_fadvise(WILLNEED)
+// readahead for the slabs inside the depth window instead of touching pages.
+// Same ordering/ticket/shutdown contract as the mmap path.
 
 #include <algorithm>
 #include <atomic>
@@ -50,6 +58,7 @@ namespace {
 struct Prefetcher {
   int fd = -1;
   const char* map = nullptr;
+  bool use_pread = false;
   int64_t file_size = 0;
   std::vector<int64_t> offsets;
   std::vector<int64_t> lengths;
@@ -81,6 +90,11 @@ void warm_loop(Prefetcher* p) {
       if (p->closed) return;
     }
     const int64_t off = p->offsets[i];
+    if (p->use_pread) {
+      // no mapping to touch: hand the kernel an async readahead hint
+      posix_fadvise(p->fd, off, p->lengths[i], POSIX_FADV_WILLNEED);
+      continue;
+    }
     // clamp to the CURRENT size too: touching past a post-open truncation
     // would SIGBUS (same per-slab re-check as the consumer)
     struct stat st;
@@ -99,7 +113,7 @@ extern "C" {
 
 void* ht_prefetch_open(const char* path, const int64_t* offsets,
                        const int64_t* lengths, int64_t nslabs, int depth,
-                       int nthreads) {
+                       int nthreads, int use_pread) {
   if (nslabs < 0 || depth < 1 || nthreads < 1) return nullptr;
   int fd = open(path, O_RDONLY);
   if (fd < 0) return nullptr;
@@ -110,8 +124,9 @@ void* ht_prefetch_open(const char* path, const int64_t* offsets,
   }
   auto* p = new Prefetcher();
   p->fd = fd;
+  p->use_pread = use_pread != 0;
   p->file_size = static_cast<int64_t>(st.st_size);
-  if (p->file_size > 0) {
+  if (!p->use_pread && p->file_size > 0) {
     void* m = mmap(nullptr, p->file_size, PROT_READ, MAP_SHARED, fd, 0);
     if (m == MAP_FAILED) {
       close(fd);
@@ -126,7 +141,7 @@ void* ht_prefetch_open(const char* path, const int64_t* offsets,
   p->lengths.assign(lengths, lengths + nslabs);
   p->depth = depth;
   if (nthreads > depth) nthreads = depth;  // warmers past the window just park
-  if (p->map != nullptr) {
+  if (p->map != nullptr || (p->use_pread && p->file_size > 0)) {
     for (int t = 0; t < nthreads; ++t) p->workers.emplace_back(warm_loop, p);
   }
   return p;
@@ -161,6 +176,16 @@ int64_t ht_prefetch_next(void* handle, char* dest, int64_t dest_cap) {
     result = -2;  // truncated/short file: the gen-1 IO-error contract
   } else if (len > dest_cap) {
     result = -3;
+  } else if (p->use_pread) {
+    lk.unlock();
+    int64_t got = 0;
+    while (got < len) {
+      const ssize_t r = pread(p->fd, dest + got, len - got, off + got);
+      if (r <= 0) break;  // EOF mid-slab or device error: catchable -2
+      got += r;
+    }
+    lk.lock();
+    result = p->closed ? -4 : (got == len ? len : -2);
   } else {
     lk.unlock();
     if (len > 0) memcpy(dest, p->map + off, len);

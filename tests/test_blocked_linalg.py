@@ -1,0 +1,370 @@
+"""
+Numerics suite for the MXU-blocked dense kernels (heat_tpu/core/linalg/blocked.py).
+
+Every kernel is checked against its ``jnp.linalg`` reference the way a LAPACK
+testing harness would: reconstruction ``||A - QR|| / ||A||``, orthogonality
+``||QᵀQ - I||``, pivot-growth sanity for the LU, singular-value match for the
+SVD — across f32/bf16-input shapes including ragged (min-dim not divisible by
+the panel width), tiny (below the dispatch crossover), and degenerate
+(rank-deficient, zero-dim) cases. The ``HEAT_TPU_BLOCKED_LINALG=0`` escape
+hatch must restore the pre-blocked path BIT FOR BIT.
+
+Tolerances: reconstruction/residual errors scale like ``c·eps·||A||`` and
+orthogonality like ``c·eps·sqrt(n)`` (the Frobenius norm of an n-column Q is
+sqrt(n)); the acceptance constant is c = 50.
+
+Marked ``blocked_linalg`` so CI can run the fast selection per PR
+(``-m "blocked_linalg and not slow"``); the large-shape checks are ``slow``.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from heat_tpu.core.linalg import blocked
+
+pytestmark = pytest.mark.blocked_linalg
+
+F32 = np.float32
+BF16 = jnp.bfloat16
+
+
+def _eps(dtype):
+    return float(jnp.finfo(jnp.dtype(dtype)).eps)
+
+
+def _mat(m, n, dtype=F32, seed=0, rank=None):
+    rng = np.random.default_rng(seed)
+    if rank is None:
+        a = rng.standard_normal((m, n))
+    else:
+        a = rng.standard_normal((m, rank)) @ rng.standard_normal((rank, n))
+    return jnp.asarray(a.astype(np.float32)).astype(dtype)
+
+
+def _fro(x):
+    return float(np.linalg.norm(np.asarray(x, dtype=np.float64)))
+
+
+# ------------------------------------------------------------------------- QR
+QR_SHAPES = [
+    (256, 256),  # square, panel-divisible
+    (384, 192),  # tall
+    (192, 384),  # wide
+    (300, 130),  # ragged: 130 % 32 != 0 and min-dim barely above crossover
+]
+
+
+@pytest.mark.parametrize("shape", QR_SHAPES)
+@pytest.mark.parametrize("dtype", [F32, BF16], ids=["f32", "bf16"])
+def test_qr_reconstruction_orthogonality(shape, dtype):
+    m, n = shape
+    a = _mat(m, n, dtype, seed=1)
+    q, r = blocked.qr(a)
+    k = min(m, n)
+    assert q.shape == (m, k) and r.shape == (k, n)
+    assert q.dtype == a.dtype and r.dtype == a.dtype
+    eps = _eps(dtype)
+    rec = _fro(np.asarray(q, np.float64) @ np.asarray(r, np.float64) - np.asarray(a, np.float64))
+    assert rec <= 50 * eps * _fro(a), f"||A-QR||={rec:.3e}"
+    orth = _fro(np.asarray(q, np.float64).T @ np.asarray(q, np.float64) - np.eye(k))
+    assert orth <= 50 * eps * np.sqrt(k), f"||QtQ-I||={orth:.3e}"
+    # R strictly upper triangular
+    assert np.abs(np.tril(np.asarray(r, np.float32), -1)).max() == 0.0
+
+
+@pytest.mark.parametrize("panel", [32, 96])
+def test_qr_ragged_panel_width(panel):
+    # explicit panel width that does NOT divide min(m, n): the last panel is
+    # narrow and the write-back offsets stay consistent
+    a = _mat(280, 250, seed=2)
+    q, r = blocked.qr(a, panel=panel)
+    rec = _fro(np.asarray(q) @ np.asarray(r) - np.asarray(a))
+    assert rec <= 50 * _eps(F32) * _fro(a)
+
+
+@pytest.mark.parametrize(
+    "shape", [(8, 8), (40, 17), (1, 1), (5, 0), (0, 5), (127, 127)]
+)
+def test_qr_below_crossover_is_jnp_bitwise(shape):
+    # tiny/degenerate shapes ride jnp.linalg.qr unchanged — bit for bit
+    m, n = shape
+    a = _mat(m, n, seed=3)
+    q, r = blocked.qr(a)
+    q_ref, r_ref = jnp.linalg.qr(a)
+    np.testing.assert_array_equal(np.asarray(q), np.asarray(q_ref))
+    np.testing.assert_array_equal(np.asarray(r), np.asarray(r_ref))
+
+
+def test_qr_rank_deficient():
+    a = _mat(320, 160, seed=4, rank=40)
+    q, r = blocked.qr(a)
+    rec = _fro(np.asarray(q) @ np.asarray(r) - np.asarray(a))
+    assert rec <= 50 * _eps(F32) * max(_fro(a), 1.0)
+    orth = _fro(np.asarray(q).T @ np.asarray(q) - np.eye(160))
+    assert orth <= 50 * _eps(F32) * np.sqrt(160)
+
+
+def test_qr_r_only_matches_q_path():
+    a = _mat(384, 160, seed=5)
+    r_only = blocked.qr(a, calc_q=False)
+    _, r = blocked.qr(a)
+    np.testing.assert_allclose(np.asarray(r_only), np.asarray(r), rtol=0, atol=0)
+
+
+def test_local_qr_flag_forced_off_is_jnp_bitwise():
+    # the compiled-builder path passes the captured flag explicitly
+    a = _mat(256, 256, seed=6)
+    q, r = blocked.local_qr(a, use_blocked=False)
+    q_ref, r_ref = jnp.linalg.qr(a)
+    np.testing.assert_array_equal(np.asarray(q), np.asarray(q_ref))
+    np.testing.assert_array_equal(np.asarray(r), np.asarray(r_ref))
+
+
+# ------------------------------------------------------------------------- LU
+def test_lu_reconstruction_and_pivot_growth():
+    n = 320
+    a = _mat(n, n, seed=7)
+    lu, piv = blocked.lu_factor(a)
+    assert piv.shape == (n,) and piv.dtype == jnp.int32
+    lo = np.tril(np.asarray(lu, np.float64), -1) + np.eye(n)
+    up = np.triu(np.asarray(lu, np.float64))
+    # apply the ipiv swap sequence to A (LAPACK getrf semantics)
+    pa = np.asarray(a, np.float64).copy()
+    for i, p in enumerate(np.asarray(piv)):
+        pa[[i, p]] = pa[[p, i]]
+    rec = _fro(lo @ up - pa)
+    assert rec <= 50 * _eps(F32) * _fro(a), f"||PA-LU||={rec:.3e}"
+    # partial pivoting within full-height panels => |L| <= 1 and bounded growth
+    assert np.abs(lo).max() <= 1.0 + 1e-6
+    growth = np.abs(up).max() / np.abs(np.asarray(a)).max()
+    assert np.isfinite(growth) and growth < 100.0, f"pivot growth {growth:.1f}"
+
+
+def test_lu_matches_lapack_interface():
+    # the (lu, piv) pair must be consumable by jax.scipy.linalg.lu_solve
+    n, k = 288, 5
+    a = _mat(n, n, seed=8)
+    b = _mat(n, k, seed=9)
+    x = jax.scipy.linalg.lu_solve(blocked.lu_factor(a), b)
+    x_ref = jnp.linalg.solve(a, b)
+    resid = _fro(np.asarray(a) @ np.asarray(x) - np.asarray(b))
+    assert resid <= 50 * _eps(F32) * _fro(a) * max(_fro(x_ref), 1.0)
+
+
+def test_lu_below_crossover_is_lapack_bitwise():
+    a = _mat(64, 64, seed=10)
+    lu, piv = blocked.lu_factor(a)
+    lu_ref, piv_ref = jax.scipy.linalg.lu_factor(a)
+    np.testing.assert_array_equal(np.asarray(lu), np.asarray(lu_ref))
+    np.testing.assert_array_equal(np.asarray(piv), np.asarray(piv_ref))
+
+
+@pytest.mark.parametrize("nrhs", [None, 1, 7])
+def test_solve_residual(nrhs):
+    n = 300
+    a = _mat(n, n, seed=11) + 3 * jnp.eye(n, dtype=jnp.float32)
+    b = _mat(n, nrhs, seed=12) if nrhs else jnp.asarray(
+        np.random.default_rng(12).standard_normal(n).astype(F32)
+    )
+    x = blocked.solve(a, b)
+    assert x.shape == b.shape and x.dtype == b.dtype
+    resid = _fro(np.asarray(a, np.float64) @ np.asarray(x, np.float64) - np.asarray(b, np.float64))
+    assert resid <= 50 * _eps(F32) * _fro(a) * max(_fro(x), 1.0)
+
+
+def test_det_slogdet_inv_match_jnp():
+    n = 300
+    a = _mat(n, n, seed=13) + 3 * jnp.eye(n, dtype=jnp.float32)
+    s, l = blocked.slogdet(a)
+    s_ref, l_ref = jnp.linalg.slogdet(a)
+    assert float(s) == float(s_ref)
+    np.testing.assert_allclose(float(l), float(l_ref), rtol=1e-5)
+    d = blocked.det(a)
+    d_ref = jnp.linalg.det(a)
+    if np.isfinite(float(d_ref)) and float(d_ref) != 0.0:
+        np.testing.assert_allclose(float(d), float(d_ref), rtol=1e-4)
+    inv = blocked.inv(a)
+    resid = _fro(np.asarray(a, np.float64) @ np.asarray(inv, np.float64) - np.eye(n))
+    assert resid <= 50 * _eps(F32) * np.sqrt(n) * float(np.linalg.cond(np.asarray(a, np.float64)))
+
+
+def test_singular_matrix_det_zero():
+    n = 280
+    a = _mat(n, n, seed=14, rank=64)  # rank-deficient: det must be ~0
+    assert abs(float(blocked.det(a))) <= 1e-3 * max(_fro(a), 1.0)
+    sign, logabs = blocked.slogdet(a)
+    # numpy contract: exact zero pivot -> (0, -inf); near-singular -> tiny det
+    assert (float(sign) == 0.0) or float(logabs) < np.log(_fro(a)) * n
+
+
+# ------------------------------------------------------------------------ SVD
+SVD_SHAPES = [(256, 256), (500, 200), (200, 500), (300, 130)]
+
+
+@pytest.mark.parametrize("shape", SVD_SHAPES)
+def test_svd_values_and_reconstruction(shape):
+    m, n = shape
+    a = _mat(m, n, seed=15)
+    u, s, vh = blocked.svd(a)
+    k = min(m, n)
+    assert u.shape == (m, k) and s.shape == (k,) and vh.shape == (k, n)
+    s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+    eps = _eps(F32)
+    assert np.all(np.diff(np.asarray(s)) <= 1e-5 * s_ref[0])  # descending
+    assert np.abs(np.asarray(s, np.float64) - s_ref).max() <= 50 * eps * _fro(a)
+    rec = _fro((np.asarray(u, np.float64) * np.asarray(s, np.float64)) @ np.asarray(vh, np.float64) - np.asarray(a, np.float64))
+    assert rec <= 50 * eps * _fro(a), f"||A-USV||={rec:.3e}"
+    assert _fro(np.asarray(u).T @ np.asarray(u) - np.eye(k)) <= 50 * eps * np.sqrt(k)
+    assert _fro(np.asarray(vh) @ np.asarray(vh).T - np.eye(k)) <= 50 * eps * np.sqrt(k)
+
+
+def test_svd_bf16_input():
+    a = _mat(320, 160, BF16, seed=16)
+    u, s, vh = blocked.svd(a)
+    assert u.dtype == jnp.bfloat16 and vh.dtype == jnp.bfloat16
+    s_ref = np.linalg.svd(np.asarray(a, np.float32), compute_uv=False)
+    eps = _eps(BF16)  # factors are quantized back to bf16 on exit
+    rec = _fro(
+        (np.asarray(u, np.float64) * np.asarray(s, np.float64)) @ np.asarray(vh, np.float64)
+        - np.asarray(a, np.float64)
+    )
+    assert rec <= 50 * eps * _fro(a)
+    assert np.abs(np.asarray(s, np.float64) - s_ref).max() <= 50 * eps * _fro(a)
+
+
+def test_svd_rank_deficient_values():
+    a = _mat(300, 300, seed=17, rank=50)
+    s = blocked.svd(a, compute_uv=False)
+    s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+    assert np.abs(np.asarray(s, np.float64) - s_ref).max() <= 50 * _eps(F32) * _fro(a)
+    # the trailing 250 singular values are numerically zero
+    assert np.asarray(s)[60:].max() <= 50 * _eps(F32) * _fro(a)
+    u, sv, vh = blocked.svd(a)
+    rec = _fro((np.asarray(u, np.float64) * np.asarray(sv, np.float64)) @ np.asarray(vh, np.float64) - np.asarray(a, np.float64))
+    assert rec <= 50 * _eps(F32) * _fro(a)
+
+
+def test_svd_compute_uv_false_matches():
+    a = _mat(256, 192, seed=18)
+    s_only = blocked.svd(a, compute_uv=False)
+    _, s, _ = blocked.svd(a)
+    np.testing.assert_allclose(np.asarray(s_only), np.asarray(s), rtol=0, atol=0)
+
+
+@pytest.mark.parametrize("shape", [(60, 60), (100, 20), (1, 1), (0, 4)])
+def test_svd_below_crossover_is_jnp_bitwise(shape):
+    a = _mat(*shape, seed=19)
+    u, s, vh = blocked.svd(a)
+    u_ref, s_ref, vh_ref = jnp.linalg.svd(a, full_matrices=False)
+    np.testing.assert_array_equal(np.asarray(u), np.asarray(u_ref))
+    np.testing.assert_array_equal(np.asarray(s), np.asarray(s_ref))
+    np.testing.assert_array_equal(np.asarray(vh), np.asarray(vh_ref))
+
+
+def test_svd_full_matrices_falls_back():
+    a = _mat(300, 200, seed=20)
+    u, s, vh = blocked.svd(a, full_matrices=True)
+    u_ref, s_ref, vh_ref = jnp.linalg.svd(a, full_matrices=True)
+    np.testing.assert_array_equal(np.asarray(u), np.asarray(u_ref))
+    np.testing.assert_array_equal(np.asarray(s), np.asarray(s_ref))
+
+
+def test_polar_factor_properties():
+    n = 256
+    a = _mat(n, n, seed=21) + 2 * jnp.eye(n, dtype=jnp.float32)
+    u, h = blocked.polar(a)
+    eps = _eps(F32)
+    assert _fro(np.asarray(u).T @ np.asarray(u) - np.eye(n)) <= 50 * eps * np.sqrt(n)
+    hh = np.asarray(h, np.float64)
+    assert _fro(hh - hh.T) <= 50 * eps * _fro(a)  # symmetric
+    assert np.linalg.eigvalsh(hh).min() >= -50 * eps * _fro(a)  # PSD
+    rec = _fro(np.asarray(u, np.float64) @ hh - np.asarray(a, np.float64))
+    assert rec <= 50 * eps * _fro(a)
+
+
+# ------------------------------------------------------------- gate & dispatch
+def test_env_escape_hatch_restores_jnp_bitwise(monkeypatch):
+    monkeypatch.setenv("HEAT_TPU_BLOCKED_LINALG", "0")
+    a = _mat(256, 256, seed=22)
+    b = _mat(256, 3, seed=23)
+    q, r = blocked.qr(a)
+    q_ref, r_ref = jnp.linalg.qr(a)
+    np.testing.assert_array_equal(np.asarray(q), np.asarray(q_ref))
+    np.testing.assert_array_equal(np.asarray(r), np.asarray(r_ref))
+    np.testing.assert_array_equal(
+        np.asarray(blocked.solve(a, b)), np.asarray(jnp.linalg.solve(a, b))
+    )
+    np.testing.assert_array_equal(
+        np.asarray(blocked.det(a)), np.asarray(jnp.linalg.det(a))
+    )
+    np.testing.assert_array_equal(
+        np.asarray(blocked.inv(a)), np.asarray(jnp.linalg.inv(a))
+    )
+    u, s, vh = blocked.svd(a)
+    u_ref, s_ref, vh_ref = jnp.linalg.svd(a, full_matrices=False)
+    np.testing.assert_array_equal(np.asarray(s), np.asarray(s_ref))
+    lu, piv = blocked.lu_factor(a)
+    lu_ref, piv_ref = jax.scipy.linalg.lu_factor(a)
+    np.testing.assert_array_equal(np.asarray(lu), np.asarray(lu_ref))
+
+
+def test_env_escape_hatch_reaches_dndarray_api(monkeypatch):
+    # the DNDarray entry points honor the flag per call (no stale kernel)
+    import heat_tpu as ht
+
+    a_np = (np.random.default_rng(24).standard_normal((260, 260)) + 4 * np.eye(260)).astype(F32)
+    monkeypatch.setenv("HEAT_TPU_BLOCKED_LINALG", "0")
+    d_off = ht.det(ht.array(a_np)).item()
+    monkeypatch.delenv("HEAT_TPU_BLOCKED_LINALG")
+    d_on = ht.det(ht.array(a_np)).item()
+    ref = float(jnp.linalg.det(jnp.asarray(a_np)))
+    assert d_off == ref  # gate off == old path, bit for bit
+    np.testing.assert_allclose(d_on, ref, rtol=1e-4)
+
+
+def test_monitoring_counters_and_span():
+    from heat_tpu import monitoring
+    from heat_tpu.monitoring import events as mev
+
+    monitoring.reset()
+    with monitoring.capture():
+        blocked.qr(_mat(256, 256, seed=25))
+        blocked.svd(_mat(256, 256, seed=26), compute_uv=False)
+    snap = monitoring.REGISTRY.snapshot()
+    disp = snap["counters"]["linalg.blocked.dispatch"]
+    assert disp["labels"]["qr"] >= 1 and disp["labels"]["svd"] >= 1
+    assert snap["counters"]["linalg.blocked.qr.panel_flops"] > 0
+    assert snap["counters"]["linalg.blocked.qr.update_flops"] > 0
+    assert snap["counters"]["linalg.blocked.svd.polar_iters"] >= 1
+    assert mev.records("linalg.blocked.qr") and mev.records("linalg.blocked.svd")
+    monitoring.reset()
+
+
+def test_default_panel_width_table():
+    assert blocked.default_panel_width(255, 255) == 32
+    assert blocked.default_panel_width(1 << 16, 511) == 64
+    assert blocked.default_panel_width(4096, 4096) == 128
+    assert blocked.default_panel_width(1 << 14, 1 << 14) == 256
+
+
+# ------------------------------------------------------------------ slow sweep
+@pytest.mark.slow
+@pytest.mark.parametrize("n", [1024])
+def test_qr_lu_svd_large(n):
+    a = _mat(n, n, seed=27)
+    eps = _eps(F32)
+    q, r = blocked.qr(a)
+    assert _fro(np.asarray(q) @ np.asarray(r) - np.asarray(a)) <= 50 * eps * _fro(a)
+    lu, piv = blocked.lu_factor(a)
+    x = jax.scipy.linalg.lu_solve((lu, piv), jnp.eye(n))
+    assert _fro(np.asarray(a) @ np.asarray(x) - np.eye(n)) <= 50 * eps * np.sqrt(n) * float(
+        np.linalg.cond(np.asarray(a, np.float64))
+    )
+    s = blocked.svd(a, compute_uv=False)
+    s_ref = np.linalg.svd(np.asarray(a, np.float64), compute_uv=False)
+    assert np.abs(np.asarray(s, np.float64) - s_ref).max() <= 50 * eps * _fro(a)

@@ -206,3 +206,61 @@ def test_prefetch_post_open_truncation_is_recoverable(tmp_path):
     assert pf.next_into(buf) == 8192
     assert bytes(buf[:16]) == data[8192 : 8192 + 16]
     pf.close()
+
+
+# ------------------------------------------------- pread mode (ADVICE r5 toggle)
+
+
+def test_prefetch_pread_mode_ordered_delivery(tmp_path):
+    """use_pread=True routes delivery through the gen-1 pread path (no mmap):
+    same ordering, payloads, and end-of-stream contract as the mmap mode —
+    for network/volatile storage where mmap fault-in can SIGBUS."""
+    rng = np.random.default_rng(7)
+    blob = rng.integers(0, 256, size=50_000, dtype=np.uint8).tobytes()
+    p = tmp_path / "pread.bin"
+    p.write_bytes(blob)
+    offsets = list(range(0, 48_000, 4000))
+    lengths = [4000] * len(offsets)
+    with native.SlabPrefetcher(
+        str(p), offsets, lengths, depth=3, nthreads=2, use_pread=True
+    ) as pf:
+        assert pf.use_pread
+        got = list(pf)
+    assert got == [blob[o : o + 4000] for o in offsets]
+
+
+def test_prefetch_pread_env_toggle(tmp_path, monkeypatch):
+    """HEAT_TPU_PREFETCH_PREAD=1 flips the default for every consumer that
+    does not pass use_pread explicitly (the io pipeline's constructor call)."""
+    p = tmp_path / "env.bin"
+    p.write_bytes(bytes(range(256)) * 8)
+    monkeypatch.setenv("HEAT_TPU_PREFETCH_PREAD", "1")
+    with native.SlabPrefetcher(str(p), [0, 512], [512, 512]) as pf:
+        assert pf.use_pread
+        assert list(pf) == [p.read_bytes()[:512], p.read_bytes()[512:1024]]
+    monkeypatch.setenv("HEAT_TPU_PREFETCH_PREAD", "0")
+    with native.SlabPrefetcher(str(p), [0], [256]) as pf:
+        assert not pf.use_pread  # explicit off wins over any ambient setting
+        assert list(pf) == [p.read_bytes()[:256]]
+
+
+def test_prefetch_pread_truncation_is_catchable(tmp_path):
+    """The pread path's reason to exist: a slab that lies beyond EOF (or is
+    truncated mid-epoch) surfaces as a catchable IOError — never a SIGBUS —
+    and the rolled-back ticket stays consumable after the file is restored."""
+    data = bytes(range(256)) * 32  # 8 KiB
+    p = tmp_path / "ptrunc.bin"
+    p.write_bytes(data)
+    pf = native.SlabPrefetcher(
+        str(p), [0, 4096], [4096, 4096], depth=1, nthreads=1, use_pread=True
+    )
+    os.truncate(p, 4096)
+    buf = np.empty(4096, dtype=np.uint8)
+    assert pf.next_into(buf) == 4096
+    with pytest.raises(IOError):
+        pf.next_into(buf)
+    p.write_bytes(data)  # restore: the -2 rollback keeps slab 1 observable
+    assert pf.next_into(buf) == 4096
+    assert bytes(buf[:16]) == data[4096 : 4096 + 16]
+    assert pf.next_into(buf) is None
+    pf.close()
