@@ -685,6 +685,27 @@ def main():
                     )
                 }
                 linalg["linalg_error"] = repr(e)[:160]
+        # deferred-execution fusion anchors (ISSUE 3): effective GB/s of an
+        # 8-op elementwise chain through the fused path, the same-process
+        # HEAT_TPU_FUSION=0 eager baseline, and their ratio (fusion_speedup),
+        # plus the dispatch-layer ops/sec on a tiny operand
+        elemwise = {}
+        if os.environ.get("BENCH_FAST") != "1":
+            try:
+                _add_benchmarks_path()
+                from elementwise_bench import bench_elementwise
+
+                with _mev.span("bench.elementwise"):
+                    elemwise = bench_elementwise()
+            except Exception as e:
+                # explicit null-valued keys, like the neighbouring benches: a
+                # crashed anchor must be distinguishable from a BENCH_FAST skip
+                elemwise = {
+                    "elementwise_chain_valid": None,
+                    "dispatch_valid": None,
+                    "fusion_speedup": None,
+                    "elementwise_error": repr(e)[:160],
+                }
         # out-of-core input pipeline (VERDICT r4 #8): native prefetcher vs h5py
         io_pipe = {}
         if os.environ.get("BENCH_FAST") != "1":
@@ -737,6 +758,7 @@ def main():
                 "dp8_cpu_iters_per_sec": scale8_ips,
                 "dp8_cpu_sharding_overhead_pct": scale8_overhead,
                 **linalg,
+                **elemwise,
                 **io_pipe,
                 "telemetry": telemetry,
             }

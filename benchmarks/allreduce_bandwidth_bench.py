@@ -25,7 +25,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from heat_tpu.core._compat import shard_map
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from _common import sync as _sync

@@ -1,0 +1,156 @@
+"""
+Gated elementwise-chain anchors for the deferred-execution fusion engine
+(``heat_tpu/core/fusion.py``, ISSUE 3).
+
+Two anchors, both measured with the same interleaved (short, long)
+paired-differencing and physics gating as every other bench surface
+(``bench._gated_rates``), plus a same-process fused-vs-eager ratio in the
+``{op}_blocked_speedup`` style of ``linalg_bench``:
+
+* ``elementwise_chain_gbps`` — effective memory throughput of an 8-op f32
+  elementwise chain over a 64 MB operand, executed through the fused path
+  (one kernel: read N·4 bytes, write N·4 bytes per step). The same chain is
+  then re-run in the same process with ``HEAT_TPU_FUSION=0`` — one XLA
+  executable per op, ~8× the traffic — and ``fusion_speedup`` is the ratio of
+  the two gated medians. Pairs are gated at 1.05× the HBM roofline through
+  the 2·N·4 bytes/step floor of the *fused* kernel (an honest pair can never
+  exceed it; the eager leg's own floor is 8× higher, gated accordingly).
+* ``dispatch_ops_per_sec`` — recording+flush dispatch throughput: the same
+  8-op chain on a 4 KB operand, where execution is free and the wall clock is
+  pure dispatch-layer overhead (expression recording, trace-cache hits, jit
+  call machinery). Reported for the fused path with the eager ops/sec beside
+  it; ungated (there is no hardware roofline on Python dispatch).
+
+Run: python benchmarks/elementwise_bench.py
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import (  # noqa: E402  (repo-root bench.py: shared gate machinery)
+    HBM_ROOFLINES_GBPS,
+    MIN_VALID,
+    _gated_rates,
+    _lookup,
+    _perturb,
+    _spread_pct,
+)
+
+CHAIN_OPS = 8
+N_LARGE = 16 * 1024 * 1024  # 64 MB f32: far beyond any cache, memory-bound
+N_SMALL = 1024  # 4 KB: execution is free, the clock measures dispatch
+
+
+def _chain(ht, x):
+    """The 8-op f32 chain: every step is a whitelisted recordable elementwise
+    op, values stay in [0, ~2] (no NaN/Inf), and each op depends on the
+    previous one so nothing can be elided."""
+    y = x * 1.0000001
+    y = y + 0.25
+    y = ht.abs(y)
+    y = ht.sqrt(y)
+    y = y * 0.5
+    y = y - 0.125
+    y = ht.maximum(y, 0.015625)
+    y = y / 0.75
+    return y
+
+
+def _make_run(ht, base, fused: bool):
+    """One timed leg: perturb the operand (quantized so the factor survives
+    f32 rounding — nothing replayable), then run ``steps`` chains with a
+    flush per chain, and stop the clock only when real bytes arrive."""
+
+    def run(steps, eps):
+        os.environ["HEAT_TPU_FUSION"] = "1" if fused else "0"
+        x = base * np.float32(_perturb(eps, 2.0**-18))
+        np.asarray(x.larray)  # perturbation lands before the clock starts
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            x = _chain(ht, x)
+            x.parray  # noqa: B018 — flush barrier (async dispatch)
+        np.asarray(x.larray)  # clock stops when the last kernel's bytes land
+        return time.perf_counter() - t0
+
+    return run
+
+
+def _rate(ht, base, fused, bytes_per_step, ceiling_gbps, long_seconds=0.6):
+    run = _make_run(ht, base, fused)
+    run(1, 0.0)  # compile + warm (8 executables eager, 1 fused kernel)
+    calib = 2.0 / max(run(2, 1e-7), 1e-9)
+    valid, total, discarded = _gated_rates(
+        run, calib, bytes_per_step, ceiling_gbps, long_seconds=long_seconds
+    )
+    if not valid:
+        return None, 0.0, total, discarded
+    return float(np.median(valid)), _spread_pct(valid), total, discarded
+
+
+def bench_elementwise():
+    import jax
+
+    import heat_tpu as ht
+
+    prev = os.environ.get("HEAT_TPU_FUSION")
+    dev = jax.devices()[0]
+    roofline = _lookup(dev, HBM_ROOFLINES_GBPS)
+    rng = np.random.default_rng(5)
+    out = {"fusion_chain_ops": CHAIN_OPS}
+    try:
+        base = ht.array(rng.random(N_LARGE, dtype=np.float32))
+        fused_bytes = 2 * N_LARGE * 4  # one read + one write of the operand
+        eager_bytes = 2 * CHAIN_OPS * N_LARGE * 4  # one read+write PER op
+
+        f_rate, f_jit, f_tot, f_disc = _rate(ht, base, True, fused_bytes, roofline)
+        e_rate, e_jit, _, _ = _rate(ht, base, False, eager_bytes, roofline)
+
+        if f_rate is not None:
+            gbps = fused_bytes * f_rate / 1e9
+            out["elementwise_chain_gbps"] = round(gbps, 1)
+            out["elementwise_chain_roofline_pct"] = (
+                round(100.0 * gbps / roofline, 1) if roofline else None
+            )
+            out["elementwise_chain_jitter_pct"] = round(f_jit, 2)
+            out["elementwise_chain_valid"] = bool(
+                f_tot - f_disc >= MIN_VALID and f_jit < 10.0
+            )
+        else:
+            out["elementwise_chain_valid"] = False
+        if e_rate is not None:
+            out["elementwise_chain_eager_gbps"] = round(
+                eager_bytes * e_rate / 1e9, 1
+            )
+        if f_rate is not None and e_rate is not None:
+            # both legs run the SAME logical chain in the same process; the
+            # gated-median rate ratio IS the wall-clock speedup
+            out["fusion_speedup"] = round(f_rate / e_rate, 2)
+
+        small = ht.array(rng.random(N_SMALL, dtype=np.float32))
+        df_rate, df_jit, df_tot, df_disc = _rate(
+            ht, small, True, 1, None, long_seconds=0.4
+        )
+        de_rate, _, _, _ = _rate(ht, small, False, 1, None, long_seconds=0.4)
+        if df_rate is not None:
+            out["dispatch_ops_per_sec"] = round(CHAIN_OPS * df_rate, 1)
+            out["dispatch_valid"] = bool(df_tot - df_disc >= MIN_VALID and df_jit < 25.0)
+        else:
+            out["dispatch_valid"] = False
+        if de_rate is not None:
+            out["dispatch_eager_ops_per_sec"] = round(CHAIN_OPS * de_rate, 1)
+    finally:
+        if prev is None:
+            os.environ.pop("HEAT_TPU_FUSION", None)
+        else:
+            os.environ["HEAT_TPU_FUSION"] = prev
+    return out
+
+
+if __name__ == "__main__":
+    print(json.dumps(bench_elementwise()))

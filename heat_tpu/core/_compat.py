@@ -1,0 +1,59 @@
+"""
+Version-compatibility shims for the jax API surface this framework targets.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to ``jax.shard_map``
+(and its replication-checking kwarg was renamed ``check_rep`` → ``check_vma``)
+across jax releases; this module presents the *new* calling convention —
+``shard_map(f, mesh=..., in_specs=..., out_specs=..., check_vma=...)`` — on
+every jax this image can carry, so all collective/kernel builders in the
+framework write one spelling and never branch on the jax version themselves.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:  # jax >= 0.6: public top-level API with the check_vma spelling
+    _shard_map = jax.shard_map
+    _LEGACY_SHARD_MAP = False
+except AttributeError:  # jax 0.4.x: experimental module with check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _LEGACY_SHARD_MAP = True
+
+try:  # jax >= 0.5: top-level double-precision context manager
+    enable_x64 = jax.enable_x64
+except AttributeError:  # jax 0.4.x: experimental module
+    from jax.experimental import enable_x64  # noqa: F401
+
+__all__ = ["enable_x64", "set_cpu_device_count", "shard_map"]
+
+
+def set_cpu_device_count(n: int) -> None:
+    """Configure the number of virtual CPU devices BEFORE backend init.
+
+    jax >= 0.5 exposes the ``jax_num_cpu_devices`` config option; 0.4.x only
+    honors the ``--xla_force_host_platform_device_count`` XLA flag, which must
+    land in ``XLA_FLAGS`` before the CPU backend is created (callers —
+    ``distributed_init`` — already require that ordering).
+    """
+    try:
+        jax.config.update("jax_num_cpu_devices", int(n))
+    except AttributeError:
+        import os
+
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={int(n)}"
+        )
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kwargs):
+    """``jax.shard_map`` with the modern keyword signature on any jax.
+
+    ``check_vma`` maps to the legacy ``check_rep`` kwarg on jax versions that
+    predate the rename; ``None`` leaves the jax default in place either way.
+    """
+    if check_vma is not None:
+        kwargs["check_rep" if _LEGACY_SHARD_MAP else "check_vma"] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)

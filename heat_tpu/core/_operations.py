@@ -25,6 +25,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from . import devices as _devices
+from . import fusion as _fusion
 from . import sanitation
 from . import stride_tricks
 from .communication import sanitize_comm
@@ -115,24 +116,25 @@ def __binary_op(
         if _MON.enabled:
             _instr.dtype_fallback("true_divide")
 
-    arrays = []
+    # normalize operands WITHOUT touching array data (a pending fused
+    # expression must not materialize just to be used as an operand)
+    ops_in = []  # ('d', DNDarray) | ('s', scalar) | ('a', jnp array)
+    shapes = []
     dnd_ops = []
     for t in (t1, t2):
         if isinstance(t, DNDarray):
-            arrays.append(t.larray)
+            ops_in.append(("d", t))
+            shapes.append(tuple(t.shape))
             dnd_ops.append(t)
         elif isinstance(t, scalars):
-            arrays.append(t)  # keep weak typing for scalars
+            ops_in.append(("s", t))  # keep weak typing for scalars
+            shapes.append(())
         else:
-            arrays.append(jnp.asarray(t))
+            a = jnp.asarray(t)
+            ops_in.append(("a", a))
+            shapes.append(tuple(a.shape))
 
-    out_shape = stride_tricks.broadcast_shapes(
-        *[
-            tuple(t.shape) if isinstance(t, DNDarray) else
-            (tuple(np.shape(a)) if not hasattr(a, "shape") else tuple(a.shape))
-            for t, a in zip((t1, t2), arrays)
-        ]
-    )
+    out_shape = stride_tricks.broadcast_shapes(*shapes)
 
     # output split: leftmost non-None split among DNDarray operands, remapped through
     # broadcasting (reference dominance rules _operations.py:57-71)
@@ -146,6 +148,19 @@ def __binary_op(
 
     device = dnd_ops[0].device if dnd_ops else _devices.get_device()
     comm = dnd_ops[0].comm if dnd_ops else sanitize_comm(None)
+
+    # --- deferred-execution fast path (core/fusion.py): record the op as an
+    # expression node instead of dispatching one standalone XLA executable;
+    # HEAT_TPU_FUSION=0 or any non-recordable shape falls through to the
+    # unchanged eager path below
+    if out is None and _fusion.enabled():
+        deferred = _fusion.defer_binary(
+            operation, ops_in, promoted, out_shape, out_split, device, comm, where, fn_kwargs
+        )
+        if deferred is not None:
+            return deferred
+
+    arrays = [t.larray if k == "d" else t for k, t in ops_in]
 
     # Ragged fast path: when an operand carries a padded split axis, compute on the
     # sharded physical arrays instead of gathering the logical views — garbage in the
@@ -237,6 +252,14 @@ def __local_op(
     if _MON.enabled:
         _instr.op_dispatch("local")
     sanitation.sanitize_in(x)
+    # deferred-execution fast path: elementwise shape-preserving unary ops are
+    # recorded in the pending expression DAG (core/fusion.py); anything else —
+    # out= buffers, force_logical over pads, shape-changing calls, non-jnp
+    # callables — takes the unchanged eager path
+    if out is None and _fusion.enabled():
+        deferred = _fusion.defer_local(operation, x, kwargs, force_logical)
+        if deferred is not None:
+            return deferred
     if force_logical and x.is_padded:
         result = operation(x.larray, **kwargs)
         gshape = tuple(result.shape)

@@ -54,6 +54,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ._compat import shard_map as _shard_map
 from .communication import MeshCommunication
 
 __all__ = ["distributed_sort", "distributed_sort_1d", "can_distribute_sort"]
@@ -217,7 +218,7 @@ def _build_sort(
 
     spec = P(*([None] * axis + [axis_name]))
     return jax.jit(
-        jax.shard_map(local, mesh=mesh, in_specs=spec, out_specs=(spec, spec), check_vma=False)
+        _shard_map(local, mesh=mesh, in_specs=spec, out_specs=(spec, spec), check_vma=False)
     )
 
 
@@ -298,7 +299,7 @@ def _build_topk(mesh, axis_name: str, p: int, pshape: Tuple[int, ...], dim: int,
 
     spec = P(*([None] * dim + [axis_name]))
     return jax.jit(
-        jax.shard_map(local, mesh=mesh, in_specs=spec, out_specs=(P(), P()), check_vma=False)
+        _shard_map(local, mesh=mesh, in_specs=spec, out_specs=(P(), P()), check_vma=False)
     )
 
 

@@ -23,6 +23,8 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from ._compat import shard_map as _shard_map
+
 # observability: disabled-path cost is one truthiness check (see monitoring/)
 from ..monitoring.registry import STATE as _MON
 from ..monitoring import instrument as _instr
@@ -737,7 +739,7 @@ def _build_collective(comm: "MeshCommunication", kind: str, split: int, ndim: in
         raise ValueError(f"unknown collective {kind}")
 
     return jax.jit(
-        jax.shard_map(body, mesh=mesh, in_specs=spec_split, out_specs=out_spec, check_vma=False)
+        _shard_map(body, mesh=mesh, in_specs=spec_split, out_specs=out_spec, check_vma=False)
     )
 
 
@@ -861,7 +863,9 @@ def distributed_init(
             RuntimeWarning,
         )
     if local_devices is not None:
-        jax.config.update("jax_num_cpu_devices", int(local_devices))
+        from ._compat import set_cpu_device_count
+
+        set_cpu_device_count(int(local_devices))
     kwargs = {}
     if coordinator_address is not None:
         kwargs["coordinator_address"] = coordinator_address

@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 
 from . import devices
+from ._compat import shard_map as _shard_map
 from .communication import Communication, MeshCommunication, sanitize_comm
 from .devices import Device
 from .stride_tricks import sanitize_axis
@@ -82,7 +83,7 @@ def _build_halo_exchange(mesh, axis: str, p: int, split: int, halo_size: int,
     in_spec = _P(*([None] * split), axis)
     out_specs = (in_spec, in_spec, _P(axis))
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             exchange, mesh=mesh, in_specs=in_spec, out_specs=out_specs, check_vma=False
         )
     )
@@ -171,6 +172,12 @@ class DNDarray:
         self.__halo_next = None
         self.__halo_prev = None
         self.__halo_stacked = None
+        # deferred-execution state (core/fusion.py): when this array is the
+        # result of a recorded elementwise chain, ``__array`` is None and
+        # ``__lazy`` holds the pending expression node; ``__pshape`` carries
+        # the (statically known) physical shape until materialization
+        self.__lazy = None
+        self.__pshape = None
 
     def __invalidate(self):
         """Drop caches derived from the physical array (logical view + halos)."""
@@ -191,6 +198,34 @@ class DNDarray:
             data, tuple(data.shape), dtype, split, proto.device, proto.comm, True
         )
 
+    @classmethod
+    def _deferred(
+        cls, node, gshape, pshape, dtype, split, device, comm
+    ) -> "DNDarray":
+        """Construct a DNDarray whose data is a pending fusion expression
+        (``core/fusion.py``). No placement happens here — materialization
+        applies the canonical placement once per fused chain."""
+        obj = object.__new__(cls)
+        obj.__array = None
+        obj.__gshape = tuple(int(v) for v in gshape)
+        obj.__dtype = dtype
+        obj.__split = split
+        obj.__device = device
+        obj.__comm = comm
+        obj.__balanced = True
+        obj.__lshape_map = None
+        obj.__logical = None
+        obj.__halo_next = None
+        obj.__halo_prev = None
+        obj.__halo_stacked = None
+        obj.__lazy = node
+        obj.__pshape = tuple(int(v) for v in pshape)
+        return obj
+
+    def _expr(self):
+        """The pending fusion expression node, or None when concrete."""
+        return self.__lazy
+
     # ------------------------------------------------------------------ properties
     @property
     def larray(self) -> jax.Array:
@@ -203,13 +238,14 @@ class DNDarray:
         sharded compute paths should prefer :attr:`parray`/:meth:`filled`.
         """
         if not self.is_padded:
-            return self.__array
+            return self.parray
         if self.__logical is None:
+            phys = self.parray
             idx = tuple(
                 slice(0, self.__gshape[d]) if d == self.__split_axis else slice(None)
                 for d in range(len(self.__gshape))
             )
-            self.__logical = self.__array[idx]
+            self.__logical = phys[idx]
         return self.__logical
 
     @larray.setter
@@ -225,14 +261,33 @@ class DNDarray:
             and tuple(array.shape) in (self.__gshape, self.pshape)
         ):
             array = self.__comm.placed(array, self.__split, self.__gshape)
+        if self.__lazy is not None:
+            # overwriting an unflushed expression: the dead graph is dropped,
+            # never executed (out=-style aliasing barrier)
+            if _MON.enabled:
+                _instr.fusion_elided_write()
+            self.__lazy = None
         self.__array = array
+        self.__pshape = None
         self.__invalidate()
 
     @property
     def parray(self) -> jax.Array:
         """The backing *physical* ``jax.Array``: the split axis padded at the global
         end to an even multiple of the mesh size and sharded over it. Equal to
-        :attr:`larray` when no padding is needed. Pad content is unspecified."""
+        :attr:`larray` when no padding is needed. Pad content is unspecified.
+
+        This accessor is the single materialization barrier of the deferred-
+        execution engine: a pending elementwise expression (``core/fusion.py``)
+        is flushed through one fused jitted kernel on first access, so every
+        consumer of the physical array — reductions, collectives, printing,
+        indexing, IO, linalg — flushes exactly where it used to execute."""
+        if self.__array is None:
+            from . import fusion as _fusion
+
+            self.__array = _fusion.materialize_for(self)
+            self.__lazy = None
+            self.__pshape = None
         return self.__array
 
     @property
@@ -244,14 +299,17 @@ class DNDarray:
 
     @property
     def pshape(self) -> Tuple[int, ...]:
-        """The physical (padded) global shape."""
+        """The physical (padded) global shape (statically known metadata —
+        reading it never materializes a pending expression)."""
+        if self.__array is None:
+            return self.__pshape
         return tuple(self.__array.shape)
 
     @property
     def is_padded(self) -> bool:
         """Whether the physical layout carries pad rows on the split axis."""
         s = self.__split_axis
-        return s is not None and len(self.__gshape) > 0 and tuple(self.__array.shape) != self.__gshape
+        return s is not None and len(self.__gshape) > 0 and self.pshape != self.__gshape
 
     @property
     def pad_count(self) -> int:
@@ -259,20 +317,21 @@ class DNDarray:
         s = self.__split_axis
         if s is None or not self.__gshape:
             return 0
-        return int(self.__array.shape[s]) - self.__gshape[s]
+        return int(self.pshape[s]) - self.__gshape[s]
 
     def filled(self, fill) -> jax.Array:
         """The physical array with the pad region set to ``fill`` — the form sharded
         reductions/contractions consume (``fill`` = the op's neutral element)."""
         if not self.is_padded:
-            return self.__array
+            return self.parray
+        phys = self.parray
         s = self.__split_axis
         n = self.__gshape[s]
-        iota = jnp.arange(self.__array.shape[s])
+        iota = jnp.arange(phys.shape[s])
         shape = [1] * len(self.__gshape)
-        shape[s] = self.__array.shape[s]
+        shape[s] = phys.shape[s]
         mask = iota.reshape(shape) < n
-        return jnp.where(mask, self.__array, jnp.asarray(fill, dtype=self.__array.dtype))
+        return jnp.where(mask, phys, jnp.asarray(fill, dtype=phys.dtype))
 
     @property
     def balanced(self) -> bool:
@@ -542,7 +601,7 @@ class DNDarray:
         if isinstance(comm, MeshCommunication) and comm.is_distributed():
             if _MON.enabled:
                 _instr.resharding(self.__split, self.__split)
-            self.__array = comm.placed(self.__array, self.__split, self.__gshape)
+            self.__array = comm.placed(self.parray, self.__split, self.__gshape)
             self.__invalidate()
 
     def get_halo(self, halo_size: int) -> None:
@@ -581,7 +640,7 @@ class DNDarray:
             )
         fn = _build_halo_exchange(comm.mesh, comm.axis_name, p, split, halo_size, self.pshape)
         # zero-fill pads so ragged tails exchange zeros, not garbage
-        phys = self.filled(0) if self.is_padded else self.__array
+        phys = self.filled(0) if self.is_padded else self.parray
         self.__halo_prev, self.__halo_next, self.__halo_stacked = fn(phys)
 
     # ------------------------------------------------------------------ conversions
@@ -593,7 +652,14 @@ class DNDarray:
         from .types import canonical_heat_type
 
         dtype = canonical_heat_type(dtype)
-        casted = self.__array.astype(dtype.jnp_type())
+        if copy and self.__lazy is not None:
+            from . import fusion as _fusion
+
+            if _fusion.enabled():
+                deferred = _fusion.defer_cast(self, dtype)
+                if deferred is not None:
+                    return deferred
+        casted = self.parray.astype(dtype.jnp_type())
         if copy:
             return DNDarray(
                 casted, self.shape, dtype, self.split, self.device, self.comm, True
@@ -622,9 +688,8 @@ class DNDarray:
             raise ValueError("Only 2D tensors supported at the moment")
         k = int(np.minimum(self.shape[0], self.shape[1]))
         idx = jnp.arange(k)
-        self.__array = self.__array.at[idx, idx].set(
-            jnp.asarray(value, dtype=self.__array.dtype)
-        )
+        phys = self.parray
+        self.__array = phys.at[idx, idx].set(jnp.asarray(value, dtype=phys.dtype))
         self.__invalidate()
         return self
 
@@ -633,7 +698,7 @@ class DNDarray:
         a resplit(None) gather; here a device fetch). In a multi-controller run the
         shards on other hosts are gathered with ``process_allgather`` (every host
         gets the full array, like the reference's resplit(None))."""
-        arr = self.__array
+        arr = self.parray
         if hasattr(arr, "is_fully_addressable") and not arr.is_fully_addressable:
             from jax.experimental import multihost_utils
 
@@ -678,8 +743,9 @@ class DNDarray:
         # back — cache the staged buffer so a sharded/TPU array is gathered
         # and host-staged once per interchange (cleared again when __dlpack__
         # hands the buffer off)
+        phys = self.parray
         cached = getattr(self, "_DNDarray__dlpack_cache", None)
-        if cached is not None and cached[0] is self.__array:
+        if cached is not None and cached[0] is phys:
             return cached[1]
         arr = self.larray
         if hasattr(arr, "sharding") and len(getattr(arr.sharding, "device_set", [None])) > 1:
@@ -687,7 +753,7 @@ class DNDarray:
         dev = next(iter(arr.devices())) if hasattr(arr, "devices") else None
         if dev is not None and dev.platform not in ("cpu", "gpu", "cuda", "rocm"):
             arr = jax.device_put(arr, jax.devices("cpu")[0])
-        self.__dlpack_cache = (self.__array, arr)
+        self.__dlpack_cache = (phys, arr)
         return arr
 
     def tolist(self, keepsplit: bool = False) -> list:
@@ -955,7 +1021,7 @@ class DNDarray:
         """
         norm, new_split, fast = self.__index_plan(key)
         if fast:
-            result = self.__array[norm]
+            result = self.parray[norm]
         else:
             result = self.larray[self.__process_key(key)]
         if np.isscalar(result) or (hasattr(result, "ndim") and result.ndim == 0):
@@ -988,14 +1054,13 @@ class DNDarray:
                 jkey = jnp.pad(jkey, widths, constant_values=False)
                 if hasattr(value, "shape") and tuple(value.shape) == self.__gshape:
                     value = jnp.pad(value, widths)
-            self.__array = jnp.where(
-                jkey, jnp.asarray(value, dtype=self.__array.dtype), self.__array
-            )
+            phys = self.parray
+            self.__array = jnp.where(jkey, jnp.asarray(value, dtype=phys.dtype), phys)
             self.__invalidate()
             return
         norm, _, fast = self.__index_plan(key)
         if fast:
-            self.__array = self.__array.at[norm].set(value)
+            self.__array = self.parray.at[norm].set(value)
         else:
             updated = self.larray.at[jkey].set(value)
             comm = self.__comm

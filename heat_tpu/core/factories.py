@@ -442,7 +442,10 @@ def linspace(
     num = int(num)
     if num < 0:  # num == 0 is a valid empty result, as in numpy
         raise ValueError(f"number of samples 'num' must be non-negative, got {num}")
-    step = (stop - start) / max(1, num - int(bool(endpoint)))
+    # numpy-exact step: delta / div when div > 0, else NaN (np.linspace returns
+    # step=nan for num=0 and for num=1 with endpoint=True)
+    div = num - 1 if endpoint else num
+    step = (stop - start) / div if div > 0 else float("nan")
     comm_r = sanitize_comm(comm)
     if __distributed(sanitize_axis((num,), split), comm_r) and num:
         if dtype is not None:

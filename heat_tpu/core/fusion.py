@@ -1,0 +1,827 @@
+"""
+Deferred-execution fusion engine for eager elementwise chains.
+
+The NumPy-eager surface dispatches one standalone XLA executable per
+``__binary_op``/``__local_op`` call, so a chain of k elementwise ops pays ~2k
+full memory round-trips where a single fused kernel pays 2 (the lazy-tensor
+technique of torch-xla/LTC and Dask's deferred expression graphs, applied to
+the dispatch layer). With ``HEAT_TPU_FUSION=1`` (the default) the hot
+templates stop executing elementwise ops immediately and instead record nodes
+in a small expression DAG carried by the result :class:`~.dndarray.DNDarray`;
+the first *materialization barrier* flushes the pending subgraph through one
+jitted fused kernel.
+
+Design (see ``doc/fusion_notes.md`` for the full narrative):
+
+* **Recording.** ``defer_binary``/``defer_local``/``defer_where``/
+  ``defer_cast`` accept the exact operand set the eager template would have
+  executed and return a deferred ``DNDarray`` (or ``None`` — caller falls back
+  to the unchanged eager path). Only whitelisted, shape-preserving jnp
+  elementwise callables are recorded; everything else (reductions,
+  cumulatives, collectives, ``out=`` writes, shape-changing ops, operands
+  traced inside someone else's ``jit``) keeps today's op-at-a-time execution.
+  Scalar operands enter the trace as runtime *arguments* with the exact aval
+  eager dispatch gives them (Python scalars weak-typed, np scalars strong) so
+  XLA cannot constant-fold them (``x / 3.0`` must stay a division, not become
+  ``x * (1/3.0)``); the one exception is a static integer exponent of
+  ``power``, baked as a constant because eager lowers it via
+  ``lax.integer_pow`` at trace time. The eager template's dtype cast-back
+  rule is replayed *inside* the trace for the same reason. The single
+  remaining numeric difference a fused kernel can exhibit is *excess
+  precision*: XLA contracts adjacent multiply→add into an FMA inside one
+  kernel (strictly more accurate, one rounding instead of two) — per-op
+  results are bit-identical to eager, and the differential suite pins both
+  properties.
+* **Barriers.** ``DNDarray.parray`` is the single materialization choke
+  point: every existing barrier — reductions and cumulatives across the
+  templates, collectives, ``.larray``/``.numpy()``/``item()``, printing,
+  indexing reads and writes, ``out=`` aliasing, halos, IO, linalg — already
+  reads ``parray``/``larray``, so the flush happens exactly where execution
+  used to. Writing into a ``DNDarray`` that still carries an unflushed
+  expression simply *drops* the dead graph (counted as
+  ``fusion.elided_writes`` — deferred work that never had to run).
+* **Ragged/padded layouts.** The padded-physical fast path is preserved
+  inside fused traces: when every split-axis operand carries the canonical
+  padded layout the nodes record the *physical* arrays and the pad rides
+  through the fused kernel exactly as it rides through the eager one.
+  Asymmetric pad situations (an operand that would need ``pad_physical``,
+  ``where=`` over padded operands, ``force_logical`` ops) fall back to eager.
+* **Trace cache.** Flushing builds a positional replay program from the
+  DAG and compiles it once per ``(graph structure, leaf avals incl.
+  weak-type, leaf shardings, donation mask)`` key, held in a bounded LRU
+  (``HEAT_TPU_FUSION_CACHE_SIZE``). Steady-state loops (lasso updates,
+  statistics pipelines) hit the cache every iteration.
+* **Donation.** On accelerator backends, leaf buffers whose owning
+  ``DNDarray`` has died (dead intermediates of a rebound chain) and that
+  match the fused output's shape/dtype are donated to XLA so the chain runs
+  in place. CPU ignores donation; ``HEAT_TPU_FUSION_DONATE=0`` disables it.
+* **Bounded graphs.** A chain that grows past ``HEAT_TPU_FUSION_MAX_CHAIN``
+  ops without hitting a barrier is flushed at record time, so unbounded
+  rebind loops compile a small set of fixed-size kernels instead of one
+  kernel per chain length.
+* **Escape hatch.** ``HEAT_TPU_FUSION=0`` restores the pre-fusion
+  op-at-a-time execution bit for bit (read per dispatch, same pattern as
+  ``HEAT_TPU_BLOCKED_LINALG``).
+
+Monitoring: ``fusion.ops_deferred`` (labelled binary/local/where/cast),
+``fusion.flushes``/``fusion.kernels_compiled``/``fusion.cache_hits``,
+``fusion.elided_writes``, and the ``fusion.chain_length`` histogram, all
+through ``monitoring/instrument.py``; :func:`cache_info` reports
+entries/hits/misses/evictions of the trace LRU.
+"""
+
+from __future__ import annotations
+
+import builtins
+import collections
+import functools
+import os
+import sys
+import weakref
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..monitoring.registry import STATE as _MON
+from ..monitoring import instrument as _instr
+from .dndarray import DNDarray
+
+__all__ = [
+    "enabled",
+    "is_deferred",
+    "pending_count",
+    "flush",
+    "flush_pending",
+    "defer_binary",
+    "defer_local",
+    "defer_where",
+    "defer_cast",
+    "materialize_for",
+    "cache_info",
+    "clear_cache",
+]
+
+
+# ------------------------------------------------------------------ gates
+def enabled() -> bool:
+    """Whether deferred-execution fusion is globally enabled (default on).
+
+    ``HEAT_TPU_FUSION=0`` (or ``false``/``off``) restores the pre-fusion
+    op-at-a-time dispatch bit for bit. Read per dispatch, so a mid-process
+    flip is honored immediately (pending graphs recorded before the flip
+    still flush through the fused path — their results are bit-identical).
+    """
+    val = os.environ.get("HEAT_TPU_FUSION", "")
+    return val.strip().lower() not in ("0", "false", "off")
+
+
+def _donate_enabled() -> bool:
+    val = os.environ.get("HEAT_TPU_FUSION_DONATE", "")
+    return val.strip().lower() not in ("0", "false", "off")
+
+
+def _max_chain() -> int:
+    try:
+        return int(os.environ.get("HEAT_TPU_FUSION_MAX_CHAIN", "64"))
+    except ValueError:
+        return 64
+
+
+def _cache_max() -> int:
+    # sized for shape-diverse workloads (test suites, exploratory sessions):
+    # a fused CPU/TPU executable is a few hundred KB at most, and an evicted
+    # entry costs a full XLA recompile on its next appearance — measured 267
+    # evictions across four op-heavy test files at 256 entries
+    try:
+        return int(os.environ.get("HEAT_TPU_FUSION_CACHE_SIZE", "4096"))
+    except ValueError:
+        return 4096
+
+
+# ------------------------------------------------------------------ whitelists
+#
+# Only elementwise, shape-preserving jnp callables are recordable: the fused
+# replay applies them positionally on traced operands, so anything with
+# data-dependent shapes, axis semantics, or non-jnp identity falls back to the
+# eager template. Matched by object identity — a lambda or partial never
+# matches.
+_BINARY_NAMES = (
+    "add", "subtract", "multiply", "true_divide", "divide", "floor_divide",
+    "mod", "remainder", "fmod", "power", "float_power", "arctan2", "hypot",
+    "maximum", "minimum", "copysign", "nextafter", "ldexp", "heaviside",
+    "logaddexp", "logaddexp2", "gcd", "lcm",
+    "equal", "not_equal", "less", "less_equal", "greater", "greater_equal",
+    "logical_and", "logical_or", "logical_xor",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "left_shift", "right_shift",
+)
+_UNARY_NAMES = (
+    "abs", "absolute", "negative", "positive", "sign", "signbit", "sqrt",
+    "cbrt", "square", "reciprocal", "exp", "exp2", "expm1", "log", "log2",
+    "log10", "log1p", "sin", "cos", "tan", "arcsin", "arccos", "arctan",
+    "sinh", "cosh", "tanh", "arcsinh", "arccosh", "arctanh", "deg2rad",
+    "rad2deg", "degrees", "radians", "floor", "ceil", "trunc", "rint",
+    "round", "clip", "isnan", "isinf", "isfinite", "isneginf", "isposinf",
+    "logical_not", "invert", "bitwise_not", "conj", "conjugate", "real",
+    "imag", "angle", "i0", "sinc",
+)
+
+ELEMENTWISE_BINARY = frozenset(
+    getattr(jnp, n) for n in _BINARY_NAMES if hasattr(jnp, n)
+)
+ELEMENTWISE_UNARY = frozenset(
+    getattr(jnp, n) for n in _UNARY_NAMES if hasattr(jnp, n)
+)
+
+#: jnp comparison ops whose bool result the eager template deliberately does
+#: NOT cast back to the promoted dtype (see ``__binary_op``).
+_EQ_NE = (jnp.equal, jnp.not_equal)
+
+#: ops that make trace-time lowering decisions from a *static* scalar operand
+#: (integer exponents -> lax.integer_pow); their int scalars are baked as
+#: constants so the fused trace lowers identically to the eager dispatch.
+_STATIC_SCALAR_OPS = frozenset(
+    op for op in (getattr(jnp, "power", None), getattr(jnp, "float_power", None)) if op is not None
+)
+
+_SCALARS = (
+    builtins.int, builtins.float, builtins.bool, builtins.complex,
+    np.number, np.bool_,
+)
+
+
+def _static_kwargs(kw: dict) -> bool:
+    """Whether every kwarg value can be baked into a trace / cache key."""
+    return all(
+        v is None or isinstance(v, (builtins.int, builtins.float, builtins.bool, str, np.number, np.bool_))
+        for v in kw.values()
+    )
+
+
+# ------------------------------------------------------------------ graph
+class _Leaf:
+    """A concrete array input of a pending graph.
+
+    ``owner`` is a weakref to the ``DNDarray`` the array was taken from (used
+    for the donation liveness check); ``None`` for raw numpy/jax operands.
+    """
+
+    __slots__ = ("array", "owner")
+
+    def __init__(self, array, owner=None):
+        self.array = array
+        self.owner = owner
+
+
+class _Node:
+    """One recorded elementwise op of the expression DAG.
+
+    ``args`` holds ``_Node`` / ``_Leaf`` / baked scalar constants in
+    positional order. ``op_key`` is the structural identity used in trace
+    cache keys (op name + process-stable object id, plus any baked
+    parameters). ``cast`` replays the eager binary template's dtype cast-back:
+    ``(promoted_np_dtype, is_eq_ne)`` or ``None``. ``value`` is filled when
+    the owning array materializes, turning the node into a leaf for any other
+    pending graph that references it.
+    """
+
+    __slots__ = ("fn", "op_key", "args", "kwargs", "cast", "aval", "nops", "value", "owner", "rc")
+
+    def __init__(self, fn, op_key, args, kwargs, cast, aval):
+        self.fn = fn
+        self.op_key = op_key
+        self.args = args
+        self.kwargs = kwargs  # tuple(sorted(items)) — hashable
+        self.cast = cast
+        self.aval = aval
+        self.value = None
+        self.owner = None
+        self.rc = 0  # how many recorded parents reference this node
+        n = 1
+        for a in args:
+            if isinstance(a, _Node) and a.value is None:
+                n += a.nops
+                a.rc += 1
+        self.nops = n  # DAG overcount is fine: only used for the flush bound
+
+
+#: Live deferred DNDarrays (weak, id-keyed — DNDarray is unhashable by
+#: design): the monitoring-export / global barrier set.
+_PENDING: dict = {}
+
+
+def _register_pending(d: "DNDarray") -> None:
+    key = id(d)
+    _PENDING[key] = weakref.ref(d, lambda _r, _k=key: _PENDING.pop(_k, None))
+
+
+def is_deferred(x) -> bool:
+    """Whether ``x`` is a DNDarray carrying an unmaterialized expression."""
+    return isinstance(x, DNDarray) and x._expr() is not None
+
+
+def _pending_arrays():
+    out = []
+    for ref in list(_PENDING.values()):
+        d = ref()
+        if d is not None and d._expr() is not None:
+            out.append(d)
+    return out
+
+
+def pending_count() -> int:
+    """Number of live DNDarrays with unflushed expressions."""
+    return len(_pending_arrays())
+
+
+def flush(x: DNDarray) -> DNDarray:
+    """Materialize ``x``'s pending expression (no-op when concrete)."""
+    x.parray  # noqa: B018 — property access is the materialization point
+    return x
+
+
+def flush_pending() -> int:
+    """Materialize every live pending graph (the monitoring-export barrier:
+    exported counters then account for all recorded work). Returns the number
+    of arrays flushed."""
+    n = 0
+    for d in _pending_arrays():
+        d.parray  # noqa: B018
+        n += 1
+    return n
+
+
+# ------------------------------------------------------------------ recording
+def _op_key(fn) -> tuple:
+    return (getattr(fn, "__name__", repr(fn)), id(fn))
+
+
+def _usable_leaf(arr) -> bool:
+    """A concrete array can enter a graph — anything but a tracer (recording
+    inside someone else's jit must stay eager)."""
+    return not isinstance(arr, jax.core.Tracer)
+
+
+def _input_of(t: DNDarray):
+    """The graph input standing for ``t``'s physical array: its pending node,
+    or a ``_Leaf`` over its (concrete) ``parray``. Returns None if unusable."""
+    node = t._expr()
+    if node is not None:
+        return node if node.value is None else _Leaf(node.value, node.owner)
+    arr = t.parray
+    if not _usable_leaf(arr):
+        return None
+    return _Leaf(arr, weakref.ref(t))
+
+
+def _aval_in(x):
+    if isinstance(x, _Node):
+        return x.aval
+    return jax.ShapeDtypeStruct(
+        x.array.shape, x.array.dtype, weak_type=bool(getattr(x.array, "weak_type", False))
+    )
+
+
+@functools.lru_cache(maxsize=4096)
+def _eval_node_cached(op_key, tmpl, kwargs, cast, avals):
+    """Abstract-eval one op (with its cast-back rule) once per structural
+    signature; repeated chain steps cost a dict hit instead of a trace."""
+    del op_key  # identity is carried by tmpl[0]'s fn via closure below
+
+    def f(*xs):
+        it = iter(xs)
+        args = [next(it) if a is _SLOT else a[2] for a in tmpl[1]]
+        return _apply(tmpl[0], args, dict(kwargs), cast)
+
+    return jax.eval_shape(f, *avals)
+
+
+_SLOT = object()  # placeholder marking tracer positions in baked arg templates
+
+
+def _const_key(a):
+    """Cache-key form of a baked scalar constant. The *type* is part of the
+    key: a Python ``2.0`` (weakly typed in jax promotion) and an
+    ``np.float64(2.0)`` (strong) hash/compare equal but trace differently."""
+    return ("c", type(a), a)
+
+
+def _apply(fn, args, kwargs, cast):
+    """Apply one recorded op exactly as the eager template would have,
+    including the binary dtype cast-back (run on traced values so weak-type
+    promotion is bit-identical)."""
+    r = fn(*args, **kwargs)
+    if cast is not None:
+        promoted, is_eq_ne = cast
+        if r.dtype != promoted and np.dtype(r.dtype).kind != "b" and not is_eq_ne:
+            r = r.astype(promoted)
+    return r
+
+
+def _eval_node(fn, op_key, args, kwargs, cast):
+    """Predicted output aval of a node (shape + dtype; weak leaves were
+    refused so the strong-type abstract eval matches the eager result)."""
+    tmpl = (fn, tuple(_SLOT if isinstance(a, (_Node, _Leaf)) else _const_key(a) for a in args))
+    avals = tuple(_aval_in(a) for a in args if isinstance(a, (_Node, _Leaf)))
+    try:
+        return _eval_node_cached(op_key, tmpl, kwargs, cast, avals)
+    except TypeError:  # unhashable template entry — eval uncached
+        def f(*xs):
+            it = iter(xs)
+            real = [next(it) if isinstance(a, (_Node, _Leaf)) else a for a in args]
+            return _apply(fn, real, dict(kwargs), cast)
+
+        return jax.eval_shape(f, *avals)
+
+
+def _finish(node: _Node, gshape, dtype, split, device, comm, kind: str) -> DNDarray:
+    """Wrap a freshly recorded node in a deferred DNDarray, register it, and
+    enforce the chain-length bound."""
+    d = DNDarray._deferred(node, gshape, tuple(node.aval.shape), dtype, split, device, comm)
+    node.owner = weakref.ref(d)
+    _register_pending(d)
+    if _MON.enabled:
+        _instr.fusion_defer(kind)
+    if node.nops >= _max_chain():
+        # flush at record time: unbounded rebind loops then compile a small
+        # set of fixed-size fused kernels instead of one per chain length
+        d.parray  # noqa: B018
+    return d
+
+
+def defer_binary(
+    operation,
+    ops_in,
+    promoted,
+    out_shape: Tuple[int, ...],
+    out_split: Optional[int],
+    device,
+    comm,
+    where,
+    fn_kwargs: dict,
+) -> Optional[DNDarray]:
+    """Record one eager ``__binary_op`` dispatch as a graph node.
+
+    ``ops_in`` is the template's normalized operand list — ``('d', DNDarray)``
+    / ``('s', scalar)`` / ``('a', jnp array)`` — exactly what the eager path
+    would execute on. Returns the deferred result, or None to fall back.
+    """
+    from .types import canonical_heat_type
+
+    if operation not in ELEMENTWISE_BINARY:
+        return None
+    if fn_kwargs and not _static_kwargs(fn_kwargs):
+        return None
+    if isinstance(where, _SCALARS) and not isinstance(where, (builtins.bool, np.bool_)):
+        return None
+
+    dnds = [t for k, t in ops_in if k == "d"]
+    padded = [t for t in dnds if t.is_padded]
+    phys = False
+    if padded:
+        # mirror of the eager padded-physical fast path, restricted to the
+        # symmetric cases; anything needing pad_physical / logical slicing
+        # inside the trace falls back to eager
+        if out_split is None or where is not None:
+            return None
+        for k, t in ops_in:
+            if k == "s":
+                continue
+            shp = tuple(t.shape)
+            ndim_t = len(shp)
+            ax_t = ndim_t - (len(out_shape) - out_split)
+            if ax_t < 0 or ndim_t == 0 or shp[ax_t] == 1:
+                if k == "d" and t.is_padded:
+                    return None  # its contribution would be a logical slice
+            elif (
+                k == "d"
+                and t.split is not None
+                and int(t.split) % ndim_t == ax_t
+                and shp[ax_t] == out_shape[out_split]
+                and t.comm is comm
+            ):
+                phys = True
+            else:
+                return None
+        if not phys:
+            return None
+
+    # collect graph inputs (no materialization happens here)
+    args = []
+    for k, t in ops_in:
+        if k == "d":
+            inp = _input_of(t)
+            if inp is None:
+                return None
+            args.append(inp)
+        elif k == "s":
+            if operation in _STATIC_SCALAR_OPS and isinstance(
+                t, (builtins.int, np.integer)
+            ) and not isinstance(t, (builtins.bool, np.bool_)):
+                # jnp.power inspects a STATIC integer exponent at trace time
+                # and lowers to integer_pow (repeated squaring) — exactly what
+                # the eager dispatch does. Baked as a constant so the fused
+                # trace takes the same lowering; the value is part of the
+                # trace-cache key.
+                args.append(t)
+            else:
+                # a scalar enters the trace as a runtime ARGUMENT with the
+                # exact aval eager dispatch gives it (Python scalars
+                # weak-typed, np scalars strong) — never as a baked constant,
+                # which XLA would fold (x / 3.0 -> x * (1/3.0)) and break
+                # bit-for-bit parity with the op-at-a-time path
+                args.append(_Leaf(jnp.asarray(t)))
+        else:  # raw jnp array operand
+            if not _usable_leaf(t):
+                return None
+            args.append(_Leaf(t))
+
+    kwargs = tuple(sorted(fn_kwargs.items()))
+    cast = (np.dtype(promoted.jnp_type()), operation in _EQ_NE)
+    okey = ("binary", _op_key(operation), kwargs, (str(cast[0]), cast[1]))
+    try:
+        aval = _eval_node(operation, okey, args, kwargs, cast)
+    except Exception:
+        return None  # abstract eval rejected the combination: eager handles
+    node = _Node(operation, okey, tuple(args), kwargs, cast, aval)
+
+    if where is not None:
+        w_in = None
+        if isinstance(where, DNDarray):
+            if where.is_padded:
+                return None
+            w_in = _input_of(where)
+        elif isinstance(where, (builtins.bool, np.bool_)):
+            w_in = _Leaf(jnp.asarray(where))
+        else:
+            w = jnp.asarray(where)
+            if not _usable_leaf(w):
+                return None
+            w_in = _Leaf(w)
+        if w_in is None:
+            return None
+        node = _where_glue(w_in, node, out_shape)
+        if node is None:
+            return None
+
+    # expected physical layout of the result: the broadcast the trace
+    # computes must BE the canonical padded layout (eager parity — the
+    # eager result is either logical or canonically padded)
+    expected = tuple(out_shape)
+    if phys:
+        expected = comm.padded_shape(out_shape, out_split)
+    if tuple(node.aval.shape) != expected:
+        return None
+
+    res_dtype = canonical_heat_type(node.aval.dtype)
+    return _finish(node, tuple(out_shape), res_dtype, out_split, device, comm, "binary")
+
+
+def _where_fn_for(shape: Tuple[int, ...]):
+    """Canonical glue callable replaying the eager ``where=`` select
+    (``jnp.where(w, r, zeros(out_shape, r.dtype))``), memoized per shape so
+    node keys and eval caches see one object per shape."""
+    fn = _WHERE_FNS.get(shape)
+    if fn is None:
+        def fn(w, r, _shape=shape):
+            return jnp.where(w, r, jnp.zeros(_shape, dtype=r.dtype))
+
+        _WHERE_FNS[shape] = fn
+    return fn
+
+
+_WHERE_FNS: dict = {}
+
+
+def _where_glue(w_in, op_node: _Node, out_shape) -> Optional[_Node]:
+    shape = tuple(out_shape)
+    fn = _where_fn_for(shape)
+    okey = ("where_glue", shape)
+    args = (w_in, op_node)
+    try:
+        aval = _eval_node(fn, okey, args, (), None)
+    except Exception:
+        return None
+    return _Node(fn, okey, args, (), None, aval)
+
+
+def defer_local(operation, x: DNDarray, kwargs: dict, force_logical: bool) -> Optional[DNDarray]:
+    """Record one eager ``__local_op`` dispatch (elementwise unary on the
+    physical array). Returns the deferred result, or None to fall back."""
+    from .types import canonical_heat_type
+
+    if operation not in ELEMENTWISE_UNARY:
+        return None
+    if kwargs and not _static_kwargs(kwargs):
+        return None
+    if force_logical and x.is_padded:
+        return None
+    inp = _input_of(x)
+    if inp is None:
+        return None
+    kw = tuple(sorted(kwargs.items()))
+    okey = ("local", _op_key(operation), kw)
+    try:
+        aval = _eval_node(operation, okey, (inp,), kw, None)
+    except Exception:
+        return None
+    if tuple(aval.shape) != tuple(x.pshape):
+        return None  # shape-changing call (e.g. degenerate clip): eager handles
+    node = _Node(operation, okey, (inp,), kw, None, aval)
+    res_dtype = canonical_heat_type(aval.dtype)
+    return _finish(node, tuple(x.shape), res_dtype, x.split, x.device, x.comm, "local")
+
+
+def defer_where(cond: DNDarray, x, y) -> Optional[DNDarray]:
+    """Record a 3-argument ``ht.where`` select as one elementwise node
+    (operands may themselves be pending). Returns None to fall back."""
+    from .types import canonical_heat_type
+
+    args = []
+    for t in (cond, x, y):
+        if isinstance(t, DNDarray):
+            if t.is_padded:
+                return None
+            inp = _input_of(t)
+            if inp is None:
+                return None
+            args.append(inp)
+        elif isinstance(t, _SCALARS):
+            args.append(_Leaf(jnp.asarray(t)))  # runtime arg: see defer_binary
+        else:
+            a = jnp.asarray(t)
+            if not _usable_leaf(a):
+                return None
+            args.append(_Leaf(a))
+    okey = ("where", _op_key(jnp.where))
+    try:
+        aval = _eval_node(jnp.where, okey, tuple(args), (), None)
+    except Exception:
+        return None
+    split = cond.split
+    if split is not None and len(aval.shape) != cond.ndim:
+        split = None
+    node = _Node(jnp.where, okey, tuple(args), (), None, aval)
+    res_dtype = canonical_heat_type(aval.dtype)
+    return _finish(
+        node, tuple(aval.shape), res_dtype, split, cond.device, cond.comm, "where"
+    )
+
+
+def _cast_fn_for(np_dtype):
+    fn = _CAST_FNS.get(np_dtype)
+    if fn is None:
+        def fn(a, _dt=np_dtype):
+            return a.astype(_dt)
+
+        _CAST_FNS[np_dtype] = fn
+    return fn
+
+
+_CAST_FNS: dict = {}
+
+
+def defer_cast(x: DNDarray, heat_dtype) -> Optional[DNDarray]:
+    """Record ``astype`` glue (``x.parray.astype(dtype)``) as a graph node so
+    a cast inside a chain fuses instead of materializing. None = fall back."""
+    dt = np.dtype(heat_dtype.jnp_type())
+    inp = _input_of(x)
+    if inp is None:
+        return None
+    fn = _cast_fn_for(dt)
+    okey = ("cast", str(dt))
+    aval = jax.ShapeDtypeStruct(tuple(x.pshape), dt)
+    node = _Node(fn, okey, (inp,), (), None, aval)
+    return _finish(node, tuple(x.shape), heat_dtype, x.split, x.device, x.comm, "cast")
+
+
+# ------------------------------------------------------------------ flush
+_TRACE_CACHE: "collections.OrderedDict" = collections.OrderedDict()
+_cache_stats = {"hits": 0, "misses": 0, "evictions": 0}
+
+
+def cache_info() -> dict:
+    """Trace-cache statistics (entries/hits/misses/evictions)."""
+    return {"entries": len(_TRACE_CACHE), **_cache_stats}
+
+
+def clear_cache() -> None:
+    """Drop every cached fused executable (kept traces are re-built lazily)."""
+    _TRACE_CACHE.clear()
+
+
+def _topo(root: _Node):
+    """Post-order of the pending (value-less) subgraph under ``root``."""
+    order, seen = [], set()
+    stack = [(root, False)]
+    while stack:
+        node, done = stack.pop()
+        if done:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for a in node.args:
+            if isinstance(a, _Node) and a.value is None and id(a) not in seen:
+                stack.append((a, False))
+    return order
+
+
+def _donatable(arr, owner_ref, out_aval) -> bool:
+    """A leaf buffer may be donated to the fused call iff its owning DNDarray
+    is dead, nothing else references the buffer (strict refcount bound), the
+    backend actually implements donation, and the buffer aliases the output
+    (same shape/dtype) so XLA can reuse it in place. The caller additionally
+    verifies the flushed subgraph is *private* — no node in it is referenced
+    by another live pending graph that could replay from the same leaves."""
+    if owner_ref is not None and owner_ref() is not None:
+        return False
+    if tuple(arr.shape) != tuple(out_aval.shape) or arr.dtype != out_aval.dtype:
+        return False
+    try:
+        platform = next(iter(arr.devices())).platform
+    except Exception:
+        return False
+    if platform not in ("tpu", "gpu", "cuda", "rocm"):
+        return False
+    # exactly: leaf_arrays slot + the _Leaf.array slot + the caller's local +
+    # getrefcount's argument = 4. One more means another live reference — a
+    # second graph's leaf, a user-held .larray, a node.value field — and the
+    # buffer must survive this call.
+    return sys.getrefcount(arr) <= 4
+
+
+def materialize_for(d: DNDarray):
+    """Flush the pending subgraph behind ``d`` through one fused, cached,
+    jitted kernel and return the canonical (placed) physical array."""
+    from .communication import MeshCommunication
+
+    root = d._expr()
+    if root is None:  # pragma: no cover — callers check
+        raise RuntimeError("materialize_for() on a concrete DNDarray")
+    if root.value is not None:
+        return root.value
+
+    topo = _topo(root)
+    index_of = {id(n): i for i, n in enumerate(topo)}
+
+    leaf_ids: dict = {}
+    leaf_arrays: list = []
+    leaf_owners: list = []
+
+    def leaf_index(arr, owner):
+        key = id(arr)
+        i = leaf_ids.get(key)
+        if i is None:
+            i = len(leaf_arrays)
+            leaf_ids[key] = i
+            leaf_arrays.append(arr)
+            leaf_owners.append(owner)
+        return i
+
+    program = []  # (fn, specs, kwargs, cast) per node, positional
+    key_prog = []
+    internal_rc: dict = {}
+    for n in topo:
+        specs = []
+        key_specs = []
+        for a in n.args:
+            if isinstance(a, _Node):
+                if a.value is not None:
+                    i = leaf_index(a.value, a.owner)
+                    specs.append(("l", i))
+                    key_specs.append(("l", i))
+                else:
+                    internal_rc[id(a)] = internal_rc.get(id(a), 0) + 1
+                    specs.append(("n", index_of[id(a)]))
+                    key_specs.append(("n", index_of[id(a)]))
+            elif isinstance(a, _Leaf):
+                i = leaf_index(a.array, a.owner)
+                specs.append(("l", i))
+                key_specs.append(("l", i))
+            else:
+                specs.append(("c", a))
+                key_specs.append(_const_key(a))
+        program.append((n.fn, tuple(specs), dict(n.kwargs), n.cast))
+        cast_key = None if n.cast is None else (str(n.cast[0]), n.cast[1])
+        key_prog.append((n.op_key, tuple(key_specs), n.kwargs, cast_key))
+
+    out_aval = root.aval
+    donate = ()
+    if _donate_enabled():
+        # donation is only safe when this subgraph is private: every non-root
+        # node's recorded parents all sit inside the subgraph, so no other
+        # live pending graph can ever replay these nodes from their leaves
+        private = all(
+            n is root or n.rc == internal_rc.get(id(n), 0) for n in topo
+        )
+        if private:
+            donate_idx = []
+            for i in range(len(leaf_arrays)):
+                arr = leaf_arrays[i]
+                if _donatable(arr, leaf_owners[i], out_aval):
+                    donate_idx.append(i)
+                del arr
+            donate = tuple(donate_idx)
+
+    leaf_key = tuple(
+        (
+            tuple(a.shape),
+            str(a.dtype),
+            bool(getattr(a, "weak_type", False)),
+            getattr(a, "sharding", None),
+        )
+        for a in leaf_arrays
+    )
+    try:
+        key = (tuple(key_prog), leaf_key, donate)
+        fused = _TRACE_CACHE.get(key)
+    except TypeError:  # unhashable sharding — compile uncached
+        key, fused = None, None
+
+    compiled = fused is None
+    if fused is None:
+        prog = tuple(program)
+
+        def replay(*leaves):
+            vals = []
+            for fn, specs, kw, cast in prog:
+                args = [
+                    vals[i] if tag == "n" else (leaves[i] if tag == "l" else i)
+                    for tag, i in specs
+                ]
+                vals.append(_apply(fn, args, kw, cast))
+            return vals[-1]
+
+        fused = jax.jit(replay, donate_argnums=donate)
+        if key is not None:
+            _TRACE_CACHE[key] = fused
+            _cache_stats["misses"] += 1
+            limit = _cache_max()
+            while len(_TRACE_CACHE) > limit:
+                _TRACE_CACHE.popitem(last=False)
+                _cache_stats["evictions"] += 1
+    else:
+        _TRACE_CACHE.move_to_end(key)
+        _cache_stats["hits"] += 1
+
+    if _MON.enabled:
+        _instr.fusion_flush(len(topo), cache_hit=not compiled, compiled=compiled)
+
+    value = fused(*leaf_arrays)
+
+    # canonical placement — the step DNDarray.__init__ applies to every eager
+    # intermediate, applied once per fused chain here
+    split = d.split
+    comm = d.comm
+    if (
+        split is not None
+        and isinstance(comm, MeshCommunication)
+        and comm.is_distributed()
+    ):
+        value = comm.placed(value, split, d.shape)
+    root.value = value
+    return value

@@ -12,6 +12,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from . import _operations
+from . import fusion
 from . import sanitation
 from .dndarray import DNDarray
 from . import types
@@ -42,6 +43,12 @@ def where(cond, x=None, y=None) -> DNDarray:
     if x is None or y is None:
         raise TypeError("either both or neither of x and y must be given")
     sanitation.sanitize_in(cond)
+    # deferred-execution fast path: a 3-argument select is elementwise glue
+    # and fuses into the pending expression DAG (core/fusion.py)
+    if fusion.enabled():
+        deferred = fusion.defer_where(cond, x, y)
+        if deferred is not None:
+            return deferred
     xv = x.larray if isinstance(x, DNDarray) else x
     yv = y.larray if isinstance(y, DNDarray) else y
     res = jnp.where(cond.larray, xv, yv)

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from . import blocked
+from .._compat import shard_map as _shard_map
 
 GEMM_PRECISION = jax.lax.Precision.HIGHEST
 
@@ -130,7 +131,7 @@ def _build_panel_det_cached(mesh, axis_name: str, p: int, m: int, dtype_name: st
 
     spec = P(axis_name, None)
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             local, mesh=mesh, in_specs=spec, out_specs=(P(), P(), P()), check_vma=False
         )
     )
@@ -273,7 +274,7 @@ def _build_panel_solve_cached(mesh, axis_name: str, p: int, m: int, k: int, dtyp
 
     spec = P(axis_name, None)
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             local, mesh=mesh, in_specs=(spec, spec), out_specs=(spec, P()), check_vma=False
         )
     )
@@ -299,7 +300,7 @@ def _build_panel_inv_cached(mesh, axis_name: str, p: int, m: int, dtype_name: st
 
     spec = P(axis_name, None)
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             local, mesh=mesh, in_specs=spec, out_specs=(spec, P()), check_vma=False
         )
     )

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from . import blocked
+from .._compat import shard_map as _shard_map
 from .. import sanitation
 from .. import types
 from ..communication import MeshCommunication
@@ -109,7 +110,7 @@ def __build_bcgs_cached(mesh, axis: str, p: int, m: int, n: int, jdtype: str, us
         return q_f, r_f
 
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             local,
             mesh=mesh,
             in_specs=P(None, axis),
@@ -151,7 +152,7 @@ def _build_tsqr_cached(mesh, axis: str, p: int, use_blocked: bool):
         return q, r
 
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             local,
             mesh=mesh,
             in_specs=P(axis, None),

@@ -21,6 +21,7 @@ from . import factories
 from . import sanitation
 from . import stride_tricks
 from . import types
+from ._compat import shard_map as _shard_map
 from .communication import MeshCommunication
 from .dndarray import DNDarray
 
@@ -633,7 +634,7 @@ def unique(a: DNDarray, sorted: bool = False, return_inverse: bool = False, axis
             return jnp.sort(masked), count.astype(jnp.int32).reshape(1)
 
         fn = jax.jit(
-            jax.shard_map(
+            _shard_map(
                 local, mesh=comm.mesh, in_specs=_P(comm.axis_name),
                 out_specs=(_P(comm.axis_name), _P(comm.axis_name)), check_vma=False,
             )

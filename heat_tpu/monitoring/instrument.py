@@ -42,6 +42,9 @@ __all__ = [
     "resharding",
     "placement",
     "collective",
+    "fusion_defer",
+    "fusion_flush",
+    "fusion_elided_write",
     "record_io",
     "step_event",
     "sample_memory",
@@ -104,6 +107,30 @@ def placement() -> None:
 def collective(kind: str) -> None:
     """One explicit collective shim invocation (allreduce/allgather/…)."""
     REGISTRY.counter("comm.collective").inc(label=kind)
+
+
+def fusion_defer(kind: str) -> None:
+    """One elementwise op recorded in the deferred-execution DAG instead of
+    dispatched eagerly (kind: binary/local/where/cast)."""
+    REGISTRY.counter("fusion.ops_deferred").inc(label=kind)
+
+
+def fusion_flush(chain_len: int, cache_hit: bool, compiled: bool) -> None:
+    """One pending-expression flush through a fused jitted kernel: flush
+    count, trace-cache hit/compile split, and the chain-length histogram
+    (how many ops each fused kernel absorbed)."""
+    REGISTRY.counter("fusion.flushes").inc()
+    if cache_hit:
+        REGISTRY.counter("fusion.cache_hits").inc()
+    if compiled:
+        REGISTRY.counter("fusion.kernels_compiled").inc()
+    REGISTRY.histogram("fusion.chain_length").observe(chain_len)
+
+
+def fusion_elided_write() -> None:
+    """One unflushed expression dropped by an overwrite (``out=`` aliasing):
+    deferred work that never had to execute."""
+    REGISTRY.counter("fusion.elided_writes").inc()
 
 
 def record_io(op: str, path: str, nbytes: int, seconds: float) -> None:

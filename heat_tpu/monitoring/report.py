@@ -35,7 +35,17 @@ def _span_summary() -> Dict[str, dict]:
 
 
 def snapshot() -> dict:
-    """Full observability snapshot as a plain (JSON-serialisable) dict."""
+    """Full observability snapshot as a plain (JSON-serialisable) dict.
+
+    Exporting is a materialization barrier for the deferred-execution engine:
+    pending fused chains are flushed first, so the ``fusion.*`` (and
+    ``jit.*``) counters account for every recorded op."""
+    try:
+        from ..core import fusion as _fusion
+
+        _fusion.flush_pending()
+    except Exception:  # core not importable / partially initialized: export anyway
+        pass
     _instrument.sample_memory()
     return {
         "metrics": REGISTRY.snapshot(),

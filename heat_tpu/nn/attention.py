@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..core._compat import shard_map as _shard_map
 from ..core.communication import MeshCommunication, sanitize_comm
 from ..core.dndarray import DNDarray
 from ..core import types
@@ -182,7 +183,7 @@ def ring_attention(
     ):
         return scaled_dot_product_attention(q, k, v, causal=causal, scale=scale)
     axis = comm.axis_name
-    fn = jax.shard_map(
+    fn = _shard_map(
         _ring_attention_sharded(axis, comm.size, causal, scale),
         mesh=comm.mesh,
         in_specs=(P(None, axis), P(None, axis), P(None, axis)),
@@ -235,7 +236,7 @@ def ulysses_attention(
         )
         return to_seq(o)
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         body,
         mesh=comm.mesh,
         in_specs=(P(None, axis), P(None, axis), P(None, axis)),

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core._compat import shard_map as _shard_map
 from ..core.communication import MeshCommunication, sanitize_comm
 from ..monitoring import instrument as _instr
 from ..monitoring.registry import REGISTRY as _REG, STATE as _MON
@@ -227,7 +228,7 @@ class DASO:
         sspec = jax.tree.map(lambda _: P("node"), self.opt_state)
 
         step = jax.jit(
-            jax.shard_map(
+            _shard_map(
                 local_block,
                 mesh=mesh,
                 in_specs=(pspec, sspec, P(("node", "local")), P(("node", "local"))),
@@ -251,7 +252,7 @@ class DASO:
             return jax.tree.map(lambda a: a[None], p2)
 
         gmean = jax.jit(
-            jax.shard_map(
+            _shard_map(
                 global_block, mesh=mesh, in_specs=(pspec,), out_specs=pspec, check_vma=False
             )
         )

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..core import types
+from ..core._compat import shard_map as _shard_map
 from ..core.communication import MeshCommunication
 from ..core.dndarray import DNDarray
 from ..core import sanitation
@@ -232,7 +233,7 @@ def _build_ring(metric: Callable, margs: tuple, mesh, axis: str, p: int) -> Call
         return jnp.concatenate(jnp.split(tiles.reshape(p * tiles.shape[1], -1), p, axis=0), axis=1)
 
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             ring,
             mesh=mesh,
             in_specs=(P(axis, None), P(axis, None)),
@@ -272,7 +273,7 @@ def _build_ring_symmetric(metric: Callable, margs: tuple, mesh, axis: str, p: in
         return jnp.concatenate(jnp.split(out.reshape(p * bm, -1), p, axis=0), axis=1)
 
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             ring, mesh=mesh, in_specs=P(axis, None), out_specs=P(axis, None), check_vma=False
         )
     )

@@ -4,6 +4,7 @@ import numpy as np
 import pytest
 
 import heat_tpu as ht
+from heat_tpu.core import _compat
 import heat_tpu.testing as htt
 
 SPLITS = [None, 0, 1]
@@ -71,6 +72,29 @@ def test_linspace_logspace():
         ht.linspace(0, 1, -1)
 
 
+def test_linspace_retstep_numpy_exact():
+    # step must match np.linspace exactly across the degenerate edges:
+    # nan for num=0 (both endpoints) and num=1 with endpoint=True; delta for
+    # num=1 with endpoint=False (the old (stop-start)/max(1, num-endpoint)
+    # formula returned delta for all of these — see PARITY.md history)
+    for num in (0, 1, 2, 7):
+        for ep in (True, False):
+            n_val, n_step = np.linspace(2.0, 10.0, num=num, endpoint=ep, retstep=True)
+            h_val, h_step = ht.linspace(2.0, 10.0, num=num, endpoint=ep, retstep=True)
+            assert (np.isnan(n_step) and np.isnan(h_step)) or n_step == h_step, (num, ep)
+            np.testing.assert_allclose(h_val.numpy(), n_val.astype(np.float32), rtol=1e-6)
+
+
+@pytest.mark.parametrize("split", [None, 0])
+def test_logspace_num_edges(split):
+    # logspace inherits linspace's empty/one-point edges through its build
+    for num in (0, 1, 5):
+        n_val = np.logspace(0.0, 3.0, num=num)
+        h = ht.logspace(0.0, 3.0, num=num, split=split)
+        assert h.shape == (num,)
+        np.testing.assert_allclose(h.numpy(), n_val.astype(np.float32), rtol=1e-5)
+
+
 @pytest.mark.parametrize("split", [None, 0])
 def test_eye(split):
     e = ht.eye(6, split=split)
@@ -107,7 +131,7 @@ def test_empty():
     import jax
 
     # f64 runs under real x64 — no silent truncation on the default suite
-    with jax.enable_x64(True):
+    with _compat.enable_x64(True):
         e = ht.empty((2, 3), dtype=ht.float64)
         assert e.shape == (2, 3)
         assert e.larray.dtype == np.float64

@@ -39,6 +39,7 @@ import pytest
 import scipy.stats as sps
 
 import jax
+from heat_tpu.core import _compat
 
 import heat_tpu as ht
 import heat_tpu.testing as htt
@@ -1217,7 +1218,7 @@ def _promote(rng, h, a):
 
 def _result_type(rng, h, a):
     assert ht.result_type(ht.int32, ht.float32) is ht.float32
-    with jax.enable_x64(True):
+    with _compat.enable_x64(True):
         assert ht.result_type(ht.float32, ht.float64) is ht.float64
     return None, None
 
@@ -1381,7 +1382,7 @@ def run_case(name, i):
         letter = letters[i % len(letters)]
     if letter == "c" and not COMPLEX_SUPPORTED:
         letter = "f" if "f" in letters else letters[0]
-    ctx = jax.enable_x64(True) if x64 else None
+    ctx = _compat.enable_x64(True) if x64 else None
     msg = f"surface fuzz op={name} case={i} dtype={letter} x64={x64}"
     try:
         if ctx is not None:

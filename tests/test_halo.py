@@ -10,6 +10,7 @@ import numpy as np
 import pytest
 
 import jax
+from heat_tpu.core import _compat
 
 import heat_tpu as ht
 from heat_tpu.core.communication import MeshCommunication
@@ -147,7 +148,7 @@ def test_halo_exchange_is_collective_permute():
         return out
 
     t = (
-        jax.jit(jax.shard_map(ex, mesh=comm.mesh, in_specs=P(comm.axis_name),
+        jax.jit(_compat.shard_map(ex, mesh=comm.mesh, in_specs=P(comm.axis_name),
                               out_specs=P(comm.axis_name), check_vma=False))
         .lower(x.parray)
         .compile()

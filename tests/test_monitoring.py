@@ -17,9 +17,9 @@ from heat_tpu import monitoring
 from heat_tpu.monitoring import events, instrument, registry, report
 from heat_tpu.core.communication import get_comm
 
-# the collective shims compile shard_map programs; older jax builds without
-# jax.shard_map cannot run them (the whole collectives suite skips there too)
-_HAS_SHARD_MAP = hasattr(jax, "shard_map")
+# the collective shims compile shard_map programs through the version-compat
+# wrapper (heat_tpu/core/_compat.py), available on every supported jax
+_HAS_SHARD_MAP = True
 
 
 @pytest.fixture(autouse=True)

@@ -10,6 +10,7 @@ import numpy as np
 import pytest
 
 import jax
+from heat_tpu.core import _compat
 
 import heat_tpu as ht
 from heat_tpu.core.communication import MeshCommunication
@@ -53,7 +54,7 @@ def test_astype_matrix(split, ht_t, np_t):
     # the 64-bit slices run under real x64 (VERDICT r3 weak #4: without this
     # they silently truncated to 32 bits and tested f32 twice)
     ctx = (
-        jax.enable_x64(True)
+        _compat.enable_x64(True)
         if ht_t in (ht.float64, ht.int64)
         else contextlib.nullcontext()
     )

@@ -12,7 +12,10 @@ import os
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
+
+from heat_tpu.core import _compat
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -93,7 +96,7 @@ def test_pipeline_ppermute_stage_chain():
         return out
 
     f = jax.jit(
-        jax.shard_map(local, mesh=mesh, in_specs=(P(), P("pp")), out_specs=P(),
+        _compat.shard_map(local, mesh=mesh, in_specs=(P(), P("pp")), out_specs=P(),
                       check_vma=False)
     )
     x = jnp.ones((4,), jnp.float32)

@@ -5,6 +5,7 @@ import numpy as np
 import pytest
 
 import heat_tpu as ht
+from heat_tpu.core import _compat
 
 
 def test_seed_reproducibility():
@@ -40,7 +41,7 @@ def test_rand_range_dtype():
     assert float(a.max().larray) < 1.0
     import jax
 
-    with jax.enable_x64(True):  # the f64 draw path, genuinely 64-bit
+    with _compat.enable_x64(True):  # the f64 draw path, genuinely 64-bit
         b = ht.random.rand(5, 5, dtype=ht.float64)
         assert b.shape == (5, 5)
         assert b.larray.dtype == np.float64

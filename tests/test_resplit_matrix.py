@@ -27,7 +27,11 @@ def test_resplit_matrix(shape, src, dst):
     if dst is not None and get_comm().is_distributed():
         # genuinely sharded: one shard per device, extent = ceil(n/p) on dst
         p = get_comm().size
-        shards = {s.index for s in r.parray.addressable_shards}
+        # slices are unhashable before Python 3.12: set-ify a plain triple
+        shards = {
+            tuple((sl.start, sl.stop, sl.step) for sl in s.index)
+            for s in r.parray.addressable_shards
+        }
         assert len(shards) == p
         c = -(-shape[dst] // p)
         for s in r.parray.addressable_shards:

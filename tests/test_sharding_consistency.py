@@ -14,6 +14,7 @@ import numpy as np
 import pytest
 
 import jax
+from heat_tpu.core import _compat
 
 import heat_tpu as ht
 from heat_tpu.spatial import cdist
@@ -94,7 +95,7 @@ def test_elementwise_and_binary(b):
         assert_consistent(r, label)
     import jax
 
-    with jax.enable_x64(True):  # the f64 cast, genuinely 64-bit
+    with _compat.enable_x64(True):  # the f64 cast, genuinely 64-bit
         assert_consistent(ht.float64(b), "cast f64")
 
 

@@ -4,6 +4,7 @@ import numpy as np
 import pytest
 
 import heat_tpu as ht
+from heat_tpu.core import _compat
 from _accel import requires_complex
 from heat_tpu.core import types
 
@@ -165,7 +166,7 @@ def test_promote_types_matches_jax_table_exhaustively():
     import jax
     import jax.numpy as jnp
 
-    with jax.enable_x64(True):
+    with _compat.enable_x64(True):
         for a in TYPE_NAMES:
             for b in TYPE_NAMES:
                 got = types.promote_types(getattr(ht, a), getattr(ht, b))
@@ -181,7 +182,7 @@ def test_promotion_divergence_from_numpy_is_the_torch_jax_class():
     Every OTHER pair agrees with numpy. Pin both facts so neither drifts."""
     import jax
 
-    with jax.enable_x64(True):
+    with _compat.enable_x64(True):
         diverged = []
         for a in TYPE_NAMES:
             if a == "bfloat16":

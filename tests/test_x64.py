@@ -1,6 +1,6 @@
 """
 Real 64-bit coverage (VERDICT r3 weak #4 / #6): every test here runs inside
-``jax.enable_x64(True)`` so f64/i64/c128 are *genuinely* 64-bit — results are
+``_compat.enable_x64(True)`` so f64/i64/c128 are *genuinely* 64-bit — results are
 asserted at precisions/magnitudes a silently-truncated 32-bit run cannot
 reach, which makes the tests self-proving (a truncation would fail them, not
 quietly pass). Mirrors the reference's f64 default coverage
@@ -11,6 +11,7 @@ import numpy as np
 import pytest
 
 import jax
+from heat_tpu.core import _compat
 
 import heat_tpu as ht
 
@@ -19,7 +20,7 @@ from _accel import requires_native_f64
 
 @pytest.fixture(autouse=True)
 def _x64():
-    with jax.enable_x64(True):
+    with _compat.enable_x64(True):
         yield
 
 
