@@ -688,7 +688,10 @@ def main():
         # deferred-execution fusion anchors (ISSUE 3): effective GB/s of an
         # 8-op elementwise chain through the fused path, the same-process
         # HEAT_TPU_FUSION=0 eager baseline, and their ratio (fusion_speedup),
-        # plus the dispatch-layer ops/sec on a tiny operand
+        # plus the dispatch-layer ops/sec on a tiny operand; ISSUE 4 adds the
+        # reduction-sink anchors (fused_reduction_gbps — chain+sum as ONE
+        # kernel at the single-read floor — and reduction_sink_speedup vs the
+        # same-process HEAT_TPU_FUSION_SINKS=0 baseline)
         elemwise = {}
         if os.environ.get("BENCH_FAST") != "1":
             try:
@@ -704,6 +707,8 @@ def main():
                     "elementwise_chain_valid": None,
                     "dispatch_valid": None,
                     "fusion_speedup": None,
+                    "fused_reduction_valid": None,
+                    "reduction_sink_speedup": None,
                     "elementwise_error": repr(e)[:160],
                 }
         # out-of-core input pipeline (VERDICT r4 #8): native prefetcher vs h5py

@@ -20,6 +20,13 @@ paired-differencing and physics gating as every other bench surface
   pure dispatch-layer overhead (expression recording, trace-cache hits, jit
   call machinery). Reported for the fused path with the eager ops/sec beside
   it; ungated (there is no hardware roofline on Python dispatch).
+* ``fused_reduction_gbps`` (ISSUE 4) — the same 8-op f32 chain terminated by
+  ``ht.sum``, executed through the reduction-sink path (ONE kernel: read N·4
+  bytes, emit a scalar — the single-read floor) vs the same-process
+  ``HEAT_TPU_FUSION_SINKS=0`` baseline (chain kernel read+write, then a
+  standalone reduce read: 3·N·4 bytes). ``reduction_sink_speedup`` is the
+  ratio of the two gated medians; the sink pairs are gated at 1.05× the HBM
+  roofline through the N·4 bytes/step floor.
 
 Run: python benchmarks/elementwise_bench.py
 """
@@ -93,12 +100,80 @@ def _rate(ht, base, fused, bytes_per_step, ceiling_gbps, long_seconds=0.6):
     return float(np.median(valid)), _spread_pct(valid), total, discarded
 
 
+def _make_reduce_run(ht, base, sinks: bool):
+    """One timed leg of the reduction-sink anchor: ``steps`` × (8-op chain →
+    ``sum`` → host scalar). The scalar fetch is the flush barrier, so the
+    clock stops only when the reduction's value lands on the host. With sinks
+    off the chain flushes (read+write 64 MB) before a standalone reduce reads
+    it back; with sinks on ONE kernel reads the operand once."""
+
+    def run(steps, eps):
+        os.environ["HEAT_TPU_FUSION"] = "1"
+        os.environ["HEAT_TPU_FUSION_SINKS"] = "1" if sinks else "0"
+        x = base * np.float32(_perturb(eps, 2.0**-18))
+        np.asarray(x.larray)  # perturbation lands before the clock starts
+        acc = 0.0
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            acc += float(_chain(ht, x).sum())
+        return time.perf_counter() - t0
+
+    return run
+
+
+def _reduce_rate(ht, base, sinks, bytes_per_step, ceiling_gbps):
+    run = _make_reduce_run(ht, base, sinks)
+    run(1, 0.0)  # compile + warm
+    calib = 2.0 / max(run(2, 1e-7), 1e-9)
+    valid, total, discarded = _gated_rates(
+        run, calib, bytes_per_step, ceiling_gbps, long_seconds=0.6
+    )
+    if not valid:
+        return None, 0.0, total, discarded
+    return float(np.median(valid)), _spread_pct(valid), total, discarded
+
+
+def bench_fused_reduction(ht, roofline, rng):
+    """Gated ``fused_reduction_gbps`` + ``reduction_sink_speedup`` anchors
+    (ISSUE 4 acceptance): 8-op f32 chain → sum over 64 MB, sink vs the
+    same-process ``HEAT_TPU_FUSION_SINKS=0`` baseline."""
+    out = {}
+    base = ht.array(rng.random(N_LARGE, dtype=np.float32))
+    sink_bytes = N_LARGE * 4  # single fused kernel: one read, scalar out
+    nosink_bytes = 3 * N_LARGE * 4  # chain read+write, reduce read
+
+    s_rate, s_jit, s_tot, s_disc = _reduce_rate(ht, base, True, sink_bytes, roofline)
+    n_rate, _, _, _ = _reduce_rate(ht, base, False, nosink_bytes, roofline)
+
+    if s_rate is not None:
+        gbps = sink_bytes * s_rate / 1e9
+        out["fused_reduction_gbps"] = round(gbps, 1)
+        out["fused_reduction_roofline_pct"] = (
+            round(100.0 * gbps / roofline, 1) if roofline else None
+        )
+        out["fused_reduction_jitter_pct"] = round(s_jit, 2)
+        out["fused_reduction_valid"] = bool(
+            s_tot - s_disc >= MIN_VALID and s_jit < 10.0
+        )
+    else:
+        out["fused_reduction_valid"] = False
+    if n_rate is not None:
+        out["fused_reduction_nosink_gbps"] = round(nosink_bytes * n_rate / 1e9, 1)
+    if s_rate is not None and n_rate is not None:
+        # both legs run the SAME logical chain+sum in the same process; the
+        # gated-median rate ratio IS the wall-clock speedup of sinking the
+        # reduction into the chain kernel
+        out["reduction_sink_speedup"] = round(s_rate / n_rate, 2)
+    return out
+
+
 def bench_elementwise():
     import jax
 
     import heat_tpu as ht
 
     prev = os.environ.get("HEAT_TPU_FUSION")
+    prev_sinks = os.environ.get("HEAT_TPU_FUSION_SINKS")
     dev = jax.devices()[0]
     roofline = _lookup(dev, HBM_ROOFLINES_GBPS)
     rng = np.random.default_rng(5)
@@ -132,6 +207,8 @@ def bench_elementwise():
             # gated-median rate ratio IS the wall-clock speedup
             out["fusion_speedup"] = round(f_rate / e_rate, 2)
 
+        out.update(bench_fused_reduction(ht, roofline, rng))
+
         small = ht.array(rng.random(N_SMALL, dtype=np.float32))
         df_rate, df_jit, df_tot, df_disc = _rate(
             ht, small, True, 1, None, long_seconds=0.4
@@ -149,6 +226,10 @@ def bench_elementwise():
             os.environ.pop("HEAT_TPU_FUSION", None)
         else:
             os.environ["HEAT_TPU_FUSION"] = prev
+        if prev_sinks is None:
+            os.environ.pop("HEAT_TPU_FUSION_SINKS", None)
+        else:
+            os.environ["HEAT_TPU_FUSION_SINKS"] = prev_sinks
     return out
 
 

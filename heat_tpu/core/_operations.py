@@ -160,7 +160,13 @@ def __binary_op(
         if deferred is not None:
             return deferred
 
-    arrays = [t.larray if k == "d" else t for k, t in ops_in]
+    if out is not None:
+        # an out= buffer forces eager execution: pending operands flush here
+        # (and a pending out is later overwritten — its dead graph is dropped)
+        with _fusion.flush_reason("out-alias"):
+            arrays = [t.larray if k == "d" else t for k, t in ops_in]
+    else:
+        arrays = [t.larray if k == "d" else t for k, t in ops_in]
 
     # Ragged fast path: when an operand carries a padded split axis, compute on the
     # sharded physical arrays instead of gathering the logical views — garbage in the
@@ -270,7 +276,12 @@ def __local_op(
             return out
         return DNDarray(result, gshape, res_dtype, x.split, x.device, x.comm, True)
     # compute on the physical array: elementwise ops keep the pad in the pad region
-    result = operation(x.parray, **kwargs)
+    if out is not None:
+        with _fusion.flush_reason("out-alias"):
+            operand = x.parray
+    else:
+        operand = x.parray
+    result = operation(operand, **kwargs)
     if tuple(result.shape) == tuple(x.parray.shape):
         gshape = x.shape
     elif x.is_padded:
@@ -328,19 +339,98 @@ def __reduce_op(
         else:
             split = xsplit
 
+    # the logical result shape (the physical one may carry the pad through)
+    if axis is None:
+        out_gshape = tuple(1 for _ in x.shape) if keepdims else ()
+    elif keepdims:
+        out_gshape = tuple(1 if d in axes else s for d, s in enumerate(x.shape))
+    else:
+        out_gshape = tuple(s for d, s in enumerate(x.shape) if d not in axes)
+
+    # normalize a where= mask once for both paths: DNDarray masks become the
+    # logical jnp array, and the whole reduction computes on the logical view
+    # (the mask's extent is logical — a physical-pad position has no mask bit)
+    where_arr = None
+    w = kwargs.get("where")
+    if w is not None and not isinstance(w, (builtins.bool, np.bool_)):
+        kwargs = dict(kwargs)
+        with _fusion.flush_reason("reduction"):
+            where_arr = w.larray if isinstance(w, DNDarray) else jnp.asarray(w)
+        kwargs["where"] = where_arr
+
+    # --- reduction-sink fast path (core/fusion.py): a pending fused chain on
+    # the operand is consumed in-register — the elementwise subgraph, the pad
+    # handling, the reduction, and the sharded cross-device combine trace as
+    # ONE jitted kernel instead of flushing the intermediate to HBM and
+    # streaming it back in. HEAT_TPU_FUSION_SINKS=0 (or any non-sinkable
+    # combination) falls through to the unchanged flushing path below.
+    if out is None and _fusion.sink_ready(x):
+        pre = ()
+        sinkable = True
+        expected_pshape = out_gshape
+        dt_np = np.dtype(x.dtype.jnp_type())
+        # ml_dtypes floats (bfloat16) report numpy kind 'V': test via issubdtype
+        if dt_np.itemsize < 4 and jnp.issubdtype(dt_np, jnp.floating) and partial_op not in (
+            jnp.max, jnp.min, jnp.nanmax, jnp.nanmin, jnp.any, jnp.all, jnp.count_nonzero,
+        ):
+            # sub-32-bit floats: eager rounds to bf16/f16 after every op, but a
+            # fused producer feeding the reduce's f32-upcast accumulator legally
+            # skips the final narrow rounding (XLA excess precision — verified on
+            # this backend). Order-preserving reduces (rounding is monotone, so
+            # the selected extremum's rounded value is identical) and boolean
+            # tests stay sinkable; arithmetic accumulations flush for parity.
+            sinkable = False
+        if x.is_padded:
+            n_log = int(x.shape[xsplit])
+            if where_arr is not None:
+                # the eager path computes on the sliced logical view; an
+                # in-trace slice would reassociate the ragged shards' partial
+                # sums (see fusion.defer_moment) — flush instead
+                sinkable = False
+            elif split_reduced:
+                neutral_fill = (
+                    None
+                    if partial_op in (jnp.argmax, jnp.argmin) and axis is None
+                    else __neutral_for(partial_op, x.dtype.jnp_type())
+                )
+                if neutral_fill is not None:
+                    # in-trace x.filled(neutral): bit-exact vs the eager fill
+                    # (the canonical pad content never reaches the combine)
+                    pre = (("fill", xsplit, n_log, neutral_fill),)
+                else:
+                    sinkable = False  # eager uses the logical view: flush
+            else:
+                # physical pass-through: the surviving split axis keeps its pad
+                expected_pshape = x.comm.padded_shape(out_gshape, split)
+        if sinkable:
+            nanfix = (
+                partial_op in (jnp.max, jnp.min)
+                and np.dtype(x.dtype.jnp_type()).kind in "fc"
+                and split_reduced
+            )
+            deferred = _fusion.defer_reduce(
+                x, partial_op, axis, keepdims, kwargs, pre, nanfix,
+                out_gshape, split, expected_pshape,
+            )
+            if deferred is not None:
+                return deferred
+
     # pad handling: a reduction across the split axis must not see the pad — fill it
     # with the op's neutral element (reference neutral-element fill for empty chunks,
     # _operations.py:414-425); reductions over other axes keep the pad in the pad
     # region of the (still padded, still sharded) result
-    if x.is_padded and split_reduced:
-        if partial_op in (jnp.argmax, jnp.argmin) and axis is None:
-            # flattened arg-reductions return flat indices: those must be logical
-            operand = x.larray
+    with _fusion.flush_reason("reduction"):
+        if x.is_padded and where_arr is not None:
+            operand = x.larray  # logical mask extent -> logical operand
+        elif x.is_padded and split_reduced:
+            if partial_op in (jnp.argmax, jnp.argmin) and axis is None:
+                # flattened arg-reductions return flat indices: those must be logical
+                operand = x.larray
+            else:
+                neutral = __neutral_for(partial_op, x.dtype.jnp_type())
+                operand = x.filled(neutral) if neutral is not None else x.larray
         else:
-            neutral = __neutral_for(partial_op, x.dtype.jnp_type())
-            operand = x.filled(neutral) if neutral is not None else x.larray
-    else:
-        operand = x.parray
+            operand = x.parray
     result = partial_op(operand, axis=axis, keepdims=keepdims, **kwargs)
     result = jnp.asarray(result)
     if (
@@ -354,14 +444,6 @@ def __reduce_op(
         # only; the pad fill is +-inf, never NaN, so the pad cannot poison it)
         hasnan = jnp.any(jnp.isnan(operand), axis=axis, keepdims=keepdims)
         result = jnp.where(hasnan, jnp.asarray(jnp.nan, result.dtype), result)
-
-    # the logical result shape (the physical one may carry the pad through)
-    if axis is None:
-        out_gshape = tuple(1 for _ in x.shape) if keepdims else ()
-    elif keepdims:
-        out_gshape = tuple(1 if d in axes else s for d, s in enumerate(x.shape))
-    else:
-        out_gshape = tuple(s for d, s in enumerate(x.shape) if d not in axes)
 
     res_dtype = canonical_heat_type(result.dtype)
     if out is not None:
@@ -403,23 +485,44 @@ def __cum_op(
         raise NotImplementedError("cumulative operations over flattened arrays: pass axis")
     comm = x.comm
     opname = {jnp.cumsum: "sum", jnp.cumprod: "prod"}.get(partial_op)
-    if (
+    use_comm_cum = (
         opname is not None
         and x.split is not None
         and axis == int(x.split) % max(x.ndim, 1)
         and isinstance(comm, MeshCommunication)
         and comm.is_distributed()
-    ):
+    )
+    cast_dtype = None if dtype is None else canonical_heat_type(dtype)
+
+    # --- reduction-sink fast path (core/fusion.py): the cumulative becomes a
+    # sink of the pending chain; along a distributed split axis the comm.Cum
+    # shard_map pipeline (local cum + block-total exchange + combine) is
+    # traced INTO the same XLA program as the fused elementwise subgraph
+    if out is None and _fusion.sink_ready(x):
+        deferred = _fusion.defer_cum(
+            x, partial_op, axis, cast_dtype,
+            comm if use_comm_cum else None, opname,
+        )
+        if deferred is not None:
+            return deferred
+
+    if use_comm_cum:
         # pad-safe: pad rows sit at the global END of the axis, so every valid
         # block's offset is built from valid predecessors only; garbage totals
-        # flow exclusively into pad-only blocks
-        result = comm.Cum(x.parray, op=opname, split=axis)
+        # flow exclusively into pad-only blocks. The operand flush inside the
+        # collective prep is reason-labelled so fusion.flushes/flush_reason
+        # stay honest on this path (ISSUE 4 bugfix).
+        with _fusion.flush_reason("collective"):
+            operand = x.parray
+        result = comm.Cum(operand, op=opname, split=axis)
     else:
         # physical compute is safe even along a padded split axis: the pad sits at
         # the global END, so the cumulative prefix over the valid region never sees it
-        result = partial_op(x.parray, axis=axis)
+        with _fusion.flush_reason("cumulative"):
+            operand = x.parray
+        result = partial_op(operand, axis=axis)
     if dtype is not None:
-        result = result.astype(canonical_heat_type(dtype).jnp_type())
+        result = result.astype(cast_dtype.jnp_type())
     res_dtype = canonical_heat_type(result.dtype)
     if out is not None:
         sanitation.sanitize_out(out, x.shape, x.split, x.device)

@@ -203,10 +203,12 @@ def pow(t1, t2, out=None, where=None) -> DNDarray:
 power = pow
 
 
-def prod(a, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
+def prod(a, axis=None, out=None, keepdim=None, keepdims=None, where=None) -> DNDarray:
     """Product of elements over the given axis (reference arithmetics.py prod →
-    __reduce_op with MPI.PROD; here a sharded jnp.prod)."""
-    return _operations.__reduce_op(a, jnp.prod, axis=axis, out=out, keepdims=_operations.resolve_keepdims(keepdim, keepdims))
+    __reduce_op with MPI.PROD; here a sharded jnp.prod). ``where`` restricts
+    the product to the masked elements (numpy semantics)."""
+    kwargs = {} if where is None else {"where": where}
+    return _operations.__reduce_op(a, jnp.prod, axis=axis, out=out, keepdims=_operations.resolve_keepdims(keepdim, keepdims), **kwargs)
 
 
 def hypot(t1, t2, out=None) -> DNDarray:
@@ -245,10 +247,13 @@ def sub(t1, t2, out=None, where=None) -> DNDarray:
 subtract = sub
 
 
-def sum(a, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
+def sum(a, axis=None, out=None, keepdim=None, keepdims=None, where=None) -> DNDarray:
     """Sum of elements over the given axis (reference arithmetics.py sum →
-    __reduce_op with MPI.SUM at _operations.py:441; lowers to psum over ICI here)."""
-    return _operations.__reduce_op(a, jnp.sum, axis=axis, out=out, keepdims=_operations.resolve_keepdims(keepdim, keepdims))
+    __reduce_op with MPI.SUM at _operations.py:441; lowers to psum over ICI
+    here). ``where`` restricts the sum to the masked elements (numpy
+    semantics)."""
+    kwargs = {} if where is None else {"where": where}
+    return _operations.__reduce_op(a, jnp.sum, axis=axis, out=out, keepdims=_operations.resolve_keepdims(keepdim, keepdims), **kwargs)
 
 
 # ---------------------------------------------------------------------- operators

@@ -226,6 +226,16 @@ class DNDarray:
         """The pending fusion expression node, or None when concrete."""
         return self.__lazy
 
+    def _flush(self, reason: str) -> None:
+        """Materialize a pending expression, attributing the flush to
+        ``reason`` in the ``fusion.flush_reason`` counter (no-op when
+        concrete — the guard keeps reason bookkeeping off the hot path)."""
+        if self.__lazy is not None:
+            from . import fusion as _fusion
+
+            with _fusion.flush_reason(reason):
+                self.parray  # noqa: B018
+
     # ------------------------------------------------------------------ properties
     @property
     def larray(self) -> jax.Array:
@@ -568,6 +578,7 @@ class DNDarray:
             return self
         comm = self.__comm
         if isinstance(comm, MeshCommunication) and comm.is_distributed():
+            self._flush("collective")
             if _MON.enabled:
                 # a genuine split change on a distributed mesh: XLA emits the
                 # all-to-all/all-gather — the event every "how many resharding
@@ -599,6 +610,7 @@ class DNDarray:
                 )
         comm = self.__comm
         if isinstance(comm, MeshCommunication) and comm.is_distributed():
+            self._flush("collective")
             if _MON.enabled:
                 _instr.resharding(self.__split, self.__split)
             self.__array = comm.placed(self.parray, self.__split, self.__gshape)
@@ -638,6 +650,7 @@ class DNDarray:
             raise ValueError(
                 f"halo_size {halo_size} needs to be smaller than the local chunk {chunk}"
             )
+        self._flush("collective")
         fn = _build_halo_exchange(comm.mesh, comm.axis_name, p, split, halo_size, self.pshape)
         # zero-fill pads so ragged tails exchange zeros, not garbage
         phys = self.filled(0) if self.is_padded else self.parray
@@ -675,6 +688,7 @@ class DNDarray:
         (parity: dndarray.py:974)."""
         if self.size != 1:
             raise ValueError("only one-element DNDarrays can be converted to Python scalars")
+        self._flush("export")
         return self.larray.reshape(()).item()
 
     def fill_diagonal(self, value: float) -> "DNDarray":
@@ -688,6 +702,7 @@ class DNDarray:
             raise ValueError("Only 2D tensors supported at the moment")
         k = int(np.minimum(self.shape[0], self.shape[1]))
         idx = jnp.arange(k)
+        self._flush("indexing")
         phys = self.parray
         self.__array = phys.at[idx, idx].set(jnp.asarray(value, dtype=phys.dtype))
         self.__invalidate()
@@ -698,6 +713,7 @@ class DNDarray:
         a resplit(None) gather; here a device fetch). In a multi-controller run the
         shards on other hosts are gathered with ``process_allgather`` (every host
         gets the full array, like the reference's resplit(None))."""
+        self._flush("export")
         arr = self.parray
         if hasattr(arr, "is_fully_addressable") and not arr.is_fully_addressable:
             from jax.experimental import multihost_utils
@@ -743,6 +759,7 @@ class DNDarray:
         # back — cache the staged buffer so a sharded/TPU array is gathered
         # and host-staged once per interchange (cleared again when __dlpack__
         # hands the buffer off)
+        self._flush("export")
         phys = self.parray
         cached = getattr(self, "_DNDarray__dlpack_cache", None)
         if cached is not None and cached[0] is phys:
@@ -796,11 +813,13 @@ class DNDarray:
     def __repr__(self) -> str:
         from . import printing
 
+        self._flush("print")
         return printing.__str__(self)
 
     def __str__(self) -> str:
         from . import printing
 
+        self._flush("print")
         return printing.__str__(self)
 
     # ------------------------------------------------------------------ indexing
@@ -1019,6 +1038,7 @@ class DNDarray:
         block's leading axis (numpy's block-placement rules); in every case the
         result is re-placed on its inferred split axis.
         """
+        self._flush("indexing")
         norm, new_split, fast = self.__index_plan(key)
         if fast:
             result = self.parray[norm]
@@ -1041,6 +1061,7 @@ class DNDarray:
         elif isinstance(value, (list, tuple, np.ndarray)):
             value = jnp.asarray(value, dtype=self.dtype.jnp_type())
         # full-array boolean-mask assignment: .at does not take masks; use where
+        self._flush("indexing")
         jkey = self.__process_key(key)
         if (
             isinstance(jkey, (jnp.ndarray, np.ndarray))

@@ -59,12 +59,30 @@ Design (see ``doc/fusion_notes.md`` for the full narrative):
   ops without hitting a barrier is flushed at record time, so unbounded
   rebind loops compile a small set of fixed-size kernels instead of one
   kernel per chain length.
+* **Reduction sinks.** Reductions, cumulatives, moments and norms are *sinks*
+  of the pending DAG rather than flush triggers: ``__reduce_op``/``__cum_op``
+  (and the statistics/linalg epilogue routes) record a sink ``_Node`` whose
+  callable replays the exact eager reduction — operand prep (pad fill with the
+  op's neutral element, or the logical slice), the reduction itself with its
+  axis/keepdims/``where=``/``initial`` arguments, and the split-axis NaN
+  re-assertion — so the elementwise subgraph, the reduction, and the sharded
+  cross-device combine (XLA's psum over the leaf shardings) land in **one**
+  XLA program. The sink result is itself a deferred ``DNDarray``, so
+  post-reduction scalar epilogues (``mean``'s ``/n``, ``norm``'s ``sqrt``, a
+  user's ``loss * scale``) re-root a new pending chain at the sink and fuse
+  too. The chain the sink consumed stays pending (and replayable) — a sink
+  reads it in-register without ever writing the intermediate to HBM.
+  ``HEAT_TPU_FUSION_SINKS=0`` keeps fusion on but restores
+  reductions-as-barriers bit for bit.
 * **Escape hatch.** ``HEAT_TPU_FUSION=0`` restores the pre-fusion
   op-at-a-time execution bit for bit (read per dispatch, same pattern as
   ``HEAT_TPU_BLOCKED_LINALG``).
 
 Monitoring: ``fusion.ops_deferred`` (labelled binary/local/where/cast),
+``fusion.reduction_sinks`` (labelled reduce/cum/moment/norm/vecdot),
 ``fusion.flushes``/``fusion.kernels_compiled``/``fusion.cache_hits``,
+``fusion.flush_reason`` (labelled reduction/cumulative/print/indexing/io/
+collective/out-alias/export/chain-bound/other — *why* each chain broke),
 ``fusion.elided_writes``, and the ``fusion.chain_length`` histogram, all
 through ``monitoring/instrument.py``; :func:`cache_info` reports
 entries/hits/misses/evictions of the trace LRU.
@@ -90,14 +108,22 @@ from .dndarray import DNDarray
 
 __all__ = [
     "enabled",
+    "sinks_enabled",
+    "sink_ready",
     "is_deferred",
     "pending_count",
     "flush",
     "flush_pending",
+    "flush_reason",
     "defer_binary",
     "defer_local",
     "defer_where",
     "defer_cast",
+    "defer_reduce",
+    "defer_moment",
+    "defer_cum",
+    "defer_norm",
+    "defer_vecdot",
     "materialize_for",
     "cache_info",
     "clear_cache",
@@ -115,6 +141,29 @@ def enabled() -> bool:
     """
     val = os.environ.get("HEAT_TPU_FUSION", "")
     return val.strip().lower() not in ("0", "false", "off")
+
+
+def sinks_enabled() -> bool:
+    """Whether reductions sink into pending graphs (default on).
+
+    ``HEAT_TPU_FUSION_SINKS=0`` keeps elementwise fusion on but restores the
+    pre-sink behavior bit for bit: every reduction/cumulative flushes its
+    operand and executes as a standalone dispatch. Read per dispatch.
+    """
+    val = os.environ.get("HEAT_TPU_FUSION_SINKS", "")
+    return val.strip().lower() not in ("0", "false", "off")
+
+
+def sink_ready(x) -> bool:
+    """Whether ``x`` carries a live pending expression a reduction may sink
+    into (fusion + sinks enabled, pending node not yet materialized through
+    another root)."""
+    if not isinstance(x, DNDarray):
+        return False
+    node = x._expr()
+    if node is None or node.value is not None:
+        return False
+    return enabled() and sinks_enabled()
 
 
 def _donate_enabled() -> bool:
@@ -281,15 +330,53 @@ def flush(x: DNDarray) -> DNDarray:
     return x
 
 
-def flush_pending() -> int:
+def flush_pending(reason: str = "export") -> int:
     """Materialize every live pending graph (the monitoring-export barrier:
     exported counters then account for all recorded work). Returns the number
     of arrays flushed."""
     n = 0
-    for d in _pending_arrays():
-        d.parray  # noqa: B018
-        n += 1
+    with flush_reason(reason):
+        for d in _pending_arrays():
+            d.parray  # noqa: B018
+            n += 1
     return n
+
+
+# ------------------------------------------------------------------ flush reasons
+#: Reason stack read by ``materialize_for`` when attributing a flush to the
+#: ``fusion.flush_reason`` labelled counter. Barrier sites push the reason of
+#: the *outermost* barrier (e.g. printing wins over the ``.numpy()`` it calls
+#: internally); a flush with no annotated barrier reports ``other``.
+_FLUSH_REASON: list = ["other"]
+
+
+class _ReasonCtx:
+    """Tiny non-generator context manager (barrier sites sit on hot paths)."""
+
+    __slots__ = ("reason", "pushed")
+
+    def __init__(self, reason: str):
+        self.reason = reason
+        self.pushed = False
+
+    def __enter__(self):
+        # outermost barrier wins: only annotate when no reason is active yet
+        if len(_FLUSH_REASON) == 1:
+            _FLUSH_REASON.append(self.reason)
+            self.pushed = True
+        return self
+
+    def __exit__(self, *exc):
+        if self.pushed:
+            _FLUSH_REASON.pop()
+        return False
+
+
+def flush_reason(reason: str) -> _ReasonCtx:
+    """Context manager annotating why any flush inside the block happened
+    (``fusion.flush_reason{reason}``). Taxonomy: reduction / cumulative /
+    print / indexing / io / collective / out-alias / export / chain-bound."""
+    return _ReasonCtx(reason)
 
 
 # ------------------------------------------------------------------ recording
@@ -386,7 +473,8 @@ def _finish(node: _Node, gshape, dtype, split, device, comm, kind: str) -> DNDar
     if node.nops >= _max_chain():
         # flush at record time: unbounded rebind loops then compile a small
         # set of fixed-size fused kernels instead of one per chain length
-        d.parray  # noqa: B018
+        with flush_reason("chain-bound"):
+            d.parray  # noqa: B018
     return d
 
 
@@ -636,6 +724,311 @@ def defer_cast(x: DNDarray, heat_dtype) -> Optional[DNDarray]:
     return _finish(node, tuple(x.shape), heat_dtype, x.split, x.device, x.comm, "cast")
 
 
+# ------------------------------------------------------------------ reduction sinks
+#
+# A sink node replays the EXACT eager reduction dispatch inside the fused
+# trace: operand prep (``pre`` — the padded-physical pass-through, the
+# neutral-element pad fill, the logical pad slice, or a static flatten), the
+# jnp reduction with its axis/keepdims/static kwargs, optional dynamic kwarg
+# operands (``where=`` masks ride as runtime leaves), and the split-axis
+# NaN re-assertion of ``__reduce_op``. The sink callable is memoized per
+# static signature so node identity, the abstract-eval cache, and the trace
+# LRU key all see one object per signature; every static parameter is also
+# part of ``op_key`` and therefore of the trace-cache key.
+
+def _low_float(x: DNDarray) -> bool:
+    """Sub-32-bit float operand: eager rounds to bf16/f16 after every op, but
+    a fused producer feeding an f32-upcast accumulator legally skips the final
+    narrow rounding (XLA excess precision) — arithmetic-accumulating sinks
+    flush instead to preserve bit parity (order-preserving min/max and boolean
+    any/all remain sinkable; see ``__reduce_op``)."""
+    dt = np.dtype(x.dtype.jnp_type())
+    # NB: ml_dtypes extended floats (bfloat16) report numpy kind 'V', so the
+    # float test must go through jnp.issubdtype, not dt.kind
+    return dt.itemsize < 4 and bool(jnp.issubdtype(dt, jnp.floating))
+
+
+_SINK_FNS: dict = {}
+
+
+def _sink_fn_for(op, pre, axis, keepdims, static_kw, dyn_names, nanfix):
+    key = (id(op), pre, axis, keepdims, static_kw, dyn_names, nanfix)
+    fn = _SINK_FNS.get(key)
+    if fn is not None:
+        return fn
+
+    def fn(operand, *dyn):
+        v = operand
+        for step in pre:
+            if step[0] == "fill":
+                # in-trace x.filled(neutral): mask the pad rows with the
+                # reduce op's neutral element (0 would corrupt min/prod/all)
+                _, s_ax, n, neutral = step
+                shape = [1] * v.ndim
+                shape[s_ax] = v.shape[s_ax]
+                mask = jnp.arange(v.shape[s_ax]).reshape(shape) < n
+                v = jnp.where(mask, v, jnp.asarray(neutral, dtype=v.dtype))
+            elif step[0] == "slice":
+                # in-trace x.larray: static slice dropping the pad rows
+                _, s_ax, n = step
+                v = v[tuple(
+                    slice(0, n) if d == s_ax else slice(None) for d in range(v.ndim)
+                )]
+            elif step[0] == "reshape":
+                v = v.reshape(step[1])
+        kw = dict(static_kw)
+        kw.update(zip(dyn_names, dyn))
+        if keepdims is None:  # op without a keepdims parameter (cumulatives)
+            r = op(v, axis=axis, **kw)
+        else:
+            r = op(v, axis=axis, keepdims=keepdims, **kw)
+        r = jnp.asarray(r)
+        if nanfix:
+            # __reduce_op's split-axis NaN re-assertion for max/min (the SPMD
+            # pmax/pmin combine drops NaN), replayed inside the trace
+            hasnan = jnp.any(jnp.isnan(v), axis=axis, keepdims=bool(keepdims))
+            r = jnp.where(hasnan, jnp.asarray(jnp.nan, r.dtype), r)
+        return r
+
+    _SINK_FNS[key] = fn
+    return fn
+
+
+def _split_sink_kwargs(fn_kwargs: dict):
+    """Partition reduction kwargs into statically baked values and dynamic
+    array operands (``where=`` masks). Returns ``(static_items, dyn_names,
+    dyn_leaves)`` or None when a value can be neither baked nor lifted."""
+    static_items, dyn_names, dyn_leaves = [], [], []
+    for k, v in sorted(fn_kwargs.items()):
+        if v is None or isinstance(
+            v, (builtins.int, builtins.float, builtins.bool, str, np.number, np.bool_)
+        ):
+            # scalars here (``initial=``) are baked: eager evaluates them at
+            # its own trace time too, so the lowering is identical
+            static_items.append((k, v))
+        else:
+            arr = jnp.asarray(v)
+            if not _usable_leaf(arr):
+                return None
+            dyn_names.append(k)
+            dyn_leaves.append(_Leaf(arr))
+    return tuple(static_items), tuple(dyn_names), tuple(dyn_leaves)
+
+
+def _finish_sink(node: _Node, gshape, dtype, split, device, comm, kind: str) -> DNDarray:
+    """Wrap a recorded sink node in a deferred DNDarray (the sink result roots
+    a NEW pending chain — scalar epilogues fuse into the same kernel)."""
+    d = DNDarray._deferred(node, gshape, tuple(node.aval.shape), dtype, split, device, comm)
+    node.owner = weakref.ref(d)
+    _register_pending(d)
+    if _MON.enabled:
+        _instr.fusion_sink(kind)
+    if node.nops >= _max_chain():
+        with flush_reason("chain-bound"):
+            d.parray  # noqa: B018
+    return d
+
+
+def defer_reduce(
+    x: DNDarray,
+    op,
+    axis,
+    keepdims: bool,
+    fn_kwargs: dict,
+    pre,
+    nanfix: bool,
+    out_gshape,
+    out_split,
+    expected_pshape,
+    kind: str = "reduce",
+) -> Optional[DNDarray]:
+    """Record one eager ``__reduce_op`` dispatch as a sink of ``x``'s pending
+    graph. ``pre`` is the operand-prep recipe the eager path would apply
+    (computed by the caller, which owns the pad semantics); ``expected_pshape``
+    is the physical result shape the eager dispatch would produce. Returns the
+    deferred result, or None to fall back to the flushing path."""
+    from .types import canonical_heat_type
+
+    inp = _input_of(x)
+    if inp is None:
+        return None
+    parts = _split_sink_kwargs(fn_kwargs)
+    if parts is None:
+        return None
+    static_items, dyn_names, dyn_leaves = parts
+    try:
+        fn = _sink_fn_for(op, pre, axis, keepdims, static_items, dyn_names, nanfix)
+    except TypeError:  # unhashable static parameter
+        return None
+    okey = (
+        "sink", kind, _op_key(op), pre, axis, keepdims, static_items, dyn_names, nanfix,
+    )
+    args = (inp, *dyn_leaves)
+    try:
+        aval = _eval_node(fn, okey, args, (), None)
+    except Exception:
+        return None  # abstract eval rejected the combination: eager handles
+    if tuple(aval.shape) != tuple(expected_pshape):
+        return None
+    node = _Node(fn, okey, args, (), None, aval)
+    res_dtype = canonical_heat_type(aval.dtype)
+    return _finish_sink(
+        node, tuple(out_gshape), res_dtype, out_split, x.device, x.comm, kind
+    )
+
+
+def defer_moment(
+    x: DNDarray, op, axis, keepdims: bool, fn_kwargs: dict, out_split
+) -> Optional[DNDarray]:
+    """Sink a logical-view moment reduction (``mean``/``var``/``std``/
+    ``nanmean`` — ``jnp`` callables taking axis/keepdims) into ``x``'s pending
+    graph; the ``/n`` and ``-mu**2`` epilogues live inside the jnp op and fuse
+    with it. The eager ``__moment`` computes on ``x.larray``, so padded
+    operands are pad-sliced in-trace."""
+    # padded operands fall back to the eager flush: an in-trace pad slice
+    # makes the SPMD partitioner group the ragged shards' partial sums
+    # differently than the eager dispatch on the sliced logical view —
+    # reassociation, which (unlike FMA contraction) is not a documented
+    # divergence
+    if x.is_padded or _low_float(x):
+        return None
+    pre = ()
+    inp = _input_of(x)
+    if inp is None:
+        return None
+    parts = _split_sink_kwargs(fn_kwargs)
+    if parts is None:
+        return None
+    static_items, dyn_names, dyn_leaves = parts
+    fn = _sink_fn_for(op, pre, axis, keepdims, static_items, dyn_names, False)
+    okey = ("sink", "moment", _op_key(op), pre, axis, keepdims, static_items, dyn_names)
+    args = (inp, *dyn_leaves)
+    try:
+        aval = _eval_node(fn, okey, args, (), None)
+    except Exception:
+        return None
+    from .types import canonical_heat_type
+
+    node = _Node(fn, okey, args, (), None, aval)
+    res_dtype = canonical_heat_type(aval.dtype)
+    return _finish_sink(
+        node, tuple(aval.shape), res_dtype, out_split, x.device, x.comm, "moment"
+    )
+
+
+_CUM_FNS: dict = {}
+
+
+def defer_cum(
+    x: DNDarray, op, axis: int, cast_dtype, comm_cum, cum_opname
+) -> Optional[DNDarray]:
+    """Sink one eager ``__cum_op`` dispatch: the chunk-local cumulative (or,
+    along a distributed split axis, the ``comm.Cum`` shard_map pipeline — the
+    block-total exchange then lands in the same XLA program as the fused
+    chain) plus the optional dtype cast."""
+    from .types import canonical_heat_type
+
+    if _low_float(x):
+        return None  # bf16/f16 prefix accumulation: flush for bit parity
+    inp = _input_of(x)
+    if inp is None:
+        return None
+    dt = None if cast_dtype is None else np.dtype(cast_dtype.jnp_type())
+    key = (id(op), axis, None if dt is None else str(dt),
+           None if comm_cum is None else id(comm_cum), cum_opname)
+    fn = _CUM_FNS.get(key)
+    if fn is None:
+        def fn(v, _op=op, _axis=axis, _dt=dt, _comm=comm_cum, _name=cum_opname):
+            if _comm is not None:
+                r = _comm.Cum(v, op=_name, split=_axis)
+            else:
+                r = _op(v, axis=_axis)
+            if _dt is not None:
+                r = r.astype(_dt)
+            return r
+
+        _CUM_FNS[key] = fn
+    okey = ("sink", "cum", _op_key(op), axis, None if dt is None else str(dt),
+            None if comm_cum is None else id(comm_cum), cum_opname)
+    try:
+        aval = _eval_node(fn, okey, (inp,), (), None)
+    except Exception:
+        return None  # e.g. shard_map refuses abstract eval on this jax: eager
+    if tuple(aval.shape) != tuple(x.pshape):
+        return None
+    node = _Node(fn, okey, (inp,), (), None, aval)
+    res_dtype = canonical_heat_type(aval.dtype)
+    return _finish_sink(
+        node, tuple(x.shape), res_dtype, x.split, x.device, x.comm, "cum"
+    )
+
+
+def defer_norm(
+    x: DNDarray, ord, axis, keepdims: bool, flatten: bool
+) -> Optional[DNDarray]:
+    """Sink a ``jnp.linalg.norm`` call (``norm``/``vector_norm``/
+    ``matrix_norm`` consume ``x.larray``); the ``sqrt`` epilogue lives inside
+    the jnp op. ``flatten`` replays ``vector_norm``'s full-array reshape."""
+    # padded operands fall back to eager (see defer_moment: an in-trace pad
+    # slice would reassociate the ragged shards' partial sums)
+    if x.is_padded or _low_float(x):
+        return None
+    pre = (("reshape", (-1,)),) if flatten else ()
+    try:
+        hash(ord)
+    except TypeError:
+        return None
+    fn = _sink_fn_for(jnp.linalg.norm, pre, axis, keepdims, (("ord", ord),), (), False)
+    okey = ("sink", "norm", pre, axis, keepdims, ("ord", str(ord)))
+    inp = _input_of(x)
+    if inp is None:
+        return None
+    try:
+        aval = _eval_node(fn, okey, (inp,), (), None)
+    except Exception:
+        return None
+    from .types import canonical_heat_type
+
+    node = _Node(fn, okey, (inp,), (), None, aval)
+    res_dtype = canonical_heat_type(aval.dtype)
+    return _finish_sink(
+        node, tuple(aval.shape), res_dtype, None, x.device, x.comm, "norm"
+    )
+
+
+def defer_vecdot(x1: DNDarray, x2: DNDarray, axis, keepdim: bool) -> Optional[DNDarray]:
+    """Sink ``vecdot``'s broadcast–conj–multiply–sum pipeline over two (possibly
+    pending) operands; the trace replays the eager body verbatim."""
+    if x1.is_padded or x2.is_padded or _low_float(x1) or _low_float(x2):
+        return None  # eager consumes larray; a two-operand pad slice is rare
+    key = ("vecdot", axis, keepdim)
+    fn = _SINK_FNS.get(key)
+    if fn is None:
+        def fn(a, b, _axis=axis, _keep=keepdim):
+            aa, bb = jnp.broadcast_arrays(a, b)
+            return jnp.sum(jnp.conj(aa) * bb, axis=_axis, keepdims=_keep)
+
+        _SINK_FNS[key] = fn
+    args = []
+    for t in (x1, x2):
+        inp = _input_of(t)
+        if inp is None:
+            return None
+        args.append(inp)
+    okey = ("sink", "vecdot", axis, keepdim)
+    try:
+        aval = _eval_node(fn, okey, tuple(args), (), None)
+    except Exception:
+        return None
+    from .types import canonical_heat_type
+
+    node = _Node(fn, okey, tuple(args), (), None, aval)
+    res_dtype = canonical_heat_type(aval.dtype)
+    return _finish_sink(
+        node, tuple(aval.shape), res_dtype, None, x1.device, x1.comm, "vecdot"
+    )
+
+
 # ------------------------------------------------------------------ flush
 _TRACE_CACHE: "collections.OrderedDict" = collections.OrderedDict()
 _cache_stats = {"hits": 0, "misses": 0, "evictions": 0}
@@ -670,16 +1063,20 @@ def _topo(root: _Node):
     return order
 
 
-def _donatable(arr, owner_ref, out_aval) -> bool:
+def _donatable(arr, owner_ref, out_avals) -> bool:
     """A leaf buffer may be donated to the fused call iff its owning DNDarray
     is dead, nothing else references the buffer (strict refcount bound), the
-    backend actually implements donation, and the buffer aliases the output
-    (same shape/dtype) so XLA can reuse it in place. The caller additionally
-    verifies the flushed subgraph is *private* — no node in it is referenced
-    by another live pending graph that could replay from the same leaves."""
+    backend actually implements donation, and the buffer aliases one of the
+    kernel's outputs (same shape/dtype) so XLA can reuse it in place. The
+    caller additionally verifies the flushed subgraph is *private* — no node
+    in it is referenced by another live pending graph that could replay from
+    the same leaves."""
     if owner_ref is not None and owner_ref() is not None:
         return False
-    if tuple(arr.shape) != tuple(out_aval.shape) or arr.dtype != out_aval.dtype:
+    if not any(
+        tuple(arr.shape) == tuple(av.shape) and arr.dtype == av.dtype
+        for av in out_avals
+    ):
         return False
     try:
         platform = next(iter(arr.devices())).platform
@@ -749,20 +1146,44 @@ def materialize_for(d: DNDarray):
         cast_key = None if n.cast is None else (str(n.cast[0]), n.cast[1])
         key_prog.append((n.op_key, tuple(key_specs), n.kwargs, cast_key))
 
-    out_aval = root.aval
+    # Outputs: the root — and, when the root is a reduction SINK, every
+    # pending interior node whose owning DNDarray is still alive. A sink
+    # leaves its consumed chain pending; when the chain will plausibly be
+    # read later (a live owner), materializing it as a SECOND output of the
+    # same kernel costs only the write the pre-sink path always paid, and
+    # saves a full recompute + recompile when the owner is read. Dead-owner
+    # chains (the hot loss/norm pattern) keep the single-read floor.
+    out_nodes = [root]
+    if root.op_key and root.op_key[0] == "sink":
+        for n in topo:
+            if n is not root and n.owner is not None and n.owner() is not None:
+                out_nodes.append(n)
+    out_ids = {id(n) for n in out_nodes}
+    out_idx = tuple(index_of[id(n)] for n in out_nodes)
+
+    out_avals = tuple(n.aval for n in out_nodes)
     donate = ()
     if _donate_enabled():
         # donation is only safe when this subgraph is private: every non-root
-        # node's recorded parents all sit inside the subgraph, so no other
-        # live pending graph can ever replay these nodes from their leaves
+        # node's recorded parents all sit inside the subgraph AND it cannot be
+        # replayed later — its owning DNDarray is dead, or it receives a value
+        # as an output of this very flush. Otherwise a live pending graph (a
+        # reduction sink leaves its operand chain pending) could replay these
+        # nodes from the donated leaves.
         private = all(
-            n is root or n.rc == internal_rc.get(id(n), 0) for n in topo
+            n is root
+            or id(n) in out_ids
+            or (
+                n.rc == internal_rc.get(id(n), 0)
+                and (n.owner is None or n.owner() is None)
+            )
+            for n in topo
         )
         if private:
             donate_idx = []
             for i in range(len(leaf_arrays)):
                 arr = leaf_arrays[i]
-                if _donatable(arr, leaf_owners[i], out_aval):
+                if _donatable(arr, leaf_owners[i], out_avals):
                     donate_idx.append(i)
                 del arr
             donate = tuple(donate_idx)
@@ -777,7 +1198,7 @@ def materialize_for(d: DNDarray):
         for a in leaf_arrays
     )
     try:
-        key = (tuple(key_prog), leaf_key, donate)
+        key = (tuple(key_prog), leaf_key, donate, out_idx)
         fused = _TRACE_CACHE.get(key)
     except TypeError:  # unhashable sharding — compile uncached
         key, fused = None, None
@@ -785,6 +1206,7 @@ def materialize_for(d: DNDarray):
     compiled = fused is None
     if fused is None:
         prog = tuple(program)
+        oidx = out_idx
 
         def replay(*leaves):
             vals = []
@@ -794,7 +1216,7 @@ def materialize_for(d: DNDarray):
                     for tag, i in specs
                 ]
                 vals.append(_apply(fn, args, kw, cast))
-            return vals[-1]
+            return tuple(vals[i] for i in oidx)
 
         fused = jax.jit(replay, donate_argnums=donate)
         if key is not None:
@@ -809,19 +1231,28 @@ def materialize_for(d: DNDarray):
         _cache_stats["hits"] += 1
 
     if _MON.enabled:
-        _instr.fusion_flush(len(topo), cache_hit=not compiled, compiled=compiled)
+        _instr.fusion_flush(
+            len(topo),
+            cache_hit=not compiled,
+            compiled=compiled,
+            reason=_FLUSH_REASON[-1],
+        )
 
-    value = fused(*leaf_arrays)
+    values = fused(*leaf_arrays)
 
     # canonical placement — the step DNDarray.__init__ applies to every eager
-    # intermediate, applied once per fused chain here
-    split = d.split
-    comm = d.comm
-    if (
-        split is not None
-        and isinstance(comm, MeshCommunication)
-        and comm.is_distributed()
-    ):
-        value = comm.placed(value, split, d.shape)
-    root.value = value
-    return value
+    # intermediate, applied once per fused output here (the root places on
+    # ``d``'s layout; extra sink-chain outputs on their live owner's)
+    for n, value in zip(out_nodes, values):
+        owner = d if n is root else n.owner()
+        if owner is not None:
+            split = owner.split
+            comm = owner.comm
+            if (
+                split is not None
+                and isinstance(comm, MeshCommunication)
+                and comm.is_distributed()
+            ):
+                value = comm.placed(value, split, owner.shape)
+        n.value = value
+    return root.value

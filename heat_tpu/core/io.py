@@ -155,6 +155,7 @@ if __HDF5:
                 _instr.record_io("save_hdf5", path, data.nbytes, _time.perf_counter() - t0)
 
     def _save_hdf5_body(data: DNDarray, path: str, dataset: str, mode: str, **kwargs) -> None:
+        data._flush("io")
         arr = data.parray
         if hasattr(arr, "is_fully_addressable") and not arr.is_fully_addressable:
             # multi-controller: a shard-wise write after a mode-'w' truncate would

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from . import _elimination
 from . import blocked
 from .. import factories
+from .. import fusion as _fusion
 from .. import sanitation
 from .. import stride_tricks
 from .. import types
@@ -341,15 +342,28 @@ def matrix_norm(x: DNDarray, axis=None, keepdims: bool = False, ord=None) -> DND
             raise ValueError("matrix_norm requires at least 2 dimensions")
         axis = (x.ndim - 2, x.ndim - 1)
     axis = tuple(stride_tricks.sanitize_axis(x.shape, a) for a in axis)
-    data = jnp.linalg.norm(x.larray, ord=ord, axis=axis, keepdims=keepdims)
+    if _fusion.sink_ready(x):
+        res = _fusion.defer_norm(x, ord, axis, keepdims, flatten=False)
+        if res is not None:
+            return res
+    with _fusion.flush_reason("reduction"):
+        data = jnp.linalg.norm(x.larray, ord=ord, axis=axis, keepdims=keepdims)
     data = jnp.asarray(data)
     return DNDarray(data, tuple(data.shape), types.canonical_heat_type(data.dtype), None, x.device, x.comm, True)
 
 
 def norm(x: DNDarray, axis=None, keepdims: bool = False, ord=None) -> DNDarray:
-    """Vector/matrix norm dispatch (reference linalg/basics.py:1231-1310)."""
+    """Vector/matrix norm dispatch (reference linalg/basics.py:1231-1310). A
+    pending fused chain on ``x`` is consumed as a reduction sink — the
+    elementwise subgraph, the norm reduction, and its ``sqrt`` epilogue
+    compile as one XLA program (core/fusion.py)."""
     sanitation.sanitize_in(x)
-    data = jnp.linalg.norm(x.larray, ord=ord, axis=axis, keepdims=keepdims)
+    if _fusion.sink_ready(x):
+        res = _fusion.defer_norm(x, ord, axis, keepdims, flatten=False)
+        if res is not None:
+            return res
+    with _fusion.flush_reason("reduction"):
+        data = jnp.linalg.norm(x.larray, ord=ord, axis=axis, keepdims=keepdims)
     data = jnp.asarray(data)
     return DNDarray(data, tuple(data.shape), types.canonical_heat_type(data.dtype), None, x.device, x.comm, True)
 
@@ -439,23 +453,44 @@ def vdot(x1: DNDarray, x2: DNDarray) -> DNDarray:
 
 
 def vecdot(x1: DNDarray, x2: DNDarray, axis: Optional[int] = None, keepdim: bool = False) -> DNDarray:
-    """Vector dot product along an axis (reference linalg/basics.py:2271-2308)."""
+    """Vector dot product along an axis (reference linalg/basics.py:2271-2308).
+    Pending fused chains on either operand are consumed as a reduction sink —
+    the conj–multiply–sum pipeline traces into the same XLA program as the
+    operand subgraphs (core/fusion.py)."""
     sanitation.sanitize_in(x1)
     sanitation.sanitize_in(x2)
     if axis is None:
         axis = -1
-    a, b = jnp.broadcast_arrays(x1.larray, x2.larray)
+    if _fusion.sink_ready(x1) or _fusion.sink_ready(x2):
+        res = _fusion.defer_vecdot(x1, x2, axis, keepdim)
+        if res is not None:
+            return res
+    with _fusion.flush_reason("reduction"):
+        a, b = jnp.broadcast_arrays(x1.larray, x2.larray)
     data = jnp.sum(jnp.conj(a) * b, axis=axis, keepdims=keepdim)
     return DNDarray(data, tuple(data.shape), types.canonical_heat_type(data.dtype), None, x1.device, x1.comm, True)
 
 
 def vector_norm(x: DNDarray, axis=None, keepdims: bool = False, ord=None) -> DNDarray:
-    """Vector norm along an axis (reference linalg/basics.py:1311-1371)."""
+    """Vector norm along an axis (reference linalg/basics.py:1311-1371). A
+    pending fused chain on ``x`` is consumed as a reduction sink
+    (core/fusion.py)."""
     sanitation.sanitize_in(x)
-    if axis is None and x.ndim > 1:
-        data = jnp.linalg.norm(x.larray.reshape(-1), ord=ord if ord is not None else 2)
-    else:
-        data = jnp.linalg.norm(x.larray, ord=ord if ord is not None else 2, axis=axis, keepdims=keepdims)
+    flatten = axis is None and x.ndim > 1
+    if _fusion.sink_ready(x):
+        res = _fusion.defer_norm(
+            x, ord if ord is not None else 2,
+            None if flatten else axis,
+            False if flatten else keepdims,
+            flatten=flatten,
+        )
+        if res is not None:
+            return res
+    with _fusion.flush_reason("reduction"):
+        if flatten:
+            data = jnp.linalg.norm(x.larray.reshape(-1), ord=ord if ord is not None else 2)
+        else:
+            data = jnp.linalg.norm(x.larray, ord=ord if ord is not None else 2, axis=axis, keepdims=keepdims)
     data = jnp.asarray(data)
     return DNDarray(data, tuple(data.shape), types.canonical_heat_type(data.dtype), None, x.device, x.comm, True)
 

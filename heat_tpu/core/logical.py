@@ -33,10 +33,13 @@ __all__ = [
 ]
 
 
-def all(x, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
+def all(x, axis=None, out=None, keepdim=None, keepdims=None, where=None) -> DNDarray:
     """Whether all elements evaluate to True over the given axis (reference
-    logical.py all → MPI.LAND)."""
-    return _operations.__reduce_op(x, jnp.all, axis=axis, out=out, keepdims=_operations.resolve_keepdims(keepdim, keepdims))
+    logical.py all → MPI.LAND). A pending fused chain on ``x`` is consumed as
+    a reduction sink (core/fusion.py); ``where`` restricts the test to the
+    masked elements (numpy semantics)."""
+    kwargs = {} if where is None else {"where": where}
+    return _operations.__reduce_op(x, jnp.all, axis=axis, out=out, keepdims=_operations.resolve_keepdims(keepdim, keepdims), **kwargs)
 
 
 def allclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False) -> bool:
@@ -47,10 +50,13 @@ def allclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = F
     return bool(jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan))
 
 
-def any(x, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
+def any(x, axis=None, out=None, keepdim=None, keepdims=None, where=None) -> DNDarray:
     """Whether any element evaluates to True over the given axis (reference
-    logical.py any → MPI.LOR)."""
-    return _operations.__reduce_op(x, jnp.any, axis=axis, out=out, keepdims=_operations.resolve_keepdims(keepdim, keepdims))
+    logical.py any → MPI.LOR). A pending fused chain on ``x`` is consumed as
+    a reduction sink (core/fusion.py); ``where`` restricts the test to the
+    masked elements (numpy semantics)."""
+    kwargs = {} if where is None else {"where": where}
+    return _operations.__reduce_op(x, jnp.any, axis=axis, out=out, keepdims=_operations.resolve_keepdims(keepdim, keepdims), **kwargs)
 
 
 def isclose(x, y, rtol: float = 1e-05, atol: float = 1e-08, equal_nan: bool = False) -> DNDarray:

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 
 from . import _operations
 from . import factories
+from . import fusion as _fusion
 from . import sanitation
 from . import stride_tricks
 from . import types
@@ -222,11 +223,24 @@ def histogram(a, bins=10, range=None, normed=None, weights=None, density=None):
     return h, e
 
 
-def __moment(x, axis, keepdims, moment_fn):
+def __moment(x, axis, keepdims, moment_fn, sink_op=None, sink_kwargs=None):
+    """Shared moment template. When ``sink_op`` names the equivalent jnp
+    reduction (mean/var/std/nanmean) and ``x`` carries a pending fused chain,
+    the moment becomes a *sink* of that chain (core/fusion.py): the
+    elementwise subgraph, the reduction, and its scalar epilogues (``/n``,
+    ``-mu**2``) trace as one XLA program instead of flushing the intermediate.
+    Multi-step moments (kurtosis/skew) pass no ``sink_op`` and keep the
+    flushing path."""
     sanitation.sanitize_in(x)
     axis = stride_tricks.sanitize_axis(x.shape, axis)
-    res = moment_fn(x.larray, axis)
     split = stride_tricks.reduced_split(x.split, axis, keepdims)
+    if sink_op is not None and _fusion.sink_ready(x):
+        res = _fusion.defer_moment(x, sink_op, axis, keepdims, sink_kwargs or {}, split)
+        if res is not None:
+            return res
+    with _fusion.flush_reason("reduction"):
+        operand = x.larray
+    res = moment_fn(operand, axis)
     return DNDarray(res, tuple(res.shape), types.canonical_heat_type(res.dtype), split, x.device, x.comm, True)
 
 
@@ -289,7 +303,7 @@ def mean(x, axis=None, keepdims: Optional[bool] = None, keepdim: Optional[bool] 
     conflicting values raises, like the other reducers.
     """
     keep = _operations.resolve_keepdims(keepdim, keepdims)
-    return __moment(x, axis, keep, lambda a, ax: jnp.mean(a, axis=ax, keepdims=keep))
+    return __moment(x, axis, keep, lambda a, ax: jnp.mean(a, axis=ax, keepdims=keep), sink_op=jnp.mean)
 
 
 def median(x, axis=None, keepdim: bool = False) -> DNDarray:
@@ -321,7 +335,7 @@ def nanmin(x, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
 
 def nanmean(x, axis=None, keepdims: bool = False) -> DNDarray:
     """Mean ignoring NaN (numpy-API completion)."""
-    return __moment(x, axis, keepdims, lambda a, ax: jnp.nanmean(a, axis=ax, keepdims=keepdims))
+    return __moment(x, axis, keepdims, lambda a, ax: jnp.nanmean(a, axis=ax, keepdims=keepdims), sink_op=jnp.nanmean)
 
 
 def min(x, axis=None, out=None, keepdim=None, keepdims=None) -> DNDarray:
@@ -433,7 +447,10 @@ def std(x, axis=None, ddof: int = 0, **kwargs) -> DNDarray:
     if not isinstance(ddof, int) or ddof < 0:
         raise ValueError(f"ddof must be a non-negative integer, got {ddof}")
     keep = _operations.resolve_keepdims(kwargs.get("keepdim"), kwargs.get("keepdims"))
-    return __moment(x, axis, keep, lambda a, ax: jnp.std(a, axis=ax, ddof=ddof, keepdims=keep))
+    return __moment(
+        x, axis, keep, lambda a, ax: jnp.std(a, axis=ax, ddof=ddof, keepdims=keep),
+        sink_op=jnp.std, sink_kwargs={"ddof": ddof},
+    )
 
 
 def var(x, axis=None, ddof: int = 0, **kwargs) -> DNDarray:
@@ -443,7 +460,10 @@ def var(x, axis=None, ddof: int = 0, **kwargs) -> DNDarray:
     if not isinstance(ddof, int) or ddof < 0:
         raise ValueError(f"ddof must be a non-negative integer, got {ddof}")
     keep = _operations.resolve_keepdims(kwargs.get("keepdim"), kwargs.get("keepdims"))
-    return __moment(x, axis, keep, lambda a, ax: jnp.var(a, axis=ax, ddof=ddof, keepdims=keep))
+    return __moment(
+        x, axis, keep, lambda a, ax: jnp.var(a, axis=ax, ddof=ddof, keepdims=keep),
+        sink_op=jnp.var, sink_kwargs={"ddof": ddof},
+    )
 
 
 DNDarray.argmax = argmax

@@ -43,6 +43,7 @@ __all__ = [
     "placement",
     "collective",
     "fusion_defer",
+    "fusion_sink",
     "fusion_flush",
     "fusion_elided_write",
     "record_io",
@@ -115,11 +116,20 @@ def fusion_defer(kind: str) -> None:
     REGISTRY.counter("fusion.ops_deferred").inc(label=kind)
 
 
-def fusion_flush(chain_len: int, cache_hit: bool, compiled: bool) -> None:
+def fusion_sink(kind: str) -> None:
+    """One reduction absorbed as a sink of a pending expression DAG instead
+    of flushing it (kind: reduce/cum/moment/norm/vecdot)."""
+    REGISTRY.counter("fusion.reduction_sinks").inc(label=kind)
+
+
+def fusion_flush(chain_len: int, cache_hit: bool, compiled: bool, reason: str = "other") -> None:
     """One pending-expression flush through a fused jitted kernel: flush
-    count, trace-cache hit/compile split, and the chain-length histogram
-    (how many ops each fused kernel absorbed)."""
+    count, trace-cache hit/compile split, the chain-length histogram (how
+    many ops each fused kernel absorbed), and the flush-reason breakdown
+    (*why* the chain broke: reduction/cumulative/print/indexing/io/
+    collective/out-alias/export/chain-bound/other)."""
     REGISTRY.counter("fusion.flushes").inc()
+    REGISTRY.counter("fusion.flush_reason").inc(label=reason)
     if cache_hit:
         REGISTRY.counter("fusion.cache_hits").inc()
     if compiled:

@@ -114,6 +114,16 @@ def telemetry() -> dict:
         "counters": {k: v for k, v in counters.items() if v},
         "spans": spans,
     }
+    # why-did-the-chain-break breakdown (ISSUE 4): the labelled
+    # fusion.flush_reason / fusion.reduction_sinks counters keep their labels
+    # in the compact block — a single total hides exactly the answer
+    for name, key in (
+        ("fusion.flush_reason", "fusion_flush_reasons"),
+        ("fusion.reduction_sinks", "fusion_reduction_sinks"),
+    ):
+        val = snap["metrics"]["counters"].get(name)
+        if isinstance(val, dict) and val.get("labels"):
+            out[key] = dict(val["labels"])
     mem = {k: v for k, v in snap["metrics"]["gauges"].items() if k.startswith("memory.")}
     if mem:
         out["memory"] = mem
