@@ -709,7 +709,30 @@ def main():
                     "fusion_speedup": None,
                     "fused_reduction_valid": None,
                     "reduction_sink_speedup": None,
+                    "fused_view_chain_valid": None,
+                    "view_fusion_speedup": None,
                     "elementwise_error": repr(e)[:160],
+                }
+        # GEMM-producer epilogue anchors (ISSUE 5): act(x@w+b) through the
+        # fusion engine's producer path — bias+activation fused into the
+        # GEMM's XLA program — vs the same-process HEAT_TPU_FUSION_GEMM=0
+        # baseline; *_valid gated on sample spread (the 1-core container is
+        # GEMM-compute-bound, so the speedup understates TPU-host headroom)
+        gemm_epi = {}
+        if os.environ.get("BENCH_FAST") != "1":
+            try:
+                _add_benchmarks_path()
+                from matmul_mfu_bench import bench_epilogue
+
+                with _mev.span("bench.matmul_epilogue"):
+                    gemm_epi = bench_epilogue()
+            except Exception as e:
+                # explicit null-valued keys, like the neighbouring benches: a
+                # crashed anchor must be distinguishable from a BENCH_FAST skip
+                gemm_epi = {
+                    "matmul_epilogue_valid": None,
+                    "epilogue_fusion_speedup": None,
+                    "matmul_epilogue_error": repr(e)[:160],
                 }
         # out-of-core input pipeline (VERDICT r4 #8): native prefetcher vs h5py
         io_pipe = {}
@@ -764,6 +787,7 @@ def main():
                 "dp8_cpu_sharding_overhead_pct": scale8_overhead,
                 **linalg,
                 **elemwise,
+                **gemm_epi,
                 **io_pipe,
                 "telemetry": telemetry,
             }

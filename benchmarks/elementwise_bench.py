@@ -27,6 +27,16 @@ paired-differencing and physics gating as every other bench surface
   standalone reduce read: 3·N·4 bytes). ``reduction_sink_speedup`` is the
   ratio of the two gated medians; the sink pairs are gated at 1.05× the HBM
   roofline through the N·4 bytes/step floor.
+* ``fused_view_chain_gbps`` (ISSUE 5) — an 8-op f32 chain with a mid-chain
+  transpose + basic row-slice (half the rows), executed through the view-node
+  path: ONE kernel reading N·4 bytes and writing (N/2)·4 — the single-read
+  traffic floor — vs the same-process ``HEAT_TPU_FUSION_VIEWS=0`` baseline,
+  where the transpose and the slice read each break the chain (pre-view
+  kernel read+write, transpose read+write, slice read + half-write, post-view
+  chain on the half: 6.5·N·4 bytes). ``view_fusion_speedup`` is the ratio of
+  the two gated medians. Both anchors carry ``*_valid`` flags: on the 1-core
+  dev container the chain is compute-bound and the speedup understates the
+  TPU-host headroom the 6.5:1.5 traffic ratio implies.
 
 Run: python benchmarks/elementwise_bench.py
 """
@@ -167,6 +177,96 @@ def bench_fused_reduction(ht, roofline, rng):
     return out
 
 
+N_SIDE = 4096  # 4096^2 f32 = 64 MB: the 2-D operand of the view-chain anchor
+
+
+def _view_chain(ht, x):
+    """8 recordable ops with a mid-chain transpose + basic row slice (half
+    the rows): through the view-node path the whole thing is ONE kernel that
+    reads the operand once; with HEAT_TPU_FUSION_VIEWS=0 the transpose and
+    the slice read each flush the pending chain."""
+    y = x * 1.0000001
+    y = y + 0.25
+    y = ht.abs(y)
+    y = y.T                      # view: transpose
+    y = y[: N_SIDE // 2]         # view: basic slice read (half the rows)
+    y = ht.sqrt(y)
+    y = y * 0.5
+    y = ht.maximum(y, 0.015625)
+    return y
+
+
+def _make_view_run(ht, base, views: bool):
+    def run(steps, eps):
+        os.environ["HEAT_TPU_FUSION"] = "1"
+        os.environ["HEAT_TPU_FUSION_VIEWS"] = "1" if views else "0"
+        x = base * np.float32(_perturb(eps, 2.0**-18))
+        np.asarray(x.larray)  # perturbation lands before the clock starts
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            x2 = _view_chain(ht, x)
+            x2.parray  # noqa: B018 — flush barrier (async dispatch)
+        np.asarray(x2.larray)  # clock stops when the last kernel's bytes land
+        return time.perf_counter() - t0
+
+    return run
+
+
+def _view_rate(ht, base, views, bytes_per_step, ceiling_gbps):
+    run = _make_view_run(ht, base, views)
+    run(1, 0.0)  # compile + warm
+    calib = 2.0 / max(run(2, 1e-7), 1e-9)
+    valid, total, discarded = _gated_rates(
+        run, calib, bytes_per_step, ceiling_gbps, long_seconds=0.6
+    )
+    if not valid:
+        return None, 0.0, total, discarded
+    return float(np.median(valid)), _spread_pct(valid), total, discarded
+
+
+def bench_fused_view_chain(ht, roofline, rng):
+    """Gated ``fused_view_chain_gbps`` + ``view_fusion_speedup`` anchors
+    (ISSUE 5 acceptance): 8-op chain with a mid-chain transpose + slice,
+    view-node path vs the same-process ``HEAT_TPU_FUSION_VIEWS=0`` baseline."""
+    out = {}
+    prev_views = os.environ.get("HEAT_TPU_FUSION_VIEWS")
+    base = ht.array(rng.random((N_SIDE, N_SIDE), dtype=np.float32))
+    n = N_SIDE * N_SIDE
+    # single fused kernel: one full read, one half write
+    view_bytes = n * 4 + (n // 2) * 4
+    # views off: pre-view chain (read+write), transpose (read+write), slice
+    # (read + half write), post-view chain on the half (read+write)
+    noview_bytes = (2 + 2 + 1.5 + 1) * n * 4
+    try:
+        v_rate, v_jit, v_tot, v_disc = _view_rate(ht, base, True, view_bytes, roofline)
+        n_rate, _, _, _ = _view_rate(ht, base, False, noview_bytes, roofline)
+    finally:
+        if prev_views is None:
+            os.environ.pop("HEAT_TPU_FUSION_VIEWS", None)
+        else:
+            os.environ["HEAT_TPU_FUSION_VIEWS"] = prev_views
+    if v_rate is not None:
+        gbps = view_bytes * v_rate / 1e9
+        out["fused_view_chain_gbps"] = round(gbps, 1)
+        out["fused_view_chain_roofline_pct"] = (
+            round(100.0 * gbps / roofline, 1) if roofline else None
+        )
+        out["fused_view_chain_jitter_pct"] = round(v_jit, 2)
+        out["fused_view_chain_valid"] = bool(
+            v_tot - v_disc >= MIN_VALID and v_jit < 10.0
+        )
+    else:
+        out["fused_view_chain_valid"] = False
+    if n_rate is not None:
+        out["fused_view_chain_noviews_gbps"] = round(noview_bytes * n_rate / 1e9, 1)
+    if v_rate is not None and n_rate is not None:
+        # both legs run the SAME logical chain in the same process; the
+        # gated-median rate ratio IS the wall-clock speedup of keeping the
+        # views inside the kernel
+        out["view_fusion_speedup"] = round(v_rate / n_rate, 2)
+    return out
+
+
 def bench_elementwise():
     import jax
 
@@ -208,6 +308,7 @@ def bench_elementwise():
             out["fusion_speedup"] = round(f_rate / e_rate, 2)
 
         out.update(bench_fused_reduction(ht, roofline, rng))
+        out.update(bench_fused_view_chain(ht, roofline, rng))
 
         small = ht.array(rng.random(N_SMALL, dtype=np.float32))
         df_rate, df_jit, df_tot, df_disc = _rate(

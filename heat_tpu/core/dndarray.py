@@ -807,6 +807,9 @@ class DNDarray:
         return int(val)
 
     def __iter__(self):
+        # materialize once up front: per-row deferred view reads of a fresh
+        # pending chain would otherwise compile one kernel per row
+        self._flush("indexing")
         for i in range(len(self)):
             yield self[i]
 
@@ -1027,6 +1030,12 @@ class DNDarray:
                     new_split = pos
         return tuple(norm), new_split, fast
 
+    def _index_plan(self, key):
+        """Package-internal alias of the name-mangled ``__index_plan`` — the
+        fusion engine plans deferred basic-slice reads with it
+        (``core/fusion.py:defer_getitem``)."""
+        return self.__index_plan(key)
+
     def __getitem__(self, key) -> "DNDarray":
         """
         Global indexing: accepts ints, slices, ellipsis, newaxis, boolean masks,
@@ -1037,7 +1046,20 @@ class DNDarray:
         advanced keys — the result is then distributed along the broadcast
         block's leading axis (numpy's block-placement rules); in every case the
         result is re-placed on its inferred split axis.
+
+        A basic read (ints/slices/Ellipsis/newaxis, non-scalar result) over a
+        PENDING fused expression records a view node instead of flushing the
+        chain (``core/fusion.py``; ``HEAT_TPU_FUSION_VIEWS=0`` restores the
+        flush-at-read behavior); advanced keys and writes keep today's
+        barrier semantics.
         """
+        if self.__lazy is not None:
+            from . import fusion as _fusion
+
+            if _fusion.view_ready(self):
+                res = _fusion.defer_getitem(self, key)
+                if res is not None:
+                    return res
         self._flush("indexing")
         norm, new_split, fast = self.__index_plan(key)
         if fast:

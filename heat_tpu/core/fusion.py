@@ -17,9 +17,10 @@ Design (see ``doc/fusion_notes.md`` for the full narrative):
   ``defer_cast`` accept the exact operand set the eager template would have
   executed and return a deferred ``DNDarray`` (or ``None`` — caller falls back
   to the unchanged eager path). Only whitelisted, shape-preserving jnp
-  elementwise callables are recorded; everything else (reductions,
-  cumulatives, collectives, ``out=`` writes, shape-changing ops, operands
-  traced inside someone else's ``jit``) keeps today's op-at-a-time execution.
+  elementwise callables are recorded here; structural ops and GEMMs have
+  their own node kinds (see below), and everything else (collectives,
+  ``out=`` writes, data-dependent-shape ops, operands traced inside someone
+  else's ``jit``) keeps today's op-at-a-time execution.
   Scalar operands enter the trace as runtime *arguments* with the exact aval
   eager dispatch gives them (Python scalars weak-typed, np scalars strong) so
   XLA cannot constant-fold them (``x / 3.0`` must stay a division, not become
@@ -59,6 +60,33 @@ Design (see ``doc/fusion_notes.md`` for the full narrative):
   ops without hitting a barrier is flushed at record time, so unbounded
   rebind loops compile a small set of fixed-size kernels instead of one
   kernel per chain length.
+* **View nodes.** Structural ops — ``transpose``, ``broadcast_to``,
+  ``expand_dims``/``squeeze``, ``flip``/``fliplr``/``flipud``, basic-slice
+  ``__getitem__`` reads, and split-preserving ``reshape``/``flatten`` — over a
+  *pending* chain record a view ``_Node`` instead of flushing it: the data
+  movement happens in-register inside the fused kernel, so a mid-chain
+  transpose or strided read costs zero extra HBM passes. Each node carries its
+  own split-axis remapping and padded-ragged rule: pad either rides through
+  unchanged (transpose and friends keep the pad at the end of the remapped
+  split axis), or the node re-establishes the canonical padded layout in-trace
+  (a split-axis slice pads its ragged result with zeros — pad content is
+  unspecified by contract). The cases where neither rule applies — an
+  asymmetric pad situation (flip/squeeze/reshape across a padded split axis)
+  or a stepped split-axis slice — keep today's eager fallback, counted in
+  ``fusion.view_fallbacks``. ``HEAT_TPU_FUSION_VIEWS=0`` (read per dispatch)
+  restores views-as-barriers bit for bit.
+* **GEMM producers.** ``linalg.matmul``/``dot`` (``@``) record a *producer*
+  ``_Node`` over pending or concrete operands at the declared ``precision``
+  instead of dispatching a standalone GEMM: downstream bias-add / activation /
+  cast chains then flush with it as ONE XLA program, and XLA fuses the
+  epilogue into the MXU GEMM (a loss epilogue additionally rides the
+  reduction sinks below — ``act(x @ w + b)`` → ``mean`` is one kernel).
+  Sub-32-bit float GEMMs fall back (same excess-precision reasoning as
+  ``_low_float`` sinks: a fused epilogue could legally read the f32
+  accumulator before the bf16 output rounding), as do padded operands (the
+  eager path contracts the sliced logical view — an in-trace pad slice would
+  reassociate the ragged shards' partial products). ``HEAT_TPU_FUSION_GEMM=0``
+  (read per dispatch) restores GEMMs-as-barriers bit for bit.
 * **Reduction sinks.** Reductions, cumulatives, moments and norms are *sinks*
   of the pending DAG rather than flush triggers: ``__reduce_op``/``__cum_op``
   (and the statistics/linalg epilogue routes) record a sink ``_Node`` whose
@@ -78,13 +106,14 @@ Design (see ``doc/fusion_notes.md`` for the full narrative):
   op-at-a-time execution bit for bit (read per dispatch, same pattern as
   ``HEAT_TPU_BLOCKED_LINALG``).
 
-Monitoring: ``fusion.ops_deferred`` (labelled binary/local/where/cast),
-``fusion.reduction_sinks`` (labelled reduce/cum/moment/norm/vecdot),
+Monitoring: ``fusion.ops_deferred`` (labelled binary/local/where/cast/view/
+gemm), ``fusion.reduction_sinks`` (labelled reduce/cum/moment/norm/vecdot),
+``fusion.view_fallbacks`` (labelled asymmetric-pad/stepped-split-slice),
 ``fusion.flushes``/``fusion.kernels_compiled``/``fusion.cache_hits``,
 ``fusion.flush_reason`` (labelled reduction/cumulative/print/indexing/io/
-collective/out-alias/export/chain-bound/other — *why* each chain broke),
-``fusion.elided_writes``, and the ``fusion.chain_length`` histogram, all
-through ``monitoring/instrument.py``; :func:`cache_info` reports
+collective/out-alias/export/chain-bound/linalg/other — *why* each chain
+broke), ``fusion.elided_writes``, and the ``fusion.chain_length`` histogram,
+all through ``monitoring/instrument.py``; :func:`cache_info` reports
 entries/hits/misses/evictions of the trace LRU.
 """
 
@@ -110,6 +139,9 @@ __all__ = [
     "enabled",
     "sinks_enabled",
     "sink_ready",
+    "views_enabled",
+    "view_ready",
+    "gemm_enabled",
     "is_deferred",
     "pending_count",
     "flush",
@@ -119,6 +151,9 @@ __all__ = [
     "defer_local",
     "defer_where",
     "defer_cast",
+    "defer_view",
+    "defer_getitem",
+    "defer_matmul",
     "defer_reduce",
     "defer_moment",
     "defer_cum",
@@ -164,6 +199,33 @@ def sink_ready(x) -> bool:
     if node is None or node.value is not None:
         return False
     return enabled() and sinks_enabled()
+
+
+def views_enabled() -> bool:
+    """Whether structural/view ops record DAG nodes over pending chains
+    (default on). ``HEAT_TPU_FUSION_VIEWS=0`` keeps elementwise fusion on but
+    restores the pre-view behavior bit for bit: every transpose / broadcast /
+    basic-slice read / reshape over a pending chain flushes it and executes
+    as a standalone dispatch. Read per dispatch."""
+    val = os.environ.get("HEAT_TPU_FUSION_VIEWS", "")
+    return val.strip().lower() not in ("0", "false", "off")
+
+
+def view_ready(x) -> bool:
+    """Whether ``x`` carries a pending expression a structural op may record
+    a view node over (fusion + views enabled)."""
+    if not isinstance(x, DNDarray) or x._expr() is None:
+        return False
+    return enabled() and views_enabled()
+
+
+def gemm_enabled() -> bool:
+    """Whether ``matmul``/``dot`` record GEMM producer nodes (default on).
+    ``HEAT_TPU_FUSION_GEMM=0`` keeps elementwise fusion on but restores the
+    pre-producer behavior bit for bit: every GEMM flushes its operands and
+    dispatches standalone. Read per dispatch."""
+    val = os.environ.get("HEAT_TPU_FUSION_GEMM", "")
+    return val.strip().lower() not in ("0", "false", "off")
 
 
 def _donate_enabled() -> bool:
@@ -375,7 +437,8 @@ class _ReasonCtx:
 def flush_reason(reason: str) -> _ReasonCtx:
     """Context manager annotating why any flush inside the block happened
     (``fusion.flush_reason{reason}``). Taxonomy: reduction / cumulative /
-    print / indexing / io / collective / out-alias / export / chain-bound."""
+    print / indexing / io / collective / out-alias / export / chain-bound /
+    linalg."""
     return _ReasonCtx(reason)
 
 
@@ -722,6 +785,338 @@ def defer_cast(x: DNDarray, heat_dtype) -> Optional[DNDarray]:
     aval = jax.ShapeDtypeStruct(tuple(x.pshape), dt)
     node = _Node(fn, okey, (inp,), (), None, aval)
     return _finish(node, tuple(x.shape), heat_dtype, x.split, x.device, x.comm, "cast")
+
+
+# ------------------------------------------------------------------ view nodes
+#
+# A view node records one structural op — pure data movement, no arithmetic —
+# over a pending chain, so a transpose / broadcast / basic-slice read /
+# split-preserving reshape mid-chain moves data in-register instead of
+# breaking the chain with a flush. The callable operates on the PHYSICAL
+# array; the per-node padded-ragged rule is one of:
+#
+# * pad passthrough — the op keeps the padded split extent intact and the pad
+#   at the global end of the (possibly remapped) split axis: transpose,
+#   expand_dims, squeeze/flip on non-split axes, extent-preserving
+#   broadcast_to and reshape;
+# * in-trace re-pad — the raw result is the full logical array whose
+#   canonical layout is ragged on the result split axis (a basic split-axis
+#   slice): the node appends a ``jnp.pad`` establishing the canonical padded
+#   layout (zero pad content — unspecified by contract);
+# * eager fallback, counted in ``fusion.view_fallbacks`` — asymmetric pad
+#   situations (flip/squeeze/reshape across a padded split axis, a padded
+#   broadcast source) and stepped split-axis slices, whose pad motion has no
+#   cheap in-trace form.
+#
+# Every static parameter (permutation, targets, encoded index keys, pad
+# widths) is part of the node's ``op_key`` and therefore of the trace-LRU key.
+
+_VIEW_FNS: dict = {}
+
+
+def _decode_key_entry(e):
+    """Inverse of the hashable index-key encoding (slices are unhashable on
+    py3.10, so ``defer_getitem`` stores them as ``('s', start, stop, step)``
+    tuples)."""
+    if isinstance(e, tuple) and len(e) == 4 and e[0] == "s":
+        return slice(e[1], e[2], e[3])
+    return e  # int / None (newaxis)
+
+
+def _view_fn_for(kind: str, params: tuple, padw):
+    """Memoized view callable per static signature (node identity, the
+    abstract-eval cache, and the trace LRU all see one object per signature).
+    ``padw`` appends an in-trace canonical re-pad of a ragged result."""
+    key = (kind, params, padw)
+    fn = _VIEW_FNS.get(key)
+    if fn is not None:
+        return fn
+    if kind == "transpose":
+        (axes,) = params
+
+        def base(v, _a=axes):
+            return jnp.transpose(v, _a)
+    elif kind == "flip":
+        (axes,) = params
+
+        def base(v, _a=axes):
+            return jnp.flip(v, axis=_a)
+    elif kind == "expand_dims":
+        (axis,) = params
+
+        def base(v, _a=axis):
+            return jnp.expand_dims(v, _a)
+    elif kind == "squeeze":
+        (axes,) = params
+
+        def base(v, _a=axes):
+            return jnp.squeeze(v, axis=_a)
+    elif kind == "broadcast_to":
+        (target,) = params
+
+        def base(v, _t=target):
+            return jnp.broadcast_to(v, _t)
+    elif kind == "reshape":
+        (target,) = params
+
+        def base(v, _t=target):
+            return v.reshape(_t)
+    elif kind == "getitem":
+        (enc,) = params
+        idx = tuple(_decode_key_entry(e) for e in enc)
+
+        def base(v, _i=idx):
+            return v[_i]
+    else:  # pragma: no cover — internal kinds only
+        raise ValueError(f"unknown view kind {kind!r}")
+    if padw is None:
+        fn = base
+    else:
+
+        def fn(v, _b=base, _w=padw):
+            return jnp.pad(_b(v), _w)
+
+    _VIEW_FNS[key] = fn
+    return fn
+
+
+def _view_fallback(kind: str) -> None:
+    if _MON.enabled:
+        _instr.fusion_view_fallback(kind)
+
+
+def defer_view(
+    x: DNDarray, kind: str, params: tuple, out_gshape, out_split, res_dtype=None
+) -> Optional[DNDarray]:
+    """Record one structural op over ``x``'s pending expression as a view
+    node. ``params`` are the op's static parameters (``broadcast_to`` /
+    ``reshape`` derive their physical target internally); ``out_gshape`` /
+    ``out_split`` are the logical result shape and remapped split axis the
+    eager dispatch would produce. Returns the deferred result, or None to
+    fall back to the (flushing) eager path."""
+    from .communication import MeshCommunication
+    from .types import canonical_heat_type
+
+    out_gshape = tuple(int(s) for s in out_gshape)
+    comm = x.comm
+    distributed = (
+        out_split is not None
+        and isinstance(comm, MeshCommunication)
+        and comm.is_distributed()
+    )
+    expected = comm.padded_shape(out_gshape, out_split) if distributed else out_gshape
+    padded = x.is_padded
+    s_ax = None if x.split is None else int(x.split) % max(x.ndim, 1)
+
+    if padded:
+        # per-node pad legality: either the pad rides through unchanged or
+        # the node can re-establish the canonical layout in-trace; anything
+        # else falls back (counted — deferred work the engine had to give up)
+        if kind in ("flip", "squeeze"):
+            (axes,) = params
+            if s_ax in axes:
+                _view_fallback("asymmetric-pad")
+                return None
+        elif kind == "reshape":
+            k = out_split
+            if (
+                k is None
+                or out_gshape[k] != x.shape[s_ax]
+                or int(np.prod(out_gshape[:k], dtype=np.int64))
+                != int(np.prod(x.shape[:s_ax], dtype=np.int64))
+            ):
+                # the padded split extent must survive as its own axis with an
+                # unchanged leading block — otherwise the physical reshape
+                # would interleave pad rows into logical positions
+                _view_fallback("asymmetric-pad")
+                return None
+        elif kind == "broadcast_to":
+            if out_split is None or x.shape[s_ax] != out_gshape[out_split]:
+                _view_fallback("asymmetric-pad")
+                return None
+        elif kind == "getitem":
+            (enc,) = params
+            in_ax = 0
+            for e in enc:
+                if e is None:
+                    continue
+                if isinstance(e, tuple) and e[0] == "s":
+                    if in_ax == s_ax and e[3] != 1:
+                        # a stepped split-axis slice reorders/strides through
+                        # the pad boundary — no cheap in-trace form
+                        _view_fallback("stepped-split-slice")
+                        return None
+                    in_ax += 1
+                else:  # integer index
+                    in_ax += 1
+
+    if kind in ("broadcast_to", "reshape"):
+        # these two take a target shape: the PHYSICAL one, so the pad (when
+        # present) broadcasts/regroups along for the ride
+        params = (expected,)
+
+    inp = _input_of(x)
+    if inp is None:
+        return None
+    fn = _view_fn_for(kind, params, None)
+    okey = ("view", kind, params, None)
+    try:
+        aval = _eval_node(fn, okey, (inp,), (), None)
+    except Exception:
+        if padded:
+            _view_fallback("asymmetric-pad")
+        return None  # invalid op for this shape: the eager dispatch raises
+    if tuple(aval.shape) != expected:
+        if tuple(aval.shape) != out_gshape or not distributed:
+            if padded:
+                _view_fallback("asymmetric-pad")
+            return None
+        # the raw result is the full logical array whose canonical layout is
+        # ragged on the result split axis (split-axis slice shrank it):
+        # re-establish the padded layout in-trace (pad content unspecified)
+        padw = tuple(
+            (0, int(expected[d]) - int(out_gshape[d])) for d in range(len(expected))
+        )
+        fn = _view_fn_for(kind, params, padw)
+        okey = ("view", kind, params, padw)
+        try:
+            aval = _eval_node(fn, okey, (inp,), (), None)
+        except Exception:
+            return None
+        if tuple(aval.shape) != expected:
+            return None
+    node = _Node(fn, okey, (inp,), (), None, aval)
+    dtype = res_dtype if res_dtype is not None else canonical_heat_type(aval.dtype)
+    return _finish(node, out_gshape, dtype, out_split, x.device, x.comm, "view")
+
+
+def defer_getitem(x: DNDarray, key) -> Optional[DNDarray]:
+    """Record a basic ``__getitem__`` read (ints / slices / Ellipsis /
+    newaxis) over ``x``'s pending expression as a view node; the normalized
+    key is the exact one the eager fast path applies to :attr:`parray`.
+    Advanced keys (arrays, masks) and 0-d element reads return None — the
+    caller keeps today's flush-at-read behavior (a scalar read gains nothing
+    from deferral, and per-element probing of a fresh chain would otherwise
+    compile one kernel per index)."""
+    if not view_ready(x):
+        return None
+    norm, new_split, fast = x._index_plan(key)
+    if not fast:
+        return None
+    enc = []
+    for k in norm:
+        if k is None:
+            enc.append(None)
+        elif isinstance(k, slice):
+            enc.append(("s", k.start, k.stop, k.step))
+        elif isinstance(k, (builtins.int, np.integer)) and not isinstance(
+            k, (builtins.bool, np.bool_)
+        ):
+            enc.append(int(k))
+        else:
+            return None  # advanced key: the eager (flushing) path handles it
+    # logical result shape via a zero-copy numpy probe (basic keys only)
+    probe = np.broadcast_to(np.uint8(0), tuple(x.shape))
+    out_gshape = tuple(int(s) for s in probe[tuple(norm)].shape)
+    if out_gshape == ():
+        return None  # scalar element read: flush (see docstring)
+    return defer_view(
+        x, "getitem", (tuple(enc),), out_gshape, new_split, res_dtype=x.dtype
+    )
+
+
+# ------------------------------------------------------------------ GEMM producers
+#
+# A GEMM producer node records the exact eager ``linalg.matmul``/``dot``
+# dispatch — the promoted-dtype casts and the declared ``precision`` — so the
+# downstream bias-add/activation/cast chain flushes with the GEMM as ONE XLA
+# program and the backend fuses the epilogue into the MXU contraction (a
+# terminal reduction additionally rides the sink path: ``act(x@w+b).mean()``
+# is one kernel). Fallbacks for bit parity: sub-32-bit float GEMMs (a fused
+# epilogue may legally read the f32 accumulator before the narrow output
+# rounding — the ``_low_float`` class) and padded operands (the eager path
+# contracts the sliced logical view; an in-trace pad slice would reassociate
+# the ragged shards' partial products).
+
+_GEMM_FNS: dict = {}
+
+
+def _gemm_fn_for(op: str, cast_dt, precision):
+    key = (op, None if cast_dt is None else str(cast_dt), str(precision))
+    fn = _GEMM_FNS.get(key)
+    if fn is not None:
+        return fn
+    jfn = jnp.matmul if op == "matmul" else jnp.dot
+    if cast_dt is None:
+
+        def fn(a, b, _f=jfn, _p=precision):
+            return _f(a, b, precision=_p)
+    else:
+
+        def fn(a, b, _f=jfn, _dt=cast_dt, _p=precision):
+            return _f(a.astype(_dt), b.astype(_dt), precision=_p)
+
+    _GEMM_FNS[key] = fn
+    return fn
+
+
+def defer_matmul(
+    a: DNDarray,
+    b: DNDarray,
+    promoted,
+    precision,
+    out_gshape,
+    out_split,
+    op: str = "matmul",
+) -> Optional[DNDarray]:
+    """Record one ``matmul``/``dot`` dispatch as a GEMM producer node over
+    (possibly pending) operands. ``promoted`` is the heat-promoted dtype both
+    operands are cast to (None = the op's own jnp promotion, the ``dot``
+    path); ``out_gshape``/``out_split`` follow the caller's reference split
+    bookkeeping. Returns the deferred result, or None to fall back to the
+    (flushing) eager dispatch."""
+    from .communication import MeshCommunication
+    from .types import canonical_heat_type
+
+    if not (enabled() and gemm_enabled()):
+        return None
+    try:
+        hash(precision)
+    except TypeError:
+        return None
+    cast_dt = None if promoted is None else np.dtype(promoted.jnp_type())
+    if cast_dt is not None:
+        low = cast_dt.itemsize < 4 and bool(jnp.issubdtype(cast_dt, jnp.floating))
+    else:
+        low = _low_float(a) or _low_float(b)
+    if low:
+        return None  # sub-32-bit float GEMM: flush for bit parity (see above)
+    if a.is_padded or b.is_padded:
+        return None  # eager contracts the sliced logical view: flush
+    in_a = _input_of(a)
+    in_b = _input_of(b)
+    if in_a is None or in_b is None:
+        return None
+    fn = _gemm_fn_for(op, cast_dt, precision)
+    okey = ("gemm", op, None if cast_dt is None else str(cast_dt), str(precision))
+    try:
+        aval = _eval_node(fn, okey, (in_a, in_b), (), None)
+    except Exception:
+        return None  # dimension mismatch etc.: the eager dispatch raises it
+    out_gshape = tuple(int(s) for s in out_gshape)
+    comm = a.comm
+    expected = out_gshape
+    if (
+        out_split is not None
+        and isinstance(comm, MeshCommunication)
+        and comm.is_distributed()
+    ):
+        expected = comm.padded_shape(out_gshape, out_split)
+    if tuple(aval.shape) != expected:
+        return None
+    node = _Node(fn, okey, (in_a, in_b), (), None, aval)
+    res_dtype = canonical_heat_type(aval.dtype)
+    return _finish(node, out_gshape, res_dtype, out_split, a.device, a.comm, "gemm")
 
 
 # ------------------------------------------------------------------ reduction sinks

@@ -73,6 +73,8 @@ def cross(a: DNDarray, b: DNDarray, axisa: int = -1, axisb: int = -1, axisc: int
     linalg/basics.py:47-159)."""
     sanitation.sanitize_in(a)
     sanitation.sanitize_in(b)
+    a._flush("linalg")
+    b._flush("linalg")
     data = jnp.cross(a.larray, b.larray, axisa=axisa, axisb=axisb, axisc=axisc, axis=axis)
     return __wrap(a, data, a.split if a.split is not None and a.split < data.ndim else None)
 
@@ -95,6 +97,7 @@ def det(a: DNDarray) -> DNDarray:
     sanitation.sanitize_in(a)
     if a.ndim < 2 or a.shape[-1] != a.shape[-2]:
         raise ValueError("a must be a square matrix (or batch thereof)")
+    a._flush("linalg")
     if not types.heat_type_is_inexact(a.dtype):
         a = a.astype(types.float32)
 
@@ -131,6 +134,16 @@ def dot(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None) -> Union[DNDar
     linalg/basics.py:246-330).
     """
     if isinstance(a, DNDarray) and isinstance(b, DNDarray) and a.ndim == 1 and b.ndim == 1:
+        if out is None and _fusion.enabled():
+            # GEMM producer node over the (possibly pending) operands: the dot
+            # and any scalar epilogue chain compile as one XLA program
+            deferred = _fusion.defer_matmul(
+                a, b, None, GEMM_PRECISION, (), None, op="dot"
+            )
+            if deferred is not None:
+                return deferred
+        a._flush("linalg")
+        b._flush("linalg")
         res = jnp.dot(a.larray, b.larray, precision=GEMM_PRECISION)
         result = DNDarray(res, (), types.canonical_heat_type(res.dtype), None, a.device, a.comm, True)
         if out is not None:
@@ -164,6 +177,7 @@ def inv(a: DNDarray) -> DNDarray:
     sanitation.sanitize_in(a)
     if a.ndim < 2 or a.shape[-1] != a.shape[-2]:
         raise ValueError("a must be a square matrix (or batch thereof)")
+    a._flush("linalg")
     if not types.heat_type_is_inexact(a.dtype):
         a = a.astype(types.float32)
     if _elimination.can_distribute_elimination(a):
@@ -191,6 +205,31 @@ def inv(a: DNDarray) -> DNDarray:
     return __wrap(a, data, a.split)
 
 
+def __matmul_split(a: DNDarray, b: DNDarray, ndim: int) -> Optional[int]:
+    """Split semantics of a matmul result, following the reference: row-split
+    ``a`` gives a row-split result, column-split ``b`` a column-split result."""
+    if ndim == 0:
+        return None
+    if b.ndim == 1:
+        # matvec: result dims are a.shape[:-1]; a's split survives unless it was
+        # the contracted axis
+        return a.split if (a.split is not None and a.split < a.ndim - 1) else None
+    if a.ndim == 1:
+        # vecmat: result dims are b.shape[:-2] + b.shape[-1:]
+        if b.split is None or b.split == b.ndim - 2:
+            return None
+        if b.split == b.ndim - 1:
+            return ndim - 1
+        return b.split  # batch dims
+    if a.split == a.ndim - 2:
+        return ndim - 2
+    if b.split == b.ndim - 1:
+        return ndim - 1
+    if a.split is not None and a.split < a.ndim - 2:
+        return a.split  # batch dims
+    return None
+
+
 def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False, precision=GEMM_PRECISION) -> DNDarray:
     """
     Matrix multiplication (reference linalg/basics.py:424-1094). The reference's
@@ -199,40 +238,49 @@ def matmul(a: DNDarray, b: DNDarray, allow_resplit: bool = False, precision=GEMM
     contraction, inserts the panel collectives over ICI and overlaps them with MXU
     GEMMs. Split semantics of the result follow the reference: row-split ``a`` gives a
     row-split result, column-split ``b`` a column-split result.
+
+    With fusion on (``HEAT_TPU_FUSION_GEMM``, default), the dispatch records a
+    GEMM *producer* node in the deferred-execution DAG over pending or
+    concrete operands: downstream bias-add/activation/cast chains then
+    compile with the GEMM as one XLA program and the epilogue fuses into the
+    MXU contraction (``core/fusion.py``).
     """
     sanitation.sanitize_in(a)
     sanitation.sanitize_in(b)
     if a.ndim == 0 or b.ndim == 0:
         raise ValueError("matmul requires at least 1-dimensional operands")
     dtype = types.promote_types(a.dtype, b.dtype)
+    # static result shape + split bookkeeping, computed BEFORE any data access
+    # so a pending operand chain can absorb the GEMM as a producer node;
+    # shapes the static pass rejects fall through to the eager dispatch, whose
+    # jnp.matmul raises the canonical error
+    out_gshape = None
+    try:
+        if a.ndim == 1 and b.ndim == 1:
+            out_gshape = ()
+        elif b.ndim == 1:
+            out_gshape = tuple(a.shape[:-1])
+        elif a.ndim == 1:
+            out_gshape = tuple(b.shape[:-2]) + (b.shape[-1],)
+        else:
+            out_gshape = tuple(
+                np.broadcast_shapes(tuple(a.shape[:-2]), tuple(b.shape[:-2]))
+            ) + (a.shape[-2], b.shape[-1])
+    except ValueError:
+        out_gshape = None
+    if out_gshape is not None and _fusion.enabled():
+        split = __matmul_split(a, b, len(out_gshape))
+        deferred = _fusion.defer_matmul(a, b, dtype, precision, out_gshape, split)
+        if deferred is not None:
+            return deferred
+    a._flush("linalg")
+    b._flush("linalg")
     data = jnp.matmul(
         a.larray.astype(dtype.jnp_type()),
         b.larray.astype(dtype.jnp_type()),
         precision=precision,
     )
-    ndim = data.ndim
-    if ndim == 0:
-        split = None
-    elif b.ndim == 1:
-        # matvec: result dims are a.shape[:-1]; a's split survives unless it was
-        # the contracted axis
-        split = a.split if (a.split is not None and a.split < a.ndim - 1) else None
-    elif a.ndim == 1:
-        # vecmat: result dims are b.shape[:-2] + b.shape[-1:]
-        if b.split is None or b.split == b.ndim - 2:
-            split = None
-        elif b.split == b.ndim - 1:
-            split = ndim - 1
-        else:
-            split = b.split  # batch dims
-    elif a.split == a.ndim - 2:
-        split = ndim - 2
-    elif b.split == b.ndim - 1:
-        split = ndim - 1
-    elif a.split is not None and a.split < a.ndim - 2:
-        split = a.split  # batch dims
-    else:
-        split = None
+    split = __matmul_split(a, b, data.ndim)
     return __wrap(a, data, split)
 
 
@@ -248,6 +296,7 @@ def slogdet(a: DNDarray) -> Tuple[DNDarray, DNDarray]:
     sanitation.sanitize_in(a)
     if a.ndim < 2 or a.shape[-1] != a.shape[-2]:
         raise ValueError("a must be a square matrix (or batch thereof)")
+    a._flush("linalg")
     if not types.heat_type_is_inexact(a.dtype):
         a = a.astype(types.float32)
 
@@ -296,6 +345,8 @@ def solve(a: DNDarray, b: DNDarray) -> DNDarray:
         raise ValueError(
             f"b must be (n,) or (n, k) with n == {a.shape[0]}, got {tuple(b.shape)}"
         )
+    a._flush("linalg")
+    b._flush("linalg")
     dtype = types.promote_types(a.dtype, b.dtype)
     if not types.heat_type_is_inexact(dtype):
         dtype = types.float32
@@ -376,6 +427,8 @@ def outer(a: DNDarray, b: DNDarray, out: Optional[DNDarray] = None, split: Optio
     """
     sanitation.sanitize_in(a)
     sanitation.sanitize_in(b)
+    a._flush("linalg")
+    b._flush("linalg")
     dtype = types.promote_types(a.dtype, b.dtype)
     data = jnp.outer(a.larray.astype(dtype.jnp_type()), b.larray.astype(dtype.jnp_type()))
     if split is None:
@@ -400,6 +453,7 @@ def trace(a: DNDarray, offset: int = 0, axis1: int = 0, axis2: int = 1, dtype=No
     sanitation.sanitize_in(a)
     if a.ndim < 2:
         raise ValueError("trace requires at least 2 dimensions")
+    a._flush("linalg")
     data = jnp.trace(a.larray, offset=offset, axis1=axis1, axis2=axis2)
     data = jnp.asarray(data)
     if dtype is not None:
@@ -415,19 +469,30 @@ def trace(a: DNDarray, offset: int = 0, axis1: int = 0, axis2: int = 1, dtype=No
 
 def transpose(a: DNDarray, axes: Optional[List[int]] = None) -> DNDarray:
     """Permute array dimensions; the split axis follows the permutation (reference
-    linalg/basics.py:2051-2120)."""
+    linalg/basics.py:2051-2120). A pending fused chain on ``a`` records a view
+    node instead of flushing — the pad of a ragged split axis rides at the end
+    of the remapped axis (``core/fusion.py``)."""
     sanitation.sanitize_in(a)
     if axes is None:
         axes = list(range(a.ndim))[::-1]
     axes = [stride_tricks.sanitize_axis(a.shape, ax) for ax in axes]
-    data = jnp.transpose(a.larray, axes)
     split = axes.index(a.split) if a.split is not None else None
+    if _fusion.view_ready(a):
+        out_gshape = tuple(a.shape[ax] for ax in axes)
+        res = _fusion.defer_view(
+            a, "transpose", (tuple(int(ax) for ax in axes),), out_gshape, split
+        )
+        if res is not None:
+            return res
+    a._flush("linalg")
+    data = jnp.transpose(a.larray, axes)
     return __wrap(a, data, split)
 
 
 def tril(m: DNDarray, k: int = 0) -> DNDarray:
     """Lower triangle (reference linalg/basics.py:2121-2178)."""
     sanitation.sanitize_in(m)
+    m._flush("linalg")
     data = jnp.tril(m.larray if m.ndim > 1 else jnp.tile(m.larray, (m.shape[0], 1)), k=k)
     if m.ndim == 1:
         return DNDarray(data, tuple(data.shape), m.dtype, None, m.device, m.comm, True)
@@ -437,6 +502,7 @@ def tril(m: DNDarray, k: int = 0) -> DNDarray:
 def triu(m: DNDarray, k: int = 0) -> DNDarray:
     """Upper triangle (reference linalg/basics.py:2179-2235)."""
     sanitation.sanitize_in(m)
+    m._flush("linalg")
     data = jnp.triu(m.larray if m.ndim > 1 else jnp.tile(m.larray, (m.shape[0], 1)), k=k)
     if m.ndim == 1:
         return DNDarray(data, tuple(data.shape), m.dtype, None, m.device, m.comm, True)
@@ -448,6 +514,8 @@ def vdot(x1: DNDarray, x2: DNDarray) -> DNDarray:
     linalg/basics.py:2236-2270)."""
     sanitation.sanitize_in(x1)
     sanitation.sanitize_in(x2)
+    x1._flush("linalg")
+    x2._flush("linalg")
     data = jnp.vdot(x1.larray, x2.larray, precision=GEMM_PRECISION)
     return DNDarray(data, (), types.canonical_heat_type(data.dtype), None, x1.device, x1.comm, True)
 

@@ -195,6 +195,7 @@ def qr(
     sanitation.sanitize_in(a)
     if a.ndim != 2:
         raise ValueError(f"qr requires a 2-D DNDarray, got {a.ndim}-d")
+    a._flush("linalg")
     if not isinstance(tiles_per_proc, int) or tiles_per_proc < 1:
         raise ValueError("tiles_per_proc must be a positive int")
     if not types.heat_type_is_inexact(a.dtype):

@@ -33,6 +33,9 @@ def cg(A: DNDarray, b: DNDarray, x0: DNDarray, out: Optional[DNDarray] = None) -
         raise RuntimeError("b needs to be a 1D vector")
     if x0.ndim != 1:
         raise RuntimeError("c needs to be a 1D vector")
+    A._flush("linalg")
+    b._flush("linalg")
+    x0._flush("linalg")
 
     r = b - matmul(A, x0)
     p = r
@@ -78,6 +81,7 @@ def lanczos(
     n, column = A.shape
     if n != column:
         raise TypeError("A needs to be a square matrix")
+    A._flush("linalg")
 
     T = factories.zeros((m, m), device=A.device, comm=A.comm)
     if v0 is None:

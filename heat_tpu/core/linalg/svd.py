@@ -47,6 +47,7 @@ def svd(a: DNDarray, full_matrices: bool = False, compute_uv: bool = True):
     sanitation.sanitize_in(a)
     if a.ndim != 2:
         raise ValueError(f"svd requires a 2-D DNDarray, got {a.ndim}-d")
+    a._flush("linalg")
     if not types.heat_type_is_inexact(a.dtype):
         a = a.astype(types.float32)
     m, n = a.shape
@@ -115,6 +116,7 @@ def rsvd(
     sanitation.sanitize_in(a)
     if a.ndim != 2:
         raise ValueError(f"rsvd requires a 2-D DNDarray, got {a.ndim}-d")
+    a._flush("linalg")
     m, n = a.shape
     if not (1 <= rank <= min(m, n)):
         raise ValueError(f"rank must be in [1, min(m, n)]={min(m, n)}, got {rank}")

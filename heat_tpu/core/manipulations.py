@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from . import factories
+from . import fusion as _fusion
 from . import sanitation
 from . import stride_tricks
 from . import types
@@ -89,13 +90,19 @@ def balance(array: DNDarray, copy: bool = False) -> DNDarray:
 
 
 def broadcast_to(x: DNDarray, shape) -> DNDarray:
-    """Broadcast an array to a new shape (view semantics; numpy parity)."""
+    """Broadcast an array to a new shape (view semantics; numpy parity). A
+    pending fused chain on ``x`` records a view node instead of flushing
+    (``core/fusion.py``)."""
     sanitation.sanitize_in(x)
     shape = stride_tricks.sanitize_shape(shape)
-    data = jnp.broadcast_to(x.larray, shape)
     new_split = None if x.split is None else len(shape) - (x.ndim - x.split)
     if new_split is not None and new_split < 0:
         new_split = None
+    if _fusion.view_ready(x):
+        res = _fusion.defer_view(x, "broadcast_to", (), tuple(shape), new_split)
+        if res is not None:
+            return res
+    data = jnp.broadcast_to(x.larray, shape)
     return __wrap(x, data, new_split)
 
 
@@ -173,28 +180,51 @@ def dsplit(x: DNDarray, indices_or_sections) -> List[DNDarray]:
 
 
 def expand_dims(a: DNDarray, axis: int) -> DNDarray:
-    """Insert a new size-1 axis (reference manipulations.py expand_dims)."""
+    """Insert a new size-1 axis (reference manipulations.py expand_dims). A
+    pending fused chain on ``a`` records a view node instead of flushing."""
     sanitation.sanitize_in(a)
     axis = stride_tricks.sanitize_axis(tuple(a.shape) + (1,), axis)
-    data = jnp.expand_dims(a.larray, axis)
     split = a.split
     if split is not None and axis <= split:
         split += 1
+    if _fusion.view_ready(a):
+        out_gshape = tuple(a.shape[:axis]) + (1,) + tuple(a.shape[axis:])
+        res = _fusion.defer_view(a, "expand_dims", (int(axis),), out_gshape, split)
+        if res is not None:
+            return res
+    data = jnp.expand_dims(a.larray, axis)
     return __wrap(a, data, split)
 
 
 def flatten(a: DNDarray) -> DNDarray:
-    """Flatten to one dimension (reference manipulations.py flatten)."""
+    """Flatten to one dimension (reference manipulations.py flatten). A
+    pending fused chain records a (reshape) view node instead of flushing."""
     sanitation.sanitize_in(a)
-    data = a.larray.reshape(-1)
     split = None if a.split is None else 0
+    if _fusion.view_ready(a):
+        res = _fusion.defer_view(a, "reshape", (), (a.size,), split)
+        if res is not None:
+            return res
+    data = a.larray.reshape(-1)
     return __wrap(a, data, split)
 
 
 def flip(a: DNDarray, axis=None) -> DNDarray:
-    """Reverse element order along the given axes (reference manipulations.py flip)."""
+    """Reverse element order along the given axes (reference manipulations.py
+    flip). A pending fused chain records a view node instead of flushing
+    (flips touching a padded split axis keep the eager fallback, counted)."""
     sanitation.sanitize_in(a)
     axis = stride_tricks.sanitize_axis(a.shape, axis)
+    if _fusion.view_ready(a):
+        if axis is None:
+            axes_t = tuple(range(a.ndim))
+        elif isinstance(axis, (int, np.integer)):
+            axes_t = (int(axis),)
+        else:
+            axes_t = tuple(int(v) for v in axis)
+        res = _fusion.defer_view(a, "flip", (axes_t,), tuple(a.shape), a.split)
+        if res is not None:
+            return res
     data = jnp.flip(a.larray, axis=axis)
     return __wrap(a, data, a.split)
 
@@ -300,6 +330,29 @@ def reshape(a: DNDarray, *shape, new_split: Optional[int] = None, **kwargs) -> D
     neg = [i for i, s in enumerate(shape) if s == -1]
     if len(neg) > 1:
         raise ValueError("can only specify one unknown dimension")
+    # static resolution of the one free dimension: a pending fused chain can
+    # then record a view node without touching data (core/fusion.py); shapes
+    # the static pass cannot resolve keep the eager path, whose jnp reshape
+    # raises the canonical error
+    resolved = shape
+    if neg:
+        known = int(np.prod([s for s in shape if s != -1], dtype=np.int64))
+        if known > 0 and a.size % known == 0:
+            resolved = tuple(a.size // known if s == -1 else s for s in shape)
+        else:
+            resolved = None
+    if resolved is not None and _fusion.view_ready(a):
+        ns = new_split
+        if ns is None:
+            ns = None if a.split is None else (
+                a.split
+                if a.split < len(resolved) and resolved[a.split] == a.shape[a.split]
+                else 0
+            )
+        ns = stride_tricks.sanitize_axis(resolved, ns)
+        res = _fusion.defer_view(a, "reshape", (), resolved, ns)
+        if res is not None:
+            return res
     data = a.larray.reshape(shape)
     if new_split is None:
         new_split = None if a.split is None else (a.split if a.split < data.ndim and
@@ -486,21 +539,30 @@ def split(x: DNDarray, indices_or_sections, axis: int = 0) -> List[DNDarray]:
 
 
 def squeeze(x: DNDarray, axis=None) -> DNDarray:
-    """Remove size-1 axes (reference manipulations.py squeeze)."""
+    """Remove size-1 axes (reference manipulations.py squeeze). A pending
+    fused chain records a view node instead of flushing (squeezes of a padded
+    split axis keep the eager fallback, counted)."""
     sanitation.sanitize_in(x)
     axis = stride_tricks.sanitize_axis(x.shape, axis)
-    data = jnp.squeeze(x.larray, axis=axis)
+    removed = (
+        [i for i, s in enumerate(x.shape) if s == 1]
+        if axis is None
+        else ([axis] if isinstance(axis, int) else list(axis))
+    )
     split = x.split
     if split is not None:
-        removed = (
-            [i for i, s in enumerate(x.shape) if s == 1]
-            if axis is None
-            else ([axis] if isinstance(axis, int) else list(axis))
-        )
         if split in removed:
             split = None
         else:
             split -= sum(1 for r in removed if r < split)
+    if _fusion.view_ready(x):
+        out_gshape = tuple(s for i, s in enumerate(x.shape) if i not in removed)
+        res = _fusion.defer_view(
+            x, "squeeze", (tuple(int(r) for r in removed),), out_gshape, split
+        )
+        if res is not None:
+            return res
+    data = jnp.squeeze(x.larray, axis=axis)
     return __wrap(x, data, split)
 
 

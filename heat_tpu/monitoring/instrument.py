@@ -44,6 +44,7 @@ __all__ = [
     "collective",
     "fusion_defer",
     "fusion_sink",
+    "fusion_view_fallback",
     "fusion_flush",
     "fusion_elided_write",
     "record_io",
@@ -111,8 +112,8 @@ def collective(kind: str) -> None:
 
 
 def fusion_defer(kind: str) -> None:
-    """One elementwise op recorded in the deferred-execution DAG instead of
-    dispatched eagerly (kind: binary/local/where/cast)."""
+    """One op recorded in the deferred-execution DAG instead of dispatched
+    eagerly (kind: binary/local/where/cast/view/gemm)."""
     REGISTRY.counter("fusion.ops_deferred").inc(label=kind)
 
 
@@ -122,12 +123,19 @@ def fusion_sink(kind: str) -> None:
     REGISTRY.counter("fusion.reduction_sinks").inc(label=kind)
 
 
+def fusion_view_fallback(kind: str) -> None:
+    """One structural op over a pending chain that had to take the eager
+    (flushing) fallback because its pad motion has no in-trace form (kind:
+    asymmetric-pad / stepped-split-slice)."""
+    REGISTRY.counter("fusion.view_fallbacks").inc(label=kind)
+
+
 def fusion_flush(chain_len: int, cache_hit: bool, compiled: bool, reason: str = "other") -> None:
     """One pending-expression flush through a fused jitted kernel: flush
     count, trace-cache hit/compile split, the chain-length histogram (how
     many ops each fused kernel absorbed), and the flush-reason breakdown
     (*why* the chain broke: reduction/cumulative/print/indexing/io/
-    collective/out-alias/export/chain-bound/other)."""
+    collective/out-alias/export/chain-bound/linalg/other)."""
     REGISTRY.counter("fusion.flushes").inc()
     REGISTRY.counter("fusion.flush_reason").inc(label=reason)
     if cache_hit:

@@ -114,12 +114,16 @@ def telemetry() -> dict:
         "counters": {k: v for k, v in counters.items() if v},
         "spans": spans,
     }
-    # why-did-the-chain-break breakdown (ISSUE 4): the labelled
-    # fusion.flush_reason / fusion.reduction_sinks counters keep their labels
-    # in the compact block — a single total hides exactly the answer
+    # why-did-the-chain-break breakdown (ISSUEs 4/5): the labelled
+    # fusion.flush_reason / fusion.reduction_sinks / fusion.ops_deferred /
+    # fusion.view_fallbacks counters keep their labels in the compact block —
+    # a single total hides exactly the answer (which node kinds deferred, and
+    # which structural ops had to give up)
     for name, key in (
         ("fusion.flush_reason", "fusion_flush_reasons"),
         ("fusion.reduction_sinks", "fusion_reduction_sinks"),
+        ("fusion.ops_deferred", "fusion_ops_deferred"),
+        ("fusion.view_fallbacks", "fusion_view_fallbacks"),
     ):
         val = snap["metrics"]["counters"].get(name)
         if isinstance(val, dict) and val.get("labels"):
