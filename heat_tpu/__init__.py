@@ -18,6 +18,7 @@ from . import naive_bayes
 from . import nn
 from . import optim
 from . import regression
+from . import robustness
 from . import spatial
 from . import utils
 

@@ -28,6 +28,7 @@ from ._compat import shard_map as _shard_map
 # observability: disabled-path cost is one truthiness check (see monitoring/)
 from ..monitoring.registry import STATE as _MON
 from ..monitoring import instrument as _instr
+from ..robustness import faultinject as _FI
 
 __all__ = [
     "Communication",
@@ -375,6 +376,12 @@ class MeshCommunication(Communication):
     # publishes the per-device layout for code that wants it.
 
     def __collective(self, kind: str, split: int, ndim: int, op: str = "", **kw):
+        # deterministic fault site for the distributed layer: an injected
+        # failure here surfaces exactly where a real ICI/DCN dispatch error
+        # would (no recovery ladder — collectives have no retained graph to
+        # replay; the site exists so tests can prove where the blast radius
+        # of a collective failure lands)
+        _FI.check("collective.dispatch")
         if _MON.enabled:
             _instr.collective(kind)
         key = (kind, op, self.mesh, self.__axis_name, split, ndim, tuple(sorted(kw.items())))

@@ -105,6 +105,25 @@ Design (see ``doc/fusion_notes.md`` for the full narrative):
 * **Escape hatch.** ``HEAT_TPU_FUSION=0`` restores the pre-fusion
   op-at-a-time execution bit for bit (read per dispatch, same pattern as
   ``HEAT_TPU_BLOCKED_LINALG``).
+* **Recovery ladder.** A fused flush executes arbitrarily far from the ops
+  that recorded it, so a compile error or RESOURCE_EXHAUSTED inside the flush
+  must never surface as a raw crash at some unrelated materialization point.
+  The deferred design makes the strong guarantee cheap: the expression DAG is
+  *retained* at flush time, so any failure can always be replayed. The ladder
+  (``_flush_ladder``): (1) run the fused kernel; on failure — classified
+  compile / oom / runtime under ``fusion.flush_failures`` — (2) retry once
+  with buffer donation disabled (an aliased in-place kernel is the riskier
+  allocation plan; skipped when nothing was donated), then (3) fall back to
+  per-op eager replay of the retained DAG, which is bit-identical to
+  ``HEAT_TPU_FUSION=0`` by construction (same ops, same order, no fused
+  kernel to contract FMAs in). A flush that recovers counts
+  ``fusion.flush_recovered``; a signature that needed eager replay is
+  *poisoned* (``fusion.poisoned_signatures``, capped set, cleared with
+  :func:`clear_cache`): subsequent identical chains skip straight to eager
+  replay — a circuit breaker, not a retry tax, for known-bad kernels.
+  Deterministic fault injection for all of this rides the
+  ``fusion.compile``/``fusion.execute`` sites of
+  :mod:`heat_tpu.robustness.faultinject`.
 
 Monitoring: ``fusion.ops_deferred`` (labelled binary/local/where/cast/view/
 gemm), ``fusion.reduction_sinks`` (labelled reduce/cum/moment/norm/vecdot),
@@ -112,9 +131,12 @@ gemm), ``fusion.reduction_sinks`` (labelled reduce/cum/moment/norm/vecdot),
 ``fusion.flushes``/``fusion.kernels_compiled``/``fusion.cache_hits``,
 ``fusion.flush_reason`` (labelled reduction/cumulative/print/indexing/io/
 collective/out-alias/export/chain-bound/linalg/other — *why* each chain
-broke), ``fusion.elided_writes``, and the ``fusion.chain_length`` histogram,
+broke), ``fusion.elided_writes``, the recovery-ladder counters
+``fusion.flush_failures{compile,oom,runtime}`` / ``fusion.flush_recovered`` /
+``fusion.poisoned_signatures``, and the ``fusion.chain_length`` histogram,
 all through ``monitoring/instrument.py``; :func:`cache_info` reports
-entries/hits/misses/evictions of the trace LRU.
+entries/hits/misses/evictions of the trace LRU plus the poisoned-signature
+count.
 """
 
 from __future__ import annotations
@@ -133,6 +155,7 @@ import jax.numpy as jnp
 
 from ..monitoring.registry import STATE as _MON
 from ..monitoring import instrument as _instr
+from ..robustness import faultinject as _FI
 from .dndarray import DNDarray
 
 __all__ = [
@@ -1428,15 +1451,26 @@ def defer_vecdot(x1: DNDarray, x2: DNDarray, axis, keepdim: bool) -> Optional[DN
 _TRACE_CACHE: "collections.OrderedDict" = collections.OrderedDict()
 _cache_stats = {"hits": 0, "misses": 0, "evictions": 0}
 
+#: Poisoned graph signatures (recovery-ladder circuit breaker): trace keys
+#: whose fused execution failed and had to be recovered by eager replay.
+#: Identical future chains skip the fused attempt entirely. Ordered so the
+#: cap evicts the oldest poisoning first.
+_POISONED: "collections.OrderedDict" = collections.OrderedDict()
+_POISON_MAX = 1024
+
 
 def cache_info() -> dict:
-    """Trace-cache statistics (entries/hits/misses/evictions)."""
-    return {"entries": len(_TRACE_CACHE), **_cache_stats}
+    """Trace-cache statistics (entries/hits/misses/evictions) plus the number
+    of poisoned signatures currently short-circuiting to eager replay."""
+    return {"entries": len(_TRACE_CACHE), "poisoned": len(_POISONED), **_cache_stats}
 
 
 def clear_cache() -> None:
-    """Drop every cached fused executable (kept traces are re-built lazily)."""
+    """Drop every cached fused executable and every poisoned-signature record
+    (kept traces are re-built — and previously poisoned chains re-attempted —
+    lazily)."""
     _TRACE_CACHE.clear()
+    _POISONED.clear()
 
 
 def _topo(root: _Node):
@@ -1484,6 +1518,112 @@ def _donatable(arr, owner_ref, out_avals) -> bool:
     # second graph's leaf, a user-held .larray, a node.value field — and the
     # buffer must survive this call.
     return sys.getrefcount(arr) <= 4
+
+
+def _replay_fn(program, out_idx):
+    """The positional replay callable for a flush program (jitted for the
+    fused kernel; also rebuilt donation-free by the recovery ladder)."""
+    prog = tuple(program)
+
+    def replay(*leaves):
+        vals = []
+        for fn, specs, kw, cast in prog:
+            args = [
+                vals[i] if tag == "n" else (leaves[i] if tag == "l" else i)
+                for tag, i in specs
+            ]
+            vals.append(_apply(fn, args, kw, cast))
+        return tuple(vals[i] for i in out_idx)
+
+    return replay
+
+
+def _eager_replay(program, leaf_arrays, out_idx):
+    """Per-op eager replay of a flush program: every recorded op dispatches
+    standalone on concrete arrays, exactly like ``HEAT_TPU_FUSION=0`` — the
+    recovery ladder's always-works bottom rung (bit-identical to the eager
+    path by construction: same ops, same order, no fused kernel for XLA to
+    contract FMAs in)."""
+    vals = []
+    for fn, specs, kw, cast in program:
+        args = [
+            vals[i] if tag == "n" else (leaf_arrays[i] if tag == "l" else i)
+            for tag, i in specs
+        ]
+        vals.append(_apply(fn, args, kw, cast))
+    return tuple(vals[i] for i in out_idx)
+
+
+def _classify_failure(e: BaseException, compiled: bool) -> str:
+    """Failure class for ``fusion.flush_failures``: oom (RESOURCE_EXHAUSTED /
+    out-of-memory signatures, whatever the phase), compile (a trace-cache miss
+    whose build/compile raised), runtime (a cached executable raised)."""
+    msg = str(e)
+    if (
+        isinstance(e, MemoryError)
+        or "RESOURCE_EXHAUSTED" in msg
+        or "out of memory" in msg.lower()
+    ):
+        return "oom"
+    return "compile" if compiled else "runtime"
+
+
+def _poison(key) -> None:
+    if key is None or key in _POISONED:
+        return
+    _POISONED[key] = True
+    while len(_POISONED) > _POISON_MAX:
+        _POISONED.popitem(last=False)
+    if _MON.enabled:
+        _instr.fusion_poisoned()
+
+
+def _flush_ladder(fused, program, leaf_arrays, out_idx, donate, compiled, key):
+    """Execute a fused flush with graceful degradation.
+
+    Rungs: (1) the fused kernel as planned; (2) on failure, one retry with
+    buffer donation disabled (skipped when nothing was donated — the rebuild
+    would be byte-identical); (3) per-op eager replay of the retained program,
+    which cannot fail for reasons the fused kernel introduced, plus poisoning
+    of the signature so identical future chains skip straight to eager. Each
+    failed rung counts ``fusion.flush_failures{class}``; any recovery counts
+    ``fusion.flush_recovered``. The ``fusion.compile``/``fusion.execute``
+    fault-injection sites are consulted per attempt, so every rung is
+    deterministically testable. Caveat (documented in robustness_notes): if a
+    *donating* kernel fails after consuming its donated buffers — possible on
+    TPU/GPU only — the retained leaves are gone and the rung-2/3 replays
+    surface that error instead; donation requires owner-death, so no
+    user-visible array is ever lost."""
+    try:
+        if compiled:
+            _FI.check("fusion.compile")
+        _FI.check("fusion.execute")
+        return fused(*leaf_arrays)
+    except (KeyboardInterrupt, SystemExit, _FI.FaultPlanError):
+        raise  # a malformed fault PLAN is a config error, not a failure
+    except Exception as e:
+        if _MON.enabled:
+            _instr.fusion_flush_failure(_classify_failure(e, compiled))
+        if key is not None:
+            # never hand the broken executable to a future flush
+            _TRACE_CACHE.pop(key, None)
+        values = None
+        if donate:
+            try:
+                _FI.check("fusion.compile")  # rung 2 always builds fresh
+                _FI.check("fusion.execute")
+                values = jax.jit(_replay_fn(program, out_idx))(*leaf_arrays)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e2:
+                if _MON.enabled:
+                    _instr.fusion_flush_failure(_classify_failure(e2, compiled))
+        if values is None:
+            values = _eager_replay(program, leaf_arrays, out_idx)
+            _poison(key)
+        if _MON.enabled:
+            _instr.fusion_flush_recovered()
+        return values
 
 
 def materialize_for(d: DNDarray):
@@ -1598,42 +1738,43 @@ def materialize_for(d: DNDarray):
     except TypeError:  # unhashable sharding — compile uncached
         key, fused = None, None
 
-    compiled = fused is None
-    if fused is None:
-        prog = tuple(program)
-        oidx = out_idx
-
-        def replay(*leaves):
-            vals = []
-            for fn, specs, kw, cast in prog:
-                args = [
-                    vals[i] if tag == "n" else (leaves[i] if tag == "l" else i)
-                    for tag, i in specs
-                ]
-                vals.append(_apply(fn, args, kw, cast))
-            return tuple(vals[i] for i in oidx)
-
-        fused = jax.jit(replay, donate_argnums=donate)
-        if key is not None:
-            _TRACE_CACHE[key] = fused
-            _cache_stats["misses"] += 1
-            limit = _cache_max()
-            while len(_TRACE_CACHE) > limit:
-                _TRACE_CACHE.popitem(last=False)
-                _cache_stats["evictions"] += 1
+    if key is not None and key in _POISONED:
+        # circuit breaker: this signature already failed fused execution and
+        # was recovered by eager replay — skip straight to eager (no compile,
+        # no retry tax); the result is bit-identical by construction
+        _POISONED.move_to_end(key)
+        if _MON.enabled:
+            _instr.fusion_flush(
+                len(topo), cache_hit=False, compiled=False, reason=_FLUSH_REASON[-1]
+            )
+        values = _eager_replay(program, leaf_arrays, out_idx)
     else:
-        _TRACE_CACHE.move_to_end(key)
-        _cache_stats["hits"] += 1
+        compiled = fused is None
+        if fused is None:
+            fused = jax.jit(_replay_fn(program, out_idx), donate_argnums=donate)
+            if key is not None:
+                _TRACE_CACHE[key] = fused
+                _cache_stats["misses"] += 1
+                limit = _cache_max()
+                while len(_TRACE_CACHE) > limit:
+                    _TRACE_CACHE.popitem(last=False)
+                    _cache_stats["evictions"] += 1
+        else:
+            _TRACE_CACHE.move_to_end(key)
+            _cache_stats["hits"] += 1
 
-    if _MON.enabled:
-        _instr.fusion_flush(
-            len(topo),
-            cache_hit=not compiled,
-            compiled=compiled,
-            reason=_FLUSH_REASON[-1],
-        )
+        if _MON.enabled:
+            # NB: `compiled` counts the compile ATTEMPT — if it fails, the
+            # ladder counters below carry the outcome and the broken entry is
+            # dropped from the cache
+            _instr.fusion_flush(
+                len(topo),
+                cache_hit=not compiled,
+                compiled=compiled,
+                reason=_FLUSH_REASON[-1],
+            )
 
-    values = fused(*leaf_arrays)
+        values = _flush_ladder(fused, program, leaf_arrays, out_idx, donate, compiled, key)
 
     # canonical placement — the step DNDarray.__init__ applies to every eager
     # intermediate, applied once per fused output here (the root places on

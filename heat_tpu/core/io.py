@@ -7,12 +7,20 @@ reads :57-660, ``load_csv`` byte-range splitting :713-925, extension dispatch
 single-controller SPMD the controller reads the slab for each device (for multi-host,
 each host would read its addressable shards' slabs) and the sharding places them. All
 I/O happens outside jit on the host.
+
+Robustness (``doc/robustness_notes.md``): every save writes a same-directory
+tempfile and ``os.replace``s it into place (a crash mid-save never truncates an
+existing file; append modes update in place), every load/save attempt passes the
+``io.read``/``io.write`` fault-injection sites, and transient ``OSError``/EIO
+failures are retried with bounded exponential backoff
+(:mod:`heat_tpu.robustness.retry`, counted as ``io.retries{site}``).
 """
 
 from __future__ import annotations
 
 import csv as csv_mod
 import os
+import tempfile
 import time as _time
 from typing import Optional, Tuple, Union
 
@@ -29,6 +37,53 @@ from .dndarray import DNDarray
 # observability: load/save record bytes moved + duration when enabled
 from ..monitoring.registry import STATE as _MON
 from ..monitoring import instrument as _instr
+
+# graceful degradation: every load/save attempt passes the deterministic
+# fault-injection hooks and rides the shared bounded-backoff retry policy
+# (transient OSError/EIO); saves are write-then-rename atomic (below)
+from ..robustness import faultinject as _FI
+from ..robustness import retry as _retry
+
+#: file modes whose save semantics are a full rewrite — only these are made
+#: atomic (append/update modes must touch the existing file in place)
+_TRUNCATING_MODES = frozenset(("w", "w-", "x"))
+
+
+def _atomic_write(path: str, mode: str, write, site: str) -> None:
+    """Run ``write(target, mode)`` with the write-then-rename idiom and the
+    shared retry policy.
+
+    For truncating modes (and for a target that does not exist yet) the writer
+    receives a same-directory tempfile and the result is ``os.replace``d into
+    place — a crash mid-save can never truncate an existing file, readers only
+    ever see the old or the new complete file (the idiom
+    ``utils/checkpoint.py`` established). Append/update modes on an existing
+    file operate in place: atomicity there would mean rewriting content the
+    caller never passed us. Each attempt (including retries after a transient
+    ``OSError``) re-checks the ``io.write`` fault site and starts from a fresh
+    tempfile."""
+
+    def attempt():
+        _FI.check("io.write")
+        if mode not in _TRUNCATING_MODES and os.path.exists(path):
+            write(path, mode)
+            return
+        d = os.path.dirname(os.path.abspath(path)) or "."
+        fd, tmp = tempfile.mkstemp(
+            dir=d, prefix=os.path.basename(path) + ".", suffix=".tmp"
+        )
+        os.close(fd)
+        try:
+            # the tempfile is a fresh target: a non-truncating mode on a
+            # missing file has creation semantics, which "w" provides
+            write(tmp, mode if mode in _TRUNCATING_MODES else "w")
+            os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    _retry.policy().call(attempt, site=site)
 
 
 def _load_sharded(reader, gshape, dtype, split, device, comm) -> Optional[DNDarray]:
@@ -126,14 +181,24 @@ if __HDF5:
         if not isinstance(dataset, str):
             raise TypeError(f"dataset must be str, not {type(dataset)}")
         t0 = _time.perf_counter()
-        with h5py.File(path, "r") as handle:
-            dset = handle[dataset]
-            gshape = tuple(int(s) for s in dset.shape)
-            res = _load_sharded(lambda sl: dset[sl], gshape, dtype, split, device, comm)
+
+        def attempt():
+            _FI.check("io.read")
+            with h5py.File(path, "r") as handle:
+                dset = handle[dataset]
+                gshape = tuple(int(s) for s in dset.shape)
+                res = _load_sharded(
+                    lambda sl: dset[sl], gshape, dtype, split, device, comm
+                )
+                if res is None:
+                    data = np.asarray(dset)
             if res is None:
-                data = np.asarray(dset)
-        if res is None:
-            res = factories.array(data, dtype=dtype, split=split, device=device, comm=comm)
+                res = factories.array(
+                    data, dtype=dtype, split=split, device=device, comm=comm
+                )
+            return res
+
+        res = _retry.policy().call(attempt, site="load_hdf5")
         if _MON.enabled:
             _instr.record_io("load_hdf5", path, res.nbytes, _time.perf_counter() - t0)
         return res
@@ -164,37 +229,45 @@ if __HDF5:
             # produce the complete file
             full = data.numpy()
             if jax.process_index() == 0:
-                with h5py.File(path, mode) as handle:
-                    handle.create_dataset(dataset, data=full, **kwargs)
+
+                def write(target, m):
+                    with h5py.File(target, m) as handle:
+                        handle.create_dataset(dataset, data=full, **kwargs)
+
+                _atomic_write(path, mode, write, site="save_hdf5")
             return
-        with h5py.File(path, mode) as handle:
-            if (
-                data.split is not None
-                and len(arr.sharding.device_set) > 1
-                and not arr.sharding.is_fully_replicated
-            ):
-                # shard-wise write: fetch one device slab at a time (the
-                # reference's per-rank offset writes, io.py:391-470) instead of
-                # gathering the full array on the host first; pad rows of ragged
-                # layouts are clamped off against the logical extent
-                np_dtype = np.dtype(data.dtype.jnp_type())
-                dset = handle.create_dataset(dataset, shape=data.shape, dtype=np_dtype, **kwargs)
-                split = data.split % data.ndim
-                n = data.shape[split]
-                for shard in arr.addressable_shards:
-                    idx = list(shard.index)
-                    sl = idx[split]
-                    start = sl.start or 0
-                    if start >= n:
-                        continue  # pure-pad shard
-                    stop = n if sl.stop is None else min(sl.stop, n)
-                    idx[split] = slice(start, stop)
-                    block = np.asarray(shard.data)
-                    take = [slice(None)] * data.ndim
-                    take[split] = slice(0, stop - start)
-                    dset[tuple(idx)] = block[tuple(take)]
-            else:
-                handle.create_dataset(dataset, data=data.numpy(), **kwargs)
+
+        def write(target, m):
+            with h5py.File(target, m) as handle:
+                if (
+                    data.split is not None
+                    and len(arr.sharding.device_set) > 1
+                    and not arr.sharding.is_fully_replicated
+                ):
+                    # shard-wise write: fetch one device slab at a time (the
+                    # reference's per-rank offset writes, io.py:391-470) instead of
+                    # gathering the full array on the host first; pad rows of ragged
+                    # layouts are clamped off against the logical extent
+                    np_dtype = np.dtype(data.dtype.jnp_type())
+                    dset = handle.create_dataset(dataset, shape=data.shape, dtype=np_dtype, **kwargs)
+                    split = data.split % data.ndim
+                    n = data.shape[split]
+                    for shard in arr.addressable_shards:
+                        idx = list(shard.index)
+                        sl = idx[split]
+                        start = sl.start or 0
+                        if start >= n:
+                            continue  # pure-pad shard
+                        stop = n if sl.stop is None else min(sl.stop, n)
+                        idx[split] = slice(start, stop)
+                        block = np.asarray(shard.data)
+                        take = [slice(None)] * data.ndim
+                        take[split] = slice(0, stop - start)
+                        dset[tuple(idx)] = block[tuple(take)]
+                else:
+                    handle.create_dataset(dataset, data=data.numpy(), **kwargs)
+
+        _atomic_write(path, mode, write, site="save_hdf5")
 
 
 if __NETCDF:
@@ -211,16 +284,24 @@ if __NETCDF:
         """Load a NetCDF variable into a (split) DNDarray (reference io.py:471-590);
         slab-wise per device like :func:`load_hdf5`."""
         t0 = _time.perf_counter()
-        with nc.Dataset(path, "r") as handle:
-            var = handle.variables[variable]
-            gshape = tuple(int(s) for s in var.shape)
-            res = _load_sharded(
-                lambda sl: np.asarray(var[sl]), gshape, dtype, split, device, comm
-            )
+
+        def attempt():
+            _FI.check("io.read")
+            with nc.Dataset(path, "r") as handle:
+                var = handle.variables[variable]
+                gshape = tuple(int(s) for s in var.shape)
+                res = _load_sharded(
+                    lambda sl: np.asarray(var[sl]), gshape, dtype, split, device, comm
+                )
+                if res is None:
+                    data = np.asarray(var[:])
             if res is None:
-                data = np.asarray(var[:])
-        if res is None:
-            res = factories.array(data, dtype=dtype, split=split, device=device, comm=comm)
+                res = factories.array(
+                    data, dtype=dtype, split=split, device=device, comm=comm
+                )
+            return res
+
+        res = _retry.policy().call(attempt, site="load_netcdf")
         if _MON.enabled:
             _instr.record_io("load_netcdf", path, res.nbytes, _time.perf_counter() - t0)
         return res
@@ -233,11 +314,17 @@ if __NETCDF:
         arr = data.numpy()  # collective in multi-controller runs
         if jax.process_index() != 0 and not data.parray.is_fully_addressable:
             return  # single writer
-        with nc.Dataset(path, mode) as handle:
-            for i, s in enumerate(arr.shape):
-                handle.createDimension(f"dim_{i}", s)
-            var = handle.createVariable(variable, arr.dtype, tuple(f"dim_{i}" for i in range(arr.ndim)))
-            var[:] = arr
+
+        def write(target, m):
+            with nc.Dataset(target, m) as handle:
+                for i, s in enumerate(arr.shape):
+                    handle.createDimension(f"dim_{i}", s)
+                var = handle.createVariable(
+                    variable, arr.dtype, tuple(f"dim_{i}" for i in range(arr.ndim))
+                )
+                var[:] = arr
+
+        _atomic_write(path, mode, write, site="save_netcdf")
         if _MON.enabled:
             _instr.record_io("save_netcdf", path, arr.nbytes, _time.perf_counter() - t0)
 
@@ -294,29 +381,34 @@ def load_csv(
     # across host threads); falls back to the Python parser on any mismatch
     from .. import native
 
-    data = None
-    if (
-        encoding.lower().replace("-", "") in ("utf8", "ascii")
-        and len(sep) == 1
-        and sep.isascii()
-        and native.available()
-    ):
-        with open(path, "rb") as handle:
-            raw = handle.read()
-        data = native.parse_csv(raw, sep, header_lines)
-    if data is None:
-        rows = []
-        with open(path, "r", encoding=encoding, newline="") as handle:
-            for i, line in enumerate(handle):
-                if i < header_lines:
-                    continue
-                line = line.strip()
-                if not line:
-                    continue
-                rows.append([float(v) for v in line.split(sep)])
-        data = np.asarray(rows)
-        if data.size == 0:
-            data = np.empty((0, 0))  # match the native parser's empty shape
+    def attempt():
+        _FI.check("io.read")
+        data = None
+        if (
+            encoding.lower().replace("-", "") in ("utf8", "ascii")
+            and len(sep) == 1
+            and sep.isascii()
+            and native.available()
+        ):
+            with open(path, "rb") as handle:
+                raw = handle.read()
+            data = native.parse_csv(raw, sep, header_lines)
+        if data is None:
+            rows = []
+            with open(path, "r", encoding=encoding, newline="") as handle:
+                for i, line in enumerate(handle):
+                    if i < header_lines:
+                        continue
+                    line = line.strip()
+                    if not line:
+                        continue
+                    rows.append([float(v) for v in line.split(sep)])
+            data = np.asarray(rows)
+            if data.size == 0:
+                data = np.empty((0, 0))  # match the native parser's empty shape
+        return data
+
+    data = _retry.policy().call(attempt, site="load_csv")
     res = factories.array(data, dtype=dtype, split=split, device=device, comm=comm)
     if _MON.enabled:
         _instr.record_io("load_csv", path, res.nbytes, _time.perf_counter() - t0)
@@ -344,18 +436,22 @@ def save_csv(
     arr = data.numpy()
     if arr.ndim == 1:
         arr = arr.reshape(-1, 1)
-    with open(path, "w", encoding=encoding, newline="") as handle:
-        if header_lines:
-            handle.write(header_lines)
-            if not header_lines.endswith("\n"):
-                handle.write("\n")
-        for row in arr:
-            handle.write(
-                sep.join(
-                    (f"%.{decimals}f" % v.item()) if decimals >= 0 else str(v.item()) for v in row
+
+    def write(target, m):
+        with open(target, m, encoding=encoding, newline="") as handle:
+            if header_lines:
+                handle.write(header_lines)
+                if not header_lines.endswith("\n"):
+                    handle.write("\n")
+            for row in arr:
+                handle.write(
+                    sep.join(
+                        (f"%.{decimals}f" % v.item()) if decimals >= 0 else str(v.item()) for v in row
+                    )
                 )
-            )
-            handle.write("\n")
+                handle.write("\n")
+
+    _atomic_write(path, "w", write, site="save_csv")
     if _MON.enabled:
         # written volume = the text file's actual size, not the array bytes
         _instr.record_io("save_csv", path, os.path.getsize(path), _time.perf_counter() - t0)

@@ -25,6 +25,12 @@ naming stays consistent:
   ``device.memory_stats()`` where the backend provides it;
 * ``io.bytes_read``/``io.bytes_written`` + ``io.seconds`` — parallel-IO
   load/save volume and latency;
+* graceful-degradation counters (``heat_tpu.robustness`` + the fused-flush
+  recovery ladder): ``fusion.flush_failures{compile,oom,runtime}`` /
+  ``fusion.flush_recovered`` / ``fusion.poisoned_signatures``,
+  ``io.retries{site}``, ``checkpoint.ops{write,restore,corrupt-skipped,
+  orphan-cleaned,preemption-save}``, ``preemption.requests{signame}``, and
+  ``faults.injected{site}`` for the deterministic injection framework;
 * per-step spans for the algorithm/train loops (kmeans, lasso, data-parallel,
   DASO) via :func:`step_event` and ``events.span``.
 """
@@ -46,8 +52,15 @@ __all__ = [
     "fusion_sink",
     "fusion_view_fallback",
     "fusion_flush",
+    "fusion_flush_failure",
+    "fusion_flush_recovered",
+    "fusion_poisoned",
     "fusion_elided_write",
     "record_io",
+    "io_retry",
+    "checkpoint_op",
+    "preemption_request",
+    "fault_injected",
     "step_event",
     "sample_memory",
 ]
@@ -145,6 +158,29 @@ def fusion_flush(chain_len: int, cache_hit: bool, compiled: bool, reason: str = 
     REGISTRY.histogram("fusion.chain_length").observe(chain_len)
 
 
+def fusion_flush_failure(kind: str) -> None:
+    """One failed fused-flush attempt caught by the recovery ladder (kind:
+    compile — the kernel build/compile raised on a trace-cache miss; oom — the
+    failure carried a RESOURCE_EXHAUSTED/out-of-memory signature; runtime —
+    a cached executable raised at dispatch). Each ladder rung that fails
+    counts separately; ``fusion.flush_recovered`` tells whether the flush
+    ultimately produced a result anyway."""
+    REGISTRY.counter("fusion.flush_failures").inc(label=kind)
+
+
+def fusion_flush_recovered() -> None:
+    """One fused flush that failed at least one ladder rung but still returned
+    correct values (donation-disabled retry or per-op eager replay)."""
+    REGISTRY.counter("fusion.flush_recovered").inc()
+
+
+def fusion_poisoned() -> None:
+    """One graph signature poisoned in the trace LRU after eager-replay
+    recovery: subsequent identical chains skip straight to eager (circuit
+    breaker — no retry tax on a known-bad signature)."""
+    REGISTRY.counter("fusion.poisoned_signatures").inc()
+
+
 def fusion_elided_write() -> None:
     """One unflushed expression dropped by an overwrite (``out=`` aliasing):
     deferred work that never had to execute."""
@@ -159,6 +195,32 @@ def record_io(op: str, path: str, nbytes: int, seconds: float) -> None:
     REGISTRY.counter("io.calls").inc(label=op)
     REGISTRY.histogram("io.seconds").observe(seconds)
     events.record(f"io.{op}", seconds, path=path, bytes=int(nbytes))
+
+
+def io_retry(site: str) -> None:
+    """One transient-failure retry taken by the shared
+    :class:`~heat_tpu.robustness.retry.RetryPolicy` (site: the wrapped writer/
+    reader, e.g. save_hdf5 / load_csv / checkpoint.write)."""
+    REGISTRY.counter("io.retries").inc(label=site)
+
+
+def checkpoint_op(kind: str) -> None:
+    """One checkpoint-subsystem operation (kind: write / restore /
+    corrupt-skipped / orphan-cleaned / preemption-save)."""
+    REGISTRY.counter("checkpoint.ops").inc(label=kind)
+
+
+def preemption_request(signame: str) -> None:
+    """One preemption signal intercepted by an active
+    :class:`~heat_tpu.robustness.preemption.PreemptionGuard` (labelled by the
+    signal name; the checkpoint itself lands at the next step boundary)."""
+    REGISTRY.counter("preemption.requests").inc(label=signame)
+
+
+def fault_injected(site: str) -> None:
+    """One deterministic fault fired by an installed
+    :mod:`~heat_tpu.robustness.faultinject` plan."""
+    REGISTRY.counter("faults.injected").inc(label=site)
 
 
 def step_event(name: str, seconds: float, rows: Optional[int] = None, **attrs) -> None:

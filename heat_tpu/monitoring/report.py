@@ -124,10 +124,26 @@ def telemetry() -> dict:
         ("fusion.reduction_sinks", "fusion_reduction_sinks"),
         ("fusion.ops_deferred", "fusion_ops_deferred"),
         ("fusion.view_fallbacks", "fusion_view_fallbacks"),
+        # graceful-degradation breakdowns (ISSUE 6): which failure classes the
+        # flush ladder absorbed, which writer paths retried, what the
+        # checkpoint subsystem did, and which fault sites actually fired
+        ("fusion.flush_failures", "fusion_flush_failures"),
+        ("io.retries", "io_retries"),
+        ("checkpoint.ops", "checkpoint_ops"),
+        ("preemption.requests", "preemption_requests"),
+        ("faults.injected", "faults_injected"),
     ):
         val = snap["metrics"]["counters"].get(name)
         if isinstance(val, dict) and val.get("labels"):
             out[key] = dict(val["labels"])
+    # scalar recovery counters, exported under their telemetry names when set
+    for name, key in (
+        ("fusion.flush_recovered", "fusion_flush_recovered"),
+        ("fusion.poisoned_signatures", "fusion_poisoned_signatures"),
+    ):
+        val = counters.get(name)
+        if val:
+            out[key] = val
     mem = {k: v for k, v in snap["metrics"]["gauges"].items() if k.startswith("memory.")}
     if mem:
         out["memory"] = mem

@@ -30,6 +30,7 @@ from ..core.communication import MeshCommunication, sanitize_comm
 from ..core.dndarray import DNDarray
 from ..monitoring import instrument as _instr
 from ..monitoring.registry import STATE as _MON
+from ..robustness import preemption as _preempt
 
 __all__ = ["DataParallel", "DataParallelMultiGPU"]
 
@@ -100,6 +101,7 @@ class DataParallel:
         self.blocking = blocking
         self.params = None
         self.opt_state = None
+        self.step_count = 0
         self._train_step = None
         self._loss_fn = None
 
@@ -223,7 +225,32 @@ class DataParallel:
             )
         if self.blocking:
             jax.block_until_ready(loss)
+        self.step_count += 1
+        # preemption contract: the step boundary is the only place (params,
+        # opt_state) is a consistent snapshot — a SIGTERM seen by an active
+        # PreemptionGuard lands a checkpoint HERE, not in signal context
+        if _preempt.should_checkpoint():
+            _preempt.checkpoint_now(self.checkpoint_state(), step=self.step_count)
         return loss
+
+    def checkpoint_state(self) -> dict:
+        """The pytree a preemption (or user-initiated) checkpoint persists:
+        replicated params, optimizer state, and the step counter — with the
+        global RNG state riding along inside ``save_checkpoint``. Restore with
+        ``CheckpointManager.restore_latest_valid(dp.checkpoint_state())`` and
+        :meth:`load_state`."""
+        return {
+            "params": self.params,
+            "opt_state": self.opt_state,
+            "step": self.step_count,
+        }
+
+    def load_state(self, state: dict) -> None:
+        """Adopt a restored :meth:`checkpoint_state` pytree (the resume half
+        of the preemption contract)."""
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+        self.step_count = int(state["step"])
 
 
 class DataParallelMultiGPU(DataParallel):

@@ -34,6 +34,7 @@ from ..core._compat import shard_map as _shard_map
 from ..core.communication import MeshCommunication, sanitize_comm
 from ..monitoring import instrument as _instr
 from ..monitoring.registry import REGISTRY as _REG, STATE as _MON
+from ..robustness import preemption as _preempt
 from .utils import DetectMetricPlateau
 
 __all__ = ["DataParallelOptimizer", "DASO"]
@@ -151,6 +152,7 @@ class DASO:
         self.verbose = verbose
         self.epoch = 0
         self.batch = 0
+        self.step_count = 0  # monotone across epochs (checkpoint step numbers)
         self.last_batch = None
         self._pending_global = None
         self._pending_countdown = 0
@@ -336,10 +338,39 @@ class DASO:
                 if _MON.enabled:
                     _REG.counter("daso.global_syncs").inc(label="async")
         self.batch += 1
+        self.step_count += 1
         if self.last_batch is not None and self.batch >= self.last_batch:
             self.batch = 0
             self.epoch += 1
+        # preemption contract: poll at the step boundary, where the per-node
+        # replicas + optimizer state are consistent (a pending async global
+        # sync is deliberately dropped — it is a staleness optimization, and
+        # resuming without it only costs one blend)
+        if _preempt.should_checkpoint():
+            _preempt.checkpoint_now(self.checkpoint_state(), step=self.step_count)
         return loss
+
+    def checkpoint_state(self) -> dict:
+        """The pytree a preemption checkpoint persists: per-node stacked
+        params, optimizer state, and the loop position (monotone step plus
+        epoch/batch so the skip schedule resumes in phase)."""
+        return {
+            "params": self.params,
+            "opt_state": self.opt_state,
+            "step": self.step_count,
+            "epoch": self.epoch,
+            "batch": self.batch,
+        }
+
+    def load_state(self, state: dict) -> None:
+        """Adopt a restored :meth:`checkpoint_state` pytree."""
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+        self.step_count = int(state["step"])
+        self.epoch = int(state["epoch"])
+        self.batch = int(state["batch"])
+        self._pending_global = None
+        self._pending_countdown = 0
 
     def epoch_loss_logic(self, loss, loss_globally_averaged: bool = False) -> None:
         """

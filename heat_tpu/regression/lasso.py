@@ -19,6 +19,7 @@ from ..core.base import BaseEstimator, RegressionMixin
 from ..core.dndarray import DNDarray
 from ..monitoring import events as _ev
 from ..monitoring.registry import REGISTRY as _REG, STATE as _MON
+from ..robustness import preemption as _preempt
 
 __all__ = ["Lasso"]
 
@@ -135,6 +136,14 @@ class Lasso(BaseEstimator, RegressionMixin):
                     sp.set(delta=diff)
                 theta = new_theta
                 if diff < self.tol:
+                    break
+                # preemption contract: a sweep boundary is a consistent
+                # (theta, sweep) snapshot — poll the guard here, save through
+                # its manager, and end the fit with the checkpointed state
+                if _preempt.should_checkpoint():
+                    _preempt.checkpoint_now(
+                        {"theta": theta, "sweep": n_iter}, step=n_iter
+                    )
                     break
             fit_sp.set(n_iter=n_iter)
         if _MON.enabled:

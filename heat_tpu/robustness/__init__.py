@@ -1,0 +1,41 @@
+"""
+Graceful-degradation runtime: deterministic fault injection, recovery
+policies, and preemption-safe checkpointing.
+
+The reference framework has no structured failure handling at all (its MPI
+errors surface as raw aborts); this subpackage is the part of the TPU-native
+redesign that assumes the *deployment* reality of the north star — preemptible
+hosts, transient IO errors, XLA compiles that can fail or exhaust device
+memory arbitrarily far from the op that recorded them:
+
+- :mod:`~heat_tpu.robustness.faultinject` — named fault sites wired into the
+  fusion engine, IO, checkpointing, and the collective layer; plans are
+  deterministic by call count (programmatic or ``HEAT_TPU_FAULT_PLAN``), so
+  every degraded path is replayable in CI.
+- :mod:`~heat_tpu.robustness.retry` — a bounded exponential-backoff retry
+  policy shared by the IO and checkpoint writers (transient ``OSError``/EIO).
+- :mod:`~heat_tpu.robustness.preemption` — a SIGTERM/SIGINT guard that turns
+  a preemption notice into a checkpoint at the next step boundary; the
+  trainers and the kmeans/lasso fit loops poll it per step.
+
+The fused-flush recovery *ladder* itself lives in ``core/fusion.py`` (it needs
+the retained expression DAG); its failure/recovery/poisoning counters are
+documented there and in ``doc/robustness_notes.md``.
+"""
+
+from . import faultinject
+from . import preemption
+from . import retry
+from .faultinject import FaultPlan, inject
+from .preemption import PreemptionGuard
+from .retry import RetryPolicy
+
+__all__ = [
+    "faultinject",
+    "preemption",
+    "retry",
+    "FaultPlan",
+    "inject",
+    "PreemptionGuard",
+    "RetryPolicy",
+]

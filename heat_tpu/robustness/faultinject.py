@@ -1,0 +1,300 @@
+"""
+Deterministic fault injection for the graceful-degradation paths.
+
+Every recovery mechanism in the runtime — the fused-flush ladder
+(``core/fusion.py``), the IO/checkpoint retry policies, the preemption
+checkpoint path — exists to absorb failures that are *rare and unreproducible*
+in the wild. This module makes them common and exactly reproducible: named
+*sites* on the hot paths call :func:`check`, and a *fault plan* decides, by
+**call count only** (never randomness), whether the site raises a planned
+exception instead of proceeding. The same plan always fails the same calls,
+so every degraded path is a deterministic CI case rather than a production
+incident.
+
+Sites (the catalog is shared with ``doc/robustness_notes.md``):
+
+========================  =====================================================
+``fusion.compile``        a fused-flush kernel is about to be built/compiled
+                          (trace-cache miss) — ``core/fusion.py``
+``fusion.execute``        a fused-flush kernel is about to execute (every
+                          flush attempt, hit or miss) — ``core/fusion.py``
+``io.write``              one save attempt in ``core/io.py`` (inside the retry
+                          loop, before the tempfile write)
+``io.read``               one load attempt in ``core/io.py`` (and a
+                          ``load_checkpoint`` read)
+``checkpoint.write``      one ``save_checkpoint`` attempt
+                          (``utils/checkpoint.py``)
+``collective.dispatch``   one explicit collective shim dispatch
+                          (``core/communication.py``)
+========================  =====================================================
+
+Plans are installed programmatically::
+
+    with faultinject.inject("fusion.compile", RuntimeError, at_calls=[1]):
+        ...   # the first fused compile in the block raises; later ones run
+
+or via the environment (read per :func:`check`, so a monkeypatched test or a
+CI job controls it without imports)::
+
+    HEAT_TPU_FAULT_PLAN="fusion.compile:RuntimeError@*;io.write:OSError@1,3"
+
+``@*`` fires on every call, ``@N,M`` on the named (1-based) calls, ``@N+`` on
+call N and every call after it. An exception *message* may be attached as
+``ExcName(message)`` — e.g. ``RuntimeError(RESOURCE_EXHAUSTED)`` exercises the
+fusion ladder's OOM classification.
+
+Zero cost when disabled: :func:`check` returns after one dict lookup and one
+``os.environ`` read when no plan exists (the same per-dispatch env-read cost
+class as ``HEAT_TPU_FUSION``), and per-site call counters only tick while a
+plan for that site is installed — so an idle process records nothing and the
+fusion bench anchors are unaffected.
+
+Monitoring: each fired fault increments ``faults.injected{site}``.
+"""
+
+from __future__ import annotations
+
+import builtins
+import os
+import re
+from typing import Iterable, Optional, Union
+
+from ..monitoring import instrument as _instr
+from ..monitoring.registry import STATE as _MON
+
+__all__ = [
+    "SITES",
+    "FaultPlan",
+    "FaultPlanError",
+    "inject",
+    "clear",
+    "check",
+    "active",
+    "call_count",
+    "reset_counts",
+]
+
+
+class FaultPlanError(ValueError):
+    """A fault *plan* itself is invalid (malformed ``HEAT_TPU_FAULT_PLAN``
+    entry, unknown site or exception name). Distinct from the planned faults
+    so recovery machinery can re-raise it instead of absorbing a config error
+    as if it were an injected failure."""
+
+#: The named fault sites wired into the runtime (see the module docstring).
+SITES = (
+    "fusion.compile",
+    "fusion.execute",
+    "io.write",
+    "io.read",
+    "checkpoint.write",
+    "collective.dispatch",
+)
+
+ENV_VAR = "HEAT_TPU_FAULT_PLAN"
+
+#: programmatic plans per site (insertion order preserved)
+_PLANS: dict = {}
+#: per-site call counters; tick only while a plan for the site is installed
+_COUNTS: dict = {}
+#: cached parse of the env plan, keyed on the exact env string
+_ENV_CACHE: tuple = ("", {})
+
+
+class FaultPlan:
+    """One deterministic fault plan for a site.
+
+    ``exc`` is an exception class (instantiated with a descriptive message at
+    fire time) or a ready exception instance (raised as-is — the way to
+    control the message, e.g. ``RuntimeError("RESOURCE_EXHAUSTED")`` for the
+    ladder's OOM classification). ``at_calls`` is a collection of 1-based call
+    indices, ``"*"`` for every call, or ``(n, "+")`` for call ``n`` onward.
+    ``fired`` records the call indices that actually raised, so tests can
+    assert the plan ran exactly as scheduled. Usable as a context manager
+    (removes itself on exit).
+    """
+
+    __slots__ = ("site", "exc", "at_calls", "fired")
+
+    def __init__(self, site: str, exc, at_calls):
+        self.site = site
+        self.exc = exc
+        if at_calls == "*":
+            self.at_calls = "*"
+        elif (
+            isinstance(at_calls, tuple)
+            and len(at_calls) == 2
+            and at_calls[1] == "+"
+        ):
+            self.at_calls = (int(at_calls[0]), "+")
+        else:
+            self.at_calls = frozenset(int(c) for c in at_calls)
+        self.fired: list = []
+
+    def matches(self, count: int) -> bool:
+        if self.at_calls == "*":
+            return True
+        if isinstance(self.at_calls, tuple):
+            return count >= self.at_calls[0]
+        return count in self.at_calls
+
+    def make(self, count: int) -> BaseException:
+        if isinstance(self.exc, BaseException):
+            return self.exc
+        return self.exc(f"injected fault at {self.site} (call #{count})")
+
+    def remove(self) -> None:
+        """Uninstall this plan (idempotent)."""
+        plans = _PLANS.get(self.site)
+        if plans and self in plans:
+            plans.remove(self)
+            if not plans:
+                del _PLANS[self.site]
+
+    def __enter__(self) -> "FaultPlan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.remove()
+        return False
+
+    def __repr__(self) -> str:  # pragma: no cover — debugging aid
+        return f"FaultPlan({self.site!r}, {self.exc!r}, at_calls={self.at_calls!r})"
+
+
+def inject(
+    site: str,
+    exc: Union[type, BaseException],
+    at_calls: Union[str, Iterable[int], tuple] = (1,),
+    reset_count: bool = True,
+) -> FaultPlan:
+    """Install a deterministic fault plan on ``site`` and return it.
+
+    ``at_calls`` schedules the failing calls (1-based; ``"*"`` = every call;
+    ``(n, "+")`` = call n onward). By default the site's call counter is reset
+    so the schedule is relative to *this* injection, which is what a test
+    wants; pass ``reset_count=False`` to schedule against the running count.
+    The returned plan is a context manager — ``with inject(...):`` scopes it.
+    """
+    if site not in SITES:
+        raise ValueError(f"unknown fault site {site!r}; known sites: {SITES}")
+    plan = FaultPlan(site, exc, at_calls)
+    if reset_count:
+        _COUNTS[site] = 0
+    _PLANS.setdefault(site, []).append(plan)
+    return plan
+
+
+def clear(site: Optional[str] = None) -> None:
+    """Remove programmatic fault plans (all sites, or one) and reset the
+    affected call counters. Env-driven plans are controlled by the
+    ``HEAT_TPU_FAULT_PLAN`` variable itself."""
+    if site is None:
+        _PLANS.clear()
+        _COUNTS.clear()
+    else:
+        _PLANS.pop(site, None)
+        _COUNTS.pop(site, None)
+
+
+def call_count(site: str) -> int:
+    """How many times ``site`` was checked while a plan for it was installed."""
+    return _COUNTS.get(site, 0)
+
+
+def reset_counts(site: Optional[str] = None) -> None:
+    """Reset the per-site call counters (all sites, or one)."""
+    if site is None:
+        _COUNTS.clear()
+    else:
+        _COUNTS.pop(site, None)
+
+
+def active() -> bool:
+    """Whether any fault plan (programmatic or env) is currently installed."""
+    return bool(_PLANS) or bool(os.environ.get(ENV_VAR))
+
+
+_ENV_ENTRY = re.compile(
+    r"^(?P<site>[a-z_.]+):(?P<exc>[A-Za-z_][A-Za-z0-9_]*)"
+    r"(?:\((?P<msg>[^)]*)\))?@(?P<calls>.+)$"
+)
+
+
+def _resolve_exc(name: str):
+    obj = getattr(builtins, name, None)
+    if isinstance(obj, type) and issubclass(obj, BaseException):
+        return obj
+    if name == "XlaRuntimeError":
+        try:
+            from jax.errors import JaxRuntimeError
+
+            return JaxRuntimeError
+        except ImportError:
+            try:
+                from jaxlib.xla_extension import XlaRuntimeError
+
+                return XlaRuntimeError
+            except ImportError:
+                return RuntimeError
+    raise FaultPlanError(f"unknown exception name {name!r} in {ENV_VAR}")
+
+
+def _parse_env(spec: str) -> dict:
+    plans: dict = {}
+    for entry in spec.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        m = _ENV_ENTRY.match(entry)
+        if m is None:
+            raise FaultPlanError(
+                f"malformed {ENV_VAR} entry {entry!r} "
+                "(expected site:ExcName[(message)]@calls)"
+            )
+        site = m.group("site")
+        if site not in SITES:
+            raise FaultPlanError(f"unknown fault site {site!r} in {ENV_VAR}")
+        exc_cls = _resolve_exc(m.group("exc"))
+        exc = exc_cls(m.group("msg")) if m.group("msg") else exc_cls
+        calls_s = m.group("calls").strip()
+        if calls_s == "*":
+            at_calls: object = "*"
+        elif calls_s.endswith("+"):
+            at_calls = (int(calls_s[:-1]), "+")
+        else:
+            at_calls = [int(c) for c in calls_s.split(",")]
+        plans.setdefault(site, []).append(FaultPlan(site, exc, at_calls))
+    return plans
+
+
+def _env_plans() -> dict:
+    global _ENV_CACHE
+    spec = os.environ.get(ENV_VAR, "")
+    if spec == _ENV_CACHE[0]:
+        return _ENV_CACHE[1]
+    plans = _parse_env(spec) if spec else {}
+    _ENV_CACHE = (spec, plans)
+    return plans
+
+
+def check(site: str) -> None:
+    """The hook the instrumented sites call. Raises the planned exception when
+    the site's call count matches an installed plan; otherwise returns (and,
+    with no plan installed for the site, returns without even counting)."""
+    plans = _PLANS.get(site)
+    spec = os.environ.get(ENV_VAR)
+    if not plans and not spec:
+        return
+    merged = list(plans) if plans else []
+    if spec:
+        merged.extend(_env_plans().get(site, ()))
+    if not merged:
+        return
+    count = _COUNTS[site] = _COUNTS.get(site, 0) + 1
+    for plan in merged:
+        if plan.matches(count):
+            plan.fired.append(count)
+            if _MON.enabled:
+                _instr.fault_injected(site)
+            raise plan.make(count)

@@ -1,0 +1,163 @@
+"""
+Preemption-safe checkpointing: turn SIGTERM/SIGINT into a checkpoint at the
+next step boundary.
+
+Preemptible TPU hosts get a termination notice delivered as a signal; the
+difference between "resume from step N" and "restart from scratch" is whether
+anything catches it. :class:`PreemptionGuard` is a context manager that
+installs signal handlers which only *set a flag* — nothing is saved from
+signal context (async-signal-unsafe, and the params mid-update would be a
+corrupt mix). The training loops poll :func:`should_checkpoint` **per step**
+(``nn/data_parallel.py``, ``optim/dp_optimizer.py``, and the kmeans/lasso fit
+loops all do) and route the save through the guard's
+:class:`~heat_tpu.utils.checkpoint.CheckpointManager` at the step boundary,
+where the state is a consistent (params, opt_state, step, RNG) snapshot and
+the write path is atomic + checksummed + retried.
+
+The contract (also in ``doc/robustness_notes.md``):
+
+1. Entering the guard installs handlers for ``signals`` (default
+   SIGTERM+SIGINT) and pushes the guard on a process-wide stack; exiting
+   restores the previous handlers exactly.
+2. A delivered signal (or an explicit, deterministic :meth:`trigger` from a
+   test) marks the guard *requested* and counts
+   ``preemption.requests{signame}``. Nothing else happens until a loop polls.
+3. The next :func:`should_checkpoint` poll returns True once;
+   :func:`checkpoint_now` saves through the guard's manager (counted as
+   ``checkpoint.ops{preemption-save}``), marks the request handled, and
+   returns the path. With no manager attached the request is still marked
+   handled (the poll is then a pure stop signal).
+4. :func:`stop_requested` stays True after the save, so loops break out and
+   the process can exit with a valid, restorable checkpoint on disk —
+   ``CheckpointManager.restore_latest_valid()`` picks it up on the next run.
+
+Guards nest (innermost wins); installing handlers off the main thread is
+impossible in CPython, so a guard entered there degrades to
+:meth:`trigger`-only mode instead of raising.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Any, Optional
+
+from ..monitoring import instrument as _instr
+from ..monitoring.registry import STATE as _MON
+
+__all__ = [
+    "PreemptionGuard",
+    "active",
+    "should_checkpoint",
+    "checkpoint_now",
+    "stop_requested",
+]
+
+#: process-wide guard stack (innermost last); polled by the training loops
+_GUARDS: list = []
+
+
+class PreemptionGuard:
+    """Signal-to-checkpoint bridge (see the module docstring).
+
+    Parameters
+    ----------
+    manager :
+        A :class:`~heat_tpu.utils.checkpoint.CheckpointManager` (or anything
+        with ``save(step, state) -> path``) the preemption checkpoint routes
+        through. Optional — without one the guard is a cooperative stop flag.
+    signals :
+        Signal numbers to intercept while the guard is active.
+    """
+
+    def __init__(self, manager=None, signals=(signal.SIGTERM, signal.SIGINT)):
+        self.manager = manager
+        self.signals = tuple(signals)
+        self.requested: Optional[int] = None  # the signal number, when seen
+        self.handled = False
+        self.saved_path: Optional[str] = None
+        self.saved_step: Optional[int] = None
+        self._previous: dict = {}
+        self._installed = False
+
+    # ------------------------------------------------------------------ signals
+    def _on_signal(self, signum, frame=None) -> None:
+        # signal context: flag only — the save happens at the step boundary
+        self.requested = signum
+        if _MON.enabled:
+            _instr.preemption_request(signal.Signals(signum).name)
+
+    def trigger(self, signum: int = signal.SIGTERM) -> None:
+        """Deterministically request a checkpoint, exactly as the signal
+        handler would (the in-test injection path — no real signal delivery,
+        no dependence on kernel timing)."""
+        self._on_signal(signum)
+
+    def __enter__(self) -> "PreemptionGuard":
+        if threading.current_thread() is threading.main_thread():
+            for s in self.signals:
+                self._previous[s] = signal.signal(s, self._on_signal)
+            self._installed = True
+        _GUARDS.append(self)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if self in _GUARDS:
+            _GUARDS.remove(self)
+        if self._installed:
+            for s, prev in self._previous.items():
+                signal.signal(s, prev)
+            self._previous.clear()
+            self._installed = False
+        return False
+
+    # ------------------------------------------------------------------ polling
+    def should_checkpoint(self) -> bool:
+        """Whether a preemption request is pending and unhandled (the per-step
+        poll of the training loops)."""
+        return self.requested is not None and not self.handled
+
+    def stop_requested(self) -> bool:
+        """Whether the loop should break out (a request was seen — before or
+        after the checkpoint was taken)."""
+        return self.requested is not None
+
+    def checkpoint_now(self, state: Any, step: int) -> Optional[str]:
+        """Save ``state`` as step ``step`` through the attached manager and
+        mark the request handled. Returns the checkpoint path (None without a
+        manager — the request is still marked handled)."""
+        path = None
+        if self.manager is not None:
+            path = self.manager.save(int(step), state)
+            if _MON.enabled:
+                _instr.checkpoint_op("preemption-save")
+        self.handled = True
+        self.saved_path = path
+        self.saved_step = int(step)
+        return path
+
+
+# ---------------------------------------------------------------- module-level API
+def active() -> Optional[PreemptionGuard]:
+    """The innermost active guard, or None (what the fit loops branch on)."""
+    return _GUARDS[-1] if _GUARDS else None
+
+
+def should_checkpoint() -> bool:
+    """Whether the innermost active guard has a pending checkpoint request.
+    False with no guard installed — the polling call sites stay inert."""
+    g = active()
+    return g.should_checkpoint() if g is not None else False
+
+
+def stop_requested() -> bool:
+    """Whether the innermost active guard saw a preemption request."""
+    g = active()
+    return g.stop_requested() if g is not None else False
+
+
+def checkpoint_now(state: Any, step: int) -> Optional[str]:
+    """Route a step-boundary checkpoint through the innermost active guard
+    (no-op returning None with no guard installed)."""
+    g = active()
+    return g.checkpoint_now(state, step) if g is not None else None

@@ -1,0 +1,95 @@
+"""
+Bounded retry with exponential backoff for transient host-side failures.
+
+A preemptible-host deployment sees transient ``OSError``/``EIO`` on network
+filesystems constantly; the reference framework surfaces every one as a crash
+mid-save. This module is the one shared policy object the IO layer
+(``core/io.py``) and the checkpoint writer (``utils/checkpoint.py``) route
+their host filesystem work through:
+
+* **Bounded**: at most ``max_attempts`` tries, then the last exception
+  propagates unchanged — a persistent failure still fails loudly.
+* **Exponential backoff, no jitter**: delays are
+  ``base_delay * multiplier**k`` capped at ``max_delay``. Deterministic by
+  design — the fault-injection differential suite replays the exact same
+  schedule every run (randomized jitter belongs to multi-client contention,
+  which a single-controller writer does not have).
+* **Selective**: only ``retry_on`` exception types are retried (default
+  ``OSError`` — which covers ``EIO``/``ENOSPC``/NFS hiccups); everything else
+  (a type error, a corrupt-input ``ValueError``) propagates on the first try.
+
+Each retried attempt increments ``io.retries{site}``, so the telemetry block
+shows exactly which writer paths are riding the policy.
+
+``HEAT_TPU_IO_RETRIES`` (attempts, default 3) and ``HEAT_TPU_IO_RETRY_DELAY``
+(base seconds, default 0.05) tune the default policy; ``HEAT_TPU_IO_RETRIES=1``
+disables retrying without touching call sites.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Tuple, Type
+
+from ..monitoring import instrument as _instr
+from ..monitoring.registry import STATE as _MON
+
+__all__ = ["RetryPolicy", "policy"]
+
+
+class RetryPolicy:
+    """Bounded exponential-backoff retry (see the module docstring)."""
+
+    __slots__ = ("max_attempts", "base_delay", "multiplier", "max_delay", "retry_on")
+
+    def __init__(
+        self,
+        max_attempts: int = 3,
+        base_delay: float = 0.05,
+        multiplier: float = 2.0,
+        max_delay: float = 2.0,
+        retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+    ):
+        if max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
+        self.max_attempts = int(max_attempts)
+        self.base_delay = float(base_delay)
+        self.multiplier = float(multiplier)
+        self.max_delay = float(max_delay)
+        self.retry_on = tuple(retry_on)
+
+    def delay(self, attempt: int) -> float:
+        """Backoff before retry number ``attempt`` (1-based)."""
+        return min(self.base_delay * self.multiplier ** (attempt - 1), self.max_delay)
+
+    def call(self, fn: Callable, site: str = "io", sleep: Callable = time.sleep):
+        """Run ``fn()``; on a ``retry_on`` failure, back off and retry up to
+        ``max_attempts`` total tries, counting each retry under
+        ``io.retries{site}``. The final failure propagates unchanged."""
+        attempt = 1
+        while True:
+            try:
+                return fn()
+            except self.retry_on as e:
+                if attempt >= self.max_attempts:
+                    raise
+                if _MON.enabled:
+                    _instr.io_retry(site)
+                sleep(self.delay(attempt))
+                attempt += 1
+                del e  # keep the traceback chain out of the retained frame
+
+
+def policy() -> RetryPolicy:
+    """The default IO retry policy, honoring the env tuning knobs (re-read per
+    call — these are cold paths, and tests flip the knobs mid-process)."""
+    try:
+        attempts = int(os.environ.get("HEAT_TPU_IO_RETRIES", "3"))
+    except ValueError:
+        attempts = 3
+    try:
+        base = float(os.environ.get("HEAT_TPU_IO_RETRY_DELAY", "0.05"))
+    except ValueError:
+        base = 0.05
+    return RetryPolicy(max_attempts=max(attempts, 1), base_delay=base)

@@ -14,6 +14,23 @@ module composes those blocks into a real subsystem — a capability superset:
   state rides along so a resumed run continues the counter-based stream exactly.
 - :class:`CheckpointManager` — step-numbered checkpoints with ``max_to_keep``
   retention, ``latest_step()`` discovery, and atomic write-then-rename.
+
+Integrity and graceful degradation (``doc/robustness_notes.md``):
+
+- every array leaf carries a CRC32 checksum in the manifest, validated on
+  :func:`load_checkpoint` (a mismatch raises :class:`CheckpointCorruptError`
+  instead of silently resuming from garbage);
+- :func:`validate_checkpoint` answers "would this file restore?" without
+  building arrays, and :meth:`CheckpointManager.restore_latest_valid` walks
+  back to the newest step that passes it (counted as
+  ``checkpoint.ops{corrupt-skipped}`` per rejected file) — a corrupt or
+  partially-written latest checkpoint costs one generation, not the run;
+- a :class:`CheckpointManager` cleans up orphaned ``*.ckpt.tmp`` files left
+  behind by killed writers at startup (``checkpoint.ops{orphan-cleaned}``);
+- writes pass the ``checkpoint.write`` fault-injection site and ride the
+  shared bounded-backoff retry policy (:mod:`heat_tpu.robustness.retry`), and
+  the :mod:`~heat_tpu.robustness.preemption` guard routes its
+  signal-triggered step-boundary saves through :meth:`CheckpointManager.save`.
 """
 
 from __future__ import annotations
@@ -22,6 +39,7 @@ import json
 import os
 import re
 import tempfile
+import zlib
 from typing import Any, Optional
 
 import numpy as np
@@ -35,12 +53,33 @@ from ..core.communication import sanitize_comm
 from ..core.devices import sanitize_device
 from ..core.dndarray import DNDarray
 from ..core.factories import array as ht_array
+from ..monitoring import instrument as _instr
+from ..monitoring.registry import STATE as _MON
+from ..robustness import faultinject as _FI
+from ..robustness import retry as _retry
 
-__all__ = ["save_checkpoint", "load_checkpoint", "CheckpointManager"]
+__all__ = [
+    "save_checkpoint",
+    "load_checkpoint",
+    "validate_checkpoint",
+    "CheckpointManager",
+    "CheckpointCorruptError",
+]
 
 _KIND_DND = "dndarray"
 _KIND_ARR = "array"
 _KIND_JSON = "json"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint file failed integrity validation (missing/unreadable
+    manifest, missing entry, or a per-leaf checksum mismatch)."""
+
+
+def _crc(data: np.ndarray) -> int:
+    """Manifest checksum of one array leaf: CRC32 over the C-contiguous bytes
+    of exactly what the dataset stores (dtype included via the byte layout)."""
+    return zlib.crc32(np.ascontiguousarray(data).tobytes())
 
 
 def _flatten(state: Any):
@@ -67,49 +106,94 @@ def save_checkpoint(path: str, state: Any, include_rng: bool = True) -> None:
     Save a pytree ``state`` to ``path`` (one HDF5 file, written atomically).
 
     Leaves may be DNDarrays (split metadata preserved), jax/numpy arrays, or JSON
-    scalars/strings. Raises on unsupported leaf types.
+    scalars/strings. Raises on unsupported leaf types. Every array leaf's CRC32
+    lands in the manifest (validated on load); the write passes the
+    ``checkpoint.write`` fault site and is retried on transient ``OSError``.
     """
     import h5py
 
-    entries = {}
-    tmp_fd, tmp_path = tempfile.mkstemp(
-        dir=os.path.dirname(os.path.abspath(path)) or ".", suffix=".ckpt.tmp"
-    )
-    os.close(tmp_fd)
+    def attempt():
+        _FI.check("checkpoint.write")
+        entries = {}
+        tmp_fd, tmp_path = tempfile.mkstemp(
+            dir=os.path.dirname(os.path.abspath(path)) or ".", suffix=".ckpt.tmp"
+        )
+        os.close(tmp_fd)
+        try:
+            with h5py.File(tmp_path, "w") as f:
+                for name, leaf in _flatten(state):
+                    if name in entries:
+                        raise ValueError(
+                            f"checkpoint leaf name collision at {name!r} "
+                            "(a dict key containing '/' shadows a nested path)"
+                        )
+                    if isinstance(leaf, DNDarray):
+                        data = leaf.numpy()
+                        f.create_dataset(name, data=data)
+                        entries[name] = {
+                            "kind": _KIND_DND,
+                            "split": leaf.split,
+                            "dtype": leaf.dtype.char(),
+                            "crc32": _crc(data),
+                        }
+                    elif isinstance(leaf, (jax.Array, np.ndarray)):
+                        data = np.asarray(leaf)
+                        f.create_dataset(name, data=data)
+                        entries[name] = {"kind": _KIND_ARR, "crc32": _crc(data)}
+                    elif isinstance(leaf, (bool, int, float, str)) or leaf is None:
+                        entries[name] = {"kind": _KIND_JSON, "value": leaf}
+                    else:
+                        raise TypeError(
+                            f"unsupported checkpoint leaf at {name!r}: {type(leaf)}"
+                        )
+                meta = {
+                    "entries": entries,
+                    "rng_state": list(ht_random.get_state()) if include_rng else None,
+                }
+                f.attrs["heat_tpu_checkpoint"] = json.dumps(meta)
+            os.replace(tmp_path, path)
+        except BaseException:
+            if os.path.exists(tmp_path):
+                os.unlink(tmp_path)
+            raise
+
+    _retry.policy().call(attempt, site="checkpoint.write")
+    if _MON.enabled:
+        _instr.checkpoint_op("write")
+
+
+def _read_meta(f) -> dict:
+    raw = f.attrs.get("heat_tpu_checkpoint")
+    if raw is None:
+        raise CheckpointCorruptError("missing heat_tpu_checkpoint manifest")
     try:
-        with h5py.File(tmp_path, "w") as f:
-            for name, leaf in _flatten(state):
-                if name in entries:
-                    raise ValueError(
-                        f"checkpoint leaf name collision at {name!r} "
-                        "(a dict key containing '/' shadows a nested path)"
-                    )
-                if isinstance(leaf, DNDarray):
-                    f.create_dataset(name, data=leaf.numpy())
-                    entries[name] = {
-                        "kind": _KIND_DND,
-                        "split": leaf.split,
-                        "dtype": leaf.dtype.char(),
-                    }
-                elif isinstance(leaf, (jax.Array, np.ndarray)):
-                    f.create_dataset(name, data=np.asarray(leaf))
-                    entries[name] = {"kind": _KIND_ARR}
-                elif isinstance(leaf, (bool, int, float, str)) or leaf is None:
-                    entries[name] = {"kind": _KIND_JSON, "value": leaf}
-                else:
-                    raise TypeError(
-                        f"unsupported checkpoint leaf at {name!r}: {type(leaf)}"
-                    )
-            meta = {
-                "entries": entries,
-                "rng_state": list(ht_random.get_state()) if include_rng else None,
-            }
-            f.attrs["heat_tpu_checkpoint"] = json.dumps(meta)
-        os.replace(tmp_path, path)
-    except BaseException:
-        if os.path.exists(tmp_path):
-            os.unlink(tmp_path)
-        raise
+        return json.loads(raw)
+    except ValueError as e:
+        raise CheckpointCorruptError(f"unreadable checkpoint manifest: {e}") from e
+
+
+def validate_checkpoint(path: str) -> bool:
+    """Whether ``path`` is a complete, uncorrupted checkpoint: the file opens,
+    the manifest parses, every manifest entry's dataset exists, and every
+    stored checksum matches the stored bytes. False for partial writes,
+    truncations, bit flips, and non-checkpoint files; checkpoints written
+    before checksums existed validate structurally (no crc to compare)."""
+    import h5py
+
+    try:
+        with h5py.File(path, "r") as f:
+            meta = _read_meta(f)
+            for name, ent in meta["entries"].items():
+                if ent["kind"] == _KIND_JSON:
+                    continue
+                if name not in f:
+                    return False
+                crc = ent.get("crc32")
+                if crc is not None and _crc(np.asarray(f[name])) != crc:
+                    return False
+        return True
+    except Exception:
+        return False
 
 
 def load_checkpoint(
@@ -118,19 +202,34 @@ def load_checkpoint(
     restore_rng: bool = True,
     device=None,
     comm=None,
+    validate: bool = True,
 ) -> Any:
     """
     Restore a checkpoint written by :func:`save_checkpoint` into the structure of
     ``target`` (a pytree with the same treedef; its leaf values supply placement:
     DNDarray leaves are restored as DNDarrays with the saved split over the current
     mesh, array leaves as ``jax.Array``).
+
+    With ``validate=True`` (default) every array leaf's bytes are checked against
+    the manifest CRC32 before anything is placed; a mismatch raises
+    :class:`CheckpointCorruptError` (see
+    :meth:`CheckpointManager.restore_latest_valid` for the fallback path).
     """
     import h5py
 
+    def check(name, ent, raw):
+        crc = ent.get("crc32")
+        if validate and crc is not None and _crc(raw) != crc:
+            raise CheckpointCorruptError(
+                f"checkpoint {path!r}: checksum mismatch at leaf {name!r}"
+            )
+        return raw
+
     device = sanitize_device(device)
     comm = sanitize_comm(comm)
+    _FI.check("io.read")
     with h5py.File(path, "r") as f:
-        meta = json.loads(f.attrs["heat_tpu_checkpoint"])
+        meta = _read_meta(f)
         entries = meta["entries"]
         flat_target = _flatten(target)
         restored = []
@@ -141,7 +240,7 @@ def load_checkpoint(
             if ent["kind"] == _KIND_JSON:
                 restored.append(ent["value"])
             elif ent["kind"] == _KIND_DND:
-                data = np.asarray(f[name])
+                data = check(name, ent, np.asarray(f[name]))
                 restored.append(
                     ht_array(
                         data,
@@ -152,7 +251,7 @@ def load_checkpoint(
                     )
                 )
             else:
-                raw = np.asarray(f[name])
+                raw = check(name, ent, np.asarray(f[name]))
                 if isinstance(leaf, np.ndarray):
                     # exact round-trip for host arrays, including 64-bit dtypes
                     restored.append(raw)
@@ -168,17 +267,21 @@ def load_checkpoint(
     treedef = jax.tree_util.tree_structure(
         target, is_leaf=lambda x: isinstance(x, DNDarray)
     )
+    if _MON.enabled:
+        _instr.checkpoint_op("restore")
     return jax.tree_util.tree_unflatten(treedef, restored)
 
 
 class CheckpointManager:
     """
-    Step-numbered checkpoint directory with retention.
+    Step-numbered checkpoint directory with retention, integrity fallback, and
+    orphan cleanup.
 
     >>> mgr = CheckpointManager("/tmp/ckpts", max_to_keep=3)
     >>> mgr.save(100, {"params": params, "step": 100})
     >>> state = mgr.restore(target)          # latest
     >>> state = mgr.restore(target, step=100)
+    >>> state = mgr.restore_latest_valid(target)  # newest that validates
     """
 
     _FMT = "ckpt_{step:012d}.h5"
@@ -187,7 +290,23 @@ class CheckpointManager:
     def __init__(self, directory: str, max_to_keep: Optional[int] = None):
         self.directory = directory
         self.max_to_keep = max_to_keep
+        #: step restored by the most recent :meth:`restore_latest_valid`
+        self.last_restored_step: Optional[int] = None
         os.makedirs(directory, exist_ok=True)
+        self._clean_orphans()
+
+    def _clean_orphans(self) -> None:
+        # tempfiles left by writers killed mid-save (the write-then-rename
+        # idiom means they never shadow a real checkpoint — just disk litter)
+        for name in os.listdir(self.directory):
+            if not name.endswith(".ckpt.tmp"):
+                continue
+            try:
+                os.unlink(os.path.join(self.directory, name))
+            except OSError:
+                continue
+            if _MON.enabled:
+                _instr.checkpoint_op("orphan-cleaned")
 
     def _path(self, step: int) -> str:
         return os.path.join(self.directory, self._FMT.format(step=step))
@@ -203,6 +322,16 @@ class CheckpointManager:
     def latest_step(self) -> Optional[int]:
         steps = self.all_steps()
         return steps[-1] if steps else None
+
+    def latest_valid_step(self) -> Optional[int]:
+        """The newest step whose file passes :func:`validate_checkpoint`
+        (corrupt/partial newer files are counted ``corrupt-skipped``)."""
+        for step in reversed(self.all_steps()):
+            if validate_checkpoint(self._path(step)):
+                return step
+            if _MON.enabled:
+                _instr.checkpoint_op("corrupt-skipped")
+        return None
 
     def save(self, step: int, state: Any, include_rng: bool = True) -> str:
         path = self._path(step)
@@ -222,3 +351,19 @@ class CheckpointManager:
             if step is None:
                 raise FileNotFoundError(f"no checkpoints in {self.directory!r}")
         return load_checkpoint(self._path(step), target, **kw)
+
+    def restore_latest_valid(self, target: Any, **kw) -> Any:
+        """Restore the newest checkpoint that passes integrity validation,
+        skipping corrupt/partial newer ones (each counted
+        ``checkpoint.ops{corrupt-skipped}``). The chosen step is recorded in
+        :attr:`last_restored_step`. Raises ``FileNotFoundError`` when no valid
+        checkpoint exists."""
+        step = self.latest_valid_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no valid checkpoints in {self.directory!r} "
+                f"(steps on disk: {self.all_steps()})"
+            )
+        state = load_checkpoint(self._path(step), target, **kw)
+        self.last_restored_step = step
+        return state

@@ -50,6 +50,26 @@ def _clean(monkeypatch):
     registry.reset()
 
 
+@pytest.fixture
+def no_faults(monkeypatch):
+    """Pin fault injection OFF for compile/cache-count-asserting tests.
+
+    The CI robustness leg runs this whole marker suite under a standing
+    ``HEAT_TPU_FAULT_PLAN`` compile-fault plan (ISSUE 6): every fused flush
+    then recovers through the ladder's per-op eager replay, so *results* stay
+    bit-identical — which is exactly what the differential tests prove — but
+    fused-kernel/compile/cache-hit counting is meaningless there. Same
+    precedent as the view/GEMM hatch leg, where deferral-asserting tests pin
+    the gates ON via monkeypatch. Clearing the trace cache also drops
+    signatures the standing plan poisoned earlier in the process, so this
+    test's chains re-attempt fused compilation."""
+    from heat_tpu.robustness import faultinject
+
+    monkeypatch.delenv("HEAT_TPU_FAULT_PLAN", raising=False)
+    faultinject.clear()
+    fusion.clear_cache()
+
+
 def _bitwise_equal(a: np.ndarray, b: np.ndarray) -> bool:
     return a.shape == b.shape and a.dtype == b.dtype and a.tobytes() == b.tobytes()
 
@@ -500,7 +520,7 @@ def test_chain_length_bound(monkeypatch):
     assert _bitwise_equal(x.numpy(), np.full((8,), 12.0, np.float32))
 
 
-def test_trace_cache_hits_and_lru(monkeypatch):
+def test_trace_cache_hits_and_lru(monkeypatch, no_faults):
     fusion.clear_cache()
     base = fusion.cache_info()
     a = ht.ones((8, 4), split=0)
@@ -516,7 +536,7 @@ def test_trace_cache_hits_and_lru(monkeypatch):
     assert fusion.cache_info()["entries"] <= 2
 
 
-def test_monitoring_counters(monkeypatch):
+def test_monitoring_counters(monkeypatch, no_faults):
     rng = np.random.default_rng(17)
     a = ht.array(rng.standard_normal((16, 4)).astype(np.float32), split=0)
     a.parray  # noqa: B018
@@ -781,7 +801,7 @@ def test_moment_and_norm_sinks_defer_and_match(monkeypatch):
     np.testing.assert_allclose(fused, eager, rtol=1e-6)
 
 
-def test_epilogue_re_rooting_single_kernel():
+def test_epilogue_re_rooting_single_kernel(no_faults):
     # acceptance: chain -> reduce (+ scalar epilogues) compiles exactly ONE
     # XLA executable, asserted via the jax.monitoring compile-miss listener
     rng = np.random.default_rng(29)
@@ -883,7 +903,7 @@ def test_cum_sink_traces_collective_in_program():
     np.testing.assert_allclose(cn, np.cumsum(a.numpy() * 2.0, axis=0), rtol=1e-5)
 
 
-def test_sink_trace_cache_key_separates_reduce_params():
+def test_sink_trace_cache_key_separates_reduce_params(no_faults):
     # axis / keepdims / op variants over the SAME chain structure must compile
     # distinct kernels (cache key carries the sink signature) yet cache-hit on
     # exact repetition
@@ -936,7 +956,7 @@ def test_out_kwarg_reduce_skips_sink():
     np.testing.assert_allclose(out.numpy(), (a.numpy() * 2.0).sum(axis=0), rtol=1e-5)
 
 
-def test_sink_flush_materializes_live_chain_in_same_kernel(monkeypatch):
+def test_sink_flush_materializes_live_chain_in_same_kernel(monkeypatch, no_faults):
     # multi-output sink flush: when the consumed chain's owner is still alive
     # at flush time, the chain materializes as a SECOND output of the same
     # kernel — one compile total, no replay compile when the owner is read,
@@ -1039,7 +1059,7 @@ def test_view_chain_stays_pending(split, monkeypatch):
     np.testing.assert_allclose(r.numpy(), ref, rtol=1e-6)
 
 
-def test_view_chain_single_compile(monkeypatch):
+def test_view_chain_single_compile(monkeypatch, no_faults):
     # acceptance: chain + transpose + slice + epilogue compile as exactly ONE
     # XLA program, and no flush is attributed to indexing
     monkeypatch.setenv("HEAT_TPU_FUSION_VIEWS", "1")
@@ -1115,7 +1135,7 @@ def test_view_replay_after_rebind():
     assert _bitwise_equal(x.numpy(), ref + 1.0)
 
 
-def test_view_lru_key_separates_metadata(monkeypatch):
+def test_view_lru_key_separates_metadata(monkeypatch, no_faults):
     # distinct view parameters over the SAME chain structure must compile
     # distinct kernels (cache key carries the view node metadata) yet
     # cache-hit on exact repetition
@@ -1220,7 +1240,7 @@ def test_dot_1d_producer_differential(monkeypatch, split):
     assert _bitwise_equal(eager, fused)
 
 
-def test_gemm_epilogue_single_compile(monkeypatch):
+def test_gemm_epilogue_single_compile(monkeypatch, no_faults):
     # acceptance: the canonical act(x @ w + b) training pattern compiles as
     # exactly ONE XLA program — the bias add and activation land in the
     # GEMM's epilogue
@@ -1245,7 +1265,7 @@ def test_gemm_epilogue_single_compile(monkeypatch):
     np.testing.assert_allclose(yn, ref, rtol=1e-5, atol=1e-6)
 
 
-def test_gemm_loss_epilogue_rides_sink(monkeypatch):
+def test_gemm_loss_epilogue_rides_sink(monkeypatch, no_faults):
     # act(x@w+b) -> mean: the GEMM producer, elementwise epilogue, and the
     # mean sink are one pending DAG flushed as one kernel
     monkeypatch.setenv("HEAT_TPU_FUSION_GEMM", "1")

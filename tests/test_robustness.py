@@ -1,0 +1,601 @@
+"""
+Fault-injection differential suite for the graceful-degradation runtime
+(ISSUE 6: ``heat_tpu/robustness/`` + the fused-flush recovery ladder).
+
+The guarantees pinned here:
+
+* **Determinism.** Every fault plan fires by call count only — the same plan
+  always fails the same calls, programmatic or env-driven — and with no plan
+  installed the hooks are inert (no counting, no behavior change).
+* **Fused-flush recovery ladder.** An injected ``fusion.compile`` /
+  ``fusion.execute`` fault during a flush never raises to the caller: the
+  result is bit-identical to ``HEAT_TPU_FUSION=0`` (per-op eager replay of
+  the retained DAG), the failure/recovery/poisoning counters increment
+  exactly as attributed, and a repeat of the same chain takes the
+  poisoned-signature fast path without consulting the fault sites again.
+* **IO.** Saves are write-then-rename atomic (a failing save never truncates
+  an existing file), transient ``OSError`` is retried with bounded backoff
+  (``io.retries{site}``), and non-transient exceptions propagate on the first
+  try.
+* **Checkpoints.** Per-leaf checksums catch bit flips; ``restore_latest_valid``
+  walks back over corrupt/truncated newer files; orphaned tempfiles are
+  cleaned at manager startup.
+* **Preemption.** ``kill -TERM`` during a data-parallel / DASO / kmeans /
+  lasso loop produces a valid checkpoint at the next step boundary with exact
+  RNG/step state, and the loops stop cooperatively.
+"""
+
+import os
+import signal
+import tempfile
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import heat_tpu as ht
+from heat_tpu import monitoring
+from heat_tpu.core import fusion
+from heat_tpu.monitoring import registry, report
+from heat_tpu.nn.data_parallel import DataParallel
+from heat_tpu.optim.dp_optimizer import DASO
+from heat_tpu.robustness import faultinject, preemption, retry
+from heat_tpu.utils.checkpoint import (
+    CheckpointCorruptError,
+    CheckpointManager,
+    load_checkpoint,
+    save_checkpoint,
+    validate_checkpoint,
+)
+
+pytestmark = pytest.mark.robustness
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    registry.reset()
+    faultinject.clear()
+    monkeypatch.delenv("HEAT_TPU_FAULT_PLAN", raising=False)
+    # keep the deterministic backoff schedule but don't spend wall time on it
+    monkeypatch.setenv("HEAT_TPU_IO_RETRY_DELAY", "0.001")
+    fusion.clear_cache()
+    yield
+    faultinject.clear()
+    registry.reset()
+
+
+def _bitwise_equal(a: np.ndarray, b: np.ndarray) -> bool:
+    return a.shape == b.shape and a.dtype == b.dtype and a.tobytes() == b.tobytes()
+
+
+# ------------------------------------------------------------------ fault injection
+def test_sites_inert_without_plan():
+    assert not faultinject.active()
+    for site in faultinject.SITES:
+        faultinject.check(site)  # no plan: must not raise...
+        assert faultinject.call_count(site) == 0  # ...and must not even count
+
+
+def test_programmatic_plan_is_deterministic_by_call_count():
+    with faultinject.inject("io.write", ValueError, at_calls=[2, 4]) as plan:
+        fired = []
+        for call in range(1, 6):
+            try:
+                faultinject.check("io.write")
+                fired.append(False)
+            except ValueError:
+                fired.append(True)
+        assert fired == [False, True, False, True, False]
+        assert plan.fired == [2, 4]
+        assert faultinject.call_count("io.write") == 5
+    # the context manager removed the plan: the site is inert again
+    faultinject.check("io.write")
+    assert not faultinject.active()
+
+
+def test_inject_validates_site_and_raises_instance_verbatim():
+    with pytest.raises(ValueError):
+        faultinject.inject("no.such.site", RuntimeError)
+    exc = RuntimeError("RESOURCE_EXHAUSTED: fake")
+    with faultinject.inject("io.read", exc, at_calls="*"):
+        with pytest.raises(RuntimeError) as ei:
+            faultinject.check("io.read")
+        assert ei.value is exc
+
+
+def test_env_plan_parses_fires_and_counts(monkeypatch):
+    monkeypatch.setenv(
+        "HEAT_TPU_FAULT_PLAN",
+        "io.write:OSError@1,3;checkpoint.write:RuntimeError(RESOURCE_EXHAUSTED)@2+",
+    )
+    assert faultinject.active()
+    outcomes = []
+    for _ in range(4):
+        try:
+            faultinject.check("io.write")
+            outcomes.append(None)
+        except OSError:
+            outcomes.append("os")
+    assert outcomes == ["os", None, "os", None]
+    faultinject.check("checkpoint.write")  # call 1: below the 2+ threshold
+    for _ in range(2):  # calls 2 and 3 both fire
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            faultinject.check("checkpoint.write")
+    # sites without an env entry stay inert and uncounted
+    faultinject.check("io.read")
+    assert faultinject.call_count("io.read") == 0
+
+
+def test_env_plan_rejects_malformed_entries(monkeypatch):
+    for bad in ("fusion.compile", "no.site:OSError@1", "io.write:NoSuchExc@1"):
+        monkeypatch.setenv("HEAT_TPU_FAULT_PLAN", bad)
+        with pytest.raises(faultinject.FaultPlanError):
+            faultinject.check("io.write")
+        monkeypatch.setenv("HEAT_TPU_FAULT_PLAN", "")  # reset the parse cache
+
+
+def test_malformed_plan_is_a_config_error_not_a_recoverable_fault(monkeypatch):
+    # the ladder absorbs injected FAILURES; a broken plan must surface loudly
+    # instead of silently demoting every flush to eager replay
+    a = ht.ones((4, 3), split=0)
+    a.parray  # noqa: B018
+    monkeypatch.setenv("HEAT_TPU_FAULT_PLAN", "fusion.compile:NoSuchExc@1")
+    with monitoring.capture():
+        registry.reset()
+        with pytest.raises(faultinject.FaultPlanError):
+            (a + 1.0).numpy()
+        snap = registry.snapshot()["counters"]
+    assert "fusion.flush_recovered" not in snap
+
+
+def test_collective_dispatch_site_fires_deterministically():
+    a = ht.ones((8, 3), split=0)
+    with faultinject.inject("collective.dispatch", RuntimeError, at_calls=[2]):
+        _ = a.comm.Allreduce(a.larray)  # call 1: runs
+        with pytest.raises(RuntimeError):
+            a.comm.Allreduce(a.larray)  # call 2: injected
+        _ = a.comm.Allreduce(a.larray)  # call 3: runs again
+
+
+# ------------------------------------------------------------------ recovery ladder
+def _ladder_workload(a, b):
+    # elementwise chain + view + GEMM epilogue + sink: every node kind rides
+    # the same flush, so one recovered flush covers the whole DAG surface
+    y = (a + 1.5) * b
+    y = ht.abs(y).T[1:, :]
+    return y.sum(axis=0)
+
+
+def test_injected_compile_fault_never_raises_and_poisons(monkeypatch):
+    # acceptance: an injected fusion.compile fault during a fused flush never
+    # raises; the result is bit-identical to HEAT_TPU_FUSION=0;
+    # fusion.flush_recovered increments; a repeat of the same chain hits the
+    # poisoned-signature fast path (no second retry, no second fault check)
+    rng = np.random.default_rng(3)
+    a = ht.array(rng.standard_normal((12, 6)).astype(np.float32), split=0)
+    b = ht.array(rng.standard_normal((12, 6)).astype(np.float32), split=0)
+    a.parray, b.parray  # noqa: B018
+
+    monkeypatch.setenv("HEAT_TPU_FUSION", "0")
+    ref = _ladder_workload(a, b).numpy()
+    monkeypatch.setenv("HEAT_TPU_FUSION", "1")
+
+    with monitoring.capture():
+        registry.reset()
+        with faultinject.inject("fusion.compile", RuntimeError, at_calls=[1]) as plan:
+            got = _ladder_workload(a, b).numpy()
+            assert plan.fired == [1]
+            repeat = _ladder_workload(a, b).numpy()
+            # the poisoned fast path never consulted the fault site again
+            assert faultinject.call_count("fusion.compile") == 1
+        snap = registry.snapshot()["counters"]
+    assert _bitwise_equal(got, ref)
+    assert _bitwise_equal(repeat, ref)
+    assert snap["fusion.flush_failures"]["labels"] == {"compile": 1}
+    assert snap["fusion.flush_recovered"] == 1
+    assert snap["fusion.poisoned_signatures"] == 1
+    assert snap["faults.injected"]["labels"] == {"fusion.compile": 1}
+    assert fusion.cache_info()["poisoned"] >= 1
+
+
+def test_execute_fault_with_oom_signature_counts_oom(monkeypatch):
+    a = ht.ones((6, 4), split=0)
+    a.parray  # noqa: B018
+    with monitoring.capture():
+        registry.reset()
+        exc = RuntimeError("RESOURCE_EXHAUSTED: out of memory while allocating")
+        with faultinject.inject("fusion.execute", exc, at_calls=[1]):
+            got = ((a * 3.0) - 1.0).numpy()
+        snap = registry.snapshot()["counters"]
+    assert _bitwise_equal(got, np.full((6, 4), 2.0, np.float32))
+    assert snap["fusion.flush_failures"]["labels"] == {"oom": 1}
+    assert snap["fusion.flush_recovered"] == 1
+
+
+def test_ladder_rung2_retries_with_donation_disabled():
+    # unit-level: when the failed flush HAD donated buffers, the ladder's
+    # second rung rebuilds the kernel donation-free before giving up on fused
+    # execution — recovery at rung 2 does not poison the signature
+    program = [(jnp.add, (("l", 0), ("l", 1)), {}, None)]
+    leaves = [jnp.ones((3,), jnp.float32), jnp.full((3,), 2.0, jnp.float32)]
+
+    def broken_fused(*args):
+        raise RuntimeError("compile blew up")
+
+    with monitoring.capture():
+        registry.reset()
+        values = fusion._flush_ladder(
+            broken_fused, program, leaves, (0,), (0,), True, None
+        )
+        snap = registry.snapshot()["counters"]
+    np.testing.assert_array_equal(np.asarray(values[0]), np.full((3,), 3.0))
+    assert snap["fusion.flush_failures"]["total"] == 1
+    assert snap["fusion.flush_recovered"] == 1
+    assert "fusion.poisoned_signatures" not in snap
+    assert fusion.cache_info()["poisoned"] == 0
+
+
+def test_standing_env_compile_plan_keeps_results_bit_identical(monkeypatch):
+    # the CI robustness leg in miniature: with EVERY fused compile failing,
+    # the whole op surface must still produce HEAT_TPU_FUSION=0 results
+    rng = np.random.default_rng(11)
+    a = ht.array(rng.standard_normal((10, 8)).astype(np.float32), split=0)
+    b = ht.array(rng.standard_normal((10, 8)).astype(np.float32), split=0)
+    w = ht.array(rng.standard_normal((8, 5)).astype(np.float32))
+    a.parray, b.parray, w.parray  # noqa: B018
+    workloads = [
+        lambda: ht.sqrt(ht.abs(a * b) + 1.0) - 0.5,
+        lambda: ((a + b) * 2.0).T[2:, :],
+        lambda: ht.where(a > 0, a, b) / 3.0,
+        lambda: (ht.abs(a) + 1.0).sum(axis=1),
+        lambda: ht.tanh(a @ w + 0.25),
+    ]
+    for i, fn in enumerate(workloads):
+        monkeypatch.delenv("HEAT_TPU_FAULT_PLAN", raising=False)
+        monkeypatch.setenv("HEAT_TPU_FUSION", "0")
+        ref = fn().numpy()
+        monkeypatch.setenv("HEAT_TPU_FUSION", "1")
+        monkeypatch.setenv("HEAT_TPU_FAULT_PLAN", "fusion.compile:RuntimeError@*")
+        got = fn().numpy()
+        assert _bitwise_equal(got, ref), f"workload {i} diverged under standing plan"
+
+
+# ------------------------------------------------------------------ retry policy
+def test_retry_policy_backoff_schedule_is_deterministic():
+    pol = retry.RetryPolicy(max_attempts=4, base_delay=0.1, multiplier=2.0, max_delay=0.3)
+    assert [pol.delay(k) for k in (1, 2, 3)] == [0.1, 0.2, 0.3]
+    calls = {"n": 0}
+    slept = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert pol.call(flaky, site="unit", sleep=slept.append) == "ok"
+    assert calls["n"] == 3
+    assert slept == [0.1, 0.2]
+
+
+def test_retry_policy_exhaustion_and_selectivity():
+    pol = retry.RetryPolicy(max_attempts=2, base_delay=0.0)
+    calls = {"n": 0}
+
+    def always_os():
+        calls["n"] += 1
+        raise OSError("persistent")
+
+    with pytest.raises(OSError):
+        pol.call(always_os, sleep=lambda _t: None)
+    assert calls["n"] == 2  # bounded
+    calls["n"] = 0
+
+    def type_err():
+        calls["n"] += 1
+        raise ValueError("not transient")
+
+    with pytest.raises(ValueError):
+        pol.call(type_err, sleep=lambda _t: None)
+    assert calls["n"] == 1  # non-retry_on exceptions propagate immediately
+
+
+# ------------------------------------------------------------------ atomic IO
+def test_csv_save_retries_transient_and_never_truncates(tmp_path):
+    a = ht.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    path = str(tmp_path / "x.csv")
+    with monitoring.capture():
+        registry.reset()
+        with faultinject.inject("io.write", OSError, at_calls=[1]):
+            ht.save_csv(a, path)  # first attempt faulted, retry landed it
+        snap = registry.snapshot()["counters"]
+    assert snap["io.retries"]["labels"] == {"save_csv": 1}
+    assert np.allclose(ht.load_csv(path).numpy(), a.numpy())
+
+    # a persistent failure exhausts the retries and raises — but the
+    # write-then-rename idiom leaves the existing file byte-for-byte intact
+    with faultinject.inject("io.write", OSError, at_calls="*"):
+        with pytest.raises(OSError):
+            ht.save_csv(a * 2.0, path)
+    assert np.allclose(ht.load_csv(path).numpy(), a.numpy())
+    assert not [n for n in os.listdir(tmp_path) if n.endswith(".tmp")]
+
+
+def test_csv_load_retries_transient(tmp_path):
+    a = ht.array(np.arange(4, dtype=np.float32).reshape(2, 2))
+    path = str(tmp_path / "y.csv")
+    ht.save_csv(a, path)
+    with monitoring.capture():
+        registry.reset()
+        with faultinject.inject("io.read", OSError, at_calls=[1]):
+            b = ht.load_csv(path)
+        snap = registry.snapshot()["counters"]
+    assert np.allclose(b.numpy(), a.numpy())
+    assert snap["io.retries"]["labels"] == {"load_csv": 1}
+
+
+@pytest.mark.skipif(not ht.io.supports_hdf5(), reason="h5py not available")
+def test_hdf5_save_is_atomic_under_midwrite_death(tmp_path):
+    a = ht.array(np.arange(24, dtype=np.float32).reshape(6, 4), split=0)
+    path = str(tmp_path / "x.h5")
+    ht.save_hdf5(a, path, "data")
+    # non-transient mid-write death on every attempt: the tempfile is
+    # discarded, the existing file (and its readable dataset) survive
+    with faultinject.inject("io.write", ValueError, at_calls="*"):
+        with pytest.raises(ValueError):
+            ht.save_hdf5(a * 7.0, path, "data")
+    b = ht.load_hdf5(path, "data", split=0)
+    assert _bitwise_equal(b.numpy(), a.numpy())
+    assert not [n for n in os.listdir(tmp_path) if n.endswith(".tmp")]
+
+
+# ------------------------------------------------------------------ checkpoint integrity
+def _state(v: float, split=0):
+    return {
+        "w": ht.array(np.full((6, 2), v, np.float32), split=split),
+        "k": jnp.asarray([v], jnp.float32),
+        "step": int(v),
+    }
+
+
+def test_checksum_detects_bitflip_and_manager_falls_back(tmp_path):
+    import h5py
+
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=4)
+    mgr.save(1, _state(1.0))
+    mgr.save(2, _state(2.0))
+    latest = mgr._path(2)
+    with h5py.File(latest, "r+") as f:  # bit flip inside a valid hdf5 file
+        f["w"][0, 0] = 777.0
+    assert not validate_checkpoint(latest)
+    with pytest.raises(CheckpointCorruptError):
+        load_checkpoint(latest, _state(0.0))
+    with monitoring.capture():
+        registry.reset()
+        restored = mgr.restore_latest_valid(_state(0.0))
+        snap = registry.snapshot()["counters"]
+    assert mgr.last_restored_step == 1
+    assert restored["step"] == 1
+    assert np.allclose(restored["w"].numpy(), 1.0)
+    assert snap["checkpoint.ops"]["labels"].get("corrupt-skipped", 0) >= 1
+    assert snap["checkpoint.ops"]["labels"].get("restore", 0) == 1
+
+
+def test_truncated_partial_checkpoint_is_skipped(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(5, _state(5.0))
+    mgr.save(9, _state(9.0))
+    latest = mgr._path(9)
+    size = os.path.getsize(latest)
+    with open(latest, "r+b") as f:  # a writer killed mid-write (no h5 footer)
+        f.truncate(size // 2)
+    assert not validate_checkpoint(latest)
+    assert mgr.latest_valid_step() == 5
+    restored = mgr.restore_latest_valid(_state(0.0))
+    assert restored["step"] == 5
+
+
+def test_orphaned_tempfiles_cleaned_at_startup(tmp_path):
+    (tmp_path / "tmpdead1.ckpt.tmp").write_bytes(b"partial")
+    (tmp_path / "tmpdead2.ckpt.tmp").write_bytes(b"partial")
+    with monitoring.capture():
+        registry.reset()
+        CheckpointManager(str(tmp_path))
+        snap = registry.snapshot()["counters"]
+    assert snap["checkpoint.ops"]["labels"]["orphan-cleaned"] == 2
+    assert not [n for n in os.listdir(tmp_path) if n.endswith(".ckpt.tmp")]
+
+
+def test_checkpoint_write_fault_retried_then_atomic_on_hard_failure(tmp_path):
+    path = str(tmp_path / "c.h5")
+    with monitoring.capture():
+        registry.reset()
+        with faultinject.inject("checkpoint.write", OSError, at_calls=[1]):
+            save_checkpoint(path, _state(3.0))
+        snap = registry.snapshot()["counters"]
+    assert snap["io.retries"]["labels"] == {"checkpoint.write": 1}
+    assert snap["checkpoint.ops"]["labels"]["write"] == 1
+    assert validate_checkpoint(path)
+    # hard failure: the established checkpoint survives, no tempfile litter
+    with faultinject.inject("checkpoint.write", ValueError, at_calls="*"):
+        with pytest.raises(ValueError):
+            save_checkpoint(path, _state(4.0))
+    restored = load_checkpoint(path, _state(0.0))
+    assert restored["step"] == 3
+    assert not [n for n in os.listdir(tmp_path) if n.endswith(".ckpt.tmp")]
+
+
+# ------------------------------------------------------------------ preemption
+class _TinyModule:
+    """Minimal init/apply pair (a linear layer) for the trainer wrappers."""
+
+    def init(self, rng, x):
+        del rng
+        return {"w": jnp.zeros((x.shape[1], 1), jnp.float32)}
+
+    def apply(self, params, x):
+        return x @ params["w"]
+
+
+def _mse(params, apply_fn, x, y):
+    return jnp.mean((apply_fn(params, x) - y) ** 2)
+
+
+def _batch(n=16, f=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, f)).astype(np.float32)
+    y = (x @ rng.standard_normal((f, 1))).astype(np.float32)
+    return x, y
+
+
+def test_sigterm_during_data_parallel_training_leaves_valid_checkpoint(tmp_path):
+    # acceptance: kill -TERM mid-training produces a checkpoint from which
+    # restore_latest_valid resumes with exact RNG/step state; a deliberately
+    # corrupted latest checkpoint is skipped for the previous valid one
+    import h5py
+
+    x, y = _batch()
+    dp = DataParallel(_TinyModule(), optimizer=optax.sgd(0.1))
+    dp.init(0, x)
+    dp.make_train_step(_mse)
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=5)
+
+    with preemption.PreemptionGuard(manager=mgr) as guard:
+        dp.train_step(x, y)
+        dp.train_step(x, y)
+        mgr.save(dp.step_count, dp.checkpoint_state())  # periodic checkpoint
+        rng_before = ht.random.get_state()
+        os.kill(os.getpid(), signal.SIGTERM)  # the preemption notice
+        dp.train_step(x, y)  # the next step boundary lands the checkpoint
+        assert guard.handled and guard.saved_step == 3
+        assert preemption.stop_requested()  # the user loop breaks here
+    assert validate_checkpoint(mgr._path(3))
+    saved_params = jax.tree.map(np.asarray, dp.params)
+
+    # scramble the live state, then resume from the preemption checkpoint
+    ht.random.seed(12345)
+    dp.train_step(x, y)
+    restored = mgr.restore_latest_valid(dp.checkpoint_state())
+    dp.load_state(restored)
+    assert mgr.last_restored_step == 3
+    assert dp.step_count == 3
+    for got, want in zip(
+        jax.tree.leaves(jax.tree.map(np.asarray, dp.params)),
+        jax.tree.leaves(saved_params),
+    ):
+        assert _bitwise_equal(np.asarray(got), np.asarray(want))
+    # exact RNG resume: the stream continues from the save point
+    assert tuple(ht.random.get_state()) == tuple(rng_before)
+
+    # corrupt the latest: restore_latest_valid falls back to the step-2 save
+    with h5py.File(mgr._path(3), "r+") as f:
+        f["params/w"][0, 0] = 1e9
+    restored = mgr.restore_latest_valid(dp.checkpoint_state())
+    assert mgr.last_restored_step == 2
+    dp.load_state(restored)
+    assert dp.step_count == 2
+
+
+def test_sigterm_during_daso_training_checkpoints_at_step_boundary(tmp_path):
+    x, y = _batch(n=16)
+    daso = DASO(optax.sgd(0.05), total_epochs=4, warmup_epochs=0, cooldown_epochs=0)
+    params = {"w": jnp.zeros((x.shape[1], 1), jnp.float32)}
+    daso.init(params)
+    daso.make_train_step(_mse, _TinyModule().apply)
+    mgr = CheckpointManager(str(tmp_path))
+
+    with preemption.PreemptionGuard(manager=mgr) as guard:
+        daso.step(x, y)
+        guard.trigger(signal.SIGTERM)  # deterministic in-test injection
+        daso.step(x, y)
+        assert guard.handled and guard.saved_step == 2
+    restored = mgr.restore_latest_valid(daso.checkpoint_state())
+    daso.load_state(restored)
+    assert daso.step_count == 2 and restored["epoch"] == daso.epoch
+
+
+def test_preemption_guard_checkpoints_kmeans_fit(tmp_path):
+    rng = np.random.default_rng(21)
+    X = ht.array(rng.standard_normal((64, 4)).astype(np.float32), split=0)
+    mgr = CheckpointManager(str(tmp_path))
+    from heat_tpu.cluster import KMeans
+
+    with preemption.PreemptionGuard(manager=mgr) as guard:
+        guard.trigger()
+        km = KMeans(n_clusters=3, max_iter=50, random_state=0).fit(X)
+    assert guard.handled and guard.saved_step == 1
+    assert km._n_iter == 1  # the fit stopped at the checkpointed boundary
+    target = {"centers": jnp.zeros((3, 4), jnp.float32), "iteration": 0}
+    restored = mgr.restore_latest_valid(target)
+    assert restored["iteration"] == 1
+    assert np.asarray(restored["centers"]).shape == (3, 4)
+
+
+def test_preemption_guard_checkpoints_lasso_fit(tmp_path):
+    rng = np.random.default_rng(23)
+    X = ht.array(rng.standard_normal((32, 5)).astype(np.float32))
+    ydat = ht.array(rng.standard_normal((32, 1)).astype(np.float32))
+    mgr = CheckpointManager(str(tmp_path))
+    from heat_tpu.regression import Lasso
+
+    with preemption.PreemptionGuard(manager=mgr) as guard:
+        guard.trigger()
+        est = Lasso(lam=0.05, max_iter=50, tol=0.0).fit(X, ydat)
+    assert guard.handled and guard.saved_step == 1
+    assert est.n_iter == 1
+    target = {"theta": jnp.zeros((6,), jnp.float32), "sweep": 0}
+    restored = mgr.restore_latest_valid(target)
+    assert restored["sweep"] == 1
+
+
+def test_guard_restores_signal_handlers_and_nests():
+    prev_term = signal.getsignal(signal.SIGTERM)
+    prev_int = signal.getsignal(signal.SIGINT)
+    with preemption.PreemptionGuard() as outer:
+        assert preemption.active() is outer
+        with preemption.PreemptionGuard() as inner:
+            assert preemption.active() is inner  # innermost wins
+            assert not preemption.should_checkpoint()
+            inner.trigger()
+            assert preemption.should_checkpoint()
+            # no manager attached: handling degrades to a pure stop flag
+            assert preemption.checkpoint_now({"x": 1}, step=7) is None
+            assert not preemption.should_checkpoint()
+            assert preemption.stop_requested()
+        assert preemption.active() is outer
+    assert preemption.active() is None
+    assert signal.getsignal(signal.SIGTERM) is prev_term
+    assert signal.getsignal(signal.SIGINT) is prev_int
+
+
+def test_preemption_request_counter_labelled_by_signal():
+    with monitoring.capture():
+        registry.reset()
+        with preemption.PreemptionGuard() as guard:
+            guard.trigger(signal.SIGTERM)
+        snap = registry.snapshot()["counters"]
+    assert snap["preemption.requests"]["labels"] == {"SIGTERM": 1}
+
+
+# ------------------------------------------------------------------ telemetry
+def test_telemetry_exports_robustness_counters(tmp_path):
+    a = ht.ones((6, 3), split=0)
+    a.parray  # noqa: B018
+    path = str(tmp_path / "t.csv")
+    with monitoring.capture():
+        registry.reset()
+        with faultinject.inject("fusion.compile", RuntimeError, at_calls=[1]):
+            _ = (a + 2.0).numpy()
+        with faultinject.inject("io.write", OSError, at_calls=[1]):
+            ht.save_csv(a, path)
+        save_checkpoint(str(tmp_path / "c.h5"), {"s": 1})
+        tele = report.telemetry()
+    assert tele["fusion_flush_failures"] == {"compile": 1}
+    assert tele["fusion_flush_recovered"] == 1
+    assert tele["fusion_poisoned_signatures"] == 1
+    assert tele["io_retries"] == {"save_csv": 1}
+    assert tele["checkpoint_ops"]["write"] == 1
+    assert tele["faults_injected"] == {"fusion.compile": 1, "io.write": 1}
