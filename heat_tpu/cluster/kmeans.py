@@ -129,17 +129,68 @@ class KMeans(_KCluster):
         )
 
     def _update_centroids(self, x: DNDarray, matching_centroids: DNDarray) -> DNDarray:
-        """Mean of the samples of each cluster (reference kmeans.py:73-101)."""
-        labels = matching_centroids.larray
-        onehot = jax.nn.one_hot(labels, self.n_clusters, dtype=x.larray.dtype)
-        counts = jnp.sum(onehot, axis=0)
-        sums = onehot.T @ x.larray
-        new_centers = jnp.where(
-            counts[:, None] > 0,
-            sums / jnp.maximum(counts[:, None], 1),
-            self._cluster_centers.larray,
-        )
-        return ht.array(new_centers, device=x.device, comm=x.comm)
+        """Mean of the samples of each cluster (reference kmeans.py:73-101).
+
+        Runs on the DNDarray op surface (ISSUE 7): the one-hot mask is an
+        elementwise chain, the masked centroid sums are a GEMM producer whose
+        cross-device psum XLA emits from the shardings, and the counts are a
+        reduction sink — so with fusion on the whole update (and any pending
+        chain the caller's assignment left on ``labels``) compiles as one
+        program at the first read instead of one dispatch per op.
+        """
+        labels = matching_centroids
+        k = self.n_clusters
+        onehot = (ht.expand_dims(labels, 1) == ht.arange(k)).astype(x.dtype)
+        counts = onehot.sum(axis=0)  # (k,) — psum over the sharded sample axis
+        sums = ht.linalg.matmul(ht.transpose(onehot), x)  # (k, f) MXU GEMM
+        c = ht.expand_dims(counts, 1)
+        return ht.where(c > 0, sums / ht.maximum(c, 1.0), self._cluster_centers)
+
+    def step(self, x: DNDarray, centers: Optional[DNDarray] = None):
+        """One Lloyd iteration on the DNDarray op surface (ROADMAP item 1):
+        returns ``(new_centers, labels, shift)`` as DEFERRED arrays.
+
+        With fusion on, the whole iteration — the quadratic-expansion distance
+        chain, the two MXU GEMM producers, the argmin assignment sink, the
+        one-hot masked centroid sums (whose cross-device psum XLA emits from
+        the shardings), a RECORDED resplit when ``centers`` arrive split, and
+        the centroid-shift reduction — compiles as ONE cached XLA program per
+        iteration, flushed at the first read (read ``shift`` first: the sink
+        flush materializes the live ``new_centers``/``labels`` chains as extra
+        outputs of the same kernel). ``fusion.flush_reason{collective}`` stays
+        0 on this workload; the fused on-device ``while_loop``
+        (:func:`_kmeans_fit_loop`) remains the production fit path — this is
+        the composable, observable step the op surface exposes, and the unit
+        the ``kmeans_step_executables`` bench anchor counts.
+        """
+        if not isinstance(x, DNDarray):
+            raise ValueError(f"input needs to be a ht.DNDarray, but was {type(x)}")
+        c = self._cluster_centers if centers is None else centers
+        if c is None:
+            raise RuntimeError("no centroids: pass centers= or fit/initialize first")
+        if c.split is not None:
+            # private identity chain so the in-place resplit below cannot
+            # mutate the caller's array; the resharding records a collective
+            # node over it (the distance GEMM needs replicated centers)
+            c = ht.positive(c)
+            c.resplit_(None)
+        k = int(c.shape[0])
+        # assignment: d2 via quadratic expansion — same two-GEMM structure as
+        # the jitted `_kmeans_step`, expressed through the op surface
+        x2 = (x * x).sum(axis=1, keepdims=True)  # (n, 1)
+        c2 = (c * c).sum(axis=1)  # (k,)
+        xc = ht.linalg.matmul(x, ht.transpose(c))  # (n, k) MXU GEMM
+        d2 = ht.maximum(x2 - 2.0 * xc + c2, 0.0)
+        labels = ht.argmin(d2, axis=1)  # (n,) sink
+        # centroid update (same math as _update_centroids, against the step's
+        # own current centers): one-hot chain + GEMM + count sink
+        onehot = (ht.expand_dims(labels, 1) == ht.arange(k)).astype(x.dtype)
+        counts = onehot.sum(axis=0)  # (k,) — psum over the sharded sample axis
+        sums = ht.linalg.matmul(ht.transpose(onehot), x)  # (k, f) MXU GEMM
+        cc = ht.expand_dims(counts, 1)
+        new_centers = ht.where(cc > 0, sums / ht.maximum(cc, 1.0), c)
+        shift = ((new_centers - c) ** 2).sum()
+        return new_centers, labels, shift
 
     def fit(self, x: DNDarray) -> "KMeans":
         """Cluster the data (reference kmeans.py:102-130)."""

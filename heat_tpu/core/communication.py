@@ -39,6 +39,7 @@ __all__ = [
     "MPI_SELF",
     "get_comm",
     "sanitize_comm",
+    "shift",
     "use_comm",
     "distributed_init",
 ]
@@ -375,15 +376,12 @@ class MeshCommunication(Communication):
     # remainder before data ever reaches a collective); ``counts_displs`` still
     # publishes the per-device layout for code that wants it.
 
-    def __collective(self, kind: str, split: int, ndim: int, op: str = "", **kw):
-        # deterministic fault site for the distributed layer: an injected
-        # failure here surfaces exactly where a real ICI/DCN dispatch error
-        # would (no recovery ladder — collectives have no retained graph to
-        # replay; the site exists so tests can prove where the blast radius
-        # of a collective failure lands)
-        _FI.check("collective.dispatch")
-        if _MON.enabled:
-            _instr.collective(kind)
+    def _collective_fn(self, kind: str, split: int, ndim: int, op: str = "", **kw):
+        """The cached compiled collective program WITHOUT the dispatch-site
+        fault check or counter (package-internal: ``core/fusion.py`` replays
+        these inside fused traces, where the flush path owns the accounting
+        and the ``collective.dispatch`` fault site — a recorded collective
+        must fault at FLUSH, recoverably, not at record)."""
         key = (kind, op, self.mesh, self.__axis_name, split, ndim, tuple(sorted(kw.items())))
         fn = _COLLECTIVE_CACHE.get(key)
         if fn is None:
@@ -395,6 +393,17 @@ class MeshCommunication(Communication):
         else:
             _COLLECTIVE_CACHE.move_to_end(key)
         return fn
+
+    def __collective(self, kind: str, split: int, ndim: int, op: str = "", **kw):
+        # deterministic fault site for the distributed layer: an injected
+        # failure here surfaces exactly where a real ICI/DCN dispatch error
+        # would (an EAGER dispatch has no retained graph to replay — only a
+        # collective recorded in a fused flush rides the recovery ladder,
+        # whose fused attempt consults this same site)
+        _FI.check("collective.dispatch")
+        if _MON.enabled:
+            _instr.collective(kind)
+        return self._collective_fn(kind, split, ndim, op, **kw)
 
     def __prep(self, x, split: int):
         x = jax.numpy.asarray(x)
@@ -527,7 +536,18 @@ class MeshCommunication(Communication):
         Re-chunk: every device exchanges slices so the array goes from being split on
         ``concat_axis`` to split on ``split_axis`` (reference Alltoall(v) axis
         rotation, communication.py:1199-1475) — one ``lax.all_to_all`` over ICI.
+
+        A :class:`~.dndarray.DNDarray` operand (which must be split on
+        ``concat_axis``) returns a DNDarray split on ``split_axis``; over a
+        pending fused chain the exchange records a collective node
+        (``core/fusion.py``) instead of flushing, so chain + all_to_all +
+        follow-on chain compile as one program
+        (``HEAT_TPU_FUSION_COLLECTIVES=0`` restores the flush barrier).
         """
+        from .dndarray import DNDarray as _D
+
+        if isinstance(x, _D):
+            return self.__alltoall_dnd(x, split_axis, concat_axis)
         x = jax.numpy.asarray(x)
         if x.ndim == 0:
             raise ValueError("collectives operate on arrays with a split axis, got a scalar")
@@ -542,6 +562,38 @@ class MeshCommunication(Communication):
                 f"{self.size} devices"
             )
         return self.__collective("alltoall", cur, x.ndim, sa=split_axis)(x)
+
+    def __alltoall_dnd(self, x, split_axis: int, concat_axis: int):
+        """DNDarray form of :meth:`Alltoall` (validation mirrors the raw-array
+        path; the exchange defers over a pending chain)."""
+        from .dndarray import DNDarray as _D
+
+        ndim = x.ndim
+        if ndim == 0:
+            raise ValueError("collectives operate on arrays with a split axis, got a scalar")
+        sa = int(split_axis) % ndim
+        ca = int(concat_axis) % ndim
+        if sa == ca:
+            raise ValueError("split_axis and concat_axis must differ")
+        if x.split is None or int(x.split) % ndim != ca:
+            raise ValueError(
+                f"DNDarray operand of Alltoall must be split on concat_axis "
+                f"({ca}), got split={x.split}"
+            )
+        if not (self.is_shardable(x.shape, sa) and self.is_shardable(x.shape, ca)):
+            raise ValueError(
+                f"axes ({sa}, {ca}) of shape {tuple(x.shape)} do not partition "
+                f"evenly over {self.size} devices"
+            )
+        from . import fusion as _fusion
+
+        if _fusion.collective_ready(x):
+            res = _fusion.defer_alltoall(x, sa, ca)
+            if res is not None:
+                return res
+        x._flush("collective")
+        data = self.__collective("alltoall", ca, ndim, sa=sa)(x.parray)
+        return _D(data, tuple(x.shape), x.dtype, sa, x.device, self, True)
 
     def Alltoallv(self, x, split_axis: int, concat_axis: int):
         """
@@ -800,6 +852,50 @@ def ensure_placement(data, split, comm, gshape=None):
     if split is not None and isinstance(comm, MeshCommunication) and comm.is_distributed():
         return comm.placed(data, split, gshape)
     return data
+
+
+def shift(x, steps: int = 1):
+    """
+    Ring-rotate the split-axis CHUNKS of a DNDarray by ``steps`` device
+    positions (the DNDarray counterpart of :meth:`MeshCommunication.Ppermute`
+    — the reference's neighbor Send/Recv choreography, e.g. the rotating-slab
+    rings of ``spatial/distance.py``; SPMD has no two-sided Send/Recv, so
+    ``lax.ppermute`` is the primitive those patterns compile to).
+
+    This is a *chunk-level* collective, not a logical ``roll``: device ``i``'s
+    chunk moves to device ``(i + steps) % p``. On a ragged split axis the
+    zero-filled pad slabs rotate along with their chunks (eager and fused
+    paths do the identical fill, so the hatch is bit-for-bit); positions the
+    rotated pad lands on read zero. Replicated or non-distributed operands
+    return an unshifted copy (a one-device ring is the identity).
+
+    Over a pending fused chain the rotation records a collective node
+    (``core/fusion.py``): chain + ppermute + follow-on chain compile as one
+    shard_map program. ``HEAT_TPU_FUSION_COLLECTIVES=0`` restores the flush
+    barrier bit for bit.
+    """
+    from .dndarray import DNDarray as _D
+
+    if not isinstance(x, _D):
+        raise TypeError(f"shift expects a DNDarray, got {type(x)}")
+    comm = x.comm
+    if (
+        x.split is None
+        or not isinstance(comm, MeshCommunication)
+        or not comm.is_distributed()
+    ):
+        return _D(x.parray, tuple(x.shape), x.dtype, x.split, x.device, comm, True)
+    s_ax = int(x.split) % x.ndim
+    from . import fusion as _fusion
+
+    if _fusion.collective_ready(x):
+        res = _fusion.defer_shift(x, steps)
+        if res is not None:
+            return res
+    x._flush("collective")
+    phys = x.filled(0) if x.is_padded else x.parray
+    data = comm.Ppermute(phys, shift=steps, split=s_ax)
+    return _D(data, tuple(x.shape), x.dtype, x.split, x.device, comm, True)
 
 
 def get_comm() -> Communication:

@@ -236,6 +236,26 @@ class DNDarray:
             with _fusion.flush_reason(reason):
                 self.parray  # noqa: B018
 
+    def _rebind_expr(self, node, split: Optional[int]) -> None:
+        """Package-internal (``core/fusion.py``): replace this array's pending
+        expression IN PLACE with ``node`` — a collective recorded OVER the old
+        expression (``record_resplit``) — updating the split/pshape metadata
+        to the node's output layout. The old root becomes an interior node of
+        the new graph; its owner pointer is cleared so flush-time liveness
+        logic never places it on this array's (now different) layout."""
+        import weakref as _weakref
+
+        old = self.__lazy
+        if old is not None:
+            old.owner = None
+        self.__lazy = node
+        self.__array = None
+        node.owner = _weakref.ref(self)
+        self.__split = split
+        self.__pshape = tuple(int(v) for v in node.aval.shape)
+        self.__lshape_map = None
+        self.__invalidate()
+
     # ------------------------------------------------------------------ properties
     @property
     def larray(self) -> jax.Array:
@@ -510,7 +530,7 @@ class DNDarray:
         shard's slot is zero — non-periodic, the reference's rank p-1 has
         ``halo_next=None``, dndarray.py:360-446). Set by :meth:`get_halo`.
         """
-        return self.__halo_next
+        return self.__halo_value(self.__halo_next)
 
     @property
     def halo_prev(self) -> Optional[jax.Array]:
@@ -520,7 +540,7 @@ class DNDarray:
         0's slot is zero — the reference's rank 0 has ``halo_prev=None``).
         Set by :meth:`get_halo`.
         """
-        return self.__halo_prev
+        return self.__halo_value(self.__halo_prev)
 
     @property
     def array_with_halos(self) -> jax.Array:
@@ -535,8 +555,17 @@ class DNDarray:
         stencil). Before any ``get_halo``, the plain logical global array.
         """
         if self.__halo_stacked is not None:
-            return self.__halo_stacked
+            return self.__halo_value(self.__halo_stacked)
         return self.larray
+
+    @staticmethod
+    def __halo_value(h):
+        """Unwrap a halo slot: ``get_halo`` over a pending chain stores the
+        halos as DEFERRED DNDarrays (``fusion.defer_halo``), materialized on
+        first property read — chain + exchange as one fused program."""
+        if isinstance(h, DNDarray):
+            return h.parray
+        return h
 
     # ------------------------------------------------------------------ layout ops
     def is_balanced(self, force_check: bool = False) -> bool:
@@ -578,12 +607,23 @@ class DNDarray:
             return self
         comm = self.__comm
         if isinstance(comm, MeshCommunication) and comm.is_distributed():
-            self._flush("collective")
             if _MON.enabled:
                 # a genuine split change on a distributed mesh: XLA emits the
                 # all-to-all/all-gather — the event every "how many resharding
-                # collectives did this run cost?" question counts
+                # collectives did this run cost?" question counts (recorded
+                # and eager paths alike: the collective runs either way)
                 _instr.resharding(self.__split, axis)
+            if self.__lazy is not None:
+                from . import fusion as _fusion
+
+                if _fusion.collective_ready(self) and _fusion.record_resplit(self, axis):
+                    # the resharding is now a node of the pending DAG: this
+                    # array stays pending under the new split metadata and the
+                    # chain + collective + any follow-on chain flush as ONE
+                    # shard_map program (HEAT_TPU_FUSION_COLLECTIVES=0
+                    # restores the flush barrier below)
+                    return self
+            self._flush("collective")
             # go through the logical view: the old axis's pad is dropped, the new
             # axis's pad (if ragged) is established by placed()
             self.__array = comm.placed(self.larray, axis, self.__gshape)
@@ -610,9 +650,21 @@ class DNDarray:
                 )
         comm = self.__comm
         if isinstance(comm, MeshCommunication) and comm.is_distributed():
-            self._flush("collective")
             if _MON.enabled:
-                _instr.resharding(self.__split, self.__split)
+                # its own label: a redistribution keeps the split axis, so it
+                # must NOT tick the resharding counter (which answers "how
+                # many genuine split changes did this run pay?")
+                _instr.redistribution()
+            if self.__lazy is not None:
+                from . import fusion as _fusion
+
+                if _fusion.collective_ready(self):
+                    # a pending expression materializes INTO the canonical
+                    # placement (materialize_for applies placed() once per
+                    # flush), so re-asserting it here would only break the
+                    # chain — leave the graph pending
+                    return
+            self._flush("collective")
             self.__array = comm.placed(self.parray, self.__split, self.__gshape)
             self.__invalidate()
 
@@ -650,6 +702,18 @@ class DNDarray:
             raise ValueError(
                 f"halo_size {halo_size} needs to be smaller than the local chunk {chunk}"
             )
+        if self.__lazy is not None:
+            from . import fusion as _fusion
+
+            if _fusion.collective_ready(self):
+                halos = _fusion.defer_halo(self, halo_size)
+                if halos is not None:
+                    # the exchange is recorded over the pending chain: chain +
+                    # ppermute compile as one program at the first halo read,
+                    # and this array's own value rides that kernel as an
+                    # extra output (the chain stays pending until then)
+                    self.__halo_prev, self.__halo_next, self.__halo_stacked = halos
+                    return
         self._flush("collective")
         fn = _build_halo_exchange(comm.mesh, comm.axis_name, p, split, halo_size, self.pshape)
         # zero-fill pads so ragged tails exchange zeros, not garbage
@@ -665,13 +729,30 @@ class DNDarray:
         from .types import canonical_heat_type
 
         dtype = canonical_heat_type(dtype)
-        if copy and self.__lazy is not None:
+        if self.__lazy is not None:
             from . import fusion as _fusion
 
             if _fusion.enabled():
+                if not copy and dtype == self.__dtype:
+                    return self  # no-op cast must not break the pending chain
                 deferred = _fusion.defer_cast(self, dtype)
                 if deferred is not None:
-                    return deferred
+                    if copy:
+                        return deferred
+                    # in-place cast over a pending chain: rebind self to the
+                    # freshly recorded cast node (same split/layout) so the
+                    # chain stays fused — the arg-reduce index-type cast used
+                    # to flush the whole sink program here
+                    node = deferred._expr()
+                    if node is not None:
+                        self._rebind_expr(node, self.__split)
+                    else:  # chain bound flushed at record: adopt the value
+                        self.__lazy = None
+                        self.__array = deferred.parray
+                        self.__pshape = None
+                        self.__invalidate()
+                    self.__dtype = dtype
+                    return self
         casted = self.parray.astype(dtype.jnp_type())
         if copy:
             return DNDarray(

@@ -87,6 +87,24 @@ Design (see ``doc/fusion_notes.md`` for the full narrative):
   eager path contracts the sliced logical view — an in-trace pad slice would
   reassociate the ragged shards' partial products). ``HEAT_TPU_FUSION_GEMM=0``
   (read per dispatch) restores GEMMs-as-barriers bit for bit.
+* **Collective nodes.** Resharding (``resplit_``/``redistribute_``), the halo
+  ppermute exchange (``get_halo``), the ring chunk shift
+  (``communication.shift``) and the DNDarray ``Alltoall`` re-chunk record a
+  *collective* ``_Node`` over a pending chain instead of flushing it: the
+  split-axis chain, the cross-device transfer, and the *next* chain compile
+  as ONE shard_map program, letting XLA overlap the ICI collective with the
+  elementwise compute (ROADMAP item 1). Each callable replays the exact
+  eager dispatch in-trace — resplit drops the old axis's pad and
+  re-establishes the new axis's canonical pad around a
+  ``with_sharding_constraint``; halo zero-fills the pad slabs like the eager
+  ``filled(0)``; shift/alltoall inline the named collective's cached
+  shard_map program — with the mesh/axis-name/split metadata in the node key
+  (and therefore the trace-LRU key). Inexpressible pad motion takes the
+  counted eager fallback ``fusion.collective_fallbacks``. Library consumers
+  whose program is itself a shard_map pipeline trace the pending chain INTO
+  their program via :func:`flush_through` (the TSQR merge).
+  ``HEAT_TPU_FUSION_COLLECTIVES=0`` (read per dispatch) restores the
+  flush-barrier behavior bit for bit.
 * **Reduction sinks.** Reductions, cumulatives, moments and norms are *sinks*
   of the pending DAG rather than flush triggers: ``__reduce_op``/``__cum_op``
   (and the statistics/linalg epilogue routes) record a sink ``_Node`` whose
@@ -126,8 +144,11 @@ Design (see ``doc/fusion_notes.md`` for the full narrative):
   :mod:`heat_tpu.robustness.faultinject`.
 
 Monitoring: ``fusion.ops_deferred`` (labelled binary/local/where/cast/view/
-gemm), ``fusion.reduction_sinks`` (labelled reduce/cum/moment/norm/vecdot),
-``fusion.view_fallbacks`` (labelled asymmetric-pad/stepped-split-slice),
+gemm/collective), ``fusion.reduction_sinks`` (labelled reduce/cum/moment/
+norm/vecdot), ``fusion.view_fallbacks`` (labelled asymmetric-pad/
+stepped-split-slice), ``fusion.collective_fallbacks`` (labelled
+tracer-operand/abstract-eval/layout/padded-operand — collectives over
+pending chains that had to take the flushing eager path),
 ``fusion.flushes``/``fusion.kernels_compiled``/``fusion.cache_hits``,
 ``fusion.flush_reason`` (labelled reduction/cumulative/print/indexing/io/
 collective/out-alias/export/chain-bound/linalg/other — *why* each chain
@@ -165,6 +186,8 @@ __all__ = [
     "views_enabled",
     "view_ready",
     "gemm_enabled",
+    "collectives_enabled",
+    "collective_ready",
     "is_deferred",
     "pending_count",
     "flush",
@@ -177,6 +200,11 @@ __all__ = [
     "defer_view",
     "defer_getitem",
     "defer_matmul",
+    "record_resplit",
+    "defer_halo",
+    "defer_shift",
+    "defer_alltoall",
+    "flush_through",
     "defer_reduce",
     "defer_moment",
     "defer_cum",
@@ -249,6 +277,30 @@ def gemm_enabled() -> bool:
     dispatches standalone. Read per dispatch."""
     val = os.environ.get("HEAT_TPU_FUSION_GEMM", "")
     return val.strip().lower() not in ("0", "false", "off")
+
+
+def collectives_enabled() -> bool:
+    """Whether collectives (resharding / halo exchange / ring shift /
+    all-to-all) record DAG nodes over pending chains (default on).
+    ``HEAT_TPU_FUSION_COLLECTIVES=0`` keeps elementwise fusion on but restores
+    the pre-collective behavior bit for bit: every ``resplit_`` /
+    ``redistribute_`` / ``get_halo`` / ``comm.shift`` / DNDarray ``Alltoall``
+    over a pending chain flushes it first and dispatches the collective
+    standalone. Read per dispatch."""
+    val = os.environ.get("HEAT_TPU_FUSION_COLLECTIVES", "")
+    return val.strip().lower() not in ("0", "false", "off")
+
+
+def collective_ready(x) -> bool:
+    """Whether ``x`` carries a live pending expression a collective may record
+    a node over (fusion + collectives enabled, pending node not yet
+    materialized through another root)."""
+    if not isinstance(x, DNDarray):
+        return False
+    node = x._expr()
+    if node is None or node.value is not None:
+        return False
+    return enabled() and collectives_enabled()
 
 
 def _donate_enabled() -> bool:
@@ -375,9 +427,15 @@ class _Node:
         n = 1
         for a in args:
             if isinstance(a, _Node) and a.value is None:
-                n += a.nops
+                if a.nops >= n:
+                    n = a.nops + 1
                 a.rc += 1
-        self.nops = n  # DAG overcount is fine: only used for the flush bound
+        # recorded DEPTH (longest pending path), only used for the flush
+        # bound: rebind loops still grow it one per op, but a diamond-shaped
+        # DAG (one sub-chain referenced by several parents — the
+        # coordinate-sweep pattern) no longer multiplies toward the bound the
+        # way a subtree-size sum did
+        self.nops = n
 
 
 #: Live deferred DNDarrays (weak, id-keyed — DNDarray is unhashable by
@@ -1447,6 +1505,306 @@ def defer_vecdot(x1: DNDarray, x2: DNDarray, axis, keepdim: bool) -> Optional[DN
     )
 
 
+# ------------------------------------------------------------------ collective nodes
+#
+# A collective node records one cross-device data motion — a resharding
+# placement (``resplit_``/``redistribute_``), the halo ppermute exchange
+# (``get_halo``), a ring chunk shift (``communication.shift``), or an axis
+# re-chunking ``Alltoall`` — over a PENDING chain, so a split-axis elementwise
+# chain, its cross-device combine, and the *next* chain compile as ONE
+# shard_map program and XLA overlaps the ICI transfer with the elementwise
+# compute (ROADMAP item 1; the communication-avoiding thesis of Demmel et
+# al., PAPERS.md, applied to the eager op surface). Each callable replays the
+# EXACT eager dispatch inside the trace:
+#
+# * ``resplit`` replays ``comm.placed(larray, new_split)``: a static slice
+#   drops the old split axis's pad, ``jnp.pad`` re-establishes the new axis's
+#   canonical pad, and ``lax.with_sharding_constraint`` pins the new layout —
+#   XLA emits the same all-to-all/all-gather the eager ``device_put`` pays
+#   (when replayed eagerly by the recovery ladder the callable issues the
+#   real ``device_put``, i.e. the retained barrier path);
+# * ``halo`` replays ``get_halo``: the pad slabs are zero-filled in-trace
+#   exactly like the eager ``filled(0)``, then the cached shard_map ppermute
+#   exchange runs inside the trace; the stacked per-shard block is the
+#   recorded node and ``halo_prev``/``halo_next`` are slice views of it
+#   (bit-identical to the exchange's own outputs — pure data movement);
+# * ``ppermute`` (``communication.shift``) and ``alltoall`` replay the named
+#   collective's cached shard_map program (``_collective_fn`` — the builder
+#   WITHOUT the dispatch-site fault check, which the flush path owns).
+#
+# The mesh / axis-name / split metadata is part of every node's ``op_key``
+# and therefore of the trace-LRU key. Cases the in-trace pad rules cannot
+# express take the counted eager fallback ``fusion.collective_fallbacks``.
+# ``HEAT_TPU_FUSION_COLLECTIVES=0`` (read per dispatch) restores the
+# flush-barrier behavior bit for bit.
+
+_COLL_FNS: dict = {}
+
+
+def _collective_fallback(kind: str) -> None:
+    if _MON.enabled:
+        _instr.fusion_collective_fallback(kind)
+
+
+def _fill0_step(v, s_ax: int, n: int):
+    """In-trace ``x.filled(0)``: zero the pad slab of the split axis (the
+    exact mask/where the eager dispatch executes)."""
+    shape = [1] * v.ndim
+    shape[s_ax] = v.shape[s_ax]
+    mask = jnp.arange(v.shape[s_ax]).reshape(shape) < n
+    return jnp.where(mask, v, jnp.asarray(0, dtype=v.dtype))
+
+
+def _resplit_fn_for(mesh, axis_name, gshape, pshape_old, old_ax, new_ax, pshape_new):
+    """Memoized resharding callable physical(old layout) -> physical(new
+    layout), replaying the eager ``placed(larray, new_split)`` dispatch."""
+    key = ("resplit", mesh, axis_name, gshape, pshape_old, old_ax, new_ax)
+    fn = _COLL_FNS.get(key)
+    if fn is not None:
+        return fn
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    ndim = len(gshape)
+    idx = None
+    if old_ax is not None and pshape_old[old_ax] != gshape[old_ax]:
+        idx = tuple(
+            slice(0, gshape[d]) if d == old_ax else slice(None) for d in range(ndim)
+        )
+    padw = None
+    if new_ax is not None and pshape_new[new_ax] != gshape[new_ax]:
+        padw = tuple((0, int(pshape_new[d]) - int(gshape[d])) for d in range(ndim))
+    spec = (
+        PartitionSpec()
+        if new_ax is None
+        else PartitionSpec(*([None] * new_ax), axis_name)
+    )
+    sharding = NamedSharding(mesh, spec)
+
+    def fn(v, _i=idx, _w=padw, _s=sharding):
+        if _i is not None:
+            v = v[_i]  # drop the old axis's pad (the eager larray view)
+        if _w is not None:
+            v = jnp.pad(v, _w)  # canonical pad of the new axis (zeros, placed())
+        if isinstance(v, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(v, _s)
+        return jax.device_put(v, _s)  # eager replay: the barrier path's placement
+
+    _COLL_FNS[key] = fn
+    return fn
+
+
+def record_resplit(x: DNDarray, axis) -> bool:
+    """Record an in-place ``resplit_(axis)`` over ``x``'s pending expression
+    as a collective node: ``x`` STAYS pending under the new split metadata and
+    the resharding executes inside the eventual fused flush. Returns False to
+    fall back to the flushing eager path."""
+    from .communication import MeshCommunication
+
+    comm = x.comm
+    if not isinstance(comm, MeshCommunication):
+        return False
+    gshape = tuple(x.shape)
+    nd = max(len(gshape), 1)
+    old_ax = None if x.split is None else int(x.split) % nd
+    new_ax = None if axis is None else int(axis) % nd
+    pshape_old = tuple(x.pshape)
+    pshape_new = tuple(comm.padded_shape(gshape, new_ax))
+    inp = _input_of(x)
+    if inp is None:
+        _collective_fallback("tracer-operand")
+        return False
+    try:
+        fn = _resplit_fn_for(
+            comm.mesh, comm.axis_name, gshape, pshape_old, old_ax, new_ax, pshape_new
+        )
+        okey = (
+            "collective", "resplit", comm.mesh, comm.axis_name,
+            pshape_old, old_ax, new_ax,
+        )
+        aval = _eval_node(fn, okey, (inp,), (), None)
+    except Exception:
+        _collective_fallback("abstract-eval")
+        return False
+    if tuple(aval.shape) != pshape_new:
+        _collective_fallback("layout")
+        return False
+    node = _Node(fn, okey, (inp,), (), None, aval)
+    x._rebind_expr(node, axis)
+    _register_pending(x)
+    if _MON.enabled:
+        _instr.fusion_defer("collective")
+    if node.nops >= _max_chain():
+        with flush_reason("chain-bound"):
+            x.parray  # noqa: B018
+    return True
+
+
+def _halo_slice_fn_for(which: str, h: int, chunk: int, split: int):
+    """Memoized view callable deriving ``halo_prev``/``halo_next`` from the
+    stacked exchange block (bit-identical to the exchange's own outputs: the
+    stacked rows are ``[from_prev; blk; from_next]`` per shard, so a
+    per-shard slice + reshape + moveaxis IS the global prev/next array)."""
+    key = ("haloslice", which, h, chunk, split)
+    fn = _COLL_FNS.get(key)
+    if fn is not None:
+        return fn
+    sl = slice(0, h) if which == "prev" else slice(chunk + h, chunk + 2 * h)
+
+    def fn(st, _sl=sl, _split=split):
+        return jnp.moveaxis(st[:, _sl].reshape((-1,) + st.shape[2:]), 0, _split)
+
+    _COLL_FNS[key] = fn
+    return fn
+
+
+def defer_halo(x: DNDarray, halo_size: int):
+    """Record ``get_halo``'s ppermute exchange over ``x``'s pending chain:
+    returns ``(halo_prev, halo_next, stacked)`` as DEFERRED DNDarrays (the
+    chain and the exchange then compile as one program at the first halo
+    read, with the chain's own value riding the same kernel as an extra
+    output), or None to fall back to the flushing eager path."""
+    from .communication import MeshCommunication
+    from .dndarray import _build_halo_exchange
+
+    comm = x.comm
+    if not isinstance(comm, MeshCommunication):
+        return None
+    split = int(x.split) % x.ndim
+    p = comm.size
+    pshape = tuple(x.pshape)
+    chunk = pshape[split] // p
+    h = int(halo_size)
+    inp = _input_of(x)
+    if inp is None:
+        _collective_fallback("tracer-operand")
+        return None
+    fill = (split, int(x.shape[split])) if x.is_padded else None
+    key = ("halo", comm.mesh, comm.axis_name, p, split, h, pshape, fill)
+    fn = _COLL_FNS.get(key)
+    if fn is None:
+        try:
+            ex = _build_halo_exchange(comm.mesh, comm.axis_name, p, split, h, pshape)
+        except Exception:
+            _collective_fallback("abstract-eval")
+            return None
+
+        def fn(v, _ex=ex, _fill=fill):
+            if _fill is not None:
+                v = _fill0_step(v, _fill[0], _fill[1])  # eager filled(0) replay
+            return _ex(v)[2]  # stacked per-shard block; prev/next are slices
+
+        _COLL_FNS[key] = fn
+    okey = ("collective", "halo", comm.mesh, comm.axis_name, p, split, h, pshape, fill)
+    try:
+        aval = _eval_node(fn, okey, (inp,), (), None)
+    except Exception:
+        _collective_fallback("abstract-eval")
+        return None
+    node = _Node(fn, okey, (inp,), (), None, aval)
+    stacked = _finish(
+        node, tuple(aval.shape), x.dtype, 0, x.device, comm, "collective"
+    )
+    halo_gshape = pshape[:split] + (p * h,) + pshape[split + 1 :]
+    out = [None, None, stacked]
+    for i, which in enumerate(("prev", "next")):
+        vfn = _halo_slice_fn_for(which, h, chunk, split)
+        vkey = ("collective", "haloslice", which, h, chunk, split)
+        st_in = stacked._expr()
+        try:
+            vaval = _eval_node(vfn, vkey, (st_in,), (), None)
+        except Exception:
+            _collective_fallback("abstract-eval")
+            return None
+        vnode = _Node(vfn, vkey, (st_in,), (), None, vaval)
+        out[i] = _finish(
+            vnode, halo_gshape, x.dtype, split, x.device, comm, "view"
+        )
+    return tuple(out)
+
+
+def defer_shift(x: DNDarray, steps: int) -> Optional[DNDarray]:
+    """Record ``communication.shift`` (ring chunk rotation) over ``x``'s
+    pending chain: in-trace pad zero-fill + the cached ppermute shard_map
+    program. Returns the deferred result, or None to fall back."""
+    from .communication import MeshCommunication
+
+    comm = x.comm
+    if not isinstance(comm, MeshCommunication):
+        return None
+    s_ax = int(x.split) % x.ndim
+    p = comm.size
+    inp = _input_of(x)
+    if inp is None:
+        _collective_fallback("tracer-operand")
+        return None
+    shift_n = int(steps) % p
+    fill = (s_ax, int(x.shape[s_ax])) if x.is_padded else None
+    try:
+        cfn = comm._collective_fn("ppermute", s_ax, x.ndim, shift=shift_n)
+    except Exception:
+        _collective_fallback("abstract-eval")
+        return None
+    key = ("shift", comm.mesh, comm.axis_name, s_ax, x.ndim, shift_n, fill)
+    fn = _COLL_FNS.get(key)
+    if fn is None:
+
+        def fn(v, _c=cfn, _fill=fill):
+            if _fill is not None:
+                v = _fill0_step(v, _fill[0], _fill[1])
+            return _c(v)
+
+        _COLL_FNS[key] = fn
+    okey = ("collective", "ppermute", comm.mesh, comm.axis_name, s_ax, shift_n, fill)
+    try:
+        aval = _eval_node(fn, okey, (inp,), (), None)
+    except Exception:
+        _collective_fallback("abstract-eval")
+        return None
+    if tuple(aval.shape) != tuple(x.pshape):
+        _collective_fallback("layout")
+        return None
+    node = _Node(fn, okey, (inp,), (), None, aval)
+    return _finish(
+        node, tuple(x.shape), x.dtype, x.split, x.device, comm, "collective"
+    )
+
+
+def defer_alltoall(x: DNDarray, split_axis: int, concat_axis: int) -> Optional[DNDarray]:
+    """Record a DNDarray ``Alltoall`` re-chunk (split moves from
+    ``concat_axis`` to ``split_axis``) over ``x``'s pending chain, replaying
+    the named collective's shard_map program in-trace. The caller has already
+    validated even partitioning of both axes. Returns None to fall back."""
+    from .communication import MeshCommunication
+
+    comm = x.comm
+    if not isinstance(comm, MeshCommunication):
+        return None
+    if x.is_padded:
+        _collective_fallback("padded-operand")
+        return None
+    inp = _input_of(x)
+    if inp is None:
+        _collective_fallback("tracer-operand")
+        return None
+    try:
+        fn = comm._collective_fn("alltoall", concat_axis, x.ndim, sa=split_axis)
+        okey = (
+            "collective", "alltoall", comm.mesh, comm.axis_name,
+            concat_axis, split_axis, x.ndim,
+        )
+        aval = _eval_node(fn, okey, (inp,), (), None)
+    except Exception:
+        _collective_fallback("abstract-eval")
+        return None
+    if tuple(aval.shape) != tuple(x.shape):
+        _collective_fallback("layout")
+        return None
+    node = _Node(fn, okey, (inp,), (), None, aval)
+    return _finish(
+        node, tuple(x.shape), x.dtype, split_axis, x.device, comm, "collective"
+    )
+
+
 # ------------------------------------------------------------------ flush
 _TRACE_CACHE: "collections.OrderedDict" = collections.OrderedDict()
 _cache_stats = {"hits": 0, "misses": 0, "evictions": 0}
@@ -1578,7 +1936,7 @@ def _poison(key) -> None:
         _instr.fusion_poisoned()
 
 
-def _flush_ladder(fused, program, leaf_arrays, out_idx, donate, compiled, key):
+def _flush_ladder(fused, program, leaf_arrays, out_idx, donate, compiled, key, has_coll=False):
     """Execute a fused flush with graceful degradation.
 
     Rungs: (1) the fused kernel as planned; (2) on failure, one retry with
@@ -1598,6 +1956,14 @@ def _flush_ladder(fused, program, leaf_arrays, out_idx, donate, compiled, key):
         if compiled:
             _FI.check("fusion.compile")
         _FI.check("fusion.execute")
+        if has_coll:
+            # collective-bearing flush: the fused program IS the dispatch of
+            # its recorded collectives, so the distributed layer's fault site
+            # is consulted here (once per attempt); the ladder's eager replay
+            # below is the recovery path and deliberately does not re-consult
+            # it — a standing collective.dispatch plan proves recovery instead
+            # of making recovery impossible
+            _FI.check("collective.dispatch")
         return fused(*leaf_arrays)
     except (KeyboardInterrupt, SystemExit, _FI.FaultPlanError):
         raise  # a malformed fault PLAN is a config error, not a failure
@@ -1612,6 +1978,8 @@ def _flush_ladder(fused, program, leaf_arrays, out_idx, donate, compiled, key):
             try:
                 _FI.check("fusion.compile")  # rung 2 always builds fresh
                 _FI.check("fusion.execute")
+                if has_coll:
+                    _FI.check("collective.dispatch")
                 values = jax.jit(_replay_fn(program, out_idx))(*leaf_arrays)
             except (KeyboardInterrupt, SystemExit):
                 raise
@@ -1626,17 +1994,11 @@ def _flush_ladder(fused, program, leaf_arrays, out_idx, donate, compiled, key):
         return values
 
 
-def materialize_for(d: DNDarray):
-    """Flush the pending subgraph behind ``d`` through one fused, cached,
-    jitted kernel and return the canonical (placed) physical array."""
-    from .communication import MeshCommunication
-
-    root = d._expr()
-    if root is None:  # pragma: no cover — callers check
-        raise RuntimeError("materialize_for() on a concrete DNDarray")
-    if root.value is not None:
-        return root.value
-
+def _build_flush(root: _Node):
+    """Positional replay program of the pending subgraph under ``root``:
+    ``(topo, index_of, program, key_prog, leaf_arrays, leaf_owners,
+    internal_rc)`` — shared by :func:`materialize_for` and
+    :func:`flush_through`."""
     topo = _topo(root)
     index_of = {id(n): i for i, n in enumerate(topo)}
 
@@ -1680,16 +2042,58 @@ def materialize_for(d: DNDarray):
         program.append((n.fn, tuple(specs), dict(n.kwargs), n.cast))
         cast_key = None if n.cast is None else (str(n.cast[0]), n.cast[1])
         key_prog.append((n.op_key, tuple(key_specs), n.kwargs, cast_key))
+    return topo, index_of, program, key_prog, leaf_arrays, leaf_owners, internal_rc
 
-    # Outputs: the root — and, when the root is a reduction SINK, every
-    # pending interior node whose owning DNDarray is still alive. A sink
-    # leaves its consumed chain pending; when the chain will plausibly be
-    # read later (a live owner), materializing it as a SECOND output of the
-    # same kernel costs only the write the pre-sink path always paid, and
-    # saves a full recompute + recompile when the owner is read. Dead-owner
-    # chains (the hot loss/norm pattern) keep the single-read floor.
+
+def _leaf_cache_key(leaf_arrays):
+    return tuple(
+        (
+            tuple(a.shape),
+            str(a.dtype),
+            bool(getattr(a, "weak_type", False)),
+            getattr(a, "sharding", None),
+        )
+        for a in leaf_arrays
+    )
+
+
+def materialize_for(d: DNDarray):
+    """Flush the pending subgraph behind ``d`` through one fused, cached,
+    jitted kernel and return the canonical (placed) physical array."""
+    from .communication import MeshCommunication
+
+    root = d._expr()
+    if root is None:  # pragma: no cover — callers check
+        raise RuntimeError("materialize_for() on a concrete DNDarray")
+    if root.value is not None:
+        return root.value
+
+    topo, index_of, program, key_prog, leaf_arrays, leaf_owners, internal_rc = (
+        _build_flush(root)
+    )
+
+    # Recorded collectives in the program (excluding the pure-slice halo
+    # views): they gate the dispatch-site fault check, the comm.collective
+    # accounting, and the widened multi-output rule below.
+    coll_kinds = [
+        n.op_key[1]
+        for n in topo
+        if n.op_key and n.op_key[0] == "collective" and n.op_key[1] != "haloslice"
+    ]
+
+    # Outputs: the root — and, when the root is a reduction SINK or the
+    # program carries a COLLECTIVE, every pending interior node whose owning
+    # DNDarray is still alive. A sink leaves its consumed chain pending; when
+    # the chain will plausibly be read later (a live owner), materializing it
+    # as a SECOND output of the same kernel costs only the write the pre-sink
+    # path always paid, and saves a full recompute + recompile when the owner
+    # is read. Dead-owner chains (the hot loss/norm pattern) keep the
+    # single-read floor. Collective-bearing programs widen the same way so a
+    # later read of the consumed chain (or of the halo exchange's stacked
+    # block from one of its slice views) never re-dispatches the ICI
+    # transfer.
     out_nodes = [root]
-    if root.op_key and root.op_key[0] == "sink":
+    if (root.op_key and root.op_key[0] == "sink") or coll_kinds:
         for n in topo:
             if n is not root and n.owner is not None and n.owner() is not None:
                 out_nodes.append(n)
@@ -1723,20 +2127,21 @@ def materialize_for(d: DNDarray):
                 del arr
             donate = tuple(donate_idx)
 
-    leaf_key = tuple(
-        (
-            tuple(a.shape),
-            str(a.dtype),
-            bool(getattr(a, "weak_type", False)),
-            getattr(a, "sharding", None),
-        )
-        for a in leaf_arrays
-    )
+    leaf_key = _leaf_cache_key(leaf_arrays)
     try:
         key = (tuple(key_prog), leaf_key, donate, out_idx)
         fused = _TRACE_CACHE.get(key)
     except TypeError:  # unhashable sharding — compile uncached
         key, fused = None, None
+
+    if _MON.enabled and coll_kinds:
+        # the flush dispatches the recorded collectives exactly once whichever
+        # rung executes them; mirror the eager shims' accounting (resplit and
+        # halo count placement/resharding at their record sites, like their
+        # eager paths, and never went through a named shim)
+        for k in coll_kinds:
+            if k in ("ppermute", "alltoall"):
+                _instr.collective(k)
 
     if key is not None and key in _POISONED:
         # circuit breaker: this signature already failed fused execution and
@@ -1774,7 +2179,10 @@ def materialize_for(d: DNDarray):
                 reason=_FLUSH_REASON[-1],
             )
 
-        values = _flush_ladder(fused, program, leaf_arrays, out_idx, donate, compiled, key)
+        values = _flush_ladder(
+            fused, program, leaf_arrays, out_idx, donate, compiled, key,
+            has_coll=bool(coll_kinds),
+        )
 
     # canonical placement — the step DNDarray.__init__ applies to every eager
     # intermediate, applied once per fused output here (the root places on
@@ -1792,3 +2200,110 @@ def materialize_for(d: DNDarray):
                 value = comm.placed(value, split, owner.shape)
         n.value = value
     return root.value
+
+
+def flush_through(x: DNDarray, consumer, consumer_key, reason: str = "linalg"):
+    """Materialize ``x``'s pending expression THROUGH ``consumer`` — a
+    jax-traceable callable taking the chain's physical array — as ONE jitted,
+    trace-LRU-cached program: the collective-aware path for library consumers
+    whose own program is a shard_map pipeline (the TSQR merge in
+    ``linalg/qr.py``). The operand chain, the consumer's collectives, and the
+    chain's own materialization compile together, so XLA overlaps the ICI
+    transfer with the producer compute; ``x``'s chain value rides the same
+    kernel as an extra output (its owner is alive by construction), so a
+    later read of ``x`` costs no recompute.
+
+    ``consumer_key`` is the consumer's static identity in the trace-LRU key
+    (mesh/axis/size/kernel-flavor — the caller owns it). Returns the tuple of
+    consumer outputs, or None when ``x`` is not pending (caller falls back to
+    its flushing path). Failures ride the recovery ladder: the fused attempt
+    consults the ``fusion.compile``/``fusion.execute``/``collective.dispatch``
+    fault sites and a failure is recovered by replaying the retained chain
+    per-op and dispatching the consumer's (cached, jitted) program eagerly —
+    the retained barrier path, bit-identical by construction."""
+    root = x._expr()
+    if root is None or root.value is not None:
+        return None
+
+    topo, index_of, program, key_prog, leaf_arrays, _owners, _rc = _build_flush(root)
+    ridx = index_of[id(root)]
+    chain_replay = _replay_fn(program, (ridx,))
+
+    def fused(*leaves):
+        (chain_val,) = chain_replay(*leaves)
+        out = consumer(chain_val)
+        if not isinstance(out, tuple):
+            out = (out,)
+        return (*out, chain_val)
+
+    leaf_key = _leaf_cache_key(leaf_arrays)
+    try:
+        key = ("through", consumer_key, tuple(key_prog), leaf_key)
+        cached = _TRACE_CACHE.get(key)
+    except TypeError:  # unhashable sharding/consumer key — compile uncached
+        key, cached = None, None
+
+    def _eager():
+        (chain_val,) = _eager_replay(program, leaf_arrays, (ridx,))
+        out = consumer(chain_val)
+        if not isinstance(out, tuple):
+            out = (out,)
+        return (*out, chain_val)
+
+    if key is not None and key in _POISONED:
+        _POISONED.move_to_end(key)
+        if _MON.enabled:
+            _instr.fusion_flush(
+                len(topo), cache_hit=False, compiled=False, reason=reason
+            )
+        values = _eager()
+    else:
+        compiled = cached is None
+        if cached is None:
+            cached = jax.jit(fused)
+            if key is not None:
+                _TRACE_CACHE[key] = cached
+                _cache_stats["misses"] += 1
+                limit = _cache_max()
+                while len(_TRACE_CACHE) > limit:
+                    _TRACE_CACHE.popitem(last=False)
+                    _cache_stats["evictions"] += 1
+        else:
+            _TRACE_CACHE.move_to_end(key)
+            _cache_stats["hits"] += 1
+        if _MON.enabled:
+            _instr.fusion_flush(
+                len(topo), cache_hit=not compiled, compiled=compiled, reason=reason
+            )
+        try:
+            if compiled:
+                _FI.check("fusion.compile")
+            _FI.check("fusion.execute")
+            _FI.check("collective.dispatch")
+            values = cached(*leaf_arrays)
+        except (KeyboardInterrupt, SystemExit, _FI.FaultPlanError):
+            raise
+        except Exception as e:
+            if _MON.enabled:
+                _instr.fusion_flush_failure(_classify_failure(e, compiled))
+            if key is not None:
+                _TRACE_CACHE.pop(key, None)
+            values = _eager()
+            _poison(key)
+            if _MON.enabled:
+                _instr.fusion_flush_recovered()
+
+    *out, chain_val = values
+    # the chain's own value: canonical placement on x's layout, then retained
+    # on the node so a later read of x is a no-op
+    from .communication import MeshCommunication
+
+    comm = x.comm
+    if (
+        x.split is not None
+        and isinstance(comm, MeshCommunication)
+        and comm.is_distributed()
+    ):
+        chain_val = comm.placed(chain_val, x.split, x.shape)
+    root.value = chain_val
+    return tuple(out)

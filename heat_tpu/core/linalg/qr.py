@@ -163,9 +163,30 @@ def _build_tsqr_cached(mesh, axis: str, p: int, use_blocked: bool):
 
 
 def __tsqr(a: DNDarray) -> Tuple[jax.Array, jax.Array]:
-    """Tall-skinny QR over the row-sharded global array via shard_map."""
+    """Tall-skinny QR over the row-sharded global array via shard_map.
+
+    A PENDING fused chain on the operand traces INTO the TSQR program
+    (``fusion.flush_through``, ISSUE 7): the producer chain, the per-device
+    panel QRs, the R all-gather, and the merge factorization compile as ONE
+    executable — the chain's own value rides the same kernel, so the TSQR
+    merge costs one program per iteration instead of flush + dispatch.
+    ``HEAT_TPU_FUSION_COLLECTIVES=0`` restores the flush-first path."""
+    from .. import fusion as _fusion
+
     comm: MeshCommunication = a.comm
-    return _build_tsqr(comm.mesh, comm.axis_name, comm.size)(a.larray)
+    use_blocked = blocked.kernels_enabled()
+    fn = _build_tsqr(comm.mesh, comm.axis_name, comm.size, use_blocked=use_blocked)
+    if _fusion.collective_ready(a):
+        out = _fusion.flush_through(
+            a,
+            fn,
+            ("tsqr", comm.mesh, comm.axis_name, comm.size, use_blocked),
+            reason="linalg",
+        )
+        if out is not None:
+            return out
+    a._flush("linalg")
+    return fn(a.larray)
 
 
 def qr(
@@ -195,7 +216,6 @@ def qr(
     sanitation.sanitize_in(a)
     if a.ndim != 2:
         raise ValueError(f"qr requires a 2-D DNDarray, got {a.ndim}-d")
-    a._flush("linalg")
     if not isinstance(tiles_per_proc, int) or tiles_per_proc < 1:
         raise ValueError("tiles_per_proc must be a positive int")
     if not types.heat_type_is_inexact(a.dtype):
@@ -212,10 +232,13 @@ def qr(
         and (m // comm.size) >= n
     )
     if use_tsqr:
+        # flush handling lives in __tsqr: a pending operand chain traces INTO
+        # the TSQR program instead of flushing first (ISSUE 7)
         q_data, r_data = __tsqr(a)
         q = DNDarray(q_data, (m, n), a.dtype, 0, a.device, a.comm, True)
         r = DNDarray(r_data, (n, n), a.dtype, None, a.device, a.comm, True)
         return QR(q, r)
+    a._flush("linalg")
 
     use_bcgs = (
         a.split == 1

@@ -11,8 +11,11 @@ naming stays consistent:
 * ``ops.dtype_fallback`` — results XLA returned in a dtype the heat promotion
   rules disagreed with (the cast-back fallback), plus the exact→float
   true-division promotion;
-* ``comm.resharding`` (labelled ``old->new``) — split changes that force XLA
-  collectives (``DNDarray.resplit_``/``redistribute_``);
+* ``comm.resharding`` (labelled ``old->new``) — genuine split changes that
+  force XLA collectives (``DNDarray.resplit_``, recorded or eager);
+* ``comm.redistribution`` — ``redistribute_`` placement re-asserts, which
+  keep the split axis and therefore deliberately do NOT tick the resharding
+  counter;
 * ``comm.placement`` — canonical (padded, sharded) placements applied by
   ``MeshCommunication.placed``;
 * ``comm.collective`` (labelled by kind) — explicit collective shim
@@ -46,11 +49,13 @@ __all__ = [
     "op_dispatch",
     "dtype_fallback",
     "resharding",
+    "redistribution",
     "placement",
     "collective",
     "fusion_defer",
     "fusion_sink",
     "fusion_view_fallback",
+    "fusion_collective_fallback",
     "fusion_flush",
     "fusion_flush_failure",
     "fusion_flush_recovered",
@@ -114,6 +119,14 @@ def resharding(old_split: Optional[int], new_split: Optional[int]) -> None:
     events.event("comm.resharding", old_split=old_split, new_split=new_split)
 
 
+def redistribution() -> None:
+    """One ``redistribute_`` call: a canonical-placement re-assert that keeps
+    the split axis. Counted under its own name so ``comm.resharding`` answers
+    "how many GENUINE split changes did this run pay?" without pollution
+    (ISSUE 7 satellite: redistribution used to tick resharding{k->k})."""
+    REGISTRY.counter("comm.redistribution").inc()
+
+
 def placement() -> None:
     """One canonical (padded, sharded) placement applied by the mesh comm."""
     REGISTRY.counter("comm.placement").inc()
@@ -126,7 +139,7 @@ def collective(kind: str) -> None:
 
 def fusion_defer(kind: str) -> None:
     """One op recorded in the deferred-execution DAG instead of dispatched
-    eagerly (kind: binary/local/where/cast/view/gemm)."""
+    eagerly (kind: binary/local/where/cast/view/gemm/collective)."""
     REGISTRY.counter("fusion.ops_deferred").inc(label=kind)
 
 
@@ -141,6 +154,13 @@ def fusion_view_fallback(kind: str) -> None:
     (flushing) fallback because its pad motion has no in-trace form (kind:
     asymmetric-pad / stepped-split-slice)."""
     REGISTRY.counter("fusion.view_fallbacks").inc(label=kind)
+
+
+def fusion_collective_fallback(kind: str) -> None:
+    """One collective over a pending chain that had to take the eager
+    (flushing) fallback because its layout motion has no in-trace form (kind:
+    tracer-operand / abstract-eval / layout / padded-operand)."""
+    REGISTRY.counter("fusion.collective_fallbacks").inc(label=kind)
 
 
 def fusion_flush(chain_len: int, cache_hit: bool, compiled: bool, reason: str = "other") -> None:

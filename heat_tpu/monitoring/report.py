@@ -124,6 +124,7 @@ def telemetry() -> dict:
         ("fusion.reduction_sinks", "fusion_reduction_sinks"),
         ("fusion.ops_deferred", "fusion_ops_deferred"),
         ("fusion.view_fallbacks", "fusion_view_fallbacks"),
+        ("fusion.collective_fallbacks", "fusion_collective_fallbacks"),
         # graceful-degradation breakdowns (ISSUE 6): which failure classes the
         # flush ladder absorbed, which writer paths retried, what the
         # checkpoint subsystem did, and which fault sites actually fired

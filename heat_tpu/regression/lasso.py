@@ -47,10 +47,19 @@ class Lasso(BaseEstimator, RegressionMixin):
     Reference parity: heat/regression/lasso.py:50-186.
     """
 
-    def __init__(self, lam: float = 0.1, max_iter: int = 100, tol: float = 1e-6):
+    def __init__(
+        self,
+        lam: float = 0.1,
+        max_iter: int = 100,
+        tol: float = 1e-6,
+        sweep_engine: str = "jit",
+    ):
+        if sweep_engine not in ("jit", "fused"):
+            raise ValueError(f"sweep_engine must be 'jit' or 'fused', got {sweep_engine!r}")
         self.__lam = lam
         self.max_iter = max_iter
         self.tol = tol
+        self.sweep_engine = sweep_engine
         self.__theta = None
         self.n_iter = None
 
@@ -95,13 +104,101 @@ class Lasso(BaseEstimator, RegressionMixin):
         """Root mean squared error (reference lasso.py:111-125)."""
         return float(jnp.sqrt(jnp.mean((gt.larray - yest.larray) ** 2)))
 
+    def sweep_once(self, x: DNDarray, y: DNDarray, theta: DNDarray) -> DNDarray:
+        """One coordinate-descent sweep on the DNDarray op surface (ROADMAP
+        item 1 / ISSUE 7): returns the updated ``theta`` as a DEFERRED array.
+
+        Every coordinate update — the column view, the residual matvec (a
+        GEMM producer whose cross-device psum over the row-sharded design
+        matrix XLA emits from the shardings), the ``rho``/``z`` dot-product
+        sinks, and the soft-threshold chain — records into ONE pending DAG,
+        so the whole sweep flushes as ONE cached XLA program at the first
+        read and ``fusion.flush_reason{collective}`` stays 0. The recorded
+        depth is ~9 ops per coordinate: sweeps deeper than
+        ``HEAT_TPU_FUSION_MAX_CHAIN`` split at the (counted) chain bound —
+        still correct, just more than one kernel. The ``lax.fori_loop`` sweep
+        (``sweep_engine='jit'``) remains the default fit path for large
+        feature counts.
+
+        ``x`` is the design matrix WITH the bias column (``(n, f+1)``,
+        row-split or replicated), ``y`` the flat targets, ``theta`` the
+        current ``(f+1,)`` coefficients; coordinate 0 is the unthresholded
+        intercept, exactly like the jitted sweep."""
+        n, f1 = (int(s) for s in x.shape)
+        lam = self.__lam
+        # pending identity roots: the per-coordinate column reads then record
+        # view nodes (a concrete operand's basic read would dispatch eagerly)
+        X = ht.positive(x)
+        th = ht.positive(theta)
+        iota = ht.arange(f1)
+        for j in range(f1):
+            xj = X[:, j]  # view node (n,)
+            resid = y - ht.dot(X, th) + xj * th[j : j + 1]
+            rho = ht.dot(xj, resid) / n
+            zj = ht.dot(xj, xj) / n
+            if j == 0:  # intercept coordinate: never thresholded
+                new = rho / zj
+            else:
+                new = ht.sign(rho) * ht.maximum(ht.abs(rho) - lam, 0.0) / zj
+            th = ht.where(iota == j, new, th)
+        return th
+
+    def _fit_fused(self, x: DNDarray, y: DNDarray) -> int:
+        """Coordinate-descent fit driven through :meth:`sweep_once` (the
+        deferred-DAG sweep): one fused executable per sweep, preemption
+        polled at sweep boundaries like the jitted path. Returns n_iter and
+        leaves the final theta in ``self.__theta``."""
+        xa = x.larray
+        ya = y.larray.reshape(-1)
+        n, f = xa.shape
+        X = ht.array(
+            jnp.concatenate([jnp.ones((n, 1), dtype=xa.dtype), xa], axis=1),
+            split=x.split, device=x.device, comm=x.comm,
+        )
+        yv = ht.array(ya, split=None if y.split is None else 0, device=y.device, comm=y.comm)
+        theta = ht.zeros((f + 1,), dtype=x.dtype, device=x.device, comm=x.comm)
+        n_iter = 0
+        with _ev.span("lasso.fit", n=int(n), features=int(f)) as fit_sp:
+            for n_iter in range(1, self.max_iter + 1):
+                with _ev.span("lasso.sweep", iteration=n_iter) as sp:
+                    new_theta = self.sweep_once(X, yv, theta)
+                    # the max-|Δ| sink consumes the sweep DAG: this readback
+                    # is the ONE flush (and the device sync the loop needs)
+                    diff = float(ht.max(ht.abs(new_theta - theta)).item())
+                    sp.set(delta=diff)
+                theta = new_theta
+                if diff < self.tol:
+                    break
+                if _preempt.should_checkpoint():
+                    _preempt.checkpoint_now(
+                        {"theta": theta.larray, "sweep": n_iter}, step=n_iter
+                    )
+                    break
+            fit_sp.set(n_iter=n_iter)
+        self.__theta = ht.array(
+            theta.larray.reshape(-1, 1), device=x.device, comm=x.comm
+        )
+        return n_iter
+
     def fit(self, x: DNDarray, y: DNDarray) -> "Lasso":
         """
         Coordinate descent fit (reference lasso.py:126-176). A bias column is
         prepended; the intercept coordinate is not thresholded.
+
+        ``sweep_engine='jit'`` (default) runs the ``lax.fori_loop`` sweep;
+        ``'fused'`` drives :meth:`sweep_once` through the deferred-execution
+        engine — one fused XLA program per sweep recorded from the op
+        surface.
         """
         if not isinstance(x, DNDarray) or not isinstance(y, DNDarray):
             raise ValueError("x and y need to be ht.DNDarrays")
+        if self.sweep_engine == "fused":
+            n_iter = self._fit_fused(x, y)
+            if _MON.enabled:
+                _REG.counter("lasso.fits").inc()
+                _REG.counter("lasso.sweeps").inc(n_iter)
+            self.n_iter = n_iter
+            return self
         xa = x.larray
         ya = y.larray.reshape(-1)
         n, f = xa.shape

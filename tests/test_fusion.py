@@ -353,17 +353,36 @@ def test_flush_on_setitem():
     assert _bitwise_equal(y.numpy(), ref)
 
 
-def test_flush_on_resplit():
+def test_resplit_records_collective_over_pending(monkeypatch):
+    # ISSUE 7: resplit_ over a pending chain records a collective node (the
+    # chain STAYS pending under the new split metadata) instead of flushing;
+    # HEAT_TPU_FUSION_COLLECTIVES=0 restores the flush barrier
+    monkeypatch.setenv("HEAT_TPU_FUSION_COLLECTIVES", "1")
+    a, y = _pending_chain(split=0)
+    y.resplit_(1)
+    if get_comm().is_distributed():
+        assert fusion.is_deferred(y)
+    assert y.split == 1
+    assert _bitwise_equal(y.numpy(), (a.numpy() + 1.0) * 2.0)
+    monkeypatch.setenv("HEAT_TPU_FUSION_COLLECTIVES", "0")
     a, y = _pending_chain(split=0)
     y.resplit_(1)
     assert not fusion.is_deferred(y)
-    assert y.split == 1
     assert _bitwise_equal(y.numpy(), (a.numpy() + 1.0) * 2.0)
 
 
-def test_flush_on_halo():
+def test_halo_defers_over_pending(monkeypatch):
+    # ISSUE 7: get_halo over a pending chain records the exchange (chain +
+    # ppermute compile at the first halo read); the hatch restores the flush
     if not get_comm().is_distributed():
         pytest.skip("halos require a multi-device mesh")
+    monkeypatch.setenv("HEAT_TPU_FUSION_COLLECTIVES", "1")
+    a, y = _pending_chain(split=0, shape=(16, 4))
+    y.get_halo(1)
+    assert fusion.is_deferred(y)
+    assert y.halo_prev is not None  # materializes chain + exchange together
+    assert tuple(y.array_with_halos.shape)[1] == 16 // get_comm().size + 2
+    monkeypatch.setenv("HEAT_TPU_FUSION_COLLECTIVES", "0")
     a, y = _pending_chain(split=0, shape=(16, 4))
     y.get_halo(1)
     assert not fusion.is_deferred(y)
@@ -1358,3 +1377,324 @@ def test_view_gemm_monitoring_export(monkeypatch):
         tele = report.telemetry()
     assert tele.get("fusion_ops_deferred", {}).get("view", 0) >= 2, tele
     assert tele.get("fusion_ops_deferred", {}).get("gemm", 0) >= 1, tele
+
+
+# ------------------------------------------------------------------ collective nodes (ISSUE 7)
+#
+# Collectives over a pending chain record COLLECTIVE nodes: resplit_ /
+# redistribute_ / get_halo / communication.shift / DNDarray Alltoall no
+# longer flush the chain — the split-axis chain, the cross-device transfer,
+# and the follow-on chain compile as ONE shard_map program. The differential
+# suite pins bit-for-bit parity vs HEAT_TPU_FUSION_COLLECTIVES=0 across
+# split/ragged/dtype for every node kind (collectives are pure data
+# movement; the in-trace pad rules replay the eager fill/slice exactly), and
+# the single-compile asserts pin the one-executable contract for
+# chain->resplit->chain->reduce, the kmeans step, the lasso sweep, and the
+# TSQR merge.
+
+
+def _coll_both(monkeypatch, fn):
+    """Run ``fn`` once with collectives-as-barriers and once recorded."""
+    monkeypatch.setenv("HEAT_TPU_FUSION_COLLECTIVES", "0")
+    eager = fn()
+    monkeypatch.setenv("HEAT_TPU_FUSION_COLLECTIVES", "1")
+    fused = fn()
+    return eager, fused
+
+
+def _coll_operand(shape, split, dtype, seed=51):
+    rng = np.random.default_rng(seed)
+    a = ht.array(rng.standard_normal(shape).astype(np.float32), split=split).astype(dtype)
+    a.parray  # noqa: B018
+    return a
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+@pytest.mark.parametrize("shape", [(16, 8), (13, 7)], ids=["even", "ragged"])
+@pytest.mark.parametrize("dtype", [ht.float32, ht.bfloat16], ids=["f32", "bf16"])
+def test_resplit_mid_chain_differential(monkeypatch, split, shape, dtype):
+    # chain -> resplit -> chain, bit-for-bit vs the flush-barrier path, for
+    # every split transition the mid-chain resplit can take from `split`
+    targets = {None: [0, 1], 0: [1, None], 1: [0, None]}[split]
+    for to in targets:
+        def run(_to=to):
+            a = _coll_operand(shape, split, dtype)
+            y = (a + 1.25) * 0.5
+            y.resplit_(_to)
+            y = y - 0.75
+            assert y.split == _to
+            return y.numpy()
+
+        eager, fused = _coll_both(monkeypatch, run)
+        assert _bitwise_equal(eager, fused), (split, to)
+
+
+@pytest.mark.parametrize("split", [0, 1])
+@pytest.mark.parametrize("shape", [(16, 8), (13, 7)], ids=["even", "ragged"])
+@pytest.mark.parametrize("dtype", [ht.float32, ht.bfloat16], ids=["f32", "bf16"])
+def test_shift_mid_chain_differential(monkeypatch, split, shape, dtype):
+    if not get_comm().is_distributed():
+        pytest.skip("ring shift requires a multi-device mesh")
+    for steps in (1, -1):
+        def run(_s=steps):
+            a = _coll_operand(shape, split, dtype, seed=53)
+            y = (a + 1.0) * 2.0
+            y = ht.shift(y, _s)
+            return (y + 0.5).numpy()
+
+        eager, fused = _coll_both(monkeypatch, run)
+        assert _bitwise_equal(eager, fused), (split, steps)
+
+
+@pytest.mark.parametrize("split,shape", [(0, (16, 4)), (0, (13, 4)), (1, (4, 16)), (1, (4, 13))],
+                         ids=["s0-even", "s0-ragged", "s1-even", "s1-ragged"])
+@pytest.mark.parametrize("dtype", [ht.float32, ht.bfloat16], ids=["f32", "bf16"])
+def test_halo_mid_chain_differential(monkeypatch, split, shape, dtype):
+    if not get_comm().is_distributed():
+        pytest.skip("halos require a multi-device mesh")
+
+    def run():
+        a = _coll_operand(shape, split, dtype, seed=57)
+        y = (a * 2.0) + 1.0
+        y.get_halo(1)
+        return (
+            np.asarray(y.halo_prev),
+            np.asarray(y.halo_next),
+            np.asarray(y.array_with_halos),
+            y.numpy(),
+        )
+
+    eager, fused = _coll_both(monkeypatch, run)
+    for e, f, name in zip(eager, fused, ("prev", "next", "stacked", "chain")):
+        assert _bitwise_equal(e, f), (name, split, shape)
+
+
+def test_alltoall_defers_and_matches(monkeypatch):
+    if not get_comm().is_distributed():
+        pytest.skip("alltoall requires a multi-device mesh")
+    comm = get_comm()
+    p = comm.size
+
+    def run():
+        a = _coll_operand((2 * p, 3 * p), 0, ht.float32, seed=59)
+        y = a * 1.5
+        z = comm.Alltoall(y, split_axis=1, concat_axis=0)
+        assert z.split == 1
+        return (z + 0.25).numpy()
+
+    eager, fused = _coll_both(monkeypatch, run)
+    assert _bitwise_equal(eager, fused)
+    # deferral actually happened with the gate on
+    monkeypatch.setenv("HEAT_TPU_FUSION_COLLECTIVES", "1")
+    a = _coll_operand((2 * p, 3 * p), 0, ht.float32, seed=59)
+    z = comm.Alltoall(a * 1.5, split_axis=1, concat_axis=0)
+    assert fusion.is_deferred(z)
+    # the raw-array shim keeps its jax.Array contract
+    raw = comm.Alltoall(jnp.ones((2 * p, 3 * p), jnp.float32), split_axis=1, concat_axis=0)
+    assert not isinstance(raw, ht.DNDarray)
+
+
+def test_chain_resplit_chain_reduce_single_compile(monkeypatch, no_faults):
+    # acceptance (ISSUE 7): chain -> resplit -> chain -> reduce == ONE XLA
+    # program — the recorded collective does not break the fused flush
+    if not get_comm().is_distributed():
+        pytest.skip("resharding requires a multi-device mesh")
+    monkeypatch.setenv("HEAT_TPU_FUSION_COLLECTIVES", "1")
+    rng = np.random.default_rng(61)
+    a = ht.array(rng.standard_normal((24, 16)).astype(np.float32), split=0)
+    a.parray  # noqa: B018
+    fusion.clear_cache()
+    with monitoring.capture():
+        registry.reset()
+        y = ht.sqrt(ht.abs(a) + 1.0)
+        y.resplit_(1)
+        z = (y * 0.25).sum()
+        assert fusion.is_deferred(z)
+        base = registry.REGISTRY.counter("jit.compiles").get()
+        zn = z.numpy()
+        compiles = registry.REGISTRY.counter("jit.compiles").get() - base
+        snap = registry.snapshot()
+    assert compiles == 1, f"expected exactly one XLA compile, got {compiles}"
+    labels = snap["counters"]["fusion.flush_reason"]["labels"]
+    assert labels.get("collective", 0) == 0, labels
+    assert snap["counters"]["fusion.ops_deferred"]["labels"].get("collective", 0) >= 1
+    ref = (np.sqrt(np.abs(a.numpy()) + 1.0) * 0.25).sum()
+    np.testing.assert_allclose(float(zn), ref, rtol=1e-5)
+
+
+def test_kmeans_step_single_program(monkeypatch, no_faults):
+    # acceptance (ISSUE 7): the DNDarray-surface kmeans iteration — distance
+    # chain + GEMMs + argmin sink + one-hot update + recorded centers resplit
+    # — compiles as ONE XLA program with flush_reason{collective} == 0
+    from heat_tpu.cluster.kmeans import KMeans, _kmeans_step
+
+    if not get_comm().is_distributed():
+        pytest.skip("the step's recorded resplit needs a multi-device mesh")
+    monkeypatch.setenv("HEAT_TPU_FUSION_COLLECTIVES", "1")
+    rng = np.random.default_rng(63)
+    n, f, k = 64, 8, 8
+    data = rng.standard_normal((n, f)).astype(np.float32)
+    cent = rng.standard_normal((k, f)).astype(np.float32)
+    x = ht.array(data, split=0)
+    x.parray  # noqa: B018
+    c_split = ht.array(cent, split=0)
+    c_split.parray  # noqa: B018
+    km = KMeans(n_clusters=k)
+    fusion.clear_cache()
+    with monitoring.capture():
+        registry.reset()
+        nc, lab, sh = km.step(x, centers=c_split)
+        assert fusion.is_deferred(sh)
+        base = registry.REGISTRY.counter("jit.compiles").get()
+        shv = sh.numpy()
+        compiles = registry.REGISTRY.counter("jit.compiles").get() - base
+        base = registry.REGISTRY.counter("jit.compiles").get()
+        ncv, labv = nc.numpy(), lab.numpy()
+        extra = registry.REGISTRY.counter("jit.compiles").get() - base
+        snap = registry.snapshot()
+    assert compiles == 1, f"expected one XLA compile for the step, got {compiles}"
+    assert extra == 0, "centers/labels must ride the same kernel"
+    labels = snap["counters"]["fusion.flush_reason"]["labels"]
+    assert labels.get("collective", 0) == 0, labels
+    nc_ref, lab_ref, sh_ref, _ = _kmeans_step(jnp.asarray(data), jnp.asarray(cent))
+    assert np.array_equal(labv, np.asarray(lab_ref))
+    np.testing.assert_allclose(ncv, np.asarray(nc_ref), rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(float(shv), float(sh_ref), rtol=2e-4)
+
+
+def test_lasso_sweep_single_program(monkeypatch, no_faults):
+    # acceptance (ISSUE 7): one coordinate-descent sweep on the op surface
+    # flushes as ONE cached XLA program with flush_reason{collective} == 0,
+    # and the fused engine converges to the jitted engine's coefficients
+    monkeypatch.setenv("HEAT_TPU_FUSION_COLLECTIVES", "1")
+    rng = np.random.default_rng(67)
+    n, f = 64, 4
+    X = rng.standard_normal((n, f)).astype(np.float32)
+    beta = np.array([1.5, 0.0, -2.0, 0.5], np.float32)
+    yv = X @ beta + 0.01 * rng.standard_normal(n).astype(np.float32)
+    x = ht.array(X, split=0)
+    y = ht.array(yv, split=0)
+    fusion.clear_cache()
+    with monitoring.capture():
+        registry.reset()
+        las = ht.regression.Lasso(lam=0.05, max_iter=1, tol=-1.0, sweep_engine="fused")
+        base = registry.REGISTRY.counter("jit.compiles").get()
+        las.fit(x, y)
+        snap = registry.snapshot()
+    labels = snap["counters"]["fusion.flush_reason"]["labels"]
+    assert labels.get("collective", 0) == 0, labels
+    assert snap["counters"]["fusion.flushes"] == 1, snap["counters"]["fusion.flushes"]
+    las_jit = ht.regression.Lasso(lam=0.05, max_iter=1, tol=-1.0)
+    las_jit.fit(x, y)
+    np.testing.assert_allclose(
+        las.theta.numpy(), las_jit.theta.numpy(), rtol=1e-4, atol=1e-6
+    )
+
+
+def test_tsqr_traces_pending_chain(monkeypatch, no_faults):
+    # ISSUE 7: a pending chain traces INTO the TSQR merge program
+    # (flush_through) — one executable, Q/R bitwise vs the flush-first path
+    comm = get_comm()
+    if not comm.is_distributed():
+        pytest.skip("TSQR requires a multi-device mesh")
+    p = comm.size
+    rng = np.random.default_rng(69)
+    A = rng.standard_normal((8 * p, 4)).astype(np.float32)
+
+    def run():
+        a = ht.array(A, split=0)
+        a.parray  # noqa: B018
+        y = (a * 0.5) + 0.25
+        res = ht.linalg.qr(y)
+        return res.Q.numpy(), res.R.numpy()
+
+    (qe, re_), (qf, rf) = _coll_both(monkeypatch, run)
+    assert _bitwise_equal(qe, qf)
+    assert _bitwise_equal(re_, rf)
+    monkeypatch.setenv("HEAT_TPU_FUSION_COLLECTIVES", "1")
+    fusion.clear_cache()
+    with monitoring.capture():
+        registry.reset()
+        a = ht.array(A, split=0)
+        a.parray  # noqa: B018
+        y = (a * 0.5) + 0.25
+        base = registry.REGISTRY.counter("jit.compiles").get()
+        res = ht.linalg.qr(y)
+        compiles = registry.REGISTRY.counter("jit.compiles").get() - base
+        base = registry.REGISTRY.counter("jit.compiles").get()
+        y.parray  # noqa: B018 — the chain value rode the same kernel
+        extra = registry.REGISTRY.counter("jit.compiles").get() - base
+    assert compiles == 1, compiles
+    assert extra == 0, extra
+
+
+def test_redistribute_telemetry_attribution():
+    # ISSUE 7 satellite: redistribute_ counts comm.redistribution, NOT a
+    # same->same comm.resharding (which must stay "genuine split changes")
+    a = ht.ones((16, 4), split=0)
+    a.parray  # noqa: B018
+    with monitoring.capture():
+        a.redistribute_()
+        b = ht.ones((16, 4), split=0)
+        b.resplit_(1)
+        snap = registry.snapshot()
+    counters = snap["counters"]
+    if get_comm().is_distributed():
+        assert counters["comm.redistribution"] == 1, counters.get("comm.redistribution")
+        resh = counters.get("comm.resharding", {"labels": {}})["labels"]
+        assert "0->0" not in resh, resh
+        assert resh.get("0->1", 0) == 1, resh
+    else:
+        assert "comm.redistribution" not in counters
+
+
+def test_redistribute_keeps_chain_pending(monkeypatch):
+    monkeypatch.setenv("HEAT_TPU_FUSION_COLLECTIVES", "1")
+    if not get_comm().is_distributed():
+        pytest.skip("redistribute placement needs a multi-device mesh")
+    a, y = _pending_chain(split=0)
+    y.redistribute_()
+    assert fusion.is_deferred(y)
+    assert _bitwise_equal(y.numpy(), (a.numpy() + 1.0) * 2.0)
+    monkeypatch.setenv("HEAT_TPU_FUSION_COLLECTIVES", "0")
+    a, y = _pending_chain(split=0)
+    y.redistribute_()
+    assert not fusion.is_deferred(y)
+
+
+def test_collective_fallback_counts_and_stays_correct(monkeypatch):
+    # a collective whose in-trace form is rejected falls back to the flush
+    # barrier, counted in fusion.collective_fallbacks — results unchanged
+    if not get_comm().is_distributed():
+        pytest.skip("resharding requires a multi-device mesh")
+    monkeypatch.setenv("HEAT_TPU_FUSION_COLLECTIVES", "1")
+    orig = fusion._eval_node
+
+    def boom(fn, okey, *args, **kw):
+        if isinstance(okey, tuple) and okey and okey[0] == "collective":
+            raise RuntimeError("forced abstract-eval failure")
+        return orig(fn, okey, *args, **kw)
+
+    monkeypatch.setattr(fusion, "_eval_node", boom)
+    with monitoring.capture():
+        a, y = _pending_chain(split=0)
+        y.resplit_(1)
+        assert not fusion.is_deferred(y)  # fell back to the flush barrier
+        snap = registry.snapshot()
+    fb = snap["counters"]["fusion.collective_fallbacks"]["labels"]
+    assert fb.get("abstract-eval", 0) >= 1, fb
+    assert _bitwise_equal(y.numpy(), (a.numpy() + 1.0) * 2.0)
+
+
+def test_collective_monitoring_export(monkeypatch):
+    # satellite: ops_deferred{collective} and collective_fallbacks ride
+    # report.telemetry() in the PR 4/5 labelled style
+    if not get_comm().is_distributed():
+        pytest.skip("resharding requires a multi-device mesh")
+    monkeypatch.setenv("HEAT_TPU_FUSION_COLLECTIVES", "1")
+    with monitoring.capture():
+        _, y = _pending_chain(split=0)
+        y.resplit_(1)
+        _ = y.numpy()
+        tele = report.telemetry()
+    assert tele.get("fusion_ops_deferred", {}).get("collective", 0) >= 1, tele

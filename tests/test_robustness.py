@@ -160,6 +160,41 @@ def test_collective_dispatch_site_fires_deterministically():
         _ = a.comm.Allreduce(a.larray)  # call 3: runs again
 
 
+def test_collective_bearing_flush_recovers_through_ladder(monkeypatch):
+    # ISSUE 7: a collective RECORDED in a fused flush consults the
+    # collective.dispatch site on the fused attempt (where the ICI dispatch
+    # now lives) and a failure there rides the recovery ladder — per-op eager
+    # replay of the retained chain plus the collective's own cached program —
+    # instead of surfacing as a raw crash; results stay bit-identical to the
+    # HEAT_TPU_FUSION_COLLECTIVES=0 barrier path
+    if not ht.get_comm().is_distributed():
+        pytest.skip("resharding requires a multi-device mesh")
+    monkeypatch.setenv("HEAT_TPU_FUSION_COLLECTIVES", "1")
+    rng = np.random.default_rng(47)
+    arr = rng.standard_normal((16, 8)).astype(np.float32)
+
+    def run():
+        a = ht.array(arr, split=0)
+        a.parray  # noqa: B018
+        y = (a + 1.0) * 2.0
+        y.resplit_(1)
+        return (y - 0.5).numpy()
+
+    monkeypatch.setenv("HEAT_TPU_FUSION_COLLECTIVES", "0")
+    ref = run()
+    monkeypatch.setenv("HEAT_TPU_FUSION_COLLECTIVES", "1")
+    fusion.clear_cache()
+    with monitoring.capture():
+        registry.reset()
+        with faultinject.inject("collective.dispatch", RuntimeError, at_calls=[1]) as plan:
+            got = run()
+            assert plan.fired == [1]
+        snap = registry.snapshot()["counters"]
+    assert _bitwise_equal(got, ref)
+    assert snap["fusion.flush_recovered"] == 1
+    assert snap["faults.injected"]["labels"] == {"collective.dispatch": 1}
+
+
 # ------------------------------------------------------------------ recovery ladder
 def _ladder_workload(a, b):
     # elementwise chain + view + GEMM epilogue + sink: every node kind rides
