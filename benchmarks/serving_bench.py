@@ -1,0 +1,216 @@
+"""
+Serving-runtime anchors (``heat_tpu/serving/``, ISSUE 8).
+
+Three anchor groups, wired into ``bench.py`` with the null-key crash-dict +
+``*_valid`` gating discipline of the PR 4/5 anchors:
+
+* ``cold_restart_compiles`` — the acceptance bar as a number: process 1
+  runs the fixed mixed-shape request mix against a fresh
+  ``HEAT_TPU_CACHE_DIR`` (recording the shape corpus and serializing every
+  compiled kernel), process 2 replays the SAME mix against the warmed
+  directory and reports its ``fusion.kernels_compiled`` — target **0**,
+  every flush served from the disk cache (``cold_restart_disk_hits`` > 0).
+  Both processes run on the CPU backend regardless of the bench host so the
+  anchor measures the cache mechanism, not backend init time; the TPU-host
+  cold path rides the identical machinery (the entry fingerprint is
+  platform-specific, so a TPU process simply records its own corpus).
+* ``dispatch_p50_us`` / ``dispatch_p99_us`` — exact sample percentiles of
+  submit-to-materialized latency for the mixed-shape mix dispatched through
+  the async flush scheduler against warm caches (one measured pass after a
+  warmup pass; the telemetry histogram carries the same signal in
+  production).
+* ``bucket_kernel_count`` vs ``unbucketed_kernel_count`` — distinct fused
+  kernels compiled by the mix with ``HEAT_TPU_SHAPE_BUCKETS=pow2`` vs the
+  exact-shape default: the bucketed count is bounded by the bucket grid
+  (``bucket_valid`` additionally requires bit-identical results pairwise
+  across the whole mix).
+
+Run: python benchmarks/serving_bench.py
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+#: The fixed mixed-shape request mix: 2-d operand shapes a shape-diverse
+#: serving workload would present (deterministic — the cold-restart replay
+#: subprocess must regenerate the identical trace keys).
+MIX_SHAPES = tuple(
+    (r, c)
+    for r in (33, 48, 57, 64, 97, 120)
+    for c in (5, 12, 31)
+)
+
+
+def _request(i, shape):
+    """One request's chain: 6 recorded pointwise ops over a fresh operand."""
+    import heat_tpu as ht
+
+    data = np.random.default_rng(i).normal(size=shape).astype(np.float32)
+    x = ht.array(data)
+    return ht.sin((x * 2.0 + 1.0) / 3.0 - 0.5)
+
+
+def _run_mix():
+    """Flush every request in the mix; returns the results as numpy arrays."""
+    import heat_tpu as ht  # noqa: F401 — imported for side effects in _request
+
+    out = []
+    for i, shape in enumerate(MIX_SHAPES):
+        r = _request(i, shape)
+        out.append(r.numpy())
+    return out
+
+
+def _replay_main():
+    """Subprocess entry: replay the mix, print compile/disk-hit counters."""
+    os.environ["HEAT_TPU_MONITORING"] = "1"
+    from heat_tpu.monitoring import registry
+
+    _run_mix()
+    c = registry.snapshot()["counters"].get("serving.disk_cache", {})
+    labels = c.get("labels", {}) if isinstance(c, dict) else {}
+    print(
+        json.dumps(
+            {
+                "compiles": registry.REGISTRY.counter("fusion.kernels_compiled").get(),
+                "disk_hits": labels.get("hit", 0),
+                "disk_writes": labels.get("write", 0),
+            }
+        )
+    )
+
+
+def _subprocess_env(cache_dir):
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        HEAT_TPU_CACHE_DIR=cache_dir,
+        HEAT_TPU_MONITORING="1",
+    )
+    env.pop("HEAT_TPU_FAULT_PLAN", None)
+    env.pop("HEAT_TPU_SHAPE_BUCKETS", None)
+    return env
+
+
+def bench_cold_restart():
+    """(cold_restart_compiles, cold_restart_disk_hits, valid): two fresh CPU
+    processes sharing one cache dir — writer then replayer."""
+    prog = (
+        "import sys; sys.path.insert(0, %r); "
+        "from serving_bench import _replay_main; _replay_main()"
+        % os.path.join(_REPO, "benchmarks")
+    )
+    with tempfile.TemporaryDirectory(prefix="heat-tpu-serving-bench-") as tmp:
+        env = _subprocess_env(tmp)
+
+        def run():
+            out = subprocess.run(
+                [sys.executable, "-c", prog],
+                env=env, cwd=_REPO, capture_output=True, text=True, timeout=600,
+            )
+            if out.returncode != 0:
+                raise RuntimeError(out.stderr[-800:])
+            return json.loads(out.stdout.strip().splitlines()[-1])
+
+        first = run()
+        second = run()
+    valid = (
+        first["disk_writes"] > 0
+        and second["compiles"] == 0
+        and second["disk_hits"] > 0
+    )
+    return second["compiles"], second["disk_hits"], bool(valid)
+
+
+def bench_bucketing():
+    """Kernel counts for the mix, exact vs pow2-bucketed, plus pairwise
+    bit-parity of the results."""
+    from heat_tpu.core import fusion
+    from heat_tpu.monitoring import registry
+
+    prev = os.environ.pop("HEAT_TPU_SHAPE_BUCKETS", None)
+    try:
+        compiles = registry.REGISTRY.counter("fusion.kernels_compiled")
+        fusion.clear_cache()
+        before = compiles.get()
+        exact = _run_mix()
+        unbucketed = compiles.get() - before
+
+        os.environ["HEAT_TPU_SHAPE_BUCKETS"] = "pow2"
+        fusion.clear_cache()
+        before = compiles.get()
+        bucketed_res = _run_mix()
+        bucketed = compiles.get() - before
+        waste = registry.REGISTRY.counter("serving.bucket").get("pad_waste_bytes")
+    finally:
+        if prev is None:
+            os.environ.pop("HEAT_TPU_SHAPE_BUCKETS", None)
+        else:
+            os.environ["HEAT_TPU_SHAPE_BUCKETS"] = prev
+    parity = all(
+        a.shape == b.shape and a.tobytes() == b.tobytes()
+        for a, b in zip(exact, bucketed_res)
+    )
+    valid = parity and 0 < bucketed < unbucketed
+    return bucketed, unbucketed, int(waste), bool(valid)
+
+
+def bench_dispatch_latency(rounds: int = 4):
+    """Exact p50/p99 (µs) of scheduler submit-to-materialized latency for
+    the mix against warm caches."""
+    from heat_tpu import serving
+    from heat_tpu.monitoring import registry as _reg
+
+    _run_mix()  # warm the trace LRU so latency measures dispatch, not compile
+    samples = []
+    with serving.FlushScheduler(max_workers=4) as sched:
+        # one untimed pass spins the pool threads up
+        sched.flush_all([_request(i, s) for i, s in enumerate(MIX_SHAPES)])
+        for _ in range(rounds):
+            for i, shape in enumerate(MIX_SHAPES):
+                r = _request(i, shape)
+                t0 = time.perf_counter()
+                sched.schedule(r).result()
+                samples.append(time.perf_counter() - t0)
+    arr = np.asarray(samples)
+    p50 = float(np.percentile(arr, 50) * 1e6)
+    p99 = float(np.percentile(arr, 99) * 1e6)
+    valid = len(samples) >= 50 and p50 > 0
+    del _reg
+    return round(p50, 1), round(p99, 1), bool(valid)
+
+
+def bench_serving():
+    """All serving anchors as one flat dict (the bench.py contract)."""
+    bucketed, unbucketed, waste, bucket_valid = bench_bucketing()
+    p50, p99, lat_valid = bench_dispatch_latency()
+    cold_compiles, cold_hits, cold_valid = bench_cold_restart()
+    return {
+        "cold_restart_compiles": cold_compiles,
+        "cold_restart_disk_hits": cold_hits,
+        "cold_restart_valid": cold_valid,
+        "dispatch_p50_us": p50,
+        "dispatch_p99_us": p99,
+        "dispatch_latency_valid": lat_valid,
+        "bucket_kernel_count": bucketed,
+        "unbucketed_kernel_count": unbucketed,
+        "bucket_pad_waste_bytes": waste,
+        "bucket_valid": bucket_valid,
+    }
+
+
+if __name__ == "__main__":
+    from heat_tpu.monitoring import registry
+
+    with registry.capture():
+        print(json.dumps(bench_serving(), indent=2, sort_keys=True))

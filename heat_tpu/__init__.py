@@ -19,6 +19,7 @@ from . import nn
 from . import optim
 from . import regression
 from . import robustness
+from . import serving
 from . import spatial
 from . import utils
 

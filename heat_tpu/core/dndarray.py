@@ -236,6 +236,19 @@ class DNDarray:
             with _fusion.flush_reason(reason):
                 self.parray  # noqa: B018
 
+    def flush_async(self, reason: str = "serving"):
+        """Submit this array's pending expression to the serving layer's
+        async flush scheduler (``heat_tpu/serving/scheduler.py``) and return
+        a ``concurrent.futures.Future`` resolving to ``self`` once the fused
+        kernel has been dispatched. Device dispatch of this flush then
+        overlaps the host-side trace/key work of the next one (JAX dispatch
+        is already asynchronous; the scheduler stops Python-side flush prep
+        from serializing on one thread). A concrete array resolves
+        immediately — scheduling is always safe."""
+        from ..serving import scheduler as _scheduler
+
+        return _scheduler.schedule(self, reason=reason)
+
     def _rebind_expr(self, node, split: Optional[int]) -> None:
         """Package-internal (``core/fusion.py``): replace this array's pending
         expression IN PLACE with ``node`` — a collective recorded OVER the old

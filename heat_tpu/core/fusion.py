@@ -167,6 +167,7 @@ import collections
 import functools
 import os
 import sys
+import threading
 import weakref
 from typing import Optional, Tuple
 
@@ -406,17 +407,28 @@ class _Node:
     ``args`` holds ``_Node`` / ``_Leaf`` / baked scalar constants in
     positional order. ``op_key`` is the structural identity used in trace
     cache keys (op name + process-stable object id, plus any baked
-    parameters). ``cast`` replays the eager binary template's dtype cast-back:
-    ``(promoted_np_dtype, is_eq_ne)`` or ``None``. ``value`` is filled when
-    the owning array materializes, turning the node into a leaf for any other
-    pending graph that references it.
+    parameters). ``skey`` is the *cross-process-stable* twin of ``op_key``
+    (no object ids — op names and static parameters only), set by the defer
+    site; it keys the serving layer's persistent disk cache and shape-corpus
+    entries (``heat_tpu/serving/``), and doubles as the rebuild recipe the
+    AOT warmup driver uses to reconstruct the exact callable in a fresh
+    process. ``None`` means the node has no process-independent identity
+    (collective nodes close over mesh/comm objects) — programs containing
+    one stay in-memory-only. ``cast`` replays the eager binary template's
+    dtype cast-back: ``(promoted_np_dtype, is_eq_ne)`` or ``None``.
+    ``value`` is filled when the owning array materializes, turning the node
+    into a leaf for any other pending graph that references it.
     """
 
-    __slots__ = ("fn", "op_key", "args", "kwargs", "cast", "aval", "nops", "value", "owner", "rc")
+    __slots__ = (
+        "fn", "op_key", "skey", "args", "kwargs", "cast", "aval", "nops",
+        "value", "owner", "rc",
+    )
 
-    def __init__(self, fn, op_key, args, kwargs, cast, aval):
+    def __init__(self, fn, op_key, args, kwargs, cast, aval, skey=None):
         self.fn = fn
         self.op_key = op_key
+        self.skey = skey
         self.args = args
         self.kwargs = kwargs  # tuple(sorted(items)) — hashable
         self.cast = cast
@@ -489,8 +501,19 @@ def flush_pending(reason: str = "export") -> int:
 #: Reason stack read by ``materialize_for`` when attributing a flush to the
 #: ``fusion.flush_reason`` labelled counter. Barrier sites push the reason of
 #: the *outermost* barrier (e.g. printing wins over the ``.numpy()`` it calls
-#: internally); a flush with no annotated barrier reports ``other``.
-_FLUSH_REASON: list = ["other"]
+#: internally); a flush with no annotated barrier reports ``other``. The
+#: stack is *per-thread* (``threading.local``) so concurrent flushes driven
+#: by the serving scheduler (``heat_tpu/serving/scheduler.py``) attribute
+#: their reasons independently instead of racing on one list.
+_REASON_TLS = threading.local()
+
+
+def _reason_stack() -> list:
+    st = getattr(_REASON_TLS, "stack", None)
+    if st is None:
+        st = ["other"]
+        _REASON_TLS.stack = st
+    return st
 
 
 class _ReasonCtx:
@@ -504,14 +527,15 @@ class _ReasonCtx:
 
     def __enter__(self):
         # outermost barrier wins: only annotate when no reason is active yet
-        if len(_FLUSH_REASON) == 1:
-            _FLUSH_REASON.append(self.reason)
+        st = _reason_stack()
+        if len(st) == 1:
+            st.append(self.reason)
             self.pushed = True
         return self
 
     def __exit__(self, *exc):
         if self.pushed:
-            _FLUSH_REASON.pop()
+            _reason_stack().pop()
         return False
 
 
@@ -554,7 +578,14 @@ def _aval_in(x):
     )
 
 
-@functools.lru_cache(maxsize=4096)
+#: Capacity of the abstract-eval memo below. Kept equal to the trace LRU's
+#: default so the two caches can't shear under eviction pressure (ISSUE 8
+#: satellite): both are surfaced in :func:`cache_info` and cleared together
+#: by :func:`clear_cache`.
+_EVAL_CACHE_SIZE = 4096
+
+
+@functools.lru_cache(maxsize=_EVAL_CACHE_SIZE)
 def _eval_node_cached(op_key, tmpl, kwargs, cast, avals):
     """Abstract-eval one op (with its cast-back rule) once per structural
     signature; repeated chain steps cost a dict hit instead of a trace."""
@@ -716,7 +747,8 @@ def defer_binary(
         aval = _eval_node(operation, okey, args, kwargs, cast)
     except Exception:
         return None  # abstract eval rejected the combination: eager handles
-    node = _Node(operation, okey, tuple(args), kwargs, cast, aval)
+    skey = ("binary", operation.__name__, kwargs, (str(cast[0]), cast[1]))
+    node = _Node(operation, okey, tuple(args), kwargs, cast, aval, skey=skey)
 
     if where is not None:
         w_in = None
@@ -775,7 +807,7 @@ def _where_glue(w_in, op_node: _Node, out_shape) -> Optional[_Node]:
         aval = _eval_node(fn, okey, args, (), None)
     except Exception:
         return None
-    return _Node(fn, okey, args, (), None, aval)
+    return _Node(fn, okey, args, (), None, aval, skey=okey)
 
 
 def defer_local(operation, x: DNDarray, kwargs: dict, force_logical: bool) -> Optional[DNDarray]:
@@ -800,7 +832,10 @@ def defer_local(operation, x: DNDarray, kwargs: dict, force_logical: bool) -> Op
         return None
     if tuple(aval.shape) != tuple(x.pshape):
         return None  # shape-changing call (e.g. degenerate clip): eager handles
-    node = _Node(operation, okey, (inp,), kw, None, aval)
+    node = _Node(
+        operation, okey, (inp,), kw, None, aval,
+        skey=("local", operation.__name__, kw),
+    )
     res_dtype = canonical_heat_type(aval.dtype)
     return _finish(node, tuple(x.shape), res_dtype, x.split, x.device, x.comm, "local")
 
@@ -834,7 +869,7 @@ def defer_where(cond: DNDarray, x, y) -> Optional[DNDarray]:
     split = cond.split
     if split is not None and len(aval.shape) != cond.ndim:
         split = None
-    node = _Node(jnp.where, okey, tuple(args), (), None, aval)
+    node = _Node(jnp.where, okey, tuple(args), (), None, aval, skey=("where",))
     res_dtype = canonical_heat_type(aval.dtype)
     return _finish(
         node, tuple(aval.shape), res_dtype, split, cond.device, cond.comm, "where"
@@ -864,7 +899,7 @@ def defer_cast(x: DNDarray, heat_dtype) -> Optional[DNDarray]:
     fn = _cast_fn_for(dt)
     okey = ("cast", str(dt))
     aval = jax.ShapeDtypeStruct(tuple(x.pshape), dt)
-    node = _Node(fn, okey, (inp,), (), None, aval)
+    node = _Node(fn, okey, (inp,), (), None, aval, skey=okey)
     return _finish(node, tuple(x.shape), heat_dtype, x.split, x.device, x.comm, "cast")
 
 
@@ -1066,7 +1101,9 @@ def defer_view(
             return None
         if tuple(aval.shape) != expected:
             return None
-    node = _Node(fn, okey, (inp,), (), None, aval)
+    # the view okey carries only the kind + static parameters — already
+    # process-stable, so it doubles as the serving-layer skey
+    node = _Node(fn, okey, (inp,), (), None, aval, skey=okey)
     dtype = res_dtype if res_dtype is not None else canonical_heat_type(aval.dtype)
     return _finish(node, out_gshape, dtype, out_split, x.device, x.comm, "view")
 
@@ -1141,6 +1178,30 @@ def _gemm_fn_for(op: str, cast_dt, precision):
     return fn
 
 
+def _precision_token(p):
+    """Process-stable (picklable, id-free) form of a declared GEMM
+    ``precision`` — None, a string alias, a ``lax.Precision`` member (by
+    name), or a pair of either — for the serving layer's disk-cache and
+    corpus keys. Returns the sentinel ``False`` when inexpressible (the
+    program then stays in-memory-only)."""
+    if p is None or isinstance(p, str):
+        return p
+    if isinstance(p, (tuple, list)):
+        toks = tuple(_precision_token(q) for q in p)
+        return False if any(t is False for t in toks) else toks
+    name = getattr(p, "name", None)
+    return ("P", name) if isinstance(name, str) else False
+
+
+def _precision_from_token(tok):
+    """Inverse of :func:`_precision_token` (the warmup rebuild path)."""
+    if tok is None or isinstance(tok, str):
+        return tok
+    if isinstance(tok, tuple) and len(tok) == 2 and tok[0] == "P":
+        return jax.lax.Precision[tok[1]]
+    return tuple(_precision_from_token(t) for t in tok)
+
+
 def defer_matmul(
     a: DNDarray,
     b: DNDarray,
@@ -1195,7 +1256,13 @@ def defer_matmul(
         expected = comm.padded_shape(out_gshape, out_split)
     if tuple(aval.shape) != expected:
         return None
-    node = _Node(fn, okey, (in_a, in_b), (), None, aval)
+    ptok = _precision_token(precision)
+    skey = (
+        None
+        if ptok is False
+        else ("gemm", op, None if cast_dt is None else str(cast_dt), ptok)
+    )
+    node = _Node(fn, okey, (in_a, in_b), (), None, aval, skey=skey)
     res_dtype = canonical_heat_type(aval.dtype)
     return _finish(node, out_gshape, res_dtype, out_split, a.device, a.comm, "gemm")
 
@@ -1346,7 +1413,13 @@ def defer_reduce(
         return None  # abstract eval rejected the combination: eager handles
     if tuple(aval.shape) != tuple(expected_pshape):
         return None
-    node = _Node(fn, okey, args, (), None, aval)
+    opname = getattr(op, "__name__", None)
+    skey = (
+        None
+        if opname is None
+        else ("sink", kind, opname, pre, axis, keepdims, static_items, dyn_names, nanfix)
+    )
+    node = _Node(fn, okey, args, (), None, aval, skey=skey)
     res_dtype = canonical_heat_type(aval.dtype)
     return _finish_sink(
         node, tuple(out_gshape), res_dtype, out_split, x.device, x.comm, kind
@@ -1385,7 +1458,13 @@ def defer_moment(
         return None
     from .types import canonical_heat_type
 
-    node = _Node(fn, okey, args, (), None, aval)
+    opname = getattr(op, "__name__", None)
+    skey = (
+        None
+        if opname is None
+        else ("sink_moment", opname, axis, keepdims, static_items, dyn_names)
+    )
+    node = _Node(fn, okey, args, (), None, aval, skey=skey)
     res_dtype = canonical_heat_type(aval.dtype)
     return _finish_sink(
         node, tuple(aval.shape), res_dtype, out_split, x.device, x.comm, "moment"
@@ -1393,6 +1472,27 @@ def defer_moment(
 
 
 _CUM_FNS: dict = {}
+
+
+def _cum_fn_for(op, axis: int, dt, comm_cum=None, cum_opname=None):
+    """Memoized cumulative sink callable: the chunk-local jnp cumulative (or
+    the ``comm.Cum`` shard_map pipeline) plus the optional dtype cast. Shared
+    by :func:`defer_cum` and the serving warmup rebuild (comm-less form)."""
+    key = (id(op), axis, None if dt is None else str(dt),
+           None if comm_cum is None else id(comm_cum), cum_opname)
+    fn = _CUM_FNS.get(key)
+    if fn is None:
+        def fn(v, _op=op, _axis=axis, _dt=dt, _comm=comm_cum, _name=cum_opname):
+            if _comm is not None:
+                r = _comm.Cum(v, op=_name, split=_axis)
+            else:
+                r = _op(v, axis=_axis)
+            if _dt is not None:
+                r = r.astype(_dt)
+            return r
+
+        _CUM_FNS[key] = fn
+    return fn
 
 
 def defer_cum(
@@ -1410,20 +1510,7 @@ def defer_cum(
     if inp is None:
         return None
     dt = None if cast_dtype is None else np.dtype(cast_dtype.jnp_type())
-    key = (id(op), axis, None if dt is None else str(dt),
-           None if comm_cum is None else id(comm_cum), cum_opname)
-    fn = _CUM_FNS.get(key)
-    if fn is None:
-        def fn(v, _op=op, _axis=axis, _dt=dt, _comm=comm_cum, _name=cum_opname):
-            if _comm is not None:
-                r = _comm.Cum(v, op=_name, split=_axis)
-            else:
-                r = _op(v, axis=_axis)
-            if _dt is not None:
-                r = r.astype(_dt)
-            return r
-
-        _CUM_FNS[key] = fn
+    fn = _cum_fn_for(op, axis, dt, comm_cum, cum_opname)
     okey = ("sink", "cum", _op_key(op), axis, None if dt is None else str(dt),
             None if comm_cum is None else id(comm_cum), cum_opname)
     try:
@@ -1432,7 +1519,14 @@ def defer_cum(
         return None  # e.g. shard_map refuses abstract eval on this jax: eager
     if tuple(aval.shape) != tuple(x.pshape):
         return None
-    node = _Node(fn, okey, (inp,), (), None, aval)
+    opname = getattr(op, "__name__", None)
+    skey = (
+        # the comm-bound form closes over the mesh pipeline: no stable identity
+        None
+        if comm_cum is not None or opname is None
+        else ("sink_cum", opname, axis, None if dt is None else str(dt))
+    )
+    node = _Node(fn, okey, (inp,), (), None, aval, skey=skey)
     res_dtype = canonical_heat_type(aval.dtype)
     return _finish_sink(
         node, tuple(x.shape), res_dtype, x.split, x.device, x.comm, "cum"
@@ -1456,6 +1550,7 @@ def defer_norm(
         return None
     fn = _sink_fn_for(jnp.linalg.norm, pre, axis, keepdims, (("ord", ord),), (), False)
     okey = ("sink", "norm", pre, axis, keepdims, ("ord", str(ord)))
+    skey = ("sink_norm", pre, axis, keepdims, ord)
     inp = _input_of(x)
     if inp is None:
         return None
@@ -1465,18 +1560,15 @@ def defer_norm(
         return None
     from .types import canonical_heat_type
 
-    node = _Node(fn, okey, (inp,), (), None, aval)
+    node = _Node(fn, okey, (inp,), (), None, aval, skey=skey)
     res_dtype = canonical_heat_type(aval.dtype)
     return _finish_sink(
         node, tuple(aval.shape), res_dtype, None, x.device, x.comm, "norm"
     )
 
 
-def defer_vecdot(x1: DNDarray, x2: DNDarray, axis, keepdim: bool) -> Optional[DNDarray]:
-    """Sink ``vecdot``'s broadcast–conj–multiply–sum pipeline over two (possibly
-    pending) operands; the trace replays the eager body verbatim."""
-    if x1.is_padded or x2.is_padded or _low_float(x1) or _low_float(x2):
-        return None  # eager consumes larray; a two-operand pad slice is rare
+def _vecdot_fn_for(axis, keepdim: bool):
+    """Memoized vecdot sink callable (shared with the warmup rebuild)."""
     key = ("vecdot", axis, keepdim)
     fn = _SINK_FNS.get(key)
     if fn is None:
@@ -1485,6 +1577,15 @@ def defer_vecdot(x1: DNDarray, x2: DNDarray, axis, keepdim: bool) -> Optional[DN
             return jnp.sum(jnp.conj(aa) * bb, axis=_axis, keepdims=_keep)
 
         _SINK_FNS[key] = fn
+    return fn
+
+
+def defer_vecdot(x1: DNDarray, x2: DNDarray, axis, keepdim: bool) -> Optional[DNDarray]:
+    """Sink ``vecdot``'s broadcast–conj–multiply–sum pipeline over two (possibly
+    pending) operands; the trace replays the eager body verbatim."""
+    if x1.is_padded or x2.is_padded or _low_float(x1) or _low_float(x2):
+        return None  # eager consumes larray; a two-operand pad slice is rare
+    fn = _vecdot_fn_for(axis, keepdim)
     args = []
     for t in (x1, x2):
         inp = _input_of(t)
@@ -1498,7 +1599,9 @@ def defer_vecdot(x1: DNDarray, x2: DNDarray, axis, keepdim: bool) -> Optional[DN
         return None
     from .types import canonical_heat_type
 
-    node = _Node(fn, okey, tuple(args), (), None, aval)
+    node = _Node(
+        fn, okey, tuple(args), (), None, aval, skey=("sink_vecdot", axis, keepdim)
+    )
     res_dtype = canonical_heat_type(aval.dtype)
     return _finish_sink(
         node, tuple(aval.shape), res_dtype, None, x1.device, x1.comm, "vecdot"
@@ -1818,17 +1921,31 @@ _POISON_MAX = 1024
 
 
 def cache_info() -> dict:
-    """Trace-cache statistics (entries/hits/misses/evictions) plus the number
-    of poisoned signatures currently short-circuiting to eager replay."""
-    return {"entries": len(_TRACE_CACHE), "poisoned": len(_POISONED), **_cache_stats}
+    """Trace-cache statistics (entries/max/hits/misses/evictions), the number
+    of poisoned signatures currently short-circuiting to eager replay, and the
+    abstract-eval memo's occupancy/capacity (``eval_entries``/``eval_max`` —
+    the two caches are sized and cleared together; see :func:`clear_cache`)."""
+    ev = _eval_node_cached.cache_info()
+    return {
+        "entries": len(_TRACE_CACHE),
+        "max": _cache_max(),
+        "poisoned": len(_POISONED),
+        "eval_entries": ev.currsize,
+        "eval_max": ev.maxsize,
+        **_cache_stats,
+    }
 
 
 def clear_cache() -> None:
-    """Drop every cached fused executable and every poisoned-signature record
-    (kept traces are re-built — and previously poisoned chains re-attempted —
-    lazily)."""
+    """Drop every cached fused executable, every poisoned-signature record,
+    AND the per-node abstract-eval memo (kept traces are re-built — and
+    previously poisoned chains re-attempted — lazily). The eval memo is
+    cleared coherently with the trace LRU: the two are independent caches
+    with equal default capacity, and clearing one but not the other would
+    let stale eval entries outlive every executable they described."""
     _TRACE_CACHE.clear()
     _POISONED.clear()
+    _eval_node_cached.cache_clear()
 
 
 def _topo(root: _Node):
@@ -1996,9 +2113,16 @@ def _flush_ladder(fused, program, leaf_arrays, out_idx, donate, compiled, key, h
 
 def _build_flush(root: _Node):
     """Positional replay program of the pending subgraph under ``root``:
-    ``(topo, index_of, program, key_prog, leaf_arrays, leaf_owners,
-    internal_rc)`` — shared by :func:`materialize_for` and
-    :func:`flush_through`."""
+    ``(topo, index_of, program, key_prog, stable_prog, leaf_arrays,
+    leaf_owners, internal_rc)`` — shared by :func:`materialize_for` and
+    :func:`flush_through`.
+
+    ``stable_prog`` is the cross-process twin of ``key_prog`` the serving
+    layer keys its persistent disk cache and shape corpus on: per node
+    ``(skey, specs, kwargs, cast_key)`` with baked constants carried as
+    ``("c", type_name, value)`` instead of live type objects. It is ``None``
+    whenever any node lacks a stable identity (collective nodes close over
+    mesh/comm objects) — such programs stay in-memory-only."""
     topo = _topo(root)
     index_of = {id(n): i for i, n in enumerate(topo)}
 
@@ -2018,31 +2142,46 @@ def _build_flush(root: _Node):
 
     program = []  # (fn, specs, kwargs, cast) per node, positional
     key_prog = []
+    stable_prog = []
+    stable_ok = True
     internal_rc: dict = {}
     for n in topo:
         specs = []
         key_specs = []
+        stable_specs = []
         for a in n.args:
             if isinstance(a, _Node):
                 if a.value is not None:
                     i = leaf_index(a.value, a.owner)
                     specs.append(("l", i))
                     key_specs.append(("l", i))
+                    stable_specs.append(("l", i))
                 else:
                     internal_rc[id(a)] = internal_rc.get(id(a), 0) + 1
                     specs.append(("n", index_of[id(a)]))
                     key_specs.append(("n", index_of[id(a)]))
+                    stable_specs.append(("n", index_of[id(a)]))
             elif isinstance(a, _Leaf):
                 i = leaf_index(a.array, a.owner)
                 specs.append(("l", i))
                 key_specs.append(("l", i))
+                stable_specs.append(("l", i))
             else:
                 specs.append(("c", a))
                 key_specs.append(_const_key(a))
+                stable_specs.append(("c", type(a).__name__, a))
         program.append((n.fn, tuple(specs), dict(n.kwargs), n.cast))
         cast_key = None if n.cast is None else (str(n.cast[0]), n.cast[1])
         key_prog.append((n.op_key, tuple(key_specs), n.kwargs, cast_key))
-    return topo, index_of, program, key_prog, leaf_arrays, leaf_owners, internal_rc
+        if n.skey is None:
+            stable_ok = False
+        else:
+            stable_prog.append((n.skey, tuple(stable_specs), n.kwargs, cast_key))
+    return (
+        topo, index_of, program, key_prog,
+        tuple(stable_prog) if stable_ok else None,
+        leaf_arrays, leaf_owners, internal_rc,
+    )
 
 
 def _leaf_cache_key(leaf_arrays):
@@ -2068,9 +2207,10 @@ def materialize_for(d: DNDarray):
     if root.value is not None:
         return root.value
 
-    topo, index_of, program, key_prog, leaf_arrays, leaf_owners, internal_rc = (
-        _build_flush(root)
-    )
+    (
+        topo, index_of, program, key_prog, stable_prog,
+        leaf_arrays, leaf_owners, internal_rc,
+    ) = _build_flush(root)
 
     # Recorded collectives in the program (excluding the pure-slice halo
     # views): they gate the dispatch-site fault check, the comm.collective
@@ -2127,6 +2267,25 @@ def materialize_for(d: DNDarray):
                 del arr
             donate = tuple(donate_idx)
 
+    # ---- serving: aval bucketing (ISSUE 8). Pointwise-only programs over
+    # uniform single-device leaves may have their leaves zero-padded up to the
+    # configured bucket edges BEFORE keying, so shape-diverse traffic shares
+    # one kernel per bucket instead of one per distinct shape; the root output
+    # is sliced back to the logical shape after the ladder below (bit-parity:
+    # every surviving op is pointwise, so the pad region never influences a
+    # logical element). Env-gated: the off path costs one os.environ read.
+    bucket_slicer = None
+    bspec = os.environ.get("HEAT_TPU_SHAPE_BUCKETS", "").strip()
+    if bspec and bspec.lower() not in ("0", "false", "off") and stable_prog is not None:
+        from ..serving import buckets as _buckets
+
+        bplan = _buckets.plan(
+            bspec, stable_prog, out_idx, tuple(root.aval.shape), leaf_arrays
+        )
+        if bplan is not None:
+            leaf_arrays, bucket_slicer = bplan
+            donate = ()  # the padded copies are fresh private temporaries
+
     leaf_key = _leaf_cache_key(leaf_arrays)
     try:
         key = (tuple(key_prog), leaf_key, donate, out_idx)
@@ -2147,42 +2306,91 @@ def materialize_for(d: DNDarray):
         # circuit breaker: this signature already failed fused execution and
         # was recovered by eager replay — skip straight to eager (no compile,
         # no retry tax); the result is bit-identical by construction
-        _POISONED.move_to_end(key)
+        try:
+            _POISONED.move_to_end(key)
+        except KeyError:  # concurrent clear_cache (scheduler threads)
+            pass
         if _MON.enabled:
             _instr.fusion_flush(
-                len(topo), cache_hit=False, compiled=False, reason=_FLUSH_REASON[-1]
+                len(topo), cache_hit=False, compiled=False, reason=_reason_stack()[-1]
             )
         values = _eager_replay(program, leaf_arrays, out_idx)
     else:
+        # ---- serving: persistent L2 on L1 miss (ISSUE 8). With
+        # HEAT_TPU_CACHE_DIR set, a trace-LRU miss consults the on-disk
+        # compilation cache keyed by the process-stable twin of the LRU key
+        # plus the jaxlib/backend fingerprint; a hit deserializes the
+        # compiled executable — no XLA compile, counted as a cache hit — and
+        # a miss AOT-compiles via .lower().compile() so the executable can
+        # be serialized back to disk for every future process.
+        from_disk = False
+        digest = None
+        disk = None
+        cache_dir = ""
+        if fused is None:
+            cache_dir = os.environ.get("HEAT_TPU_CACHE_DIR", "").strip()
+        if cache_dir:
+            from ..serving import cache as disk
+
+            if stable_prog is None:
+                disk.incompatible("unstable-program")
+            else:
+                digest = disk.digest_for(stable_prog, leaf_arrays, donate, out_idx)
+                if digest is None:
+                    disk.incompatible("leaf-layout")
+                else:
+                    fused = disk.load(cache_dir, digest)
+                    from_disk = fused is not None
         compiled = fused is None
         if fused is None:
             fused = jax.jit(_replay_fn(program, out_idx), donate_argnums=donate)
-            if key is not None:
+            if digest is not None:
+                # AOT-compile now so the executable is serializable; on
+                # success the Compiled replaces the jit wrapper in L1 (same
+                # call contract, no retrace) and lands on disk + in the
+                # shape corpus for the warmup driver
+                aot = disk.store(
+                    cache_dir, digest, fused, leaf_arrays, stable_prog,
+                    donate, out_idx,
+                )
+                if aot is not None:
+                    fused = aot
+        if key is not None:
+            if compiled or from_disk:
                 _TRACE_CACHE[key] = fused
                 _cache_stats["misses"] += 1
                 limit = _cache_max()
                 while len(_TRACE_CACHE) > limit:
                     _TRACE_CACHE.popitem(last=False)
                     _cache_stats["evictions"] += 1
-        else:
-            _TRACE_CACHE.move_to_end(key)
-            _cache_stats["hits"] += 1
+            else:
+                try:
+                    _TRACE_CACHE.move_to_end(key)
+                except KeyError:  # concurrent eviction (scheduler threads)
+                    pass
+                _cache_stats["hits"] += 1
 
         if _MON.enabled:
             # NB: `compiled` counts the compile ATTEMPT — if it fails, the
             # ladder counters below carry the outcome and the broken entry is
-            # dropped from the cache
+            # dropped from the cache; a disk-cache hit is a cache hit (the
+            # executable was deserialized, never compiled)
             _instr.fusion_flush(
                 len(topo),
                 cache_hit=not compiled,
                 compiled=compiled,
-                reason=_FLUSH_REASON[-1],
+                reason=_reason_stack()[-1],
             )
 
         values = _flush_ladder(
             fused, program, leaf_arrays, out_idx, donate, compiled, key,
             has_coll=bool(coll_kinds),
         )
+
+    if bucket_slicer is not None:
+        # restore the logical view from the bucket-padded root output (the
+        # plan admits single-output pointwise programs only)
+        values = (values[0][bucket_slicer],)
 
     # canonical placement — the step DNDarray.__init__ applies to every eager
     # intermediate, applied once per fused output here (the root places on
@@ -2225,7 +2433,9 @@ def flush_through(x: DNDarray, consumer, consumer_key, reason: str = "linalg"):
     if root is None or root.value is not None:
         return None
 
-    topo, index_of, program, key_prog, leaf_arrays, _owners, _rc = _build_flush(root)
+    topo, index_of, program, key_prog, _stable, leaf_arrays, _owners, _rc = (
+        _build_flush(root)
+    )
     ridx = index_of[id(root)]
     chain_replay = _replay_fn(program, (ridx,))
 

@@ -34,6 +34,13 @@ naming stays consistent:
   ``io.retries{site}``, ``checkpoint.ops{write,restore,corrupt-skipped,
   orphan-cleaned,preemption-save}``, ``preemption.requests{signame}``, and
   ``faults.injected{site}`` for the deterministic injection framework;
+* serving-runtime counters (``heat_tpu.serving``): ``serving.disk_cache``
+  {hit,miss,write,incompatible,corrupt} for the persistent L2 compilation
+  cache, ``serving.bucket`` {hit,pad_waste_bytes} for the aval-bucketing
+  policy, ``serving.corpus`` {recorded,full,corrupt} and ``serving.warmup``
+  {compiled,cached,skipped,error} for the shape corpus + AOT warmup driver,
+  plus the ``serving.dispatch_latency`` histogram for the async flush
+  scheduler;
 * per-step spans for the algorithm/train loops (kmeans, lasso, data-parallel,
   DASO) via :func:`step_event` and ``events.span``.
 """
@@ -61,6 +68,11 @@ __all__ = [
     "fusion_flush_recovered",
     "fusion_poisoned",
     "fusion_elided_write",
+    "serving_disk_cache",
+    "serving_bucket",
+    "serving_corpus",
+    "serving_warmup",
+    "serving_dispatch",
     "record_io",
     "io_retry",
     "checkpoint_op",
@@ -205,6 +217,49 @@ def fusion_elided_write() -> None:
     """One unflushed expression dropped by an overwrite (``out=`` aliasing):
     deferred work that never had to execute."""
     REGISTRY.counter("fusion.elided_writes").inc()
+
+
+#: serving.dispatch_latency buckets: 1-2-5 log steps from 1 µs to 10 s —
+#: dispatch latencies need finer resolution than the decade-wide defaults
+#: for the p50/p99 interpolation in ``report.telemetry()`` to mean anything.
+_DISPATCH_BOUNDS = tuple(m * 10.0**e for e in range(-6, 1) for m in (1, 2, 5)) + (10.0,)
+
+
+def serving_disk_cache(kind: str) -> None:
+    """One persistent-compilation-cache (L2) event (kind: hit — executable
+    deserialized from disk, no compile; miss — no entry; write — freshly
+    compiled executable serialized and stored; incompatible — program not
+    cross-process keyable / fingerprint mismatch / serialization unsupported;
+    corrupt — an entry existed but could not be read, recompiled)."""
+    REGISTRY.counter("serving.disk_cache").inc(label=kind)
+
+
+def serving_bucket(pad_waste_bytes: int) -> None:
+    """One flush keyed through an aval-bucketed shape: label ``hit`` counts
+    the flush, label ``pad_waste_bytes`` accumulates the pad bytes appended
+    across its leaves (the cost side of the bounded-kernel-count tradeoff)."""
+    c = REGISTRY.counter("serving.bucket")
+    c.inc(label="hit")
+    if pad_waste_bytes:
+        c.inc(int(pad_waste_bytes), label="pad_waste_bytes")
+
+
+def serving_corpus(kind: str) -> None:
+    """One shape-corpus event (kind: recorded / full — bound hit, entry not
+    recorded / corrupt — unreadable entry skipped during iteration)."""
+    REGISTRY.counter("serving.corpus").inc(label=kind)
+
+
+def serving_warmup(kind: str) -> None:
+    """One corpus entry processed by the AOT warmup driver (kind: compiled /
+    cached — executable already in the warmed cache / skipped — foreign
+    fingerprint or not rebuildable / error)."""
+    REGISTRY.counter("serving.warmup").inc(label=kind)
+
+
+def serving_dispatch(seconds: float) -> None:
+    """One scheduled flush's submit-to-materialized latency."""
+    REGISTRY.histogram("serving.dispatch_latency", _DISPATCH_BOUNDS).observe(seconds)
 
 
 def record_io(op: str, path: str, nbytes: int, seconds: float) -> None:

@@ -125,6 +125,12 @@ def telemetry() -> dict:
         ("fusion.ops_deferred", "fusion_ops_deferred"),
         ("fusion.view_fallbacks", "fusion_view_fallbacks"),
         ("fusion.collective_fallbacks", "fusion_collective_fallbacks"),
+        # serving-runtime breakdowns (ISSUE 8): disk-cache hit/miss/write
+        # traffic, bucket hits + pad waste, corpus/warmup outcomes
+        ("serving.disk_cache", "serving_disk_cache"),
+        ("serving.bucket", "serving_bucket"),
+        ("serving.corpus", "serving_corpus"),
+        ("serving.warmup", "serving_warmup"),
         # graceful-degradation breakdowns (ISSUE 6): which failure classes the
         # flush ladder absorbed, which writer paths retried, what the
         # checkpoint subsystem did, and which fault sites actually fired
@@ -145,6 +151,37 @@ def telemetry() -> dict:
         val = counters.get(name)
         if val:
             out[key] = val
+    # trace-cache occupancy + hit/miss/eviction + poisoned count (ISSUE 8
+    # satellite: cache_info() was not exported, so the serving SLO had no
+    # denominator) and the cache-hit-rate SLO itself: L1 = in-process trace
+    # LRU hits, L2 = persistent disk-cache hits, lookups = L1 hits + L1
+    # misses (every flush that consulted the cache)
+    try:
+        from ..core import fusion as _fusion
+
+        ci = _fusion.cache_info()
+        out["fusion_trace_cache"] = dict(ci)
+        disk = snap["metrics"]["counters"].get("serving.disk_cache")
+        l2_hits = disk["labels"].get("hit", 0) if isinstance(disk, dict) else 0
+        lookups = ci["hits"] + ci["misses"]
+        out["serving_cache_slo"] = {
+            "l1_hits": ci["hits"],
+            "l2_hits": l2_hits,
+            # registry.reset() clears the disk counter but not the fusion
+            # stats, so clamp the true-cold-compile estimate at zero
+            "misses": max(0, ci["misses"] - l2_hits),
+            "evictions": ci["evictions"],
+            "hit_rate": round((ci["hits"] + l2_hits) / lookups, 4) if lookups else None,
+        }
+    except Exception:  # core not importable / partially initialized
+        pass
+    lat = snap["metrics"]["histograms"].get("serving.dispatch_latency")
+    if lat and lat["count"]:
+        out["serving_dispatch_latency"] = {
+            "count": lat["count"],
+            "p50_us": round(_hist_quantile(lat, 0.50) * 1e6, 1),
+            "p99_us": round(_hist_quantile(lat, 0.99) * 1e6, 1),
+        }
     mem = {k: v for k, v in snap["metrics"]["gauges"].items() if k.startswith("memory.")}
     if mem:
         out["memory"] = mem
@@ -152,3 +189,22 @@ def telemetry() -> dict:
     if comp and comp["count"]:
         out["jit_compile_seconds_total"] = round(comp["sum"], 3)
     return out
+
+
+def _hist_quantile(h: dict, q: float) -> float:
+    """Quantile estimate from a bucketed histogram snapshot: linear
+    interpolation inside the bucket the target rank lands in (the overflow
+    bucket reports its lower bound — an under-estimate, flagged by the bench
+    anchors which compute exact sample percentiles instead)."""
+    target = q * h["count"]
+    bounds = h["buckets"]
+    cum = 0.0
+    lo = 0.0
+    for i, b in enumerate(bounds):
+        c = h["counts"][i]
+        if cum + c >= target and c > 0:
+            frac = (target - cum) / c
+            return lo + frac * (b - lo)
+        cum += c
+        lo = b
+    return float(bounds[-1]) if bounds else 0.0

@@ -26,6 +26,10 @@ Sites (the catalog is shared with ``doc/robustness_notes.md``):
                           (``utils/checkpoint.py``)
 ``collective.dispatch``   one explicit collective shim dispatch
                           (``core/communication.py``)
+``serving.cache_read``    one persistent-compilation-cache read attempt
+                          (``serving/cache.py`` — a planned fault falls back
+                          to a fresh compile, counted
+                          ``serving.disk_cache{corrupt}``)
 ========================  =====================================================
 
 Plans are installed programmatically::
@@ -89,6 +93,7 @@ SITES = (
     "io.read",
     "checkpoint.write",
     "collective.dispatch",
+    "serving.cache_read",
 )
 
 ENV_VAR = "HEAT_TPU_FAULT_PLAN"

@@ -1,0 +1,53 @@
+"""
+AOT serving runtime: from trace cache to traffic (ROADMAP item 3, ISSUE 8).
+
+The fusion engine (PRs 3–7) makes the kernel *set* of a workload small and
+replayable; this package makes that set **persistent, bounded and
+pre-compiled** so a serving process can face shape-diverse traffic from a
+cold start without paying a single JIT:
+
+* :mod:`~heat_tpu.serving.cache` — persistent on-disk compilation cache
+  (L2 under the in-process trace LRU), shared across processes via
+  ``HEAT_TPU_CACHE_DIR``; compiled fused programs are serialized through
+  ``jax.jit(...).lower().compile()`` + ``jax.experimental
+  .serialize_executable`` and keyed by the process-stable twin of the trace
+  LRU key plus the jax/jaxlib/backend fingerprint.
+* :mod:`~heat_tpu.serving.buckets` — aval-bucketing policy
+  (``HEAT_TPU_SHAPE_BUCKETS``): leaf dims of eligible pointwise programs
+  round up to configured bucket edges before keying (zero-pad in, logical
+  slice out — bit-identical), bounding distinct kernels under shape-diverse
+  traffic.
+* :mod:`~heat_tpu.serving.corpus` — bounded on-disk shape corpus: every
+  compiled kernel's rebuild recipe, appended at flush time.
+* :mod:`~heat_tpu.serving.warmup` — :func:`warmup` + ``python -m
+  heat_tpu.serving.warmup``: AOT-compiles the corpus into the persistent
+  cache at startup (zero cold compiles once warmed).
+* :mod:`~heat_tpu.serving.scheduler` — async flush scheduler
+  (:func:`schedule` / :func:`flush_all`, and
+  ``DNDarray.flush_async()``): device dispatch of one flush overlaps the
+  host-side trace/key work of the next.
+
+Everything is env-gated and inert by default: with no ``HEAT_TPU_CACHE_DIR``
+and no ``HEAT_TPU_SHAPE_BUCKETS`` the flush path is byte-for-byte the PR 7
+behavior (the cold-dir CI leg proves it). Counters: ``serving.disk_cache``
+{hit,miss,write,incompatible,corrupt}, ``serving.bucket``
+{hit,pad_waste_bytes}, ``serving.corpus`` {recorded,full,corrupt},
+``serving.warmup`` {compiled,cached,skipped,error}, and the
+``serving.dispatch_latency`` histogram — all surfaced (with the cache-hit
+SLO) in ``report.telemetry()``. See ``doc/serving_notes.md``.
+"""
+
+from . import buckets, cache, corpus, scheduler
+from .scheduler import FlushScheduler, flush_all, schedule
+from .warmup import warmup
+
+__all__ = [
+    "buckets",
+    "cache",
+    "corpus",
+    "scheduler",
+    "FlushScheduler",
+    "flush_all",
+    "schedule",
+    "warmup",
+]

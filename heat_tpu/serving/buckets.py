@@ -1,0 +1,179 @@
+"""
+Aval-bucketing policy: bound distinct fused kernels under shape-diverse
+traffic.
+
+A serving process sees arbitrary request shapes, and the trace LRU keys on
+exact leaf avals — one kernel (and one cold XLA compile) per distinct shape.
+With ``HEAT_TPU_SHAPE_BUCKETS`` set to a policy, eligible flush programs
+round every leaf dimension up to the nearest configured *bucket edge* before
+keying: the leaves are zero-padded to the bucketed shape (riding the same
+pad-and-slice machinery the canonical ragged layout uses), the kernel is
+compiled/cached/persisted under the bucketed avals, and the root output is
+sliced back to the logical shape after the flush. Shape-diverse traffic then
+shares one kernel per bucket, trading bounded pad FLOPs/bytes (counted
+``serving.bucket{pad_waste_bytes}``) for an O(log shape-space) kernel count.
+
+**Bit parity.** Only programs whose every node is *pointwise* (binary /
+local / where / where-glue / cast — each output element a function of the
+same-position input elements only) over uniform single-device leaves are
+eligible, so the pad region can never influence a logical element and the
+sliced result is bit-identical to the exact-shape kernel. Reductions, views,
+GEMMs, collectives, multi-output flushes, and distributed/padded operands
+all take the exact path unchanged. ``HEAT_TPU_SHAPE_BUCKETS=0`` (or unset)
+disables bucketing entirely — the bit-parity escape hatch in the PR 3–7
+discipline (here the *whole feature* is opt-in: padding below the serving
+layer is a throughput tradeoff a NumPy library must not impose by default).
+
+**Policy syntax** (parsed once per env-string value, monkeypatch-friendly):
+
+* ``pow2`` — powers of two up to 1024, then a linear tail of 1024 multiples
+  (the recommended serving default);
+* ``pow2:N`` — powers of two up to N, then multiples of N;
+* ``8,64,512`` — explicit ascending edges; dimensions above the last edge
+  round up to a multiple of it (the linear tail).
+
+Counters: ``serving.bucket{hit}`` — a flush keyed through the bucketed
+shape; ``serving.bucket{pad_waste_bytes}`` — bytes of pad appended across
+its leaves.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..monitoring import instrument as _instr
+from ..monitoring.registry import STATE as _MON
+
+__all__ = ["policy", "bucket_dim", "bucket_shape", "plan"]
+
+#: Node kinds (skey tags) whose recorded op is pointwise: the pad region of a
+#: bucketed operand flows through without touching any logical element.
+_POINTWISE_TAGS = frozenset(("binary", "local", "where", "where_glue", "cast"))
+
+_parse_cache: dict = {}
+
+
+def policy(spec: str) -> Optional[Tuple[Tuple[int, ...], int]]:
+    """Parse a ``HEAT_TPU_SHAPE_BUCKETS`` value into ``(edges, tail)``, or
+    None when bucketing is off (``''``/``0``/``false``/``off``). Malformed
+    specs raise ``ValueError`` — a config error, never silently ignored."""
+    cached = _parse_cache.get(spec)
+    if cached is not None:
+        return cached if cached != () else None
+    s = spec.strip().lower()
+    if s in ("", "0", "false", "off"):
+        _parse_cache[spec] = ()
+        return None
+    if s.startswith("pow2"):
+        if s == "pow2" or s == "pow2:":
+            top = 1024
+        else:
+            if not s.startswith("pow2:"):
+                raise ValueError(f"malformed HEAT_TPU_SHAPE_BUCKETS policy {spec!r}")
+            try:
+                top = int(s.split(":", 1)[1])
+            except ValueError:
+                raise ValueError(
+                    f"malformed HEAT_TPU_SHAPE_BUCKETS policy {spec!r}"
+                ) from None
+        if top < 1:
+            raise ValueError(f"HEAT_TPU_SHAPE_BUCKETS pow2 bound must be >=1: {spec!r}")
+        edges = tuple(2**e for e in range(0, int(math.log2(top)) + 1) if 2**e <= top)
+        parsed = (edges, edges[-1])
+    else:
+        try:
+            edges = tuple(int(t) for t in s.split(","))
+        except ValueError:
+            raise ValueError(
+                f"malformed HEAT_TPU_SHAPE_BUCKETS policy {spec!r}"
+            ) from None
+        if not edges or any(e < 1 for e in edges) or list(edges) != sorted(set(edges)):
+            raise ValueError(
+                f"HEAT_TPU_SHAPE_BUCKETS edges must be ascending positive ints: {spec!r}"
+            )
+        parsed = (edges, edges[-1])
+    _parse_cache[spec] = parsed
+    return parsed
+
+
+def bucket_dim(d: int, edges: Tuple[int, ...], tail: int) -> int:
+    """The smallest bucket edge >= ``d`` (linear ``tail`` multiples above the
+    last edge). Zero-extent dims stay zero."""
+    if d <= 0:
+        return d
+    for e in edges:
+        if d <= e:
+            return e
+    return ((d + tail - 1) // tail) * tail
+
+
+def bucket_shape(shape, edges, tail) -> Tuple[int, ...]:
+    return tuple(bucket_dim(int(d), edges, tail) for d in shape)
+
+
+def plan(spec: str, stable_prog, out_idx, root_shape, leaf_arrays):
+    """Bucketing plan for one flush, or None to key on exact shapes.
+
+    Eligibility (all checked here, nothing assumed by the caller):
+    * a parseable, enabled policy;
+    * a single-output program whose every node is pointwise;
+    * every non-scalar leaf shares the root's (physical == logical) shape —
+      uniform pointwise broadcast-free programs only — and lives on a single
+      device (padding a sharded operand eagerly would reshard it);
+    * scalar (0-d) leaves ride unchanged.
+
+    Returns ``(new_leaf_arrays, slicer)`` — the zero-padded leaves to key,
+    compile and execute on, and the index restoring the logical root view —
+    or None. Counts ``serving.bucket{hit}`` for every flush keyed through a
+    bucketed shape and ``{pad_waste_bytes}`` for the pad bytes appended."""
+    parsed = policy(spec)
+    if parsed is None:
+        return None
+    if len(out_idx) != 1 or stable_prog is None:
+        return None
+    for skey, _specs, _kw, _cast in stable_prog:
+        if skey[0] not in _POINTWISE_TAGS:
+            return None
+    root_shape = tuple(int(d) for d in root_shape)
+    if not root_shape:
+        return None  # 0-d result: nothing to bucket
+    from jax.sharding import SingleDeviceSharding
+
+    for a in leaf_arrays:
+        if a.shape != () and tuple(a.shape) != root_shape:
+            return None
+        if not isinstance(getattr(a, "sharding", None), SingleDeviceSharding):
+            return None
+    edges, tail = parsed
+    bshape = bucket_shape(root_shape, edges, tail)
+    if bshape == root_shape:
+        # already on a bucket edge: the exact key IS the bucketed key —
+        # traffic with this shape shares the bucket kernel by construction
+        if _MON.enabled:
+            _instr.serving_bucket(0)
+        return None
+    widths = tuple((0, b - s) for b, s in zip(bshape, root_shape))
+    new_leaves = []
+    waste = 0
+    for a in leaf_arrays:
+        if a.shape == ():
+            new_leaves.append(a)
+            continue
+        new_leaves.append(jnp.pad(a, widths))
+        waste += (
+            int(np_prod(bshape)) - int(np_prod(root_shape))
+        ) * a.dtype.itemsize
+    if _MON.enabled:
+        _instr.serving_bucket(waste)
+    slicer = tuple(slice(0, s) for s in root_shape)
+    return new_leaves, slicer
+
+
+def np_prod(shape) -> int:
+    p = 1
+    for d in shape:
+        p *= int(d)
+    return p

@@ -1,0 +1,341 @@
+"""
+Persistent on-disk compilation cache (L2) for fused flush programs.
+
+The in-process trace LRU (``core/fusion.py``) is L1: it maps a structural
+``(program, leaf avals, shardings, donation mask, outputs)`` key to a live
+executable, and dies with the process — a restart pays every XLA compile
+again (the first TPU compile in a process costs ~460s of XLA init, PR 3
+notes). This module adds L2: on an L1 miss the flush path consults a
+directory shared across processes (``HEAT_TPU_CACHE_DIR``), keyed by a
+*digest* of the cross-process-stable twin of the LRU key
+(:data:`~heat_tpu.core.fusion._Node.skey` per node — op names and static
+parameters, no object ids) plus the jax/jaxlib/backend *fingerprint*. A hit
+deserializes the compiled executable via
+``jax.experimental.serialize_executable`` — no XLA compile happens; a miss
+compiles through the AOT path (``jax.jit(...).lower(*leaves).compile()``) so
+the executable can be serialized back for every future process, and appends
+the program's rebuild recipe to the shape corpus (``corpus.py``) for the
+warmup driver.
+
+Robustness discipline (PR 6): every read consults the
+``serving.cache_read`` fault-injection site, and a corrupt / truncated /
+fingerprint-mismatched entry is *counted* (``serving.disk_cache{corrupt}`` /
+``{incompatible}``) and falls back to a fresh compile — the cache can never
+crash a flush. Writes are atomic (same-directory tempfile + ``os.replace``),
+so a process killed mid-write never leaves a truncated entry behind.
+
+Counters (``serving.disk_cache``): ``hit`` (entry deserialized and used),
+``miss`` (no entry on disk), ``write`` (entry serialized and stored),
+``incompatible`` (program has no stable identity, a leaf layout is not
+describable, the backend fingerprint changed, or serialization is
+unsupported), ``corrupt`` (an on-disk entry existed but could not be read).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+from ..monitoring import instrument as _instr
+from ..monitoring.registry import STATE as _MON
+from ..robustness import faultinject as _FI
+
+__all__ = [
+    "enabled",
+    "cache_dir",
+    "fingerprint",
+    "digest_for",
+    "load",
+    "store",
+    "persist",
+    "entry_path",
+]
+
+#: On-disk entry format version: bumped whenever the pickled layout changes.
+_FORMAT = 1
+
+#: Pickle protocol pinned for the *stored* entries (identity never depends on
+#: pickle bytes — digests go through the canonical serializer below).
+_PICKLE_PROTOCOL = 4
+
+
+def enabled() -> bool:
+    """Whether the persistent disk cache is active (``HEAT_TPU_CACHE_DIR``
+    set to a directory path; read per flush, so tests and mid-process
+    reconfiguration work without restarts)."""
+    return bool(cache_dir())
+
+
+def cache_dir() -> str:
+    """The configured cache directory ('' when disabled)."""
+    return os.environ.get("HEAT_TPU_CACHE_DIR", "").strip()
+
+
+_fingerprint_cache = None
+
+
+def fingerprint() -> tuple:
+    """Process-stable identity of the compiler stack a serialized executable
+    is only valid for: jax + jaxlib versions, backend platform and platform
+    version. Part of every digest AND stored in every entry (defense in
+    depth: a digest collision across toolchains still fails the explicit
+    check and recompiles, counted ``incompatible``)."""
+    global _fingerprint_cache
+    if _fingerprint_cache is None:
+        import jax
+        import jaxlib
+
+        try:
+            from jax.extend.backend import get_backend
+        except Exception:  # pragma: no cover — older jax
+            from jax.lib.xla_bridge import get_backend
+        backend = get_backend()
+        _fingerprint_cache = (
+            jax.__version__,
+            jaxlib.__version__,
+            backend.platform,
+            str(getattr(backend, "platform_version", "")),
+        )
+    return _fingerprint_cache
+
+
+# ------------------------------------------------------------------ digests
+#
+# The digest is a sha256 over a CANONICAL byte serialization of the stable
+# key — not over pickle bytes: pickle memoizes shared objects, so two
+# processes building value-equal but differently-shared tuples would produce
+# different payloads for the same logical key. The canonical form is
+# sharing-insensitive and type-explicit (floats by hex, numpy scalars by
+# dtype+hex), and refuses anything it does not recognize (the flush then
+# counts ``incompatible`` and stays in-memory-only).
+
+
+class _Unstable(Exception):
+    """A key component has no canonical cross-process form."""
+
+
+def _canon(x, out: list) -> None:
+    if x is None or x is True or x is False:
+        out.append(repr(x))
+    elif isinstance(x, str):
+        out.append("s%d:%s" % (len(x), x))
+    elif isinstance(x, int) and not isinstance(x, bool):
+        out.append("i%d" % x)
+    elif isinstance(x, float):
+        out.append("f" + float.hex(x))
+    elif isinstance(x, complex):
+        out.append("c" + float.hex(x.real) + "," + float.hex(x.imag))
+    elif isinstance(x, (np.number, np.bool_)):
+        out.append("n%s:%s" % (x.dtype.str, float.hex(float(np.real(x)))))
+    elif isinstance(x, (tuple, list)):
+        out.append("(")
+        for v in x:
+            _canon(v, out)
+            out.append(",")
+        out.append(")")
+    else:
+        raise _Unstable(type(x).__name__)
+
+
+def _leaf_desc(arr):
+    """Cross-process description of one leaf: shape, dtype, weak-type flag,
+    and sharding. Single-device and NamedSharding layouts are describable;
+    anything else marks the program incompatible."""
+    from jax.sharding import NamedSharding, SingleDeviceSharding
+
+    s = getattr(arr, "sharding", None)
+    if isinstance(s, SingleDeviceSharding):
+        d = next(iter(s.device_set))
+        sd = ("single", d.platform, int(d.id), str(getattr(s, "memory_kind", None)))
+    elif isinstance(s, NamedSharding):
+        m = s.mesh
+        sd = (
+            "named",
+            tuple(str(a) for a in m.axis_names),
+            tuple(int(v) for v in m.devices.shape),
+            tuple((d.platform, int(d.id)) for d in m.devices.flat),
+            str(s.spec),
+            str(getattr(s, "memory_kind", None)),
+        )
+    elif s is None:  # raw numpy leaf (never happens today; describe plainly)
+        sd = ("host",)
+    else:
+        return None
+    return (
+        tuple(int(v) for v in arr.shape),
+        str(arr.dtype),
+        bool(getattr(arr, "weak_type", False)),
+        sd,
+    )
+
+
+def leaf_descs(leaf_arrays) -> Optional[tuple]:
+    """Leaf descriptors for every leaf, or None when any layout is not
+    cross-process describable."""
+    descs = []
+    for a in leaf_arrays:
+        d = _leaf_desc(a)
+        if d is None:
+            return None
+        descs.append(d)
+    return tuple(descs)
+
+
+def digest_for(stable_prog, leaf_arrays, donate, out_idx) -> Optional[str]:
+    """The disk-cache key for one flush program: sha256 of the canonical
+    serialization of (format, fingerprint, stable program, leaf descriptors,
+    donation mask, output indices). None when not describable."""
+    descs = leaf_descs(leaf_arrays)
+    if descs is None:
+        return None
+    out: list = []
+    try:
+        _canon((_FORMAT, fingerprint(), stable_prog, descs, donate, out_idx), out)
+    except _Unstable:
+        return None
+    return hashlib.sha256("".join(out).encode()).hexdigest()
+
+
+# ------------------------------------------------------------------ entries
+def entry_path(cache_dir_: str, digest: str) -> str:
+    return os.path.join(cache_dir_, "exec", digest + ".bin")
+
+
+def _count(kind: str) -> None:
+    if _MON.enabled:
+        _instr.serving_disk_cache(kind)
+
+
+def incompatible(_why: str = "") -> None:
+    """Count a flush whose program cannot use the disk cache (no stable
+    identity / leaf layout not describable). The flush proceeds in-memory."""
+    _count("incompatible")
+
+
+def load(cache_dir_: str, digest: str):
+    """Deserialize the cached executable for ``digest``, or None.
+
+    Never raises (beyond a malformed fault *plan*): a missing entry counts
+    ``miss``, a fingerprint/format mismatch counts ``incompatible``, and any
+    other failure — truncated file, pickle garbage, an injected
+    ``serving.cache_read`` fault, a deserialization error — counts
+    ``corrupt``; every non-hit falls back to a fresh compile."""
+    path = entry_path(cache_dir_, digest)
+    try:
+        _FI.check("serving.cache_read")
+        with open(path, "rb") as f:
+            entry = pickle.load(f)
+        if entry.get("format") != _FORMAT or entry.get("fp") != fingerprint():
+            _count("incompatible")
+            return None
+        from jax.experimental.serialize_executable import deserialize_and_load
+
+        loaded = deserialize_and_load(
+            entry["payload"], entry["in_tree"], entry["out_tree"]
+        )
+        _count("hit")
+        return loaded
+    except FileNotFoundError:
+        _count("miss")
+        return None
+    except (KeyboardInterrupt, SystemExit, _FI.FaultPlanError):
+        raise
+    except Exception:
+        _count("corrupt")
+        return None
+
+
+def _atomic_write(path: str, blob: bytes) -> None:
+    """Same-directory tempfile + ``os.replace``: a concurrent reader sees the
+    old entry or the new one, never a torn write (the PR 6 atomic-IO rule)."""
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(path), prefix=".tmp-", suffix=".bin"
+    )
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def persist(cache_dir_: str, digest: str, compiled) -> bool:
+    """Serialize one ``Compiled`` into the cache under ``digest`` (atomic,
+    counted ``write``). Returns False — counted ``incompatible`` — when the
+    backend cannot serialize the executable; never raises."""
+    try:
+        from jax.experimental.serialize_executable import serialize
+
+        payload, in_tree, out_tree = serialize(compiled)
+        blob = pickle.dumps(
+            {
+                "format": _FORMAT,
+                "fp": fingerprint(),
+                "payload": payload,
+                "in_tree": in_tree,
+                "out_tree": out_tree,
+            },
+            protocol=_PICKLE_PROTOCOL,
+        )
+        _atomic_write(entry_path(cache_dir_, digest), blob)
+        _count("write")
+        return True
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        _count("incompatible")
+        return False
+
+
+def store(
+    cache_dir_: str, digest: str, jitted, leaf_arrays, stable_prog, donate, out_idx
+):
+    """AOT-compile ``jitted`` for the concrete ``leaf_arrays`` via
+    ``.lower().compile()``, serialize the executable into the cache under
+    ``digest``, and append the program's rebuild recipe to the shape corpus.
+
+    Returns the ``Compiled`` (same call contract as the jit wrapper, minus
+    retracing) so the flush can execute and L1-cache it, or None when the
+    AOT path failed — the caller then falls back to the plain jit wrapper
+    and the flush stays in-memory-only (counted ``incompatible``)."""
+    try:
+        compiled = jitted.lower(*leaf_arrays).compile()
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        _count("incompatible")
+        return None
+    if not persist(cache_dir_, digest, compiled):
+        # the executable is fine, only persistence failed: serve this flush
+        # from the AOT compile and leave L2 for a future attempt
+        return compiled
+    try:
+        from . import corpus as _corpus
+
+        _corpus.record(
+            cache_dir_,
+            digest,
+            {
+                "format": _FORMAT,
+                "fp": fingerprint(),
+                "stable_prog": stable_prog,
+                "leaf_descs": leaf_descs(leaf_arrays),
+                "donate": tuple(donate),
+                "out_idx": tuple(out_idx),
+            },
+        )
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        pass  # corpus recording is best-effort; the cache entry is live
+    return compiled

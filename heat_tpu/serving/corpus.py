@@ -1,0 +1,130 @@
+"""
+Bounded on-disk shape corpus: the record of which fused kernels a serving
+workload actually compiled.
+
+Every L2 store (``cache.py``) appends the program's *rebuild recipe* — the
+stable program (per-node ``skey`` + positional arg specs), the leaf aval /
+sharding descriptors, the donation mask and output indices — keyed by the
+same digest as the executable entry. The corpus is what makes ahead-of-time
+warmup possible: a fresh process (or a fresh machine with the same
+jax/jaxlib/backend fingerprint) can rebuild the exact callables from
+``core/fusion.py``'s memoized factories and AOT-compile every recorded
+kernel into the persistent cache *before traffic arrives*
+(:func:`heat_tpu.serving.warmup.warmup`).
+
+Layout: one pickle file per kernel under ``<corpus>/<digest>.pkl`` —
+append == write-if-absent, dedup is structural (the digest), and the bound
+is a simple file count (``HEAT_TPU_SHAPE_CORPUS_MAX``, default 4096 — the
+trace LRU's default size; a corpus bigger than the L1 would warm kernels
+the process immediately evicts). ``HEAT_TPU_SHAPE_CORPUS`` overrides the
+location (default ``$HEAT_TPU_CACHE_DIR/corpus``) or disables recording
+(``0``). Corrupt entries are skipped and counted, never raised
+(``serving.corpus{corrupt}``).
+
+Counters (``serving.corpus``): ``recorded``, ``full`` (bound hit — entry not
+recorded), ``corrupt`` (unreadable entry skipped during iteration).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+from typing import Iterator, Optional, Tuple
+
+from ..monitoring import instrument as _instr
+from ..monitoring.registry import STATE as _MON
+
+__all__ = ["corpus_dir", "record", "entries", "size"]
+
+_PICKLE_PROTOCOL = 4
+
+#: Digests known recorded by THIS process: skips the listdir/exists probe on
+#: the steady-state path (one set lookup per repeat kernel).
+_seen: set = set()
+
+
+def _max_entries() -> int:
+    try:
+        return int(os.environ.get("HEAT_TPU_SHAPE_CORPUS_MAX", "4096"))
+    except ValueError:
+        return 4096
+
+
+def corpus_dir(cache_dir: str) -> Optional[str]:
+    """The corpus location for ``cache_dir`` — ``HEAT_TPU_SHAPE_CORPUS``
+    override, ``0``/``false``/``off`` disabling, default
+    ``<cache_dir>/corpus``. None when recording is disabled."""
+    spec = os.environ.get("HEAT_TPU_SHAPE_CORPUS", "").strip()
+    if spec.lower() in ("0", "false", "off"):
+        return None
+    if spec:
+        return spec
+    if not cache_dir:
+        return None
+    return os.path.join(cache_dir, "corpus")
+
+
+def _count(kind: str) -> None:
+    if _MON.enabled:
+        _instr.serving_corpus(kind)
+
+
+def size(path: str) -> int:
+    try:
+        return sum(1 for n in os.listdir(path) if n.endswith(".pkl"))
+    except OSError:
+        return 0
+
+
+def record(cache_dir: str, digest: str, entry: dict) -> bool:
+    """Write one rebuild recipe (idempotent per digest, bounded, atomic).
+    Returns whether the entry is on disk after the call."""
+    d = corpus_dir(cache_dir)
+    if d is None:
+        return False
+    path = os.path.join(d, digest + ".pkl")
+    if digest in _seen or os.path.exists(path):
+        _seen.add(digest)
+        return True
+    if size(d) >= _max_entries():
+        _count("full")
+        return False
+    os.makedirs(d, exist_ok=True)
+    blob = pickle.dumps(entry, protocol=_PICKLE_PROTOCOL)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp-", suffix=".pkl")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _seen.add(digest)
+    _count("recorded")
+    return True
+
+
+def entries(path: str) -> Iterator[Tuple[str, dict]]:
+    """Iterate ``(digest, recipe)`` over a corpus directory, skipping (and
+    counting) unreadable entries — a half-written or bit-flipped file can
+    never break a warmup run."""
+    try:
+        names = sorted(n for n in os.listdir(path) if n.endswith(".pkl"))
+    except OSError:
+        return
+    for name in names:
+        try:
+            with open(os.path.join(path, name), "rb") as f:
+                entry = pickle.load(f)
+            if not isinstance(entry, dict):
+                raise ValueError("corpus entry is not a dict")
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception:
+            _count("corrupt")
+            continue
+        yield name[: -len(".pkl")], entry

@@ -1,0 +1,241 @@
+"""
+AOT warmup driver: compile a recorded shape corpus into the persistent cache
+before traffic arrives.
+
+``warmup(corpus, cache_dir)`` iterates the corpus recorded by
+``corpus.py``, rebuilds each fused program from its stable recipe — every
+node's ``skey`` names one of ``core/fusion.py``'s memoized callable
+factories (jnp whitelisted ops, where-glue, casts, views, GEMM producers,
+reduction sinks), so the rebuilt callable is the *same object* the live
+flush path would use — and AOT-compiles it for the recorded leaf avals via
+``jax.jit(...).lower(*avals).compile()``, serializing the executable into
+the disk cache under the recipe's digest. A serving process started against
+the warmed directory then takes **zero cold compiles**: every flush lands as
+an L1 miss → L2 hit → deserialized executable
+(``fusion.kernels_compiled == 0`` — the cold-restart acceptance bar, proven
+by ``tests/test_serving.py`` and the ``cold_restart_compiles`` bench
+anchor).
+
+Entries it cannot rebuild are *skipped, never fatal*: a fingerprint from
+another toolchain, a sharded (NamedSharding) leaf layout (the executable is
+still L2-served once some process compiles it — only the cross-process
+rebuild needs single-device avals today), an op name this jax build lacks.
+Each outcome is counted (``serving.warmup{compiled,cached,skipped,error}``)
+and returned in the stats dict.
+
+CLI::
+
+    python -m heat_tpu.serving.warmup [--cache-dir DIR] [--corpus DIR] [-q]
+
+prints the stats as one JSON line — the startup hook a serving deployment
+runs before opening the request port.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+import numpy as np
+
+from ..monitoring import instrument as _instr
+from ..monitoring.registry import STATE as _MON
+
+__all__ = ["warmup", "main"]
+
+
+class _Unbuildable(Exception):
+    """The recipe references something this process cannot reconstruct."""
+
+
+def _resolve_op(name: str):
+    import jax.numpy as jnp
+
+    op = getattr(jnp, name, None)
+    if op is None:
+        op = getattr(jnp.linalg, name, None)
+    if op is None:
+        raise _Unbuildable(f"unknown op {name!r}")
+    return op
+
+
+def _node_fn(skey):
+    """The exact callable a live defer site would have recorded for ``skey``
+    (fusion's memoized factories guarantee object identity per signature)."""
+    import jax.numpy as jnp
+
+    from ..core import fusion as F
+
+    tag = skey[0]
+    if tag == "binary":
+        _, name, _kw, _cast = skey
+        op = _resolve_op(name)
+        if op not in F.ELEMENTWISE_BINARY:
+            raise _Unbuildable(f"{name!r} not in the binary whitelist")
+        return op
+    if tag == "local":
+        _, name, _kw = skey
+        op = _resolve_op(name)
+        if op not in F.ELEMENTWISE_UNARY:
+            raise _Unbuildable(f"{name!r} not in the unary whitelist")
+        return op
+    if tag == "where":
+        return jnp.where
+    if tag == "where_glue":
+        return F._where_fn_for(tuple(skey[1]))
+    if tag == "cast":
+        return F._cast_fn_for(np.dtype(skey[1]))
+    if tag == "view":
+        _, kind, params, padw = skey
+        return F._view_fn_for(kind, params, padw)
+    if tag == "gemm":
+        _, op, dtstr, ptok = skey
+        return F._gemm_fn_for(
+            op,
+            None if dtstr is None else np.dtype(dtstr),
+            F._precision_from_token(ptok),
+        )
+    if tag == "sink":
+        _, _kind, opname, pre, axis, keepdims, static_items, dyn_names, nanfix = skey
+        return F._sink_fn_for(
+            _resolve_op(opname), pre, axis, keepdims, static_items, dyn_names, nanfix
+        )
+    if tag == "sink_moment":
+        _, opname, axis, keepdims, static_items, dyn_names = skey
+        return F._sink_fn_for(
+            _resolve_op(opname), (), axis, keepdims, static_items, dyn_names, False
+        )
+    if tag == "sink_cum":
+        _, opname, axis, dtstr = skey
+        return F._cum_fn_for(
+            _resolve_op(opname), axis, None if dtstr is None else np.dtype(dtstr)
+        )
+    if tag == "sink_norm":
+        _, pre, axis, keepdims, ord_ = skey
+        return F._sink_fn_for(
+            jnp.linalg.norm, pre, axis, keepdims, (("ord", ord_),), (), False
+        )
+    if tag == "sink_vecdot":
+        _, axis, keepdim = skey
+        return F._vecdot_fn_for(axis, keepdim)
+    raise _Unbuildable(f"unknown node kind {tag!r}")
+
+
+def _rebuild(entry: dict):
+    """(program, avals, donate, out_idx) for one corpus recipe, or raise
+    :class:`_Unbuildable`."""
+    import jax
+
+    program = []
+    for skey, specs, kwargs, cast_key in entry["stable_prog"]:
+        fn = _node_fn(skey)
+        run_specs = tuple(
+            (s[0], s[2]) if s[0] == "c" else (s[0], s[1]) for s in specs
+        )
+        cast = (
+            None
+            if cast_key is None
+            else (np.dtype(cast_key[0]), bool(cast_key[1]))
+        )
+        program.append((fn, run_specs, dict(kwargs), cast))
+    avals = []
+    for shape, dtstr, weak, sd in entry["leaf_descs"]:
+        if sd[0] not in ("single", "host"):
+            raise _Unbuildable("sharded leaf layout (rebuild is single-device)")
+        avals.append(
+            jax.ShapeDtypeStruct(tuple(shape), np.dtype(dtstr), weak_type=bool(weak))
+        )
+    return program, avals, tuple(entry["donate"]), tuple(entry["out_idx"])
+
+
+def _count(kind: str) -> None:
+    if _MON.enabled:
+        _instr.serving_warmup(kind)
+
+
+def warmup(corpus: Optional[str] = None, cache_dir: Optional[str] = None) -> dict:
+    """Compile every corpus recipe into the persistent cache. Returns
+    ``{"entries", "compiled", "cached", "skipped", "errors"}`` — ``cached``
+    counts recipes whose executable already sits in the cache (the warmed
+    steady state; a cold-restart replay reports ``compiled == 0`` there)."""
+    import jax
+
+    from . import cache as _cache
+    from . import corpus as _corpus
+    from ..core.fusion import _replay_fn
+
+    if cache_dir is None:
+        cache_dir = _cache.cache_dir()
+    if not cache_dir:
+        raise ValueError(
+            "warmup needs a cache directory (HEAT_TPU_CACHE_DIR or cache_dir=)"
+        )
+    if corpus is None:
+        corpus = _corpus.corpus_dir(cache_dir) or os.path.join(cache_dir, "corpus")
+    stats = {"entries": 0, "compiled": 0, "cached": 0, "skipped": 0, "errors": 0}
+    fp = _cache.fingerprint()
+    for digest, entry in _corpus.entries(corpus):
+        stats["entries"] += 1
+        try:
+            if entry.get("fp") != fp or entry.get("format") != 1:
+                stats["skipped"] += 1
+                _count("skipped")
+                continue
+            if os.path.exists(_cache.entry_path(cache_dir, digest)):
+                stats["cached"] += 1
+                _count("cached")
+                continue
+            program, avals, donate, out_idx = _rebuild(entry)
+            jitted = jax.jit(_replay_fn(program, out_idx), donate_argnums=donate)
+            compiled = jitted.lower(*avals).compile()
+            if _cache.persist(cache_dir, digest, compiled):
+                stats["compiled"] += 1
+                _count("compiled")
+            else:
+                stats["errors"] += 1
+                _count("error")
+        except _Unbuildable:
+            stats["skipped"] += 1
+            _count("skipped")
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception:
+            stats["errors"] += 1
+            _count("error")
+    return stats
+
+
+def main(argv=None) -> int:
+    """CLI entry point (``python -m heat_tpu.serving.warmup``)."""
+    p = argparse.ArgumentParser(
+        prog="python -m heat_tpu.serving.warmup",
+        description="AOT-compile a recorded shape corpus into the persistent "
+        "compilation cache so a fresh serving process takes zero cold compiles.",
+    )
+    p.add_argument(
+        "--cache-dir",
+        default=None,
+        help="cache directory (default: $HEAT_TPU_CACHE_DIR)",
+    )
+    p.add_argument(
+        "--corpus",
+        default=None,
+        help="corpus directory (default: <cache-dir>/corpus or $HEAT_TPU_SHAPE_CORPUS)",
+    )
+    p.add_argument("-q", "--quiet", action="store_true", help="suppress the stats line")
+    args = p.parse_args(argv)
+    try:
+        stats = warmup(corpus=args.corpus, cache_dir=args.cache_dir)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if not args.quiet:
+        print(json.dumps(stats, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,541 @@
+"""
+Serving-runtime suite (``heat_tpu/serving/``, ISSUE 8): persistent
+compilation cache, aval bucketing, shape corpus + AOT warmup, async flush
+scheduler.
+
+Guarantees pinned here:
+
+* **Cross-process persistence** (the acceptance bar): a fresh process
+  replaying a workload against a warmed ``HEAT_TPU_CACHE_DIR`` performs
+  ZERO fused-kernel compiles — every flush is an L1 miss → disk hit →
+  deserialized executable, bit-identical to the compiling process.
+* **Bucketed ≡ exact**: results under ``HEAT_TPU_SHAPE_BUCKETS`` are
+  bit-for-bit those of ``HEAT_TPU_SHAPE_BUCKETS=0`` across split
+  {None, 0, 1} × even/ragged × f32/bf16, while the kernel count is bounded
+  by buckets instead of distinct shapes.
+* **Degradation discipline** (PR 6): a corrupt/truncated disk entry or an
+  injected ``serving.cache_read`` fault is counted and falls back to a
+  fresh compile — the cache can never crash a flush; the fingerprint check
+  recompiles rather than loading a foreign executable.
+* **Warmup**: ``serving.warmup`` rebuilds corpus recipes through fusion's
+  memoized factories and AOT-compiles them into the cache; the CLI wraps it.
+* **Concurrency**: independent DAGs flushed through the scheduler match
+  sequential results; dispatch latency lands in telemetry.
+* **Telemetry** (satellite): ``fusion_trace_cache`` (cache_info incl. the
+  poisoned count and both cache capacities) and the cache-hit-rate SLO are
+  exported by ``report.telemetry()``.
+"""
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu import serving
+from heat_tpu.core import fusion
+from heat_tpu.monitoring import registry, report
+from heat_tpu.robustness import faultinject
+from heat_tpu.serving import buckets as sbuckets
+from heat_tpu.serving import cache as scache
+from heat_tpu.serving import corpus as scorpus
+
+pytestmark = pytest.mark.serving
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """Fresh counters and trace cache on both sides; the disk cache is
+    opt-in per test (a shared HEAT_TPU_CACHE_DIR would cross-couple entry
+    counts between tests). HEAT_TPU_SHAPE_BUCKETS is deliberately NOT
+    cleared: the CI serving-smoke leg runs this whole suite under
+    ``HEAT_TPU_SHAPE_BUCKETS=0`` and bucketing-asserting tests pin their own
+    policy via monkeypatch (the PR 5 pin-the-gate-ON precedent)."""
+    registry.reset()
+    monkeypatch.setenv("HEAT_TPU_FUSION", "1")
+    monkeypatch.delenv("HEAT_TPU_CACHE_DIR", raising=False)
+    monkeypatch.delenv("HEAT_TPU_SHAPE_CORPUS", raising=False)
+    monkeypatch.delenv("HEAT_TPU_SHAPE_CORPUS_MAX", raising=False)
+    fusion.clear_cache()
+    yield
+    fusion.clear_cache()
+    registry.reset()
+
+
+@pytest.fixture
+def no_faults(monkeypatch):
+    """Pin fault injection OFF for compile/cache-count-asserting tests (the
+    PR 6 precedent: a standing CI fault plan makes count assertions
+    meaningless while results stay bit-identical)."""
+    monkeypatch.delenv("HEAT_TPU_FAULT_PLAN", raising=False)
+    faultinject.clear()
+    fusion.clear_cache()
+
+
+def _compiles() -> int:
+    return registry.REGISTRY.counter("fusion.kernels_compiled").get()
+
+
+def _disk(label: str) -> int:
+    return registry.REGISTRY.counter("serving.disk_cache").get(label)
+
+
+def _chain(x):
+    return (x * 2.0 + 1.0) / 3.0
+
+
+def _fresh(shape=(5, 12), seed=0, dtype=np.float32, split=None):
+    data = np.random.default_rng(seed).normal(size=shape).astype(dtype)
+    return ht.array(data, split=split)
+
+
+def _bitwise(a: np.ndarray, b: np.ndarray) -> bool:
+    return a.shape == b.shape and a.dtype == b.dtype and a.tobytes() == b.tobytes()
+
+
+# ------------------------------------------------------------------ disk cache
+def test_disk_cache_write_then_l2_hit_zero_compiles(monkeypatch, tmp_path, no_faults):
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    with registry.capture():
+        r1 = _chain(_fresh()).numpy()
+        assert _disk("miss") == 1 and _disk("write") == 1
+        assert len(os.listdir(tmp_path / "exec")) == 1
+        # L1 hit on the second identical chain: the disk is not consulted
+        r2 = _chain(_fresh()).numpy()
+        assert _disk("miss") == 1 and _disk("hit") == 0
+        # cold L1 (process-restart stand-in): served from disk, zero compiles
+        fusion.clear_cache()
+        before = _compiles()
+        r3 = _chain(_fresh()).numpy()
+        assert _compiles() == before
+        assert _disk("hit") == 1
+    assert _bitwise(r1, r2) and _bitwise(r1, r3)
+
+
+def test_disk_cache_bit_parity_vs_eager(monkeypatch, tmp_path, no_faults):
+    monkeypatch.setenv("HEAT_TPU_FUSION", "0")
+    x = _fresh(seed=3)
+    eager = _chain(x).numpy()
+    monkeypatch.setenv("HEAT_TPU_FUSION", "1")
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    _chain(_fresh(seed=3)).numpy()  # compile + store
+    fusion.clear_cache()
+    with registry.capture():
+        served = _chain(_fresh(seed=3)).numpy()
+        assert _disk("hit") == 1
+    # FMA carve-out does not apply: add/div chain has no mul->add contraction
+    assert _bitwise(eager, served)
+
+
+def test_sink_and_gemm_programs_persist(monkeypatch, tmp_path, no_faults):
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+
+    def work():
+        a = _fresh((8, 6), seed=5)
+        w = _fresh((6, 4), seed=6)
+        loss = ((a @ w) + 1.0).sum()
+        return np.asarray(loss.larray)
+
+    with registry.capture():
+        r1 = work()
+        writes = _disk("write")
+        assert writes >= 1
+        fusion.clear_cache()
+        before = _compiles()
+        r2 = work()
+        assert _compiles() == before  # GEMM + epilogue + sink served from disk
+        assert _disk("hit") >= 1
+    assert _bitwise(r1, r2)
+
+
+def test_cross_process_persistence_zero_compiles(tmp_path):
+    """A SECOND process with the same HEAT_TPU_CACHE_DIR performs zero fused
+    compiles and serves every flush from the disk cache (acceptance bar)."""
+    prog = textwrap.dedent(
+        """
+        import os, json
+        import numpy as np
+        os.environ["HEAT_TPU_MONITORING"] = "1"
+        import heat_tpu as ht
+        from heat_tpu.monitoring import registry
+        x = ht.array(np.arange(60, dtype=np.float32).reshape(5, 12))
+        r = ((x * 2.0 + 1.0) / 3.0).numpy()
+        y = ht.array(np.linspace(0.1, 1.0, 24, dtype=np.float32).reshape(4, 6))
+        s = np.asarray((y * y + y).sum().larray)
+        c = registry.snapshot()["counters"].get("serving.disk_cache", {})
+        labels = c.get("labels", {}) if isinstance(c, dict) else {}
+        print(json.dumps({
+            "compiles": registry.REGISTRY.counter("fusion.kernels_compiled").get(),
+            "hits": labels.get("hit", 0),
+            "checksum": [float(r.sum()), float(s)],
+        }))
+        """
+    )
+    env = dict(os.environ, HEAT_TPU_CACHE_DIR=str(tmp_path))
+    env.pop("HEAT_TPU_FAULT_PLAN", None)
+    env.pop("HEAT_TPU_SHAPE_BUCKETS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def run():
+        out = subprocess.run(
+            [sys.executable, "-c", prog], env=env, cwd=repo,
+            capture_output=True, text=True, timeout=240,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    first = run()
+    second = run()
+    assert first["compiles"] >= 1
+    assert second["compiles"] == 0, second
+    assert second["hits"] > 0
+    assert first["checksum"] == second["checksum"]
+
+
+def test_corrupt_entry_counted_and_recompiled(monkeypatch, tmp_path, no_faults):
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    r1 = _chain(_fresh(seed=9)).numpy()
+    (entry,) = (tmp_path / "exec").iterdir()
+    entry.write_bytes(b"\x00truncated-garbage")
+    fusion.clear_cache()
+    with registry.capture():
+        r2 = _chain(_fresh(seed=9)).numpy()
+        assert _disk("corrupt") == 1
+        # the recompile re-stored a good entry over the corrupt one
+        assert _disk("write") == 1
+    assert _bitwise(r1, r2)
+    fusion.clear_cache()
+    with registry.capture():
+        r3 = _chain(_fresh(seed=9)).numpy()
+        assert _disk("hit") == 1
+    assert _bitwise(r1, r3)
+
+
+def test_cache_read_fault_site_falls_back(monkeypatch, tmp_path, no_faults):
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    r1 = _chain(_fresh(seed=11)).numpy()
+    fusion.clear_cache()
+    with registry.capture():
+        with faultinject.inject("serving.cache_read", OSError, at_calls=[1]) as plan:
+            r2 = _chain(_fresh(seed=11)).numpy()
+        assert plan.fired == [1]
+        assert _disk("corrupt") == 1
+        assert registry.REGISTRY.counter("faults.injected").get("serving.cache_read") == 1
+    assert _bitwise(r1, r2)
+
+
+def test_fingerprint_mismatch_counted_incompatible(monkeypatch, tmp_path, no_faults):
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    _chain(_fresh(seed=13)).numpy()
+    (path,) = (tmp_path / "exec").iterdir()
+    entry = pickle.loads(path.read_bytes())
+    entry["fp"] = ("jax-from-another-life", "0.0.0", "cpu", "")
+    path.write_bytes(pickle.dumps(entry))
+    fusion.clear_cache()
+    with registry.capture():
+        _chain(_fresh(seed=13)).numpy()
+        assert _disk("incompatible") == 1
+        assert _disk("hit") == 0
+
+
+def test_collective_programs_stay_in_memory(monkeypatch, tmp_path, no_faults):
+    """A resplit-bearing program has no stable identity: counted
+    incompatible, never written, still correct."""
+    comm = ht.core.communication.get_comm()
+    if comm.size < 2:
+        pytest.skip("needs a multi-device mesh")
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    with registry.capture():
+        x = _fresh((12, 6), seed=17, split=0)
+        y = x * 2.0 + 1.0
+        y.resplit_(1)
+        r = (y + 0.5).numpy()
+        assert _disk("incompatible") >= 1
+        assert _disk("write") == 0
+    assert not (tmp_path / "exec").exists()
+    ref = (np.asarray(
+        np.random.default_rng(17).normal(size=(12, 6)).astype(np.float32)
+    ) * 2.0 + 1.0) + 0.5
+    np.testing.assert_allclose(r, ref, rtol=1e-6)
+
+
+def test_disabled_serving_is_inert(monkeypatch, tmp_path, no_faults):
+    """No HEAT_TPU_CACHE_DIR, no HEAT_TPU_SHAPE_BUCKETS: no files, no
+    serving counters, flushes unchanged (the cold-dir CI leg contract)."""
+    monkeypatch.delenv("HEAT_TPU_SHAPE_BUCKETS", raising=False)
+    with registry.capture():
+        r = _chain(_fresh(seed=19)).numpy()
+        snap = registry.snapshot()["counters"]
+        assert not any(k.startswith("serving.") for k in snap)
+    assert r.shape == (5, 12)
+    assert list(tmp_path.iterdir()) == []
+
+
+# ------------------------------------------------------------------ bucketing
+@pytest.mark.parametrize("split", [None, 0, 1])
+@pytest.mark.parametrize(
+    "shape", [(12, 8), (11, 7)], ids=["even", "ragged"]
+)
+@pytest.mark.parametrize("dtype", [np.float32, "bfloat16"], ids=["f32", "bf16"])
+def test_bucketed_bit_parity_matrix(monkeypatch, split, shape, dtype, no_faults):
+    """Bucketed results are bit-identical to HEAT_TPU_SHAPE_BUCKETS=0 across
+    split/ragged/dtype (distributed operands take the exact path — parity
+    must hold there too)."""
+    dt = np.dtype(dtype)
+    data = (
+        np.random.default_rng(int(np.prod(shape))).normal(size=shape).astype(np.float32)
+    ).astype(dt)
+
+    def work():
+        x = ht.array(data.copy(), split=split)
+        y = ht.where(x > 0, x * 3.0, x + 1.0)
+        return np.asarray((y - 0.25).larray)
+
+    monkeypatch.setenv("HEAT_TPU_SHAPE_BUCKETS", "0")
+    exact = work()
+    fusion.clear_cache()
+    monkeypatch.setenv("HEAT_TPU_SHAPE_BUCKETS", "pow2")
+    bucketed = work()
+    assert _bitwise(exact, bucketed)
+
+
+def test_bucketing_bounds_kernel_count(monkeypatch, no_faults):
+    shapes = [(97, 5), (100, 7), (128, 8), (111, 6)]
+
+    def sweep():
+        out = []
+        for i, s in enumerate(shapes):
+            out.append(_chain(_fresh(s, seed=i)).numpy())
+        return out
+
+    with registry.capture():
+        before = _compiles()
+        exact = sweep()
+        unbucketed = _compiles() - before
+        fusion.clear_cache()
+        monkeypatch.setenv("HEAT_TPU_SHAPE_BUCKETS", "pow2")
+        before = _compiles()
+        bucketed = sweep()
+        n_bucketed = _compiles() - before
+        waste = registry.REGISTRY.counter("serving.bucket").get("pad_waste_bytes")
+        hits = registry.REGISTRY.counter("serving.bucket").get("hit")
+    assert unbucketed == len(shapes)  # one kernel per distinct shape
+    assert n_bucketed == 1  # all four shapes round to the (128, 8) bucket
+    assert hits == len(shapes)
+    assert waste > 0
+    for e, b in zip(exact, bucketed):
+        assert _bitwise(e, b)
+
+
+def test_bucketing_skips_reduction_programs(monkeypatch, no_faults):
+    """A sink-rooted program is not pointwise: bucketing must decline (the
+    pad would enter the sum) and the result must match the exact path."""
+    data = np.random.default_rng(23).normal(size=(10, 3)).astype(np.float32)
+    monkeypatch.setenv("HEAT_TPU_SHAPE_BUCKETS", "0")
+    exact = np.asarray((ht.array(data.copy()) * 2.0).sum().larray)
+    fusion.clear_cache()
+    monkeypatch.setenv("HEAT_TPU_SHAPE_BUCKETS", "pow2")
+    with registry.capture():
+        bucketed = np.asarray((ht.array(data.copy()) * 2.0).sum().larray)
+        assert registry.REGISTRY.counter("serving.bucket").get("hit") == 0
+    assert _bitwise(exact, bucketed)
+
+
+def test_bucket_policy_parse():
+    assert sbuckets.policy("0") is None
+    assert sbuckets.policy("") is None
+    edges, tail = sbuckets.policy("pow2:16")
+    assert edges == (1, 2, 4, 8, 16) and tail == 16
+    assert sbuckets.bucket_dim(17, edges, tail) == 32  # linear tail
+    assert sbuckets.bucket_dim(5, edges, tail) == 8
+    edges, tail = sbuckets.policy("8,64,512")
+    assert sbuckets.bucket_shape((3, 65, 1000), edges, tail) == (8, 512, 1024)
+    with pytest.raises(ValueError):
+        sbuckets.policy("pow2:banana")
+    with pytest.raises(ValueError):
+        sbuckets.policy("64,8")  # not ascending
+
+
+def test_bucketing_composes_with_disk_cache(monkeypatch, tmp_path, no_faults):
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    monkeypatch.setenv("HEAT_TPU_SHAPE_BUCKETS", "pow2")
+    r1 = _chain(_fresh((97, 5), seed=1)).numpy()
+    r2 = _chain(_fresh((100, 7), seed=2)).numpy()
+    # both shapes share one bucketed kernel -> one exec entry on disk
+    assert len(os.listdir(tmp_path / "exec")) == 1
+    fusion.clear_cache()
+    with registry.capture():
+        before = _compiles()
+        r1b = _chain(_fresh((97, 5), seed=1)).numpy()
+        r2b = _chain(_fresh((100, 7), seed=2)).numpy()
+        assert _compiles() == before
+        assert _disk("hit") >= 1
+    assert _bitwise(r1, r1b) and _bitwise(r2, r2b)
+
+
+# ------------------------------------------------------------------ corpus + warmup
+def test_corpus_records_bounded_and_deduped(monkeypatch, tmp_path, no_faults):
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    monkeypatch.setenv("HEAT_TPU_SHAPE_CORPUS_MAX", "2")
+    scorpus._seen.clear()
+    with registry.capture():
+        for i, s in enumerate([(4, 4), (5, 5), (6, 6)]):
+            _chain(_fresh(s, seed=i)).numpy()
+        # repeat shape: dedup, no new entry
+        fusion.clear_cache()
+        _chain(_fresh((4, 4), seed=0)).numpy()
+        assert scorpus.size(str(tmp_path / "corpus")) == 2
+        c = registry.REGISTRY.counter("serving.corpus")
+        assert c.get("recorded") == 2 and c.get("full") == 1
+
+
+def test_warmup_compiles_corpus_into_fresh_cache(monkeypatch, tmp_path, no_faults):
+    warm_dir = tmp_path / "warm"
+    cold_dir = tmp_path / "cold"
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(warm_dir))
+    scorpus._seen.clear()
+    shapes = [(4, 6), (3, 9)]
+    ref = [
+        _chain(_fresh(s, seed=i)).numpy() for i, s in enumerate(shapes)
+    ]
+    stats = serving.warmup(
+        corpus=str(warm_dir / "corpus"), cache_dir=str(cold_dir)
+    )
+    assert stats["entries"] == len(shapes)
+    assert stats["compiled"] == len(shapes)
+    assert stats["errors"] == 0
+    # the freshly warmed dir serves a cold L1 with zero compiles
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(cold_dir))
+    fusion.clear_cache()
+    with registry.capture():
+        before = _compiles()
+        out = [_chain(_fresh(s, seed=i)).numpy() for i, s in enumerate(shapes)]
+        assert _compiles() == before
+        assert _disk("hit") == len(shapes)
+    for a, b in zip(ref, out):
+        assert _bitwise(a, b)
+    # idempotent second warmup: everything already cached
+    stats2 = serving.warmup(corpus=str(warm_dir / "corpus"), cache_dir=str(cold_dir))
+    assert stats2["cached"] == len(shapes) and stats2["compiled"] == 0
+
+
+def test_warmup_skips_foreign_fingerprint_and_garbage(monkeypatch, tmp_path, no_faults):
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    scorpus._seen.clear()
+    _chain(_fresh(seed=31)).numpy()
+    cdir = tmp_path / "corpus"
+    (entry,) = cdir.iterdir()
+    recipe = pickle.loads(entry.read_bytes())
+    recipe["fp"] = ("other-jax", "0", "tpu", "")
+    (cdir / ("f" * 64 + ".pkl")).write_bytes(pickle.dumps(recipe))
+    (cdir / ("e" * 64 + ".pkl")).write_bytes(b"not a pickle")
+    with registry.capture():
+        stats = serving.warmup(cache_dir=str(tmp_path))
+    assert stats == {
+        "entries": 2, "compiled": 0, "cached": 1, "skipped": 1, "errors": 0,
+    }
+    assert registry.REGISTRY.counter("serving.corpus").get("corrupt") == 1
+
+
+def test_warmup_cli_main(monkeypatch, tmp_path, capsys, no_faults):
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    scorpus._seen.clear()
+    _chain(_fresh(seed=37)).numpy()
+    import importlib
+
+    # the package re-exports the warmup FUNCTION under the submodule's name
+    wmod = importlib.import_module("heat_tpu.serving.warmup")
+
+    rc = wmod.main(["--cache-dir", str(tmp_path)])
+    assert rc == 0
+    stats = json.loads(capsys.readouterr().out.strip())
+    assert stats["entries"] == 1 and stats["cached"] == 1
+    monkeypatch.delenv("HEAT_TPU_CACHE_DIR")
+    assert wmod.main([]) == 2  # no cache dir: usage error, not a crash
+
+
+# ------------------------------------------------------------------ scheduler
+def test_concurrent_flushes_match_sequential(no_faults):
+    rng = np.random.default_rng(41)
+    datas = [rng.normal(size=(16, 8)).astype(np.float32) for _ in range(12)]
+    expected = [
+        np.asarray(_chain(ht.array(d.copy())).larray) for d in datas
+    ]
+    pending = [_chain(ht.array(d.copy())) for d in datas]
+    with serving.FlushScheduler(max_workers=4) as sched:
+        done = sched.flush_all(pending)
+    for p, e in zip(done, expected):
+        assert _bitwise(np.asarray(p.larray), e)
+
+
+def test_scheduler_latency_telemetry_and_flush_async(no_faults):
+    with registry.capture():
+        x = _chain(_fresh(seed=43))
+        fut = x.flush_async()
+        assert fut.result() is x
+        serving.flush_all([_chain(_fresh(seed=44)), _fresh(seed=45)])
+        tel = report.telemetry()
+    lat = tel["serving_dispatch_latency"]
+    assert lat["count"] == 3
+    assert lat["p50_us"] >= 0 and lat["p99_us"] >= lat["p50_us"]
+    reasons = tel.get("fusion_flush_reasons", {})
+    assert reasons.get("serving", 0) >= 2
+
+
+def test_concurrent_flushes_under_disk_cache(monkeypatch, tmp_path, no_faults):
+    """Scheduler + L2 compose: concurrent same-signature flushes settle to
+    one disk entry and correct results (benign races allowed, crashes not)."""
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    datas = [np.full((8, 8), float(i), np.float32) for i in range(8)]
+    pending = [_chain(ht.array(d)) for d in datas]
+    with serving.FlushScheduler(max_workers=4) as sched:
+        sched.flush_all(pending)
+    for i, p in enumerate(pending):
+        assert _bitwise(
+            np.asarray(p.larray), np.asarray(_chain(ht.array(datas[i])).larray)
+        )
+    assert len(os.listdir(tmp_path / "exec")) == 1
+
+
+# ------------------------------------------------------------------ telemetry + cache fix
+def test_telemetry_exports_fusion_trace_cache_and_slo(monkeypatch, tmp_path, no_faults):
+    """Satellite regression: cache_info (entries/hits/misses/evictions +
+    poisoned + both capacities) and the SLO reach report.telemetry()."""
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    ci0 = fusion.cache_info()  # the fusion stats are process-cumulative
+    with registry.capture():
+        _chain(_fresh(seed=47)).numpy()   # miss + write
+        _chain(_fresh(seed=47)).numpy()   # L1 hit
+        fusion.clear_cache()
+        _chain(_fresh(seed=47)).numpy()   # L2 hit
+        tel = report.telemetry()
+    tc = tel["fusion_trace_cache"]
+    for k in ("entries", "max", "hits", "misses", "evictions", "poisoned",
+              "eval_entries", "eval_max"):
+        assert k in tc, k
+    assert tc["max"] == 4096 and tc["eval_max"] == 4096
+    assert tc["hits"] - ci0["hits"] == 1
+    assert tc["misses"] - ci0["misses"] == 2  # cold compile + L2-served miss
+    slo = tel["serving_cache_slo"]
+    assert slo["l2_hits"] == 1
+    assert slo["l1_hits"] == tc["hits"]
+    assert slo["hit_rate"] is not None and 0.0 < slo["hit_rate"] <= 1.0
+    assert tel["serving_disk_cache"]["write"] == 1
+
+
+def test_clear_cache_clears_eval_memo_coherently(no_faults):
+    """Satellite: the trace LRU and the eval-node memo are cleared together
+    and both capacities are surfaced."""
+    _chain(_fresh(seed=53)).numpy()
+    info = fusion.cache_info()
+    assert info["entries"] >= 1 and info["eval_entries"] >= 1
+    fusion.clear_cache()
+    info = fusion.cache_info()
+    assert info["entries"] == 0 and info["eval_entries"] == 0
+    assert info["poisoned"] == 0
+    assert info["max"] == info["eval_max"] == 4096
