@@ -791,6 +791,9 @@ def main():
                     "bucket_kernel_count": None,
                     "unbucketed_kernel_count": None,
                     "bucket_valid": None,
+                    "janitor_bytes_after": None,
+                    "janitor_evicted": None,
+                    "janitor_valid": None,
                     "serving_error": repr(e)[:160],
                 }
         # out-of-core input pipeline (VERDICT r4 #8): native prefetcher vs h5py

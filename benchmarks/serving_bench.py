@@ -24,6 +24,11 @@ Three anchor groups, wired into ``bench.py`` with the null-key crash-dict +
   exact-shape default: the bucketed count is bounded by the bucket grid
   (``bucket_valid`` additionally requires bit-identical results pairwise
   across the whole mix).
+* ``janitor_bytes_before``/``janitor_cache_bound``/``janitor_bytes_after``/
+  ``janitor_evicted`` — the disk-cache janitor (ISSUE 9) fills a cache dir
+  past a size bound with the same mix and sweeps: ``janitor_valid``
+  requires eviction down to <= the bound with the hit-rate SLO telemetry
+  still intact afterwards.
 
 Run: python benchmarks/serving_bench.py
 """
@@ -99,6 +104,8 @@ def _subprocess_env(cache_dir):
     )
     env.pop("HEAT_TPU_FAULT_PLAN", None)
     env.pop("HEAT_TPU_SHAPE_BUCKETS", None)
+    env.pop("HEAT_TPU_CHAOS", None)
+    env.pop("HEAT_TPU_BREAKER_FORCE_OPEN", None)
     return env
 
 
@@ -190,10 +197,62 @@ def bench_dispatch_latency(rounds: int = 4):
     return round(p50, 1), round(p99, 1), bool(valid)
 
 
+def bench_janitor():
+    """(bytes_before, bound, bytes_after, evicted, valid): fill a cache dir
+    past a size bound with the mixed-shape mix, sweep, and prove the janitor
+    evicts LRU-by-mtime to <= bound while the hit-rate telemetry stays
+    intact (ISSUE 9 acceptance: HEAT_TPU_CACHE_MAX_BYTES enforced)."""
+    import tempfile as _tf
+
+    from heat_tpu.core import fusion
+    from heat_tpu.monitoring import report
+    from heat_tpu.serving import janitor
+
+    def governed_bytes(d):
+        total = 0
+        for sub in ("exec", "corpus"):
+            p = os.path.join(d, sub)
+            if os.path.isdir(p):
+                total += sum(
+                    os.path.getsize(os.path.join(p, n)) for n in os.listdir(p)
+                )
+        return total
+
+    prev = os.environ.get("HEAT_TPU_CACHE_DIR")
+    try:
+        with _tf.TemporaryDirectory(prefix="heat-tpu-janitor-bench-") as tmp:
+            os.environ["HEAT_TPU_CACHE_DIR"] = tmp
+            fusion.clear_cache()
+            _run_mix()  # one exec entry + corpus recipe per distinct shape
+            before = governed_bytes(tmp)
+            bound = max(1, before // 2)
+            stats = janitor.sweep(tmp, limit=bound, validate=True)
+            after = governed_bytes(tmp)
+            # surviving (and re-stored) entries still serve: hit-rate SLO
+            # telemetry must remain intact after eviction
+            fusion.clear_cache()
+            _run_mix()
+            slo = report.telemetry().get("serving_cache_slo", {})
+            valid = (
+                before > bound
+                and stats["evicted"] > 0
+                and after <= bound
+                and slo.get("hit_rate") is not None
+            )
+            return before, bound, after, stats["evicted"], bool(valid)
+    finally:
+        if prev is None:
+            os.environ.pop("HEAT_TPU_CACHE_DIR", None)
+        else:
+            os.environ["HEAT_TPU_CACHE_DIR"] = prev
+        fusion.clear_cache()
+
+
 def bench_serving():
     """All serving anchors as one flat dict (the bench.py contract)."""
     bucketed, unbucketed, waste, bucket_valid = bench_bucketing()
     p50, p99, lat_valid = bench_dispatch_latency()
+    jan_before, jan_bound, jan_after, jan_evicted, jan_valid = bench_janitor()
     cold_compiles, cold_hits, cold_valid = bench_cold_restart()
     return {
         "cold_restart_compiles": cold_compiles,
@@ -206,6 +265,11 @@ def bench_serving():
         "unbucketed_kernel_count": unbucketed,
         "bucket_pad_waste_bytes": waste,
         "bucket_valid": bucket_valid,
+        "janitor_bytes_before": jan_before,
+        "janitor_cache_bound": jan_bound,
+        "janitor_bytes_after": jan_after,
+        "janitor_evicted": jan_evicted,
+        "janitor_valid": jan_valid,
     }
 
 

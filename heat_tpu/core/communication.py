@@ -399,8 +399,22 @@ class MeshCommunication(Communication):
         # failure here surfaces exactly where a real ICI/DCN dispatch error
         # would (an EAGER dispatch has no retained graph to replay — only a
         # collective recorded in a fused flush rides the recovery ladder,
-        # whose fused attempt consults this same site)
-        _FI.check("collective.dispatch")
+        # whose fused attempt consults this same site). Outcomes feed the
+        # collective.dispatch circuit breaker: the eager shim has no degraded
+        # path of its own (the error still raises here), but its evidence is
+        # what lets collective-bearing FUSED flushes fail fast to their
+        # retained eager barrier path while the fabric is flapping.
+        from ..robustness import breaker as _BRK
+
+        b = _BRK.breaker("collective.dispatch")
+        try:
+            _FI.check("collective.dispatch")
+        except (KeyboardInterrupt, SystemExit, _FI.FaultPlanError):
+            raise
+        except BaseException:
+            b.record_failure()
+            raise
+        b.record_success()
         if _MON.enabled:
             _instr.collective(kind)
         return self._collective_fn(kind, split, ndim, op, **kw)

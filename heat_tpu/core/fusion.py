@@ -177,6 +177,7 @@ import jax.numpy as jnp
 
 from ..monitoring.registry import STATE as _MON
 from ..monitoring import instrument as _instr
+from ..robustness import breaker as _BRK
 from ..robustness import faultinject as _FI
 from .dndarray import DNDarray
 
@@ -1919,6 +1920,13 @@ _cache_stats = {"hits": 0, "misses": 0, "evictions": 0}
 _POISONED: "collections.OrderedDict" = collections.OrderedDict()
 _POISON_MAX = 1024
 
+#: Chain signatures whose BUCKETED execution hit an OOM and recovered on the
+#: exact-shape kernel (the ladder's debucket rung, ISSUE 9): future flushes
+#: of the same signature skip aval bucketing outright — the padded
+#: temporaries are what blew the memory plan, so re-trying them every flush
+#: would be a retry tax. Capped like the poison set; cleared together.
+_BUCKET_OOM: "collections.OrderedDict" = collections.OrderedDict()
+
 
 def cache_info() -> dict:
     """Trace-cache statistics (entries/max/hits/misses/evictions), the number
@@ -1930,6 +1938,7 @@ def cache_info() -> dict:
         "entries": len(_TRACE_CACHE),
         "max": _cache_max(),
         "poisoned": len(_POISONED),
+        "bucket_oom": len(_BUCKET_OOM),
         "eval_entries": ev.currsize,
         "eval_max": ev.maxsize,
         **_cache_stats,
@@ -1945,6 +1954,7 @@ def clear_cache() -> None:
     let stale eval entries outlive every executable they described."""
     _TRACE_CACHE.clear()
     _POISONED.clear()
+    _BUCKET_OOM.clear()
     _eval_node_cached.cache_clear()
 
 
@@ -2053,22 +2063,32 @@ def _poison(key) -> None:
         _instr.fusion_poisoned()
 
 
-def _flush_ladder(fused, program, leaf_arrays, out_idx, donate, compiled, key, has_coll=False):
+def _flush_ladder(
+    fused, program, leaf_arrays, out_idx, donate, compiled, key,
+    has_coll=False, debucket=None,
+):
     """Execute a fused flush with graceful degradation.
 
-    Rungs: (1) the fused kernel as planned; (2) on failure, one retry with
-    buffer donation disabled (skipped when nothing was donated — the rebuild
-    would be byte-identical); (3) per-op eager replay of the retained program,
-    which cannot fail for reasons the fused kernel introduced, plus poisoning
-    of the signature so identical future chains skip straight to eager. Each
-    failed rung counts ``fusion.flush_failures{class}``; any recovery counts
-    ``fusion.flush_recovered``. The ``fusion.compile``/``fusion.execute``
-    fault-injection sites are consulted per attempt, so every rung is
-    deterministically testable. Caveat (documented in robustness_notes): if a
-    *donating* kernel fails after consuming its donated buffers — possible on
-    TPU/GPU only — the retained leaves are gone and the rung-2/3 replays
-    surface that error instead; donation requires owner-death, so no
-    user-visible array is ever lost."""
+    Rungs: (1) the fused kernel as planned; (1b) when the failure classifies
+    ``oom`` and the program was shape-bucketed (``debucket`` is the caller's
+    exact-shape retry closure), drop the padded temporaries and run the
+    unbucketed kernel once — counted ``fusion.flush_failures{oom-bucketed}``,
+    and the signature skips bucketing from then on; (2) on failure, one retry
+    with buffer donation disabled (skipped when nothing was donated — the
+    rebuild would be byte-identical); (3) per-op eager replay of the retained
+    program, which cannot fail for reasons the fused kernel introduced, plus
+    poisoning of the signature so identical future chains skip straight to
+    eager. Each failed rung counts ``fusion.flush_failures{class}``; any
+    recovery counts ``fusion.flush_recovered``. The ``fusion.compile``/
+    ``fusion.execute`` fault-injection sites are consulted per attempt, so
+    every rung is deterministically testable, and rung-1 outcomes feed the
+    ``fusion.compile``/``collective.dispatch`` circuit breakers (ISSUE 9) so
+    a flapping site eventually routes flushes straight to eager replay.
+    Caveat (documented in robustness_notes): if a *donating* kernel fails
+    after consuming its donated buffers — possible on TPU/GPU only — the
+    retained leaves are gone and the rung-2/3 replays surface that error
+    instead; donation requires owner-death, so no user-visible array is ever
+    lost."""
     try:
         if compiled:
             _FI.check("fusion.compile")
@@ -2081,17 +2101,44 @@ def _flush_ladder(fused, program, leaf_arrays, out_idx, donate, compiled, key, h
             # it — a standing collective.dispatch plan proves recovery instead
             # of making recovery impossible
             _FI.check("collective.dispatch")
-        return fused(*leaf_arrays)
+        values = fused(*leaf_arrays)
+        if compiled:
+            _BRK.breaker("fusion.compile").record_success()
+        if has_coll:
+            _BRK.breaker("collective.dispatch").record_success()
+        return values
     except (KeyboardInterrupt, SystemExit, _FI.FaultPlanError):
         raise  # a malformed fault PLAN is a config error, not a failure
     except Exception as e:
+        cls = _classify_failure(e, compiled)
         if _MON.enabled:
-            _instr.fusion_flush_failure(_classify_failure(e, compiled))
+            _instr.fusion_flush_failure(cls)
+        if compiled:
+            _BRK.breaker("fusion.compile").record_failure()
+        if has_coll:
+            _BRK.breaker("collective.dispatch").record_failure()
         if key is not None:
             # never hand the broken executable to a future flush
             _TRACE_CACHE.pop(key, None)
         values = None
-        if donate:
+        if cls == "oom" and debucket is not None:
+            # the padded bucket temporaries are the likeliest extra memory in
+            # the failed plan: retry once at the exact shapes before demoting
+            # the whole signature to eager replay
+            if _MON.enabled:
+                _instr.fusion_flush_failure("oom-bucketed")
+            try:
+                _FI.check("fusion.compile")  # the exact-shape kernel is fresh
+                _FI.check("fusion.execute")
+                if has_coll:
+                    _FI.check("collective.dispatch")
+                values = debucket()
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e1:
+                if _MON.enabled:
+                    _instr.fusion_flush_failure(_classify_failure(e1, True))
+        if values is None and donate:
             try:
                 _FI.check("fusion.compile")  # rung 2 always builds fresh
                 _FI.check("fusion.execute")
@@ -2275,16 +2322,41 @@ def materialize_for(d: DNDarray):
     # every surviving op is pointwise, so the pad region never influences a
     # logical element). Env-gated: the off path costs one os.environ read.
     bucket_slicer = None
+    debucket = None
     bspec = os.environ.get("HEAT_TPU_SHAPE_BUCKETS", "").strip()
     if bspec and bspec.lower() not in ("0", "false", "off") and stable_prog is not None:
         from ..serving import buckets as _buckets
 
-        bplan = _buckets.plan(
-            bspec, stable_prog, out_idx, tuple(root.aval.shape), leaf_arrays
+        # a signature whose bucketed execution already hit OOM (and recovered
+        # on the exact-shape kernel) skips bucketing outright — the padded
+        # temporaries are what blew the memory plan (ISSUE 9 satellite)
+        try:
+            bkey = (tuple(key_prog), _leaf_cache_key(leaf_arrays), out_idx)
+            skip_bucketing = bkey in _BUCKET_OOM
+        except TypeError:  # unhashable sharding — no OOM memo either
+            bkey, skip_bucketing = None, False
+        bplan = (
+            None
+            if skip_bucketing
+            else _buckets.plan(
+                bspec, stable_prog, out_idx, tuple(root.aval.shape), leaf_arrays
+            )
         )
         if bplan is not None:
+            orig_leaves = leaf_arrays
             leaf_arrays, bucket_slicer = bplan
             donate = ()  # the padded copies are fresh private temporaries
+
+            def debucket(_orig=orig_leaves, _bkey=bkey):
+                # the ladder's oom-bucketed rung: run the exact-shape kernel
+                # (no padded temporaries) and remember the signature so
+                # future flushes of this chain key on exact shapes directly
+                values = jax.jit(_replay_fn(program, out_idx))(*_orig)
+                if _bkey is not None:
+                    _BUCKET_OOM[_bkey] = True
+                    while len(_BUCKET_OOM) > _POISON_MAX:
+                        _BUCKET_OOM.popitem(last=False)
+                return values
 
     leaf_key = _leaf_cache_key(leaf_arrays)
     try:
@@ -2302,14 +2374,28 @@ def materialize_for(d: DNDarray):
             if k in ("ppermute", "alltoall"):
                 _instr.collective(k)
 
-    if key is not None and key in _POISONED:
-        # circuit breaker: this signature already failed fused execution and
-        # was recovered by eager replay — skip straight to eager (no compile,
-        # no retry tax); the result is bit-identical by construction
-        try:
-            _POISONED.move_to_end(key)
-        except KeyError:  # concurrent clear_cache (scheduler threads)
-            pass
+    poisoned = key is not None and key in _POISONED
+    breaker_eager = False
+    if not poisoned:
+        # site-level circuit breakers (ISSUE 9, robustness/breaker.py): an
+        # open fusion.compile breaker routes L1-miss flushes straight to the
+        # eager-replay rung (skipping a doomed compile attempt); an open
+        # collective.dispatch breaker fails collective-bearing flushes fast
+        # to the retained eager barrier path. Both are bit-identical to the
+        # ladder's own recovery — the breaker only removes the retry tax.
+        if fused is None and not _BRK.breaker("fusion.compile").allow():
+            breaker_eager = True
+        elif coll_kinds and not _BRK.breaker("collective.dispatch").allow():
+            breaker_eager = True
+    if poisoned or breaker_eager:
+        # per-signature poisoning (the recovery ladder's own breaker) or an
+        # open site breaker: skip straight to eager (no compile, no retry
+        # tax); the result is bit-identical by construction
+        if poisoned:
+            try:
+                _POISONED.move_to_end(key)
+            except KeyError:  # concurrent clear_cache (scheduler threads)
+                pass
         if _MON.enabled:
             _instr.fusion_flush(
                 len(topo), cache_hit=False, compiled=False, reason=_reason_stack()[-1]
@@ -2342,6 +2428,10 @@ def materialize_for(d: DNDarray):
                     fused = disk.load(cache_dir, digest)
                     from_disk = fused is not None
         compiled = fused is None
+        if from_disk:
+            # a disk-served executable satisfies the compile-class operation
+            # (incl. a half-open probe) even though no XLA compile ran
+            _BRK.breaker("fusion.compile").record_success()
         if fused is None:
             fused = jax.jit(_replay_fn(program, out_idx), donate_argnums=donate)
             if digest is not None:
@@ -2384,7 +2474,7 @@ def materialize_for(d: DNDarray):
 
         values = _flush_ladder(
             fused, program, leaf_arrays, out_idx, donate, compiled, key,
-            has_coll=bool(coll_kinds),
+            has_coll=bool(coll_kinds), debucket=debucket,
         )
 
     if bucket_slicer is not None:

@@ -73,6 +73,12 @@ __all__ = [
     "serving_corpus",
     "serving_warmup",
     "serving_dispatch",
+    "serving_shed",
+    "serving_deadline_miss",
+    "serving_queue_depth",
+    "serving_janitor",
+    "breaker_transition",
+    "chaos_fire",
     "record_io",
     "io_retry",
     "checkpoint_op",
@@ -260,6 +266,47 @@ def serving_warmup(kind: str) -> None:
 def serving_dispatch(seconds: float) -> None:
     """One scheduled flush's submit-to-materialized latency."""
     REGISTRY.histogram("serving.dispatch_latency", _DISPATCH_BOUNDS).observe(seconds)
+
+
+def serving_shed(kind: str) -> None:
+    """One scheduled flush shed by admission control instead of dispatched
+    (kind: queue-full — the bounded queue overflowed under the ``shed``
+    policy; deadline — the flush was already past ``HEAT_TPU_FLUSH_DEADLINE_MS``
+    at dequeue). Shedding drops only the *async* dispatch: the owner's
+    ``flush()`` still materializes the correct value synchronously."""
+    REGISTRY.counter("serving.shed").inc(label=kind)
+
+
+def serving_deadline_miss(kind: str) -> None:
+    """One flush the dispatch watchdog observed exceeding the configured
+    deadline *while already in flight* (kind: in-flight) — work is never
+    aborted mid-kernel, so these are counted and logged, not killed."""
+    REGISTRY.counter("serving.deadline_miss").inc(label=kind)
+
+
+def serving_queue_depth(depth: int) -> None:
+    """Current number of scheduled-but-unfinished flushes (gauge)."""
+    REGISTRY.gauge("serving.queue_depth").set(int(depth))
+
+
+def serving_janitor(kind: str, n: int = 1) -> None:
+    """One disk-cache janitor outcome (kind: runs / evicted / evicted_bytes /
+    quarantined / orphans — mixed units by design, the labels are the
+    content)."""
+    REGISTRY.counter("serving.janitor").inc(int(n), label=kind)
+
+
+def breaker_transition(site: str, state: str) -> None:
+    """One circuit-breaker state transition
+    (``robustness.breaker{site:state}`` — closed / open / half-open)."""
+    REGISTRY.counter("robustness.breaker").inc(label=f"{site}:{state}")
+
+
+def chaos_fire(site: str) -> None:
+    """One fault fired by a derandomized chaos schedule
+    (:mod:`heat_tpu.robustness.chaos`) — counted on top of the generic
+    ``faults.injected{site}``."""
+    REGISTRY.counter("robustness.chaos").inc(label=site)
 
 
 def record_io(op: str, path: str, nbytes: int, seconds: float) -> None:

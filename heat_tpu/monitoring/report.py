@@ -131,6 +131,15 @@ def telemetry() -> dict:
         ("serving.bucket", "serving_bucket"),
         ("serving.corpus", "serving_corpus"),
         ("serving.warmup", "serving_warmup"),
+        # production-hardening breakdowns (ISSUE 9): admission-control sheds,
+        # watchdog deadline misses, janitor evictions/quarantines, breaker
+        # state transitions, and chaos-schedule fires — the counters that
+        # prove the degraded paths (not luck) carried an adverse-load run
+        ("serving.shed", "serving_shed"),
+        ("serving.deadline_miss", "serving_deadline_miss"),
+        ("serving.janitor", "serving_janitor"),
+        ("robustness.breaker", "robustness_breakers"),
+        ("robustness.chaos", "chaos_fires"),
         # graceful-degradation breakdowns (ISSUE 6): which failure classes the
         # flush ladder absorbed, which writer paths retried, what the
         # checkpoint subsystem did, and which fault sites actually fired
@@ -175,6 +184,9 @@ def telemetry() -> dict:
         }
     except Exception:  # core not importable / partially initialized
         pass
+    qd = snap["metrics"]["gauges"].get("serving.queue_depth")
+    if qd is not None:
+        out["serving_queue_depth"] = qd
     lat = snap["metrics"]["histograms"].get("serving.dispatch_latency")
     if lat and lat["count"]:
         out["serving_dispatch_latency"] = {

@@ -13,27 +13,42 @@ memory arbitrarily far from the op that recorded them:
   deterministic by call count (programmatic or ``HEAT_TPU_FAULT_PLAN``), so
   every degraded path is replayable in CI.
 - :mod:`~heat_tpu.robustness.retry` — a bounded exponential-backoff retry
-  policy shared by the IO and checkpoint writers (transient ``OSError``/EIO).
+  policy shared by the IO and checkpoint writers (transient ``OSError``/EIO),
+  with an optional total-deadline budget for bounded-latency callers.
 - :mod:`~heat_tpu.robustness.preemption` — a SIGTERM/SIGINT guard that turns
   a preemption notice into a checkpoint at the next step boundary; the
   trainers and the kmeans/lasso fit loops poll it per step.
+- :mod:`~heat_tpu.robustness.breaker` — deterministic circuit breakers
+  (closed → open after N consecutive failures → half-open probe, measured in
+  *calls*) wrapping the fault-site call points, so a flapping site routes
+  callers straight to its degraded path instead of charging every call the
+  full recovery ladder/backoff schedule.
+- :mod:`~heat_tpu.robustness.chaos` — seeded multi-site chaos schedules
+  (``HEAT_TPU_CHAOS="seed:rate[:sites]"``), derandomized at install into
+  exact per-call fault plans on the :mod:`faultinject` machinery.
 
 The fused-flush recovery *ladder* itself lives in ``core/fusion.py`` (it needs
 the retained expression DAG); its failure/recovery/poisoning counters are
 documented there and in ``doc/robustness_notes.md``.
 """
 
+from . import breaker
+from . import chaos
 from . import faultinject
 from . import preemption
 from . import retry
+from .breaker import CircuitBreaker
 from .faultinject import FaultPlan, inject
 from .preemption import PreemptionGuard
 from .retry import RetryPolicy
 
 __all__ = [
+    "breaker",
+    "chaos",
     "faultinject",
     "preemption",
     "retry",
+    "CircuitBreaker",
     "FaultPlan",
     "inject",
     "PreemptionGuard",

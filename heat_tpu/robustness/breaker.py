@@ -1,0 +1,238 @@
+"""
+Deterministic circuit breakers for the runtime's fault-site call points.
+
+PR 6's recovery machinery absorbs *individual* failures — one flush rides the
+ladder, one save retries — but a *flapping* resource (a backend whose compiles
+keep failing, a disk whose reads keep erroring, an ICI link that keeps
+dropping) re-enters the full recovery path on every call: every flush pays a
+doomed compile attempt before its eager replay, every save pays the whole
+exponential backoff schedule. A circuit breaker remembers that a site is
+failing and routes callers straight to the degraded path until the site
+proves healthy again.
+
+One breaker per wrapped site, classic three-state semantics made
+**deterministic** — thresholds and cool-down are measured in *calls*, never
+wall time, so a test (or a replayed incident) sees the exact same state
+sequence every run:
+
+* **closed** — normal operation. ``record_failure`` increments a
+  *consecutive*-failure count (any success resets it); at
+  ``HEAT_TPU_BREAKER_THRESHOLD`` consecutive failures (default 5) the breaker
+  **opens**.
+* **open** — ``allow()`` returns False: the caller skips the doomed attempt
+  and takes its degraded path directly. Each refused call ticks the cool-down;
+  after ``HEAT_TPU_BREAKER_COOLDOWN`` refused calls (default 32) the breaker
+  goes **half-open** and that same call is granted as the probe.
+* **half-open** — exactly one probe is outstanding: its success closes the
+  breaker, its failure re-opens it (cool-down restarts); other calls arriving
+  while the probe is outstanding are refused.
+
+Degraded paths per site (the callers own them — the breaker only answers
+``allow()``):
+
+========================  ====================================================
+``fusion.compile``        ``materialize_for`` skips the doomed fused
+                          compile and goes straight to the recovery ladder's
+                          per-op eager replay rung (bit-identical to
+                          ``HEAT_TPU_FUSION=0`` by construction)
+``serving.cache_read``    ``serving/cache.py`` stops consulting the disk and
+                          serves in-memory-only (counted
+                          ``serving.disk_cache{breaker-open}``)
+``collective.dispatch``   collective-bearing fused flushes fail fast to the
+                          retained eager barrier path (the ladder's rung 3);
+                          the *eager* shims have no degraded path and only
+                          feed the breaker outcomes
+``io.write``/``io.read``  the shared :class:`~heat_tpu.robustness.retry
+                          .RetryPolicy` collapses to a single attempt (no
+                          backoff schedule) so a persistently failing disk
+                          fails loudly in bounded time
+========================  ====================================================
+
+Every state transition is counted ``robustness.breaker{site:state}`` and
+exported labelled by ``report.telemetry()`` — a production incident reads as
+an exact transition log, not a vibe.
+
+Env knobs: ``HEAT_TPU_BREAKERS=0`` disables the subsystem bit-for-bit
+(``allow()`` always True, outcomes ignored — the pre-PR-9 behavior);
+``HEAT_TPU_BREAKER_THRESHOLD`` / ``HEAT_TPU_BREAKER_COOLDOWN`` tune the call
+counts; ``HEAT_TPU_BREAKER_FORCE_OPEN="*"`` (or a comma-separated site list)
+pins breakers open — the CI leg that proves the degraded paths *alone* still
+pass the marked suites. All knobs are read per call (monkeypatch-friendly,
+the ``HEAT_TPU_FUSION`` cost class); defaults change nothing until a site
+actually fails ``threshold`` times in a row.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional
+
+from ..monitoring import instrument as _instr
+from ..monitoring.registry import STATE as _MON
+
+__all__ = [
+    "BREAKER_SITES",
+    "CircuitBreaker",
+    "breaker",
+    "enabled",
+    "forced_open",
+    "reset",
+    "states",
+]
+
+#: The fault-site call points wrapped by a breaker (a subset of
+#: ``faultinject.SITES`` — the sites with a meaningful degraded path).
+BREAKER_SITES = (
+    "fusion.compile",
+    "serving.cache_read",
+    "collective.dispatch",
+    "io.write",
+    "io.read",
+)
+
+_DEFAULT_THRESHOLD = 5
+_DEFAULT_COOLDOWN = 32
+
+
+def enabled() -> bool:
+    """Whether the breaker subsystem is active (default on; ``0`` restores
+    the pre-breaker behavior exactly — every call point attempts as before).
+    Read per call."""
+    val = os.environ.get("HEAT_TPU_BREAKERS", "")
+    return val.strip().lower() not in ("0", "false", "off")
+
+
+def _threshold() -> int:
+    try:
+        return max(1, int(os.environ.get("HEAT_TPU_BREAKER_THRESHOLD", "") or _DEFAULT_THRESHOLD))
+    except ValueError:
+        return _DEFAULT_THRESHOLD
+
+
+def _cooldown() -> int:
+    try:
+        return max(1, int(os.environ.get("HEAT_TPU_BREAKER_COOLDOWN", "") or _DEFAULT_COOLDOWN))
+    except ValueError:
+        return _DEFAULT_COOLDOWN
+
+
+def forced_open(site: str) -> bool:
+    """Whether ``HEAT_TPU_BREAKER_FORCE_OPEN`` pins this site's breaker open
+    (``"*"`` = every site, else a comma-separated site list)."""
+    spec = os.environ.get("HEAT_TPU_BREAKER_FORCE_OPEN", "").strip()
+    if not spec:
+        return False
+    if spec == "*":
+        return True
+    return site in tuple(s.strip() for s in spec.split(","))
+
+
+class CircuitBreaker:
+    """One deterministic breaker (see the module docstring for semantics).
+
+    Thread-safe: the serving scheduler drives flushes (and therefore breaker
+    consults) from worker threads. All counting is by calls, so a replayed
+    deterministic fault schedule produces the identical transition sequence.
+    """
+
+    __slots__ = ("site", "_state", "_failures", "_open_calls", "_lock")
+
+    def __init__(self, site: str):
+        self.site = site
+        self._state = "closed"
+        self._failures = 0
+        self._open_calls = 0
+        self._lock = threading.Lock()
+
+    def _transition(self, state: str) -> None:
+        self._state = state
+        if _MON.enabled:
+            _instr.breaker_transition(self.site, state)
+
+    def state(self) -> str:
+        """Current state: ``closed`` / ``open`` / ``half-open`` (or
+        ``forced-open`` while the env pin is active)."""
+        if forced_open(self.site):
+            return "forced-open"
+        return self._state
+
+    def allow(self) -> bool:
+        """Whether the caller should attempt the wrapped operation. False
+        means: take the degraded path now. Refused calls tick the open
+        breaker's cool-down; the call that exhausts it is granted as the
+        half-open probe."""
+        if forced_open(self.site):
+            return False
+        if not enabled():
+            return True
+        with self._lock:
+            if self._state == "closed":
+                return True
+            if self._state == "open":
+                self._open_calls += 1
+                if self._open_calls >= _cooldown():
+                    self._transition("half-open")
+                    return True  # this call is the probe
+                return False
+            return False  # half-open: a probe is already outstanding
+
+    def record_success(self) -> None:
+        """One wrapped operation succeeded: reset the consecutive-failure
+        count; a successful half-open probe (or any success observed while
+        open) closes the breaker."""
+        if forced_open(self.site) or not enabled():
+            return
+        with self._lock:
+            self._failures = 0
+            if self._state != "closed":
+                self._open_calls = 0
+                self._transition("closed")
+
+    def record_failure(self) -> None:
+        """One wrapped operation failed: open after ``threshold`` consecutive
+        failures; a failed half-open probe re-opens (cool-down restarts)."""
+        if forced_open(self.site) or not enabled():
+            return
+        with self._lock:
+            if self._state == "half-open":
+                self._open_calls = 0
+                self._transition("open")
+            elif self._state == "closed":
+                self._failures += 1
+                if self._failures >= _threshold():
+                    self._open_calls = 0
+                    self._transition("open")
+            # open: refused callers never attempted; nothing new to learn
+
+
+_BREAKERS: Dict[str, CircuitBreaker] = {}
+_REG_LOCK = threading.Lock()
+
+
+def breaker(site: str) -> CircuitBreaker:
+    """The process-wide breaker for ``site`` (created on first use; unknown
+    sites raise — a typo must not mint a silently-unwired breaker)."""
+    b = _BREAKERS.get(site)
+    if b is None:
+        if site not in BREAKER_SITES:
+            raise ValueError(
+                f"unknown breaker site {site!r}; known sites: {BREAKER_SITES}"
+            )
+        with _REG_LOCK:
+            b = _BREAKERS.setdefault(site, CircuitBreaker(site))
+    return b
+
+
+def states() -> Dict[str, str]:
+    """Current state per instantiated breaker (diagnostics / telemetry)."""
+    return {site: b.state() for site, b in sorted(_BREAKERS.items())}
+
+
+def reset(site: Optional[str] = None) -> None:
+    """Drop breaker state (all sites, or one) back to closed-with-no-history.
+    Tests and operator interventions use this; it does not count transitions."""
+    if site is None:
+        _BREAKERS.clear()
+    else:
+        _BREAKERS.pop(site, None)

@@ -1,0 +1,224 @@
+"""
+Seeded multi-site chaos schedules, derandomized onto the PR 6 plan machinery.
+
+Single-site fault plans prove each recovery path in isolation; production
+failure is *correlated* — a flaky host degrades compiles, disk reads and
+collectives in the same window. This module generates a reproducible
+pseudo-random fault schedule across many sites at once and lowers it, **at
+install time**, into exact per-call :class:`~heat_tpu.robustness.faultinject
+.FaultPlan` schedules — so a chaos run is as replayable as a hand-written
+``at_calls`` list: the same seed always fires the same faults on the same
+calls, on every machine.
+
+Spec (``HEAT_TPU_CHAOS`` or :func:`install`)::
+
+    "seed:rate[:sites]"          e.g.  "1234:0.08"
+                                       "7:0.2:fusion.compile,io.write"
+
+* ``seed`` — any string; the schedule derives from ``Random(f"{seed}:{site}")``
+  (string seeding is hash-salt-independent, so the schedule is identical
+  across processes and machines).
+* ``rate`` — per-site fire probability in ``[0, 1]``, applied independently
+  per call index during derandomization.
+* ``sites`` — optional comma list; default :data:`DEFAULT_SITES` — the sites
+  whose faults are *always* absorbed whatever the call context: the fusion
+  ladder (``fusion.compile``/``fusion.execute``), the cache-read fallback
+  (``serving.cache_read``), and the IO retry policy (``io.write``/
+  ``io.read``). ``collective.dispatch`` is deliberately **not** a default:
+  a collective recorded in a fused flush recovers through the ladder, but an
+  *eager* shim dispatch has no retained graph and raises at the call site by
+  design — name it explicitly to chaos-test fused collective pipelines.
+
+Derandomization walks call indices ``1..HEAT_TPU_CHAOS_HORIZON`` (default
+4096) once per site and records the firing calls as an explicit ``at_calls``
+set — after install there is **no randomness left anywhere on the hot path**.
+Two safety properties are enforced structurally:
+
+* at most :data:`MAX_CONSECUTIVE` (2) consecutive calls of one site fire, so
+  the bounded recovery mechanisms always get a clean attempt (the default
+  3-attempt IO retry schedule can always land; the fused ladder's eager
+  replay consults no site at all);
+* each site raises its *recoverable* exception class — ``OSError`` for the
+  IO/checkpoint sites (the retry policy's selectivity), ``RuntimeError``
+  elsewhere — so every fired fault lands in machinery that absorbs it
+  bit-identically.
+
+Fired chaos faults count ``robustness.chaos{site}`` (on top of the usual
+``faults.injected{site}``), so a chaos CI run's telemetry proves the degraded
+paths — ladders, breakers, retries — actually carried the load rather than
+the schedule happening to miss. The ``chaos-smoke`` CI job runs the
+fusion+serving+robustness marker suites under a standing ``HEAT_TPU_CHAOS``
+schedule; count-asserting tests pin it off via their ``no_faults`` fixtures
+(the PR 6 precedent).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from typing import Dict, List, Optional, Tuple
+
+from . import faultinject as _FI
+
+__all__ = [
+    "DEFAULT_SITES",
+    "MAX_CONSECUTIVE",
+    "ChaosPlan",
+    "parse",
+    "schedule_for",
+    "plans",
+    "install",
+    "clear",
+]
+
+ENV_VAR = "HEAT_TPU_CHAOS"
+
+#: Sites a default chaos schedule exercises: each one sits behind a recovery
+#: mechanism that absorbs the fault bit-identically in EVERY call context
+#: (``collective.dispatch`` is opt-in — eager shim dispatches raise by
+#: design; see the module docstring).
+DEFAULT_SITES = (
+    "fusion.compile",
+    "fusion.execute",
+    "serving.cache_read",
+    "io.write",
+    "io.read",
+)
+
+#: Hard structural cap on consecutive fires per site (see module docstring).
+MAX_CONSECUTIVE = 2
+
+#: Exception class per site — the one its recovery machinery is selective on.
+_EXC_FOR = {
+    "io.write": OSError,
+    "io.read": OSError,
+    "checkpoint.write": OSError,
+}
+
+
+def _horizon() -> int:
+    try:
+        return max(1, int(os.environ.get("HEAT_TPU_CHAOS_HORIZON", "4096")))
+    except ValueError:
+        return 4096
+
+
+class ChaosPlan(_FI.FaultPlan):
+    """A derandomized chaos schedule for one site — a plain
+    :class:`~heat_tpu.robustness.faultinject.FaultPlan` whose fires
+    additionally count ``robustness.chaos{site}`` (the ``is_chaos`` flag is
+    what :func:`faultinject.check` keys the extra counter on)."""
+
+    is_chaos = True
+
+
+def parse(spec: str) -> Tuple[str, float, Tuple[str, ...]]:
+    """Validate a chaos spec into ``(seed, rate, sites)``. Malformed specs
+    raise :class:`~heat_tpu.robustness.faultinject.FaultPlanError` — a config
+    error, never silently ignored."""
+    parts = spec.strip().split(":")
+    if len(parts) not in (2, 3) or not parts[0]:
+        raise _FI.FaultPlanError(
+            f"malformed {ENV_VAR} spec {spec!r} (expected seed:rate[:sites])"
+        )
+    seed = parts[0]
+    try:
+        rate = float(parts[1])
+    except ValueError:
+        raise _FI.FaultPlanError(
+            f"malformed {ENV_VAR} rate {parts[1]!r} in {spec!r}"
+        ) from None
+    if not 0.0 <= rate <= 1.0:
+        raise _FI.FaultPlanError(f"{ENV_VAR} rate must be in [0,1]: {spec!r}")
+    if len(parts) == 3 and parts[2].strip():
+        sites = tuple(s.strip() for s in parts[2].split(",") if s.strip())
+        for s in sites:
+            if s not in _FI.SITES:
+                raise _FI.FaultPlanError(f"unknown chaos site {s!r} in {spec!r}")
+    else:
+        sites = DEFAULT_SITES
+    return seed, rate, sites
+
+
+def schedule_for(seed: str, rate: float, site: str, horizon: Optional[int] = None) -> List[int]:
+    """The exact (sorted) firing call indices for one site: the whole
+    derandomization. ``Random(f"{seed}:{site}")`` makes per-site streams
+    independent, and the :data:`MAX_CONSECUTIVE` cap is applied in-walk so it
+    is part of the deterministic schedule, not a runtime judgment."""
+    horizon = _horizon() if horizon is None else horizon
+    rng = random.Random(f"{seed}:{site}")
+    at: List[int] = []
+    run = 0
+    for call in range(1, horizon + 1):
+        if rng.random() < rate and run < MAX_CONSECUTIVE:
+            at.append(call)
+            run += 1
+        else:
+            run = 0
+    return at
+
+
+def plans(spec: str) -> Dict[str, List[ChaosPlan]]:
+    """Derandomized per-site plans for a chaos spec (empty schedules are
+    dropped — a site the dice never hit installs nothing)."""
+    seed, rate, sites = parse(spec)
+    out: Dict[str, List[ChaosPlan]] = {}
+    for site in sites:
+        at = schedule_for(seed, rate, site)
+        if not at:
+            continue
+        exc_cls = _EXC_FOR.get(site, RuntimeError)
+        plan = ChaosPlan(site, exc_cls, at)
+        out[site] = [plan]
+    return out
+
+
+class _Installed:
+    """Handle over a programmatically installed chaos schedule (context
+    manager; ``fired()`` aggregates the per-site audit trails)."""
+
+    def __init__(self, by_site: Dict[str, List[ChaosPlan]]):
+        self.by_site = by_site
+
+    def fired(self) -> Dict[str, List[int]]:
+        return {
+            site: [c for p in ps for c in p.fired]
+            for site, ps in self.by_site.items()
+        }
+
+    def remove(self) -> None:
+        for ps in self.by_site.values():
+            for p in ps:
+                p.remove()
+
+    def __enter__(self) -> "_Installed":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.remove()
+        return False
+
+
+def install(spec: str, reset_counts: bool = True) -> _Installed:
+    """Install a chaos schedule programmatically (the env-free twin of
+    ``HEAT_TPU_CHAOS``): every site's derandomized plan lands in the
+    programmatic plan table, scheduled relative to this install when
+    ``reset_counts`` (the default, what a test wants)."""
+    by_site = plans(spec)
+    for site, ps in by_site.items():
+        if reset_counts:
+            _FI.reset_counts(site)
+        for p in ps:
+            _FI._PLANS.setdefault(site, []).append(p)
+    return _Installed(by_site)
+
+
+def clear() -> None:
+    """Remove every programmatically installed chaos plan (env-driven
+    schedules are controlled by the ``HEAT_TPU_CHAOS`` variable itself)."""
+    for site, ps in list(_FI._PLANS.items()):
+        kept = [p for p in ps if not getattr(p, "is_chaos", False)]
+        if kept:
+            _FI._PLANS[site] = kept
+        else:
+            del _FI._PLANS[site]

@@ -97,6 +97,9 @@ SITES = (
 )
 
 ENV_VAR = "HEAT_TPU_FAULT_PLAN"
+#: seeded multi-site chaos schedules (``robustness/chaos.py``) ride the same
+#: check() merge as programmatic/env plans — derandomized at parse time
+CHAOS_ENV_VAR = "HEAT_TPU_CHAOS"
 
 #: programmatic plans per site (insertion order preserved)
 _PLANS: dict = {}
@@ -104,6 +107,8 @@ _PLANS: dict = {}
 _COUNTS: dict = {}
 #: cached parse of the env plan, keyed on the exact env string
 _ENV_CACHE: tuple = ("", {})
+#: cached derandomized chaos plans, keyed on the exact HEAT_TPU_CHAOS string
+_CHAOS_CACHE: tuple = ("", {})
 
 
 class FaultPlan:
@@ -216,8 +221,12 @@ def reset_counts(site: Optional[str] = None) -> None:
 
 
 def active() -> bool:
-    """Whether any fault plan (programmatic or env) is currently installed."""
-    return bool(_PLANS) or bool(os.environ.get(ENV_VAR))
+    """Whether any fault plan (programmatic, env, or chaos) is installed."""
+    return (
+        bool(_PLANS)
+        or bool(os.environ.get(ENV_VAR))
+        or bool(os.environ.get(CHAOS_ENV_VAR))
+    )
 
 
 _ENV_ENTRY = re.compile(
@@ -283,17 +292,38 @@ def _env_plans() -> dict:
     return plans
 
 
+def _chaos_env_plans() -> dict:
+    """Derandomized plans for the standing ``HEAT_TPU_CHAOS`` schedule,
+    cached on the exact env string (the parse — and the whole schedule
+    derandomization — happens once per distinct spec)."""
+    global _CHAOS_CACHE
+    spec = os.environ.get(CHAOS_ENV_VAR, "")
+    if spec == _CHAOS_CACHE[0]:
+        return _CHAOS_CACHE[1]
+    if spec:
+        from . import chaos as _chaos
+
+        plans = _chaos.plans(spec)
+    else:
+        plans = {}
+    _CHAOS_CACHE = (spec, plans)
+    return plans
+
+
 def check(site: str) -> None:
     """The hook the instrumented sites call. Raises the planned exception when
     the site's call count matches an installed plan; otherwise returns (and,
     with no plan installed for the site, returns without even counting)."""
     plans = _PLANS.get(site)
     spec = os.environ.get(ENV_VAR)
-    if not plans and not spec:
+    chaos_spec = os.environ.get(CHAOS_ENV_VAR)
+    if not plans and not spec and not chaos_spec:
         return
     merged = list(plans) if plans else []
     if spec:
         merged.extend(_env_plans().get(site, ()))
+    if chaos_spec:
+        merged.extend(_chaos_env_plans().get(site, ()))
     if not merged:
         return
     count = _COUNTS[site] = _COUNTS.get(site, 0) + 1
@@ -302,4 +332,6 @@ def check(site: str) -> None:
             plan.fired.append(count)
             if _MON.enabled:
                 _instr.fault_injected(site)
+                if getattr(plan, "is_chaos", False):
+                    _instr.chaos_fire(site)
             raise plan.make(count)

@@ -17,6 +17,20 @@ their host filesystem work through:
 * **Selective**: only ``retry_on`` exception types are retried (default
   ``OSError`` — which covers ``EIO``/``ENOSPC``/NFS hiccups); everything else
   (a type error, a corrupt-input ``ValueError``) propagates on the first try.
+* **Budgeted** (optional, ISSUE 9): a *total-deadline budget*
+  (``HEAT_TPU_IO_RETRY_BUDGET_MS`` / ``budget=`` seconds) caps the cumulative
+  *planned* backoff — a bounded-latency caller stops retrying once the next
+  scheduled delay would exceed the budget, and the last exception propagates.
+  The budget is charged against the deterministic schedule, not measured wall
+  time, so a budgeted run still replays exactly. Default off — the schedule
+  is bit-for-bit the PR 6 behavior.
+* **Breaker-aware** (ISSUE 9): every attempt outcome feeds the ``io.write`` /
+  ``io.read`` circuit breakers (:mod:`heat_tpu.robustness.breaker`; the
+  breaker site derives from the counter site — ``load_*`` reads, everything
+  else writes). While a breaker is **open**, the policy collapses to a single
+  attempt with no backoff — a persistently failing disk fails loudly in
+  bounded time instead of charging every caller the full schedule; the
+  half-open probe (and any success) closes it again.
 
 Each retried attempt increments ``io.retries{site}``, so the telemetry block
 shows exactly which writer paths are riding the policy.
@@ -30,10 +44,11 @@ from __future__ import annotations
 
 import os
 import time
-from typing import Callable, Tuple, Type
+from typing import Callable, Optional, Tuple, Type
 
 from ..monitoring import instrument as _instr
 from ..monitoring.registry import STATE as _MON
+from . import breaker as _BRK
 
 __all__ = ["RetryPolicy", "policy"]
 
@@ -41,7 +56,10 @@ __all__ = ["RetryPolicy", "policy"]
 class RetryPolicy:
     """Bounded exponential-backoff retry (see the module docstring)."""
 
-    __slots__ = ("max_attempts", "base_delay", "multiplier", "max_delay", "retry_on")
+    __slots__ = (
+        "max_attempts", "base_delay", "multiplier", "max_delay", "retry_on",
+        "budget",
+    )
 
     def __init__(
         self,
@@ -50,6 +68,7 @@ class RetryPolicy:
         multiplier: float = 2.0,
         max_delay: float = 2.0,
         retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+        budget: Optional[float] = None,
     ):
         if max_attempts < 1:
             raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
@@ -58,32 +77,53 @@ class RetryPolicy:
         self.multiplier = float(multiplier)
         self.max_delay = float(max_delay)
         self.retry_on = tuple(retry_on)
+        self.budget = None if budget is None else float(budget)
 
     def delay(self, attempt: int) -> float:
         """Backoff before retry number ``attempt`` (1-based)."""
         return min(self.base_delay * self.multiplier ** (attempt - 1), self.max_delay)
 
+    @staticmethod
+    def _breaker_site(site: str) -> str:
+        """The breaker governing this counter site: ``load_*`` (and explicit
+        ``io.read``) feed the read breaker, all writer paths the write one."""
+        return "io.read" if site.startswith("load") or site == "io.read" else "io.write"
+
     def call(self, fn: Callable, site: str = "io", sleep: Callable = time.sleep):
         """Run ``fn()``; on a ``retry_on`` failure, back off and retry up to
         ``max_attempts`` total tries, counting each retry under
-        ``io.retries{site}``. The final failure propagates unchanged."""
+        ``io.retries{site}``. The final failure propagates unchanged. An open
+        ``io.*`` circuit breaker collapses the schedule to one attempt; an
+        exhausted total-deadline budget stops the schedule early."""
+        b = _BRK.breaker(self._breaker_site(site))
+        attempts = self.max_attempts if b.allow() else 1
         attempt = 1
+        planned = 0.0
         while True:
             try:
-                return fn()
+                r = fn()
+                b.record_success()
+                return r
             except self.retry_on as e:
-                if attempt >= self.max_attempts:
+                b.record_failure()
+                if attempt >= attempts:
                     raise
+                d = self.delay(attempt)
+                if self.budget is not None and planned + d > self.budget:
+                    raise  # the next scheduled delay would blow the budget
                 if _MON.enabled:
                     _instr.io_retry(site)
-                sleep(self.delay(attempt))
+                sleep(d)
+                planned += d
                 attempt += 1
                 del e  # keep the traceback chain out of the retained frame
 
 
 def policy() -> RetryPolicy:
     """The default IO retry policy, honoring the env tuning knobs (re-read per
-    call — these are cold paths, and tests flip the knobs mid-process)."""
+    call — these are cold paths, and tests flip the knobs mid-process).
+    ``HEAT_TPU_IO_RETRY_BUDGET_MS`` (unset = no budget, the deterministic PR 6
+    schedule bit-for-bit) caps the cumulative planned backoff."""
     try:
         attempts = int(os.environ.get("HEAT_TPU_IO_RETRIES", "3"))
     except ValueError:
@@ -92,4 +132,11 @@ def policy() -> RetryPolicy:
         base = float(os.environ.get("HEAT_TPU_IO_RETRY_DELAY", "0.05"))
     except ValueError:
         base = 0.05
-    return RetryPolicy(max_attempts=max(attempts, 1), base_delay=base)
+    budget = None
+    spec = os.environ.get("HEAT_TPU_IO_RETRY_BUDGET_MS", "").strip()
+    if spec:
+        try:
+            budget = float(spec) / 1000.0
+        except ValueError:
+            budget = None
+    return RetryPolicy(max_attempts=max(attempts, 1), base_delay=base, budget=budget)

@@ -25,7 +25,14 @@ cold start without paying a single JIT:
 * :mod:`~heat_tpu.serving.scheduler` — async flush scheduler
   (:func:`schedule` / :func:`flush_all`, and
   ``DNDarray.flush_async()``): device dispatch of one flush overlaps the
-  host-side trace/key work of the next.
+  host-side trace/key work of the next; bounded admission queue
+  (``HEAT_TPU_SERVING_QUEUE_MAX`` + ``block``/``shed`` overflow policy) and
+  per-flush deadlines (``HEAT_TPU_FLUSH_DEADLINE_MS``, enforced at dequeue —
+  shed work stays bit-exact because the owner's read still materializes it).
+* :mod:`~heat_tpu.serving.janitor` — disk-cache janitor
+  (``HEAT_TPU_CACHE_MAX_BYTES`` + ``python -m heat_tpu.serving.janitor``):
+  LRU-by-mtime eviction to the size bound, corrupt-entry quarantine, and
+  orphaned-tempfile sweep, safe under concurrent multi-process writers.
 
 Everything is env-gated and inert by default: with no ``HEAT_TPU_CACHE_DIR``
 and no ``HEAT_TPU_SHAPE_BUCKETS`` the flush path is byte-for-byte the PR 7
@@ -37,7 +44,7 @@ behavior (the cold-dir CI leg proves it). Counters: ``serving.disk_cache``
 SLO) in ``report.telemetry()``. See ``doc/serving_notes.md``.
 """
 
-from . import buckets, cache, corpus, scheduler
+from . import buckets, cache, corpus, janitor, scheduler
 from .scheduler import FlushScheduler, flush_all, schedule
 from .warmup import warmup
 
@@ -45,6 +52,7 @@ __all__ = [
     "buckets",
     "cache",
     "corpus",
+    "janitor",
     "scheduler",
     "FlushScheduler",
     "flush_all",

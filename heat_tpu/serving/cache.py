@@ -28,7 +28,11 @@ Counters (``serving.disk_cache``): ``hit`` (entry deserialized and used),
 ``miss`` (no entry on disk), ``write`` (entry serialized and stored),
 ``incompatible`` (program has no stable identity, a leaf layout is not
 describable, the backend fingerprint changed, or serialization is
-unsupported), ``corrupt`` (an on-disk entry existed but could not be read).
+unsupported), ``corrupt`` (an on-disk entry existed but could not be read —
+genuinely unreadable files are additionally *quarantined* via
+``serving/janitor.py``), ``breaker-open`` (the ``serving.cache_read``
+circuit breaker is open: the disk was not consulted and the flush serves
+in-memory-only until a half-open probe succeeds).
 """
 
 from __future__ import annotations
@@ -43,6 +47,7 @@ import numpy as np
 
 from ..monitoring import instrument as _instr
 from ..monitoring.registry import STATE as _MON
+from ..robustness import breaker as _BRK
 from ..robustness import faultinject as _FI
 
 __all__ = [
@@ -224,13 +229,35 @@ def load(cache_dir_: str, digest: str):
     ``miss``, a fingerprint/format mismatch counts ``incompatible``, and any
     other failure — truncated file, pickle garbage, an injected
     ``serving.cache_read`` fault, a deserialization error — counts
-    ``corrupt``; every non-hit falls back to a fresh compile."""
+    ``corrupt``; every non-hit falls back to a fresh compile.
+
+    Production hardening (ISSUE 9): reads ride the ``serving.cache_read``
+    circuit breaker — a flapping disk opens it after N consecutive failures
+    and the flush path serves in-memory-only (counted ``breaker-open``) until
+    a half-open probe succeeds. A *genuinely unreadable* file (not an
+    injected fault) is quarantined via the janitor so future scans and reads
+    never touch it; a hit refreshes the entry's mtime so the janitor's
+    LRU-by-mtime eviction order tracks real use across processes."""
+    b = _BRK.breaker("serving.cache_read")
+    if not b.allow():
+        _count("breaker-open")
+        return None
     path = entry_path(cache_dir_, digest)
     try:
         _FI.check("serving.cache_read")
+    except (KeyboardInterrupt, SystemExit, _FI.FaultPlanError):
+        raise
+    except Exception:
+        # an injected read fault: counted like a corrupt read and fed to the
+        # breaker, but the on-disk entry is NOT quarantined (it may be fine)
+        b.record_failure()
+        _count("corrupt")
+        return None
+    try:
         with open(path, "rb") as f:
             entry = pickle.load(f)
         if entry.get("format") != _FORMAT or entry.get("fp") != fingerprint():
+            b.record_success()  # the read mechanism worked; the entry is foreign
             _count("incompatible")
             return None
         from jax.experimental.serialize_executable import deserialize_and_load
@@ -238,15 +265,28 @@ def load(cache_dir_: str, digest: str):
         loaded = deserialize_and_load(
             entry["payload"], entry["in_tree"], entry["out_tree"]
         )
+        b.record_success()
         _count("hit")
+        try:
+            os.utime(path)  # LRU signal for the janitor's mtime eviction
+        except OSError:
+            pass
         return loaded
     except FileNotFoundError:
+        b.record_success()  # a clean miss (or a janitor eviction): not a fault
         _count("miss")
         return None
-    except (KeyboardInterrupt, SystemExit, _FI.FaultPlanError):
+    except (KeyboardInterrupt, SystemExit):
         raise
     except Exception:
+        b.record_failure()
         _count("corrupt")
+        try:
+            from . import janitor as _janitor
+
+            _janitor._quarantine(cache_dir_, path)
+        except Exception:
+            pass  # quarantine is best-effort; the fallback compile proceeds
         return None
 
 
@@ -289,6 +329,12 @@ def persist(cache_dir_: str, digest: str, compiled) -> bool:
         )
         _atomic_write(entry_path(cache_dir_, digest), blob)
         _count("write")
+        from . import janitor as _janitor
+
+        # inline size enforcement: one env read when HEAT_TPU_CACHE_MAX_BYTES
+        # is unset; with a bound, evict LRU entries so the cache never
+        # exceeds it by more than the entry just written
+        _janitor.maybe_sweep(cache_dir_)
         return True
     except (KeyboardInterrupt, SystemExit):
         raise

@@ -1,0 +1,316 @@
+"""
+Disk-cache janitor: keep ``HEAT_TPU_CACHE_DIR`` bounded, clean and honest.
+
+The persistent L2 cache (``cache.py``) and the shape corpus (``corpus.py``)
+only ever *add* files — a long-lived serving deployment would grow the
+directory without bound, and a crashed writer can leave tempfiles (the
+atomic-rename idiom guarantees no torn entries, but the ``.tmp-*`` source of
+a killed ``os.replace`` stays behind). The janitor closes both gaps, plus
+the one the loader only half-handles: a corrupt entry used to be *skipped*
+on every read forever; now it is **quarantined** so future scans (and future
+reads) never touch it again.
+
+What one :func:`sweep` does, in order:
+
+1. **Orphaned-tempfile sweep** — ``.tmp-*`` files in the exec/corpus dirs
+   older than ``orphan_age_s`` (default 300 s; the age gate keeps a sweep
+   from racing an in-flight writer's live tempfile) are deleted, counted
+   ``serving.janitor{orphans}``.
+2. **Quarantine** (``validate=True``, the CLI default) — every ``exec``
+   entry must unpickle to a dict with the expected fields, every corpus
+   entry to a dict; failures are **moved** to ``<dir>/quarantine/`` (atomic
+   ``os.replace`` — never deleted: a poisoned entry is evidence), counted
+   ``serving.janitor{quarantined}``. The quarantine directory is outside
+   every scan, so a poisoned file costs its discovery once.
+3. **LRU-by-mtime eviction** — when the combined size of the exec entries
+   and corpus recipes exceeds ``max_bytes`` (``HEAT_TPU_CACHE_MAX_BYTES``),
+   the oldest-mtime files are unlinked until the total is ≤ the bound,
+   counted ``serving.janitor{evicted}`` / ``{evicted_bytes}``. ``cache.load``
+   touches an entry's mtime on every hit, so mtime order approximates LRU
+   across processes without any shared index.
+
+**Concurrency contract** (multi-process writers and readers share the dir):
+every unlink/replace tolerates ``FileNotFoundError`` (a racing janitor or
+writer got there first); a reader that already ``open()``-ed an entry keeps
+its POSIX handle through an eviction; a reader that loses the race to the
+unlink sees a clean ``miss`` and recompiles (``cache.load``'s existing
+discipline). Nothing here can crash a flush.
+
+Runs two ways:
+
+* **inline at store time** — ``cache.persist`` calls :func:`maybe_sweep`
+  after each write; with ``HEAT_TPU_CACHE_MAX_BYTES`` unset this is one env
+  read (the default — current behavior, unbounded), with a bound set it
+  sweeps eviction+orphans (no validation pass) so the cache never exceeds
+  the bound by more than the entry just written;
+* **as a CLI** — ``python -m heat_tpu.serving.janitor [--cache-dir DIR]
+  [--max-bytes N] [--orphan-age S] [--no-validate] [--dry-run]`` prints the
+  stats as one JSON line (the cron-job / init-container form).
+
+Counters: ``serving.janitor{runs,evicted,evicted_bytes,quarantined,orphans}``
+(mixed units by design — the labels are the content), exported labelled via
+``report.telemetry()``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pickle
+import sys
+from typing import List, Optional, Tuple
+
+from ..monitoring import instrument as _instr
+from ..monitoring.registry import STATE as _MON
+
+__all__ = [
+    "max_bytes",
+    "scan",
+    "sweep",
+    "maybe_sweep",
+    "quarantine_dir",
+    "main",
+]
+
+ENV_VAR = "HEAT_TPU_CACHE_MAX_BYTES"
+
+#: exec-entry fields a valid cache entry must carry (cache.py's format)
+_ENTRY_FIELDS = ("format", "fp", "payload", "in_tree", "out_tree")
+
+#: minimum age (seconds) before a tempfile counts as orphaned by default —
+#: generous versus any real write, small versus a janitor cadence
+DEFAULT_ORPHAN_AGE_S = 300.0
+
+
+def max_bytes() -> Optional[int]:
+    """The configured cache size bound in bytes, or None when unbounded
+    (``HEAT_TPU_CACHE_MAX_BYTES`` unset/empty/0 — the default, current
+    behavior). Read per call."""
+    spec = os.environ.get(ENV_VAR, "").strip()
+    if not spec:
+        return None
+    try:
+        val = int(spec)
+    except ValueError:
+        raise ValueError(f"malformed {ENV_VAR} value {spec!r} (expected bytes)")
+    return val if val > 0 else None
+
+
+def quarantine_dir(cache_dir: str) -> str:
+    return os.path.join(cache_dir, "quarantine")
+
+
+def _count(kind: str, n: int = 1) -> None:
+    if _MON.enabled and n:
+        _instr.serving_janitor(kind, n)
+
+
+def _listdir(d: str) -> List[str]:
+    try:
+        return os.listdir(d)
+    except OSError:
+        return []
+
+
+def scan(cache_dir: str) -> Tuple[List[Tuple[str, int, float]], List[str]]:
+    """One pass over the governed files: returns ``(entries, tempfiles)``
+    where entries are ``(path, size, mtime)`` for every exec/corpus file and
+    tempfiles are the ``.tmp-*`` paths seen. Files that vanish mid-scan (a
+    concurrent janitor/writer) are simply not reported."""
+    entries: List[Tuple[str, int, float]] = []
+    tmps: List[str] = []
+    for sub, suffix in (("exec", ".bin"), ("corpus", ".pkl")):
+        d = os.path.join(cache_dir, sub)
+        for name in _listdir(d):
+            path = os.path.join(d, name)
+            if name.startswith(".tmp-"):
+                tmps.append(path)
+                continue
+            if not name.endswith(suffix):
+                continue
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            entries.append((path, int(st.st_size), float(st.st_mtime)))
+    return entries, tmps
+
+
+def _quarantine(cache_dir: str, path: str) -> bool:
+    """Move one poisoned file into the quarantine dir (atomic, tolerant of a
+    concurrent eviction winning the race)."""
+    qdir = quarantine_dir(cache_dir)
+    try:
+        os.makedirs(qdir, exist_ok=True)
+        os.replace(path, os.path.join(qdir, os.path.basename(path)))
+        return True
+    except OSError:
+        return False
+
+
+def _valid_entry(path: str) -> bool:
+    """Whether one exec/corpus file unpickles to its expected layout. Reads
+    the whole file — the validation pass is a CLI/maintenance concern, not a
+    hot-path one."""
+    try:
+        with open(path, "rb") as f:
+            entry = pickle.load(f)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        return False
+    if not isinstance(entry, dict):
+        return False
+    if path.endswith(".bin"):
+        return all(k in entry for k in _ENTRY_FIELDS)
+    return True
+
+
+def sweep(
+    cache_dir: str,
+    limit: Optional[int] = None,
+    orphan_age_s: float = DEFAULT_ORPHAN_AGE_S,
+    validate: bool = False,
+    dry_run: bool = False,
+) -> dict:
+    """One full janitor pass (see the module docstring for the three stages).
+    ``limit=None`` reads ``HEAT_TPU_CACHE_MAX_BYTES`` (None = no eviction).
+    Returns the stats dict; counts every action under ``serving.janitor``."""
+    import time
+
+    if limit is None:
+        limit = max_bytes()
+    stats = {
+        "entries": 0,
+        "bytes": 0,
+        "limit": limit,
+        "orphans": 0,
+        "quarantined": 0,
+        "evicted": 0,
+        "evicted_bytes": 0,
+    }
+    entries, tmps = scan(cache_dir)
+
+    now = time.time()
+    for path in tmps:
+        try:
+            age = now - os.stat(path).st_mtime
+        except OSError:
+            continue
+        if age < orphan_age_s:
+            continue
+        if not dry_run:
+            try:
+                os.unlink(path)
+            except OSError:
+                continue
+        stats["orphans"] += 1
+
+    if validate:
+        kept = []
+        for path, size, mtime in entries:
+            if _valid_entry(path):
+                kept.append((path, size, mtime))
+            else:
+                if not dry_run and not _quarantine(cache_dir, path):
+                    continue
+                stats["quarantined"] += 1
+        entries = kept
+
+    total = sum(size for _p, size, _m in entries)
+    stats["entries"] = len(entries)
+    stats["bytes"] = total
+    if limit is not None and total > limit:
+        # LRU by mtime: oldest first (cache.load touches mtime on every hit)
+        for path, size, _mtime in sorted(entries, key=lambda e: e[2]):
+            if total <= limit:
+                break
+            if not dry_run:
+                try:
+                    os.unlink(path)
+                except FileNotFoundError:
+                    continue  # a concurrent janitor evicted it already
+                except OSError:
+                    continue
+            total -= size
+            stats["evicted"] += 1
+            stats["evicted_bytes"] += size
+        stats["bytes"] = total
+    _count("runs")
+    _count("orphans", stats["orphans"])
+    _count("quarantined", stats["quarantined"])
+    _count("evicted", stats["evicted"])
+    _count("evicted_bytes", stats["evicted_bytes"])
+    return stats
+
+
+def maybe_sweep(cache_dir: str) -> Optional[dict]:
+    """The inline store-time hook (``cache.persist`` calls this after every
+    write): with no ``HEAT_TPU_CACHE_MAX_BYTES`` it is one env read; with a
+    bound it runs an eviction+orphan sweep (no validation pass — a store must
+    stay cheap). Never raises: a janitor problem must not fail a flush."""
+    try:
+        if max_bytes() is None:
+            return None
+        return sweep(cache_dir, validate=False)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        return None
+
+
+def main(argv=None) -> int:
+    """CLI entry point (``python -m heat_tpu.serving.janitor``)."""
+    p = argparse.ArgumentParser(
+        prog="python -m heat_tpu.serving.janitor",
+        description="Bound, validate and clean a persistent compilation cache "
+        "directory: orphaned-tempfile sweep, corrupt-entry quarantine, and "
+        "LRU-by-mtime eviction down to the size bound.",
+    )
+    p.add_argument(
+        "--cache-dir", default=None, help="cache directory (default: $HEAT_TPU_CACHE_DIR)"
+    )
+    p.add_argument(
+        "--max-bytes",
+        type=int,
+        default=None,
+        help="size bound in bytes (default: $HEAT_TPU_CACHE_MAX_BYTES; omit both for no eviction)",
+    )
+    p.add_argument(
+        "--orphan-age",
+        type=float,
+        default=DEFAULT_ORPHAN_AGE_S,
+        help="seconds before a .tmp-* file counts as orphaned (default 300)",
+    )
+    p.add_argument(
+        "--no-validate",
+        action="store_true",
+        help="skip the corrupt-entry quarantine pass",
+    )
+    p.add_argument(
+        "--dry-run", action="store_true", help="report what would happen; touch nothing"
+    )
+    p.add_argument("-q", "--quiet", action="store_true", help="suppress the stats line")
+    args = p.parse_args(argv)
+    cache_dir = args.cache_dir or os.environ.get("HEAT_TPU_CACHE_DIR", "").strip()
+    if not cache_dir:
+        print(
+            "janitor needs a cache directory (HEAT_TPU_CACHE_DIR or --cache-dir)",
+            file=sys.stderr,
+        )
+        return 2
+    stats = sweep(
+        cache_dir,
+        limit=args.max_bytes,
+        orphan_age_s=args.orphan_age,
+        validate=not args.no_validate,
+        dry_run=args.dry_run,
+    )
+    if not args.quiet:
+        print(json.dumps(stats, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

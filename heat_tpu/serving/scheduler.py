@@ -1,6 +1,7 @@
 """
 Async flush scheduler: dispatch independent pending DAGs from concurrent
-requests without serializing Python-side flush prep on one thread.
+requests without serializing Python-side flush prep on one thread — and keep
+the process healthy when traffic outruns it.
 
 JAX device dispatch is already asynchronous — the expensive *host-side* part
 of a flush is the Python work in ``materialize_for``: graph walk, key build,
@@ -9,6 +10,29 @@ requests gains by overlapping the device dispatch of one flush with the
 host-side prep of the next, which is exactly what a small thread pool buys:
 while worker A sits inside the XLA executable call (GIL released), worker B
 builds the next program and key.
+
+**Admission control + deadlines** (ISSUE 9). An unbounded submission queue
+turns overload into unbounded memory growth and unbounded tail latency; a
+flush with no deadline keeps burning device time for a request whose caller
+gave up long ago. Three env knobs (all default-off — the PR 8 behavior):
+
+* ``HEAT_TPU_SERVING_QUEUE_MAX=N`` bounds scheduled-but-unfinished flushes.
+  On overflow the policy ``HEAT_TPU_SERVING_OVERFLOW`` decides:
+  ``block`` (default) — ``schedule()`` waits for a slot; ``shed`` — the
+  *async dispatch* is refused (counted ``serving.shed{queue-full}``) and the
+  returned Future resolves immediately to the **unflushed** array. Shedding
+  is always correct: only *whether async work ran* changes — the owner's
+  ``flush()``/read still materializes the exact value synchronously, so
+  results stay bit-identical.
+* ``HEAT_TPU_FLUSH_DEADLINE_MS=D`` gives every scheduled flush a deadline,
+  enforced **at dequeue, never mid-kernel**: a worker picking up a flush
+  already past its deadline sheds it before dispatch (counted
+  ``serving.shed{deadline}``, Future resolves to the unflushed array).
+  A flush that *entered* dispatch in time but exceeded the deadline in
+  flight is observed by the **dispatch watchdog**: counted
+  ``serving.deadline_miss{in-flight}`` and logged (``heat_tpu.serving``
+  logger) — work is never aborted mid-kernel, so bit-exactness is untouched.
+* ``serving.queue_depth`` (gauge) tracks scheduled-but-unfinished flushes.
 
 Contract:
 
@@ -23,9 +47,11 @@ Contract:
   graphs on the same lane (or flush them sequentially) when the retained
   intermediate must come from a specific kernel.
 * ``schedule()`` on a concrete array resolves immediately; scheduling is
-  always safe.
+  always safe. A shed flush is indistinguishable from one that never got
+  scheduled: the pending expression stays recorded and materializes at the
+  owner's next read.
 
-Latency: every scheduled flush observes ``serving.dispatch_latency``
+Latency: every *dispatched* flush observes ``serving.dispatch_latency``
 (seconds, 1-2-5 log buckets from 1 µs to 10 s) — submit-to-materialized
 wall time. ``report.telemetry()`` surfaces the p50/p99 interpolated from
 the buckets; the serving bench reports exact sample percentiles
@@ -36,6 +62,7 @@ the buckets; the serving bench reports exact sample percentiles
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -47,6 +74,8 @@ from ..monitoring.registry import STATE as _MON
 
 __all__ = ["FlushScheduler", "schedule", "flush_all", "shutdown"]
 
+_LOG = logging.getLogger("heat_tpu.serving")
+
 
 def _default_workers() -> int:
     try:
@@ -56,19 +85,73 @@ def _default_workers() -> int:
     return max(1, n)
 
 
+def _env_int(name: str) -> int:
+    try:
+        return max(0, int(os.environ.get(name, "0") or 0))
+    except ValueError:
+        return 0
+
+
 class FlushScheduler:
-    """A small executor that flushes pending DNDarrays off-thread.
+    """A small executor that flushes pending DNDarrays off-thread, behind a
+    bounded admission queue with per-flush deadlines.
 
     ``schedule(x)`` returns a ``Future`` resolving to ``x`` once its pending
-    expression has materialized; ``flush_all(arrays)`` fans a batch out and
-    blocks until every flush lands (exceptions re-raise at collection, after
-    all futures settled). The pool is lazy — constructing a scheduler spawns
-    no threads until the first ``schedule``."""
+    expression has materialized (or was shed — the value then materializes
+    lazily at the owner's next read, unchanged); ``flush_all(arrays)`` fans a
+    batch out and blocks until every flush lands (exceptions re-raise at
+    collection, after all futures settled). The pool is lazy — constructing
+    a scheduler spawns no threads until the first ``schedule``.
 
-    def __init__(self, max_workers: Optional[int] = None):
+    Ctor overrides win over the env knobs: ``queue_max`` (0 = unbounded),
+    ``overflow`` (``"block"``/``"shed"``), ``deadline_ms`` (0 = none)."""
+
+    def __init__(
+        self,
+        max_workers: Optional[int] = None,
+        queue_max: Optional[int] = None,
+        overflow: Optional[str] = None,
+        deadline_ms: Optional[float] = None,
+    ):
         self._max_workers = max_workers or _default_workers()
+        if overflow is not None and overflow not in ("block", "shed"):
+            raise ValueError(f"overflow policy must be 'block' or 'shed', got {overflow!r}")
+        self._queue_max = queue_max
+        self._overflow = overflow
+        self._deadline_ms = deadline_ms
         self._pool: Optional[ThreadPoolExecutor] = None
         self._lock = threading.Lock()
+        self._inflight = 0
+        self._cond = threading.Condition()
+
+    # ---- knobs (env read per call so tests/monkeypatch reconfigure live)
+    def _queue_bound(self) -> int:
+        if self._queue_max is not None:
+            return max(0, int(self._queue_max))
+        return _env_int("HEAT_TPU_SERVING_QUEUE_MAX")
+
+    def _overflow_policy(self) -> str:
+        if self._overflow is not None:
+            return self._overflow
+        pol = os.environ.get("HEAT_TPU_SERVING_OVERFLOW", "block").strip().lower()
+        return pol if pol in ("block", "shed") else "block"
+
+    def _deadline_s(self) -> Optional[float]:
+        if self._deadline_ms is not None:
+            return self._deadline_ms / 1000.0 if self._deadline_ms > 0 else None
+        ms = os.environ.get("HEAT_TPU_FLUSH_DEADLINE_MS", "").strip()
+        if not ms:
+            return None
+        try:
+            val = float(ms)
+        except ValueError:
+            return None
+        return val / 1000.0 if val > 0 else None
+
+    def queue_depth(self) -> int:
+        """Scheduled-but-unfinished flushes right now (also a gauge:
+        ``serving.queue_depth``)."""
+        return self._inflight
 
     def _executor(self) -> ThreadPoolExecutor:
         if self._pool is None:
@@ -80,21 +163,84 @@ class FlushScheduler:
                     )
         return self._pool
 
+    def _gauge(self) -> None:
+        if _MON.enabled:
+            _instr.serving_queue_depth(self._inflight)
+
+    def _shed(self, x, kind: str) -> Future:
+        """Refuse the async dispatch (results stay exact: the pending
+        expression materializes at the owner's next read)."""
+        if _MON.enabled:
+            _instr.serving_shed(kind)
+        fut: Future = Future()
+        fut.set_result(x)
+        return fut
+
     def schedule(self, x, reason: str = "serving") -> Future:
-        """Submit ``x``'s pending flush; the Future resolves to ``x``."""
+        """Submit ``x``'s pending flush; the Future resolves to ``x``.
+
+        Admission control happens here (queue bound + overflow policy); the
+        deadline is enforced by the worker at dequeue — past-deadline work is
+        shed *before* dispatch, never aborted mid-kernel."""
+        qmax = self._queue_bound()
+        if qmax:
+            with self._cond:
+                if self._inflight >= qmax:
+                    if self._overflow_policy() == "shed":
+                        return self._shed(x, "queue-full")
+                    while self._inflight >= qmax:
+                        self._cond.wait()
+                self._inflight += 1
+                self._gauge()
+        else:
+            with self._cond:
+                self._inflight += 1
+                self._gauge()
+
+        deadline = self._deadline_s()
         t0 = time.perf_counter()
 
         def run():
+            dispatched = False
             try:
+                waited = time.perf_counter() - t0
+                if deadline is not None and waited > deadline:
+                    # dequeued already past deadline: shed before dispatch
+                    if _MON.enabled:
+                        _instr.serving_shed("deadline")
+                    return x
+                dispatched = True
                 flush = getattr(x, "_flush", None)
                 if flush is not None:
                     flush(reason)
+                if deadline is not None:
+                    took = time.perf_counter() - t0
+                    if took > deadline:
+                        # the dispatch watchdog: in-flight work is never
+                        # killed, only counted and logged
+                        if _MON.enabled:
+                            _instr.serving_deadline_miss("in-flight")
+                        _LOG.warning(
+                            "flush exceeded deadline in flight: %.1fms > %.1fms",
+                            took * 1e3, deadline * 1e3,
+                        )
                 return x
             finally:
-                if _MON.enabled:
+                if dispatched and _MON.enabled:
                     _instr.serving_dispatch(time.perf_counter() - t0)
+                with self._cond:
+                    self._inflight -= 1
+                    self._gauge()
+                    self._cond.notify()
 
-        return self._executor().submit(run)
+        try:
+            return self._executor().submit(run)
+        except BaseException:
+            with self._cond:
+                self._inflight -= 1
+                self._gauge()
+                self._cond.notify()
+            raise
 
     def flush_all(self, arrays: Iterable, reason: str = "serving") -> list:
         """Flush a batch concurrently (deduped by identity — scheduling the

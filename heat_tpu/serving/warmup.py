@@ -25,10 +25,15 @@ and returned in the stats dict.
 
 CLI::
 
-    python -m heat_tpu.serving.warmup [--cache-dir DIR] [--corpus DIR] [-q]
+    python -m heat_tpu.serving.warmup [--cache-dir DIR] [--corpus DIR]
+                                      [--strict] [-q]
 
-prints the stats as one JSON line — the startup hook a serving deployment
-runs before opening the request port.
+prints the stats as one JSON line plus a human summary line (stderr) — the
+startup hook a serving deployment runs before opening the request port. The
+exit code is CI-gateable (ISSUE 9 satellite: a fully-failed warmup used to
+exit 0): nonzero when any entry *errored*; under ``--strict``, nonzero when
+any entry was skipped too (a deployment that requires every recorded kernel
+warmed — e.g. same-fingerprint fleet restarts — can gate on it).
 """
 
 from __future__ import annotations
@@ -209,7 +214,10 @@ def warmup(corpus: Optional[str] = None, cache_dir: Optional[str] = None) -> dic
 
 
 def main(argv=None) -> int:
-    """CLI entry point (``python -m heat_tpu.serving.warmup``)."""
+    """CLI entry point (``python -m heat_tpu.serving.warmup``). Exit codes:
+    0 — every entry compiled/cached (skips allowed unless ``--strict``);
+    1 — at least one entry errored (or, with ``--strict``, was skipped);
+    2 — unusable configuration (no cache directory)."""
     p = argparse.ArgumentParser(
         prog="python -m heat_tpu.serving.warmup",
         description="AOT-compile a recorded shape corpus into the persistent "
@@ -225,6 +233,11 @@ def main(argv=None) -> int:
         default=None,
         help="corpus directory (default: <cache-dir>/corpus or $HEAT_TPU_SHAPE_CORPUS)",
     )
+    p.add_argument(
+        "--strict",
+        action="store_true",
+        help="also fail (exit 1) when any entry was skipped, not just errored",
+    )
     p.add_argument("-q", "--quiet", action="store_true", help="suppress the stats line")
     args = p.parse_args(argv)
     try:
@@ -234,6 +247,16 @@ def main(argv=None) -> int:
         return 2
     if not args.quiet:
         print(json.dumps(stats, sort_keys=True))
+    print(
+        "warmup: %d entries — %d compiled, %d cached, %d skipped, %d errors"
+        % (
+            stats["entries"], stats["compiled"], stats["cached"],
+            stats["skipped"], stats["errors"],
+        ),
+        file=sys.stderr,
+    )
+    if stats["errors"] > 0 or (args.strict and stats["skipped"] > 0):
+        return 1
     return 0
 
 

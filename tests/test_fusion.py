@@ -62,11 +62,18 @@ def no_faults(monkeypatch):
     precedent as the view/GEMM hatch leg, where deferral-asserting tests pin
     the gates ON via monkeypatch. Clearing the trace cache also drops
     signatures the standing plan poisoned earlier in the process, so this
-    test's chains re-attempt fused compilation."""
-    from heat_tpu.robustness import faultinject
+    test's chains re-attempt fused compilation. The ISSUE 9 chaos-smoke legs
+    extend the same precedent: a standing ``HEAT_TPU_CHAOS`` schedule or
+    ``HEAT_TPU_BREAKER_FORCE_OPEN`` pin routes flushes through the degraded
+    paths (bit-identical results, meaningless compile counts), so this
+    fixture also pins chaos off and resets the circuit breakers."""
+    from heat_tpu.robustness import breaker, faultinject
 
     monkeypatch.delenv("HEAT_TPU_FAULT_PLAN", raising=False)
+    monkeypatch.delenv("HEAT_TPU_CHAOS", raising=False)
+    monkeypatch.delenv("HEAT_TPU_BREAKER_FORCE_OPEN", raising=False)
     faultinject.clear()
+    breaker.reset()
     fusion.clear_cache()
 
 

@@ -56,11 +56,21 @@ def _clean(monkeypatch):
     cleared: the CI serving-smoke leg runs this whole suite under
     ``HEAT_TPU_SHAPE_BUCKETS=0`` and bucketing-asserting tests pin their own
     policy via monkeypatch (the PR 5 pin-the-gate-ON precedent)."""
+    from heat_tpu.robustness import breaker
+
     registry.reset()
     monkeypatch.setenv("HEAT_TPU_FUSION", "1")
     monkeypatch.delenv("HEAT_TPU_CACHE_DIR", raising=False)
     monkeypatch.delenv("HEAT_TPU_SHAPE_CORPUS", raising=False)
     monkeypatch.delenv("HEAT_TPU_SHAPE_CORPUS_MAX", raising=False)
+    # ISSUE 9 knobs default to current behavior; clear any ambient tuning
+    # (breaker STATE resets too — the force-open env pin, when a CI leg sets
+    # it, deliberately survives: it is what that leg proves)
+    monkeypatch.delenv("HEAT_TPU_CACHE_MAX_BYTES", raising=False)
+    monkeypatch.delenv("HEAT_TPU_SERVING_QUEUE_MAX", raising=False)
+    monkeypatch.delenv("HEAT_TPU_SERVING_OVERFLOW", raising=False)
+    monkeypatch.delenv("HEAT_TPU_FLUSH_DEADLINE_MS", raising=False)
+    breaker.reset()
     fusion.clear_cache()
     yield
     fusion.clear_cache()
@@ -71,9 +81,17 @@ def _clean(monkeypatch):
 def no_faults(monkeypatch):
     """Pin fault injection OFF for compile/cache-count-asserting tests (the
     PR 6 precedent: a standing CI fault plan makes count assertions
-    meaningless while results stay bit-identical)."""
+    meaningless while results stay bit-identical). ISSUE 9 extends the same
+    precedent to the standing chaos schedule and the forced-open breaker CI
+    legs — both keep results bit-identical through the degraded paths, which
+    is exactly what count-agnostic tests prove."""
+    from heat_tpu.robustness import breaker
+
     monkeypatch.delenv("HEAT_TPU_FAULT_PLAN", raising=False)
+    monkeypatch.delenv("HEAT_TPU_CHAOS", raising=False)
+    monkeypatch.delenv("HEAT_TPU_BREAKER_FORCE_OPEN", raising=False)
     faultinject.clear()
+    breaker.reset()
     fusion.clear_cache()
 
 
@@ -179,6 +197,8 @@ def test_cross_process_persistence_zero_compiles(tmp_path):
     env = dict(os.environ, HEAT_TPU_CACHE_DIR=str(tmp_path))
     env.pop("HEAT_TPU_FAULT_PLAN", None)
     env.pop("HEAT_TPU_SHAPE_BUCKETS", None)
+    env.pop("HEAT_TPU_CHAOS", None)
+    env.pop("HEAT_TPU_BREAKER_FORCE_OPEN", None)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
     def run():
@@ -539,3 +559,358 @@ def test_clear_cache_clears_eval_memo_coherently(no_faults):
     assert info["entries"] == 0 and info["eval_entries"] == 0
     assert info["poisoned"] == 0
     assert info["max"] == info["eval_max"] == 4096
+
+
+# ------------------------------------------------------------------ admission control
+def _shed_count(label: str) -> int:
+    return registry.REGISTRY.counter("serving.shed").get(label)
+
+
+def test_queue_bound_shed_policy_is_exact(no_faults):
+    """Overflowed schedules are refused (counted) but results never change:
+    the owner read still materializes every shed chain synchronously."""
+    rng = np.random.default_rng(0)
+    datas = [rng.normal(size=(16, 16)).astype(np.float32) for _ in range(8)]
+    with registry.capture():
+        sched = serving.FlushScheduler(max_workers=1, queue_max=1, overflow="shed")
+        try:
+            arrs = [_chain(ht.array(d)) for d in datas]
+            futs = [sched.schedule(a) for a in arrs]
+            outs = [f.result().numpy() for f in futs]
+        finally:
+            sched.shutdown()
+        assert _shed_count("queue-full") > 0  # the bound actually bit
+    for d, out in zip(datas, outs):
+        ref = _chain(ht.array(d)).numpy()
+        assert _bitwise(out, ref)
+
+
+def test_queue_bound_block_policy_drains_without_deadlock(no_faults):
+    rng = np.random.default_rng(1)
+    datas = [rng.normal(size=(16, 16)).astype(np.float32) for _ in range(6)]
+    with registry.capture():
+        with serving.FlushScheduler(max_workers=2, queue_max=2, overflow="block") as sched:
+            arrs = [_chain(ht.array(d)) for d in datas]
+            futs = [sched.schedule(a) for a in arrs]
+            for f in futs:
+                f.result()
+        assert _shed_count("queue-full") == 0  # block policy never sheds
+        assert registry.REGISTRY.counter("serving.shed").get() == 0
+    for d, a in zip(datas, arrs):
+        assert _bitwise(a.numpy(), _chain(ht.array(d)).numpy())
+
+
+def test_deadline_sheds_at_dequeue_never_wrong(no_faults):
+    """A microscopic deadline with a saturated single worker: queued flushes
+    are past-deadline at dequeue and shed BEFORE dispatch — and every value
+    still reads back exactly."""
+    rng = np.random.default_rng(2)
+    datas = [rng.normal(size=(64, 64)).astype(np.float32) for _ in range(8)]
+    with registry.capture():
+        sched = serving.FlushScheduler(max_workers=1, deadline_ms=0.0001)
+        try:
+            arrs = [_chain(ht.array(d)) for d in datas]
+            futs = [sched.schedule(a) for a in arrs]
+            for f in futs:
+                f.result()
+        finally:
+            sched.shutdown()
+        assert _shed_count("deadline") > 0
+    for d, a in zip(datas, arrs):
+        assert _bitwise(a.numpy(), _chain(ht.array(d)).numpy())
+
+
+def test_deadline_watchdog_counts_inflight_misses(no_faults):
+    """Work that entered dispatch in time but exceeded the deadline in flight
+    is counted and logged, never aborted."""
+    import time as _time
+
+    class _Slow:
+        def _flush(self, _reason):
+            _time.sleep(0.02)
+
+    with registry.capture():
+        sched = serving.FlushScheduler(max_workers=1, deadline_ms=5.0)
+        try:
+            sched.schedule(_Slow()).result()
+        finally:
+            sched.shutdown()
+        assert (
+            registry.REGISTRY.counter("serving.deadline_miss").get("in-flight") == 1
+        )
+        assert _shed_count("deadline") == 0  # it was dispatched, not shed
+
+
+def test_scheduler_env_knobs_and_gauge(monkeypatch, no_faults):
+    monkeypatch.setenv("HEAT_TPU_SERVING_QUEUE_MAX", "3")
+    monkeypatch.setenv("HEAT_TPU_SERVING_OVERFLOW", "shed")
+    monkeypatch.setenv("HEAT_TPU_FLUSH_DEADLINE_MS", "5000")
+    sched = serving.FlushScheduler(max_workers=1)
+    assert sched._queue_bound() == 3
+    assert sched._overflow_policy() == "shed"
+    assert sched._deadline_s() == 5.0
+    monkeypatch.delenv("HEAT_TPU_SERVING_QUEUE_MAX")
+    monkeypatch.delenv("HEAT_TPU_SERVING_OVERFLOW")
+    monkeypatch.delenv("HEAT_TPU_FLUSH_DEADLINE_MS")
+    # defaults: unbounded, block, no deadline — the PR 8 behavior
+    assert sched._queue_bound() == 0
+    assert sched._overflow_policy() == "block"
+    assert sched._deadline_s() is None
+    with registry.capture():
+        x = _chain(_fresh(seed=40))
+        sched.schedule(x).result()
+        sched.shutdown()
+        tele = report.telemetry()
+    assert tele.get("serving_queue_depth") == 0  # drained back to zero
+
+
+# ------------------------------------------------------------------ disk-cache janitor
+from heat_tpu.serving import janitor as sjanitor  # noqa: E402
+
+
+def _fill_cache(tmp_path, n=4, seed0=50):
+    """n distinct-shape chains -> n exec entries (+ n corpus recipes)."""
+    outs = []
+    for i in range(n):
+        x = _fresh(shape=(5 + i, 7), seed=seed0 + i)
+        outs.append(_chain(x).numpy())
+    return outs
+
+
+def _cache_bytes(tmp_path) -> int:
+    total = 0
+    for sub in ("exec", "corpus"):
+        d = tmp_path / sub
+        if d.is_dir():
+            total += sum(f.stat().st_size for f in d.iterdir() if f.is_file())
+    return int(total)
+
+
+def test_janitor_evicts_lru_to_bound(monkeypatch, tmp_path, no_faults):
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    with registry.capture():
+        _fill_cache(tmp_path)
+        before = _cache_bytes(tmp_path)
+        assert before > 0
+        # age the first entry so LRU order is deterministic
+        victim = sorted((tmp_path / "exec").iterdir())[0]
+        os.utime(victim, (1, 1))
+        stats = sjanitor.sweep(str(tmp_path), limit=before - 1, validate=False)
+        assert stats["evicted"] >= 1
+        assert stats["bytes"] <= before - 1
+        assert _cache_bytes(tmp_path) == stats["bytes"]
+        assert not victim.exists()  # oldest mtime went first
+        tele = report.telemetry()
+    assert tele["serving_janitor"]["evicted"] == stats["evicted"]
+    assert tele["serving_janitor"]["runs"] == 1
+
+
+def test_janitor_quarantines_corrupt_entries(monkeypatch, tmp_path, no_faults):
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    with registry.capture():
+        _fill_cache(tmp_path, n=2)
+        entries = sorted((tmp_path / "exec").iterdir())
+        entries[0].write_bytes(b"\x00garbage")
+        stats = sjanitor.sweep(str(tmp_path), validate=True)
+        assert stats["quarantined"] == 1
+        assert not entries[0].exists()
+        assert (tmp_path / "quarantine" / entries[0].name).exists()
+        # the poisoned file is out of every future scan
+        stats2 = sjanitor.sweep(str(tmp_path), validate=True)
+        assert stats2["quarantined"] == 0
+        assert entries[1].exists()  # the healthy entry untouched
+
+
+def test_corrupt_entry_quarantined_at_read_time(monkeypatch, tmp_path, no_faults):
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    with registry.capture():
+        r1 = _chain(_fresh(seed=60)).numpy()
+        entry = next((tmp_path / "exec").iterdir())
+        entry.write_bytes(b"truncated")
+        fusion.clear_cache()
+        r2 = _chain(_fresh(seed=60)).numpy()  # corrupt read -> recompile
+        assert _disk("corrupt") == 1
+        assert (tmp_path / "quarantine" / entry.name).exists()
+        # the recompile re-stored a good entry under the same digest
+        assert entry.exists()
+    assert _bitwise(r1, r2)
+
+
+def test_janitor_orphan_tempfile_sweep(tmp_path, no_faults):
+    (tmp_path / "exec").mkdir()
+    orphan = tmp_path / "exec" / ".tmp-dead.bin"
+    orphan.write_bytes(b"half a write")
+    fresh = tmp_path / "exec" / ".tmp-live.bin"
+    fresh.write_bytes(b"in flight")
+    with registry.capture():
+        stats = sjanitor.sweep(str(tmp_path), orphan_age_s=3600.0)
+        assert stats["orphans"] == 0 and orphan.exists()  # age gate holds
+        stats = sjanitor.sweep(str(tmp_path), orphan_age_s=0.0)
+        assert stats["orphans"] == 2
+    assert not orphan.exists() and not fresh.exists()
+
+
+def test_store_time_inline_sweep_enforces_bound(monkeypatch, tmp_path, no_faults):
+    """HEAT_TPU_CACHE_MAX_BYTES holds while traffic keeps storing: fill past
+    the bound and the inline sweep (cache.persist) evicts back under it —
+    with hit-rate telemetry intact."""
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    with registry.capture():
+        _fill_cache(tmp_path, n=2, seed0=70)
+        bound = _cache_bytes(tmp_path)  # room for ~2 entries' worth
+        monkeypatch.setenv("HEAT_TPU_CACHE_MAX_BYTES", str(bound))
+        _fill_cache(tmp_path, n=4, seed0=80)  # 4 more stores, each sweeping
+        assert _cache_bytes(tmp_path) <= bound
+        assert registry.REGISTRY.counter("serving.janitor").get("evicted") > 0
+        tele = report.telemetry()
+    assert "serving_cache_slo" in tele and tele["serving_cache_slo"]["l1_hits"] >= 0
+    assert tele["serving_janitor"]["evicted"] > 0
+
+
+def test_janitor_cli(monkeypatch, tmp_path, no_faults, capsys):
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    _fill_cache(tmp_path, n=2, seed0=90)
+    rc = sjanitor.main(["--max-bytes", "1", "--orphan-age", "0"])
+    assert rc == 0
+    stats = json.loads(capsys.readouterr().out.strip())
+    assert stats["evicted"] >= 1 and stats["bytes"] <= 1
+    monkeypatch.delenv("HEAT_TPU_CACHE_DIR")
+    assert sjanitor.main([]) == 2  # no cache dir: config error
+
+
+def test_reader_tolerates_concurrent_eviction(monkeypatch, tmp_path, no_faults):
+    """A reader hammering cache.load while the janitor evicts underneath
+    never crashes: it sees hits or clean misses (satellite: evict-while-read
+    tolerance)."""
+    import threading
+
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    with registry.capture():
+        _chain(_fresh(seed=95)).numpy()
+        digest = next((tmp_path / "exec").iterdir()).name[: -len(".bin")]
+        errors = []
+
+        def hammer():
+            try:
+                for _ in range(200):
+                    scache.load(str(tmp_path), digest)
+            except Exception as e:  # any leak here is the bug
+                errors.append(e)
+
+        t = threading.Thread(target=hammer)
+        t.start()
+        for _ in range(20):
+            sjanitor.sweep(str(tmp_path), limit=0, validate=False)
+        t.join()
+    assert errors == []
+
+
+# ------------------------------------------------------------------ multi-process contention
+def _writer_prog(shape=(5, 12)):
+    return (
+        "import os, numpy as np\n"
+        "os.environ['JAX_PLATFORMS']='cpu'\n"
+        "import heat_tpu as ht\n"
+        "x = ht.array(np.random.default_rng(0).normal(size=%r).astype(np.float32))\n"
+        "r = ((x * 2.0 + 1.0) / 3.0).numpy()\n"
+        "print(float(r.sum()))\n" % (shape,)
+    )
+
+
+def test_two_writers_racing_same_key(monkeypatch, tmp_path, no_faults):
+    """Two processes computing the identical chain against one cache dir:
+    both land, exactly one valid entry remains, and a fresh in-process read
+    is served from it (satellite: same-key write race)."""
+    env = dict(os.environ)
+    env.update(HEAT_TPU_CACHE_DIR=str(tmp_path), JAX_PLATFORMS="cpu")
+    env.pop("HEAT_TPU_FAULT_PLAN", None)
+    env.pop("HEAT_TPU_CHAOS", None)
+    env.pop("HEAT_TPU_BREAKER_FORCE_OPEN", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _writer_prog()],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for _ in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, err[-800:]
+        outs.append(out.strip())
+    assert outs[0] == outs[1]
+    entries = list((tmp_path / "exec").iterdir())
+    assert len(entries) == 1  # same digest: last atomic replace wins
+    assert sjanitor._valid_entry(str(entries[0]))
+    # and the shared entry actually serves this process
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    with registry.capture():
+        fusion.clear_cache()
+        before = _compiles()
+        _chain(_fresh(shape=(5, 12), seed=0)).numpy()
+        assert _disk("hit") == 1 and _compiles() == before
+
+
+# ------------------------------------------------------------------ cache-read breaker
+def test_cache_read_breaker_serves_memory_only(monkeypatch, tmp_path, no_faults):
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    monkeypatch.setenv("HEAT_TPU_BREAKER_THRESHOLD", "2")
+    monkeypatch.setenv("HEAT_TPU_BREAKER_COOLDOWN", "100")
+    from heat_tpu.robustness import breaker as rbreaker
+
+    with registry.capture():
+        r1 = _chain(_fresh(seed=97)).numpy()  # stores the entry
+        with faultinject.inject("serving.cache_read", OSError, at_calls="*"):
+            for seed in (97, 97, 97):
+                fusion.clear_cache()
+                r = _chain(_fresh(seed=seed)).numpy()
+                assert _bitwise(r, r1)
+            consulted = faultinject.call_count("serving.cache_read")
+        # two failing reads opened the breaker; the third flush never touched
+        # the disk (served by a fresh in-memory compile)
+        assert consulted == 2
+        assert rbreaker.breaker("serving.cache_read").state() == "open"
+        assert _disk("corrupt") == 2
+        assert _disk("breaker-open") == 1
+        tele = report.telemetry()
+    assert tele["robustness_breakers"]["serving.cache_read:open"] == 1
+
+
+# ------------------------------------------------------------------ warmup CLI gating
+def test_warmup_cli_exit_codes_and_summary(monkeypatch, tmp_path, capsys, no_faults):
+    """Satellite: error > 0 exits nonzero, --strict also gates on skips, and
+    the stderr summary line is CI-greppable."""
+    import importlib
+
+    # the package re-exports the warmup FUNCTION under the submodule's name
+    swarmup = importlib.import_module("heat_tpu.serving.warmup")
+
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    scorpus._seen.clear()  # digests are deduped process-wide
+    with registry.capture():
+        _chain(_fresh(seed=99)).numpy()  # one good corpus recipe
+    corpus_dir = tmp_path / "corpus"
+    good = next(corpus_dir.iterdir())
+    entry = pickle.loads(good.read_bytes())
+    # a foreign-fingerprint recipe: skipped (not an error)
+    foreign = dict(entry, fp=("other", "toolchain", "cpu", "v0"))
+    (corpus_dir / ("f" * 64 + ".pkl")).write_bytes(pickle.dumps(foreign))
+    # a same-fingerprint recipe that cannot compile: leaf specs reference a
+    # leaf that does not exist -> an error, not a skip
+    broken = dict(entry, leaf_descs=())
+    (corpus_dir / ("e" * 64 + ".pkl")).write_bytes(pickle.dumps(broken))
+
+    rc = swarmup.main(["--cache-dir", str(tmp_path)])
+    captured = capsys.readouterr()
+    assert rc == 1  # errors > 0 now fails (a fully-failed warmup used to exit 0)
+    stats = json.loads(captured.out.strip())
+    assert stats["errors"] == 1 and stats["skipped"] == 1 and stats["cached"] == 1
+    assert "warmup: 3 entries" in captured.err
+
+    os.unlink(str(corpus_dir / ("e" * 64 + ".pkl")))
+    rc = swarmup.main(["--cache-dir", str(tmp_path)])
+    capsys.readouterr()
+    assert rc == 0  # skips alone pass by default...
+    rc = swarmup.main(["--cache-dir", str(tmp_path), "--strict"])
+    capsys.readouterr()
+    assert rc == 1  # ...but --strict gates on them
