@@ -711,6 +711,9 @@ def main():
                     "reduction_sink_speedup": None,
                     "fused_view_chain_valid": None,
                     "view_fusion_speedup": None,
+                    "ragged_reduce_gbps": None,
+                    "ragged_reduce_speedup": None,
+                    "ragged_reduce_valid": None,
                     "elementwise_error": repr(e)[:160],
                 }
         # GEMM-producer epilogue anchors (ISSUE 5): act(x@w+b) through the
@@ -796,6 +799,36 @@ def main():
                     "janitor_valid": None,
                     "serving_error": repr(e)[:160],
                 }
+        # pallas kernel tier anchors (ISSUE 10): ring_attention_step_gbps —
+        # the per-hop fused flash update's effective throughput — and the
+        # same-process tier-on/tier-off speedups for ring attention and the
+        # fused kmeans assign+update step. On this container the kernels run
+        # through the pallas INTERPRETER (HEAT_TPU_PALLAS_INTERPRET=1), so
+        # the speedups understate the TPU-host headroom enormously (« 1 is
+        # expected; the anchors pin the dispatch machinery — ROADMAP 5 owns
+        # the real-chip measurement); *_valid gates on sample spread only
+        pallas_anchors = {}
+        if os.environ.get("BENCH_FAST") != "1":
+            try:
+                _add_benchmarks_path()
+                from attention_bench import bench_attention
+                from kmeans_bench import kmeans_pallas_anchor
+
+                with _mev.span("bench.pallas"):
+                    pallas_anchors = bench_attention()
+                    pallas_anchors.update(kmeans_pallas_anchor())
+            except Exception as e:
+                # explicit null-valued keys, like the neighbouring benches: a
+                # crashed anchor must be distinguishable from a BENCH_FAST skip
+                pallas_anchors = {
+                    "ring_attention_step_gbps": None,
+                    "ring_attention_step_valid": None,
+                    "attention_pallas_speedup": None,
+                    "attention_pallas_valid": None,
+                    "kmeans_pallas_speedup": None,
+                    "kmeans_pallas_valid": None,
+                    "pallas_error": repr(e)[:160],
+                }
         # out-of-core input pipeline (VERDICT r4 #8): native prefetcher vs h5py
         io_pipe = {}
         if os.environ.get("BENCH_FAST") != "1":
@@ -852,6 +885,7 @@ def main():
                 **gemm_epi,
                 **coll_fusion,
                 **serving_anchors,
+                **pallas_anchors,
                 **io_pipe,
                 "telemetry": telemetry,
             }

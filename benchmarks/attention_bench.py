@@ -1,0 +1,138 @@
+"""
+Attention anchors for the pallas kernel tier (ISSUE 10,
+``heat_tpu/core/pallas/flash.py``).
+
+* ``ring_attention_step_gbps`` — effective memory throughput of ONE fused
+  flash ring-step update (the per-hop online-softmax (m, l, o) rescale over a
+  whole K/V block): traffic floor = the q/k/v block reads + the triple's
+  read+write, all f32. Measured on the kernel call itself (warm, medians).
+* ``attention_pallas_speedup`` — wall-clock ratio of the full
+  :func:`~heat_tpu.nn.ring_attention` over the virtual mesh with the tier ON
+  vs the same-process ``HEAT_TPU_PALLAS=0`` jnp-ring baseline.
+
+NOTE (the PR 4/5 anchor methodology): on this 1-core CPU dev container the
+kernel runs through the pallas *interpreter* (``HEAT_TPU_PALLAS_INTERPRET=1``)
+— every kernel op is a jaxpr-interpreter dispatch, so both anchors understate
+the TPU-host headroom enormously (speedups « 1 are expected here; the
+VMEM-residency the kernel buys is invisible to an interpreter). The anchors
+exist to pin the dispatch machinery and to be re-measured on the real bench
+host (ROADMAP item 5); ``*_valid`` gates on sample spread only.
+
+Run: python benchmarks/attention_bench.py
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import _spread_pct  # noqa: E402  (repo-root bench.py: shared gates)
+
+B, S, H, D = 1, 256, 4, 64  # per-device block extents of the step anchor
+TRIALS = 5
+
+
+def bench_attention():
+    import jax
+    import jax.numpy as jnp
+
+    import heat_tpu as ht  # noqa: F401 — device/mesh init
+    from heat_tpu.core.communication import MeshCommunication
+    from heat_tpu.core import pallas as plreg
+    from heat_tpu.core.pallas import flash as plflash
+    from heat_tpu.nn import ring_attention
+
+    out = {}
+    os.environ["HEAT_TPU_PALLAS_INTERPRET"] = "1"
+    interp = plreg.use_interpret()
+    out["attention_pallas_interpret"] = bool(interp)
+
+    # ---- ring_attention_step_gbps: one fused per-hop update, warm
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    bh = B * H
+    qm, km, vm = (
+        jax.random.normal(k, (bh, S, D), jnp.float32) for k in ks
+    )
+    m0 = jnp.full((bh, S), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bh, S), jnp.float32)
+    o0 = jnp.zeros((bh, S, D), jnp.float32)
+    pos = jnp.arange(S, dtype=jnp.int32)
+
+    def step():
+        m, l, o = plflash.tile_update(
+            qm, km, vm, m0, l0, o0, scale=D**-0.5, causal=True,
+            q_pos=pos, k_pos=pos, interpret=interp,
+        )
+        jax.block_until_ready(o)
+        return o
+
+    try:
+        step()  # compile + warm
+        rates = []
+        # floor: q,k,v block reads + (m,l,o) in + (m,l,o) out, f32
+        nbytes = 4 * (3 * bh * S * D + 2 * (2 * bh * S + bh * S * D))
+        for _ in range(TRIALS):
+            t0 = time.perf_counter()
+            step()
+            rates.append(nbytes / (time.perf_counter() - t0))
+        med = float(np.median(rates))
+        spread = _spread_pct(rates)
+        out["ring_attention_step_gbps"] = round(med / 1e9, 3)
+        out["ring_attention_step_jitter_pct"] = round(spread, 2)
+        out["ring_attention_step_valid"] = bool(spread < 25.0)
+        out["ring_attention_step_note"] = (
+            "pallas interpreter on the CPU container — understates TPU "
+            "headroom; re-measure on the bench host (ROADMAP 5)"
+            if interp else "compiled kernel"
+        )
+    except Exception as e:  # pragma: no cover — anchor crash stays visible
+        out["ring_attention_step_gbps"] = None
+        out["ring_attention_step_valid"] = None
+        out["ring_attention_step_error"] = repr(e)[:160]
+
+    # ---- attention_pallas_speedup: full ring over the mesh, tier on vs off
+    comm = MeshCommunication()
+    p = max(1, comm.size)
+    seq = 64 * p
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q, k, v = (jax.random.normal(kk, (1, seq, 2, 32), jnp.float32) for kk in ks)
+
+    def leg(pallas_on: bool):
+        os.environ["HEAT_TPU_PALLAS"] = "1" if pallas_on else "0"
+        ts = []
+        np.asarray(ring_attention(q, k, v, comm=comm, causal=True))  # warm
+        for _ in range(TRIALS):
+            t0 = time.perf_counter()
+            np.asarray(ring_attention(q, k, v, comm=comm, causal=True))
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts)), _spread_pct([1.0 / t for t in ts])
+
+    try:
+        t_off, sp_off = leg(False)
+        t_on, sp_on = leg(True)
+        out["attention_pallas_speedup"] = round(t_off / t_on, 3)
+        out["attention_pallas_valid"] = bool(sp_off < 25.0 and sp_on < 25.0)
+        out["attention_pallas_note"] = (
+            "interpreter leg vs XLA leg on 1 core: expect « 1 here; the "
+            "anchor pins dispatch, the bench host measures headroom"
+            if interp else "compiled"
+        )
+    except Exception as e:  # pragma: no cover
+        out["attention_pallas_speedup"] = None
+        out["attention_pallas_valid"] = None
+        out["attention_pallas_error"] = repr(e)[:160]
+    finally:
+        os.environ["HEAT_TPU_PALLAS"] = "1"
+    return out
+
+
+def main():
+    print(json.dumps(bench_attention(), indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -267,6 +267,75 @@ def bench_fused_view_chain(ht, roofline, rng):
     return out
 
 
+def bench_ragged_reduce(ht, rng):
+    """``ragged_reduce_gbps`` (+``ragged_reduce_speedup``) anchor (ISSUE 10):
+    a ragged split-axis where-mask sum over a pending chain through the
+    pallas ragged-reduce sink (``core/pallas/ragged.py`` — pad and mask
+    neutralized in-register, ONE program at the single-read floor) vs the
+    same-process ``HEAT_TPU_PALLAS=0`` baseline (the PR 4 eager fallback:
+    chain flush read+write, then the standalone logical-view reduce).
+
+    A 1-device host has no canonical pad, so the sink never engages there —
+    reported null like ``ici_gbps``. On this container the pallas leg runs
+    through the interpreter (``HEAT_TPU_PALLAS_INTERPRET=1``): the speedup
+    understates the TPU-host headroom the 3:1 traffic ratio implies (expect
+    « 1 here); ``*_valid`` gates on spread only."""
+    import time
+
+    from heat_tpu.core.communication import MeshCommunication
+
+    out = {}
+    comm = MeshCommunication()
+    if comm.size < 2:
+        out["ragged_reduce_gbps"] = None
+        out["ragged_reduce_speedup"] = None
+        out["ragged_reduce_valid"] = None
+        out["ragged_reduce_note"] = "1-device host: no padded layout to serve"
+        return out
+    rows = 1024 * comm.size + 17  # ragged on the split axis by construction
+    cols = 64
+    data = rng.random((rows, cols), dtype=np.float32)
+    mask = rng.random((rows, cols)) > 0.5
+    base = ht.array(data, split=0)
+    base.parray  # noqa: B018
+    m = ht.array(mask, split=0)
+    os.environ["HEAT_TPU_PALLAS_INTERPRET"] = "1"
+    nbytes = rows * cols * 4  # single-read floor of the fused sink
+
+    def leg(pallas_on: bool, trials: int = 5):
+        os.environ["HEAT_TPU_PALLAS"] = "1" if pallas_on else "0"
+        def one():
+            c = ht.abs(base * 1.0000001 + 0.25)
+            return float(ht.sum(c, where=m))
+        one()  # compile + warm
+        ts = []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            one()
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts)), _spread_pct([1.0 / t for t in ts])
+
+    try:
+        t_off, sp_off = leg(False)
+        t_on, sp_on = leg(True)
+        out["ragged_reduce_gbps"] = round(nbytes / t_on / 1e9, 3)
+        out["ragged_reduce_speedup"] = round(t_off / t_on, 3)
+        out["ragged_reduce_valid"] = bool(sp_off < 25.0 and sp_on < 25.0)
+        out["ragged_reduce_note"] = (
+            "interpreter leg on this host — understates the TPU headroom of "
+            "the 3:1 traffic ratio"
+        )
+    except Exception as e:  # pragma: no cover — anchor crash stays visible
+        out["ragged_reduce_gbps"] = None
+        out["ragged_reduce_speedup"] = None
+        out["ragged_reduce_valid"] = None
+        out["ragged_reduce_error"] = repr(e)[:160]
+    finally:
+        os.environ["HEAT_TPU_PALLAS"] = "1"
+        os.environ.pop("HEAT_TPU_PALLAS_INTERPRET", None)
+    return out
+
+
 def bench_elementwise():
     import jax
 
@@ -309,6 +378,7 @@ def bench_elementwise():
 
         out.update(bench_fused_reduction(ht, roofline, rng))
         out.update(bench_fused_view_chain(ht, roofline, rng))
+        out.update(bench_ragged_reduce(ht, rng))
 
         small = ht.array(rng.random(N_SMALL, dtype=np.float32))
         df_rate, df_jit, df_tot, df_disc = _rate(

@@ -56,6 +56,63 @@ def kmeans_step_anchor(n: int = 1 << 14, f: int = 32, k: int = 8):
     return out
 
 
+def kmeans_pallas_anchor(n: int = 1 << 13, f: int = 32, k: int = 8, trials: int = 5):
+    """``kmeans_pallas_speedup`` anchor (ISSUE 10): the fused pallas
+    assign+update step (``core/pallas/kmeans.py`` behind ``KMeans.step``,
+    one sample pass) vs the same-process ``HEAT_TPU_PALLAS=0`` deferred
+    op-surface step. NOTE: on this 1-core container the pallas leg runs
+    through the interpreter (``HEAT_TPU_PALLAS_INTERPRET=1``) — expect a
+    ratio « 1 here; the anchor pins the dispatch path and the bench host
+    (ROADMAP 5) measures the headroom. ``*_valid`` gates on spread only."""
+    import os
+    import sys
+    import time
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from bench import _spread_pct
+
+    rng = np.random.default_rng(29)
+    cent = rng.normal(scale=5.0, size=(k, f)).astype(np.float32)
+    data = (cent[rng.integers(0, k, n)] + rng.normal(scale=0.4, size=(n, f))).astype(
+        np.float32
+    )
+    x = ht.array(data, split=0)
+    x.parray  # noqa: B018
+    km = ht.cluster.KMeans(n_clusters=k)
+    centers = ht.array(cent)
+    os.environ["HEAT_TPU_PALLAS_INTERPRET"] = "1"
+
+    def leg(pallas_on: bool):
+        os.environ["HEAT_TPU_PALLAS"] = "1" if pallas_on else "0"
+        def one():
+            _, _, sh = km.step(x, centers=centers)
+            float(sh)  # flush / sync
+        one()  # warm
+        ts = []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            one()
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts)), _spread_pct([1.0 / t for t in ts])
+
+    out = {}
+    try:
+        t_off, sp_off = leg(False)
+        t_on, sp_on = leg(True)
+        out["kmeans_pallas_speedup"] = round(t_off / t_on, 3)
+        out["kmeans_pallas_valid"] = bool(sp_off < 25.0 and sp_on < 25.0)
+        out["kmeans_pallas_note"] = (
+            "interpreter leg vs XLA leg on 1 core — understates TPU headroom"
+        )
+    except Exception as e:  # pragma: no cover — anchor crash stays visible
+        out["kmeans_pallas_speedup"] = None
+        out["kmeans_pallas_valid"] = None
+        out["kmeans_pallas_error"] = repr(e)[:160]
+    finally:
+        os.environ["HEAT_TPU_PALLAS"] = "1"
+    return out
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--n", type=int, default=1_048_576)

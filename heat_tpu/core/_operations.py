@@ -379,13 +379,25 @@ def __reduce_op(
             # this backend). Order-preserving reduces (rounding is monotone, so
             # the selected extremum's rounded value is identical) and boolean
             # tests stay sinkable; arithmetic accumulations flush for parity.
+            if _MON.enabled:
+                _instr.fusion_sink_fallback("low-float")
             sinkable = False
-        if x.is_padded:
+        if sinkable and x.is_padded:
             n_log = int(x.shape[xsplit])
             if where_arr is not None:
                 # the eager path computes on the sliced logical view; an
                 # in-trace slice would reassociate the ragged shards' partial
-                # sums (see fusion.defer_moment) — flush instead
+                # sums (see fusion.defer_moment) — the pallas ragged-reduce
+                # kernel (ISSUE 10) masks the pad AND the where= mask with
+                # the op's neutral in-register instead; combinations it does
+                # not express keep the counted eager flush
+                deferred = _fusion.defer_ragged_reduce(
+                    x, partial_op, axis, keepdims, kwargs, out_gshape
+                )
+                if deferred is not None:
+                    return deferred
+                if _MON.enabled:
+                    _instr.fusion_sink_fallback("padded-operand")
                 sinkable = False
             elif split_reduced:
                 neutral_fill = (
@@ -398,7 +410,18 @@ def __reduce_op(
                     # (the canonical pad content never reaches the combine)
                     pre = (("fill", xsplit, n_log, neutral_fill),)
                 else:
-                    sinkable = False  # eager uses the logical view: flush
+                    # flattened arg-reduction: flat indices must be logical —
+                    # the pallas kernel masks the pad out of the running
+                    # (value, index) pair and remaps the physical flat index
+                    # exactly; otherwise the eager logical view flushes
+                    deferred = _fusion.defer_ragged_reduce(
+                        x, partial_op, axis, keepdims, kwargs, out_gshape
+                    )
+                    if deferred is not None:
+                        return deferred
+                    if _MON.enabled:
+                        _instr.fusion_sink_fallback("padded-operand")
+                    sinkable = False
             else:
                 # physical pass-through: the surviving split axis keeps its pad
                 expected_pshape = x.comm.padded_shape(out_gshape, split)

@@ -179,6 +179,7 @@ from ..monitoring.registry import STATE as _MON
 from ..monitoring import instrument as _instr
 from ..robustness import breaker as _BRK
 from ..robustness import faultinject as _FI
+from . import pallas as _PL
 from .dndarray import DNDarray
 
 __all__ = [
@@ -212,6 +213,7 @@ __all__ = [
     "defer_cum",
     "defer_norm",
     "defer_vecdot",
+    "defer_ragged_reduce",
     "materialize_for",
     "cache_info",
     "clear_cache",
@@ -1292,6 +1294,108 @@ def _low_float(x: DNDarray) -> bool:
     return dt.itemsize < 4 and bool(jnp.issubdtype(dt, jnp.floating))
 
 
+def _sink_fallback(kind: str) -> None:
+    """One reduction over a pending chain that had to take the eager
+    (flushing) fallback (kind: padded-operand — the eager path computes on
+    the sliced logical view and no pallas route applied; low-float — the
+    sub-32-bit excess-precision carve-out)."""
+    if _MON.enabled:
+        _instr.fusion_sink_fallback(kind)
+
+
+def _ragged_pallas_ok(x: DNDarray) -> bool:
+    """Whether the pallas ragged-reduce sink may serve this padded operand.
+    A canonically padded operand is by construction *distributed* (sharded
+    leaves), and a compiled ``pallas_call`` has no GSPMD partitioning rule —
+    so this route requires the interpreter (``HEAT_TPU_PALLAS_INTERPRET=1``,
+    under which the kernel discharges to partitionable jax ops; the CPU test
+    and bench regime). The hatches are consulted here without counting — the
+    caller counts the sink-level fallback, and ``HEAT_TPU_PALLAS=0`` must
+    restore the pre-PR counter stream exactly."""
+    del x
+    if not (_PL.enabled() and _PL.kernel_enabled("ragged_reduce")):
+        return False
+    return _PL.interpret_forced()
+
+
+def _defer_ragged(
+    x: DNDarray, kind: str, opname: str, axis, keepdims: bool,
+    where_arr=None, extra=(), sink_label: str = "reduce",
+) -> Optional[DNDarray]:
+    """Record one padded-operand reduction as a pallas ragged-reduce sink
+    (``heat_tpu/core/pallas/ragged.py``): the pending chain, the in-tile pad
+    masking, and the reduction compile as one program — the fused path the
+    PR 4 ``padded-operand`` fallbacks lacked. Returns None (caller counts the
+    fallback) when the kernel does not express the combination or the
+    registry refuses the dispatch."""
+    from .types import canonical_heat_type
+
+    if not _ragged_pallas_ok(x):
+        return None
+    from .pallas import ragged as _plragged
+
+    xsplit = int(x.split) % max(x.ndim, 1)
+    n_log = int(x.shape[xsplit])
+    dt = np.dtype(x.dtype.jnp_type())
+    task = _plragged.plan(
+        kind, opname, tuple(x.pshape), dt, xsplit, n_log, axis, keepdims,
+        where_arr is not None, extra, _PL.use_interpret(),
+    )
+    if task is None:
+        return None
+    if not _PL.available("ragged_reduce", dtype=dt):
+        return None
+    inp = _input_of(x)
+    if inp is None:
+        return None
+    args = (inp,)
+    if where_arr is not None:
+        if not _usable_leaf(where_arr):
+            return None
+        args = (inp, _Leaf(where_arr))
+    fn = _plragged.sink_fn_for(task)
+    okey = ("sink", "pallas", task)
+    try:
+        aval = _eval_node(fn, okey, args, (), None)
+    except Exception:
+        return None
+    out_shape, out_dtype = task[-2], task[-1]
+    if tuple(aval.shape) != tuple(out_shape) or str(aval.dtype) != out_dtype:
+        return None  # plan/trace disagreement: let the eager path decide
+    # no cross-process skey: a pallas custom call is not serializable through
+    # the serving layer's executable cache — these programs stay in-memory
+    node = _Node(fn, okey, args, (), None, aval, skey=None)
+    _PL.dispatch("ragged_reduce")
+    res_dtype = canonical_heat_type(aval.dtype)
+    return _finish_sink(
+        node, tuple(out_shape), res_dtype, None, x.device, x.comm, sink_label
+    )
+
+
+def defer_ragged_reduce(
+    x: DNDarray, op, axis, keepdims: bool, fn_kwargs: dict, out_gshape
+) -> Optional[DNDarray]:
+    """The ``__reduce_op`` entry to the pallas ragged sink, for the two
+    padded-operand cases the PR 4 sinks flush: ``where=``-masked reductions
+    (the mask's extent is logical) and flattened arg-reductions (flat indices
+    must be logical). Returns None to fall back (caller counts it)."""
+    opname = getattr(op, "__name__", None)
+    if opname in ("argmin", "argmax"):
+        if keepdims or axis is not None or fn_kwargs:
+            return None
+        res = _defer_ragged(x, "argflat", opname, None, False)
+    else:
+        where_arr = fn_kwargs.get("where")
+        if where_arr is None or len(fn_kwargs) != 1:
+            return None  # initial= etc. keep the eager fallback
+        res = _defer_ragged(
+            x, "where", opname, axis, keepdims, where_arr=where_arr
+        )
+    if res is not None and tuple(res.shape) != tuple(out_gshape):
+        return None  # pragma: no cover — plan bakes the eager aval
+    return res
+
+
 _SINK_FNS: dict = {}
 
 
@@ -1435,12 +1539,23 @@ def defer_moment(
     graph; the ``/n`` and ``-mu**2`` epilogues live inside the jnp op and fuse
     with it. The eager ``__moment`` computes on ``x.larray``, so padded
     operands are pad-sliced in-trace."""
-    # padded operands fall back to the eager flush: an in-trace pad slice
-    # makes the SPMD partitioner group the ragged shards' partial sums
-    # differently than the eager dispatch on the sliced logical view —
-    # reassociation, which (unlike FMA contraction) is not a documented
-    # divergence
-    if x.is_padded or _low_float(x):
+    if _low_float(x):
+        _sink_fallback("low-float")
+        return None
+    if x.is_padded:
+        # an in-trace pad slice would make the SPMD partitioner group the
+        # ragged shards' partial sums differently than the eager dispatch on
+        # the sliced logical view (reassociation) — but the pallas ragged
+        # kernel masks the pad in-register instead (ISSUE 10): mean/nanmean
+        # with an unsplit result take it; the rest keep the counted flush
+        opname = getattr(op, "__name__", None)
+        if not fn_kwargs and out_split is None and opname in ("mean", "nanmean"):
+            res = _defer_ragged(
+                x, "moment", opname, axis, keepdims, sink_label="moment"
+            )
+            if res is not None:
+                return res
+        _sink_fallback("padded-operand")
         return None
     pre = ()
     inp = _input_of(x)
@@ -1540,9 +1655,28 @@ def defer_norm(
     """Sink a ``jnp.linalg.norm`` call (``norm``/``vector_norm``/
     ``matrix_norm`` consume ``x.larray``); the ``sqrt`` epilogue lives inside
     the jnp op. ``flatten`` replays ``vector_norm``'s full-array reshape."""
-    # padded operands fall back to eager (see defer_moment: an in-trace pad
-    # slice would reassociate the ragged shards' partial sums)
-    if x.is_padded or _low_float(x):
+    if _low_float(x):
+        _sink_fallback("low-float")
+        return None
+    if x.is_padded:
+        # in-trace pad slice would reassociate (see defer_moment) — the
+        # pallas ragged kernel serves the sqrt-sum-of-squares orders instead:
+        # default/Euclidean/Frobenius, i.e. exactly the cases where the jnp
+        # default ord reproduces the requested one
+        logical_nd = 1 if flatten else x.ndim
+        ord_ok = (
+            ord is None
+            or (ord == 2 and (logical_nd == 1 or isinstance(axis, int)))
+            or (ord == "fro" and axis is None and logical_nd == 2)
+        )
+        if ord_ok:
+            res = _defer_ragged(
+                x, "norm", "norm2", axis, keepdims, extra=(bool(flatten),),
+                sink_label="norm",
+            )
+            if res is not None:
+                return res
+        _sink_fallback("padded-operand")
         return None
     pre = (("reshape", (-1,)),) if flatten else ()
     try:
@@ -1584,7 +1718,11 @@ def _vecdot_fn_for(axis, keepdim: bool):
 def defer_vecdot(x1: DNDarray, x2: DNDarray, axis, keepdim: bool) -> Optional[DNDarray]:
     """Sink ``vecdot``'s broadcast–conj–multiply–sum pipeline over two (possibly
     pending) operands; the trace replays the eager body verbatim."""
-    if x1.is_padded or x2.is_padded or _low_float(x1) or _low_float(x2):
+    if _low_float(x1) or _low_float(x2):
+        _sink_fallback("low-float")
+        return None
+    if x1.is_padded or x2.is_padded:
+        _sink_fallback("padded-operand")
         return None  # eager consumes larray; a two-operand pad slice is rare
     fn = _vecdot_fn_for(axis, keepdim)
     args = []
@@ -2065,7 +2203,7 @@ def _poison(key) -> None:
 
 def _flush_ladder(
     fused, program, leaf_arrays, out_idx, donate, compiled, key,
-    has_coll=False, debucket=None,
+    has_coll=False, debucket=None, has_pallas=False,
 ):
     """Execute a fused flush with graceful degradation.
 
@@ -2084,6 +2222,12 @@ def _flush_ladder(
     every rung is deterministically testable, and rung-1 outcomes feed the
     ``fusion.compile``/``collective.dispatch`` circuit breakers (ISSUE 9) so
     a flapping site eventually routes flushes straight to eager replay.
+    A pallas-bearing program (``has_pallas``) additionally consults the
+    ``pallas.execute`` fault site on the fused attempt — and the recovery
+    rungs run under :func:`heat_tpu.core.pallas.recovery_mode`, in which
+    every pallas-backed sink callable re-emits its XLA reference formulation
+    instead of the kernel, so a failing kernel degrades to the XLA path (the
+    ``collective.dispatch`` precedent: recovery is proven, not prevented).
     Caveat (documented in robustness_notes): if a *donating* kernel fails
     after consuming its donated buffers — possible on TPU/GPU only — the
     retained leaves are gone and the rung-2/3 replays surface that error
@@ -2101,6 +2245,8 @@ def _flush_ladder(
             # it — a standing collective.dispatch plan proves recovery instead
             # of making recovery impossible
             _FI.check("collective.dispatch")
+        if has_pallas:
+            _FI.check("pallas.execute")
         values = fused(*leaf_arrays)
         if compiled:
             _BRK.breaker("fusion.compile").record_success()
@@ -2132,7 +2278,8 @@ def _flush_ladder(
                 _FI.check("fusion.execute")
                 if has_coll:
                     _FI.check("collective.dispatch")
-                values = debucket()
+                with _PL.recovery_mode():
+                    values = debucket()
             except (KeyboardInterrupt, SystemExit):
                 raise
             except Exception as e1:
@@ -2144,14 +2291,16 @@ def _flush_ladder(
                 _FI.check("fusion.execute")
                 if has_coll:
                     _FI.check("collective.dispatch")
-                values = jax.jit(_replay_fn(program, out_idx))(*leaf_arrays)
+                with _PL.recovery_mode():
+                    values = jax.jit(_replay_fn(program, out_idx))(*leaf_arrays)
             except (KeyboardInterrupt, SystemExit):
                 raise
             except Exception as e2:
                 if _MON.enabled:
                     _instr.fusion_flush_failure(_classify_failure(e2, compiled))
         if values is None:
-            values = _eager_replay(program, leaf_arrays, out_idx)
+            with _PL.recovery_mode():
+                values = _eager_replay(program, leaf_arrays, out_idx)
             _poison(key)
         if _MON.enabled:
             _instr.fusion_flush_recovered()
@@ -2267,6 +2416,16 @@ def materialize_for(d: DNDarray):
         for n in topo
         if n.op_key and n.op_key[0] == "collective" and n.op_key[1] != "haloslice"
     ]
+
+    # Pallas-backed sink nodes in the program: they gate the pallas.execute
+    # fault site in the ladder's fused attempt, and the recovery rungs run
+    # under pallas.recovery_mode so the replay re-emits the XLA reference
+    # formulation instead of the failed kernel.
+    has_pallas = any(
+        n.op_key and n.op_key[0] == "sink" and len(n.op_key) > 1
+        and n.op_key[1] == "pallas"
+        for n in topo
+    )
 
     # Outputs: the root — and, when the root is a reduction SINK or the
     # program carries a COLLECTIVE, every pending interior node whose owning
@@ -2400,7 +2559,8 @@ def materialize_for(d: DNDarray):
             _instr.fusion_flush(
                 len(topo), cache_hit=False, compiled=False, reason=_reason_stack()[-1]
             )
-        values = _eager_replay(program, leaf_arrays, out_idx)
+        with _PL.recovery_mode():
+            values = _eager_replay(program, leaf_arrays, out_idx)
     else:
         # ---- serving: persistent L2 on L1 miss (ISSUE 8). With
         # HEAT_TPU_CACHE_DIR set, a trace-LRU miss consults the on-disk
@@ -2474,7 +2634,7 @@ def materialize_for(d: DNDarray):
 
         values = _flush_ladder(
             fused, program, leaf_arrays, out_idx, donate, compiled, key,
-            has_coll=bool(coll_kinds), debucket=debucket,
+            has_coll=bool(coll_kinds), debucket=debucket, has_pallas=has_pallas,
         )
 
     if bucket_slicer is not None:
@@ -2543,9 +2703,17 @@ def flush_through(x: DNDarray, consumer, consumer_key, reason: str = "linalg"):
     except TypeError:  # unhashable sharding/consumer key — compile uncached
         key, cached = None, None
 
+    has_pallas = any(
+        n.op_key and n.op_key[0] == "sink" and len(n.op_key) > 1
+        and n.op_key[1] == "pallas"
+        for n in topo
+    )
+
     def _eager():
-        (chain_val,) = _eager_replay(program, leaf_arrays, (ridx,))
-        out = consumer(chain_val)
+        # recovery mode: pallas-backed sink nodes replay their XLA reference
+        with _PL.recovery_mode():
+            (chain_val,) = _eager_replay(program, leaf_arrays, (ridx,))
+            out = consumer(chain_val)
         if not isinstance(out, tuple):
             out = (out,)
         return (*out, chain_val)
@@ -2580,6 +2748,8 @@ def flush_through(x: DNDarray, consumer, consumer_key, reason: str = "linalg"):
                 _FI.check("fusion.compile")
             _FI.check("fusion.execute")
             _FI.check("collective.dispatch")
+            if has_pallas:
+                _FI.check("pallas.execute")
             values = cached(*leaf_arrays)
         except (KeyboardInterrupt, SystemExit, _FI.FaultPlanError):
             raise

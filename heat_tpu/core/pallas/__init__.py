@@ -1,0 +1,208 @@
+"""
+Pallas kernel tier: hand-tiled TPU kernels below XLA for the fusion-resistant
+hot paths (ROADMAP item 2).
+
+PRs 3-9 produced a *counted* list of places XLA fusion provably cannot follow
+the eager surface — ``fusion.view_fallbacks{asymmetric-pad,stepped-split-slice}``,
+the padded-operand and sub-32-bit reduction-sink fallbacks of PR 4, and the
+plain-jnp online softmax inside ``ring_attention``'s ppermute loop. This
+package is the escape hatch *below* XLA the SURVEY names (PAPER.md §0/§7):
+three hand-tiled kernels behind existing call sites, each carrying its working
+set in VMEM instead of materializing intermediates through HBM:
+
+* ``flash_ring`` (:mod:`.flash`) — the per-hop (max, denominator, numerator)
+  online-softmax update of ``_ring_attention_sharded`` as ONE kernel that
+  walks the hop's K/V block tile by tile with the running triple resident in
+  VMEM (the FlashAttention tiling, Dao et al. 2022 — PAPERS.md), reused by
+  :func:`~heat_tpu.nn.scaled_dot_product_attention` for the multi-device
+  GSPMD path that previously fell back to dense attention;
+* ``ragged_reduce`` (:mod:`.ragged`) — reductions over canonically padded
+  split-axis operands with the pad masked to the op's neutral element *inside
+  the tile*, giving the PR 4 padded-operand sink fallbacks (where-masked
+  reductions, flat arg-reductions, moments, norms) a fused in-register path
+  instead of an eager flushing one (wired as an alternative sink executor in
+  ``core/fusion.py``);
+* ``kmeans_step`` (:mod:`.kmeans`) — distance tile → label argmin → one-hot
+  centroid accumulation as one pass over the samples (f32 accumulation per
+  the ``spatial/distance.py`` contract), behind
+  :meth:`heat_tpu.cluster.KMeans.step` — BENCH_r05 shows the two-GEMM step is
+  VMEM-resident and therefore bandwidth-bound; the fused kernel reads the
+  sample tile once for both the assignment and the update.
+
+**Availability.** Every kernel runs *compiled* only on a real TPU backend;
+``HEAT_TPU_PALLAS_INTERPRET=1`` additionally admits any backend through the
+pallas interpreter (``pallas_call(interpret=True)`` — the same kernel code
+executed by the jaxpr interpreter), which is how the CPU-only tier-1 host
+tests real kernel bodies. Per-kernel predicates on platform / shape / dtype
+gate each dispatch; every refusal is counted in ``pallas.fallbacks``
+{platform, shape, dtype, hatch} and every taken dispatch in
+``pallas.dispatch`` {kernel}, both exported by
+:func:`heat_tpu.monitoring.report.telemetry`. ``pallas.dispatch`` counts
+*routing decisions* (a cached fused program re-executes without re-recording).
+
+**Escape hatches.** ``HEAT_TPU_PALLAS=0`` disables the whole tier (counted
+``hatch``), restoring the pre-PR XLA paths bit for bit;
+``HEAT_TPU_PALLAS_<KERNEL>=0`` (e.g. ``HEAT_TPU_PALLAS_RAGGED_REDUCE=0``)
+disables one kernel. Both are read per dispatch.
+
+**Recovery.** Kernel call points consult the ``pallas.execute`` fault site
+(:mod:`heat_tpu.robustness.faultinject`): direct call sites (attention,
+kmeans) degrade to their XLA formulation in a ``try``/``except`` (counted
+``pallas.fallbacks{execute}``); a pallas-bearing *fused flush* consults the
+site once per ladder attempt exactly like ``collective.dispatch``, and the
+ladder's recovery rungs run under :func:`recovery_mode`, in which every
+pallas-backed sink callable re-emits its XLA reference formulation instead —
+so a failing kernel degrades through the PR 6 ladder to the XLA path, and
+only its own signature is poisoned.
+
+**Numerics** (doc/pallas_notes.md): masking and arg-selection are bit-exact
+vs the hatch by construction (the neutral fill and the first-index tie-break
+replay the eager semantics); accumulations the tiling reorders (online
+softmax rescaling, centroid sums, f32 masked sums) carry a documented bounded
+divergence, pinned by the differential suite in ``tests/test_pallas.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import jax
+
+from ...monitoring.registry import STATE as _MON
+from ...monitoring import instrument as _instr
+from ...robustness import faultinject as _FI
+
+__all__ = [
+    "KERNELS",
+    "enabled",
+    "kernel_enabled",
+    "interpret_forced",
+    "use_interpret",
+    "available",
+    "dispatch",
+    "execute_guard",
+    "fallback",
+    "in_recovery",
+    "recovery_mode",
+]
+
+#: The registered kernels of the tier (also the ``pallas.dispatch`` labels).
+KERNELS = ("flash_ring", "ragged_reduce", "kmeans_step")
+
+#: dtypes each kernel accepts. ``ragged_reduce`` additionally restricts
+#: *accumulating* ops to exact (integer/bool) or f32 operands at the plan
+#: level — bf16 accumulation keeps the PR 4 low-float fallback discipline.
+_KERNEL_DTYPES = {
+    "flash_ring": ("float32", "bfloat16"),
+    "ragged_reduce": ("float32", "bfloat16", "bool", "int8", "int16", "int32", "int64",
+                      "uint8", "uint16", "uint32", "uint64"),
+    "kmeans_step": ("float32", "bfloat16"),
+}
+
+
+def enabled() -> bool:
+    """Whether the pallas kernel tier is globally enabled (default on).
+    ``HEAT_TPU_PALLAS=0`` restores every pre-PR XLA path bit for bit (read
+    per dispatch, same pattern as ``HEAT_TPU_FUSION``)."""
+    val = os.environ.get("HEAT_TPU_PALLAS", "")
+    return val.strip().lower() not in ("0", "false", "off")
+
+
+def kernel_enabled(kernel: str) -> bool:
+    """Per-kernel hatch: ``HEAT_TPU_PALLAS_<KERNEL>=0`` (kernel name
+    upper-cased) disables one kernel while the rest of the tier stays on."""
+    val = os.environ.get(f"HEAT_TPU_PALLAS_{kernel.upper()}", "")
+    return val.strip().lower() not in ("0", "false", "off")
+
+
+def interpret_forced() -> bool:
+    """Whether ``HEAT_TPU_PALLAS_INTERPRET=1`` admits non-TPU backends via the
+    pallas interpreter (the CPU-host test/bench mode; default off, so the
+    production CPU path never pays interpreter overhead)."""
+    return os.environ.get("HEAT_TPU_PALLAS_INTERPRET", "").strip().lower() in (
+        "1", "true", "on",
+    )
+
+
+def use_interpret() -> bool:
+    """Whether kernel call sites should pass ``interpret=True``: anywhere but
+    a real TPU backend. (On TPU the Mosaic compiler takes the kernel.)"""
+    return jax.default_backend() != "tpu"
+
+
+def fallback(kind: str) -> None:
+    """Count one refused/degraded pallas dispatch (kind: platform / shape /
+    dtype / hatch / execute)."""
+    if _MON.enabled:
+        _instr.pallas_fallback(kind)
+
+
+def available(kernel: str, dtype=None, shape_ok: bool = True) -> bool:
+    """Whether ``kernel`` may take this dispatch. Checks, in order: the master
+    and per-kernel hatches (counted ``hatch``), the platform (TPU, or any
+    backend under ``HEAT_TPU_PALLAS_INTERPRET=1`` — counted ``platform``),
+    the kernel's dtype set (counted ``dtype``), and the caller's precomputed
+    shape predicate (counted ``shape``). Refusals restore the pre-PR XLA
+    path; only a refusal of an *eligible* site is counted, so the counters
+    read as "work the tier declined", not "ops that never applied"."""
+    if kernel not in KERNELS:
+        raise ValueError(f"unknown pallas kernel {kernel!r} (have {KERNELS})")
+    if not (enabled() and kernel_enabled(kernel)):
+        fallback("hatch")
+        return False
+    if jax.default_backend() != "tpu" and not interpret_forced():
+        fallback("platform")
+        return False
+    if dtype is not None and str(dtype) not in _KERNEL_DTYPES[kernel]:
+        fallback("dtype")
+        return False
+    if not shape_ok:
+        fallback("shape")
+        return False
+    return True
+
+
+def dispatch(kernel: str) -> None:
+    """Count one taken routing decision into ``kernel``
+    (``pallas.dispatch{kernel}``)."""
+    if _MON.enabled:
+        _instr.pallas_dispatch(kernel)
+
+
+def execute_guard() -> None:
+    """The ``pallas.execute`` fault site: consulted wherever a pallas kernel
+    is about to be dispatched (direct call sites before running the kernel;
+    pallas-bearing fused flushes once per ladder attempt, see
+    ``fusion._flush_ladder``). Raises the planned exception under an
+    installed :mod:`~heat_tpu.robustness.faultinject` plan."""
+    _FI.check("pallas.execute")
+
+
+# ------------------------------------------------------------------ recovery
+#: Thread-local recovery depth: >0 while the fusion ladder replays a failed
+#: flush (rung 2 donation-free rebuild / rung 3 per-op eager replay) or a
+#: poisoned/breaker-routed signature skips straight to eager. Pallas-backed
+#: sink callables consult it and re-emit their XLA reference formulation, so
+#: recovery lands on the XLA path instead of re-entering the failed kernel.
+_TLS = threading.local()
+
+
+def in_recovery() -> bool:
+    """Whether the current thread is inside a fusion-ladder recovery replay
+    (pallas-backed callables must take their XLA reference path)."""
+    return getattr(_TLS, "depth", 0) > 0
+
+
+class recovery_mode:
+    """Context manager marking ladder recovery on this thread (nestable)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        _TLS.depth = getattr(_TLS, "depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _TLS.depth = getattr(_TLS, "depth", 0) - 1
+        return False

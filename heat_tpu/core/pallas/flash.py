@@ -1,0 +1,179 @@
+"""
+Flash-attention inner tile: the per-hop online-softmax update of
+:func:`heat_tpu.nn.ring_attention` as ONE pallas kernel.
+
+``_ring_attention_sharded`` rescales a running (max, denominator, numerator)
+triple once per ``ppermute`` hop — exactly the flash-attention recurrence
+(Dao et al. 2022, PAPERS.md) — but the plain-jnp body materializes the score
+matrix, the probability matrix, and the rescaled accumulator as three
+separate HBM-round-tripping passes per hop. This kernel walks the hop's K/V
+block tile by tile with the triple resident in VMEM: per (batch·head, q-tile)
+grid cell a ``fori_loop`` over K tiles computes the score tile on the MXU
+(f32 accumulation), folds it into the running (m, l, acc) with the standard
+rescaling identity, and writes the updated triple once at the end.
+
+Layout: the caller presents ``q`` as ``(bh, sq, d)`` (batch and heads merged
+— they are embarrassingly parallel grid dimensions), ``k``/``v`` as
+``(bh, sk, d)``, the triple as ``(bh, sq)`` / ``(bh, sq)`` / ``(bh, sq, d)``
+(all f32). Causality is decided from global position vectors ``q_pos`` /
+``k_pos`` passed as i32 row vectors — they may be traced (the ring's K-block
+index is ``(axis_index + t) % p``), so nothing about the mask is baked.
+
+Numerics: the final running max is exact (max is associative); the
+denominator and numerator accumulate per K tile instead of once per block,
+so f32 results carry a bounded reordering divergence vs the jnp formulation
+(pinned at tight tolerance in ``tests/test_pallas.py``); a single-K-tile
+call replays the jnp algebra operation for operation.
+
+:func:`attention_local` wraps one init→update→normalize round over a whole
+(K, V) — the single-pass flash attention
+:func:`~heat_tpu.nn.scaled_dot_product_attention` uses for the multi-device
+GSPMD path that previously fell back to dense.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["tile_update", "attention_local", "shape_ok"]
+
+#: Q/K tile extents. Blocks are (1, TILE, d) per grid cell; sequences that
+#: are not tile multiples use a single whole-sequence tile when small (the
+#: interpret/test regime) — :func:`shape_ok` refuses the rest.
+TILE_Q = 128
+TILE_K = 128
+MAX_HEAD_DIM = 256
+MAX_SEQ_SINGLE_TILE = 256
+
+
+def _tile(n: int, pref: int) -> int:
+    if n % pref == 0:
+        return pref
+    return n  # single tile (shape_ok bounds this to MAX_SEQ_SINGLE_TILE)
+
+
+def shape_ok(sq: int, sk: int, head_dim: int) -> bool:
+    """Whether the kernel's tiling expresses these extents: head_dim within
+    the VMEM budget, and each sequence either a 128-multiple or small enough
+    for a single whole-sequence tile."""
+    if head_dim > MAX_HEAD_DIM or head_dim < 1:
+        return False
+    for s in (sq, sk):
+        if s % TILE_Q != 0 and s > MAX_SEQ_SINGLE_TILE:
+            return False
+    return sq >= 1 and sk >= 1
+
+
+@functools.lru_cache(maxsize=128)
+def _update_call(bh, sq, sk, d, causal, scale, interpret):
+    tq = _tile(sq, TILE_Q)
+    tk = _tile(sk, TILE_K)
+    nk = sk // tk
+    scale = float(scale)
+
+    def kernel(q_ref, k_ref, v_ref, qp_ref, kp_ref, m_ref, l_ref, o_ref,
+               mo_ref, lo_ref, oo_ref):
+        q = q_ref[0]  # (tq, d) f32
+        m0 = m_ref[0].reshape(tq, 1)
+        l0 = l_ref[0].reshape(tq, 1)
+        acc0 = o_ref[0]  # (tq, d)
+        qp = qp_ref[0].reshape(tq, 1)
+
+        def body(j, carry):
+            m, l, acc = carry
+            kblk = k_ref[0, pl.ds(j * tk, tk), :]
+            vblk = v_ref[0, pl.ds(j * tk, tk), :]
+            s = jnp.dot(q, kblk.T, preferred_element_type=jnp.float32) * scale
+            if causal:
+                kp = kp_ref[0, pl.ds(j * tk, tk)].reshape(1, tk)
+                s = jnp.where(qp >= kp, s, -jnp.inf)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(m - m_new)  # 0 on the -inf -> finite transition
+            p = jnp.exp(s - m_new)
+            l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc_new = acc * alpha + jnp.dot(
+                p, vblk, preferred_element_type=jnp.float32
+            )
+            return m_new, l_new, acc_new
+
+        m, l, acc = jax.lax.fori_loop(0, nk, body, (m0, l0, acc0))
+        mo_ref[0] = m.reshape(tq)
+        lo_ref[0] = l.reshape(tq)
+        oo_ref[0] = acc
+
+    grid = (bh, sq // tq)
+    f32 = jnp.float32
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, tq, d), lambda b, i: (b, i, 0)),   # q
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),   # k (full block)
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),   # v
+            pl.BlockSpec((1, tq), lambda b, i: (0, i)),         # q_pos
+            pl.BlockSpec((1, sk), lambda b, i: (0, 0)),         # k_pos
+            pl.BlockSpec((1, tq), lambda b, i: (b, i)),         # m
+            pl.BlockSpec((1, tq), lambda b, i: (b, i)),         # l
+            pl.BlockSpec((1, tq, d), lambda b, i: (b, i, 0)),   # o
+        ],
+        out_specs=(
+            pl.BlockSpec((1, tq), lambda b, i: (b, i)),
+            pl.BlockSpec((1, tq), lambda b, i: (b, i)),
+            pl.BlockSpec((1, tq, d), lambda b, i: (b, i, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, sq), f32),
+            jax.ShapeDtypeStruct((bh, sq), f32),
+            jax.ShapeDtypeStruct((bh, sq, d), f32),
+        ),
+        interpret=interpret,
+    )
+
+
+def tile_update(q, k, v, m, l, o, *, scale, causal, q_pos, k_pos, interpret):
+    """One online-softmax update of the running triple with a (K, V) block.
+
+    ``q``: (bh, sq, d) f32; ``k``/``v``: (bh, sk, d); ``m``/``l``: (bh, sq)
+    f32; ``o``: (bh, sq, d) f32; ``q_pos``/``k_pos``: i32 global sequence
+    positions, shape (sq,) / (sk,), traced values allowed. Returns the
+    updated ``(m, l, o)``."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    call = _update_call(bh, sq, sk, d, bool(causal), float(scale), bool(interpret))
+    qp = jnp.asarray(q_pos, jnp.int32).reshape(1, sq)
+    kp = jnp.asarray(k_pos, jnp.int32).reshape(1, sk)
+    k32 = k.astype(jnp.float32)
+    v32 = v.astype(jnp.float32)
+    return call(q, k32, v32, qp, kp, m, l, o)
+
+
+def attention_local(q, k, v, *, causal, scale, interpret):
+    """Single-pass flash attention over whole (K, V) via one init → update →
+    normalize round of the ring-step kernel. Operands are
+    ``(batch, seq, heads, head_dim)`` like
+    :func:`~heat_tpu.nn.scaled_dot_product_attention`; returns the attention
+    output in the same layout and ``q``'s dtype."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    bh = b * h
+
+    def merge(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(bh, x.shape[1], d)
+
+    qm = merge(q).astype(jnp.float32)
+    m0 = jnp.full((bh, sq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bh, sq), jnp.float32)
+    o0 = jnp.zeros((bh, sq, d), jnp.float32)
+    q_pos = jnp.arange(sq, dtype=jnp.int32)
+    k_pos = jnp.arange(sk, dtype=jnp.int32)
+    m, l, acc = tile_update(
+        qm, merge(k), merge(v), m0, l0, o0,
+        scale=scale, causal=causal, q_pos=q_pos, k_pos=k_pos, interpret=interpret,
+    )
+    out = acc / l[..., None]
+    out = jnp.transpose(out.reshape(b, h, sq, d), (0, 2, 1, 3))
+    return out.astype(q.dtype)

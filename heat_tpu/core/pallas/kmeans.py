@@ -1,0 +1,114 @@
+"""
+Fused k-means assign+update: distance tile → label argmin → one-hot centroid
+accumulation in ONE pass over the samples.
+
+BENCH_r05 pins the two-GEMM Lloyd step as VMEM-resident and therefore
+bandwidth-bound: the XLA formulation reads the sample block once for the
+distance GEMM and again for the ``onehot.T @ x`` update GEMM, with the
+(n, k) distance matrix and the (n, k) one-hot mask materialized in between.
+This kernel streams the samples in 128-row tiles and, per tile, computes the
+quadratic-expansion distance block on the MXU (f32 accumulation, the
+``spatial/distance.py`` contract), takes the label argmin (first-index
+tie-break, like ``jnp.argmin``), and folds the one-hot-masked centroid sums
+and counts into running (k, f)/(k, 1) accumulators carried in the output
+blocks — the sample tile is read exactly once for both phases and the
+distance/one-hot intermediates never leave VMEM.
+
+The sample tile mask (``row < n_logical``) covers both the grid's tile pad
+and the canonical ragged split pad in one comparison, so the kernel accepts
+the padded physical layout directly. The mean/shift epilogue stays outside
+(plain jnp on (k, f) accumulators — bandwidth-irrelevant).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["fused_step", "shape_ok"]
+
+#: Sample-tile height; centers stay whole in VMEM.
+TILE_N = 128
+MAX_FEATURES = 2048
+MAX_CLUSTERS = 1024
+
+
+def shape_ok(n: int, f: int, k: int) -> bool:
+    """Whether the (samples, features, clusters) extents fit the kernel's
+    VMEM plan: whole (k, f) centers + accumulator blocks beside one sample
+    tile."""
+    return 1 <= f <= MAX_FEATURES and 1 <= k <= MAX_CLUSTERS and n >= 1
+
+
+@functools.lru_cache(maxsize=64)
+def _step_call(n_pad, f, k, dt_str, n_log, tile_n, interpret):
+    tiles = n_pad // tile_n
+
+    def kernel(x_ref, c_ref, lab_ref, sums_ref, cnt_ref):
+        i = pl.program_id(0)
+        xb = x_ref[...].astype(jnp.float32)  # (tile_n, f)
+        c = c_ref[...].astype(jnp.float32)   # (k, f)
+        x2 = jnp.sum(xb * xb, axis=1, keepdims=True)        # (tile_n, 1)
+        c2 = jnp.sum(c * c, axis=1, keepdims=True).T         # (1, k)
+        xc = jnp.dot(xb, c.T, preferred_element_type=jnp.float32)
+        d2 = jnp.maximum(x2 - 2.0 * xc + c2, 0.0)            # (tile_n, k)
+        lab = jnp.argmin(d2, axis=1).astype(jnp.int32)       # (tile_n,)
+        rid = jax.lax.broadcasted_iota(jnp.int32, (tile_n, 1), 0) + i * tile_n
+        valid = rid < n_log                                  # (tile_n, 1)
+        cid = jax.lax.broadcasted_iota(jnp.int32, (tile_n, k), 1)
+        onehot = ((lab[:, None] == cid) & valid).astype(jnp.float32)
+        lab_ref[...] = jnp.where(valid, lab[:, None], 0)
+
+        @pl.when(i == 0)
+        def _():
+            sums_ref[...] = jnp.zeros_like(sums_ref)
+            cnt_ref[...] = jnp.zeros_like(cnt_ref)
+
+        sums_ref[...] += jnp.dot(
+            onehot.T, xb, preferred_element_type=jnp.float32
+        )
+        cnt_ref[...] += jnp.sum(onehot, axis=0, keepdims=True).T  # (k, 1)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(tiles,),
+        in_specs=[
+            pl.BlockSpec((tile_n, f), lambda i: (i, 0)),
+            pl.BlockSpec((k, f), lambda i: (0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((tile_n, 1), lambda i: (i, 0)),
+            pl.BlockSpec((k, f), lambda i: (0, 0)),
+            pl.BlockSpec((k, 1), lambda i: (0, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
+            jax.ShapeDtypeStruct((k, f), jnp.float32),
+            jax.ShapeDtypeStruct((k, 1), jnp.float32),
+        ),
+        interpret=interpret,
+    )
+
+
+def fused_step(x_phys, centers, n_log: int, interpret: bool):
+    """One fused assignment+update pass. ``x_phys`` is the (possibly
+    canonically padded) physical sample block ``(n_phys, f)``; ``centers``
+    ``(k, f)``; ``n_log`` the logical sample count. Returns
+    ``(labels (n_phys,) i32 — pad rows 0, sums (k, f) f32, counts (k,) f32)``.
+    """
+    n_phys, f = x_phys.shape
+    k = centers.shape[0]
+    if n_phys > TILE_N:
+        tile_n = TILE_N
+    else:
+        tile_n = max(8, -(-n_phys // 8) * 8) if n_phys > 1 else 1
+    n_pad = -(-n_phys // tile_n) * tile_n
+    xp = jnp.pad(x_phys, ((0, n_pad - n_phys), (0, 0))) if n_pad != n_phys else x_phys
+    call = _step_call(
+        n_pad, f, k, str(x_phys.dtype), int(n_log), tile_n, bool(interpret)
+    )
+    labels, sums, counts = call(xp, centers)
+    return labels[:n_phys, 0], sums, counts[:, 0]

@@ -1,0 +1,478 @@
+"""
+In-register ragged reduce: reductions over canonically padded split-axis
+operands with the pad masked to the op's neutral element *inside the tile*.
+
+The PR 4 reduction sinks fall back to an eager flush whenever the eager path
+computes on the *sliced logical view* of a padded operand — ``where=``-masked
+reductions (the mask's extent is logical), flattened arg-reductions (flat
+indices must be logical), and the moment/norm routes (they consume
+``x.larray``). An in-trace pad slice is no substitute: the SPMD partitioner
+then groups the ragged shards' partial sums differently from the eager
+dispatch (reassociation). This kernel takes the third road the ISSUE names:
+keep the *physical* padded layout, walk it in row tiles, and neutralize the
+pad (and any ``where=`` mask) with the op's own neutral element in VMEM —
+one pass, no materialized logical copy, no separate mask kernel.
+
+Kernel shape: the operand is viewed 2-D (``(1, N)`` for vectors), row-tiled
+at 128 rows per grid step with the full column extent resident in VMEM;
+validity is decided per element from two baked bounds (the logical extent of
+the padded axis and the tile-pad bound) plus the optional ``where`` mask, and
+each tile folds into a running accumulator carried in the output block
+(scalar and reduce-rows modes) or writes its own output rows (reduce-cols
+mode). Arg-reductions carry a (best value, best flat index) pair with the
+eager first-occurrence tie-break: within a tile the minimum flat index among
+hits, across tiles strict improvement only (earlier tiles hold smaller
+indices); the physical flat index is remapped to the logical one outside the
+kernel (exact — one padded axis preserves C-order).
+
+Lowered ops: ``sum`` / ``prod`` / ``min`` / ``max`` / ``argmin`` / ``argmax``
+in-kernel; ``any``/``all`` ride max/min over an i32 cast, ``mean`` divides
+the masked sum by the static logical count, ``nanmean`` accumulates a
+dynamic non-NaN count beside the sum, and the Euclidean/Frobenius norms
+square in-register and ``sqrt`` outside. Accumulating ops are restricted to
+f32 and exact integer operands (integer accumulation is order-exact;
+sub-32-bit floats keep the PR 4 low-float fallback); order-preserving
+min/max/arg additionally admit bf16 bit-exactly.
+
+Every callable consults :func:`heat_tpu.core.pallas.in_recovery` first and
+re-emits the *XLA reference formulation* (the eager logical-view compute)
+when the fusion ladder is replaying a failed flush — recovery lands on the
+XLA path, never re-enters the failed kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from . import in_recovery as _in_recovery
+
+__all__ = ["plan", "sink_fn_for", "reference_fn"]
+
+#: Row-tile height of the grid sweep (full column extent per tile).
+TILE_R = 128
+
+#: VMEM guardrails for the availability predicate: a row tile (and the
+#: accumulator row) must fit comfortably beside double-buffered inputs.
+MAX_COLS = 16384
+MAX_ELEMS = 1 << 24
+
+_ACC_OPS = ("sum", "prod", "mean", "nanmean", "norm2")
+_ORDER_OPS = ("min", "max", "any", "all", "argmin", "argmax")
+_INT_KINDS = "biu"  # numpy dtype kinds with order-exact accumulation
+
+
+def _axmode(ndim, axis, split_ax):
+    """Normalize the reduction axis against the 2-D kernel view. Returns
+    ``("all" | 0 | 1, split2d)`` or None when the combination would leave the
+    result split (the sink contract here is an unsplit result) or is not a
+    2-D-expressible reduction."""
+    if ndim == 1:
+        if axis in (None, 0, (0,)):
+            return "all", 1
+        return None
+    if ndim != 2:
+        return None
+    split2d = int(split_ax)
+    if axis is None:
+        return "all", split2d
+    axes = (axis,) if isinstance(axis, int) else tuple(sorted(axis))
+    if axes == (0, 1):
+        return "all", split2d
+    if len(axes) == 1 and axes[0] == split2d:
+        # reducing exactly the padded axis: the surviving axis is unsplit
+        return axes[0], split2d
+    return None
+
+
+def plan(
+    kind: str,
+    opname: str,
+    shape,
+    dtype,
+    split_ax: int,
+    n_log: int,
+    axis,
+    keepdims: bool,
+    has_where: bool,
+    extra=(),
+    interpret: bool = True,
+):
+    """Build the static task descriptor for one padded-operand sink, or None
+    when the kernel does not express this combination (the caller counts the
+    ``fusion.sink_fallbacks`` label). ``shape`` is the PHYSICAL padded shape;
+    ``extra`` carries per-kind statics (norm: ``(flatten,)``). The returned
+    task bakes the expected logical result aval of the *eager* formulation,
+    so the fused and hatch paths agree on shape and dtype by construction."""
+    shape = tuple(int(s) for s in shape)
+    dt = np.dtype(dtype)
+    if kind == "where" and opname not in ("sum", "prod", "any", "all"):
+        return None
+    if kind == "argflat" and (opname not in ("argmin", "argmax") or axis is not None):
+        return None
+    if kind == "moment" and opname not in ("mean", "nanmean"):
+        return None
+    if kind == "norm" and opname != "norm2":
+        return None
+    if opname in _ACC_OPS and not (
+        dt == np.dtype(np.float32) or dt.kind in _INT_KINDS
+    ):
+        return None  # bf16/f16 accumulation: PR 4 low-float discipline
+    mode = _axmode(len(shape), axis, split_ax)
+    if mode is None:
+        return None
+    r, c = (1, shape[0]) if len(shape) == 1 else shape
+    if c > MAX_COLS or r * c > MAX_ELEMS:
+        return None
+    axisn = axis if (axis is None or isinstance(axis, int)) else tuple(sorted(axis))
+    task = (
+        kind, opname, shape, str(dt), int(split_ax), int(n_log),
+        axisn, bool(keepdims), bool(has_where), tuple(extra), bool(interpret),
+    )
+    try:
+        ref = reference_fn(task)
+        avals = [jax.ShapeDtypeStruct(shape, dt)]
+        if has_where:
+            logical = list(shape)
+            logical[split_ax] = n_log
+            avals.append(jax.ShapeDtypeStruct(tuple(logical), np.dtype(bool)))
+        out = jax.eval_shape(ref, *avals)
+    except Exception:
+        return None
+    return task + (tuple(int(s) for s in out.shape), str(out.dtype))
+
+
+def _unpack(task):
+    (kind, opname, shape, dt, split_ax, n_log, axis, keepdims, has_where,
+     extra, interpret, out_shape, out_dtype) = task
+    return (kind, opname, shape, np.dtype(dt), split_ax, n_log, axis,
+            keepdims, has_where, extra, interpret, out_shape, np.dtype(out_dtype))
+
+
+def _logical_index(shape, split_ax, n_log):
+    return tuple(
+        slice(0, n_log) if d == split_ax else slice(None) for d in range(len(shape))
+    )
+
+
+@functools.lru_cache(maxsize=256)
+def _reference_cached(key):
+    (kind, opname, shape, split_ax, n_log, axis, keepdims, extra) = key
+    idx = _logical_index(shape, split_ax, n_log)
+    jop = {
+        "sum": jnp.sum, "prod": jnp.prod, "any": jnp.any, "all": jnp.all,
+        "argmin": jnp.argmin, "argmax": jnp.argmax,
+        "mean": jnp.mean, "nanmean": jnp.nanmean,
+    }.get(opname)
+
+    def ref(v, *dyn):
+        vl = v[idx]  # the eager logical view
+        if kind == "where":
+            return jop(vl, axis=axis, keepdims=keepdims, where=dyn[0])
+        if kind == "argflat":
+            return jop(vl, axis=None)
+        if kind == "moment":
+            return jop(vl, axis=axis, keepdims=keepdims)
+        # norm2: vector_norm's full-array flatten, or norm on the view
+        (flatten,) = extra
+        if flatten:
+            vl = vl.reshape(-1)
+        return jnp.linalg.norm(vl, axis=axis, keepdims=keepdims)
+
+    return ref
+
+
+def reference_fn(task):
+    """The XLA reference formulation of ``task`` — the eager logical-view
+    compute, used for abstract eval at plan time and by the fusion ladder's
+    recovery replay (in eager replay it runs op-at-a-time on concrete arrays,
+    bit-identical to the hatch path). Accepts both the 11-field plan-time
+    prefix and the full task."""
+    return _reference_cached(
+        (task[0], task[1], task[2], task[4], task[5], task[6], task[7], task[9])
+    )
+
+
+# ------------------------------------------------------------------ kernel
+def _neutral(op, dt):
+    if op == "sum":
+        return np.zeros((), dt)[()]
+    if op == "prod":
+        return np.ones((), dt)[()]
+    if op == "min":
+        return np.array(np.inf if dt.kind == "f" else np.iinfo(dt).max, dt)[()]
+    if op == "max":
+        return np.array(-np.inf if dt.kind == "f" else np.iinfo(dt).min, dt)[()]
+    raise AssertionError(op)
+
+
+_COMBINE = {
+    "sum": jnp.add, "prod": jnp.multiply, "min": jnp.minimum, "max": jnp.maximum,
+}
+
+
+@functools.lru_cache(maxsize=256)
+def _reduce_call(op, r_pad, c, tile_r, dt_str, row_bound, col_bound, axmode,
+                 has_where, with_count, interpret):
+    """Memoized pallas callable for one masked-reduce signature. ``op`` is a
+    core op (sum/prod/min/max); ``with_count`` adds a dynamic valid-count
+    output (nanmean — NaN positions are already invalid in the mask the
+    wrapper passes). Inputs are the tile-padded physical 2-D operand and,
+    when ``has_where``, an i32 mask of the same shape."""
+    dt = jnp.dtype(dt_str)
+    neutral = _neutral(op, np.dtype(dt_str))
+    combine = _COMBINE[op]
+    grid = (r_pad // tile_r,)
+
+    def kernel(*refs):
+        x_ref = refs[0]
+        m_ref = refs[1] if has_where else None
+        out_ref = refs[1 + int(has_where)]
+        cnt_ref = refs[2 + int(has_where)] if with_count else None
+        i = pl.program_id(0)
+        x = x_ref[...]
+        rid = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0) + i * tile_r
+        cid = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+        valid = (rid < row_bound) & (cid < col_bound)
+        if m_ref is not None:
+            valid = valid & (m_ref[...] != 0)
+        vm = jnp.where(valid, x, jnp.asarray(neutral, dt))
+        if axmode == 1:
+            # reduce-cols: each tile owns its output rows, no carry
+            out_ref[...] = jnp.asarray(
+                getattr(jnp, op)(vm, axis=1, keepdims=True), dt
+            )
+        else:
+            t = (
+                getattr(jnp, op)(vm).reshape(1, 1)
+                if axmode == "all"
+                else getattr(jnp, op)(vm, axis=0, keepdims=True)
+            )
+
+            @pl.when(i == 0)
+            def _():
+                out_ref[...] = jnp.full_like(out_ref, neutral)
+
+            out_ref[...] = combine(out_ref[...], jnp.asarray(t, dt))
+        if cnt_ref is not None:
+            n = valid.astype(jnp.int32)
+            if axmode == 1:
+                cnt_ref[...] = jnp.sum(n, axis=1, keepdims=True)
+            else:
+                tn = (
+                    jnp.sum(n).reshape(1, 1)
+                    if axmode == "all"
+                    else jnp.sum(n, axis=0, keepdims=True)
+                )
+
+                @pl.when(i == 0)
+                def _():
+                    cnt_ref[...] = jnp.zeros_like(cnt_ref)
+
+                cnt_ref[...] += tn
+
+    if axmode == "all":
+        out_sds = jax.ShapeDtypeStruct((1, 1), dt)
+        out_spec = pl.BlockSpec((1, 1), lambda i: (0, 0))
+    elif axmode == 0:
+        out_sds = jax.ShapeDtypeStruct((1, c), dt)
+        out_spec = pl.BlockSpec((1, c), lambda i: (0, 0))
+    else:
+        out_sds = jax.ShapeDtypeStruct((r_pad, 1), dt)
+        out_spec = pl.BlockSpec((tile_r, 1), lambda i: (i, 0))
+    out_shape = [out_sds]
+    out_specs = [out_spec]
+    if with_count:
+        cshape = (1, 1) if axmode == "all" else ((1, c) if axmode == 0 else (r_pad, 1))
+        cspec = out_spec if axmode != "all" else pl.BlockSpec((1, 1), lambda i: (0, 0))
+        out_shape.append(jax.ShapeDtypeStruct(cshape, jnp.int32))
+        out_specs.append(
+            cspec if axmode != 0 else pl.BlockSpec((1, c), lambda i: (0, 0))
+        )
+    in_specs = [pl.BlockSpec((tile_r, c), lambda i: (i, 0))]
+    if has_where:
+        in_specs.append(pl.BlockSpec((tile_r, c), lambda i: (i, 0)))
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=tuple(out_specs) if len(out_specs) > 1 else out_specs[0],
+        out_shape=tuple(out_shape) if len(out_shape) > 1 else out_shape[0],
+        interpret=interpret,
+    )
+
+
+@functools.lru_cache(maxsize=256)
+def _arg_call(op, r_pad, c, tile_r, dt_str, row_bound, col_bound, interpret):
+    """Memoized pallas callable for a flattened arg-reduction: carries the
+    (best value, best physical flat index) pair across row tiles with the
+    eager first-occurrence tie-break."""
+    dt = np.dtype(dt_str)
+    is_min = op == "argmin"
+    is_float = dt.kind == "f" or dt_str == "bfloat16"
+    kdt = jnp.float32 if is_float else jnp.dtype(dt_str)
+    worst = _neutral("min" if is_min else "max", np.dtype(np.float32)) if is_float \
+        else _neutral("min" if is_min else "max", dt)
+    intmax = np.iinfo(np.int32).max
+
+    def kernel(x_ref, bv_ref, bi_ref):
+        i = pl.program_id(0)
+        x = x_ref[...].astype(kdt)
+        rid = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0) + i * tile_r
+        cid = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+        valid = (rid < row_bound) & (cid < col_bound)
+        if is_float:
+            # numpy/jnp arg-reductions let NaN win: fold NaN to the strongest
+            # key so the first NaN's index is selected, exactly like eager
+            x = jnp.where(jnp.isnan(x), jnp.asarray(
+                -jnp.inf if is_min else jnp.inf, kdt), x)
+        key = jnp.where(valid, x, jnp.asarray(worst, kdt))
+        flat = rid * c + cid
+        tbest = jnp.min(key) if is_min else jnp.max(key)
+        hit = (key == tbest) & valid
+        tidx = jnp.min(jnp.where(hit, flat, intmax))
+
+        @pl.when(i == 0)
+        def _():
+            bv_ref[0, 0] = jnp.asarray(worst, kdt)
+            bi_ref[0, 0] = intmax
+
+        bv, bi = bv_ref[0, 0], bi_ref[0, 0]
+        # strict improvement only: earlier tiles hold strictly smaller flat
+        # indices, so a tie keeps the first occurrence
+        take = (tbest < bv) if is_min else (tbest > bv)
+        bv_ref[0, 0] = jnp.where(take, tbest, bv)
+        bi_ref[0, 0] = jnp.where(take, tidx, bi)
+
+    return pl.pallas_call(
+        kernel,
+        grid=(r_pad // tile_r,),
+        in_specs=[pl.BlockSpec((tile_r, c), lambda i: (i, 0))],
+        out_specs=(
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((1, 1), kdt),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        ),
+        interpret=interpret,
+    )
+
+
+def _tile_geometry(r):
+    """(tile_r, r_pad): 128-row tiles for tall operands, one sublane-aligned
+    tile otherwise."""
+    if r > TILE_R:
+        tile_r = TILE_R
+    else:
+        tile_r = max(8, -(-r // 8) * 8) if r > 1 else 1
+    return tile_r, -(-r // tile_r) * tile_r
+
+
+def _execute(task, v, *dyn):
+    """Run ``task``'s kernel on the physical operand (2-D view, tile pad,
+    kernel, epilogue) and return the eager-shaped logical result."""
+    (kind, opname, shape, dt, split_ax, n_log, axis, keepdims, has_where,
+     extra, interpret, out_shape, out_dtype) = _unpack(task)
+    ndim = len(shape)
+    v2 = v.reshape(1, shape[0]) if ndim == 1 else v
+    split2d = 1 if ndim == 1 else split_ax
+    r, c = v2.shape
+    row_bound = n_log if split2d == 0 else r
+    col_bound = n_log if split2d == 1 else c
+    mode = _axmode(ndim, axis, split_ax)[0]
+    tile_r, r_pad = _tile_geometry(r)
+
+    mask = None
+    if has_where:
+        logical = tuple(n_log if d == split_ax else s for d, s in enumerate(shape))
+        m = jnp.broadcast_to(dyn[0], logical).astype(jnp.int32)
+        m2 = m.reshape(1, -1) if ndim == 1 else m
+        pad = [(0, v2.shape[d] - m2.shape[d]) for d in range(2)]
+        mask = jnp.pad(m2, pad)  # physical extent; pad region False
+    if r_pad != r:
+        v2 = jnp.pad(v2, ((0, r_pad - r), (0, 0)))
+        if mask is not None:
+            mask = jnp.pad(mask, ((0, r_pad - r), (0, 0)))
+
+    # core-op lowering: any/all ride max/min over an i32 cast, mean/nanmean
+    # and the norms accumulate sums of (transformed) values
+    x = v2
+    count = None
+    if opname in ("any", "all"):
+        core = "max" if opname == "any" else "min"
+        x = (v2 != 0).astype(jnp.int32)
+    elif opname == "nanmean":
+        core = "sum"
+        nanm = jnp.isnan(v2)
+        x = jnp.where(nanm, jnp.asarray(0, v2.dtype), v2)
+        extra_mask = (~nanm).astype(jnp.int32)
+        mask = extra_mask if mask is None else mask * extra_mask
+        has_where = True
+    elif opname == "norm2":
+        core = "sum"
+        x = (v2.astype(jnp.float32) ** 2)
+    elif opname == "mean":
+        core = "sum"
+    elif opname in ("argmin", "argmax"):
+        core = opname
+    else:
+        core = opname
+
+    if core in ("argmin", "argmax"):
+        call = _arg_call(
+            core, r_pad, c, tile_r, str(v2.dtype), row_bound, col_bound, interpret
+        )
+        _, bi = call(x)
+        p = bi[0, 0]
+        if split2d == 1 and col_bound != c:
+            p = (p // c) * col_bound + (p % c)
+        res = p
+    else:
+        call = _reduce_call(
+            core, r_pad, c, tile_r, str(x.dtype), row_bound, col_bound, mode,
+            mask is not None, opname == "nanmean", interpret,
+        )
+        args = (x,) if mask is None else (x, mask)
+        out = call(*args)
+        if opname == "nanmean":
+            s, count = out
+            res = s / jnp.maximum(count, 1).astype(s.dtype)
+        else:
+            res = out
+        if mode == 1 and r_pad != r:
+            res = res[:r]  # drop the tile-pad rows of the per-row output
+        if opname == "mean":
+            rows_log = row_bound
+            cols_log = col_bound
+            n = {"all": rows_log * cols_log, 0: rows_log, 1: cols_log}[mode]
+            res = res / jnp.asarray(n, res.dtype)
+        elif opname == "norm2":
+            res = jnp.sqrt(res)
+        elif opname in ("any", "all"):
+            res = res != 0
+    return jnp.asarray(res).reshape(out_shape).astype(out_dtype)
+
+
+_FNS: dict = {}
+
+
+def sink_fn_for(task):
+    """Memoized sink callable for one static task signature (one object per
+    signature: node identity, the abstract-eval memo, and the trace-LRU key
+    all hang off it). The callable replays the XLA reference formulation
+    under ladder recovery and dispatches the pallas kernel otherwise."""
+    fn = _FNS.get(task)
+    if fn is None:
+        def fn(v, *dyn, _t=task):
+            if _in_recovery():
+                return reference_fn(_t)(v, *dyn)
+            return _execute(_t, v, *dyn)
+
+        _FNS[task] = fn
+    return fn

@@ -61,7 +61,10 @@ __all__ = [
     "collective",
     "fusion_defer",
     "fusion_sink",
+    "fusion_sink_fallback",
     "fusion_view_fallback",
+    "pallas_dispatch",
+    "pallas_fallback",
     "fusion_collective_fallback",
     "fusion_flush",
     "fusion_flush_failure",
@@ -165,6 +168,31 @@ def fusion_sink(kind: str) -> None:
     """One reduction absorbed as a sink of a pending expression DAG instead
     of flushing it (kind: reduce/cum/moment/norm/vecdot)."""
     REGISTRY.counter("fusion.reduction_sinks").inc(label=kind)
+
+
+def fusion_sink_fallback(kind: str) -> None:
+    """One reduction over a pending chain that had to take the eager
+    (flushing) fallback instead of sinking (kind: padded-operand — the eager
+    path computes on the sliced logical view and no pallas ragged-reduce
+    route applied; low-float — the sub-32-bit excess-precision carve-out)."""
+    REGISTRY.counter("fusion.sink_fallbacks").inc(label=kind)
+
+
+def pallas_dispatch(kernel: str) -> None:
+    """One routing decision taken INTO a pallas-tier kernel
+    (``heat_tpu/core/pallas/``; kernel: flash_ring / ragged_reduce /
+    kmeans_step). Counts decisions, not launches — a cached fused program
+    re-executes without re-recording its pallas sink."""
+    REGISTRY.counter("pallas.dispatch").inc(label=kernel)
+
+
+def pallas_fallback(kind: str) -> None:
+    """One pallas-tier dispatch refused or degraded back to the XLA path
+    (kind: hatch — ``HEAT_TPU_PALLAS[_<KERNEL>]=0``; platform — not a TPU
+    backend and the interpreter not forced; dtype / shape — the kernel's
+    availability predicate; execute — a kernel call point failed or was
+    fault-injected at ``pallas.execute`` and the call site degraded)."""
+    REGISTRY.counter("pallas.fallbacks").inc(label=kind)
 
 
 def fusion_view_fallback(kind: str) -> None:

@@ -125,6 +125,12 @@ def telemetry() -> dict:
         ("fusion.ops_deferred", "fusion_ops_deferred"),
         ("fusion.view_fallbacks", "fusion_view_fallbacks"),
         ("fusion.collective_fallbacks", "fusion_collective_fallbacks"),
+        # pallas kernel tier (ISSUE 10): which kernels took dispatches, which
+        # sites refused them and why, and which reductions still had to take
+        # the eager sink fallback the tier exists to shrink
+        ("fusion.sink_fallbacks", "fusion_sink_fallbacks"),
+        ("pallas.dispatch", "pallas_dispatch"),
+        ("pallas.fallbacks", "pallas_fallbacks"),
         # serving-runtime breakdowns (ISSUE 8): disk-cache hit/miss/write
         # traffic, bucket hits + pad waste, corpus/warmup outcomes
         ("serving.disk_cache", "serving_disk_cache"),

@@ -30,6 +30,12 @@ Sites (the catalog is shared with ``doc/robustness_notes.md``):
                           (``serving/cache.py`` — a planned fault falls back
                           to a fresh compile, counted
                           ``serving.disk_cache{corrupt}``)
+``pallas.execute``        one pallas-tier kernel dispatch
+                          (``core/pallas/``): direct call sites (attention,
+                          kmeans) degrade to their XLA formulation, counted
+                          ``pallas.fallbacks{execute}``; a pallas-bearing
+                          fused flush consults it per ladder attempt and
+                          recovers through the ladder's XLA replay
 ========================  =====================================================
 
 Plans are installed programmatically::
@@ -94,6 +100,10 @@ SITES = (
     "checkpoint.write",
     "collective.dispatch",
     "serving.cache_read",
+    # pallas-tier kernel dispatch (core/pallas/): NOT in the chaos defaults —
+    # direct-site degradation swaps the kernel for its XLA formulation, which
+    # is correct but only boundedly (not bitwise) identical
+    "pallas.execute",
 )
 
 ENV_VAR = "HEAT_TPU_FAULT_PLAN"

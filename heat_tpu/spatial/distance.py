@@ -73,7 +73,9 @@ def _euclidian_fast(x: jax.Array, y: jax.Array) -> jax.Array:
 def _quadratic_expand(x: jax.Array, y: jax.Array, precision=None) -> jax.Array:
     """|x|^2 - 2 x.y + |y|^2 (reference distance.py:46-65): one MXU GEMM + rank-1
     updates — the TPU-optimal formulation. All intermediates stay 2-D and the GEMM
-    pins f32 accumulation, so this is also the canonical in-kernel (pallas) form.
+    pins f32 accumulation — the exact contract the shipped pallas kernel tier
+    implements in-register (``core/pallas/kmeans.py`` fuses this distance tile
+    with the label argmin and the one-hot centroid accumulate in one pass).
 
     ``precision=None`` is the MXU default (one bf16 pass for f32 operands) —
     throughput-critical callers like the KMeans assignment step keep it. The

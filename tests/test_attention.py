@@ -148,3 +148,14 @@ def test_attention_numerical_stability_large_logits(comm):
     assert np.isfinite(out).all()
     dense = np.asarray(scaled_dot_product_attention(q, k, v, causal=False))
     np.testing.assert_allclose(out, dense, rtol=1e-3, atol=1e-3)
+
+
+def test_flash_impl_off_tpu_raises_clear_error():
+    """ISSUE 10 satellite: impl='flash' off-TPU used to die inside the
+    jax.experimental.pallas TPU kernel import/lowering — it must name the
+    platform requirement instead."""
+    if jax.default_backend() == "tpu":
+        pytest.skip("flash impl is legitimate on a TPU backend")
+    q, k, v = _qkv(b=1, s=32, h=2, d=8)
+    with pytest.raises(ValueError, match="TPU backend"):
+        scaled_dot_product_attention(q, k, v, impl="flash")

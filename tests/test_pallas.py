@@ -1,0 +1,512 @@
+"""
+Differential and behavioral suite for the pallas kernel tier (ISSUE 10,
+``heat_tpu/core/pallas/``).
+
+Guarantees pinned here:
+
+* **Registry.** Availability predicates on platform / shape / dtype, the
+  ``HEAT_TPU_PALLAS=0`` master hatch and per-kernel hatches, and the
+  ``pallas.dispatch`` / ``pallas.fallbacks`` counter catalog.
+* **Ragged-reduce differential.** Every pallas-served padded-operand sink
+  (where-masked reductions, flat arg-reductions, mean/nanmean moments,
+  Euclidean norms) vs its ``HEAT_TPU_PALLAS=0`` hatch across split
+  {None, 0, 1} × even/ragged × f32/bf16 (bf16 on the order-preserving ops the
+  plan admits), in interpret mode: masking and arg-selection bit-for-bit,
+  accumulations within the documented reordering bound.
+* **Acceptance** (ISSUE 10): a ragged split-axis where-mask/moment workload
+  that previously took the PR 4 eager sink fallback executes through the
+  pallas sink — ``pallas.dispatch{ragged_reduce}`` > 0 and
+  ``fusion.sink_fallbacks`` == 0 on that workload, and the reductions SINK
+  (``fusion.flush_reason{reduction}`` == 0).
+* **Flash kernel.** ``scaled_dot_product_attention``'s multi-device GSPMD
+  path and ``ring_attention``'s per-hop update vs their dense/jnp
+  formulations; a fault-injected kernel degrades to the XLA path bit-for-bit.
+* **KMeans.** The fused assign+update step vs the hatch step: labels
+  bit-equal (same first-index argmin), centers/shift within the f32
+  accumulation bound; the hatch restores the deferred op-surface step.
+* **Recovery ladder.** A pallas-bearing fused flush fault-injected at
+  ``pallas.execute`` degrades through the PR 6 ladder to the XLA reference
+  replay (bit-identical to the hatch), poisoning only its own signature.
+
+The CI ``pallas-smoke`` hatch leg runs this whole suite under
+``HEAT_TPU_PALLAS=0``: tests that assert pallas engagement pin the gates ON
+via monkeypatch (the fusion-smoke precedent).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from heat_tpu import monitoring
+from heat_tpu.core import fusion
+from heat_tpu.core import pallas as plreg
+from heat_tpu.monitoring import registry, report
+from heat_tpu.nn import ring_attention, scaled_dot_product_attention
+from heat_tpu.robustness import faultinject
+
+pytestmark = pytest.mark.pallas
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    registry.reset()
+    monkeypatch.setenv("HEAT_TPU_FUSION", "1")
+    monkeypatch.setenv("HEAT_TPU_FUSION_SINKS", "1")
+    fusion.clear_cache()
+    yield
+    registry.reset()
+
+
+@pytest.fixture
+def pallas_on(monkeypatch):
+    """Pin the tier ON in interpret mode (the CPU-host kernel regime); the CI
+    hatch leg sets HEAT_TPU_PALLAS=0 suite-wide, so engagement-asserting
+    tests must pin their own gates."""
+    monkeypatch.setenv("HEAT_TPU_PALLAS", "1")
+    monkeypatch.setenv("HEAT_TPU_PALLAS_INTERPRET", "1")
+    monkeypatch.delenv("HEAT_TPU_FAULT_PLAN", raising=False)
+    monkeypatch.delenv("HEAT_TPU_CHAOS", raising=False)
+    faultinject.clear()
+    fusion.clear_cache()
+    return monkeypatch
+
+
+def _count(name, label=None):
+    c = registry.REGISTRY.counter(name)
+    return c.get(label=label) if label else c.get()
+
+
+def _operand(shape, split, dtype, seed=0, offset=0.0):
+    rng = np.random.default_rng(seed)
+    a = ht.array(
+        (rng.standard_normal(shape) + offset).astype(np.float32), split=split
+    ).astype(dtype)
+    a.parray  # noqa: B018 — concrete leaf; the tests chain on top
+    return a
+
+
+def _both(monkeypatch, fn):
+    """Run ``fn`` once with the tier hatched off and once on (interpret);
+    returns both results as numpy arrays."""
+    monkeypatch.setenv("HEAT_TPU_PALLAS", "0")
+    fusion.clear_cache()
+    off = np.asarray(fn().numpy())
+    monkeypatch.setenv("HEAT_TPU_PALLAS", "1")
+    monkeypatch.setenv("HEAT_TPU_PALLAS_INTERPRET", "1")
+    fusion.clear_cache()
+    on = np.asarray(fn().numpy())
+    return off, on
+
+
+def _bitwise(a, b):
+    return a.shape == b.shape and a.dtype == b.dtype and a.tobytes() == b.tobytes()
+
+
+# ---------------------------------------------------------------- registry
+def test_master_hatch_counts_fallback(monkeypatch):
+    monkeypatch.setenv("HEAT_TPU_PALLAS", "0")
+    with monitoring.capture():
+        assert not plreg.available("ragged_reduce")
+    assert _count("pallas.fallbacks", "hatch") == 1
+
+
+def test_per_kernel_hatch(monkeypatch, pallas_on):
+    monkeypatch.setenv("HEAT_TPU_PALLAS_RAGGED_REDUCE", "0")
+    with monitoring.capture():
+        assert not plreg.available("ragged_reduce")
+        assert plreg.available("flash_ring", dtype=np.dtype(np.float32))
+    assert _count("pallas.fallbacks", "hatch") == 1
+
+
+def test_platform_fallback_without_interpret(monkeypatch):
+    monkeypatch.setenv("HEAT_TPU_PALLAS", "1")
+    monkeypatch.delenv("HEAT_TPU_PALLAS_INTERPRET", raising=False)
+    with monitoring.capture():
+        # CPU host, interpreter not forced: the tier declines the platform
+        assert not plreg.available("kmeans_step")
+    assert _count("pallas.fallbacks", "platform") == 1
+
+
+def test_dtype_and_shape_fallbacks(pallas_on):
+    with monitoring.capture():
+        assert not plreg.available("flash_ring", dtype=np.dtype(np.float64))
+        assert not plreg.available("kmeans_step", shape_ok=False)
+    assert _count("pallas.fallbacks", "dtype") == 1
+    assert _count("pallas.fallbacks", "shape") == 1
+
+
+def test_unknown_kernel_rejected():
+    with pytest.raises(ValueError, match="unknown pallas kernel"):
+        plreg.available("nope")
+
+
+def test_interpret_not_forced_is_production_default(monkeypatch):
+    monkeypatch.delenv("HEAT_TPU_PALLAS_INTERPRET", raising=False)
+    assert not plreg.interpret_forced()
+    assert plreg.use_interpret()  # CPU host: any kernel use would interpret
+
+
+# ------------------------------------------------------- ragged differential
+_RAGGED_SHAPES = [((16, 6), None), ((16, 6), 0), ((17, 6), 0), ((6, 17), 1)]
+
+
+@pytest.mark.parametrize("shape,split", _RAGGED_SHAPES)
+@pytest.mark.parametrize("op", ["sum", "any", "all"])
+def test_where_mask_reduce_differential(monkeypatch, shape, split, op):
+    rng = np.random.default_rng(3)
+    mask_np = rng.integers(0, 2, shape).astype(bool)
+
+    def work():
+        a = _operand(shape, split, ht.float32, seed=4)
+        c = ht.sqrt(ht.abs(a * 1.5 + 0.25))
+        m = ht.array(mask_np, split=split)
+        if op == "sum":
+            return ht.sum(c, where=m)
+        if op == "any":
+            return ht.any(c > 1.0, where=m)
+        return ht.all(c > -1.0, where=m)
+
+    off, on = _both(monkeypatch, work)
+    if op == "sum":
+        np.testing.assert_allclose(on, off, rtol=2e-6, atol=2e-6)
+    else:
+        assert _bitwise(off, on)  # boolean tests: bit-exact by construction
+
+
+@pytest.mark.parametrize("shape,split", _RAGGED_SHAPES)
+@pytest.mark.parametrize("dtype", [ht.float32, ht.bfloat16])
+def test_flat_arg_reduce_differential(monkeypatch, shape, split, dtype):
+    def work():
+        a = _operand(shape, split, dtype, seed=5)
+        c = a * 2.0 + 0.5
+        return ht.argmin(c)
+
+    off, on = _both(monkeypatch, work)
+    assert _bitwise(off, on)  # first-index tie-break replayed exactly
+
+
+@pytest.mark.parametrize("shape,split", _RAGGED_SHAPES)
+@pytest.mark.parametrize("op", ["mean", "norm"])
+def test_moment_norm_differential(monkeypatch, shape, split, op):
+    def work():
+        a = _operand(shape, split, ht.float32, seed=6)
+        c = ht.abs(a * 1.25 + 0.125)
+        return ht.mean(c) if op == "mean" else ht.linalg.norm(c)
+
+    off, on = _both(monkeypatch, work)
+    np.testing.assert_allclose(on, off, rtol=2e-6, atol=2e-6)
+
+
+def test_nanmean_and_axis_variants(monkeypatch):
+    base = np.random.default_rng(8).standard_normal((17, 6)).astype(np.float32)
+    base[3, 2] = np.nan
+
+    def work():
+        a = ht.array(base, split=0)
+        a.parray  # noqa: B018
+        c = a * 1.0 + 0.0
+        return ht.nanmean(c)
+
+    off, on = _both(monkeypatch, work)
+    np.testing.assert_allclose(on, off, rtol=2e-6, atol=2e-6)
+
+    def work_axis():
+        a = _operand((17, 6), 0, ht.float32, seed=9)
+        return ht.mean(a * 3.0, axis=0)
+
+    off, on = _both(monkeypatch, work_axis)
+    np.testing.assert_allclose(on, off, rtol=2e-6, atol=2e-6)
+
+
+def test_argmax_nan_wins_like_eager(monkeypatch):
+    base = np.random.default_rng(10).standard_normal((17, 6)).astype(np.float32)
+    base[5, 1] = np.nan
+    base[9, 3] = np.nan
+
+    def work():
+        a = ht.array(base, split=0)
+        a.parray  # noqa: B018
+        return ht.argmax(a * 1.0)
+
+    off, on = _both(monkeypatch, work)
+    assert _bitwise(off, on)
+
+
+def test_bf16_accumulation_keeps_low_float_fallback(pallas_on):
+    """bf16 sums keep the PR 4 low-float discipline: no pallas route, counted
+    ``fusion.sink_fallbacks{low-float}``."""
+    mask_np = np.ones((17, 6), dtype=bool)
+    with monitoring.capture():
+        a = _operand((17, 6), 0, ht.bfloat16, seed=11)
+        s = ht.sum(a * 1.5, where=ht.array(mask_np, split=0))
+        s.numpy()
+    assert _count("pallas.dispatch", "ragged_reduce") == 0
+    assert _count("fusion.sink_fallbacks", "low-float") >= 1
+
+
+# ----------------------------------------------------------- acceptance
+def test_ragged_workload_takes_pallas_sink(pallas_on):
+    """ISSUE 10 acceptance: the ragged split-axis where-mask/moment workload
+    that previously took the PR 4 eager sink fallback executes through the
+    pallas sink — dispatch > 0, the fallback counter 0, and the reductions
+    SINK instead of flushing."""
+    rng = np.random.default_rng(12)
+    mask_np = rng.integers(0, 2, (17, 7)).astype(bool)
+    with monitoring.capture():
+        a = _operand((17, 7), 0, ht.float32, seed=12)
+        c = ht.sqrt(ht.abs(a * 1.5 + 0.25))
+        s = ht.sum(c, where=ht.array(mask_np, split=0))
+        m = ht.mean(ht.abs(a * 2.0 + 1.0))
+        i = ht.argmin(a * 1.0 + 0.0)
+        float(s), float(m), int(i)
+    assert _count("pallas.dispatch", "ragged_reduce") == 3
+    assert _count("fusion.sink_fallbacks") == 0
+    assert _count("fusion.flush_reason", "reduction") == 0
+    assert _count("fusion.reduction_sinks") >= 3
+
+
+def test_same_workload_counts_fallback_without_pallas(monkeypatch):
+    """The control leg: the identical workload under the hatch counts the
+    eager sink fallbacks the tier exists to shrink."""
+    monkeypatch.setenv("HEAT_TPU_PALLAS", "0")
+    rng = np.random.default_rng(12)
+    mask_np = rng.integers(0, 2, (17, 7)).astype(bool)
+    with monitoring.capture():
+        a = _operand((17, 7), 0, ht.float32, seed=12)
+        c = ht.sqrt(ht.abs(a * 1.5 + 0.25))
+        s = ht.sum(c, where=ht.array(mask_np, split=0))
+        m = ht.mean(ht.abs(a * 2.0 + 1.0))
+        float(s), float(m)
+    assert _count("pallas.dispatch", "ragged_reduce") == 0
+    assert _count("fusion.sink_fallbacks", "padded-operand") == 2
+
+
+def test_eager_fusion_off_parity(monkeypatch, pallas_on):
+    """The pallas sink result agrees with the fully-eager path (not just the
+    fused hatch path) within the documented accumulation bound."""
+    rng = np.random.default_rng(13)
+    mask_np = rng.integers(0, 2, (17, 7)).astype(bool)
+
+    def work():
+        a = _operand((17, 7), 0, ht.float32, seed=13)
+        return ht.sum(ht.abs(a * 1.5), where=ht.array(mask_np, split=0))
+
+    on = float(work())
+    monkeypatch.setenv("HEAT_TPU_FUSION", "0")
+    eager = float(work())
+    np.testing.assert_allclose(on, eager, rtol=2e-6, atol=2e-6)
+
+
+# ------------------------------------------------------- recovery ladder
+def test_pallas_flush_recovers_through_ladder(pallas_on):
+    """A pallas-bearing fused flush fault-injected at ``pallas.execute``
+    degrades through the PR 6 ladder: the recovery replay re-emits the XLA
+    reference formulation (bit-identical to the hatch path), the flush is
+    counted recovered, and only this signature is poisoned."""
+    def work():
+        a = _operand((17, 7), 0, ht.float32, seed=14)
+        return ht.mean(ht.abs(a * 2.0 + 1.0))
+
+    os.environ["HEAT_TPU_PALLAS"] = "0"
+    fusion.clear_cache()
+    hatch = float(work())
+    os.environ["HEAT_TPU_PALLAS"] = "1"
+    fusion.clear_cache()
+    with monitoring.capture():
+        with faultinject.inject("pallas.execute", RuntimeError, at_calls="*") as plan:
+            got = float(work())
+        assert plan.fired  # the fused attempt consulted the site
+    assert got == hatch  # recovery replay IS the eager logical-view compute
+    assert _count("fusion.flush_failures", "compile") == 1
+    assert _count("fusion.flush_recovered") == 1
+    assert fusion.cache_info()["poisoned"], "the failed signature is poisoned"
+    registry.reset()
+    with monitoring.capture():
+        # an UNRELATED pallas signature still compiles fused and dispatches
+        b = _operand((19, 5), 0, ht.float32, seed=15)
+        v = float(ht.mean(ht.abs(b * 2.0 + 1.0)))
+        assert np.isfinite(v)
+        assert _count("pallas.dispatch", "ragged_reduce") == 1
+        assert _count("fusion.flush_failures") == 0
+        assert _count("fusion.reduction_sinks", "moment") == 1
+
+
+def test_poisoned_signature_skips_pallas_site(pallas_on):
+    """Repeating the poisoned chain skips the fused attempt AND the
+    ``pallas.execute`` site entirely (the PR 6 frozen-call-count contract)."""
+    def work():
+        a = _operand((23, 4), 0, ht.float32, seed=16)
+        return float(ht.mean(ht.abs(a * 2.0 + 1.0)))
+
+    with faultinject.inject("pallas.execute", RuntimeError, at_calls="*") as plan:
+        first = work()
+        fired_once = list(plan.fired)
+        second = work()
+        assert first == second
+        assert list(plan.fired) == fired_once  # site never re-consulted
+
+
+# ------------------------------------------------------------- flash kernel
+def test_sdpa_gspmd_path_uses_flash(pallas_on):
+    """On the multi-device CPU mesh the jax TPU kernel is unavailable and the
+    dense path used to be the only one — the tier's flash kernel takes the
+    dispatch and matches dense."""
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (2, 32, 2, 8), jnp.float32) for kk in ks)
+    dense = scaled_dot_product_attention(q, k, v, causal=True, impl="dense")
+    with monitoring.capture():
+        got = scaled_dot_product_attention(q, k, v, causal=True)
+    assert _count("pallas.dispatch", "flash_ring") == 1
+    np.testing.assert_allclose(np.asarray(got), np.asarray(dense), rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_ring_attention_flash_differential(pallas_on, causal, dtype):
+    from heat_tpu.core.communication import MeshCommunication
+
+    comm = MeshCommunication()
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q, k, v = (jax.random.normal(kk, (2, 64, 4, 16), jnp.float32).astype(dtype) for kk in ks)
+    os.environ["HEAT_TPU_PALLAS"] = "0"
+    hatch = np.asarray(ring_attention(q, k, v, comm=comm, causal=causal), np.float32)
+    os.environ["HEAT_TPU_PALLAS"] = "1"
+    with monitoring.capture():
+        got = np.asarray(ring_attention(q, k, v, comm=comm, causal=causal), np.float32)
+    assert _count("pallas.dispatch", "flash_ring") == 1
+    tol = 2e-2 if dtype == jnp.bfloat16 else 2e-6
+    np.testing.assert_allclose(got, hatch, rtol=tol, atol=tol)
+
+
+def test_ring_attention_fault_degrades_bitwise(pallas_on):
+    from heat_tpu.core.communication import MeshCommunication
+
+    comm = MeshCommunication()
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q, k, v = (jax.random.normal(kk, (1, 64, 2, 8), jnp.float32) for kk in ks)
+    os.environ["HEAT_TPU_PALLAS"] = "0"
+    hatch = np.asarray(ring_attention(q, k, v, comm=comm, causal=True))
+    os.environ["HEAT_TPU_PALLAS"] = "1"
+    with monitoring.capture():
+        with faultinject.inject("pallas.execute", RuntimeError, at_calls="*"):
+            got = np.asarray(ring_attention(q, k, v, comm=comm, causal=True))
+    assert _bitwise(hatch, got)  # degraded build is exactly the jnp ring
+    assert _count("pallas.fallbacks", "execute") == 1
+
+
+def test_sdpa_single_tile_seq_admitted(pallas_on):
+    """Sequence lengths the jax kernel's 128-block tiling cannot divide ride
+    the tier's single-tile mode."""
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q, k, v = (jax.random.normal(kk, (1, 40, 2, 8), jnp.float32) for kk in ks)
+    dense = scaled_dot_product_attention(q, k, v, impl="dense")
+    with monitoring.capture():
+        got = scaled_dot_product_attention(q, k, v)
+    assert _count("pallas.dispatch", "flash_ring") == 1
+    np.testing.assert_allclose(np.asarray(got), np.asarray(dense), rtol=2e-5, atol=2e-6)
+
+
+# ------------------------------------------------------------------ kmeans
+@pytest.mark.parametrize("split,n", [(None, 64), (0, 64), (0, 61)])
+def test_kmeans_step_pallas_differential(pallas_on, split, n):
+    rng = np.random.default_rng(20)
+    k, f = 5, 8
+    cent = rng.normal(scale=5.0, size=(k, f)).astype(np.float32)
+    data = (cent[rng.integers(0, k, n)] + rng.normal(scale=0.4, size=(n, f))).astype(
+        np.float32
+    )
+    km = ht.cluster.KMeans(n_clusters=k)
+
+    def step():
+        x = ht.array(data, split=split)
+        x.parray  # noqa: B018
+        return km.step(x, centers=ht.array(cent))
+
+    os.environ["HEAT_TPU_PALLAS"] = "0"
+    fusion.clear_cache()
+    nc0, lab0, sh0 = step()
+    nc0, lab0, sh0 = np.asarray(nc0.numpy()), np.asarray(lab0.numpy()), float(sh0)
+    os.environ["HEAT_TPU_PALLAS"] = "1"
+    with monitoring.capture():
+        nc1, lab1, sh1 = step()
+        assert not fusion.is_deferred(lab1)  # the pallas path is concrete
+        nc1, lab1, sh1 = np.asarray(nc1.numpy()), np.asarray(lab1.numpy()), float(sh1)
+    assert _count("pallas.dispatch", "kmeans_step") == 1
+    assert _bitwise(lab0, lab1)  # same first-index argmin over a f32 tile
+    np.testing.assert_allclose(nc1, nc0, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(sh1, sh0, rtol=1e-4, atol=1e-6)
+
+
+def test_kmeans_step_hatch_restores_deferred_contract(monkeypatch):
+    monkeypatch.setenv("HEAT_TPU_PALLAS", "0")
+    rng = np.random.default_rng(21)
+    data = rng.normal(size=(40, 4)).astype(np.float32)
+    x = ht.array(data, split=0)
+    x.parray  # noqa: B018
+    km = ht.cluster.KMeans(n_clusters=3)
+    nc, lab, sh = km.step(x, centers=ht.array(rng.normal(size=(3, 4)).astype(np.float32)))
+    assert fusion.is_deferred(sh)  # the ISSUE 7 deferred step, untouched
+
+
+def test_kmeans_step_fault_degrades_to_deferred(pallas_on):
+    rng = np.random.default_rng(22)
+    data = rng.normal(size=(40, 4)).astype(np.float32)
+    cent = rng.normal(size=(3, 4)).astype(np.float32)
+    km = ht.cluster.KMeans(n_clusters=3)
+    x = ht.array(data, split=0)
+    x.parray  # noqa: B018
+    with monitoring.capture():
+        with faultinject.inject("pallas.execute", RuntimeError, at_calls="*"):
+            nc, lab, sh = km.step(x, centers=ht.array(cent))
+        assert fusion.is_deferred(sh)  # degraded to the op-surface step
+    assert _count("pallas.fallbacks", "execute") == 1
+
+
+# ---------------------------------------------------------------- telemetry
+def test_telemetry_exports_pallas_blocks(pallas_on):
+    rng = np.random.default_rng(23)
+    mask_np = rng.integers(0, 2, (17, 5)).astype(bool)
+    with monitoring.capture():
+        a = _operand((17, 5), 0, ht.float32, seed=23)
+        float(ht.sum(ht.abs(a * 1.5), where=ht.array(mask_np, split=0)))
+        b = _operand((17, 5), 0, ht.bfloat16, seed=24)
+        float(ht.sum(b * 1.5, where=ht.array(mask_np, split=0)))
+        tel = report.telemetry()
+    assert tel["pallas_dispatch"] == {"ragged_reduce": 1}
+    assert "low-float" in tel["fusion_sink_fallbacks"]
+
+
+def test_telemetry_fallback_labels(monkeypatch):
+    monkeypatch.setenv("HEAT_TPU_PALLAS", "1")
+    monkeypatch.delenv("HEAT_TPU_PALLAS_INTERPRET", raising=False)
+    with monitoring.capture():
+        plreg.available("ragged_reduce")  # platform refusal on the CPU host
+        tel = report.telemetry()
+    assert tel["pallas_fallbacks"] == {"platform": 1}
+
+
+# ------------------------------------------------------------------- slow
+@pytest.mark.slow
+def test_flash_multi_k_tile_large(pallas_on):
+    """Multi-K-tile regime (sk=256 → two 128-tiles) at a larger head dim."""
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q, k, v = (jax.random.normal(kk, (1, 256, 2, 64), jnp.float32) for kk in ks)
+    dense = scaled_dot_product_attention(q, k, v, causal=True, impl="dense")
+    got = scaled_dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(dense), rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.slow
+def test_ragged_reduce_multi_tile_tall(monkeypatch):
+    """Row extents past one 128-tile exercise the cross-tile accumulators."""
+    def work():
+        a = _operand((301, 5), 0, ht.float32, seed=30)
+        return ht.mean(ht.abs(a * 1.5 + 0.25))
+
+    off, on = _both(monkeypatch, work)
+    np.testing.assert_allclose(on, off, rtol=2e-6, atol=2e-6)
