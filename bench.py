@@ -748,12 +748,15 @@ def main():
         if os.environ.get("BENCH_FAST") != "1":
             try:
                 _add_benchmarks_path()
-                from allreduce_bandwidth_bench import bench_fused_collectives
+                from allreduce_bandwidth_bench import bench_fused_collectives, bench_two_tier
                 from kmeans_bench import kmeans_step_anchor
 
                 with _mev.span("bench.fused_collectives"):
                     coll_fusion = bench_fused_collectives()
                     coll_fusion.update(kmeans_step_anchor())
+                    # ISSUE 11: hierarchical (dcn, ici) allreduce vs the flat
+                    # single-level program over the same devices
+                    coll_fusion.update(bench_two_tier())
             except Exception as e:
                 # explicit null-valued keys, like the neighbouring benches: a
                 # crashed anchor must be distinguishable from a BENCH_FAST skip
@@ -764,6 +767,8 @@ def main():
                     "halo_fusion_speedup": None,
                     "kmeans_step_valid": None,
                     "kmeans_step_executables": None,
+                    "two_tier_valid": None,
+                    "two_tier_speedup": None,
                     "fused_collectives_error": repr(e)[:160],
                 }
         # AOT serving runtime anchors (ISSUE 8): cold_restart_compiles — a

@@ -233,6 +233,62 @@ def bench_fused_collectives(trials: int = 5, n_rows: int = 1 << 18, n_cols: int 
     return out
 
 
+def bench_two_tier(trials: int = 5, n_rows: int = 1 << 18, n_cols: int = 8):
+    """
+    ``two_tier_allreduce_gbps`` anchor (ISSUE 11): the hierarchical
+    (reduce-in-ICI, cross-DCN-once) allreduce of a
+    ``MeshCommunication.two_tier`` comm against the same-process flat
+    single-level program, paired interleaved per the 1-core container
+    methodology. On the virtual CPU mesh both tiers live on the same silicon,
+    so the ratio validates the code path and costs — the communication-
+    avoiding win (the DCN crossing carries already-reduced data, ``1/ici`` of
+    the flat crossing volume) only shows on a real DCN-attached pod, exactly
+    like the ici_gbps anchor understates on one device.
+    """
+    from heat_tpu.core.communication import MeshCommunication
+
+    devs = jax.devices()
+    p = len(devs)
+    if p < 4 or p % 2:
+        return {
+            "two_tier_valid": None,
+            "two_tier_note": "needs an even multi-device mesh to factor (dcn=2)",
+        }
+    tiered = MeshCommunication.two_tier(dcn=2, devices=devs)
+    flat = MeshCommunication(devices=devs)
+    x = np.ones((n_rows, n_cols), np.float32)
+    placed = flat.shard(x, 0)
+    nbytes = n_rows * n_cols * 4
+    eff_bytes = 2 * (p - 1) / p * nbytes  # ring-allreduce convention
+    fn_tiered = tiered._collective_fn("allreduce", 0, 2, "sum")
+    fn_flat = flat._collective_fn("allreduce", 0, 2, "sum")
+
+    def make_run(fn):
+        def run(steps):
+            t0 = time.perf_counter()
+            out = placed
+            for _ in range(steps):
+                out = fn(placed)
+            _sync(out)
+            return time.perf_counter() - t0
+
+        return run
+
+    pairs = _paired_rates(make_run(fn_tiered), make_run(fn_flat), 4, trials)
+    if len(pairs) < 3:
+        return {"two_tier_valid": False}
+    t_tiered = sorted(t for t, _ in pairs)[len(pairs) // 2]
+    t_flat = sorted(t for _, t in pairs)[len(pairs) // 2]
+    jit_pct = _spread_pct([t for t, _ in pairs])
+    return {
+        "two_tier_allreduce_gbps": round(eff_bytes / t_tiered / 1e9, 2),
+        "flat_allreduce_gbps": round(eff_bytes / t_flat / 1e9, 2),
+        "two_tier_speedup": round(t_flat / t_tiered, 2),
+        "two_tier_jitter_pct": round(jit_pct, 1),
+        "two_tier_valid": bool(jit_pct < 25.0),
+    }
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--sizes-mb", type=int, nargs="+", default=[1, 8, 64, 256])
@@ -259,6 +315,9 @@ def main():
                 # ISSUE 7: chain + recorded collective + chain as ONE program
                 # vs the same-process HEAT_TPU_FUSION_COLLECTIVES=0 barriers
                 "fused_collectives": bench_fused_collectives(trials=args.trials),
+                # ISSUE 11: hierarchical (dcn, ici) allreduce vs the flat
+                # single-level program over the same devices
+                "two_tier": bench_two_tier(trials=args.trials),
             }
         )
     )

@@ -93,7 +93,13 @@ class MeshCommunication(Communication):
     the primitive those patterns compile to.
     """
 
-    def __init__(self, devices: Optional[Sequence["jax.Device"]] = None, mesh: Optional[Mesh] = None):
+    def __init__(
+        self,
+        devices: Optional[Sequence["jax.Device"]] = None,
+        mesh: Optional[Mesh] = None,
+        *,
+        tiers: Optional[Tuple[int, int]] = None,
+    ):
         if mesh is not None and devices is not None:
             raise ValueError("pass either devices or mesh, not both")
         self.__devices = list(devices) if devices is not None else None
@@ -104,6 +110,75 @@ class MeshCommunication(Communication):
             self.__axis_name = mesh.axis_names[0]
         else:
             self.__axis_name = SPLIT_AXIS
+        if tiers is not None:
+            dcn, ici = (int(tiers[0]), int(tiers[1]))
+            if dcn < 1 or ici < 1:
+                raise ValueError(f"tier sizes must be positive, got (dcn={dcn}, ici={ici})")
+            tiers = (dcn, ici)
+        self.__tiers: Optional[Tuple[int, int]] = tiers
+        self.__tier_mesh: Optional[Mesh] = None
+
+    # ------------------------------------------------------------------ two-tier topology
+    @classmethod
+    def two_tier(
+        cls,
+        ici: Optional[int] = None,
+        dcn: Optional[int] = None,
+        devices: Optional[Sequence["jax.Device"]] = None,
+    ) -> "MeshCommunication":
+        """
+        Build a communicator whose flat ``split`` axis carries a **two-tier
+        topology annotation**: the device order is ``ici``-inner (devices
+        sharing an ICI domain — a host/slice — are adjacent) and the flat axis
+        factors as ``dcn x ici``. Ordinary ``split`` semantics are unchanged
+        (the mesh stays 1-D); collectives that have a hierarchical lowering
+        (``Allreduce``/``Bcast``) compile a two-level program over the
+        ``(dcn, ici)`` tier mesh instead — reduce within the ICI tier first,
+        cross the DCN tier exactly once with already-reduced data (the
+        communication-avoiding discipline of Demmel et al., PAPERS.md
+        CAQR/CALU, applied one level up; PAPER.md §7 ICI/DCN mapping).
+
+        Defaults infer the split from the pod wiring: ``dcn`` = the process
+        count (every ``jax.distributed`` host is one DCN endpoint, localhost
+        CPU simulation included), ``ici`` = devices-per-process. Pass explicit
+        sizes to simulate a multi-host topology on a single-process virtual
+        mesh (the CI/dev-container mode). ``HEAT_TPU_TWO_TIER=0`` restores the
+        flat single-level programs bit for bit without rebuilding the comm.
+        """
+        devs = list(devices) if devices is not None else list(jax.devices())
+        n = len(devs)
+        if dcn is None and ici is None:
+            dcn = jax.process_count()
+        if dcn is None:
+            dcn = n // int(ici) if int(ici) else 0
+        if ici is None:
+            ici = n // int(dcn) if int(dcn) else 0
+        dcn, ici = int(dcn), int(ici)
+        if dcn < 1 or ici < 1 or dcn * ici != n:
+            raise ValueError(
+                f"two-tier factorization (dcn={dcn}) x (ici={ici}) does not "
+                f"cover the {n}-device mesh"
+            )
+        return cls(devices=devs, tiers=(dcn, ici))
+
+    @property
+    def tiers(self) -> Optional[Tuple[int, int]]:
+        """``(dcn, ici)`` tier sizes of a two-tier comm, or None for a flat
+        one. Part of every collective cache key — a tiered and a flat comm
+        over the same devices never share compiled programs."""
+        return self.__tiers
+
+    @property
+    def tier_mesh(self) -> Mesh:
+        """The 2-D ``("dcn", "ici")`` view of a two-tier comm's devices
+        (ici-inner flat order), built lazily like :attr:`mesh`."""
+        if self.__tiers is None:
+            raise ValueError("tier_mesh requires a two-tier communicator (see two_tier())")
+        if self.__tier_mesh is None:
+            dcn, ici = self.__tiers
+            devs = np.asarray(self.mesh.devices).reshape(dcn, ici)
+            self.__tier_mesh = Mesh(devs, ("dcn", "ici"))
+        return self.__tier_mesh
 
     # ------------------------------------------------------------------ mesh access
     @property
@@ -112,6 +187,11 @@ class MeshCommunication(Communication):
         if self.__mesh is None:
             devs = self.__devices if self.__devices is not None else jax.devices()
             self.__mesh = Mesh(np.asarray(devs), (self.__axis_name,))
+        if self.__tiers is not None and self.__tiers[0] * self.__tiers[1] != self.__mesh.devices.size:
+            raise ValueError(
+                f"two-tier factorization {self.__tiers} does not cover the "
+                f"{self.__mesh.devices.size}-device mesh"
+            )
         return self.__mesh
 
     @property
@@ -382,10 +462,16 @@ class MeshCommunication(Communication):
         these inside fused traces, where the flush path owns the accounting
         and the ``collective.dispatch`` fault site — a recorded collective
         must fault at FLUSH, recoverably, not at record)."""
-        key = (kind, op, self.mesh, self.__axis_name, split, ndim, tuple(sorted(kw.items())))
+        # two-tier lowering applies to the reduction-shaped collectives only:
+        # ppermute/alltoall/allgather are pure data movement whose ici-inner
+        # ring order is already topology-optimal (a flat ring crosses DCN
+        # exactly dcn times — once per tier boundary — whatever the program
+        # says), and scan/cumop exchange O(1)-per-device block totals.
+        tiers = self.__tiers if (kind in _HIERARCHICAL_KINDS and two_tier_enabled()) else None
+        key = (kind, op, self.mesh, self.__axis_name, split, ndim, tiers, tuple(sorted(kw.items())))
         fn = _COLLECTIVE_CACHE.get(key)
         if fn is None:
-            fn = _build_collective(self, kind, split, ndim, op, **kw)
+            fn = _build_collective(self, kind, split, ndim, op, tiers=tiers, **kw)
             _COLLECTIVE_CACHE[key] = fn
             _COLLECTIVE_CACHE.move_to_end(key)
             while len(_COLLECTIVE_CACHE) > _COLLECTIVE_CACHE_MAX:
@@ -417,7 +503,11 @@ class MeshCommunication(Communication):
         b.record_success()
         if _MON.enabled:
             _instr.collective(kind)
-        return self._collective_fn(kind, split, ndim, op, **kw)
+        fn = self._collective_fn(kind, split, ndim, op, **kw)
+        deadline_ms = _collective_timeout_ms()
+        if deadline_ms is None:
+            return fn
+        return _watched(fn, kind, deadline_ms)
 
     def __prep(self, x, split: int):
         x = jax.numpy.asarray(x)
@@ -668,13 +758,72 @@ class MeshCommunication(Communication):
         return MeshCommunication(devices=members)
 
     def __repr__(self) -> str:
-        return f"MeshCommunication(size={self.size if self.__mesh or self.__devices else '?'})"
+        size = self.size if self.__mesh or self.__devices else "?"
+        if self.__tiers is not None:
+            return f"MeshCommunication(size={size}, tiers=(dcn={self.__tiers[0]}, ici={self.__tiers[1]}))"
+        return f"MeshCommunication(size={size})"
 
 
 import collections as _collections
+import logging as _logging
+import os as _os
+import time as _time
+
+_logger = _logging.getLogger("heat_tpu.distributed")
 
 _COLLECTIVE_CACHE: "_collections.OrderedDict" = _collections.OrderedDict()
 _COLLECTIVE_CACHE_MAX = 256
+
+#: Collective kinds with a genuine two-level (reduce-in-ICI, cross-DCN-once)
+#: lowering; everything else is data movement that a flat ici-inner device
+#: order already routes optimally (see ``_collective_fn``).
+_HIERARCHICAL_KINDS = frozenset({"allreduce", "bcast"})
+
+
+def two_tier_enabled() -> bool:
+    """Whether two-tier comms lower their hierarchical collectives two-level
+    (default). ``HEAT_TPU_TWO_TIER=0`` restores the flat single-level programs
+    — the bit-parity hatch for the reassociated f32 sum (read per dispatch,
+    the ``HEAT_TPU_FUSION`` cost class)."""
+    return _os.environ.get("HEAT_TPU_TWO_TIER", "").strip().lower() not in ("0", "false", "off")
+
+
+def _collective_timeout_ms() -> Optional[float]:
+    """The ``HEAT_TPU_COLLECTIVE_TIMEOUT_MS`` dispatch deadline (None = off,
+    the default — zero behavior change). Read per dispatch."""
+    raw = _os.environ.get("HEAT_TPU_COLLECTIVE_TIMEOUT_MS", "").strip()
+    if not raw:
+        return None
+    try:
+        ms = float(raw)
+    except ValueError:
+        return None
+    return ms if ms > 0 else None
+
+
+def _watched(fn, kind: str, deadline_ms: float):
+    """The collective-dispatch watchdog (the PR 9 dispatch-watchdog
+    semantics): block on the result, count + log an overrun as
+    ``comm.collective_timeout{kind}`` — and never interrupt the running
+    program (a mid-kernel kill would leave the mesh in an undefined
+    collective epoch; a counted overrun feeds the elastic supervisor's
+    evidence instead)."""
+
+    def watched(*args):
+        t0 = _time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        took_ms = (_time.perf_counter() - t0) * 1e3
+        if took_ms > deadline_ms:
+            if _MON.enabled:
+                _instr.collective_timeout(kind)
+            _logger.warning(
+                "collective %s exceeded dispatch deadline in flight: %.1fms > %.1fms",
+                kind, took_ms, deadline_ms,
+            )
+        return out
+
+    return watched
 
 _REDUCERS = {
     "sum": (lambda b, ax: jax.lax.psum(b, ax), jax.numpy.sum, lambda g: jax.lax.cumsum(g, axis=0)),
@@ -686,9 +835,13 @@ _REDUCERS = {
 }
 
 
-def _build_collective(comm: "MeshCommunication", kind: str, split: int, ndim: int, op: str, **kw):
+def _build_collective(
+    comm: "MeshCommunication", kind: str, split: int, ndim: int, op: str, tiers=None, **kw
+):
     """Compile one collective as a jitted shard_map program (cached per mesh/shape
-    family by the caller)."""
+    family by the caller). With ``tiers`` set the reduction-shaped kinds lower
+    two-level over the ``(dcn, ici)`` tier mesh: reduce within the ICI tier
+    first, cross the DCN tier exactly once with already-reduced chunks."""
     from jax import lax
 
     mesh = comm.mesh
@@ -698,10 +851,16 @@ def _build_collective(comm: "MeshCommunication", kind: str, split: int, ndim: in
         raise ValueError(f"unknown reduction op {op!r}; expected one of {sorted(_REDUCERS)}")
     spec_split = PartitionSpec(*([None] * split + [ax]))
     spec_repl = PartitionSpec()
+    if tiers is not None:
+        # the flat split axis re-expressed over the tier mesh: dcn-major,
+        # ici-minor — identical device-to-chunk assignment because the flat
+        # order is ici-inner by the two_tier() contract
+        mesh = comm.tier_mesh
+        spec_split = PartitionSpec(*([None] * split + [("dcn", "ici")]))
 
     if op in ("land", "lor") and kind in ("allreduce", "scan"):
         inner = "min" if op == "land" else "max"
-        inner_fn = _build_collective(comm, kind, split, ndim, inner, **kw)
+        inner_fn = _build_collective(comm, kind, split, ndim, inner, tiers=tiers, **kw)
 
         def logical(x):
             # truthiness, not a lossy integer cast: 256 and 0.5 are logically true
@@ -712,11 +871,27 @@ def _build_collective(comm: "MeshCommunication", kind: str, split: int, ndim: in
     if kind == "allreduce":
         preduce, local_reduce, _ = _REDUCERS[op]
 
-        def body(b):
-            if preduce is not None:
-                return preduce(b, ax)
-            g = lax.all_gather(b, ax, axis=0)  # (p, ...chunk)
-            return local_reduce(g, axis=0)
+        if tiers is not None:
+
+            def body(b):
+                # hierarchical: combine the ICI tier in full, then cross DCN
+                # once with the tier-reduced chunk. Reassociates the f32 sum
+                # (HEAT_TPU_TWO_TIER=0 is the bit-parity hatch); max/min/
+                # land/lor and exact dtypes are order-free.
+                if preduce is not None:
+                    return preduce(preduce(b, "ici"), "dcn")
+                g = lax.all_gather(b, "ici", axis=0)  # (ici, ...chunk)
+                r = local_reduce(g, axis=0)
+                g2 = lax.all_gather(r, "dcn", axis=0)  # (dcn, ...chunk)
+                return local_reduce(g2, axis=0)
+
+        else:
+
+            def body(b):
+                if preduce is not None:
+                    return preduce(b, ax)
+                g = lax.all_gather(b, ax, axis=0)  # (p, ...chunk)
+                return local_reduce(g, axis=0)
 
         out_spec = spec_repl
     elif kind == "allgather":
@@ -728,11 +903,24 @@ def _build_collective(comm: "MeshCommunication", kind: str, split: int, ndim: in
     elif kind == "bcast":
         root = kw["root"]
 
-        def body(b):
-            i = lax.axis_index(ax)
-            masked = jax.numpy.where(i == root, b, jax.numpy.zeros_like(b))
-            # psum promotes bool -> int; restore the input dtype
-            return lax.psum(masked, ax).astype(b.dtype)
+        if tiers is not None:
+            ici_size = tiers[1]
+
+            def body(b):
+                # one-hot in flat coordinates, then the two-level psum: the
+                # root chunk fans out over its ICI tier first and crosses DCN
+                # once (zeros elsewhere — exact whatever the dtype)
+                i = lax.axis_index("dcn") * ici_size + lax.axis_index("ici")
+                masked = jax.numpy.where(i == root, b, jax.numpy.zeros_like(b))
+                return lax.psum(lax.psum(masked, "ici"), "dcn").astype(b.dtype)
+
+        else:
+
+            def body(b):
+                i = lax.axis_index(ax)
+                masked = jax.numpy.where(i == root, b, jax.numpy.zeros_like(b))
+                # psum promotes bool -> int; restore the input dtype
+                return lax.psum(masked, ax).astype(b.dtype)
 
         out_spec = spec_split  # every device's slot now holds the root chunk
     elif kind == "scan":
@@ -956,7 +1144,40 @@ def distributed_init(
     After it returns, ``WORLD``/``get_comm()`` cover all chips in the pod and every
     ``split`` array spans hosts, with XLA routing collectives over ICI within a
     slice and DCN across slices.
+
+    Explicit wiring must be complete: passing some of ``coordinator_address``/
+    ``num_processes``/``process_id`` but not all three is rejected with a
+    ``ValueError`` *here* — handing partial wiring to
+    ``jax.distributed.initialize`` turns the mistake into an opaque
+    coordination-service hang instead of an error.
     """
+    explicit = {
+        "coordinator_address": coordinator_address,
+        "num_processes": num_processes,
+        "process_id": process_id,
+    }
+    given = {k for k, v in explicit.items() if v is not None}
+    if given and given != set(explicit):
+        missing = sorted(set(explicit) - given)
+        raise ValueError(
+            f"incomplete distributed wiring: got {sorted(given)} without "
+            f"{missing} — pass all three (or none, for Cloud TPU "
+            "metadata-server auto-detection); a partial spec would hang in "
+            "jax.distributed.initialize waiting for peers that were never told "
+            "where the coordinator is"
+        )
+    if num_processes is not None:
+        num_processes = int(num_processes)
+        process_id = int(process_id)
+        if num_processes < 1:
+            raise ValueError(f"num_processes must be >= 1, got {num_processes}")
+        if not 0 <= process_id < num_processes:
+            raise ValueError(
+                f"process_id {process_id} out of range for num_processes="
+                f"{num_processes} (valid: 0..{num_processes - 1})"
+            )
+    if local_devices is not None and int(local_devices) < 1:
+        raise ValueError(f"local_devices must be >= 1, got {local_devices}")
     if getattr(WORLD, "mesh_built", False) or getattr(SELF, "mesh_built", False):
         raise RuntimeError(
             "distributed_init() must run before any heat_tpu/JAX operation: a "

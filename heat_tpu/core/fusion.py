@@ -1774,13 +1774,22 @@ def defer_vecdot(x1: DNDarray, x2: DNDarray, axis, keepdim: bool) -> Optional[DN
 #   collective's cached shard_map program (``_collective_fn`` — the builder
 #   WITHOUT the dispatch-site fault check, which the flush path owns).
 #
-# The mesh / axis-name / split metadata is part of every node's ``op_key``
-# and therefore of the trace-LRU key. Cases the in-trace pad rules cannot
+# The mesh / axis-name / split metadata — and the comm's two-tier topology
+# annotation (``MeshCommunication.tiers``, ISSUE 11): a tiered and a flat
+# comm over the SAME devices build equal-hashing meshes but may inline
+# different collective programs — is part of every node's ``op_key`` and
+# therefore of the trace-LRU key. Cases the in-trace pad rules cannot
 # express take the counted eager fallback ``fusion.collective_fallbacks``.
 # ``HEAT_TPU_FUSION_COLLECTIVES=0`` (read per dispatch) restores the
 # flush-barrier behavior bit for bit.
 
 _COLL_FNS: dict = {}
+
+
+def _comm_topo(comm):
+    """The topology component of a collective node key: the ``(dcn, ici)``
+    tier annotation of a two-tier comm, None for a flat one."""
+    return getattr(comm, "tiers", None)
 
 
 def _collective_fallback(kind: str) -> None:
@@ -1860,7 +1869,7 @@ def record_resplit(x: DNDarray, axis) -> bool:
             comm.mesh, comm.axis_name, gshape, pshape_old, old_ax, new_ax, pshape_new
         )
         okey = (
-            "collective", "resplit", comm.mesh, comm.axis_name,
+            "collective", "resplit", comm.mesh, comm.axis_name, _comm_topo(comm),
             pshape_old, old_ax, new_ax,
         )
         aval = _eval_node(fn, okey, (inp,), (), None)
@@ -1936,7 +1945,10 @@ def defer_halo(x: DNDarray, halo_size: int):
             return _ex(v)[2]  # stacked per-shard block; prev/next are slices
 
         _COLL_FNS[key] = fn
-    okey = ("collective", "halo", comm.mesh, comm.axis_name, p, split, h, pshape, fill)
+    okey = (
+        "collective", "halo", comm.mesh, comm.axis_name, _comm_topo(comm),
+        p, split, h, pshape, fill,
+    )
     try:
         aval = _eval_node(fn, okey, (inp,), (), None)
     except Exception:
@@ -1986,7 +1998,7 @@ def defer_shift(x: DNDarray, steps: int) -> Optional[DNDarray]:
     except Exception:
         _collective_fallback("abstract-eval")
         return None
-    key = ("shift", comm.mesh, comm.axis_name, s_ax, x.ndim, shift_n, fill)
+    key = ("shift", comm.mesh, comm.axis_name, _comm_topo(comm), s_ax, x.ndim, shift_n, fill)
     fn = _COLL_FNS.get(key)
     if fn is None:
 
@@ -1996,7 +2008,10 @@ def defer_shift(x: DNDarray, steps: int) -> Optional[DNDarray]:
             return _c(v)
 
         _COLL_FNS[key] = fn
-    okey = ("collective", "ppermute", comm.mesh, comm.axis_name, s_ax, shift_n, fill)
+    okey = (
+        "collective", "ppermute", comm.mesh, comm.axis_name, _comm_topo(comm),
+        s_ax, shift_n, fill,
+    )
     try:
         aval = _eval_node(fn, okey, (inp,), (), None)
     except Exception:
@@ -2031,7 +2046,7 @@ def defer_alltoall(x: DNDarray, split_axis: int, concat_axis: int) -> Optional[D
     try:
         fn = comm._collective_fn("alltoall", concat_axis, x.ndim, sa=split_axis)
         okey = (
-            "collective", "alltoall", comm.mesh, comm.axis_name,
+            "collective", "alltoall", comm.mesh, comm.axis_name, _comm_topo(comm),
             concat_axis, split_axis, x.ndim,
         )
         aval = _eval_node(fn, okey, (inp,), (), None)

@@ -158,6 +158,22 @@ def collective(kind: str) -> None:
     REGISTRY.counter("comm.collective").inc(label=kind)
 
 
+def collective_timeout(kind: str) -> None:
+    """One collective dispatch that exceeded the
+    ``HEAT_TPU_COLLECTIVE_TIMEOUT_MS`` deadline in flight (counted + logged,
+    never interrupted — the PR 9 dispatch-watchdog semantics applied to the
+    distributed layer; evidence for the elastic supervisor)."""
+    REGISTRY.counter("comm.collective_timeout").inc(label=kind)
+
+
+def elastic_transition(state: str) -> None:
+    """One elastic-supervisor state transition or detection event
+    (``robustness.elastic{state}`` — healthy/degraded/draining/saving/saved/
+    restart-pending, plus peer-lost/heartbeat-*/probe-* evidence labels; see
+    :mod:`heat_tpu.robustness.elastic`)."""
+    REGISTRY.counter("robustness.elastic").inc(label=state)
+
+
 def fusion_defer(kind: str) -> None:
     """One op recorded in the deferred-execution DAG instead of dispatched
     eagerly (kind: binary/local/where/cast/view/gemm/collective)."""

@@ -141,6 +141,11 @@ def telemetry() -> dict:
         # watchdog deadline misses, janitor evictions/quarantines, breaker
         # state transitions, and chaos-schedule fires — the counters that
         # prove the degraded paths (not luck) carried an adverse-load run
+        # elastic multi-host runtime breakdowns (ISSUE 11): supervisor state
+        # transitions + peer-loss evidence, and collective dispatches that
+        # overran the watchdog deadline in flight
+        ("robustness.elastic", "robustness_elastic"),
+        ("comm.collective_timeout", "comm_collective_timeout"),
         ("serving.shed", "serving_shed"),
         ("serving.deadline_miss", "serving_deadline_miss"),
         ("serving.janitor", "serving_janitor"),

@@ -104,6 +104,7 @@ class DataParallel:
         self.step_count = 0
         self._train_step = None
         self._loss_fn = None
+        self._elastic = None
 
     # ------------------------------------------------------------------ mesh helpers
     @property
@@ -199,11 +200,26 @@ class DataParallel:
         self._train_step = step
         return step
 
+    def attach_elastic(self, supervisor) -> None:
+        """Attach an :class:`~heat_tpu.robustness.elastic.ElasticSupervisor`:
+        every :meth:`train_step` then heartbeats + probes peers BEFORE
+        dispatching (a collective against a dead peer would hang — the poll
+        must precede the doomed dispatch), and a detected peer loss drains,
+        checkpoints the last step-boundary state, and raises
+        :class:`~heat_tpu.robustness.elastic.PeerLostError` for the worker's
+        main to exit ``ELASTIC_RESTART_EXIT``."""
+        self._elastic = supervisor
+
     def train_step(self, *batch) -> jax.Array:
         """Run one jitted update on the stored (params, opt_state); returns the
         loss."""
         if self._train_step is None:
             raise RuntimeError("call make_train_step(loss_fn, optimizer) first")
+        # elastic contract: poll at the step boundary, before any dispatch —
+        # the state saved on peer loss is the previous boundary's consistent
+        # snapshot, and the collective that would hang never launches
+        if self._elastic is not None:
+            self._elastic.check(self.checkpoint_state, self.step_count)
         batch = self.shard_batch(*batch)
         if not isinstance(batch, tuple):
             batch = (batch,)

@@ -162,15 +162,24 @@ class DASO:
         self._local_step = None
         self._global_mean = None
         self._blend = None
+        self._elastic = None
 
-        # hierarchical mesh: factor the world into (nodes, local)
+        # hierarchical mesh: factor the world into (nodes, local). A two-tier
+        # comm (ISSUE 11) pins the factorization to the physical topology —
+        # node groups = DCN endpoints, local = the ICI tier — so DASO's
+        # local-sync runs on ICI every batch and the async bf16 global sync is
+        # the only traffic that crosses DCN (once per global_skip batches).
         size = self.comm.size
         if nodes is None:
-            nodes = 1
-            for cand in range(int(np.sqrt(size)), 0, -1):
-                if size % cand == 0:
-                    nodes = cand
-                    break
+            tiers = getattr(self.comm, "tiers", None)
+            if tiers is not None:
+                nodes = tiers[0]
+            else:
+                nodes = 1
+                for cand in range(int(np.sqrt(size)), 0, -1):
+                    if size % cand == 0:
+                        nodes = cand
+                        break
         if size % nodes != 0:
             raise ValueError(f"device count {size} not divisible into {nodes} node groups")
         self.nodes = nodes
@@ -297,6 +306,10 @@ class DASO:
         """
         if self._local_step is None:
             raise RuntimeError("call make_train_step(loss_fn, apply_fn) first")
+        # elastic contract (mirrors the preemption poll below, but BEFORE any
+        # dispatch: a hierarchical sync against a dead peer would hang)
+        if self._elastic is not None:
+            self._elastic.check(self.checkpoint_state, self.step_count)
         x, y = self.shard_batch(x, y)
         if _MON.enabled:
             import time as _time
@@ -349,6 +362,14 @@ class DASO:
         if _preempt.should_checkpoint():
             _preempt.checkpoint_now(self.checkpoint_state(), step=self.step_count)
         return loss
+
+    def attach_elastic(self, supervisor) -> None:
+        """Attach an :class:`~heat_tpu.robustness.elastic.ElasticSupervisor`:
+        :meth:`step` then heartbeats + probes per batch before dispatching,
+        and a detected peer loss drains, checkpoints, and raises
+        :class:`~heat_tpu.robustness.elastic.PeerLostError` (a pending async
+        global sync is dropped by the same contract as preemption)."""
+        self._elastic = supervisor
 
     def checkpoint_state(self) -> dict:
         """The pytree a preemption checkpoint persists: per-node stacked

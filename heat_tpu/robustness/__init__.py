@@ -26,6 +26,11 @@ memory arbitrarily far from the op that recorded them:
 - :mod:`~heat_tpu.robustness.chaos` — seeded multi-site chaos schedules
   (``HEAT_TPU_CHAOS="seed:rate[:sites]"``), derandomized at install into
   exact per-call fault plans on the :mod:`faultinject` machinery.
+- :mod:`~heat_tpu.robustness.elastic` — peer-failure detection (heartbeat
+  files + deterministic consecutive-miss verdicts on the
+  ``distributed.heartbeat``/``distributed.peer`` fault sites) and the
+  drain → checkpoint → restart-shrunk choreography: a ``kill -9``'d worker
+  costs the run a checkpoint generation and one mesh size, not the job.
 
 The fused-flush recovery *ladder* itself lives in ``core/fusion.py`` (it needs
 the retained expression DAG); its failure/recovery/poisoning counters are
@@ -34,10 +39,12 @@ documented there and in ``doc/robustness_notes.md``.
 
 from . import breaker
 from . import chaos
+from . import elastic
 from . import faultinject
 from . import preemption
 from . import retry
 from .breaker import CircuitBreaker
+from .elastic import ElasticSupervisor, PeerLostError
 from .faultinject import FaultPlan, inject
 from .preemption import PreemptionGuard
 from .retry import RetryPolicy
@@ -45,12 +52,15 @@ from .retry import RetryPolicy
 __all__ = [
     "breaker",
     "chaos",
+    "elastic",
     "faultinject",
     "preemption",
     "retry",
     "CircuitBreaker",
+    "ElasticSupervisor",
     "FaultPlan",
     "inject",
+    "PeerLostError",
     "PreemptionGuard",
     "RetryPolicy",
 ]

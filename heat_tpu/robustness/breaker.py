@@ -46,6 +46,17 @@ Degraded paths per site (the callers own them — the breaker only answers
                           .RetryPolicy` collapses to a single attempt (no
                           backoff schedule) so a persistently failing disk
                           fails loudly in bounded time
+``distributed.heartbeat`` the elastic supervisor stops attempting heartbeat
+                          writes (counted ``robustness.elastic
+                          {heartbeat-skipped}``) — a disk that keeps failing
+                          cannot prove liveness anyway, and doomed writes
+                          would tax every training step
+``distributed.peer``      peer probes return the last known liveness without
+                          reading (counted ``robustness.elastic
+                          {probe-skipped}``) and do NOT advance miss counts:
+                          no evidence, no verdict — with the probe breaker
+                          open nobody is ever declared lost (fail-safe, the
+                          property the forced-open CI leg pins)
 ========================  ====================================================
 
 Every state transition is counted ``robustness.breaker{site:state}`` and
@@ -89,6 +100,8 @@ BREAKER_SITES = (
     "collective.dispatch",
     "io.write",
     "io.read",
+    "distributed.heartbeat",
+    "distributed.peer",
 )
 
 _DEFAULT_THRESHOLD = 5

@@ -93,6 +93,13 @@ _EXC_FOR = {
     "io.write": OSError,
     "io.read": OSError,
     "checkpoint.write": OSError,
+    # elastic supervisor sites: heartbeat/probe IO is file-system shaped and
+    # the supervisor absorbs OSError at the call site (opt-in like
+    # collective.dispatch — name them explicitly to chaos-test the
+    # peer-failure detector; a probe fault is inconclusive by contract, so a
+    # chaos schedule can never fabricate a peer loss)
+    "distributed.heartbeat": OSError,
+    "distributed.peer": OSError,
 }
 
 

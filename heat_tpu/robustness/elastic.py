@@ -1,0 +1,330 @@
+"""
+Elastic multi-host supervision: peer-failure detection and the
+checkpoint-restore-onto-a-shrunk-mesh choreography.
+
+Everything below the trainers assumes the pod it joined stays whole;
+production multi-host runs lose members — a preempted host, a failed DCN
+link, an OOM-killed worker. The survey's MPI-world answer is the job dying
+with the rank; the elastic answer (ROADMAP item 3) is that a host loss
+degrades through the PR 6 checkpoint ladder to a *restore on a shrunk mesh*:
+
+1. **Detect.** Every process runs an :class:`ElasticSupervisor`: per training
+   step it writes a monotone heartbeat (a file in a shared directory — the
+   localhost-simulation stand-in for coordinator liveness probes) and reads
+   its peers'. A peer whose heartbeat has not advanced for
+   ``miss_threshold`` *consecutive probes* is declared lost. Detection is
+   deterministic by **call count only** (probe calls, never wall time), the
+   ``faultinject`` discipline: the same schedule of beats and probes always
+   produces the same verdict on every machine.
+2. **Drain + save.** On detected loss the survivors drain pending fused
+   flushes (``fusion.flush_pending`` — a half-recorded expression DAG must
+   not be captured mid-chain), then save through the preemption-safe
+   :class:`~heat_tpu.utils.checkpoint.CheckpointManager` path (atomic,
+   CRC-validated, retried).
+3. **Restart shrunk.** The worker exits with :data:`ELASTIC_RESTART_EXIT`;
+   the launcher respawns the survivors as an (N-1)-process world, and
+   ``CheckpointManager.restore_latest_valid`` re-lays every ``split`` array
+   out on the smaller mesh — the padded physical layout is re-canonicalized
+   for the new device count by the ``ht.array`` restore path, so a ragged
+   axis saved over 8 devices restores bit-for-bit onto 4 or 1.
+
+Failure handling is itself supervised: heartbeat writes consult the
+``distributed.heartbeat`` fault site and probe reads consult
+``distributed.peer`` (chaos-schedulable, opt-in), each behind a circuit
+breaker (``robustness/breaker.py``). A failed heartbeat write is absorbed —
+training never dies because liveness IO failed; a failed probe is
+**inconclusive** — it neither advances nor resets a peer's miss count, so a
+flaky shared disk (or a chaos schedule) can never fabricate a peer loss.
+With the probe breaker open nobody is ever declared lost (fail-safe — the
+``HEAT_TPU_BREAKER_FORCE_OPEN`` CI leg pins exactly this).
+
+Every state transition and evidence event is counted
+``robustness.elastic{...}`` and exported by ``report.telemetry()``:
+``healthy``/``degraded``/``draining``/``saving``/``saved``/
+``restart-pending`` transitions plus ``peer-lost``, ``heartbeat-failed``/
+``heartbeat-skipped`` and ``probe-failed``/``probe-skipped`` evidence.
+
+The trainers poll the supervisor per step like they poll the preemption
+guard: ``DataParallel.attach_elastic(sup)`` / ``DASO.attach_elastic(sup)``
+make ``train_step``/``step`` call :meth:`ElasticSupervisor.check` at the
+step boundary, which raises :class:`PeerLostError` (checkpoint already on
+disk) for the worker's main to catch and exit :data:`ELASTIC_RESTART_EXIT`.
+
+Env knobs: ``HEAT_TPU_ELASTIC_MISS_THRESHOLD`` overrides the consecutive-
+miss verdict count (default 3; ctor wins over env, the scheduler-knob
+precedent).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any, Dict, FrozenSet, Optional
+
+from ..monitoring import instrument as _instr
+from ..monitoring.registry import STATE as _MON
+from . import breaker as _BRK
+from . import faultinject as _FI
+
+__all__ = [
+    "ELASTIC_RESTART_EXIT",
+    "ElasticSupervisor",
+    "PeerLostError",
+    "survivors",
+]
+
+#: Exit code a worker uses after a drained-and-saved peer-loss shutdown
+#: (EX_TEMPFAIL: "try again" — the launcher's respawn-shrunk signal, distinct
+#: from success, crash, and the kill signal itself).
+ELASTIC_RESTART_EXIT = 75
+
+_DEFAULT_MISS_THRESHOLD = 3
+
+#: supervisor states, in the order the happy degradation path visits them
+STATES = ("healthy", "degraded", "draining", "saving", "saved", "restart-pending")
+
+
+def _miss_threshold_default() -> int:
+    try:
+        return max(1, int(os.environ.get("HEAT_TPU_ELASTIC_MISS_THRESHOLD", "")
+                          or _DEFAULT_MISS_THRESHOLD))
+    except ValueError:
+        return _DEFAULT_MISS_THRESHOLD
+
+
+class PeerLostError(RuntimeError):
+    """A peer was declared lost and this process has already drained and
+    saved: the worker's main should exit :data:`ELASTIC_RESTART_EXIT` so the
+    launcher respawns the survivors as a shrunk world.
+
+    Attributes carry the restart contract: ``lost`` (the dead process ids),
+    ``survivors`` (count, = the shrunk world size), ``saved_path`` /
+    ``saved_step`` (the checkpoint the shrunk run resumes from — None when
+    the supervisor has no manager attached)."""
+
+    def __init__(self, lost, survivors: int, saved_path: Optional[str], saved_step: Optional[int]):
+        self.lost = frozenset(lost)
+        self.survivors = int(survivors)
+        self.saved_path = saved_path
+        self.saved_step = saved_step
+        super().__init__(
+            f"peers {sorted(self.lost)} lost; drained and saved "
+            f"{'step ' + str(saved_step) if saved_path else 'nothing (no manager)'} — "
+            f"restart shrunk with {survivors} process(es)"
+        )
+
+
+def survivors(directory: str, num_processes: int, miss_threshold: Optional[int] = None) -> list:
+    """Launcher-side view: the process ids whose heartbeat files exist in
+    ``directory`` (the ids a shrunk relaunch should respawn). The launcher
+    normally knows the dead worker from its exit status; this helper covers
+    crash-only launchers that can only read the shared directory."""
+    out = []
+    for pid in range(int(num_processes)):
+        if os.path.exists(os.path.join(directory, f"hb_{pid}.beat")):
+            out.append(pid)
+    return out
+
+
+class ElasticSupervisor:
+    """Peer-failure detector + drain/save choreographer for one process (see
+    the module docstring for the protocol).
+
+    Parameters
+    ----------
+    directory : str
+        Shared heartbeat directory (all processes of the run must see the
+        same files — a shared filesystem, or localhost).
+    process_id, num_processes : int, optional
+        This process's slot and the world size; default to
+        ``jax.process_index()`` / ``jax.process_count()``.
+    miss_threshold : int, optional
+        Consecutive conclusive probes without heartbeat advance before a peer
+        is declared lost (default ``HEAT_TPU_ELASTIC_MISS_THRESHOLD`` or 3).
+        Counted in *probe calls* — deterministic, never wall time.
+    manager : CheckpointManager, optional
+        Where :meth:`drain_and_save` routes the peer-loss checkpoint. Without
+        one the supervisor still detects (and :meth:`check` still raises) but
+        saves nothing.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        process_id: Optional[int] = None,
+        num_processes: Optional[int] = None,
+        miss_threshold: Optional[int] = None,
+        manager=None,
+    ):
+        import jax
+
+        self.directory = str(directory)
+        self.process_id = int(jax.process_index() if process_id is None else process_id)
+        self.num_processes = int(jax.process_count() if num_processes is None else num_processes)
+        if not 0 <= self.process_id < self.num_processes:
+            raise ValueError(
+                f"process_id {self.process_id} out of range for "
+                f"num_processes={self.num_processes}"
+            )
+        self.miss_threshold = int(miss_threshold) if miss_threshold is not None else _miss_threshold_default()
+        if self.miss_threshold < 1:
+            raise ValueError(f"miss_threshold must be >= 1, got {self.miss_threshold}")
+        self.manager = manager
+        os.makedirs(self.directory, exist_ok=True)
+        self._state = "healthy"
+        self._beats = 0
+        self._last_seen: Dict[int, int] = {}
+        self._misses: Dict[int, int] = {p: 0 for p in self._peers()}
+        self._lost: set = set()
+        self.saved_path: Optional[str] = None
+        self.saved_step: Optional[int] = None
+
+    # ------------------------------------------------------------------ state machine
+    @property
+    def state(self) -> str:
+        """Current supervisor state (one of :data:`STATES`)."""
+        return self._state
+
+    def _to(self, state: str) -> None:
+        if state != self._state:
+            self._state = state
+            if _MON.enabled:
+                _instr.elastic_transition(state)
+
+    def _evidence(self, kind: str) -> None:
+        if _MON.enabled:
+            _instr.elastic_transition(kind)
+
+    def _peers(self):
+        return [p for p in range(self.num_processes) if p != self.process_id]
+
+    def _hb_path(self, pid: int) -> str:
+        return os.path.join(self.directory, f"hb_{pid}.beat")
+
+    # ------------------------------------------------------------------ heartbeat
+    def beat(self) -> bool:
+        """Write this process's monotone heartbeat. Returns whether a beat
+        landed on disk. Failures are absorbed (counted ``heartbeat-failed``,
+        fed to the ``distributed.heartbeat`` breaker); with the breaker open
+        the write is skipped outright (``heartbeat-skipped``) — a disk that
+        keeps failing cannot prove liveness, and doomed writes would tax
+        every step."""
+        b = _BRK.breaker("distributed.heartbeat")
+        if not b.allow():
+            self._evidence("heartbeat-skipped")
+            return False
+        self._beats += 1
+        try:
+            _FI.check("distributed.heartbeat")
+            fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".beat.tmp")
+            try:
+                with os.fdopen(fd, "w") as f:
+                    f.write(str(self._beats))
+                os.replace(tmp, self._hb_path(self.process_id))
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+                raise
+        except (KeyboardInterrupt, SystemExit, _FI.FaultPlanError):
+            raise
+        except BaseException:
+            b.record_failure()
+            self._evidence("heartbeat-failed")
+            return False
+        b.record_success()
+        return True
+
+    # ------------------------------------------------------------------ probing
+    def _read_peer(self, pid: int) -> Optional[int]:
+        with open(self._hb_path(pid), "r") as f:
+            raw = f.read().strip()
+        return int(raw) if raw else None
+
+    def probe(self) -> FrozenSet[int]:
+        """One liveness probe of every peer; returns the currently-lost set.
+
+        Per peer, one conclusive read either resets its miss count (heartbeat
+        advanced) or increments it (absent file, unreadable/empty content, or
+        no advance — a killed worker's file stays frozen at its last beat); at
+        ``miss_threshold`` consecutive misses the peer is declared lost
+        (``peer-lost``, state → ``degraded``). An ``OSError``/injected fault
+        is INCONCLUSIVE: counted ``probe-failed``, breaker-fed, miss count
+        untouched. With the ``distributed.peer`` breaker open the whole read
+        is skipped (``probe-skipped``) and the last known verdict stands."""
+        for pid in self._peers():
+            if pid in self._lost:
+                continue  # a verdict is final for this incarnation
+            b = _BRK.breaker("distributed.peer")
+            if not b.allow():
+                self._evidence("probe-skipped")
+                continue
+            try:
+                _FI.check("distributed.peer")
+                try:
+                    value = self._read_peer(pid)
+                except FileNotFoundError:
+                    value = None  # absence IS conclusive: no beat on disk
+                except ValueError:
+                    value = None  # torn/empty content: no provable advance
+            except (KeyboardInterrupt, SystemExit, _FI.FaultPlanError):
+                raise
+            except BaseException:
+                b.record_failure()
+                self._evidence("probe-failed")
+                continue  # inconclusive: no evidence, no verdict
+            b.record_success()
+            if value is not None and value > self._last_seen.get(pid, -1):
+                self._last_seen[pid] = value
+                self._misses[pid] = 0
+            else:
+                self._misses[pid] = self._misses.get(pid, 0) + 1
+                if self._misses[pid] >= self.miss_threshold:
+                    self._lost.add(pid)
+                    self._evidence("peer-lost")
+                    if self._state == "healthy":
+                        self._to("degraded")
+        return frozenset(self._lost)
+
+    def lost_peers(self) -> FrozenSet[int]:
+        """Peers declared lost so far (a verdict is final)."""
+        return frozenset(self._lost)
+
+    def shrunk_world_size(self) -> int:
+        """World size after dropping the lost peers (what the relaunch
+        respawns)."""
+        return self.num_processes - len(self._lost)
+
+    # ------------------------------------------------------------------ drain + save
+    def drain_and_save(self, state: Any, step: int) -> Optional[str]:
+        """The survivor's shutdown half: drain pending fused flushes, then
+        save ``state`` as ``step`` through the attached manager (the PR 6
+        atomic/CRC/retried path). States ``draining`` → ``saving`` → ``saved``
+        are walked (and counted) even without a manager — the drain matters
+        on its own: a pending expression DAG must not be abandoned
+        half-recorded. Returns the checkpoint path (None without a manager)."""
+        self._to("draining")
+        from ..core import fusion as _fusion
+
+        _fusion.flush_pending("export")
+        self._to("saving")
+        path = None
+        if self.manager is not None:
+            path = self.manager.save(int(step), state)
+        self.saved_path = path
+        self.saved_step = int(step)
+        self._to("saved")
+        return path
+
+    # ------------------------------------------------------------------ trainer hook
+    def check(self, state: Any, step: int) -> None:
+        """The per-step trainer poll: beat, probe, and on any lost peer
+        drain + save + raise :class:`PeerLostError` (state →
+        ``restart-pending``). ``state`` may be the checkpoint pytree or a
+        zero-arg callable producing it (evaluated only on loss)."""
+        self.beat()
+        if not self.probe():
+            return
+        payload = state() if callable(state) else state
+        path = self.drain_and_save(payload, step)
+        self._to("restart-pending")
+        raise PeerLostError(self._lost, self.shrunk_world_size(), path, self.saved_step)

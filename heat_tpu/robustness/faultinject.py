@@ -36,6 +36,12 @@ Sites (the catalog is shared with ``doc/robustness_notes.md``):
                           ``pallas.fallbacks{execute}``; a pallas-bearing
                           fused flush consults it per ladder attempt and
                           recovers through the ladder's XLA replay
+``distributed.heartbeat`` one elastic-supervisor heartbeat write
+                          (``robustness/elastic.py`` — absorbed and counted;
+                          training never dies because liveness IO failed)
+``distributed.peer``      one elastic-supervisor peer-liveness read — a
+                          planned fault makes that probe *inconclusive*
+                          (no miss-count advance) rather than a verdict
 ========================  =====================================================
 
 Plans are installed programmatically::
@@ -104,6 +110,14 @@ SITES = (
     # direct-site degradation swaps the kernel for its XLA formulation, which
     # is correct but only boundedly (not bitwise) identical
     "pallas.execute",
+    # elastic supervisor sites (robustness/elastic.py): one heartbeat write /
+    # one peer-liveness read. Both absorbed at the call site (a failed
+    # heartbeat must never kill training; a failed probe is INCONCLUSIVE
+    # evidence — it neither advances nor resets a peer's miss count), counted
+    # robustness.elastic{heartbeat-failed,probe-failed} and fed to their
+    # circuit breakers. Chaos-schedulable but opt-in like collective.dispatch.
+    "distributed.heartbeat",
+    "distributed.peer",
 )
 
 ENV_VAR = "HEAT_TPU_FAULT_PLAN"

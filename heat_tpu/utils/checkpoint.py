@@ -82,6 +82,28 @@ def _crc(data: np.ndarray) -> int:
     return zlib.crc32(np.ascontiguousarray(data).tobytes())
 
 
+def _encode_hdf5(data: np.ndarray):
+    """HDF5-storable twin of an array leaf. ml_dtypes types (bfloat16,
+    float8s) have ``dtype.kind == 'V'``: h5py stores them as opaque bytes that
+    nothing can cast back — store a bit-preserving unsigned view instead and
+    record the true dtype name in the manifest. Returns ``(stored, vdtype)``
+    with ``vdtype`` None for natively storable dtypes."""
+    if data.dtype.kind != "V":
+        return data, None
+    carrier = np.dtype(f"u{data.dtype.itemsize}")
+    return np.ascontiguousarray(data).view(carrier), data.dtype.name
+
+
+def _decode_hdf5(raw: np.ndarray, vdtype: Optional[str]) -> np.ndarray:
+    """Invert :func:`_encode_hdf5`: re-view the stored unsigned carrier as the
+    recorded ml_dtypes type (bit-preserving — never a value cast)."""
+    if vdtype is None:
+        return raw
+    import ml_dtypes
+
+    return np.asarray(raw).view(np.dtype(getattr(ml_dtypes, vdtype)))
+
+
 def _flatten(state: Any):
     """Flatten a pytree to (path, leaf) pairs with '/'-joined string paths."""
     leaves_with_paths = jax.tree_util.tree_flatten_with_path(
@@ -115,6 +137,7 @@ def save_checkpoint(path: str, state: Any, include_rng: bool = True) -> None:
     def attempt():
         _FI.check("checkpoint.write")
         entries = {}
+        world_size = None  # save-time device count of the first split leaf
         tmp_fd, tmp_path = tempfile.mkstemp(
             dir=os.path.dirname(os.path.abspath(path)) or ".", suffix=".ckpt.tmp"
         )
@@ -128,7 +151,7 @@ def save_checkpoint(path: str, state: Any, include_rng: bool = True) -> None:
                             "(a dict key containing '/' shadows a nested path)"
                         )
                     if isinstance(leaf, DNDarray):
-                        data = leaf.numpy()
+                        data, vdtype = _encode_hdf5(leaf.numpy())
                         f.create_dataset(name, data=data)
                         entries[name] = {
                             "kind": _KIND_DND,
@@ -136,10 +159,16 @@ def save_checkpoint(path: str, state: Any, include_rng: bool = True) -> None:
                             "dtype": leaf.dtype.char(),
                             "crc32": _crc(data),
                         }
+                        if vdtype is not None:
+                            entries[name]["vdtype"] = vdtype
+                        if world_size is None:
+                            world_size = getattr(leaf.comm, "size", None)
                     elif isinstance(leaf, (jax.Array, np.ndarray)):
-                        data = np.asarray(leaf)
+                        data, vdtype = _encode_hdf5(np.asarray(leaf))
                         f.create_dataset(name, data=data)
                         entries[name] = {"kind": _KIND_ARR, "crc32": _crc(data)}
+                        if vdtype is not None:
+                            entries[name]["vdtype"] = vdtype
                     elif isinstance(leaf, (bool, int, float, str)) or leaf is None:
                         entries[name] = {"kind": _KIND_JSON, "value": leaf}
                     else:
@@ -149,6 +178,11 @@ def save_checkpoint(path: str, state: Any, include_rng: bool = True) -> None:
                 meta = {
                     "entries": entries,
                     "rng_state": list(ht_random.get_state()) if include_rng else None,
+                    # the elastic-restart contract rides this: a restore onto
+                    # a communicator of a DIFFERENT size is legitimate (shrunk
+                    # mesh) and counted, never rejected — split leaves are
+                    # stored logically and re-laid-out at restore
+                    "world_size": world_size,
                 }
                 f.attrs["heat_tpu_checkpoint"] = json.dumps(meta)
             os.replace(tmp_path, path)
@@ -231,6 +265,16 @@ def load_checkpoint(
     with h5py.File(path, "r") as f:
         meta = _read_meta(f)
         entries = meta["entries"]
+        saved_world = meta.get("world_size")
+        if (
+            _MON.enabled
+            and saved_world is not None
+            and getattr(comm, "size", None) not in (None, saved_world)
+        ):
+            # elastic restore onto a shrunk (or grown) mesh: split leaves are
+            # re-laid-out below — the padded physical layout is re-
+            # canonicalized for the new device count by the ht.array path
+            _instr.checkpoint_op("mesh-resized")
         flat_target = _flatten(target)
         restored = []
         for name, leaf in flat_target:
@@ -240,7 +284,9 @@ def load_checkpoint(
             if ent["kind"] == _KIND_JSON:
                 restored.append(ent["value"])
             elif ent["kind"] == _KIND_DND:
-                data = check(name, ent, np.asarray(f[name]))
+                data = _decode_hdf5(
+                    check(name, ent, np.asarray(f[name])), ent.get("vdtype")
+                )
                 restored.append(
                     ht_array(
                         data,
@@ -251,7 +297,9 @@ def load_checkpoint(
                     )
                 )
             else:
-                raw = check(name, ent, np.asarray(f[name]))
+                raw = _decode_hdf5(
+                    check(name, ent, np.asarray(f[name])), ent.get("vdtype")
+                )
                 if isinstance(leaf, np.ndarray):
                     # exact round-trip for host arrays, including 64-bit dtypes
                     restored.append(raw)

@@ -137,3 +137,92 @@ def test_manager_step_ordering_and_restore_specific(tmp_path):
     assert len(mgr.all_steps()) == 3
     got = mgr.restore({"v": 0.0}, step=sorted(mgr.all_steps())[0])
     assert got["v"] == float(sorted(mgr.all_steps())[0])
+
+
+# ---------------------------------------------------------------- shrunk-mesh restore
+# ISSUE 11: the elastic-restart contract — a checkpoint saved on an N-device
+# world must restore onto a communicator with a DIFFERENT device count, with
+# every split array re-laid-out (ragged pad re-canonicalized) on the smaller
+# mesh, bit-for-bit against a single-device reference.
+import jax as _jax
+
+from heat_tpu.core.communication import MeshCommunication as _MC
+
+
+def _subcomm(p):
+    devs = _jax.devices()
+    if len(devs) < p:
+        pytest.skip(f"needs {p} devices")
+    return _MC(devices=devs[:p])
+
+
+@pytest.mark.parametrize("split", [0, 1])
+@pytest.mark.parametrize("n", [16, 13])  # even / ragged over every mesh size used
+@pytest.mark.parametrize("dtype", [ht.float32, ht.bfloat16])
+def test_restore_latest_valid_onto_shrunk_mesh(tmp_path, split, n, dtype):
+    big = _subcomm(8)
+    shape = (n, 3) if split == 0 else (3, n)
+    ref = np.arange(int(np.prod(shape)), dtype=np.float32).reshape(shape)
+    x = ht.array(ref, dtype=dtype, split=split, comm=big)
+    mgr = CheckpointManager(str(tmp_path))
+    mgr.save(4, {"x": x, "step": 4})
+    # the single-device reference restore pins the expected bytes
+    one = _subcomm(1)
+    single = mgr.restore_latest_valid(
+        {"x": ht.zeros(shape, dtype=dtype, split=split, comm=one), "step": 0}, comm=one
+    )
+    ref_np = single["x"].numpy()
+    assert ref_np.tobytes() == x.numpy().tobytes()
+    for p in (4, 1):
+        small = _subcomm(p)
+        back = mgr.restore_latest_valid(
+            {"x": ht.zeros(shape, dtype=dtype, split=split, comm=small), "step": 0},
+            comm=small,
+        )
+        y = back["x"]
+        assert y.comm is small and y.split == split and tuple(y.shape) == shape
+        # logical bytes: bit-for-bit against the single-device reference
+        assert y.numpy().tobytes() == ref_np.tobytes()
+        # physical layout: the canonical padded placement for the NEW mesh —
+        # split axis padded to ceil(n/p)*p, pad slab zero-filled
+        pshape = small.padded_shape(shape, split)
+        assert tuple(y.parray.shape) == pshape
+        if pshape != shape:
+            phys = np.asarray(y.parray)
+            pad = np.take(phys, range(n, pshape[split]), axis=split)
+            assert not pad.any(), "pad slab must be re-canonicalized to zeros"
+
+
+def test_restore_counts_mesh_resize(tmp_path):
+    from heat_tpu import monitoring as _mon
+    from heat_tpu.monitoring import report as _report
+
+    big = _subcomm(8)
+    small = _subcomm(2)
+    p = str(tmp_path / "ck.h5")
+    save_checkpoint(p, {"x": ht.arange(8, split=0, dtype=ht.float32, comm=big)})
+    with _mon.capture():
+        load_checkpoint(
+            p, {"x": ht.zeros(8, split=0, dtype=ht.float32, comm=small)}, comm=small
+        )
+        ops = _report.telemetry()["checkpoint_ops"]
+        assert ops.get("mesh-resized") == 1
+        # same-size restore: not counted
+        load_checkpoint(
+            p, {"x": ht.zeros(8, split=0, dtype=ht.float32, comm=big)}, comm=big
+        )
+        assert _report.telemetry()["checkpoint_ops"].get("mesh-resized") == 1
+
+
+def test_bfloat16_leaves_roundtrip_bitwise(tmp_path):
+    # regression (ISSUE 11 satellite): h5py stores ml_dtypes arrays as opaque
+    # V-kind bytes nothing can cast back — the manifest now records the true
+    # dtype and the bytes ride a bit-preserving unsigned view
+    p = str(tmp_path / "ck.h5")
+    w = jnp.arange(7, dtype=jnp.bfloat16) / 3
+    n = np.asarray(w)  # numpy bfloat16 leaf
+    save_checkpoint(p, {"w": w, "n": n})
+    out = load_checkpoint(p, {"w": jnp.zeros(7, jnp.bfloat16), "n": np.zeros(7, n.dtype)})
+    assert out["w"].dtype == jnp.bfloat16 and out["n"].dtype == n.dtype
+    assert np.asarray(out["w"]).tobytes() == np.asarray(w).tobytes()
+    assert out["n"].tobytes() == n.tobytes()

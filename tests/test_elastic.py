@@ -1,0 +1,683 @@
+"""
+Elastic multi-host runtime suite (ISSUE 11): topology-aware two-tier meshes,
+peer-failure detection, and checkpoint-restore onto a shrunk mesh.
+
+The guarantees pinned here:
+
+* **Two-tier meshes.** ``MeshCommunication.two_tier`` factors the flat split
+  axis as ``dcn x ici`` (ici-inner device order); hierarchical
+  ``Allreduce``/``Bcast`` lower two-level (reduce in ICI, cross DCN once) and
+  match the flat programs exactly for order-free ops and within reassociation
+  tolerance for f32 sums; ``HEAT_TPU_TWO_TIER=0`` restores the flat programs
+  bit for bit; tiered and flat comms over the same devices never share
+  compiled collective programs.
+* **Watchdog.** ``HEAT_TPU_COLLECTIVE_TIMEOUT_MS`` counts + logs in-flight
+  overruns (``comm.collective_timeout{kind}``, exported by telemetry) and
+  never interrupts a running program; unset = zero behavior change.
+* **Wiring validation.** ``distributed_init`` rejects partial explicit wiring
+  with a ``ValueError`` before it can become an opaque coordination hang, and
+  the gloo-missing branch degrades to a ``RuntimeWarning``.
+* **Peer-failure detection.** A peer is lost after exactly
+  ``miss_threshold`` consecutive conclusive no-advance probes (call-count
+  deterministic); an injected ``distributed.peer`` fault is inconclusive; the
+  ``distributed.heartbeat``/``distributed.peer`` breakers degrade fail-safe
+  (open probe breaker => nobody is ever declared lost).
+* **Elastic restart.** On detected loss the trainers drain pending fused
+  flushes, checkpoint through the PR 6 preemption-safe path, and raise
+  ``PeerLostError``; ``restore_latest_valid`` re-lays every split array out
+  on a SHRUNK mesh with exact params/step/RNG. The ``kill -9`` acceptance
+  test proves the whole choreography across real OS processes over
+  ``jax.distributed`` (gloo permitting; the in-process dryrun proof pins the
+  same contract unconditionally).
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import textwrap
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import heat_tpu as ht
+from heat_tpu import monitoring
+from heat_tpu.core import communication as comm_mod
+from heat_tpu.core import fusion
+from heat_tpu.core.communication import MeshCommunication, distributed_init
+from heat_tpu.monitoring import registry, report
+from heat_tpu.nn.data_parallel import DataParallel
+from heat_tpu.optim.dp_optimizer import DASO
+from heat_tpu.robustness import breaker, chaos, elastic, faultinject
+from heat_tpu.utils.checkpoint import CheckpointManager
+
+pytestmark = pytest.mark.robustness
+
+_DEVS = jax.devices()
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    registry.reset()
+    faultinject.clear()
+    breaker.reset()
+    # this suite asserts exact counts and schedules its own faults — standing
+    # CI envs (fault-plan / chaos / forced-open legs) are pinned off
+    monkeypatch.delenv("HEAT_TPU_FAULT_PLAN", raising=False)
+    monkeypatch.delenv("HEAT_TPU_CHAOS", raising=False)
+    monkeypatch.delenv("HEAT_TPU_BREAKER_FORCE_OPEN", raising=False)
+    monkeypatch.delenv("HEAT_TPU_TWO_TIER", raising=False)
+    monkeypatch.delenv("HEAT_TPU_COLLECTIVE_TIMEOUT_MS", raising=False)
+    yield
+    faultinject.clear()
+    breaker.reset()
+    registry.reset()
+
+
+# ------------------------------------------------------------------ two-tier meshes
+def test_two_tier_constructor_and_validation():
+    n = len(_DEVS)
+    c = MeshCommunication.two_tier(ici=n // 2, dcn=2) if n % 2 == 0 else None
+    if c is not None:
+        assert c.tiers == (2, n // 2)
+        assert c.tier_mesh.axis_names == ("dcn", "ici")
+        assert c.tier_mesh.devices.shape == (2, n // 2)
+        assert c.size == n
+        assert "tiers" in repr(c)
+        # one explicit size infers the other
+        assert MeshCommunication.two_tier(ici=n // 2).tiers == (2, n // 2)
+        # sub-communicators are flat: the tier annotation describes THIS world
+        assert c.Split(devices=list(range(n // 2))).tiers is None
+    assert MeshCommunication(devices=_DEVS).tiers is None
+    with pytest.raises(ValueError):
+        MeshCommunication.two_tier(ici=3, dcn=3, devices=_DEVS[:8])
+    with pytest.raises(ValueError):
+        MeshCommunication.two_tier(ici=0, dcn=1, devices=_DEVS[:1])
+    with pytest.raises(ValueError):
+        MeshCommunication(devices=_DEVS[:2], tiers=(0, 2))
+
+
+@pytest.mark.skipif(len(_DEVS) < 4, reason="needs a multi-device mesh to factor")
+@pytest.mark.parametrize("dcn", [2, len(_DEVS) // 2])
+def test_two_tier_allreduce_matches_flat(dcn):
+    tiered = MeshCommunication.two_tier(dcn=dcn)
+    flat = MeshCommunication(devices=_DEVS)
+    p = len(_DEVS)
+    x = np.arange(p * 2 * 3, dtype=np.float32).reshape(p * 2, 3) / 7.0
+    xi = np.arange(p * 2 * 3, dtype=np.int32).reshape(p * 2, 3)
+    xb = (xi % 5) > 1
+    # order-free ops: exact whatever the tiering
+    for op in ("max", "min"):
+        assert np.array_equal(
+            np.asarray(tiered.Allreduce(x, op=op)), np.asarray(flat.Allreduce(x, op=op))
+        )
+    for op in ("land", "lor"):
+        assert np.array_equal(
+            np.asarray(tiered.Allreduce(xb, op=op)), np.asarray(flat.Allreduce(xb, op=op))
+        )
+    # exact dtypes: associativity cannot bite
+    assert np.array_equal(
+        np.asarray(tiered.Allreduce(xi, op="sum")), np.asarray(flat.Allreduce(xi, op="sum"))
+    )
+    # f32 sum/prod: the two-level combine reassociates — equal within one
+    # reassociation bound (the documented two-tier numerics carve-out)
+    for op in ("sum", "prod"):
+        np.testing.assert_allclose(
+            np.asarray(tiered.Allreduce(x, op=op)),
+            np.asarray(flat.Allreduce(x, op=op)),
+            rtol=1e-6,
+        )
+    # bcast: pure selection — exact for every root incl. cross-tier ones
+    for root in (0, p // 2, p - 1):
+        assert np.array_equal(
+            np.asarray(tiered.Bcast(x, root=root)), np.asarray(flat.Bcast(x, root=root))
+        )
+
+
+@pytest.mark.skipif(len(_DEVS) < 4, reason="needs a multi-device mesh to factor")
+def test_two_tier_hatch_is_bit_identical_to_flat(monkeypatch):
+    tiered = MeshCommunication.two_tier(dcn=2)
+    flat = MeshCommunication(devices=_DEVS)
+    p = len(_DEVS)
+    x = np.arange(p * 3, dtype=np.float32).reshape(p, 3) / 7.0
+    ref = np.asarray(flat.Allreduce(x, op="sum"))
+    monkeypatch.setenv("HEAT_TPU_TWO_TIER", "0")
+    hatched = np.asarray(tiered.Allreduce(x, op="sum"))
+    assert hatched.tobytes() == ref.tobytes()
+    # with the hatch on, the tiered comm resolves to the SAME cached flat
+    # program; with it off, the programs key separately
+    assert tiered._collective_fn("allreduce", 0, 2, "sum") is flat._collective_fn(
+        "allreduce", 0, 2, "sum"
+    )
+    monkeypatch.delenv("HEAT_TPU_TWO_TIER")
+    assert tiered._collective_fn("allreduce", 0, 2, "sum") is not flat._collective_fn(
+        "allreduce", 0, 2, "sum"
+    )
+
+
+@pytest.mark.skipif(len(_DEVS) < 4, reason="needs a multi-device mesh to factor")
+@pytest.mark.fusion
+def test_collective_nodes_ride_tiered_comms():
+    # a fused chain + ring shift over a TIERED comm lands bit-identically to
+    # the flat comm (ppermute is pure data movement: the ici-inner ring order
+    # is already topology-optimal), and the node keys carry the tier
+    # annotation so the two comms never share trace-cache entries
+    tiered = MeshCommunication.two_tier(dcn=2)
+    flat = MeshCommunication(devices=_DEVS)
+    data = np.arange(2 * len(_DEVS) * 3, dtype=np.float32).reshape(-1, 3)
+    outs = {}
+    for name, c in (("tiered", tiered), ("flat", flat)):
+        x = ht.array(data, split=0, comm=c)
+        y = (x * 2.0 + 1.0)
+        outs[name] = comm_mod.shift(y, 1).numpy()
+    assert outs["tiered"].tobytes() == outs["flat"].tobytes()
+
+
+# ------------------------------------------------------------------ watchdog
+@pytest.mark.skipif(len(_DEVS) < 2, reason="collectives need a multi-device mesh")
+def test_collective_watchdog_counts_overruns_and_never_interrupts(monkeypatch):
+    c = MeshCommunication(devices=_DEVS)
+    x = np.arange(len(_DEVS) * 2, dtype=np.float32).reshape(len(_DEVS), 2)
+    ref = np.asarray(c.Allreduce(x, op="sum"))
+    with monitoring.capture():
+        # no knob: no counting
+        c.Allreduce(x, op="sum")
+        assert "comm.collective_timeout" not in report.telemetry()["counters"]
+        # an unmeetable deadline: the dispatch still completes with the exact
+        # result (never interrupted), the overrun is counted and exported
+        monkeypatch.setenv("HEAT_TPU_COLLECTIVE_TIMEOUT_MS", "0.0000001")
+        got = np.asarray(c.Allreduce(x, op="sum"))
+        assert got.tobytes() == ref.tobytes()
+        t = report.telemetry()
+        assert t["comm_collective_timeout"]["allreduce"] >= 1
+        # a generous deadline: no overrun counted
+        monkeypatch.setenv("HEAT_TPU_COLLECTIVE_TIMEOUT_MS", "60000")
+        before = t["comm_collective_timeout"]["allreduce"]
+        c.Allreduce(x, op="sum")
+        after = report.telemetry()["comm_collective_timeout"]["allreduce"]
+        assert after == before
+
+
+# ------------------------------------------------------------------ wiring validation
+def test_distributed_init_rejects_partial_wiring():
+    with pytest.raises(ValueError, match="incomplete distributed wiring"):
+        distributed_init(num_processes=2)
+    with pytest.raises(ValueError, match="incomplete distributed wiring"):
+        distributed_init(coordinator_address="127.0.0.1:1")
+    with pytest.raises(ValueError, match="incomplete distributed wiring"):
+        distributed_init(coordinator_address="127.0.0.1:1", num_processes=2)
+    with pytest.raises(ValueError, match="out of range"):
+        distributed_init("127.0.0.1:1", num_processes=2, process_id=2)
+    with pytest.raises(ValueError, match="out of range"):
+        distributed_init("127.0.0.1:1", num_processes=2, process_id=-1)
+    with pytest.raises(ValueError, match="num_processes"):
+        distributed_init("127.0.0.1:1", num_processes=0, process_id=0)
+    with pytest.raises(ValueError, match="local_devices"):
+        distributed_init(
+            "127.0.0.1:1", num_processes=1, process_id=0, local_devices=0
+        )
+
+
+def test_distributed_init_warns_when_gloo_config_missing(monkeypatch):
+    # the communication.py gloo-missing branch: a jax whose config lacks the
+    # CPU-collectives option degrades to a RuntimeWarning instead of a hang
+    class _Unbuilt:
+        mesh_built = False
+
+    monkeypatch.setattr(comm_mod, "WORLD", _Unbuilt())
+    monkeypatch.setattr(comm_mod, "SELF", _Unbuilt())
+
+    def no_such_option(*a, **kw):
+        raise AttributeError("unrecognized config option")
+
+    monkeypatch.setattr(jax.config, "update", no_such_option)
+    initialized = {}
+    monkeypatch.setattr(
+        jax.distributed, "initialize", lambda **kw: initialized.update(kw)
+    )
+    with pytest.warns(RuntimeWarning, match="gloo"):
+        distributed_init("127.0.0.1:1", num_processes=1, process_id=0)
+    assert initialized == {
+        "coordinator_address": "127.0.0.1:1",
+        "num_processes": 1,
+        "process_id": 0,
+    }
+
+
+# ------------------------------------------------------------------ peer detection
+def test_supervisor_detects_lost_peer_by_exact_probe_count(tmp_path):
+    s0 = elastic.ElasticSupervisor(str(tmp_path), 0, 2, miss_threshold=3)
+    s1 = elastic.ElasticSupervisor(str(tmp_path), 1, 2, miss_threshold=3)
+    with monitoring.capture():
+        for _ in range(4):
+            assert s0.beat() and s1.beat()
+            assert not s0.probe() and not s1.probe()
+        assert s0.state == "healthy"
+        # peer 1 "dies": its heartbeat file freezes. Exactly miss_threshold
+        # conclusive no-advance probes later — not one earlier — it is lost.
+        for i in range(3):
+            s0.beat()
+            lost = s0.probe()
+            assert (lost == frozenset({1})) == (i == 2), (i, lost)
+        assert s0.state == "degraded"
+        assert s0.lost_peers() == frozenset({1})
+        assert s0.shrunk_world_size() == 1
+        # the verdict is final: more probes change nothing
+        assert s0.probe() == frozenset({1})
+        t = report.telemetry()["robustness_elastic"]
+        assert t["peer-lost"] == 1 and t["degraded"] == 1
+
+
+def test_peer_beat_advance_resets_miss_count(tmp_path):
+    s0 = elastic.ElasticSupervisor(str(tmp_path), 0, 2, miss_threshold=3)
+    s1 = elastic.ElasticSupervisor(str(tmp_path), 1, 2, miss_threshold=3)
+    s1.beat()
+    s0.probe()  # sees beat 1
+    assert not s0.probe() and not s0.probe()  # 2 misses: below threshold
+    s1.beat()  # the slow peer advances
+    assert not s0.probe()  # advance resets the count
+    assert not s0.probe() and not s0.probe()  # 2 fresh misses: still alive
+    assert s0.probe() == frozenset({1})  # third consecutive: lost
+
+
+def test_probe_fault_is_inconclusive_and_heartbeat_fault_absorbed(tmp_path):
+    with monitoring.capture():
+        s = elastic.ElasticSupervisor(str(tmp_path), 0, 2, miss_threshold=2)
+        # 4 injected probe faults (below the breaker threshold of 5): NO miss
+        # advance — a flaky disk or chaos schedule cannot fabricate a loss
+        with faultinject.inject("distributed.peer", OSError, at_calls=[1, 2, 3, 4]) as plan:
+            for _ in range(4):
+                assert not s.probe()
+            assert plan.fired == [1, 2, 3, 4]
+        assert not s.probe()  # first conclusive miss
+        assert s.probe() == frozenset({1})  # second: lost
+        # heartbeat faults are absorbed: training never dies for liveness IO
+        s2 = elastic.ElasticSupervisor(str(tmp_path / "hb2"), 0, 1)
+        with faultinject.inject("distributed.heartbeat", OSError, at_calls=[1]):
+            assert s2.beat() is False
+        assert s2.beat() is True
+        t = report.telemetry()["robustness_elastic"]
+        assert t["probe-failed"] == 4 and t["heartbeat-failed"] == 1
+        assert report.telemetry()["faults_injected"]["distributed.peer"] == 4
+
+
+def test_peer_breaker_opens_and_fails_safe(tmp_path):
+    with monitoring.capture():
+        s = elastic.ElasticSupervisor(str(tmp_path), 0, 2, miss_threshold=1)
+        with faultinject.inject("distributed.peer", OSError, at_calls="*"):
+            for _ in range(5):
+                s.probe()  # 5 consecutive failures: breaker opens
+        assert breaker.breaker("distributed.peer").state() == "open"
+        # open probe breaker: reads are skipped, misses never advance, nobody
+        # is EVER declared lost — fail-safe by construction
+        for _ in range(10):
+            assert not s.probe()
+        t = report.telemetry()["robustness_elastic"]
+        assert t["probe-skipped"] == 10
+        assert "peer-lost" not in t
+
+
+def test_forced_open_breakers_keep_supervisor_inert(tmp_path, monkeypatch):
+    monkeypatch.setenv("HEAT_TPU_BREAKER_FORCE_OPEN", "*")
+    with monitoring.capture():
+        s = elastic.ElasticSupervisor(str(tmp_path), 0, 2, miss_threshold=1)
+        assert s.beat() is False  # skipped, not failed
+        assert s.probe() == frozenset()
+        t = report.telemetry()["robustness_elastic"]
+        assert t["heartbeat-skipped"] == 1 and t["probe-skipped"] == 1
+        assert "peer-lost" not in t and s.state == "healthy"
+
+
+def test_chaos_schedules_distributed_sites_without_fabricating_loss(tmp_path):
+    # the distributed.* sites are chaos-schedulable (opt-in, like
+    # collective.dispatch); a live peer under standing chaos is never lost —
+    # probe faults are inconclusive and heartbeat faults only skip one beat
+    with monitoring.capture():
+        with chaos.install("20260805:0.3:distributed.heartbeat,distributed.peer") as handle:
+            s0 = elastic.ElasticSupervisor(str(tmp_path), 0, 2, miss_threshold=3)
+            s1 = elastic.ElasticSupervisor(str(tmp_path), 1, 2, miss_threshold=3)
+            for _ in range(20):
+                s0.beat()
+                s1.beat()
+                assert not s0.probe()
+                assert not s1.probe()
+            fired = handle.fired()
+        assert any(fired.values())  # the schedule genuinely exercised the sites
+        t = report.telemetry()
+        assert sum(t["chaos_fires"].values()) == sum(len(v) for v in fired.values())
+        assert "peer-lost" not in t["robustness_elastic"]
+
+
+def test_supervisor_validates_arguments(tmp_path):
+    with pytest.raises(ValueError):
+        elastic.ElasticSupervisor(str(tmp_path), 2, 2)
+    with pytest.raises(ValueError):
+        elastic.ElasticSupervisor(str(tmp_path), 0, 1, miss_threshold=0)
+    assert elastic.survivors(str(tmp_path), 2) == []
+    s = elastic.ElasticSupervisor(str(tmp_path), 1, 2)
+    s.beat()
+    assert elastic.survivors(str(tmp_path), 2) == [1]
+
+
+# ------------------------------------------------------------------ drain + save
+def test_drain_and_save_flushes_pending_and_checkpoints(tmp_path):
+    fusion.clear_cache()
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    s = elastic.ElasticSupervisor(str(tmp_path / "hb"), 0, 1, manager=mgr)
+    with monitoring.capture():
+        x = ht.arange(16, split=0, dtype=ht.float32)
+        y = x * 2.0 + 1.0  # a pending fused chain
+        path = s.drain_and_save({"y": y, "step": 5}, step=5)
+        t = report.telemetry()
+        assert t["robustness_elastic"] == {"draining": 1, "saving": 1, "saved": 1}
+        assert t["counters"]["fusion.flushes"] >= 1  # the drain flushed it
+    assert s.state == "saved" and s.saved_step == 5
+    assert mgr.latest_valid_step() == 5
+    back = mgr.restore_latest_valid(
+        {"y": ht.zeros(16, split=0, dtype=ht.float32), "step": 0}
+    )
+    assert np.array_equal(back["y"].numpy(), np.arange(16, dtype=np.float32) * 2.0 + 1.0)
+    assert path == str(tmp_path / "ck" / "ckpt_000000000005.h5")
+
+
+# -------------------------------------------------------- in-process elastic proof
+class _TinyNet:
+    """Minimal .init/.apply module (no flax dependency in the hot loop)."""
+
+    def init(self, rng, x):
+        k = jax.random.PRNGKey(0) if isinstance(rng, int) else rng
+        return {"w": jax.random.normal(k, (x.shape[1], 1), jnp.float32) * 0.1}
+
+    def apply(self, params, x):
+        return x @ params["w"]
+
+
+def _mse(params, apply_fn, x, y):
+    return ((apply_fn(params, x) - y) ** 2).mean()
+
+
+def _batch():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((16, 4)).astype(np.float32)
+    return x, x.sum(axis=1, keepdims=True).astype(np.float32)
+
+
+def test_dryrun_elastic_restart_onto_shrunk_mesh(tmp_path):
+    # the single-process proof of the whole elastic flow (the PR 3
+    # dryrun_multichip precedent): an 8-device world loses a simulated peer,
+    # the survivor drains + saves through the preemption-safe path, and the
+    # run resumes on a SHRUNK mesh with exact params/step/RNG
+    if len(_DEVS) < 2:
+        pytest.skip("needs a multi-device mesh to shrink")
+    x, y = _batch()
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    sup = elastic.ElasticSupervisor(
+        str(tmp_path / "hb"), 0, 2, miss_threshold=2, manager=mgr
+    )
+    big = MeshCommunication(devices=_DEVS)
+    dp = DataParallel(_TinyNet(), comm=big, optimizer=optax.sgd(0.05))
+    dp.init(0, x)
+    dp.make_train_step(_mse)
+    dp.attach_elastic(sup)
+    with monitoring.capture():
+        dp.train_step(x, y)  # poll: miss 1 (peer 1 never beats), then the step runs
+        with pytest.raises(elastic.PeerLostError) as ei:
+            dp.train_step(x, y)  # poll: miss 2 = threshold -> drain+save+raise
+        t = report.telemetry()["robustness_elastic"]
+        assert t["restart-pending"] == 1 and t["peer-lost"] == 1
+    err = ei.value
+    assert err.survivors == 1 and err.saved_path is not None
+    saved_params = np.asarray(dp.params["w"])
+    saved_rng = ht.random.get_state()
+    # --- the "respawned" shrunk run: half the devices
+    small = MeshCommunication(devices=_DEVS[: len(_DEVS) // 2])
+    dp2 = DataParallel(_TinyNet(), comm=small, optimizer=optax.sgd(0.05))
+    dp2.init(1, x)  # different seed: restore must overwrite everything
+    dp2.make_train_step(_mse)
+    state = mgr.restore_latest_valid(dp2.checkpoint_state())
+    dp2.load_state(state)
+    assert dp2.step_count == err.saved_step
+    assert np.asarray(dp2.params["w"]).tobytes() == saved_params.tobytes()
+    assert ht.random.get_state() == saved_rng
+    # training continues on the shrunk mesh
+    loss = dp2.train_step(x, y)
+    assert np.isfinite(float(loss))
+
+
+def test_daso_elastic_poll_drains_and_raises(tmp_path):
+    x, y = _batch()
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    sup = elastic.ElasticSupervisor(
+        str(tmp_path / "hb"), 0, 2, miss_threshold=1, manager=mgr
+    )
+    daso = DASO(
+        local_optimizer=optax.sgd(1e-2),
+        total_epochs=2,
+        warmup_epochs=0,
+        cooldown_epochs=0,
+        max_global_skips=2,
+    )
+    params = _TinyNet().init(0, x)
+    daso.init(params)
+    daso.make_train_step(_mse, _TinyNet().apply)
+    daso.step(x, y)
+    daso.attach_elastic(sup)
+    with pytest.raises(elastic.PeerLostError) as ei:
+        daso.step(x, y)
+    assert ei.value.saved_step == 1
+    assert mgr.latest_valid_step() == 1
+    assert sup.state == "restart-pending"
+    # the saved DASO state restores with the loop position intact
+    target = {k: v for k, v in daso.checkpoint_state().items()}
+    back = mgr.restore_latest_valid(target)
+    assert back["step"] == 1 and back["epoch"] == 0
+
+
+def test_telemetry_exports_elastic_counters(tmp_path):
+    with monitoring.capture():
+        s = elastic.ElasticSupervisor(str(tmp_path), 0, 2, miss_threshold=1)
+        s.beat()
+        s.probe()
+        t = report.telemetry()
+        assert "robustness_elastic" in t
+        assert t["robustness_elastic"]["peer-lost"] == 1
+
+
+# ------------------------------------------------------ kill -9 acceptance (2 procs)
+# jax 0.4.x ships a gloo TCP transport with a framing bug (see
+# tests/test_multihost.py); 2-process runs generally work, but transport
+# flakiness under host load gets the documented skip, not a red build
+_LEGACY_GLOO = tuple(int(v) for v in jax.__version__.split(".")[:2]) < (0, 5)
+
+_ELASTIC_WORKER = textwrap.dedent(
+    """
+    import json, os, signal, sys, time, zlib
+    pid = int(sys.argv[1]); nprocs = int(sys.argv[2]); port = sys.argv[3]; tmp = sys.argv[4]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import numpy as np
+    import heat_tpu as ht
+    from heat_tpu.core.communication import MeshCommunication, distributed_init
+    from heat_tpu.nn.data_parallel import DataParallel
+    from heat_tpu.robustness import elastic
+    from heat_tpu.utils.checkpoint import CheckpointManager
+    import jax, jax.numpy as jnp, optax
+
+    if nprocs > 1:
+        distributed_init(f"127.0.0.1:{port}", num_processes=nprocs, process_id=pid,
+                         local_devices=2)
+        # prove the pod is genuinely wired: one cross-host psum
+        g = ht.arange(4 * jax.device_count(), split=0, dtype=ht.float32)
+        n = 4 * jax.device_count()
+        assert float(ht.sum(g).item()) == n * (n - 1) / 2.0
+    else:
+        from heat_tpu.core._compat import set_cpu_device_count
+        set_cpu_device_count(2)
+
+    class Tiny:
+        def init(self, rng, x):
+            k = jax.random.PRNGKey(0) if isinstance(rng, int) else rng
+            return {"w": jax.random.normal(k, (x.shape[1], 1), jnp.float32) * 0.1}
+        def apply(self, params, x):
+            return x @ params["w"]
+
+    def mse(p, apply_fn, x, y):
+        return ((apply_fn(p, x) - y) ** 2).mean()
+
+    rng = np.random.default_rng(0)
+    xb = rng.standard_normal((8, 4)).astype(np.float32)
+    yb = xb.sum(axis=1, keepdims=True).astype(np.float32)
+    # steady-state training is LOCAL (this host's 2 devices) — the DASO
+    # local-sync tier; cross-host traffic is the startup psum above plus the
+    # elastic checkpoint protocol. A collective against a dead peer would
+    # hang, so the supervisor poll must precede any global dispatch.
+    local = MeshCommunication(devices=jax.local_devices())
+    dp = DataParallel(Tiny(), comm=local, optimizer=optax.sgd(0.05))
+    dp.init(0, xb)
+    dp.make_train_step(mse)
+    hb, ck = f"{tmp}/hb", f"{tmp}/ck"
+
+    # warm the jitted step BEFORE supervision starts: both workers compile the
+    # same program concurrently, so the first heartbeat lands only once the
+    # steady-state (fast) step cadence is established — scheduler skew on a
+    # loaded 1-core host then cannot mimic a dead peer
+    dp.train_step(xb, yb)
+
+    if nprocs > 1 and pid == 1:
+        # the victim: beats while training, then takes a real kill -9 —
+        # no atexit, no flush, the heartbeat file freezes mid-run
+        sup = elastic.ElasticSupervisor(hb, process_id=1, num_processes=2)
+        for _ in range(3):
+            sup.beat()
+            dp.train_step(xb, yb)
+            time.sleep(0.02)
+        sup.beat()
+        print("victim about to die", flush=True)
+        os.kill(os.getpid(), signal.SIGKILL)
+    elif nprocs > 1:
+        # the survivor: full supervision; a generous miss threshold tolerates
+        # scheduler skew on a loaded host (a live-but-slow peer resets the
+        # count on its next beat; only a dead one misses 50 straight)
+        mgr = CheckpointManager(ck)
+        sup = elastic.ElasticSupervisor(hb, process_id=0, num_processes=2,
+                                        miss_threshold=50, manager=mgr)
+        dp.attach_elastic(sup)
+        try:
+            for _ in range(4000):
+                dp.train_step(xb, yb)
+                time.sleep(0.02)
+            raise SystemExit("peer loss never detected")
+        except elastic.PeerLostError as e:
+            manifest = {
+                "step": e.saved_step,
+                "survivors": e.survivors,
+                "crc": zlib.crc32(np.asarray(dp.params["w"]).tobytes()),
+                "rng": list(ht.random.get_state()),
+            }
+            with open(f"{tmp}/manifest.json", "w") as f:
+                json.dump(manifest, f)
+            print(f"survivor saved step {e.saved_step}", flush=True)
+            os._exit(elastic.ELASTIC_RESTART_EXIT)
+    else:
+        # the shrunk relaunch: restore the survivor's checkpoint onto the
+        # (N-1)-process world and train on
+        with open(f"{tmp}/manifest.json") as f:
+            manifest = json.load(f)
+        mgr = CheckpointManager(ck)
+        dp.init(1, xb)  # different seed: restore must overwrite everything
+        state = mgr.restore_latest_valid(dp.checkpoint_state())
+        dp.load_state(state)
+        assert dp.step_count == manifest["step"], (dp.step_count, manifest)
+        assert zlib.crc32(np.asarray(dp.params["w"]).tobytes()) == manifest["crc"]
+        assert list(ht.random.get_state()) == manifest["rng"]
+        for _ in range(2):
+            loss = dp.train_step(xb, yb)
+        assert np.isfinite(float(loss))
+        print("resume ok", flush=True)
+    """
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(worker, args, env):
+    return subprocess.Popen(
+        [sys.executable, str(worker)] + [str(a) for a in args],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+def test_kill9_elastic_restart_shrinks_mesh(tmp_path):
+    """ISSUE 11 acceptance: kill -9 of one worker in a 2-process localhost
+    ``jax.distributed`` run → the survivor detects the loss via heartbeats,
+    drains + saves, exits ``ELASTIC_RESTART_EXIT``; the relaunch restores the
+    latest valid checkpoint onto the 1-process world and keeps training with
+    exact params/step/RNG."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(_ELASTIC_WORKER)
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        # the parent's 8-device flag and any standing chaos/fault/breaker CI
+        # envs must not leak into the workers: each process provisions its own
+        # 2-device world and the test asserts exact elastic behavior
+        if k
+        not in (
+            "XLA_FLAGS",
+            "PYTHONPATH",
+            "HEAT_TPU_CHAOS",
+            "HEAT_TPU_FAULT_PLAN",
+            "HEAT_TPU_BREAKER_FORCE_OPEN",
+        )
+    }
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    port = _free_port()
+    procs = [
+        _spawn(worker, [pid, 2, port, tmp_path], env) for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    blob = "\n".join(outs)
+    if _LEGACY_GLOO and (
+        "Connection reset by peer" in blob
+        or "heartbeat timeout" in blob
+        or "preamble" in blob
+    ) and procs[0].returncode not in (elastic.ELASTIC_RESTART_EXIT,):
+        # the jax<0.5 gloo tcp framing race (reproduced standalone, see
+        # test_multihost.py) — environment defect; the dryrun proof above
+        # pins the elastic contract unconditionally
+        pytest.skip("jax<0.5 gloo tcp framing race killed the pod")
+    assert procs[1].returncode == -signal.SIGKILL, f"victim:\n{outs[1][-2000:]}"
+    assert procs[0].returncode == elastic.ELASTIC_RESTART_EXIT, (
+        f"survivor:\n{outs[0][-3000:]}"
+    )
+    assert "survivor saved step" in outs[0]
+    manifest = json.loads((tmp_path / "manifest.json").read_text())
+    assert manifest["survivors"] == 1 and manifest["step"] >= 1
+    # --- phase B: the shrunk relaunch
+    resumed = _spawn(worker, [0, 1, 0, tmp_path], env)
+    out, _ = resumed.communicate(timeout=300)
+    assert resumed.returncode == 0, f"resumed worker:\n{out[-3000:]}"
+    assert "resume ok" in out
