@@ -27,6 +27,10 @@ paired-differencing and physics gating as every other bench surface
   standalone reduce read: 3·N·4 bytes). ``reduction_sink_speedup`` is the
   ratio of the two gated medians; the sink pairs are gated at 1.05× the HBM
   roofline through the N·4 bytes/step floor.
+* ``audit_overhead_pct`` (ISSUE 12) — wall-clock tax of the shadow-replay
+  audit (``HEAT_TPU_AUDIT_RATE``) at rate 1 and rate 8 vs audit-off, paired
+  same-process over the 8-op chain; ``audit_overhead_valid`` additionally
+  requires ZERO mismatches on the clean data (see ``bench_audit_overhead``).
 * ``fused_view_chain_gbps`` (ISSUE 5) — an 8-op f32 chain with a mid-chain
   transpose + basic row-slice (half the rows), executed through the view-node
   path: ONE kernel reading N·4 bytes and writing (N/2)·4 — the single-read
@@ -336,6 +340,76 @@ def bench_ragged_reduce(ht, rng):
     return out
 
 
+N_AUDIT = 1024 * 1024  # 4 MB f32: big enough that replay cost dominates noise
+
+
+def bench_audit_overhead(ht, rng):
+    """``audit_overhead_pct`` anchor (ISSUE 12): wall-clock cost of the
+    shadow-replay audit at ``HEAT_TPU_AUDIT_RATE=N`` vs audit-off, paired in
+    the same process over the same 8-op chain (clean data — the anchor
+    measures the replay tax, not detection). At rate N every Nth flush pays
+    one per-op eager replay of the chain, so the modeled overhead is roughly
+    ``(t_eager / t_fused) / N``; the anchor reports rate 1 (the ceiling) and
+    rate 8 (a production sampling cadence). ``audit_overhead_valid`` gates
+    on spread and on ZERO mismatches (a mismatch would mean the comparator
+    flagged a clean run — the false-positive guard's bench twin)."""
+    import time
+
+    from heat_tpu.monitoring import registry as _registry
+
+    out = {}
+    prev_rate = os.environ.get("HEAT_TPU_AUDIT_RATE")
+    base = ht.array(rng.random(N_AUDIT, dtype=np.float32))
+    base.parray  # noqa: B018
+
+    def leg(rate, trials=7, steps=8):
+        # steps is a multiple of every measured rate, so each trial pays an
+        # identical number of audits (cadence never straddles a trial edge)
+        if rate is None:
+            os.environ.pop("HEAT_TPU_AUDIT_RATE", None)
+        else:
+            os.environ["HEAT_TPU_AUDIT_RATE"] = str(rate)
+
+        def one():
+            x = base
+            for _ in range(steps):
+                x = _chain(ht, x)
+                x.parray  # noqa: B018 — flush barrier (each flush audited)
+            np.asarray(x.larray)
+
+        one()  # compile + warm
+        one()  # second warm pass: first-flush listener/counter setup settles
+        ts = []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            one()
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts)), _spread_pct([1.0 / t for t in ts])
+
+    try:
+        with _registry.capture():
+            t_off, sp_off = leg(None)
+            t_on1, sp_on1 = leg(1)
+            t_on8, sp_on8 = leg(8)
+            mism = _registry.REGISTRY.counter("robustness.integrity").get("mismatch")
+        out["audit_overhead_pct"] = round(100.0 * (t_on1 / t_off - 1.0), 1)
+        out["audit_overhead_rate8_pct"] = round(100.0 * (t_on8 / t_off - 1.0), 1)
+        out["audit_mismatches"] = int(mism)
+        out["audit_overhead_valid"] = bool(
+            mism == 0 and sp_off < 25.0 and sp_on1 < 25.0 and sp_on8 < 25.0
+        )
+    except Exception as e:  # pragma: no cover — anchor crash stays visible
+        out["audit_overhead_pct"] = None
+        out["audit_overhead_valid"] = None
+        out["audit_overhead_error"] = repr(e)[:160]
+    finally:
+        if prev_rate is None:
+            os.environ.pop("HEAT_TPU_AUDIT_RATE", None)
+        else:
+            os.environ["HEAT_TPU_AUDIT_RATE"] = prev_rate
+    return out
+
+
 def bench_elementwise():
     import jax
 
@@ -379,6 +453,7 @@ def bench_elementwise():
         out.update(bench_fused_reduction(ht, roofline, rng))
         out.update(bench_fused_view_chain(ht, roofline, rng))
         out.update(bench_ragged_reduce(ht, rng))
+        out.update(bench_audit_overhead(ht, rng))
 
         small = ht.array(rng.random(N_SMALL, dtype=np.float32))
         df_rate, df_jit, df_tot, df_disc = _rate(

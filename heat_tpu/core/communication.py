@@ -505,9 +505,18 @@ class MeshCommunication(Communication):
             _instr.collective(kind)
         fn = self._collective_fn(kind, split, ndim, op, **kw)
         deadline_ms = _collective_timeout_ms()
-        if deadline_ms is None:
-            return fn
-        return _watched(fn, kind, deadline_ms)
+        if deadline_ms is not None:
+            fn = _watched(fn, kind, deadline_ms)
+        if kind in _CHECKSUM_KINDS:
+            # value-level fault site + checksum lane (ISSUE 12): the SDC
+            # adversary perturbs the dispatched result, and with
+            # HEAT_TPU_COLLECTIVE_CHECKSUM=1 the per-chunk CRC lane (or the
+            # allreduce f64 local-sum invariant) verifies it on receipt —
+            # a mismatch raises IntegrityError (eager shims raise by
+            # design: there is no retained graph to degrade to). Off, the
+            # wrapper costs one dict lookup + one env read per dispatch.
+            fn = _integrity_wrapped(self, fn, kind, split, op, kw)
+        return fn
 
     def __prep(self, x, split: int):
         x = jax.numpy.asarray(x)
@@ -824,6 +833,162 @@ def _watched(fn, kind: str, deadline_ms: float):
         return out
 
     return watched
+
+# ------------------------------------------------------------------ checksum lane
+#
+# Silent-data-corruption defense for the EAGER collective shims (ISSUE 12;
+# collectives recorded in fused flushes are covered by the shadow-replay
+# audit in core/fusion.py instead). The pure data-movement kinds —
+# ppermute / alltoall / allgather (and shift, which rides the Ppermute shim;
+# the halo exchange has its own hook in dndarray.get_halo) — are *bitwise*
+# by contract (PR 7), so their lane is exact: a CRC32 per chunk of the input
+# is matched against the received chunks under the collective's documented
+# permutation. Allreduce is reassociation-bounded, so its lane is the
+# reduced f64 local-sum invariant (op 'sum'; max/min/land/lor verify exactly
+# elementwise; float 'prod' is unchecked — documented). Verification runs on
+# the host against the single-controller's own global view; a mismatch
+# raises IntegrityError, counted ``robustness.integrity{collective-mismatch}``.
+
+#: Collective kinds the checksum lane covers ('shift' arrives as ppermute).
+_CHECKSUM_KINDS = frozenset({"ppermute", "alltoall", "allgather", "allreduce"})
+
+
+def collective_checksum_enabled() -> bool:
+    """Whether eager collective dispatches verify their checksum lane
+    (``HEAT_TPU_COLLECTIVE_CHECKSUM=1``; default off = bit-for-bit the
+    pre-ISSUE-12 dispatch). Read per dispatch."""
+    return _os.environ.get("HEAT_TPU_COLLECTIVE_CHECKSUM", "").strip().lower() in (
+        "1", "true", "on",
+    )
+
+
+def _integrity_wrapped(comm, fn, kind: str, split: int, op: str, kw: dict):
+    """The per-dispatch integrity wrapper: consult the value-fault adversary
+    (:func:`faultinject.corrupt_value`) on the result, then — when the lane
+    is enabled — verify it on receipt."""
+
+    def dispatch(x):
+        out = _FI.corrupt_value("collective.dispatch", fn(x))
+        if collective_checksum_enabled():
+            _verify_collective(comm, kind, split, op, kw, x, out)
+        return out
+
+    return dispatch
+
+
+def _crc(a) -> int:
+    import zlib
+
+    return zlib.crc32(np.ascontiguousarray(a).tobytes())
+
+
+def _checksum_fail(kind: str, detail: str):
+    from ..robustness.integrity import IntegrityError
+
+    if _MON.enabled:
+        _instr.integrity("collective-mismatch")
+    raise IntegrityError(
+        f"collective checksum lane mismatch on {kind}: {detail} — the "
+        "received payload does not match the dispatched chunks "
+        "(HEAT_TPU_COLLECTIVE_CHECKSUM=1; see doc/integrity_notes.md)"
+    )
+
+
+def _verify_collective(comm, kind: str, split: int, op: str, kw: dict, x, out) -> None:
+    """Host-side receipt verification of one eager collective dispatch
+    against the controller's own pre-dispatch view of the chunks."""
+    p = comm.size
+    xa = np.asarray(x)
+    oa = np.asarray(out)
+    in_chunks = np.split(xa, p, axis=split)
+    if kind == "ppermute":
+        shift_ = int(kw["shift"]) % p
+        out_chunks = np.split(oa, p, axis=split)
+        for j in range(p):
+            src = in_chunks[(j - shift_) % p]
+            if _crc(out_chunks[j]) != _crc(src):
+                _checksum_fail(kind, f"chunk {j} != dispatched chunk {(j - shift_) % p}")
+    elif kind == "allgather":
+        out_chunks = np.split(oa, p, axis=split)
+        for j in range(p):
+            if _crc(out_chunks[j]) != _crc(in_chunks[j]):
+                _checksum_fail(kind, f"gathered chunk {j} differs from its source")
+    elif kind == "alltoall":
+        sa = int(kw["sa"])
+        out_chunks = np.split(oa, p, axis=sa)
+        for j in range(p):
+            blocks = [np.split(c, p, axis=sa)[j] for c in in_chunks]
+            expected = np.concatenate(blocks, axis=split)
+            if _crc(out_chunks[j]) != _crc(expected):
+                _checksum_fail(kind, f"re-chunked slab {j} differs from its source blocks")
+    elif kind == "allreduce":
+        _verify_allreduce(kind, op, in_chunks, oa, p)
+    if _MON.enabled:
+        _instr.integrity("collective-verified")
+
+
+def _verify_allreduce(kind: str, op: str, in_chunks, oa, p: int) -> None:
+    stacked = np.stack([np.asarray(c) for c in in_chunks])
+    if op == "sum":
+        dt = stacked.dtype
+        if jax.numpy.issubdtype(dt, jax.numpy.floating):
+            # reduced f64 local-sum invariant: the scalar totals of input
+            # and output agree within the documented reassociation bound
+            from ..robustness.integrity import allreduce_sum_bound
+
+            tin = float(np.sum(stacked.astype(np.float64)))
+            tout = float(np.sum(oa.astype(np.float64)))
+            bound = allreduce_sum_bound(float(np.sum(np.abs(stacked.astype(np.float64)))), dt, p)
+            if not (abs(tin - tout) <= bound or (np.isnan(tin) and np.isnan(tout))):
+                _checksum_fail(kind, f"f64 sum invariant |{tin} - {tout}| > {bound}")
+        else:
+            # exact dtypes: elementwise re-reduction with matching wraparound
+            expected = np.add.reduce(stacked, axis=0, dtype=oa.dtype)
+            if _crc(expected.astype(oa.dtype)) != _crc(oa):
+                _checksum_fail(kind, "integer sum differs from re-reduction")
+    elif op in ("max", "min"):
+        red = np.maximum.reduce if op == "max" else np.minimum.reduce
+        if _crc(red(stacked).astype(oa.dtype)) != _crc(oa):
+            _checksum_fail(kind, f"{op} differs from exact re-reduction")
+    elif op in ("land", "lor"):
+        red = np.logical_and.reduce if op == "land" else np.logical_or.reduce
+        if _crc(red(stacked != 0).astype(oa.dtype)) != _crc(oa):
+            _checksum_fail(kind, f"{op} differs from exact re-reduction")
+    # float 'prod' has no bounded invariant cheaper than recomputation:
+    # unchecked by design (documented in doc/integrity_notes.md)
+
+
+def _verify_halo(comm, phys: "np.ndarray", split: int, halo_size: int, prev, nxt, stacked) -> None:
+    """Receipt verification of the eager halo exchange (``DNDarray.get_halo``):
+    every received slab must equal the neighbor's boundary slice of the
+    controller's own pre-dispatch view (zeros at the outer boundaries)."""
+    p = comm.size
+    chunks = np.split(np.asarray(phys), p, axis=split)
+    h = halo_size
+
+    def edge(c, first: bool):
+        sl = [slice(None)] * c.ndim
+        sl[split] = slice(0, h) if first else slice(c.shape[split] - h, None)
+        return c[tuple(sl)]
+
+    prev_chunks = np.split(np.asarray(prev), p, axis=split)
+    next_chunks = np.split(np.asarray(nxt), p, axis=split)
+    stacked_np = np.asarray(stacked)
+    for i in range(p):
+        exp_prev = np.zeros_like(prev_chunks[i]) if i == 0 else edge(chunks[i - 1], False)
+        exp_next = np.zeros_like(next_chunks[i]) if i == p - 1 else edge(chunks[i + 1], True)
+        if _crc(prev_chunks[i]) != _crc(exp_prev):
+            _checksum_fail("halo", f"prev slab of shard {i} differs from its neighbor's edge")
+        if _crc(next_chunks[i]) != _crc(exp_next):
+            _checksum_fail("halo", f"next slab of shard {i} differs from its neighbor's edge")
+        expected_stack = np.concatenate(
+            [np.moveaxis(a, split, 0) for a in (exp_prev, chunks[i], exp_next)], axis=0
+        )
+        if _crc(stacked_np[i]) != _crc(expected_stack):
+            _checksum_fail("halo", f"stacked block of shard {i} differs from its sources")
+    if _MON.enabled:
+        _instr.integrity("collective-verified")
+
 
 _REDUCERS = {
     "sum": (lambda b, ax: jax.lax.psum(b, ax), jax.numpy.sum, lambda g: jax.lax.cumsum(g, axis=0)),

@@ -731,7 +731,17 @@ class DNDarray:
         fn = _build_halo_exchange(comm.mesh, comm.axis_name, p, split, halo_size, self.pshape)
         # zero-fill pads so ragged tails exchange zeros, not garbage
         phys = self.filled(0) if self.is_padded else self.parray
-        self.__halo_prev, self.__halo_next, self.__halo_stacked = fn(phys)
+        # value-level fault site + checksum lane (ISSUE 12): the SDC
+        # adversary perturbs the exchanged slabs, and with
+        # HEAT_TPU_COLLECTIVE_CHECKSUM=1 every received halo is verified
+        # against the controller's own view of the neighbor edges
+        from ..robustness import faultinject as _FI
+        from .communication import _verify_halo, collective_checksum_enabled
+
+        prev, nxt, stacked = _FI.corrupt_value("collective.dispatch", tuple(fn(phys)))
+        if collective_checksum_enabled():
+            _verify_halo(comm, np.asarray(phys), split, halo_size, prev, nxt, stacked)
+        self.__halo_prev, self.__halo_next, self.__halo_stacked = prev, nxt, stacked
 
     # ------------------------------------------------------------------ conversions
     def astype(self, dtype, copy: bool = True) -> "DNDarray":

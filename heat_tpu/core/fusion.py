@@ -142,6 +142,18 @@ Design (see ``doc/fusion_notes.md`` for the full narrative):
   Deterministic fault injection for all of this rides the
   ``fusion.compile``/``fusion.execute`` sites of
   :mod:`heat_tpu.robustness.faultinject`.
+* **Shadow-replay audit.** Exceptions are not the only failure mode: silent
+  data corruption inside a fused kernel produces a wrong *value* nothing
+  re-checks. With ``HEAT_TPU_AUDIT_RATE=N`` every Nth fused flush also runs
+  the retained per-op eager replay (the ladder's rung-3 program) and
+  compares outputs under the documented carve-out tolerances
+  (:mod:`heat_tpu.robustness.integrity`); a mismatch counts
+  ``robustness.integrity{mismatch}``, poisons the signature, evicts the L1
+  executable and quarantines the L2 entry, then raises ``IntegrityError``
+  or serves the trusted eager value per ``HEAT_TPU_AUDIT_ACTION``
+  (``raise``/``degrade``, default degrade). The value-level fault site
+  ``faultinject.corrupt("fusion.execute", ...)`` is the seeded adversary
+  the audit is proven against. Off by default (one env read per flush).
 
 Monitoring: ``fusion.ops_deferred`` (labelled binary/local/where/cast/view/
 gemm/collective), ``fusion.reduction_sinks`` (labelled reduce/cum/moment/
@@ -179,6 +191,7 @@ from ..monitoring.registry import STATE as _MON
 from ..monitoring import instrument as _instr
 from ..robustness import breaker as _BRK
 from ..robustness import faultinject as _FI
+from ..robustness import integrity as _INTEG
 from . import pallas as _PL
 from .dndarray import DNDarray
 
@@ -2216,6 +2229,60 @@ def _poison(key) -> None:
         _instr.fusion_poisoned()
 
 
+def _audit_flush(values, program, leaf_arrays, out_idx, donate, key, stable_prog):
+    """Shadow-replay audit of one sampled fused flush (ISSUE 12,
+    ``HEAT_TPU_AUDIT_RATE``): re-run the retained per-op eager replay — the
+    ladder's rung-3 program, bit-parity with ``HEAT_TPU_FUSION=0`` by
+    construction — and compare every output under the documented carve-out
+    tolerances (:mod:`heat_tpu.robustness.integrity`). A mismatch counts
+    ``robustness.integrity{mismatch}``, drops the suspect executable from
+    the trace LRU, POISONS the signature (identical future chains run
+    permanently eager) and quarantines the L2 entry + corpus recipe; policy
+    ``HEAT_TPU_AUDIT_ACTION=raise`` raises
+    :class:`~heat_tpu.robustness.integrity.IntegrityError` at the
+    materialization barrier, the default ``degrade`` returns the trusted
+    eager values. Donating flushes are skipped (the fused kernel may have
+    consumed the retained leaves on accelerator backends — counted
+    ``skip-donated``); the replay runs the exact recorded callables
+    (pallas-backed sinks included), so a clean flush compares bit-for-bit
+    up to the fused kernel's own FMA/excess-precision carve-outs."""
+    if donate:
+        if _MON.enabled:
+            _instr.integrity("skip-donated")
+        return values
+    if _MON.enabled:
+        _instr.integrity("audit")
+    ref = _eager_replay(program, leaf_arrays, out_idx)
+    bad = _INTEG.compare_outputs(values, ref)
+    if not bad:
+        return values
+    if _MON.enabled:
+        _instr.integrity("mismatch")
+    if key is not None:
+        _TRACE_CACHE.pop(key, None)
+    _poison(key)
+    cache_dir = os.environ.get("HEAT_TPU_CACHE_DIR", "").strip()
+    if cache_dir and stable_prog is not None:
+        try:
+            from ..serving import cache as _disk
+
+            digest = _disk.digest_for(stable_prog, leaf_arrays, donate, out_idx)
+            if digest is not None:
+                _disk.evict(cache_dir, digest)
+        except Exception:
+            pass  # eviction is best-effort; poisoning already isolates L1
+    if _INTEG.audit_action() == "raise":
+        raise _INTEG.IntegrityError(
+            f"shadow-replay audit mismatch at fused output(s) {bad}: the "
+            "fused kernel's values diverge from the retained eager replay "
+            "beyond the documented carve-out tolerances (signature "
+            "poisoned, cache entries evicted — see doc/integrity_notes.md)"
+        )
+    # degrade: the eager replay IS the rung-3 trusted value; serve it, and
+    # the poisoned signature routes every identical future chain eager
+    return ref
+
+
 def _flush_ladder(
     fused, program, leaf_arrays, out_idx, donate, compiled, key,
     has_coll=False, debucket=None, has_pallas=False,
@@ -2263,6 +2330,12 @@ def _flush_ladder(
         if has_pallas:
             _FI.check("pallas.execute")
         values = fused(*leaf_arrays)
+        # value-level fault site (ISSUE 12): the SDC adversary perturbs the
+        # FUSED kernel's outputs — the one execution path nobody re-checks —
+        # which the shadow-replay audit in materialize_for must catch. The
+        # recovery rungs below replay the retained program per-op and are
+        # deliberately never corrupted: they are the trusted reference.
+        values = _FI.corrupt_value("fusion.execute", values)
         if compiled:
             _BRK.breaker("fusion.compile").record_success()
         if has_coll:
@@ -2651,6 +2724,15 @@ def materialize_for(d: DNDarray):
             fused, program, leaf_arrays, out_idx, donate, compiled, key,
             has_coll=bool(coll_kinds), debucket=debucket, has_pallas=has_pallas,
         )
+
+        # ---- integrity: shadow-replay audit (ISSUE 12). Every Nth fused
+        # flush also runs the retained eager replay and compares outputs;
+        # off (the default) this is one os.environ read. The poisoned /
+        # breaker-eager branch above IS the eager replay — nothing to audit.
+        if _INTEG.audit_due():
+            values = _audit_flush(
+                values, program, leaf_arrays, out_idx, donate, key, stable_prog
+            )
 
     if bucket_slicer is not None:
         # restore the logical view from the bucket-padded root output (the

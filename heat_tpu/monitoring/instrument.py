@@ -82,6 +82,8 @@ __all__ = [
     "serving_janitor",
     "breaker_transition",
     "chaos_fire",
+    "integrity",
+    "fault_corrupted",
     "record_io",
     "io_retry",
     "checkpoint_op",
@@ -349,8 +351,31 @@ def breaker_transition(site: str, state: str) -> None:
 def chaos_fire(site: str) -> None:
     """One fault fired by a derandomized chaos schedule
     (:mod:`heat_tpu.robustness.chaos`) — counted on top of the generic
-    ``faults.injected{site}``."""
+    ``faults.injected{site}`` (exception plans) or ``faults.corrupted{site}``
+    (corrupt-mode value plans, ISSUE 12)."""
     REGISTRY.counter("robustness.chaos").inc(label=site)
+
+
+def integrity(kind: str) -> None:
+    """One value-integrity event (``robustness.integrity{kind}``, ISSUE 12):
+    ``audit`` — a fused flush shadow-replayed; ``mismatch`` — the audit
+    found the fused outputs diverging beyond the carve-out tolerances
+    (signature poisoned, cache entries evicted); ``skip-donated`` — an
+    audit-sampled flush skipped because donation consumed the retained
+    leaves; ``collective-verified`` / ``collective-mismatch`` — a
+    checksummed eager collective's lane verified / failed on receipt;
+    ``checkpoint-crc`` — a checkpoint leaf checksum mismatch raised at
+    load; ``scrub-scanned`` / ``scrub-corrupt`` / ``scrub-legacy`` — the
+    offline scrubber's per-artifact outcomes."""
+    REGISTRY.counter("robustness.integrity").inc(label=kind)
+
+
+def fault_corrupted(site: str) -> None:
+    """One value-level fault fired by an installed
+    :class:`~heat_tpu.robustness.faultinject.ValueFaultPlan` — the site's
+    return value was deterministically perturbed (the SDC adversary the
+    integrity machinery must catch)."""
+    REGISTRY.counter("faults.corrupted").inc(label=site)
 
 
 def record_io(op: str, path: str, nbytes: int, seconds: float) -> None:

@@ -151,6 +151,11 @@ def telemetry() -> dict:
         ("serving.janitor", "serving_janitor"),
         ("robustness.breaker", "robustness_breakers"),
         ("robustness.chaos", "chaos_fires"),
+        # silent-data-corruption defense (ISSUE 12): audit/mismatch/checksum
+        # outcomes and the fired value-level faults they must account for —
+        # the fires-vs-detections ledger of the integrity-smoke CI legs
+        ("robustness.integrity", "robustness_integrity"),
+        ("faults.corrupted", "faults_corrupted"),
         # graceful-degradation breakdowns (ISSUE 6): which failure classes the
         # flush ladder absorbed, which writer paths retried, what the
         # checkpoint subsystem did, and which fault sites actually fired

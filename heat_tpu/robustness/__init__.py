@@ -31,6 +31,17 @@ memory arbitrarily far from the op that recorded them:
   ``distributed.heartbeat``/``distributed.peer`` fault sites) and the
   drain → checkpoint → restart-shrunk choreography: a ``kill -9``'d worker
   costs the run a checkpoint generation and one mesh size, not the job.
+- :mod:`~heat_tpu.robustness.integrity` — silent-data-corruption defense
+  (ISSUE 12): the shadow-replay audit contract (``HEAT_TPU_AUDIT_RATE`` /
+  ``HEAT_TPU_AUDIT_ACTION``) with its carve-out tolerance comparator,
+  :class:`IntegrityError`, and the allreduce sum-invariant bound the
+  checksummed collectives (``HEAT_TPU_COLLECTIVE_CHECKSUM``,
+  ``core/communication.py``) verify against. The adversary is
+  :func:`faultinject.corrupt` — deterministic value-level fault plans.
+- :mod:`~heat_tpu.robustness.scrub` — offline integrity scrubber
+  (``python -m heat_tpu.robustness.scrub``): revalidates checkpoint CRC
+  manifests and L2 cache/corpus sha256 footers out of band, quarantining
+  failures via the janitor path.
 
 The fused-flush recovery *ladder* itself lives in ``core/fusion.py`` (it needs
 the retained expression DAG); its failure/recovery/poisoning counters are
@@ -41,11 +52,14 @@ from . import breaker
 from . import chaos
 from . import elastic
 from . import faultinject
+from . import integrity
 from . import preemption
 from . import retry
+from . import scrub
 from .breaker import CircuitBreaker
 from .elastic import ElasticSupervisor, PeerLostError
-from .faultinject import FaultPlan, inject
+from .faultinject import FaultPlan, ValueFaultPlan, corrupt, inject
+from .integrity import IntegrityError
 from .preemption import PreemptionGuard
 from .retry import RetryPolicy
 
@@ -54,12 +68,17 @@ __all__ = [
     "chaos",
     "elastic",
     "faultinject",
+    "integrity",
     "preemption",
     "retry",
+    "scrub",
     "CircuitBreaker",
     "ElasticSupervisor",
     "FaultPlan",
+    "ValueFaultPlan",
+    "corrupt",
     "inject",
+    "IntegrityError",
     "PeerLostError",
     "PreemptionGuard",
     "RetryPolicy",

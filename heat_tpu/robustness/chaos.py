@@ -12,8 +12,10 @@ calls, on every machine.
 
 Spec (``HEAT_TPU_CHAOS`` or :func:`install`)::
 
-    "seed:rate[:sites]"          e.g.  "1234:0.08"
+    "seed:rate[:sites[:mode]]"   e.g.  "1234:0.08"
                                        "7:0.2:fusion.compile,io.write"
+                                       "1234:0.05::corrupt"
+                                       "7:0.1:fusion.execute:corrupt"
 
 * ``seed`` — any string; the schedule derives from ``Random(f"{seed}:{site}")``
   (string seeding is hash-salt-independent, so the schedule is identical
@@ -28,6 +30,20 @@ Spec (``HEAT_TPU_CHAOS`` or :func:`install`)::
   a collective recorded in a fused flush recovers through the ladder, but an
   *eager* shim dispatch has no retained graph and raises at the call site by
   design — name it explicitly to chaos-test fused collective pipelines.
+* ``mode`` — optional 4th field, ``corrupt`` (ISSUE 12): the schedule
+  derandomizes into **value-fault plans**
+  (:class:`~heat_tpu.robustness.faultinject.ValueFaultPlan`) instead of
+  exception plans — a seeded whole-suite silent-data-corruption storm in
+  one env var. Sites must come from
+  :data:`~heat_tpu.robustness.faultinject.VALUE_SITES`; the default is
+  :data:`DEFAULT_CORRUPT_SITES` (``fusion.execute`` / ``serving.cache_read``
+  / ``io.read`` — each behind an always-on or CI-enabled detector;
+  ``collective.dispatch`` is opt-in here too, since its checksum lane is an
+  env-gated defense). Each site's corruption *mode* (bitflip / signflip /
+  nan) derives deterministically from ``Random(f"{seed}:{site}:mode")``.
+  The ≤2-consecutive-fires cap and the per-call determinism carry over
+  unchanged; fired corruptions count ``robustness.chaos{site}`` on top of
+  ``faults.corrupted{site}``.
 
 Derandomization walks call indices ``1..HEAT_TPU_CHAOS_HORIZON`` (default
 4096) once per site and records the firing calls as an explicit ``at_calls``
@@ -62,8 +78,10 @@ from . import faultinject as _FI
 
 __all__ = [
     "DEFAULT_SITES",
+    "DEFAULT_CORRUPT_SITES",
     "MAX_CONSECUTIVE",
     "ChaosPlan",
+    "ChaosValuePlan",
     "parse",
     "schedule_for",
     "plans",
@@ -82,6 +100,17 @@ DEFAULT_SITES = (
     "fusion.execute",
     "serving.cache_read",
     "io.write",
+    "io.read",
+)
+
+#: Sites a default ``corrupt``-mode schedule perturbs: each one sits behind
+#: an integrity detector (the shadow-replay audit, the L2 sha256 footer, the
+#: checkpoint CRC manifest). ``collective.dispatch`` is opt-in — its
+#: checksum lane is the env-gated ``HEAT_TPU_COLLECTIVE_CHECKSUM`` defense,
+#: so a default storm must not corrupt dispatches nothing verifies.
+DEFAULT_CORRUPT_SITES = (
+    "fusion.execute",
+    "serving.cache_read",
     "io.read",
 )
 
@@ -119,14 +148,24 @@ class ChaosPlan(_FI.FaultPlan):
     is_chaos = True
 
 
-def parse(spec: str) -> Tuple[str, float, Tuple[str, ...]]:
-    """Validate a chaos spec into ``(seed, rate, sites)``. Malformed specs
-    raise :class:`~heat_tpu.robustness.faultinject.FaultPlanError` — a config
+class ChaosValuePlan(_FI.ValueFaultPlan):
+    """A derandomized ``corrupt``-mode chaos schedule for one site — a plain
+    :class:`~heat_tpu.robustness.faultinject.ValueFaultPlan` whose fires
+    additionally count ``robustness.chaos{site}``."""
+
+    is_chaos = True
+
+
+def parse(spec: str) -> Tuple[str, float, Tuple[str, ...], Optional[str]]:
+    """Validate a chaos spec into ``(seed, rate, sites, mode)`` — ``mode``
+    is None for the classic exception schedules or ``"corrupt"`` for a
+    value-fault storm. Malformed specs raise
+    :class:`~heat_tpu.robustness.faultinject.FaultPlanError` — a config
     error, never silently ignored."""
     parts = spec.strip().split(":")
-    if len(parts) not in (2, 3) or not parts[0]:
+    if len(parts) not in (2, 3, 4) or not parts[0]:
         raise _FI.FaultPlanError(
-            f"malformed {ENV_VAR} spec {spec!r} (expected seed:rate[:sites])"
+            f"malformed {ENV_VAR} spec {spec!r} (expected seed:rate[:sites[:mode]])"
         )
     seed = parts[0]
     try:
@@ -137,14 +176,25 @@ def parse(spec: str) -> Tuple[str, float, Tuple[str, ...]]:
         ) from None
     if not 0.0 <= rate <= 1.0:
         raise _FI.FaultPlanError(f"{ENV_VAR} rate must be in [0,1]: {spec!r}")
-    if len(parts) == 3 and parts[2].strip():
+    mode: Optional[str] = None
+    if len(parts) == 4:
+        mode = parts[3].strip().lower()
+        if mode != "corrupt":
+            raise _FI.FaultPlanError(
+                f"unknown {ENV_VAR} mode {parts[3]!r} in {spec!r} (expected 'corrupt')"
+            )
+    valid = _FI.VALUE_SITES if mode == "corrupt" else _FI.SITES
+    if len(parts) >= 3 and parts[2].strip():
         sites = tuple(s.strip() for s in parts[2].split(",") if s.strip())
         for s in sites:
-            if s not in _FI.SITES:
-                raise _FI.FaultPlanError(f"unknown chaos site {s!r} in {spec!r}")
+            if s not in valid:
+                raise _FI.FaultPlanError(
+                    f"unknown chaos site {s!r} in {spec!r}"
+                    + (" (corrupt mode requires a VALUE_SITES member)" if mode else "")
+                )
     else:
-        sites = DEFAULT_SITES
-    return seed, rate, sites
+        sites = DEFAULT_CORRUPT_SITES if mode == "corrupt" else DEFAULT_SITES
+    return seed, rate, sites, mode
 
 
 def schedule_for(seed: str, rate: float, site: str, horizon: Optional[int] = None) -> List[int]:
@@ -165,18 +215,24 @@ def schedule_for(seed: str, rate: float, site: str, horizon: Optional[int] = Non
     return at
 
 
-def plans(spec: str) -> Dict[str, List[ChaosPlan]]:
+def plans(spec: str) -> Dict[str, list]:
     """Derandomized per-site plans for a chaos spec (empty schedules are
-    dropped — a site the dice never hit installs nothing)."""
-    seed, rate, sites = parse(spec)
-    out: Dict[str, List[ChaosPlan]] = {}
+    dropped — a site the dice never hit installs nothing). Exception
+    schedules yield :class:`ChaosPlan` lists; ``corrupt``-mode schedules
+    yield :class:`ChaosValuePlan` lists whose per-site corruption mode
+    derives from ``Random(f"{seed}:{site}:mode")``."""
+    seed, rate, sites, mode = parse(spec)
+    out: Dict[str, list] = {}
     for site in sites:
         at = schedule_for(seed, rate, site)
         if not at:
             continue
-        exc_cls = _EXC_FOR.get(site, RuntimeError)
-        plan = ChaosPlan(site, exc_cls, at)
-        out[site] = [plan]
+        if mode == "corrupt":
+            cmode = random.Random(f"{seed}:{site}:mode").choice(_FI.CORRUPT_MODES)
+            out[site] = [ChaosValuePlan(site, cmode, at, seed=seed)]
+        else:
+            exc_cls = _EXC_FOR.get(site, RuntimeError)
+            out[site] = [ChaosPlan(site, exc_cls, at)]
     return out
 
 
@@ -184,7 +240,7 @@ class _Installed:
     """Handle over a programmatically installed chaos schedule (context
     manager; ``fired()`` aggregates the per-site audit trails)."""
 
-    def __init__(self, by_site: Dict[str, List[ChaosPlan]]):
+    def __init__(self, by_site: Dict[str, list]):
         self.by_site = by_site
 
     def fired(self) -> Dict[str, List[int]]:
@@ -216,16 +272,19 @@ def install(spec: str, reset_counts: bool = True) -> _Installed:
         if reset_counts:
             _FI.reset_counts(site)
         for p in ps:
-            _FI._PLANS.setdefault(site, []).append(p)
+            table = _FI._VPLANS if isinstance(p, _FI.ValueFaultPlan) else _FI._PLANS
+            table.setdefault(site, []).append(p)
     return _Installed(by_site)
 
 
 def clear() -> None:
-    """Remove every programmatically installed chaos plan (env-driven
-    schedules are controlled by the ``HEAT_TPU_CHAOS`` variable itself)."""
-    for site, ps in list(_FI._PLANS.items()):
-        kept = [p for p in ps if not getattr(p, "is_chaos", False)]
-        if kept:
-            _FI._PLANS[site] = kept
-        else:
-            del _FI._PLANS[site]
+    """Remove every programmatically installed chaos plan — exception and
+    corrupt-mode alike (env-driven schedules are controlled by the
+    ``HEAT_TPU_CHAOS`` variable itself)."""
+    for table in (_FI._PLANS, _FI._VPLANS):
+        for site, ps in list(table.items()):
+            kept = [p for p in ps if not getattr(p, "is_chaos", False)]
+            if kept:
+                table[site] = kept
+            else:
+                del table[site]

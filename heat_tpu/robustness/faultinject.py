@@ -59,6 +59,29 @@ call N and every call after it. An exception *message* may be attached as
 ``ExcName(message)`` — e.g. ``RuntimeError(RESOURCE_EXHAUSTED)`` exercises the
 fusion ladder's OOM classification.
 
+**Value-level fault plans** (ISSUE 12) are the second plan family: instead of
+raising where a site is consulted, :func:`corrupt` deterministically perturbs
+the site's *return value* — the silent-data-corruption adversary the
+integrity machinery (:mod:`heat_tpu.robustness.integrity`) must catch::
+
+    with faultinject.corrupt("fusion.execute", "bitflip", at_calls=[1]):
+        ...   # the first fused flush returns a corrupted root output
+
+Sites supporting value faults (:data:`VALUE_SITES`): ``fusion.execute``
+(perturbs a fused kernel's output — caught by the shadow-replay audit),
+``collective.dispatch`` (perturbs an eager collective shim's / halo
+exchange's result — caught by the checksum lane), ``serving.cache_read``
+(perturbs the raw L2 entry bytes — caught by the sha256 footer) and
+``io.read`` (perturbs a checkpoint leaf's bytes — caught by the CRC32
+manifest). Modes (:data:`CORRUPT_MODES`): ``bitflip`` flips the
+most-significant *exponent* bit of the dominant element (the
+worst-case-detectable single-event upset — see the residual-risk note in
+``doc/integrity_notes.md``), ``signflip`` flips the dominant element's sign
+bit, ``nan`` splats a NaN; ``bytes`` payloads flip one seeded bit. Fired
+corruptions count ``faults.corrupted{site}`` and keep their own per-site
+call counters, so exception plans and value plans never perturb each
+other's schedules.
+
 Zero cost when disabled: :func:`check` returns after one dict lookup and one
 ``os.environ`` read when no plan exists (the same per-dispatch env-read cost
 class as ``HEAT_TPU_FUSION``), and per-site call counters only tick while a
@@ -80,13 +103,19 @@ from ..monitoring.registry import STATE as _MON
 
 __all__ = [
     "SITES",
+    "VALUE_SITES",
+    "CORRUPT_MODES",
     "FaultPlan",
+    "ValueFaultPlan",
     "FaultPlanError",
     "inject",
+    "corrupt",
     "clear",
     "check",
+    "corrupt_value",
     "active",
     "call_count",
+    "value_call_count",
     "reset_counts",
 ]
 
@@ -120,6 +149,22 @@ SITES = (
     "distributed.peer",
 )
 
+#: Sites whose *return value* a :func:`corrupt` plan may perturb (ISSUE 12):
+#: each one sits in front of an integrity detector that must catch the
+#: corruption — the shadow-replay audit (fusion.execute), the collective
+#: checksum lane (collective.dispatch), the L2 sha256 footer
+#: (serving.cache_read) and the checkpoint CRC manifest (io.read).
+VALUE_SITES = (
+    "fusion.execute",
+    "collective.dispatch",
+    "serving.cache_read",
+    "io.read",
+)
+
+#: Deterministic corruption modes of a value-fault plan (array payloads;
+#: byte payloads always take the single-bit flip whatever the mode).
+CORRUPT_MODES = ("bitflip", "signflip", "nan")
+
 ENV_VAR = "HEAT_TPU_FAULT_PLAN"
 #: seeded multi-site chaos schedules (``robustness/chaos.py``) ride the same
 #: check() merge as programmatic/env plans — derandomized at parse time
@@ -129,10 +174,32 @@ CHAOS_ENV_VAR = "HEAT_TPU_CHAOS"
 _PLANS: dict = {}
 #: per-site call counters; tick only while a plan for the site is installed
 _COUNTS: dict = {}
+#: programmatic VALUE-fault plans and their own call counters (value plans
+#: never perturb exception-plan schedules, and vice versa)
+_VPLANS: dict = {}
+_VCOUNTS: dict = {}
 #: cached parse of the env plan, keyed on the exact env string
 _ENV_CACHE: tuple = ("", {})
 #: cached derandomized chaos plans, keyed on the exact HEAT_TPU_CHAOS string
 _CHAOS_CACHE: tuple = ("", {})
+
+
+def _norm_calls(at_calls):
+    """Normalized form of an ``at_calls`` schedule: ``"*"``, ``(n, "+")``,
+    or a frozenset of 1-based call indices (shared by both plan families)."""
+    if at_calls == "*":
+        return "*"
+    if isinstance(at_calls, tuple) and len(at_calls) == 2 and at_calls[1] == "+":
+        return (int(at_calls[0]), "+")
+    return frozenset(int(c) for c in at_calls)
+
+
+def _calls_match(at_calls, count: int) -> bool:
+    if at_calls == "*":
+        return True
+    if isinstance(at_calls, tuple):
+        return count >= at_calls[0]
+    return count in at_calls
 
 
 class FaultPlan:
@@ -153,24 +220,11 @@ class FaultPlan:
     def __init__(self, site: str, exc, at_calls):
         self.site = site
         self.exc = exc
-        if at_calls == "*":
-            self.at_calls = "*"
-        elif (
-            isinstance(at_calls, tuple)
-            and len(at_calls) == 2
-            and at_calls[1] == "+"
-        ):
-            self.at_calls = (int(at_calls[0]), "+")
-        else:
-            self.at_calls = frozenset(int(c) for c in at_calls)
+        self.at_calls = _norm_calls(at_calls)
         self.fired: list = []
 
     def matches(self, count: int) -> bool:
-        if self.at_calls == "*":
-            return True
-        if isinstance(self.at_calls, tuple):
-            return count >= self.at_calls[0]
-        return count in self.at_calls
+        return _calls_match(self.at_calls, count)
 
     def make(self, count: int) -> BaseException:
         if isinstance(self.exc, BaseException):
@@ -194,6 +248,159 @@ class FaultPlan:
 
     def __repr__(self) -> str:  # pragma: no cover — debugging aid
         return f"FaultPlan({self.site!r}, {self.exc!r}, at_calls={self.at_calls!r})"
+
+
+class ValueFaultPlan:
+    """One deterministic value-corruption plan for a site (ISSUE 12).
+
+    Where a :class:`FaultPlan` raises, a value plan *perturbs the site's
+    return value* — the silent-data-corruption adversary. ``mode`` is one of
+    :data:`CORRUPT_MODES`; ``seed`` plus the site, mode and call index fully
+    determine the perturbation (which element, which bit), so the same plan
+    always corrupts the same bytes. ``fired`` records the corrupted call
+    indices for fires-vs-detections assertions. Context manager like its
+    exception twin."""
+
+    __slots__ = ("site", "mode", "seed", "at_calls", "fired")
+    is_chaos = False
+
+    def __init__(self, site: str, mode: str = "bitflip", at_calls=(1,), seed=0):
+        if mode not in CORRUPT_MODES:
+            raise ValueError(f"unknown corruption mode {mode!r}; known: {CORRUPT_MODES}")
+        self.site = site
+        self.mode = mode
+        self.seed = seed
+        self.at_calls = _norm_calls(at_calls)
+        self.fired: list = []
+
+    def matches(self, count: int) -> bool:
+        return _calls_match(self.at_calls, count)
+
+    def apply(self, value, count: int):
+        import random
+
+        rng = random.Random(f"{self.seed}:{self.site}:{self.mode}:{count}")
+        return _perturb(value, self.mode, rng)
+
+    def remove(self) -> None:
+        """Uninstall this plan (idempotent)."""
+        plans = _VPLANS.get(self.site)
+        if plans and self in plans:
+            plans.remove(self)
+            if not plans:
+                del _VPLANS[self.site]
+
+    def __enter__(self) -> "ValueFaultPlan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.remove()
+        return False
+
+    def __repr__(self) -> str:  # pragma: no cover — debugging aid
+        return (
+            f"ValueFaultPlan({self.site!r}, {self.mode!r}, "
+            f"at_calls={self.at_calls!r}, seed={self.seed!r})"
+        )
+
+
+def _perturb(value, mode: str, rng):
+    """Deterministically corrupt ``value``: one seeded bit of a ``bytes``
+    payload, one element of an array payload (recursing into one element of
+    a tuple/list container). Unknown payload kinds are returned unchanged —
+    the injector must never crash the site it is corrupting."""
+    if isinstance(value, (bytes, bytearray)):
+        b = bytearray(value)
+        if not b:
+            return bytes(b)
+        b[rng.randrange(len(b))] ^= 1 << rng.randrange(8)
+        return bytes(b)
+    if isinstance(value, tuple):
+        if not value:
+            return value
+        i = rng.randrange(len(value))
+        return value[:i] + (_perturb(value[i], mode, rng),) + value[i + 1 :]
+    if isinstance(value, list):
+        if not value:
+            return value
+        out = list(value)
+        i = rng.randrange(len(out))
+        out[i] = _perturb(out[i], mode, rng)
+        return out
+    return _perturb_array(value, mode, rng)
+
+
+def _perturb_array(arr, mode: str, rng):
+    """Corrupt one element of an array payload, preserving dtype, shape and
+    (for jax arrays) sharding. Float arrays target the dominant (max-|x|)
+    element for ``bitflip``/``signflip`` so the upset always clears the
+    audit comparator's magnitude-scaled tolerance — the worst-case-
+    *detectable* SEU; see the residual-risk note in doc/integrity_notes.md."""
+    import numpy as np
+
+    try:
+        import jax
+        import jax.numpy as jnp
+    except ImportError:  # pragma: no cover — jax is a hard dep of the repo
+        return arr
+    a = np.array(np.asarray(arr))  # host copy, writable, dtype-preserving
+    if a.size == 0:
+        return arr
+    dt = a.dtype
+    flat = a.reshape(-1)
+    is_float = bool(jnp.issubdtype(dt, jnp.floating))
+    is_complex = bool(jnp.issubdtype(dt, jnp.complexfloating))
+    idx = rng.randrange(a.size)
+    if is_float and mode in ("bitflip", "signflip"):
+        mags = np.abs(flat.astype(np.float64))
+        mags[~np.isfinite(mags)] = -1.0
+        if float(mags.max()) >= 0.0:
+            idx = int(mags.argmax())
+    if mode == "nan" and (is_float or is_complex):
+        flat[idx] = dt.type(float("nan"))
+    elif dt == np.bool_:
+        flat[idx] = not flat[idx]
+    else:
+        # byte-level flip: sign bit (signflip) or the most-significant
+        # exponent/value bit (bitflip) of the element's MSB byte
+        msb = 0 if dt.byteorder == ">" else dt.itemsize - 1
+        bview = flat.view(np.uint8).reshape(a.size, dt.itemsize)
+        bit = 7 if (mode == "signflip" and (is_float or jnp.issubdtype(dt, jnp.signedinteger))) else 6
+        bview[idx, msb] ^= np.uint8(1 << bit)
+    if isinstance(arr, jax.Array):
+        out = jnp.asarray(a)
+        sh = getattr(arr, "sharding", None)
+        if sh is not None:
+            try:
+                out = jax.device_put(out, sh)
+            except Exception:  # pragma: no cover — exotic layouts
+                pass
+        return out
+    return a
+
+
+def corrupt(
+    site: str,
+    mode: str = "bitflip",
+    at_calls: Union[str, Iterable[int], tuple] = (1,),
+    seed=0,
+    reset_count: bool = True,
+) -> ValueFaultPlan:
+    """Install a deterministic **value-corruption** plan on ``site`` and
+    return it (the :func:`inject` twin for silent-data-corruption: the site
+    proceeds, but its return value comes back perturbed). ``at_calls``
+    schedules against the site's *value-plan* call counter (reset by default
+    so the schedule is relative to this installation). The returned plan is
+    a context manager."""
+    if site not in VALUE_SITES:
+        raise ValueError(
+            f"site {site!r} does not support value faults; value sites: {VALUE_SITES}"
+        )
+    plan = ValueFaultPlan(site, mode, at_calls, seed=seed)
+    if reset_count:
+        _VCOUNTS[site] = 0
+    _VPLANS.setdefault(site, []).append(plan)
+    return plan
 
 
 def inject(
@@ -220,15 +427,19 @@ def inject(
 
 
 def clear(site: Optional[str] = None) -> None:
-    """Remove programmatic fault plans (all sites, or one) and reset the
-    affected call counters. Env-driven plans are controlled by the
-    ``HEAT_TPU_FAULT_PLAN`` variable itself."""
+    """Remove programmatic fault plans — exception AND value families — (all
+    sites, or one) and reset the affected call counters. Env-driven plans
+    are controlled by the ``HEAT_TPU_FAULT_PLAN`` variable itself."""
     if site is None:
         _PLANS.clear()
         _COUNTS.clear()
+        _VPLANS.clear()
+        _VCOUNTS.clear()
     else:
         _PLANS.pop(site, None)
         _COUNTS.pop(site, None)
+        _VPLANS.pop(site, None)
+        _VCOUNTS.pop(site, None)
 
 
 def call_count(site: str) -> int:
@@ -236,18 +447,28 @@ def call_count(site: str) -> int:
     return _COUNTS.get(site, 0)
 
 
+def value_call_count(site: str) -> int:
+    """How many times ``site``'s return value was offered to an installed
+    value-fault plan (the value-plan family's own counter)."""
+    return _VCOUNTS.get(site, 0)
+
+
 def reset_counts(site: Optional[str] = None) -> None:
-    """Reset the per-site call counters (all sites, or one)."""
+    """Reset the per-site call counters of both plan families (all sites,
+    or one)."""
     if site is None:
         _COUNTS.clear()
+        _VCOUNTS.clear()
     else:
         _COUNTS.pop(site, None)
+        _VCOUNTS.pop(site, None)
 
 
 def active() -> bool:
     """Whether any fault plan (programmatic, env, or chaos) is installed."""
     return (
         bool(_PLANS)
+        or bool(_VPLANS)
         or bool(os.environ.get(ENV_VAR))
         or bool(os.environ.get(CHAOS_ENV_VAR))
     )
@@ -347,7 +568,13 @@ def check(site: str) -> None:
     if spec:
         merged.extend(_env_plans().get(site, ()))
     if chaos_spec:
-        merged.extend(_chaos_env_plans().get(site, ()))
+        # a corrupt-mode chaos schedule derandomizes into VALUE plans, which
+        # belong to corrupt_value()'s merge, never to this one
+        merged.extend(
+            p
+            for p in _chaos_env_plans().get(site, ())
+            if not isinstance(p, ValueFaultPlan)
+        )
     if not merged:
         return
     count = _COUNTS[site] = _COUNTS.get(site, 0) + 1
@@ -359,3 +586,34 @@ def check(site: str) -> None:
                 if getattr(plan, "is_chaos", False):
                     _instr.chaos_fire(site)
             raise plan.make(count)
+
+
+def corrupt_value(site: str, value):
+    """The hook value-fault-capable sites pass their return value through:
+    returns the (possibly perturbed) value. With no value plan installed for
+    ``site`` — programmatic or a corrupt-mode chaos schedule — this is one
+    dict lookup and one ``os.environ`` read, and the value-plan call counter
+    does not tick (the :func:`check` cost discipline)."""
+    plans = _VPLANS.get(site)
+    chaos_spec = os.environ.get(CHAOS_ENV_VAR)
+    if not plans and not chaos_spec:
+        return value
+    merged = list(plans) if plans else []
+    if chaos_spec:
+        merged.extend(
+            p
+            for p in _chaos_env_plans().get(site, ())
+            if isinstance(p, ValueFaultPlan)
+        )
+    if not merged:
+        return value
+    count = _VCOUNTS[site] = _VCOUNTS.get(site, 0) + 1
+    for plan in merged:
+        if plan.matches(count):
+            plan.fired.append(count)
+            if _MON.enabled:
+                _instr.fault_corrupted(site)
+                if getattr(plan, "is_chaos", False):
+                    _instr.chaos_fire(site)
+            return plan.apply(value, count)
+    return value

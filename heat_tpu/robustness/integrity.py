@@ -1,0 +1,226 @@
+"""
+Silent-data-corruption defense: the shadow-replay audit contract.
+
+Every robustness layer before this one turns failures into *exceptions* —
+the fused-flush recovery ladder, the circuit breakers, the elastic
+supervisor all assume a failure announces itself. This module (and its
+consumers across ``core/fusion.py``, ``core/communication.py``,
+``serving/cache.py`` and ``utils/checkpoint.py``) defends against the
+failure mode that does not: a silently wrong **value** — a bit flipped in a
+collective payload crossing the interconnect, an L2 cache entry that
+corrupted on disk but still deserializes, or SDC inside a fused kernel
+whose whole point is that nobody re-checks it.
+
+Threat model (full narrative in ``doc/integrity_notes.md``):
+
+* **Adversary.** The value-level fault plans of
+  :func:`heat_tpu.robustness.faultinject.corrupt` — seeded, deterministic
+  perturbations of a site's *return value* (exponent-bit flip, sign flip,
+  NaN splat) at ``fusion.execute``, ``collective.dispatch``,
+  ``serving.cache_read`` and ``io.read``. The injected upsets model the
+  *worst-case-detectable* single-event upset: they target the sign/exponent
+  of the dominant element, because a low-mantissa upset below the audit
+  tolerances is numerically indistinguishable from legal compilation
+  variation (FMA contraction, excess precision) — that is the documented
+  residual risk of any tolerance-based audit. Checksum-based detectors
+  (collective checksum lanes, the L2 sha256 footers, checkpoint CRCs) have
+  no tolerance and catch *any* flipped bit.
+* **Detector 1 — shadow-replay audit** (``HEAT_TPU_AUDIT_RATE=N``): every
+  Nth fused flush also runs the retained per-op eager replay — the recovery
+  ladder's rung-3 program, bit-parity with ``HEAT_TPU_FUSION=0`` by
+  construction — and compares outputs under the carve-out tolerances
+  below. A mismatch counts ``robustness.integrity{mismatch}``, poisons the
+  signature, evicts the L1 executable and quarantines the L2 entry; policy
+  ``HEAT_TPU_AUDIT_ACTION=raise`` raises :class:`IntegrityError`, the
+  default ``degrade`` serves the (trusted) eager value and the poisoned
+  signature routes every identical future chain permanently eager.
+* **Detector 2 — checksummed collectives**
+  (``HEAT_TPU_COLLECTIVE_CHECKSUM=1``, ``core/communication.py``): pure
+  data-movement collectives (ppermute / alltoall / allgather / shift /
+  halo — bitwise by contract) get a per-chunk CRC lane verified on
+  receipt; allreduce gets a reduced f64 local-sum invariant checked within
+  :func:`allreduce_sum_bound`. A mismatch raises :class:`IntegrityError`
+  (eager shims raise by design — there is no retained graph to degrade to).
+* **Detector 3 — content digests at rest**: sha256 footers on every L2
+  executable entry and corpus recipe (``serving/cache.py``/``corpus.py``),
+  CRC32 manifests on every checkpoint leaf (``utils/checkpoint.py``), and
+  the offline scrubber ``python -m heat_tpu.robustness.scrub`` revalidating
+  all of them out of band.
+
+Audit comparator (the tolerance contract the clean-run false-positive guard
+pins): exact dtypes (ints, bools) must match byte for byte; float dtypes
+are compared as ``|fused - eager| <= rtol * |eager| + rtol * (1 + max|eager|)``
+with ``equal_nan`` per matching positions, where ``rtol`` is the per-dtype
+carve-out headroom of :func:`tolerance_for` — sized for the documented
+fused-kernel numerics (FMA contraction bounded by one product rounding,
+adjacent-scalar-division merging, bf16 excess-precision elision;
+``doc/fusion_notes.md`` Numerics), a couple orders of magnitude below any
+exponent-class upset.
+
+All knobs default **off**: with ``HEAT_TPU_AUDIT_RATE`` and
+``HEAT_TPU_COLLECTIVE_CHECKSUM`` unset every hook in the hot paths is one
+``os.environ`` read (the ``HEAT_TPU_FUSION`` cost class) and behavior is
+bit-for-bit the pre-ISSUE-12 runtime.
+
+Counters (``robustness.integrity``): ``audit`` (shadow replays run),
+``mismatch`` (audit divergence), ``skip-donated`` (audit skipped — donated
+leaves were consumed by the fused kernel), ``collective-verified`` /
+``collective-mismatch`` (checksum lane outcomes), ``checkpoint-crc``
+(checkpoint leaf checksum mismatches raised at load),
+``scrub-scanned`` / ``scrub-corrupt`` / ``scrub-legacy`` (offline scrubber
+outcomes). Exported labelled via ``report.telemetry()`` as
+``robustness_integrity``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+from typing import List, Optional, Tuple
+
+__all__ = [
+    "IntegrityError",
+    "ENV_RATE",
+    "ENV_ACTION",
+    "audit_rate",
+    "audit_action",
+    "audit_due",
+    "tolerance_for",
+    "outputs_match",
+    "compare_outputs",
+    "allreduce_sum_bound",
+]
+
+ENV_RATE = "HEAT_TPU_AUDIT_RATE"
+ENV_ACTION = "HEAT_TPU_AUDIT_ACTION"
+
+
+class IntegrityError(RuntimeError):
+    """A value-integrity check failed: the shadow-replay audit found a fused
+    kernel's outputs diverging from the retained eager replay beyond the
+    documented carve-out tolerances, or a collective's checksum lane /
+    reduction invariant did not verify on receipt. Raised only when the
+    corresponding detector is enabled — never a silent wrong answer."""
+
+
+def audit_rate() -> Optional[int]:
+    """The configured shadow-replay sampling rate: audit every Nth fused
+    flush (``HEAT_TPU_AUDIT_RATE=N``; unset/empty/non-positive = off, the
+    default). Read per flush so tests and mid-process reconfiguration work
+    without restarts."""
+    raw = os.environ.get(ENV_RATE, "").strip()
+    if not raw:
+        return None
+    try:
+        n = int(raw)
+    except ValueError:
+        return None
+    return n if n > 0 else None
+
+
+def audit_action() -> str:
+    """Mismatch policy: ``"raise"`` raises :class:`IntegrityError` at the
+    materialization barrier (fail-stop deployments); anything else is the
+    default ``"degrade"`` — serve the trusted eager-replay value and let the
+    poisoned signature route every identical future chain permanently
+    eager (wrong answers are worse than errors, but the eager replay is not
+    a wrong answer: it is the ladder's rung-3 reference)."""
+    return "raise" if os.environ.get(ENV_ACTION, "").strip().lower() == "raise" else "degrade"
+
+
+#: Audit cadence counter — counts only *eligible* flushes while the audit is
+#: enabled (itertools.count is atomic under CPython, so concurrent scheduler
+#: flushes sample without a lock; exact interleaving under concurrency is
+#: not part of the contract, the RATE is).
+_audit_calls = itertools.count(1)
+
+
+def audit_due() -> bool:
+    """Whether this fused flush is the Nth one the auditor samples. One env
+    read and an immediate False when the audit is off (the cadence counter
+    does not advance while disabled)."""
+    n = audit_rate()
+    if n is None:
+        return False
+    return next(_audit_calls) % n == 0
+
+
+# ------------------------------------------------------------------ comparator
+def tolerance_for(dtype) -> Optional[float]:
+    """Per-dtype relative tolerance of the audit comparator, or None for
+    exact (bitwise) dtypes.
+
+    The float headroom covers the documented fused-vs-eager carve-outs —
+    f32 FMA contraction (bounded by one rounding of the contracted product),
+    the algebraic simplifier's adjacent-scalar-division merge (~1 ulp), and
+    the sub-32-bit excess-precision elision (~1-2 ulp of the narrow type) —
+    compounded across a bounded chain. It sits far below any exponent-class
+    corruption: an exponent-bit upset of the dominant element changes it by
+    at least its own magnitude.
+    """
+    import numpy as np
+    import jax.numpy as jnp
+
+    dt = np.dtype(dtype)
+    if not (jnp.issubdtype(dt, jnp.floating) or jnp.issubdtype(dt, jnp.complexfloating)):
+        return None  # exact dtype: bitwise comparison
+    # ml_dtypes extended floats report numpy kind 'V'; jnp.finfo handles all
+    eps = float(jnp.finfo(dt).eps)
+    if eps >= 1e-4:  # bf16 / f16 / f8 class
+        return 64.0 * eps
+    if eps >= 1e-8:  # f32
+        return 1e-5
+    return 1e-12  # f64
+
+
+def outputs_match(got, ref) -> bool:
+    """Whether one fused output matches its eager-replay reference under the
+    audit comparator: bitwise for exact dtypes, tolerance-bounded (with
+    ``equal_nan`` positions) for floats. Shape or dtype disagreement is
+    always a mismatch."""
+    import numpy as np
+
+    g = np.asarray(got)
+    r = np.asarray(ref)
+    if g.shape != r.shape or g.dtype != r.dtype:
+        return False
+    rtol = tolerance_for(g.dtype)
+    if rtol is None:
+        return g.tobytes() == r.tobytes()
+    g64 = np.asarray(g, dtype=np.complex128 if g.dtype.kind == "c" else np.float64)
+    r64 = np.asarray(r, dtype=g64.dtype)
+    if r64.size == 0:
+        return True
+    finite = np.isfinite(r64)
+    scale = float(np.max(np.abs(r64[finite]))) if finite.any() else 0.0
+    atol = rtol * (1.0 + scale)
+    return bool(
+        np.allclose(g64, r64, rtol=rtol, atol=atol, equal_nan=True)
+        # non-finite positions must agree exactly (inf sign included)
+        and np.array_equal(np.isfinite(g64), finite)
+    )
+
+
+def compare_outputs(values, refs) -> List[int]:
+    """Indices of fused outputs that fail the audit comparator against their
+    eager-replay references (empty list = the flush verified clean)."""
+    bad: List[int] = []
+    for i, (g, r) in enumerate(zip(values, refs)):
+        if not outputs_match(g, r):
+            bad.append(i)
+    if len(values) != len(refs):  # pragma: no cover — structural invariant
+        bad.append(min(len(values), len(refs)))
+    return bad
+
+
+def allreduce_sum_bound(abs_sum: float, dtype, size: int) -> float:
+    """Documented bound of the allreduce f64 local-sum invariant: the device
+    reduction and the host f64 re-reduction may associate the per-chunk sums
+    differently, so the scalar totals agree within a reassociation error of
+    ``16 * p * eps(input dtype) * (sum|x| + 1)`` — generous for any legal
+    summation order, orders of magnitude below a corrupted payload's
+    displacement of the total."""
+    import jax.numpy as jnp
+
+    eps = float(jnp.finfo(dtype).eps)
+    return 16.0 * float(size) * eps * (float(abs_sum) + 1.0)

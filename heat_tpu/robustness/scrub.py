@@ -1,0 +1,208 @@
+"""
+Integrity scrubber: offline revalidation of every content digest at rest.
+
+The read paths validate lazily — a checkpoint CRC fires at restore, an L2
+sha256 footer at the next cache read — which means corruption discovered
+*at* the moment of need costs exactly when the system can least afford it
+(a restore after a crash, a cold-start flush). The scrubber is the
+proactive counterpart (ISSUE 12): ``python -m heat_tpu.robustness.scrub``
+walks checkpoint directories and the persistent compilation cache + shape
+corpus out of band, revalidates every content digest, and **quarantines**
+what fails (the PR 9 janitor discipline: moved to ``<dir>/quarantine/``,
+never deleted — a poisoned artifact is evidence), so the lazy validators
+only ever see clean inventory.
+
+What one run scrubs:
+
+* **Checkpoints** (``--checkpoints DIR``, repeatable): every
+  ``ckpt_*.h5`` is run through
+  :func:`heat_tpu.utils.checkpoint.validate_checkpoint` (manifest parses,
+  every dataset present, every CRC32 matches). Failures move to
+  ``<dir>/quarantine/`` — ``restore_latest_valid`` already skips them, but
+  a quarantined corpse stops charging every restore the re-validation.
+* **L2 cache + corpus** (``--cache-dir DIR``, default
+  ``$HEAT_TPU_CACHE_DIR``): every ``exec/*.bin`` executable entry and
+  ``corpus/*.pkl`` recipe has its sha256 footer re-verified
+  (``serving/cache.py`` wire format). Mismatches quarantine via the
+  janitor path; pre-footer ("legacy") files that still unpickle are
+  counted and left in place (the read path treats them as incompatible —
+  they recompile and re-store footered).
+
+Exit codes: 0 = everything verified, 1 = corruption found (quarantined
+unless ``--dry-run``), 2 = usage error. Output is one JSON stats line
+(the janitor CLI idiom). Counted ``robustness.integrity{scrub-scanned,
+scrub-corrupt,scrub-legacy}``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional
+
+from ..monitoring import instrument as _instr
+from ..monitoring.registry import STATE as _MON
+
+__all__ = ["scrub_checkpoints", "scrub_cache", "main"]
+
+
+def _count(kind: str, n: int = 1) -> None:
+    if _MON.enabled and n:
+        _instr.integrity(kind)
+
+
+def _quarantine_into(root: str, path: str) -> bool:
+    """Move one poisoned file to ``<root>/quarantine/`` (atomic, tolerant of
+    a concurrent scrubber winning the race)."""
+    qdir = os.path.join(root, "quarantine")
+    try:
+        os.makedirs(qdir, exist_ok=True)
+        os.replace(path, os.path.join(qdir, os.path.basename(path)))
+        return True
+    except OSError:
+        return False
+
+
+def scrub_checkpoints(directory: str, dry_run: bool = False) -> dict:
+    """Revalidate every step-numbered checkpoint in ``directory``; corrupt
+    files are quarantined (unless ``dry_run``). Returns the stats dict."""
+    # deferred: utils.checkpoint pulls in the core package — the scrubber
+    # must stay importable from a half-initialized robustness package
+    from ..utils.checkpoint import CheckpointManager, validate_checkpoint
+
+    stats = {"dir": directory, "scanned": 0, "valid": 0, "corrupt": 0, "quarantined": 0}
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return stats
+    for name in names:
+        if not CheckpointManager._RE.match(name):
+            continue
+        path = os.path.join(directory, name)
+        stats["scanned"] += 1
+        _count("scrub-scanned")
+        if validate_checkpoint(path):
+            stats["valid"] += 1
+            continue
+        stats["corrupt"] += 1
+        _count("scrub-corrupt")
+        if not dry_run and _quarantine_into(directory, path):
+            stats["quarantined"] += 1
+    return stats
+
+
+def scrub_cache(cache_dir: str, dry_run: bool = False) -> dict:
+    """Re-verify the sha256 footer of every L2 executable entry and corpus
+    recipe under ``cache_dir``; mismatches (and unpicklable files) are
+    quarantined via the janitor path (unless ``dry_run``), legacy pre-footer
+    files that still unpickle are counted and left. Returns the stats dict."""
+    import pickle
+
+    from ..serving import cache as _cache
+    from ..serving import janitor as _janitor
+
+    stats = {
+        "dir": cache_dir,
+        "scanned": 0,
+        "valid": 0,
+        "corrupt": 0,
+        "legacy": 0,
+        "quarantined": 0,
+    }
+    for sub, suffix in (("exec", ".bin"), ("corpus", ".pkl")):
+        d = os.path.join(cache_dir, sub)
+        try:
+            names = sorted(os.listdir(d))
+        except OSError:
+            continue
+        for name in names:
+            if not name.endswith(suffix) or name.startswith(".tmp-"):
+                continue
+            path = os.path.join(d, name)
+            try:
+                with open(path, "rb") as f:
+                    blob = f.read()
+            except OSError:
+                continue  # vanished mid-scan (concurrent janitor/eviction)
+            stats["scanned"] += 1
+            _count("scrub-scanned")
+            body, verdict = _cache.split_footer(blob)
+            if verdict is True:
+                stats["valid"] += 1
+                continue
+            if verdict is None:
+                # pre-footer file: corrupt only if it no longer unpickles
+                try:
+                    if not isinstance(pickle.loads(body), dict):
+                        raise ValueError("not a dict")
+                    stats["legacy"] += 1
+                    _count("scrub-legacy")
+                    continue
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception:
+                    pass
+            stats["corrupt"] += 1
+            _count("scrub-corrupt")
+            if not dry_run and _janitor._quarantine(cache_dir, path):
+                stats["quarantined"] += 1
+    return stats
+
+
+def main(argv=None) -> int:
+    """CLI entry point (``python -m heat_tpu.robustness.scrub``)."""
+    p = argparse.ArgumentParser(
+        prog="python -m heat_tpu.robustness.scrub",
+        description="Offline integrity scrubber: revalidate checkpoint CRC "
+        "manifests and L2 cache/corpus sha256 footers, quarantining what "
+        "fails (exit 1 when corruption was found).",
+    )
+    p.add_argument(
+        "--checkpoints",
+        action="append",
+        default=[],
+        metavar="DIR",
+        help="checkpoint directory to scrub (repeatable)",
+    )
+    p.add_argument(
+        "--cache-dir",
+        default=None,
+        help="compilation-cache directory (default: $HEAT_TPU_CACHE_DIR)",
+    )
+    p.add_argument(
+        "--dry-run", action="store_true", help="report what would happen; touch nothing"
+    )
+    p.add_argument("-q", "--quiet", action="store_true", help="suppress the stats line")
+    args = p.parse_args(argv)
+
+    cache_dir: Optional[str] = args.cache_dir or os.environ.get(
+        "HEAT_TPU_CACHE_DIR", ""
+    ).strip() or None
+    if not args.checkpoints and not cache_dir:
+        print(
+            "scrub needs something to scrub: --checkpoints DIR and/or "
+            "--cache-dir DIR (or HEAT_TPU_CACHE_DIR)",
+            file=sys.stderr,
+        )
+        return 2
+
+    stats = {"checkpoints": [], "cache": None, "corrupt": 0, "quarantined": 0}
+    for d in args.checkpoints:
+        s = scrub_checkpoints(d, dry_run=args.dry_run)
+        stats["checkpoints"].append(s)
+        stats["corrupt"] += s["corrupt"]
+        stats["quarantined"] += s["quarantined"]
+    if cache_dir:
+        s = scrub_cache(cache_dir, dry_run=args.dry_run)
+        stats["cache"] = s
+        stats["corrupt"] += s["corrupt"]
+        stats["quarantined"] += s["quarantined"]
+    if not args.quiet:
+        print(json.dumps(stats, sort_keys=True))
+    return 1 if stats["corrupt"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

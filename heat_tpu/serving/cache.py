@@ -24,15 +24,31 @@ fingerprint-mismatched entry is *counted* (``serving.disk_cache{corrupt}`` /
 crash a flush. Writes are atomic (same-directory tempfile + ``os.replace``),
 so a process killed mid-write never leaves a truncated entry behind.
 
+Content integrity (ISSUE 12): every stored entry carries a **sha256
+footer** — ``body || b"HTPUSHA\\x01" || sha256(body)`` — validated before
+the body is unpickled, because a corrupted-but-still-deserializable entry
+is exactly the silent failure pickle cannot catch. A footer mismatch counts
+``serving.disk_cache{checksum}`` and quarantines the entry; a pre-footer
+("legacy") entry that still unpickles to a valid dict is treated as
+*incompatible* (recompiled and re-stored with a footer), never a crash.
+Reads also pass the raw bytes through the ``serving.cache_read``
+value-fault hook (:func:`faultinject.corrupt_value`) — the seeded SDC
+adversary the footer is proven against — and the shadow-replay auditor's
+:func:`evict` quarantines an entry whose executable produced a mismatching
+flush.
+
 Counters (``serving.disk_cache``): ``hit`` (entry deserialized and used),
 ``miss`` (no entry on disk), ``write`` (entry serialized and stored),
 ``incompatible`` (program has no stable identity, a leaf layout is not
-describable, the backend fingerprint changed, or serialization is
-unsupported), ``corrupt`` (an on-disk entry existed but could not be read —
-genuinely unreadable files are additionally *quarantined* via
-``serving/janitor.py``), ``breaker-open`` (the ``serving.cache_read``
-circuit breaker is open: the disk was not consulted and the flush serves
-in-memory-only until a half-open probe succeeds).
+describable, the backend fingerprint changed, serialization is
+unsupported, or a legacy pre-footer entry was found), ``corrupt`` (an
+on-disk entry existed but could not be read — genuinely unreadable files
+are additionally *quarantined* via ``serving/janitor.py``), ``checksum``
+(the sha256 footer did not verify — quarantined), ``audit-evict`` (the
+shadow-replay auditor quarantined the entry for its flush mismatch),
+``breaker-open`` (the ``serving.cache_read`` circuit breaker is open: the
+disk was not consulted and the flush serves in-memory-only until a
+half-open probe succeeds).
 """
 
 from __future__ import annotations
@@ -58,7 +74,10 @@ __all__ = [
     "load",
     "store",
     "persist",
+    "evict",
     "entry_path",
+    "with_footer",
+    "split_footer",
 ]
 
 #: On-disk entry format version: bumped whenever the pickled layout changes.
@@ -67,6 +86,29 @@ _FORMAT = 1
 #: Pickle protocol pinned for the *stored* entries (identity never depends on
 #: pickle bytes — digests go through the canonical serializer below).
 _PICKLE_PROTOCOL = 4
+
+#: Content-digest footer (ISSUE 12): every stored blob is
+#: ``body || _FOOTER_MAGIC || sha256(body)``. The magic is checked before
+#: the digest so legacy pre-footer entries are *distinguishable* from
+#: corruption (pickle ignores trailing bytes, so footered entries stay
+#: readable by tools that stream-unpickle, e.g. the janitor's validator).
+_FOOTER_MAGIC = b"HTPUSHA\x01"
+_FOOTER_LEN = len(_FOOTER_MAGIC) + 32
+
+
+def with_footer(body: bytes) -> bytes:
+    """Append the sha256 content footer to a serialized blob."""
+    return body + _FOOTER_MAGIC + hashlib.sha256(body).digest()
+
+
+def split_footer(blob: bytes):
+    """Split a stored blob into ``(body, verdict)``: verdict True = footer
+    present and verified, False = footer present but the digest mismatches
+    (corruption), None = no footer (a legacy pre-ISSUE-12 entry)."""
+    if len(blob) >= _FOOTER_LEN and blob[-_FOOTER_LEN:-32] == _FOOTER_MAGIC:
+        body = blob[:-_FOOTER_LEN]
+        return body, hashlib.sha256(body).digest() == blob[-32:]
+    return blob, None
 
 
 def enabled() -> bool:
@@ -255,7 +297,41 @@ def load(cache_dir_: str, digest: str):
         return None
     try:
         with open(path, "rb") as f:
-            entry = pickle.load(f)
+            blob = f.read()
+    except FileNotFoundError:
+        b.record_success()  # a clean miss (or a janitor eviction): not a fault
+        _count("miss")
+        return None
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        b.record_failure()
+        _count("corrupt")
+        return None
+    # value-level fault hook (ISSUE 12): the SDC adversary perturbs the raw
+    # bytes the process just read — the sha256 footer below must catch it
+    blob = _FI.corrupt_value("serving.cache_read", blob)
+    body, verdict = split_footer(blob)
+    if verdict is False:
+        # content digest mismatch: the entry corrupted at rest (or in the
+        # read path). Quarantine the on-disk file — it may itself be fine
+        # under an in-flight corruption, but a suspect executable must never
+        # be served again without revalidation (the scrubber's job).
+        b.record_failure()
+        _count("checksum")
+        _quarantine_entry(cache_dir_, path)
+        return None
+    try:
+        entry = pickle.loads(body)
+        if not isinstance(entry, dict):
+            raise ValueError("cache entry is not a dict")
+        if verdict is None:
+            # legacy pre-footer entry that still deserializes: treated as
+            # incompatible — recompile, and the re-store writes a footered
+            # entry over it. Never served, never a crash.
+            b.record_success()
+            _count("incompatible")
+            return None
         if entry.get("format") != _FORMAT or entry.get("fp") != fingerprint():
             b.record_success()  # the read mechanism worked; the entry is foreign
             _count("incompatible")
@@ -272,22 +348,45 @@ def load(cache_dir_: str, digest: str):
         except OSError:
             pass
         return loaded
-    except FileNotFoundError:
-        b.record_success()  # a clean miss (or a janitor eviction): not a fault
-        _count("miss")
-        return None
     except (KeyboardInterrupt, SystemExit):
         raise
     except Exception:
         b.record_failure()
         _count("corrupt")
-        try:
-            from . import janitor as _janitor
-
-            _janitor._quarantine(cache_dir_, path)
-        except Exception:
-            pass  # quarantine is best-effort; the fallback compile proceeds
+        _quarantine_entry(cache_dir_, path)
         return None
+
+
+def _quarantine_entry(cache_dir_: str, path: str) -> None:
+    """Best-effort quarantine of a poisoned on-disk file (the PR 9 janitor
+    path); the fallback compile proceeds regardless."""
+    try:
+        from . import janitor as _janitor
+
+        _janitor._quarantine(cache_dir_, path)
+    except Exception:
+        pass
+
+
+def evict(cache_dir_: str, digest: str) -> None:
+    """Quarantine the executable entry AND corpus recipe for ``digest`` —
+    the shadow-replay auditor's L2 eviction (ISSUE 12): an executable whose
+    flush failed the audit must never be deserialized by any process again
+    without offline revalidation (quarantine keeps the evidence; counted
+    ``serving.disk_cache{audit-evict}`` per file). Never raises."""
+    from . import corpus as _corpus
+
+    paths = [entry_path(cache_dir_, digest)]
+    cdir = _corpus.corpus_dir(cache_dir_)
+    if cdir:
+        paths.append(os.path.join(cdir, digest + ".pkl"))
+    for path in paths:
+        try:
+            if os.path.exists(path):
+                _quarantine_entry(cache_dir_, path)
+                _count("audit-evict")
+        except Exception:
+            pass
 
 
 def _atomic_write(path: str, blob: bytes) -> None:
@@ -317,15 +416,17 @@ def persist(cache_dir_: str, digest: str, compiled) -> bool:
         from jax.experimental.serialize_executable import serialize
 
         payload, in_tree, out_tree = serialize(compiled)
-        blob = pickle.dumps(
-            {
-                "format": _FORMAT,
-                "fp": fingerprint(),
-                "payload": payload,
-                "in_tree": in_tree,
-                "out_tree": out_tree,
-            },
-            protocol=_PICKLE_PROTOCOL,
+        blob = with_footer(
+            pickle.dumps(
+                {
+                    "format": _FORMAT,
+                    "fp": fingerprint(),
+                    "payload": payload,
+                    "in_tree": in_tree,
+                    "out_tree": out_tree,
+                },
+                protocol=_PICKLE_PROTOCOL,
+            )
         )
         _atomic_write(entry_path(cache_dir_, digest), blob)
         _count("write")

@@ -21,8 +21,17 @@ location (default ``$HEAT_TPU_CACHE_DIR/corpus``) or disables recording
 (``0``). Corrupt entries are skipped and counted, never raised
 (``serving.corpus{corrupt}``).
 
+Content integrity (ISSUE 12): every record carries the same sha256 footer
+as the L2 executable entries (``serving/cache.py``) — a bit-flipped recipe
+that still unpickles used to feed the warmup driver silently. A footer
+mismatch is skipped and counted ``serving.corpus{checksum}`` (the offline
+scrubber quarantines it); a pre-footer ("legacy") record that still
+unpickles is yielded as before, counted ``serving.corpus{legacy}``.
+
 Counters (``serving.corpus``): ``recorded``, ``full`` (bound hit — entry not
-recorded), ``corrupt`` (unreadable entry skipped during iteration).
+recorded), ``corrupt`` (unreadable entry skipped during iteration),
+``checksum`` (footer mismatch skipped), ``legacy`` (pre-footer record
+yielded unverified).
 """
 
 from __future__ import annotations
@@ -91,7 +100,9 @@ def record(cache_dir: str, digest: str, entry: dict) -> bool:
         _count("full")
         return False
     os.makedirs(d, exist_ok=True)
-    blob = pickle.dumps(entry, protocol=_PICKLE_PROTOCOL)
+    from . import cache as _cache
+
+    blob = _cache.with_footer(pickle.dumps(entry, protocol=_PICKLE_PROTOCOL))
     fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp-", suffix=".pkl")
     try:
         with os.fdopen(fd, "wb") as f:
@@ -111,7 +122,11 @@ def record(cache_dir: str, digest: str, entry: dict) -> bool:
 def entries(path: str) -> Iterator[Tuple[str, dict]]:
     """Iterate ``(digest, recipe)`` over a corpus directory, skipping (and
     counting) unreadable entries — a half-written or bit-flipped file can
-    never break a warmup run."""
+    never break a warmup run. Footered records (ISSUE 12) are sha256-
+    validated first: a digest mismatch is skipped (``checksum``), a
+    pre-footer record that still unpickles is yielded (``legacy``)."""
+    from . import cache as _cache
+
     try:
         names = sorted(n for n in os.listdir(path) if n.endswith(".pkl"))
     except OSError:
@@ -119,9 +134,16 @@ def entries(path: str) -> Iterator[Tuple[str, dict]]:
     for name in names:
         try:
             with open(os.path.join(path, name), "rb") as f:
-                entry = pickle.load(f)
+                blob = f.read()
+            body, verdict = _cache.split_footer(blob)
+            if verdict is False:
+                _count("checksum")
+                continue
+            entry = pickle.loads(body)
             if not isinstance(entry, dict):
                 raise ValueError("corpus entry is not a dict")
+            if verdict is None:
+                _count("legacy")
         except (KeyboardInterrupt, SystemExit):
             raise
         except Exception:

@@ -64,6 +64,7 @@ __all__ = [
     "validate_checkpoint",
     "CheckpointManager",
     "CheckpointCorruptError",
+    "main",
 ]
 
 _KIND_DND = "dndarray"
@@ -252,8 +253,13 @@ def load_checkpoint(
     import h5py
 
     def check(name, ent, raw):
+        # value-level fault hook (ISSUE 12): the SDC adversary perturbs the
+        # leaf bytes this read just produced — the CRC below must catch it
+        raw = _FI.corrupt_value("io.read", raw)
         crc = ent.get("crc32")
         if validate and crc is not None and _crc(raw) != crc:
+            if _MON.enabled:
+                _instr.integrity("checkpoint-crc")
             raise CheckpointCorruptError(
                 f"checkpoint {path!r}: checksum mismatch at leaf {name!r}"
             )
@@ -415,3 +421,58 @@ class CheckpointManager:
         state = load_checkpoint(self._path(step), target, **kw)
         self.last_restored_step = step
         return state
+
+
+def main(argv=None) -> int:
+    """CLI entry point (``python -m heat_tpu.utils.checkpoint``) — the
+    operator/cron counterpart of the janitor CLI (ISSUE 12 satellite).
+
+    ``validate <dir>`` walks the step-numbered checkpoints newest-first and
+    prints the newest step that passes :func:`validate_checkpoint` (the one
+    ``restore_latest_valid`` would choose): exit 0 with the chosen step on
+    stdout, exit 1 when no valid checkpoint exists (or the directory is
+    missing/empty), exit 2 on usage errors. Read-only — corrupt newer files
+    are reported to stderr, never touched (quarantining is the scrubber's
+    job: ``python -m heat_tpu.robustness.scrub``)."""
+    import argparse
+    import sys
+
+    p = argparse.ArgumentParser(
+        prog="python -m heat_tpu.utils.checkpoint",
+        description="Operator tools over step-numbered checkpoint directories.",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+    v = sub.add_parser(
+        "validate",
+        help="print the newest step whose checkpoint passes integrity "
+        "validation (exit 1 when none does)",
+    )
+    v.add_argument("directory", help="checkpoint directory (CheckpointManager layout)")
+    v.add_argument("-q", "--quiet", action="store_true", help="suppress stderr detail")
+    args = p.parse_args(argv)
+
+    try:
+        names = os.listdir(args.directory)
+    except OSError as e:
+        if not args.quiet:
+            print(f"checkpoint validate: cannot read {args.directory!r}: {e}", file=sys.stderr)
+        return 1
+    steps = sorted(
+        int(m.group(1)) for m in (CheckpointManager._RE.match(n) for n in names) if m
+    )
+    if not steps and not args.quiet:
+        print(f"checkpoint validate: no checkpoints in {args.directory!r}", file=sys.stderr)
+    for step in reversed(steps):
+        path = os.path.join(args.directory, CheckpointManager._FMT.format(step=step))
+        if validate_checkpoint(path):
+            print(step)
+            return 0
+        if not args.quiet:
+            print(f"checkpoint validate: step {step} FAILED validation: {path}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

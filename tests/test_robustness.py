@@ -65,6 +65,9 @@ def _clean(monkeypatch):
     monkeypatch.delenv("HEAT_TPU_CHAOS", raising=False)
     monkeypatch.delenv("HEAT_TPU_BREAKER_FORCE_OPEN", raising=False)
     monkeypatch.delenv("HEAT_TPU_IO_RETRY_BUDGET_MS", raising=False)
+    # ISSUE 12: the integrity-smoke legs' standing knobs change flush paths
+    monkeypatch.delenv("HEAT_TPU_AUDIT_RATE", raising=False)
+    monkeypatch.delenv("HEAT_TPU_COLLECTIVE_CHECKSUM", raising=False)
     breaker.reset()
     # keep the deterministic backoff schedule but don't spend wall time on it
     monkeypatch.setenv("HEAT_TPU_IO_RETRY_DELAY", "0.001")
@@ -800,11 +803,21 @@ def _tmp_csv_dir():
 
 # ------------------------------------------------------------------ chaos harness
 def test_chaos_spec_parsing_and_validation():
-    seed, rate, sites = chaos.parse("1234:0.25")
+    seed, rate, sites, mode = chaos.parse("1234:0.25")
     assert seed == "1234" and rate == 0.25 and sites == chaos.DEFAULT_SITES
-    _s, _r, sites = chaos.parse("x:0.5:io.write,fusion.compile")
+    assert mode is None
+    _s, _r, sites, _m = chaos.parse("x:0.5:io.write,fusion.compile")
     assert sites == ("io.write", "fusion.compile")
-    for bad in ("", "nocolon", "s:notafloat", "s:1.5", "s:0.1:bogus.site"):
+    # the 4th field (ISSUE 12) selects the value-fault storm mode
+    _s, _r, sites, mode = chaos.parse("x:0.5::corrupt")
+    assert mode == "corrupt" and sites == chaos.DEFAULT_CORRUPT_SITES
+    _s, _r, sites, mode = chaos.parse("x:0.5:fusion.execute:corrupt")
+    assert sites == ("fusion.execute",)
+    for bad in (
+        "", "nocolon", "s:notafloat", "s:1.5", "s:0.1:bogus.site",
+        "s:0.1::notamode",
+        "s:0.1:io.write:corrupt",  # io.write is not a VALUE_SITES member
+    ):
         with pytest.raises(faultinject.FaultPlanError):
             chaos.parse(bad)
 

@@ -90,6 +90,10 @@ def no_faults(monkeypatch):
     monkeypatch.delenv("HEAT_TPU_FAULT_PLAN", raising=False)
     monkeypatch.delenv("HEAT_TPU_CHAOS", raising=False)
     monkeypatch.delenv("HEAT_TPU_BREAKER_FORCE_OPEN", raising=False)
+    # ISSUE 12: the standing audit/corruption legs change compile counts and
+    # disk-cache traffic (eager-replay jits, checksum fallbacks)
+    monkeypatch.delenv("HEAT_TPU_AUDIT_RATE", raising=False)
+    monkeypatch.delenv("HEAT_TPU_COLLECTIVE_CHECKSUM", raising=False)
     faultinject.clear()
     breaker.reset()
     fusion.clear_cache()
