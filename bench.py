@@ -716,6 +716,8 @@ def main():
                     "ragged_reduce_valid": None,
                     "audit_overhead_pct": None,
                     "audit_overhead_valid": None,
+                    "flight_overhead_pct": None,
+                    "flight_overhead_valid": None,
                     "elementwise_error": repr(e)[:160],
                 }
         # GEMM-producer epilogue anchors (ISSUE 5): act(x@w+b) through the

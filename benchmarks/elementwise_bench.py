@@ -31,6 +31,11 @@ paired-differencing and physics gating as every other bench surface
   audit (``HEAT_TPU_AUDIT_RATE``) at rate 1 and rate 8 vs audit-off, paired
   same-process over the 8-op chain; ``audit_overhead_valid`` additionally
   requires ZERO mismatches on the clean data (see ``bench_audit_overhead``).
+* ``flight_overhead_pct`` (ISSUE 13) — wall-clock tax of the execution
+  flight recorder (``HEAT_TPU_FLIGHT=1``: one ring append + one signature
+  digest per flush) vs recorder-off, paired same-process over the same
+  chain; ``flight_overhead_valid`` additionally requires that records
+  actually landed during the on-leg (see ``bench_flight_overhead``).
 * ``fused_view_chain_gbps`` (ISSUE 5) — an 8-op f32 chain with a mid-chain
   transpose + basic row-slice (half the rows), executed through the view-node
   path: ONE kernel reading N·4 bytes and writing (N/2)·4 — the single-read
@@ -410,6 +415,72 @@ def bench_audit_overhead(ht, rng):
     return out
 
 
+N_FLIGHT = 1024 * 1024  # 4 MB f32: flush-heavy enough that the ring tax shows
+
+
+def bench_flight_overhead(ht, rng):
+    """``flight_overhead_pct`` anchor (ISSUE 13): wall-clock tax of the
+    execution flight recorder (``HEAT_TPU_FLIGHT=1`` — one ring append +
+    one signature digest per flush) vs recorder-off, paired in the same
+    process over the same 8-op chain. ``flight_overhead_valid`` gates on
+    sample spread AND on records actually landing during the on-leg (an
+    anchor that silently measured a disarmed recorder would report zero).
+    The recorder is a pure observer, so both legs compute identical values
+    — only the bookkeeping differs."""
+    import time
+
+    from heat_tpu.monitoring import flight as _flight
+
+    out = {}
+    prev = os.environ.get("HEAT_TPU_FLIGHT")
+    base = ht.array(rng.random(N_FLIGHT, dtype=np.float32))
+    base.parray  # noqa: B018
+
+    def leg(on, trials=7, steps=8):
+        if on:
+            os.environ["HEAT_TPU_FLIGHT"] = "1"
+        else:
+            os.environ.pop("HEAT_TPU_FLIGHT", None)
+
+        def one():
+            x = base
+            for _ in range(steps):
+                x = _chain(ht, x)
+                x.parray  # noqa: B018 — flush barrier (each flush recorded)
+            np.asarray(x.larray)
+
+        one()  # compile + warm
+        one()  # second warm pass: ring allocation/digest caches settle
+        ts = []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            one()
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts)), _spread_pct([1.0 / t for t in ts])
+
+    try:
+        _flight.clear()
+        t_off, sp_off = leg(False)
+        t_on, sp_on = leg(True)
+        recorded = len(_flight.records("flush"))
+        out["flight_overhead_pct"] = round(100.0 * (t_on / t_off - 1.0), 1)
+        out["flight_records"] = int(recorded)
+        out["flight_overhead_valid"] = bool(
+            recorded > 0 and sp_off < 25.0 and sp_on < 25.0
+        )
+    except Exception as e:  # pragma: no cover — anchor crash stays visible
+        out["flight_overhead_pct"] = None
+        out["flight_overhead_valid"] = None
+        out["flight_overhead_error"] = repr(e)[:160]
+    finally:
+        if prev is None:
+            os.environ.pop("HEAT_TPU_FLIGHT", None)
+        else:
+            os.environ["HEAT_TPU_FLIGHT"] = prev
+        _flight.clear()
+    return out
+
+
 def bench_elementwise():
     import jax
 
@@ -454,6 +525,7 @@ def bench_elementwise():
         out.update(bench_fused_view_chain(ht, roofline, rng))
         out.update(bench_ragged_reduce(ht, rng))
         out.update(bench_audit_overhead(ht, rng))
+        out.update(bench_flight_overhead(ht, rng))
 
         small = ht.array(rng.random(N_SMALL, dtype=np.float32))
         df_rate, df_jit, df_tot, df_disc = _rate(

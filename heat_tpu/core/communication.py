@@ -27,6 +27,7 @@ from ._compat import shard_map as _shard_map
 
 # observability: disabled-path cost is one truthiness check (see monitoring/)
 from ..monitoring.registry import STATE as _MON
+from ..monitoring import flight as _flight
 from ..monitoring import instrument as _instr
 from ..robustness import faultinject as _FI
 
@@ -516,6 +517,13 @@ class MeshCommunication(Communication):
             # design: there is no retained graph to degrade to). Off, the
             # wrapper costs one dict lookup + one env read per dispatch.
             fn = _integrity_wrapped(self, fn, kind, split, op, kw)
+        if _flight.flight_enabled():
+            # flight recorder (ISSUE 13): one record per EAGER collective
+            # dispatch, timed around the whole wrapped call (watchdog +
+            # checksum lane included) — collectives recorded inside fused
+            # flushes are part of their flush record instead. Outermost by
+            # design; off = the one env read above.
+            fn = _flight_wrapped(fn, kind, op)
         return fn
 
     def __prep(self, x, split: int):
@@ -808,6 +816,23 @@ def _collective_timeout_ms() -> Optional[float]:
     except ValueError:
         return None
     return ms if ms > 0 else None
+
+
+def _flight_wrapped(fn, kind: str, op: str):
+    """Flight-record one eager collective dispatch (ISSUE 13): kind, op and
+    dispatch wall time (the host-side call — jax dispatch is async, so the
+    device transfer overlaps unless the watchdog's ``block_until_ready`` is
+    armed). A pure observation — the dispatched value is returned as-is."""
+
+    def recorded(*args):
+        t0 = _time.perf_counter()
+        out = fn(*args)
+        _flight.record_collective(
+            kind, _time.perf_counter() - t0, op=op or None
+        )
+        return out
+
+    return recorded
 
 
 def _watched(fn, kind: str, deadline_ms: float):

@@ -180,6 +180,7 @@ import functools
 import os
 import sys
 import threading
+import time
 import weakref
 from typing import Optional, Tuple
 
@@ -188,6 +189,7 @@ import jax
 import jax.numpy as jnp
 
 from ..monitoring.registry import STATE as _MON
+from ..monitoring import flight as _FL
 from ..monitoring import instrument as _instr
 from ..robustness import breaker as _BRK
 from ..robustness import faultinject as _FI
@@ -2285,7 +2287,7 @@ def _audit_flush(values, program, leaf_arrays, out_idx, donate, key, stable_prog
 
 def _flush_ladder(
     fused, program, leaf_arrays, out_idx, donate, compiled, key,
-    has_coll=False, debucket=None, has_pallas=False,
+    has_coll=False, debucket=None, has_pallas=False, note=None, compile_t0=None,
 ):
     """Execute a fused flush with graceful degradation.
 
@@ -2314,7 +2316,14 @@ def _flush_ladder(
     after consuming its donated buffers — possible on TPU/GPU only — the
     retained leaves are gone and the rung-2/3 replays surface that error
     instead; donation requires owner-death, so no user-visible array is ever
-    lost."""
+    lost.
+
+    Observability (ISSUE 13): ``note`` (a dict, only when the flight
+    recorder is armed) receives ``rung`` — which rung produced the values —
+    and ``failures`` — the failure classes of the rungs that did not;
+    ``compile_t0`` (a ``perf_counter`` stamp, only when this flush built a
+    fresh in-memory kernel whose first dispatch pays the XLA compile) feeds
+    the ``fusion.compile_latency`` histogram on rung-1 success."""
     try:
         if compiled:
             _FI.check("fusion.compile")
@@ -2336,6 +2345,12 @@ def _flush_ladder(
         # recovery rungs below replay the retained program per-op and are
         # deliberately never corrupted: they are the trusted reference.
         values = _FI.corrupt_value("fusion.execute", values)
+        if compile_t0 is not None and _MON.enabled:
+            # in-memory compile path: the first dispatch of the fresh jit
+            # wrapper just paid trace + XLA compile (+ a negligible execute)
+            _instr.fusion_compile_latency(time.perf_counter() - compile_t0)
+        if note is not None:
+            note["rung"] = "fused"
         if compiled:
             _BRK.breaker("fusion.compile").record_success()
         if has_coll:
@@ -2347,6 +2362,8 @@ def _flush_ladder(
         cls = _classify_failure(e, compiled)
         if _MON.enabled:
             _instr.fusion_flush_failure(cls)
+        if note is not None:
+            note.setdefault("failures", []).append(cls)
         if compiled:
             _BRK.breaker("fusion.compile").record_failure()
         if has_coll:
@@ -2368,11 +2385,16 @@ def _flush_ladder(
                     _FI.check("collective.dispatch")
                 with _PL.recovery_mode():
                     values = debucket()
+                if note is not None:
+                    note["rung"] = "oom-debucket"
             except (KeyboardInterrupt, SystemExit):
                 raise
             except Exception as e1:
+                cls1 = _classify_failure(e1, True)
                 if _MON.enabled:
-                    _instr.fusion_flush_failure(_classify_failure(e1, True))
+                    _instr.fusion_flush_failure(cls1)
+                if note is not None:
+                    note.setdefault("failures", []).append(cls1)
         if values is None and donate:
             try:
                 _FI.check("fusion.compile")  # rung 2 always builds fresh
@@ -2381,15 +2403,22 @@ def _flush_ladder(
                     _FI.check("collective.dispatch")
                 with _PL.recovery_mode():
                     values = jax.jit(_replay_fn(program, out_idx))(*leaf_arrays)
+                if note is not None:
+                    note["rung"] = "donation-off"
             except (KeyboardInterrupt, SystemExit):
                 raise
             except Exception as e2:
+                cls2 = _classify_failure(e2, compiled)
                 if _MON.enabled:
-                    _instr.fusion_flush_failure(_classify_failure(e2, compiled))
+                    _instr.fusion_flush_failure(cls2)
+                if note is not None:
+                    note.setdefault("failures", []).append(cls2)
         if values is None:
             with _PL.recovery_mode():
                 values = _eager_replay(program, leaf_arrays, out_idx)
             _poison(key)
+            if note is not None:
+                note["rung"] = "eager-replay"
         if _MON.enabled:
             _instr.fusion_flush_recovered()
         return values
@@ -2496,6 +2525,15 @@ def materialize_for(d: DNDarray):
         leaf_arrays, leaf_owners, internal_rc,
     ) = _build_flush(root)
 
+    # ---- observability: execution flight recorder (ISSUE 13). Armed by
+    # HEAT_TPU_FLIGHT=1; off (the default) this is ONE env read per flush —
+    # no note dict, no timing stamps, no ring allocation. Recording is a
+    # pure observation: nothing below branches on flight_on except the
+    # bookkeeping itself, so results are bit-identical either way.
+    flight_on = _FL.flight_enabled()
+    t_flush0 = time.perf_counter() if flight_on else 0.0
+    note: Optional[dict] = {} if flight_on else None
+
     # Recorded collectives in the program (excluding the pure-slice halo
     # views): they gate the dispatch-site fault check, the comm.collective
     # accounting, and the widened multi-output rule below.
@@ -2593,6 +2631,11 @@ def materialize_for(d: DNDarray):
             orig_leaves = leaf_arrays
             leaf_arrays, bucket_slicer = bplan
             donate = ()  # the padded copies are fresh private temporaries
+            if note is not None:
+                note["pad_waste"] = int(
+                    sum(int(getattr(a, "nbytes", 0)) for a in leaf_arrays)
+                    - sum(int(getattr(a, "nbytes", 0)) for a in orig_leaves)
+                )
 
             def debucket(_orig=orig_leaves, _bkey=bkey):
                 # the ladder's oom-bucketed rung: run the exact-shape kernel
@@ -2621,6 +2664,7 @@ def materialize_for(d: DNDarray):
             if k in ("ppermute", "alltoall"):
                 _instr.collective(k)
 
+    digest = None  # the flight record reads it whichever branch runs
     poisoned = key is not None and key in _POISONED
     breaker_eager = False
     if not poisoned:
@@ -2647,6 +2691,10 @@ def materialize_for(d: DNDarray):
             _instr.fusion_flush(
                 len(topo), cache_hit=False, compiled=False, reason=_reason_stack()[-1]
             )
+        if note is not None:
+            note["cache"] = "eager"
+            note["rung"] = "eager-replay"
+            note["poisoned"] = bool(poisoned)
         with _PL.recovery_mode():
             values = _eager_replay(program, leaf_arrays, out_idx)
     else:
@@ -2680,7 +2728,13 @@ def materialize_for(d: DNDarray):
             # a disk-served executable satisfies the compile-class operation
             # (incl. a half-open probe) even though no XLA compile ran
             _BRK.breaker("fusion.compile").record_success()
+            if flight_on:
+                # a zero-compile process keeps attribution: the compiling
+                # process persisted a cost card beside the L2 entry
+                _FL.load_cost_card(cache_dir, digest)
+        compile_t0 = None
         if fused is None:
+            compile_t0 = time.perf_counter()
             fused = jax.jit(_replay_fn(program, out_idx), donate_argnums=donate)
             if digest is not None:
                 # AOT-compile now so the executable is serializable; on
@@ -2693,6 +2747,13 @@ def materialize_for(d: DNDarray):
                 )
                 if aot is not None:
                     fused = aot
+                    if _MON.enabled:
+                        # the AOT path paid the XLA compile inside store();
+                        # the ladder's rung-1 dispatch is then execute-only
+                        _instr.fusion_compile_latency(
+                            time.perf_counter() - compile_t0
+                        )
+                    compile_t0 = None
         if key is not None:
             if compiled or from_disk:
                 _TRACE_CACHE[key] = fused
@@ -2720,9 +2781,13 @@ def materialize_for(d: DNDarray):
                 reason=_reason_stack()[-1],
             )
 
+        if note is not None:
+            note["cache"] = "l2" if from_disk else ("compile" if compiled else "l1")
+
         values = _flush_ladder(
             fused, program, leaf_arrays, out_idx, donate, compiled, key,
             has_coll=bool(coll_kinds), debucket=debucket, has_pallas=has_pallas,
+            note=note, compile_t0=compile_t0,
         )
 
         # ---- integrity: shadow-replay audit (ISSUE 12). Every Nth fused
@@ -2730,9 +2795,15 @@ def materialize_for(d: DNDarray):
         # off (the default) this is one os.environ read. The poisoned /
         # breaker-eager branch above IS the eager replay — nothing to audit.
         if _INTEG.audit_due():
-            values = _audit_flush(
+            audited = _audit_flush(
                 values, program, leaf_arrays, out_idx, donate, key, stable_prog
             )
+            if note is not None:
+                note["audit"] = (
+                    "skip-donated" if donate
+                    else ("clean" if audited is values else "mismatch")
+                )
+            values = audited
 
     if bucket_slicer is not None:
         # restore the logical view from the bucket-padded root output (the
@@ -2754,6 +2825,41 @@ def materialize_for(d: DNDarray):
             ):
                 value = comm.placed(value, split, owner.shape)
         n.value = value
+
+    if flight_on:
+        # one structured record per flush. The signature is the L2 digest
+        # when the flush computed one; otherwise it is derived here (same
+        # canonical serialization, so in-memory and disk-served flushes of
+        # one program share a signature); unstable programs (collective
+        # nodes close over mesh objects) fall back to the in-process L1 key
+        # hash, unhashable shardings to "unkeyed".
+        sig = digest
+        if sig is None and stable_prog is not None:
+            from ..serving import cache as _svc
+
+            sig = _svc.digest_for(stable_prog, leaf_arrays, donate, out_idx)
+        if sig is None:
+            sig = (
+                "mem:%016x" % (hash(key) & 0xFFFFFFFFFFFFFFFF)
+                if key is not None
+                else "unkeyed"
+            )
+        kinds: dict = {}
+        for n in topo:
+            k = str(n.op_key[0]) if isinstance(n.op_key, tuple) and n.op_key else "other"
+            kinds[k] = kinds.get(k, 0) + 1
+        _FL.record_flush(
+            sig,
+            time.perf_counter() - t_flush0,
+            reason=_reason_stack()[-1],
+            chain=len(topo),
+            kinds=kinds,
+            outputs=len(out_idx),
+            leaves=len(leaf_arrays),
+            donate=list(donate),
+            collectives=list(coll_kinds) or None,
+            **note,
+        )
     return root.value
 
 

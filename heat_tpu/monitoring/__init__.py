@@ -32,16 +32,23 @@ Modules:
   call (op dispatches, dtype fallbacks, reshardings, collectives, jit
   compile-cache misses, device memory, IO volume, step throughput);
 * :mod:`~heat_tpu.monitoring.report` — human-readable tables and the compact
-  ``telemetry`` block ``bench.py`` embeds in its output line.
+  ``telemetry`` block ``bench.py`` embeds in its output line;
+* :mod:`~heat_tpu.monitoring.flight` — the execution flight recorder
+  (``HEAT_TPU_FLIGHT=1``): a bounded ring of per-flush records with XLA cost
+  attribution, Chrome-trace/Perfetto export
+  (:func:`~heat_tpu.monitoring.flight.export_chrome_trace`), and the
+  ``python -m heat_tpu.monitoring.flight dump|trace|statusz`` CLI.
 """
 
 from __future__ import annotations
 
 from . import registry
 from . import events
+from . import flight
 from . import instrument
 from . import report
 
+from .flight import export_chrome_trace, statusz
 from .registry import (
     Counter,
     Gauge,
@@ -67,11 +74,14 @@ __all__ = [
     "enable",
     "enabled",
     "event",
+    "export_chrome_trace",
     "export_jsonl",
+    "flight",
     "render",
     "reset",
     "snapshot",
     "span",
+    "statusz",
     "telemetry",
 ]
 
@@ -88,7 +98,8 @@ def snapshot() -> dict:
 
 
 def reset() -> None:
-    """Clear all metrics and recorded events (test isolation / between
-    benchmark phases)."""
+    """Clear all metrics, recorded events, and flight records (test
+    isolation / between benchmark phases)."""
     registry.reset()
     events.clear()
+    flight.clear()

@@ -6,7 +6,19 @@ records: a :func:`span` context manager captures wall time (and, via
 :meth:`_Span.mark`, optional device-time marks that ``jax.block_until_ready``
 a value before stamping), nesting (parent/depth via a thread-local stack) and
 arbitrary attributes. Records are held in memory and exportable as JSON lines
-(:func:`export_jsonl`) — the shape every log shipper ingests.
+(:func:`export_jsonl`) — the shape every log shipper ingests — or as
+Chrome-trace/Perfetto JSON via
+:func:`heat_tpu.monitoring.flight.export_chrome_trace`.
+
+Threading contract (ISSUE 13 satellite): the span stack is **per-thread**
+(a ``threading.local``), so concurrent async flushes on
+``FlushScheduler`` worker threads can never corrupt each other's nesting,
+and every record is tagged with the OS thread id (``tid``) so export
+consumers can reconstruct per-thread timelines. Cross-thread nesting is
+explicit: a caller that hands work to another thread captures
+:func:`current_span_name` on the submitting thread and passes it as
+``span(..., parent=...)`` on the worker — the serving scheduler does
+exactly this, so a flush's span nests under the request that scheduled it.
 
 Disabled mode (``registry.STATE.enabled`` False) returns a shared no-op span
 object and records nothing — callers need no branching of their own, though
@@ -23,7 +35,16 @@ from typing import Any, Dict, List, Optional
 
 from .registry import STATE
 
-__all__ = ["span", "event", "record", "records", "export_jsonl", "clear", "dropped"]
+__all__ = [
+    "span",
+    "event",
+    "record",
+    "records",
+    "current_span_name",
+    "export_jsonl",
+    "clear",
+    "dropped",
+]
 
 #: Bound on resident records; overflow is counted, not stored (a long training
 #: run with per-step spans must not grow memory without bound).
@@ -74,17 +95,26 @@ _NULL = _NullSpan()
 
 
 class _Span:
-    __slots__ = ("name", "attrs", "marks", "t0", "t0_wall", "depth", "parent", "wall_s")
+    __slots__ = (
+        "name", "attrs", "marks", "t0", "t0_wall", "depth", "parent",
+        "wall_s", "_parent_override",
+    )
 
-    def __init__(self, name: str, attrs: Dict[str, Any]):
+    def __init__(self, name: str, attrs: Dict[str, Any], parent: Optional[str] = None):
         self.name = name
         self.attrs = attrs
         self.marks: List[dict] = []
         self.wall_s = 0.0
+        self._parent_override = parent
 
     def __enter__(self):
         st = _stack()
-        self.parent = st[-1].name if st else None
+        if self._parent_override is not None:
+            # cross-thread nesting: the submitting thread's span, captured by
+            # the caller via current_span_name() and handed across explicitly
+            self.parent = self._parent_override
+        else:
+            self.parent = st[-1].name if st else None
         self.depth = len(st)
         st.append(self)
         self.t0_wall = time.time()
@@ -103,6 +133,7 @@ class _Span:
             "wall_s": self.wall_s,
             "depth": self.depth,
             "parent": self.parent,
+            "tid": threading.get_ident(),
         }
         if exc_type is not None:
             rec["error"] = exc_type.__name__
@@ -130,8 +161,13 @@ class _Span:
         return self
 
 
-def span(name: str, **attrs):
+def span(name: str, parent: Optional[str] = None, **attrs):
     """Context manager recording a named span with wall time and attributes.
+
+    ``parent`` overrides the nesting parent (normally the enclosing span on
+    *this* thread) — the cross-thread propagation hook: capture
+    :func:`current_span_name` on the submitting thread, pass it here on the
+    worker, and the worker's span nests under the submitter's.
 
     >>> with span("kmeans.step", iteration=3) as sp:
     ...     shift = step(...)
@@ -139,7 +175,14 @@ def span(name: str, **attrs):
     """
     if not STATE.enabled:
         return _NULL
-    return _Span(name, attrs)
+    return _Span(name, attrs, parent=parent)
+
+
+def current_span_name() -> Optional[str]:
+    """Name of the innermost open span on this thread (None outside any
+    span) — what a scheduler captures before handing work to a worker."""
+    st = _stack()
+    return st[-1].name if st else None
 
 
 def event(name: str, **attrs) -> None:
@@ -153,6 +196,7 @@ def event(name: str, **attrs) -> None:
         "t_start": time.time(),
         "depth": len(st),
         "parent": st[-1].name if st else None,
+        "tid": threading.get_ident(),
     }
     if attrs:
         rec["attrs"] = attrs
@@ -172,6 +216,7 @@ def record(name: str, wall_s: float, **attrs) -> None:
         "wall_s": wall_s,
         "depth": len(st),
         "parent": st[-1].name if st else None,
+        "tid": threading.get_ident(),
     }
     if attrs:
         rec["attrs"] = attrs

@@ -1,0 +1,608 @@
+"""
+Execution flight recorder: per-flush structured tracing with XLA cost
+attribution, Chrome-trace/Perfetto export, and the one-shot ``statusz``
+health surface.
+
+PR 1's counters say *how many* flushes/compiles/recoveries happened; nine
+subsystems later nobody can answer *which signature* burned the time, *why*
+a given flush compiled instead of hitting L2, or *what fraction of peak* a
+kernel achieved — the per-kernel attribution the XLA-fusion analysis
+methodology relies on (PAPERS.md arXiv:2301.13062). The flight recorder is
+that answer: a bounded in-memory ring of structured records, one per fused
+flush (plus eager collective dispatches and elastic-supervisor transitions),
+each carrying
+
+* ``signature`` — the cross-process digest of the flush program (the L2
+  disk-cache key when the program is stable; ``mem:<hash>`` for in-memory-
+  only keys, ``unkeyed`` for unhashable shardings);
+* ``reason`` — the flush-reason taxonomy label (why the chain broke);
+* ``chain`` / ``kinds`` — recorded DAG depth and per-node-kind counts;
+* ``cache`` — the outcome lane: ``l1`` (trace-LRU hit), ``l2`` (disk-served
+  executable, zero XLA compile), ``compile`` (fresh build), ``eager``
+  (poisoned signature or open breaker — straight to per-op replay);
+* ``rung`` — which recovery-ladder rung produced the values (``fused`` /
+  ``oom-debucket`` / ``donation-off`` / ``eager-replay``) plus the failure
+  classes of the rungs that failed;
+* ``audit`` — the shadow-replay outcome when the flush was sampled
+  (``clean`` / ``mismatch`` / ``skip-donated``);
+* ``pad_waste`` — bucket pad bytes appended across the leaves;
+* ``donate`` — the donation mask;
+* ``queue_s`` / ``wall_s`` / ``tid`` — scheduler queue time (when the flush
+  was dispatched by ``serving/scheduler.py``), dispatch wall time, and the
+  executing thread id.
+
+Gating contract (the ``HEAT_TPU_FUSION`` cost class): the recorder is armed
+by ``HEAT_TPU_FLIGHT=1`` and *off by default* — every hook guards with
+:func:`flight_enabled`, so the disabled cost is **one env read per flush**
+(per collective dispatch / per transition at the other hook sites), zero
+records, and **zero ring allocation** (the ring list is created lazily on
+the first record). The ring holds ``HEAT_TPU_FLIGHT_RECORDS`` records
+(default 1024); overflow evicts the *oldest* record and counts it — a long
+run's recorder is a bounded flight recorder, not a leak. Recording is a
+pure observation: no hook influences a computed value, so every workload is
+bit-identical with the gate on or off (the ``observability-smoke`` CI leg
+pins exactly this).
+
+**Cost cards.** On every real (AOT) compile the serving layer queries
+``compiled.cost_analysis()`` into a *cost card* — ``flops``, ``bytes
+accessed``, ``output bytes`` — persisted beside the L2 entry under the same
+digest (``<cache_dir>/cost/<digest>.json``), so a disk-served zero-compile
+process keeps full attribution: an L2 hit loads the card instead of
+re-deriving it. When ``cost_analysis`` is unavailable (older jaxlib, an
+in-memory-only program, a backend that refuses the query) the card is
+``{"available": false}`` — attribution degrades to wall time, never to an
+error. Running totals per signature feed ``report.telemetry()``'s modeled-
+utilization gauge (flops/s against a small per-platform peak table) and the
+top-K hottest-signatures table in ``report.render()``.
+
+**Export.** :func:`export_chrome_trace` renders the monitoring ``events``
+spans *and* the flight records as Chrome-trace/Perfetto JSON (an object
+with a ``traceEvents`` array of ``ph: "X"`` complete events carrying
+``ts``/``dur`` in microseconds and the real ``tid``), loadable in
+``chrome://tracing`` or https://ui.perfetto.dev.
+
+**CLI.** ``python -m heat_tpu.monitoring.flight dump|trace|statusz``:
+``dump`` prints the ring as JSON lines, ``trace`` the Chrome-trace JSON,
+``statusz`` the one-shot health payload (telemetry + breaker/elastic states
++ cache SLOs) the fleet layer's readiness endpoint will serve (ROADMAP
+item 2). ``--selftest`` runs a small fused workload first so a fresh
+process demonstrates a populated surface; ``--out FILE`` writes instead of
+printing.
+
+See ``doc/observability_notes.md`` for the record schema, the cost-card
+contract, and the overhead numbers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from . import events as _events
+from .registry import REGISTRY, STATE as _MON
+
+__all__ = [
+    "flight_enabled",
+    "capacity",
+    "record",
+    "record_flush",
+    "record_collective",
+    "record_elastic",
+    "records",
+    "evicted",
+    "clear",
+    "ring_allocated",
+    "sched_context",
+    "sched_queue_s",
+    "cost_card_from",
+    "note_cost_card",
+    "load_cost_card",
+    "cost_cards",
+    "totals",
+    "hottest",
+    "peak_flops",
+    "modeled_utilization",
+    "export_chrome_trace",
+    "statusz",
+]
+
+_DEFAULT_RECORDS = 1024
+
+#: The ring. ``None`` until the first record lands (off-mode allocates
+#: nothing); once allocated its capacity is fixed for the process (documented
+#: — re-reading the env per record would let a mid-run change silently drop
+#: history).
+_RING: Optional[List[dict]] = None
+_CAP = _DEFAULT_RECORDS
+_NEXT = 0  # ring cursor once full
+_SEQ = 0  # total records ever appended (evicted = _SEQ - len(ring))
+_LOCK = threading.Lock()
+
+#: Per-signature running totals: {"flushes", "wall_s", "queue_s"} plus the
+#: cost-card dims when a card is known.
+_TOTALS: Dict[str, Dict[str, float]] = {}
+
+#: digest -> cost card (in-memory attribution; populated at compile time or
+#: lazily from the on-disk card on an L2 hit).
+_COST_CARDS: Dict[str, dict] = {}
+
+#: Last-observed elastic-supervisor state (the statusz surface; None until a
+#: supervisor transitions).
+_LAST_ELASTIC: Optional[str] = None
+
+#: Scheduler context handed across the async worker threads: the flush that
+#: runs inside ``FlushScheduler`` reads its queue time from here.
+_TLS = threading.local()
+
+
+# ------------------------------------------------------------------ gates
+def flight_enabled() -> bool:
+    """Whether the flight recorder is armed (``HEAT_TPU_FLIGHT=1``; default
+    off). Read per hook — one env read is the entire disabled cost."""
+    val = os.environ.get("HEAT_TPU_FLIGHT", "")
+    return val.strip().lower() not in ("", "0", "false", "off")
+
+
+def capacity() -> int:
+    """Configured ring capacity (``HEAT_TPU_FLIGHT_RECORDS``, default 1024,
+    min 1). Fixed at first-record time for the life of the ring."""
+    try:
+        return max(1, int(os.environ.get("HEAT_TPU_FLIGHT_RECORDS", "")
+                          or _DEFAULT_RECORDS))
+    except ValueError:
+        return _DEFAULT_RECORDS
+
+
+def ring_allocated() -> bool:
+    """Whether the ring list exists (off-mode inertness: it must not)."""
+    return _RING is not None
+
+
+# ------------------------------------------------------------------ recording
+def record(kind: str, **fields) -> None:
+    """Append one flight record (callers gate on :func:`flight_enabled`).
+
+    Every record carries ``kind``, ``ts`` (epoch seconds at the *start* of
+    the recorded interval when the caller passes one, else now), ``tid``
+    (the executing thread), and the caller's fields. Overflow evicts the
+    oldest record."""
+    global _RING, _NEXT, _SEQ, _CAP
+    rec = dict(fields)
+    rec["kind"] = kind
+    rec.setdefault("ts", time.time())
+    rec.setdefault("tid", threading.get_ident())
+    with _LOCK:
+        if _RING is None:
+            _RING = []
+            _CAP = capacity()
+        _SEQ += 1
+        if len(_RING) < _CAP:
+            _RING.append(rec)
+        else:
+            _RING[_NEXT] = rec
+            _NEXT = (_NEXT + 1) % _CAP
+
+
+def record_flush(signature: str, wall_s: float, **fields) -> None:
+    """One fused-flush record (called from ``core/fusion.py``) — also folds
+    the flush into the per-signature running totals."""
+    queue_s = sched_queue_s()
+    if queue_s is not None:
+        fields["queue_s"] = round(queue_s, 6)
+    record(
+        "flush",
+        signature=signature,
+        wall_s=round(float(wall_s), 6),
+        ts=time.time() - float(wall_s),
+        **fields,
+    )
+    with _LOCK:
+        t = _TOTALS.setdefault(
+            signature, {"flushes": 0, "wall_s": 0.0, "queue_s": 0.0}
+        )
+        t["flushes"] += 1
+        t["wall_s"] += float(wall_s)
+        if queue_s is not None:
+            t["queue_s"] += float(queue_s)
+
+
+def record_collective(kind: str, wall_s: float, **fields) -> None:
+    """One eager collective dispatch (called from ``core/communication.py``;
+    collectives recorded in fused flushes are part of their flush record)."""
+    record(
+        "collective",
+        collective=kind,
+        wall_s=round(float(wall_s), 6),
+        ts=time.time() - float(wall_s),
+        **fields,
+    )
+
+
+def record_elastic(state: str, **fields) -> None:
+    """One elastic-supervisor state transition / evidence event (called from
+    ``robustness/elastic.py``); the latest state also backs the ``statusz``
+    ``elastic`` field."""
+    global _LAST_ELASTIC
+    _LAST_ELASTIC = state
+    record("elastic", state=state, **fields)
+
+
+def records(kind: Optional[str] = None) -> List[dict]:
+    """Chronological copy of the resident records, optionally filtered."""
+    with _LOCK:
+        if _RING is None:
+            out = []
+        elif len(_RING) < _CAP:
+            out = list(_RING)
+        else:
+            out = _RING[_NEXT:] + _RING[:_NEXT]
+    if kind is not None:
+        out = [r for r in out if r.get("kind") == kind]
+    return out
+
+
+def evicted() -> int:
+    """Records evicted from the ring so far (oldest-first overflow)."""
+    with _LOCK:
+        return _SEQ - (len(_RING) if _RING is not None else 0)
+
+
+def clear() -> None:
+    """Drop the ring, totals, cost cards, and elastic state (test
+    isolation). The next record re-reads ``HEAT_TPU_FLIGHT_RECORDS``."""
+    global _RING, _NEXT, _SEQ, _LAST_ELASTIC
+    with _LOCK:
+        _RING = None
+        _NEXT = 0
+        _SEQ = 0
+        _TOTALS.clear()
+        _COST_CARDS.clear()
+        _LAST_ELASTIC = None
+
+
+# ------------------------------------------------------------------ scheduler
+class sched_context:
+    """Thread-local scheduler context the async flush workers install around
+    a dispatched flush, so the flush record (written deep inside
+    ``materialize_for``, which knows nothing of the scheduler) can carry the
+    queue time. Re-entrant is unnecessary — one worker runs one flush."""
+
+    def __init__(self, queue_s: float):
+        self.queue_s = float(queue_s)
+
+    def __enter__(self):
+        _TLS.queue_s = self.queue_s
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        _TLS.queue_s = None
+        return False
+
+
+def sched_queue_s() -> Optional[float]:
+    """Queue time of the scheduler dispatch currently running on this
+    thread, or None when the flush was not scheduler-dispatched."""
+    return getattr(_TLS, "queue_s", None)
+
+
+# ------------------------------------------------------------------ cost cards
+def cost_card_from(compiled) -> dict:
+    """Build a cost card from a ``Compiled``'s ``cost_analysis()``.
+
+    Normalizes the version-variant key spellings (``bytes accessed output``
+    vs ``bytes accessedout{}``) into ``{"available": True, "flops",
+    "bytes_accessed", "output_bytes"}``; any failure — method missing,
+    backend refusal, unexpected shape — degrades to
+    ``{"available": False}`` (the documented fallback: attribution then
+    rests on wall time alone)."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if not isinstance(ca, dict):
+            return {"available": False}
+        out_bytes = 0.0
+        for k, v in ca.items():
+            if k == "bytes accessed output" or k.startswith("bytes accessedout"):
+                out_bytes += float(v)
+        return {
+            "available": True,
+            "flops": float(ca.get("flops", 0.0) or 0.0),
+            "bytes_accessed": float(ca.get("bytes accessed", 0.0) or 0.0),
+            "output_bytes": out_bytes,
+        }
+    except Exception:
+        return {"available": False}
+
+
+def note_cost_card(signature: str, card: dict) -> None:
+    """Attach a cost card to a signature's running totals (compile-time, or
+    lazily from disk on an L2 hit)."""
+    with _LOCK:
+        _COST_CARDS[signature] = dict(card)
+
+
+def load_cost_card(cache_dir: str, signature: str) -> Optional[dict]:
+    """Fetch the persisted cost card for a disk-served signature (memoized;
+    best-effort — a missing/corrupt card returns None and attribution stays
+    wall-time-only)."""
+    with _LOCK:
+        card = _COST_CARDS.get(signature)
+    if card is not None:
+        return card
+    from ..serving import cache as _cache
+
+    path = _cache.cost_card_path(cache_dir, signature)
+    try:
+        with open(path, "r") as f:
+            card = json.load(f)
+        if not isinstance(card, dict):
+            return None
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        return None
+    note_cost_card(signature, card)
+    return card
+
+
+def cost_cards() -> Dict[str, dict]:
+    """Copy of the in-memory signature -> cost-card map."""
+    with _LOCK:
+        return {k: dict(v) for k, v in _COST_CARDS.items()}
+
+
+# ------------------------------------------------------------------ attribution
+#: Modeled peak FLOP/s by accelerator generation (dense f32-class peak — the
+#: MXU bf16 peak is 2x on v4/v5; CPU is a deliberately rough single-core
+#: estimate). Matched by substring against the lowercased device_kind, first
+#: hit wins; unmatched platforms report utilization None rather than a lie.
+PEAK_FLOPS = (
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 46e12),
+    ("cpu", 1e11),
+)
+
+
+def peak_flops() -> Optional[float]:
+    """Modeled peak FLOP/s of local device 0, or None when the platform is
+    not in the table."""
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        kind = str(getattr(dev, "device_kind", dev.platform)).lower()
+        plat = str(dev.platform).lower()
+    except Exception:
+        return None
+    for sub, peak in PEAK_FLOPS:
+        if sub in kind or sub == plat:
+            return peak
+    return None
+
+
+def totals() -> Dict[str, dict]:
+    """Per-signature running totals, cost-card dims folded in where known:
+    ``{signature: {flushes, wall_s, queue_s, flops?, bytes_accessed?,
+    output_bytes?, modeled_util?}}``. ``modeled_util`` is per-flush flops
+    over mean flush wall time, as a fraction of the platform peak."""
+    peak = peak_flops()
+    out: Dict[str, dict] = {}
+    with _LOCK:
+        items = [(k, dict(v)) for k, v in _TOTALS.items()]
+        cards = {k: v for k, v in _COST_CARDS.items()}
+    for sig, t in items:
+        card = cards.get(sig)
+        if card and card.get("available"):
+            t["flops"] = card["flops"] * t["flushes"]
+            t["bytes_accessed"] = card["bytes_accessed"] * t["flushes"]
+            t["output_bytes"] = card["output_bytes"] * t["flushes"]
+            if peak and t["wall_s"] > 0:
+                t["modeled_util"] = round(t["flops"] / t["wall_s"] / peak, 6)
+        out[sig] = t
+    return out
+
+
+def modeled_utilization() -> Optional[float]:
+    """Aggregate modeled utilization: total attributed flops over total
+    flush wall time, as a fraction of the platform peak. None when no cost
+    card is available or the platform peak is unknown — the honest answer,
+    never a fabricated number."""
+    peak = peak_flops()
+    if not peak:
+        return None
+    t = totals()
+    flops = sum(v.get("flops", 0.0) for v in t.values())
+    wall = sum(v["wall_s"] for v in t.values() if v.get("flops"))
+    if flops <= 0.0 or wall <= 0.0:
+        return None
+    return round(flops / wall / peak, 6)
+
+
+def hottest(k: int = 5) -> List[dict]:
+    """Top-``k`` signatures by total flush wall time (the render table)."""
+    rows = [dict(v, signature=sig) for sig, v in totals().items()]
+    rows.sort(key=lambda r: r["wall_s"], reverse=True)
+    return rows[: max(0, int(k))]
+
+
+# ------------------------------------------------------------------ export
+def _flight_trace_events(pid: int) -> List[dict]:
+    evs = []
+    for r in records():
+        kind = r.get("kind", "flight")
+        if kind == "flush":
+            name = "flush %s" % str(r.get("signature", ""))[:12]
+        elif kind == "collective":
+            name = "collective %s" % r.get("collective", "")
+        else:
+            name = "%s %s" % (kind, r.get("state", ""))
+        args = {
+            k: v
+            for k, v in r.items()
+            if k not in ("kind", "ts", "tid", "wall_s") and v is not None
+        }
+        evs.append(
+            {
+                "name": name,
+                "cat": "flight." + kind,
+                "ph": "X",
+                "ts": r["ts"] * 1e6,
+                "dur": float(r.get("wall_s", 0.0)) * 1e6,
+                "pid": pid,
+                "tid": r.get("tid", 0),
+                "args": args,
+            }
+        )
+    return evs
+
+
+def export_chrome_trace() -> str:
+    """The monitoring ``events`` spans/events plus the flight ring as
+    Chrome-trace JSON (the Perfetto-loadable ``traceEvents`` schema).
+
+    Every emitted event is a ``ph: "X"`` *complete* event — spans with their
+    measured ``dur``, point events and flight records without a duration as
+    ``dur: 0`` — carrying ``ts``/``dur`` in microseconds, the OS thread id,
+    and the record's attributes under ``args``. Events are sorted by ``ts``
+    (the viewer requires monotone timestamps per process)."""
+    pid = os.getpid()
+    evs: List[dict] = []
+    for r in _events.records():
+        args = dict(r.get("attrs") or {})
+        if r.get("parent"):
+            args["parent"] = r["parent"]
+        evs.append(
+            {
+                "name": r["name"],
+                "cat": "events." + r.get("type", "span"),
+                "ph": "X",
+                "ts": r["t_start"] * 1e6,
+                "dur": float(r.get("wall_s", 0.0)) * 1e6,
+                "pid": pid,
+                "tid": r.get("tid", 0),
+                "args": args,
+            }
+        )
+    evs.extend(_flight_trace_events(pid))
+    evs.sort(key=lambda e: e["ts"])
+    return json.dumps(
+        {"traceEvents": evs, "displayTimeUnit": "ms"}, sort_keys=True, default=str
+    )
+
+
+# ------------------------------------------------------------------ statusz
+def statusz() -> dict:
+    """The one-shot health payload the fleet layer's readiness endpoint will
+    serve (ROADMAP item 2 specifies it "fed by ``report.telemetry()``"):
+    telemetry, per-site breaker states, the last elastic-supervisor state,
+    the cache SLOs, and the flight summary. Pure read — flushes pending
+    work (the telemetry barrier) but changes no state."""
+    from ..robustness import breaker as _BRK
+    from . import report as _report
+
+    tel = _report.telemetry()
+    return {
+        "ok": True,
+        "time": time.time(),
+        "pid": os.getpid(),
+        "telemetry": tel,
+        "breakers": _BRK.states(),
+        "elastic": _LAST_ELASTIC,
+        "cache_slo": tel.get("serving_cache_slo"),
+        "flight": {
+            "enabled": flight_enabled(),
+            "records": len(records()),
+            "evicted": evicted(),
+            "capacity": _CAP if _RING is not None else capacity(),
+            "signatures": len(_TOTALS),
+            "modeled_utilization": modeled_utilization(),
+        },
+    }
+
+
+# ------------------------------------------------------------------ CLI
+_USAGE = """usage: python -m heat_tpu.monitoring.flight <command> [--out FILE] [--selftest]
+
+commands:
+  dump     print the resident flight records as JSON lines
+  trace    print the Chrome-trace/Perfetto JSON (events spans + flight ring)
+  statusz  print the one-shot health payload (telemetry + breakers + elastic
+           + cache SLOs + flight summary)
+
+options:
+  --out FILE   write to FILE instead of stdout
+  --selftest   run a small fused workload first (HEAT_TPU_FLIGHT=1 +
+               monitoring enabled), so a fresh process demonstrates a
+               populated surface
+"""
+
+
+def _selftest() -> None:
+    """A tiny chain+sink workload under the recorder, so the CLI has
+    something to show in a fresh process."""
+    os.environ.setdefault("HEAT_TPU_FLIGHT", "1")
+    import numpy as np
+
+    from . import registry as _registry
+
+    _registry.enable()
+    import heat_tpu as ht
+
+    x = ht.array(np.linspace(0.0, 1.0, 4096, dtype=np.float32).reshape(64, 64))
+    with _events.span("flight.selftest"):
+        y = ((x * 2.0 + 1.0) / 3.0 - 0.25).sum()
+        float(y.larray)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    out_path = None
+    if "--out" in argv:
+        i = argv.index("--out")
+        try:
+            out_path = argv[i + 1]
+        except IndexError:
+            sys.stderr.write(_USAGE)
+            return 2
+        del argv[i : i + 2]
+    selftest = "--selftest" in argv
+    if selftest:
+        argv.remove("--selftest")
+    if len(argv) != 1 or argv[0] not in ("dump", "trace", "statusz"):
+        sys.stderr.write(_USAGE)
+        return 2
+    if selftest:
+        _selftest()
+    cmd = argv[0]
+    if cmd == "dump":
+        text = "\n".join(
+            json.dumps(r, sort_keys=True, default=str) for r in records()
+        )
+    elif cmd == "trace":
+        text = export_chrome_trace()
+    else:
+        text = json.dumps(statusz(), sort_keys=True, default=str, indent=2)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(text + "\n")
+    else:
+        sys.stdout.write(text + "\n")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover — exercised via subprocess tests
+    # `python -m` executes this file as `__main__` — a SECOND module object
+    # with its own ring. Delegate to the canonical import so the CLI reads
+    # the ring the runtime hooks actually record into.
+    from heat_tpu.monitoring import flight as _canonical
+
+    sys.exit(_canonical.main())

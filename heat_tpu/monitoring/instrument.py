@@ -67,6 +67,7 @@ __all__ = [
     "pallas_fallback",
     "fusion_collective_fallback",
     "fusion_flush",
+    "fusion_compile_latency",
     "fusion_flush_failure",
     "fusion_flush_recovered",
     "fusion_poisoned",
@@ -240,6 +241,17 @@ def fusion_flush(chain_len: int, cache_hit: bool, compiled: bool, reason: str = 
     if compiled:
         REGISTRY.counter("fusion.kernels_compiled").inc()
     REGISTRY.histogram("fusion.chain_length").observe(chain_len)
+
+
+def fusion_compile_latency(seconds: float) -> None:
+    """One L2-miss compile's latency (ISSUE 13 satellite — compile time used
+    to be invisible outside the aggregate ``jit.compile_seconds`` sum). For
+    the AOT/L2 path this times ``.lower().compile()`` (+ serialization)
+    exactly; for the in-memory path it times the fused kernel's *first*
+    dispatch (trace + compile + execute — compile-dominated). Same 1-2-5
+    buckets as ``serving.dispatch_latency``, exported as ``p50_us``/
+    ``p99_us`` by ``report.telemetry()``."""
+    REGISTRY.histogram("fusion.compile_latency", _DISPATCH_BOUNDS).observe(seconds)
 
 
 def fusion_flush_failure(kind: str) -> None:

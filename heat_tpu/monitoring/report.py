@@ -15,6 +15,7 @@ import json
 from typing import Dict
 
 from . import events as _events
+from . import flight as _flight
 from . import instrument as _instrument
 from .registry import REGISTRY
 
@@ -91,6 +92,22 @@ def render() -> str:
             lines.append(
                 f"  {name:<28} n={s['count']} total={s['wall_s']:.4f}s "
                 f"max={s['max_wall_s']:.4f}s"
+            )
+    # top-K hottest signatures (ISSUE 13): which flush programs burned the
+    # wall time, with cost-card attribution where a compile (or its
+    # persisted card) provided one
+    hot = _flight.hottest(5)
+    if hot:
+        lines.append("-- flight: hottest signatures --")
+        for row in hot:
+            extra = ""
+            if row.get("flops"):
+                extra = f" gflops={row['flops'] / 1e9:.3g}"
+                if row.get("modeled_util") is not None:
+                    extra += f" util={100.0 * row['modeled_util']:.2g}%"
+            lines.append(
+                f"  {row['signature'][:20]:<20} n={row['flushes']} "
+                f"wall={row['wall_s']:.4f}s{extra}"
             )
     lines.append(
         f"-- events: {snap['events_recorded']} recorded, "
@@ -209,6 +226,28 @@ def telemetry() -> dict:
             "count": lat["count"],
             "p50_us": round(_hist_quantile(lat, 0.50) * 1e6, 1),
             "p99_us": round(_hist_quantile(lat, 0.99) * 1e6, 1),
+        }
+    # L2-miss compile latency (ISSUE 13 satellite): compile time used to be
+    # invisible outside the aggregate jit.compile_seconds sum — the
+    # histogram answers "what does a cold signature cost this process?"
+    comp_lat = snap["metrics"]["histograms"].get("fusion.compile_latency")
+    if comp_lat and comp_lat["count"]:
+        out["fusion_compile_latency"] = {
+            "count": comp_lat["count"],
+            "p50_us": round(_hist_quantile(comp_lat, 0.50) * 1e6, 1),
+            "p99_us": round(_hist_quantile(comp_lat, 0.99) * 1e6, 1),
+        }
+    # execution flight recorder (ISSUE 13): per-signature attribution
+    # totals, the modeled-utilization gauge (attributed flops/s over the
+    # per-platform peak table), and the ring occupancy — present only when
+    # the recorder has records, so the off-mode telemetry block is
+    # byte-identical to pre-flight output
+    if _flight.ring_allocated():
+        out["flight"] = {
+            "records": len(_flight.records()),
+            "evicted": _flight.evicted(),
+            "signatures": len(_flight.totals()),
+            "modeled_utilization": _flight.modeled_utilization(),
         }
     mem = {k: v for k, v in snap["metrics"]["gauges"].items() if k.startswith("memory.")}
     if mem:

@@ -61,6 +61,7 @@ import os
 import tempfile
 from typing import Any, Dict, FrozenSet, Optional
 
+from ..monitoring import flight as _FL
 from ..monitoring import instrument as _instr
 from ..monitoring.registry import STATE as _MON
 from . import breaker as _BRK
@@ -190,10 +191,18 @@ class ElasticSupervisor:
             self._state = state
             if _MON.enabled:
                 _instr.elastic_transition(state)
+            if _FL.flight_enabled():
+                # flight recorder (ISSUE 13): state transitions land in the
+                # ring (and back the statusz `elastic` field) so a post-hoc
+                # trace shows WHEN the supervisor degraded relative to the
+                # flushes around it
+                _FL.record_elastic(state, process=self.process_id)
 
     def _evidence(self, kind: str) -> None:
         if _MON.enabled:
             _instr.elastic_transition(kind)
+        if _FL.flight_enabled():
+            _FL.record("elastic", state=kind, evidence=True, process=self.process_id)
 
     def _peers(self):
         return [p for p in range(self.num_processes) if p != self.process_id]

@@ -54,6 +54,7 @@ half-open probe succeeds).
 from __future__ import annotations
 
 import hashlib
+import json
 import os
 import pickle
 import tempfile
@@ -76,6 +77,7 @@ __all__ = [
     "persist",
     "evict",
     "entry_path",
+    "cost_card_path",
     "with_footer",
     "split_footer",
 ]
@@ -251,6 +253,16 @@ def digest_for(stable_prog, leaf_arrays, donate, out_idx) -> Optional[str]:
 # ------------------------------------------------------------------ entries
 def entry_path(cache_dir_: str, digest: str) -> str:
     return os.path.join(cache_dir_, "exec", digest + ".bin")
+
+
+def cost_card_path(cache_dir_: str, digest: str) -> str:
+    """The XLA cost card persisted beside the L2 entry (ISSUE 13): a small
+    JSON of ``compiled.cost_analysis()`` under the *same digest*, so a
+    disk-served zero-compile process keeps per-signature flop/byte
+    attribution without ever holding a ``Compiled`` that could answer the
+    query. A few hundred bytes per signature; not counted by the janitor's
+    exec+corpus byte bound (documented in observability_notes)."""
+    return os.path.join(cache_dir_, "cost", digest + ".json")
 
 
 def _count(kind: str) -> None:
@@ -430,6 +442,26 @@ def persist(cache_dir_: str, digest: str, compiled) -> bool:
         )
         _atomic_write(entry_path(cache_dir_, digest), blob)
         _count("write")
+        # XLA cost attribution (ISSUE 13): every real compile persists its
+        # cost card beside the entry — unconditionally (not gated on the
+        # flight recorder), because the process that *reads* this entry may
+        # be the one with the recorder armed, and a serialized executable
+        # cannot answer cost_analysis() after the fact. Best-effort: a card
+        # that fails to write degrades attribution, never the flush.
+        from ..monitoring import flight as _flight
+
+        card = _flight.cost_card_from(compiled)
+        try:
+            _atomic_write(
+                cost_card_path(cache_dir_, digest),
+                json.dumps(card, sort_keys=True).encode(),
+            )
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception:
+            pass
+        if _flight.flight_enabled():
+            _flight.note_cost_card(digest, card)
         from . import janitor as _janitor
 
         # inline size enforcement: one env read when HEAT_TPU_CACHE_MAX_BYTES

@@ -69,6 +69,8 @@ import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Iterable, Optional
 
+from ..monitoring import events as _events
+from ..monitoring import flight as _flight
 from ..monitoring import instrument as _instr
 from ..monitoring.registry import STATE as _MON
 
@@ -199,6 +201,12 @@ class FlushScheduler:
 
         deadline = self._deadline_s()
         t0 = time.perf_counter()
+        # cross-thread span propagation (ISSUE 13 satellite): capture the
+        # submitting thread's innermost span NOW, so the worker-thread flush
+        # span nests under the request that scheduled it (each worker has
+        # its own span stack — concurrent flushes cannot corrupt each
+        # other's nesting — and every record carries its thread id)
+        parent_span = _events.current_span_name() if _MON.enabled else None
 
         def run():
             dispatched = False
@@ -212,7 +220,19 @@ class FlushScheduler:
                 dispatched = True
                 flush = getattr(x, "_flush", None)
                 if flush is not None:
-                    flush(reason)
+                    with _events.span(
+                        "serving.flush",
+                        parent=parent_span,
+                        queued_ms=round(waited * 1e3, 3),
+                    ):
+                        if _flight.flight_enabled():
+                            # the flush record (written inside
+                            # materialize_for) reads its queue time from
+                            # this thread-local context
+                            with _flight.sched_context(waited):
+                                flush(reason)
+                        else:
+                            flush(reason)
                 if deadline is not None:
                     took = time.perf_counter() - t0
                     if took > deadline:

@@ -1,0 +1,455 @@
+"""
+Execution flight recorder (ISSUE 13, heat_tpu/monitoring/flight.py): ring
+semantics (overflow evicts oldest, off-mode allocates nothing), per-flush
+record fields and their agreement with the fusion/serving counters, XLA cost
+cards persisted beside the L2 entries (zero-compile processes keep
+attribution — subprocess acceptance test), Chrome-trace/Perfetto export
+schema, the compile-latency histogram satellite, cross-thread span nesting
+under the FlushScheduler (≥2 worker threads), the statusz CLI surface, the
+counter-catalog drift guard, and the pure-observer contract (bit-identical
+results with the recorder armed).
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.core import fusion
+from heat_tpu.monitoring import events, flight, registry, report
+from heat_tpu.robustness import faultinject
+
+pytestmark = pytest.mark.flight
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """Fresh ring/counters/trace-cache on both sides; the recorder gate is
+    opt-in per test (tier-1 runs with it off; the observability-smoke CI
+    leg runs the fusion+serving suites with it ambient — count-asserting
+    tests here pin their own gate via monkeypatch)."""
+    from heat_tpu.robustness import breaker
+
+    monkeypatch.delenv("HEAT_TPU_FLIGHT", raising=False)
+    monkeypatch.delenv("HEAT_TPU_FLIGHT_RECORDS", raising=False)
+    monkeypatch.delenv("HEAT_TPU_CACHE_DIR", raising=False)
+    monkeypatch.delenv("HEAT_TPU_FAULT_PLAN", raising=False)
+    monkeypatch.delenv("HEAT_TPU_CHAOS", raising=False)
+    monkeypatch.delenv("HEAT_TPU_BREAKER_FORCE_OPEN", raising=False)
+    monkeypatch.delenv("HEAT_TPU_AUDIT_RATE", raising=False)
+    monkeypatch.setenv("HEAT_TPU_FUSION", "1")
+    registry.reset()
+    events.clear()
+    flight.clear()
+    faultinject.clear()
+    breaker.reset()
+    fusion.clear_cache()
+    yield
+    fusion.clear_cache()
+    flight.clear()
+    events.clear()
+    registry.reset()
+
+
+def _fresh(shape=(6, 10), seed=0, split=None):
+    data = np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+    return ht.array(data, split=split)
+
+
+def _chain(x):
+    return (x * 2.0 + 1.0) / 3.0 - 0.25
+
+
+def _flushes():
+    return flight.records("flush")
+
+
+# ---------------------------------------------------------------- ring + gate
+def test_off_mode_is_inert_and_allocates_no_ring():
+    assert not flight.flight_enabled()
+    _chain(_fresh()).numpy()
+    assert flight.records() == []
+    assert not flight.ring_allocated()
+    assert flight.evicted() == 0
+
+
+def test_ring_overflow_evicts_oldest(monkeypatch):
+    monkeypatch.setenv("HEAT_TPU_FLIGHT", "1")
+    monkeypatch.setenv("HEAT_TPU_FLIGHT_RECORDS", "4")
+    for i in range(6):
+        # six distinct single-flush programs (chain length varies)
+        x = _fresh(seed=i)
+        for _ in range(i + 1):
+            x = x * 1.5
+        x.numpy()
+    recs = _flushes()
+    assert len(recs) == 4
+    assert flight.evicted() == 2
+    # chronological order survives wraparound, and the two oldest (shortest)
+    # chains are the evicted ones
+    assert [r["chain"] for r in recs] == [3, 4, 5, 6]
+    ts = [r["ts"] for r in recs]
+    assert ts == sorted(ts)
+
+
+def test_flush_record_fields_and_counter_agreement(monkeypatch):
+    monkeypatch.setenv("HEAT_TPU_FLIGHT", "1")
+    with registry.capture():
+        y = _chain(_fresh()).sum()
+        float(y.larray)
+        recs = _flushes()
+        assert len(recs) == 1
+        (rec,) = recs
+        assert rec["cache"] == "compile"
+        assert rec["rung"] == "fused"
+        assert rec["chain"] == 5 and rec["kinds"] == {"binary": 4, "sink": 1}
+        assert rec["reason"] in ("other", "export")
+        assert rec["wall_s"] >= 0.0 and isinstance(rec["tid"], int)
+        assert isinstance(rec["signature"], str) and len(rec["signature"]) == 64
+        assert rec["donate"] == [] and rec["outputs"] == 1
+        # an identical chain flushes from L1
+        y2 = _chain(_fresh()).sum()
+        float(y2.larray)
+        recs = _flushes()
+        assert recs[-1]["cache"] == "l1"
+        assert recs[-1]["signature"] == rec["signature"]
+        # cache-outcome fields agree with the fusion counters (acceptance
+        # criterion a): compile-lane records == kernels_compiled, l1-lane
+        # records == cache_hits
+        c = registry.REGISTRY.counter
+        assert sum(r["cache"] == "compile" for r in recs) == c(
+            "fusion.kernels_compiled"
+        ).get()
+        assert sum(r["cache"] == "l1" for r in recs) == c("fusion.cache_hits").get()
+
+
+def test_l2_outcome_agrees_with_disk_counter(monkeypatch, tmp_path):
+    monkeypatch.setenv("HEAT_TPU_FLIGHT", "1")
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    with registry.capture():
+        _chain(_fresh(seed=3)).numpy()
+        fusion.clear_cache()  # drop L1, keep disk
+        _chain(_fresh(seed=3)).numpy()
+        recs = _flushes()
+        assert [r["cache"] for r in recs] == ["compile", "l2"]
+        assert recs[0]["signature"] == recs[1]["signature"]
+        disk = registry.REGISTRY.counter("serving.disk_cache")
+        assert sum(r["cache"] == "l2" for r in recs) == disk.get("hit")
+
+
+def test_ladder_recovery_and_poisoning_lanes(monkeypatch):
+    monkeypatch.setenv("HEAT_TPU_FLIGHT", "1")
+    x = _fresh(seed=5)
+    with faultinject.inject("fusion.execute", RuntimeError, at_calls=[1]):
+        _chain(x).numpy()
+    rec = _flushes()[-1]
+    assert rec["rung"] == "eager-replay"
+    assert rec["failures"] == ["compile"]
+    # the poisoned signature routes the identical chain straight to eager
+    _chain(x).numpy()
+    rec2 = _flushes()[-1]
+    assert rec2["cache"] == "eager"
+    assert rec2["rung"] == "eager-replay"
+    assert rec2["poisoned"] is True
+
+
+def test_flight_is_a_pure_observer(monkeypatch):
+    """Bit-identical results with the recorder armed (the observability-smoke
+    CI leg runs the full fusion+serving suites under this gate)."""
+    for split in (None, 0, 1):
+        x = _fresh(shape=(7, 9), seed=11, split=split)
+        monkeypatch.delenv("HEAT_TPU_FLIGHT", raising=False)
+        fusion.clear_cache()
+        ref_chain = _chain(x).numpy()
+        ref_sum = np.asarray(_chain(x).sum().larray)
+        monkeypatch.setenv("HEAT_TPU_FLIGHT", "1")
+        fusion.clear_cache()
+        got_chain = _chain(x).numpy()
+        got_sum = np.asarray(_chain(x).sum().larray)
+        assert ref_chain.tobytes() == got_chain.tobytes()
+        assert ref_sum.tobytes() == got_sum.tobytes()
+        assert len(_flushes()) >= 2
+
+
+# ---------------------------------------------------------------- chrome trace
+def test_chrome_trace_schema(monkeypatch):
+    monkeypatch.setenv("HEAT_TPU_FLIGHT", "1")
+    with registry.capture():
+        with events.span("workload"):
+            y = _chain(_fresh(seed=7, split=0)).sum()
+            float(y.larray)
+        _chain(_fresh(seed=8)).numpy()
+        trace = json.loads(flight.export_chrome_trace())
+    evs = trace["traceEvents"]
+    assert isinstance(evs, list) and len(evs) >= 3  # span + >=2 flight records
+    for e in evs:
+        assert set(("name", "ph", "ts", "dur", "pid", "tid")) <= set(e)
+        assert e["ph"] == "X"
+        assert isinstance(e["ts"], float) and isinstance(e["tid"], int)
+        assert e["dur"] >= 0.0
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts)  # monotone timestamps
+    names = {e["name"] for e in evs}
+    assert "workload" in names
+    assert any(n.startswith("flush ") for n in names)
+
+
+# ---------------------------------------------------------------- cost cards
+def test_cost_cards_keep_attribution_across_processes(tmp_path):
+    """Acceptance criterion (c): a fresh process serving every flush from
+    the warmed L2 (``fusion.kernels_compiled == 0``) still attributes flops
+    per signature — the compiling process persisted the cost card beside
+    the entry."""
+    prog = textwrap.dedent(
+        """
+        import os, json
+        import numpy as np
+        os.environ["HEAT_TPU_MONITORING"] = "1"
+        os.environ["HEAT_TPU_FLIGHT"] = "1"
+        import heat_tpu as ht
+        from heat_tpu.monitoring import flight, registry
+        x = ht.array(np.arange(60, dtype=np.float32).reshape(5, 12))
+        r = ((x * 2.0 + 1.0) / 3.0).numpy()
+        recs = flight.records("flush")
+        totals = flight.totals()
+        print(json.dumps({
+            "compiles": registry.REGISTRY.counter("fusion.kernels_compiled").get(),
+            "lanes": [rec["cache"] for rec in recs],
+            "sigs": [rec["signature"] for rec in recs],
+            "flops": [t.get("flops") for t in totals.values()],
+            "checksum": float(r.sum()),
+        }))
+        """
+    )
+    env = dict(os.environ, HEAT_TPU_CACHE_DIR=str(tmp_path))
+    for k in (
+        "HEAT_TPU_FAULT_PLAN", "HEAT_TPU_CHAOS", "HEAT_TPU_SHAPE_BUCKETS",
+        "HEAT_TPU_BREAKER_FORCE_OPEN", "HEAT_TPU_AUDIT_RATE",
+    ):
+        env.pop(k, None)
+
+    def run():
+        out = subprocess.run(
+            [sys.executable, "-c", prog], env=env, cwd=_REPO,
+            capture_output=True, text=True, timeout=240,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        return json.loads(out.stdout.strip().splitlines()[-1])
+
+    first = run()
+    assert first["compiles"] >= 1 and first["lanes"] == ["compile"]
+    (sig,) = first["sigs"]
+    card_path = os.path.join(str(tmp_path), "cost", sig + ".json")
+    assert os.path.exists(card_path)
+    card = json.load(open(card_path))
+    assert card["available"] is True and card["flops"] > 0
+
+    second = run()
+    assert second["compiles"] == 0, second
+    assert second["lanes"] == ["l2"] and second["sigs"] == [sig]
+    assert second["flops"] == first["flops"] and second["flops"][0] > 0
+    assert second["checksum"] == first["checksum"]
+
+
+def test_cost_card_unavailable_fallback():
+    class _NoCost:
+        def cost_analysis(self):
+            raise RuntimeError("backend refuses")
+
+    assert flight.cost_card_from(_NoCost()) == {"available": False}
+    assert flight.cost_card_from(object()) == {"available": False}
+
+
+def test_totals_and_hottest_table(monkeypatch, tmp_path):
+    monkeypatch.setenv("HEAT_TPU_FLIGHT", "1")
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    x = _fresh(seed=13)
+    for _ in range(3):
+        _chain(x).numpy()
+    t = flight.totals()
+    assert len(t) == 1
+    (tot,) = t.values()
+    assert tot["flushes"] == 3 and tot["wall_s"] > 0
+    assert tot.get("flops", 0) > 0  # cost card folded in
+    hot = flight.hottest(5)
+    assert hot and hot[0]["flushes"] == 3
+    text = report.render()
+    assert "hottest signatures" in text
+    tel = report.telemetry()
+    assert tel["flight"]["records"] == 3
+    assert tel["flight"]["signatures"] == 1
+
+
+# ---------------------------------------------------------------- satellites
+def test_compile_latency_histogram_and_telemetry(monkeypatch):
+    with registry.capture():
+        _chain(_fresh(seed=17)).numpy()  # one fresh in-memory compile
+        h = registry.REGISTRY.histogram("fusion.compile_latency")
+        assert h.count == 1 and h.sum > 0
+        _chain(_fresh(seed=17)).numpy()  # L1 hit: no new observation
+        assert h.count == 1
+        tel = report.telemetry()
+    assert tel["fusion_compile_latency"]["count"] == 1
+    assert tel["fusion_compile_latency"]["p99_us"] >= tel["fusion_compile_latency"]["p50_us"] > 0
+
+
+def test_compile_latency_observed_on_aot_path(monkeypatch, tmp_path):
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    with registry.capture():
+        _chain(_fresh(seed=19)).numpy()  # AOT compile through disk.store
+        assert registry.REGISTRY.histogram("fusion.compile_latency").count == 1
+        fusion.clear_cache()
+        _chain(_fresh(seed=19)).numpy()  # L2 hit: no compile, no observation
+        assert registry.REGISTRY.histogram("fusion.compile_latency").count == 1
+
+
+def test_scheduler_span_nesting_across_worker_threads(monkeypatch):
+    """ISSUE 13 satellite: per-thread span stacks + explicit cross-thread
+    parent propagation — concurrent async flushes on ≥2 scheduler workers
+    nest under the scheduling request, tagged with their own thread ids,
+    and never under each other."""
+    from heat_tpu.serving.scheduler import FlushScheduler
+
+    monkeypatch.setenv("HEAT_TPU_FLIGHT", "1")
+    with registry.capture():
+        with FlushScheduler(max_workers=2) as sched:
+            with events.span("request"):
+                futs = [
+                    sched.schedule(_chain(_fresh(seed=20 + i)))
+                    for i in range(8)
+                ]
+            for f in futs:
+                f.result()
+    spans = [r for r in events.records() if r["name"] == "serving.flush"]
+    assert len(spans) == 8
+    main_tid = threading.get_ident()
+    for s in spans:
+        assert s["parent"] == "request"  # cross-thread propagation
+        assert s["depth"] == 0  # worker stacks start empty: no corruption
+        assert isinstance(s["tid"], int) and s["tid"] != main_tid
+        assert s["attrs"]["queued_ms"] >= 0.0
+    # the flush records carry the scheduler queue time + worker thread id
+    frecs = _flushes()
+    assert len(frecs) == 8
+    for r in frecs:
+        assert r["queue_s"] >= 0.0 and r["tid"] != main_tid
+
+
+def test_every_event_record_carries_thread_id():
+    with registry.capture():
+        with events.span("outer"):
+            events.event("tick")
+        events.record("pre-timed", 0.01)
+    recs = events.records()
+    assert len(recs) == 3
+    assert all(isinstance(r["tid"], int) for r in recs)
+
+
+def test_elastic_transitions_land_in_ring(monkeypatch, tmp_path):
+    from heat_tpu.robustness.elastic import ElasticSupervisor
+
+    monkeypatch.setenv("HEAT_TPU_FLIGHT", "1")
+    sup = ElasticSupervisor(str(tmp_path), process_id=0, num_processes=2)
+    sup.drain_and_save(None, step=3)
+    states = [r["state"] for r in flight.records("elastic")]
+    assert states == ["draining", "saving", "saved"]
+    assert flight.statusz()["elastic"] == "saved"
+
+
+def test_eager_collective_dispatch_recorded(monkeypatch):
+    from heat_tpu.core.communication import get_comm
+
+    comm = get_comm()
+    if not comm.is_distributed():
+        pytest.skip("collective shims need a multi-device mesh")
+    monkeypatch.setenv("HEAT_TPU_FLIGHT", "1")
+    x = np.arange(comm.size * 4, dtype=np.float32)
+    comm.Allreduce(x, "sum", split=0)
+    recs = flight.records("collective")
+    assert [r["collective"] for r in recs] == ["allreduce"]
+    assert recs[0]["wall_s"] >= 0.0
+
+
+# ---------------------------------------------------------------- statusz CLI
+def test_statusz_payload_shape(monkeypatch, tmp_path):
+    monkeypatch.setenv("HEAT_TPU_FLIGHT", "1")
+    _chain(_fresh(seed=23)).numpy()
+    payload = flight.statusz()
+    assert payload["ok"] is True
+    assert set(("telemetry", "breakers", "elastic", "cache_slo", "flight")) <= set(payload)
+    assert isinstance(payload["breakers"], dict)
+    assert payload["flight"]["records"] == 1
+    assert payload["flight"]["enabled"] is True
+    json.dumps(payload, default=str)  # serializable — the readiness wire shape
+
+
+def test_flight_cli_statusz_and_usage(tmp_path):
+    env = dict(os.environ)
+    for k in ("HEAT_TPU_FAULT_PLAN", "HEAT_TPU_CHAOS", "HEAT_TPU_CACHE_DIR"):
+        env.pop(k, None)
+    out = subprocess.run(
+        [sys.executable, "-m", "heat_tpu.monitoring.flight", "statusz", "--selftest"],
+        env=env, cwd=_REPO, capture_output=True, text=True, timeout=240,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    payload = json.loads(out.stdout)
+    assert payload["ok"] is True and payload["flight"]["records"] >= 1
+    assert payload["flight"]["enabled"] is True
+    bad = subprocess.run(
+        [sys.executable, "-m", "heat_tpu.monitoring.flight", "nonsense"],
+        env=env, cwd=_REPO, capture_output=True, text=True, timeout=240,
+    )
+    assert bad.returncode == 2
+    assert "usage:" in bad.stderr
+
+
+# ---------------------------------------------------------------- ledger guard
+_METRIC_RE = re.compile(r'REGISTRY\.(counter|gauge|histogram)\(\s*f?"([^"]+)"')
+_LEDGER_ROW = re.compile(r"\|\s*`([^`]+)`\s*\|\s*(counter|gauge|histogram)\s*\|")
+
+
+def _source_metrics():
+    found = set()
+    pkg = os.path.join(_REPO, "heat_tpu")
+    for dirpath, _dirs, files in os.walk(pkg):
+        if "__pycache__" in dirpath:
+            continue
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fname), "r") as f:
+                src = f.read()
+            for kind, name in _METRIC_RE.findall(src):
+                found.add((name, kind))
+    return found
+
+
+def _ledger_metrics():
+    path = os.path.join(_REPO, "doc", "observability_notes.md")
+    text = open(path).read()
+    m = re.search(r"<!-- ledger:begin -->(.*?)<!-- ledger:end -->", text, re.S)
+    assert m, "counter ledger markers missing from doc/observability_notes.md"
+    return {(name, kind) for name, kind in _LEDGER_ROW.findall(m.group(1))}
+
+
+def test_counter_catalog_ledger_in_sync():
+    """Drift guard (ISSUE 13 satellite): every statically-named
+    ``REGISTRY.counter/gauge/histogram`` in ``heat_tpu/`` must appear in the
+    doc ledger, and the ledger must carry no dead entries. (Names built from
+    runtime variables — the ``memory.*`` gauges — are documented prose, not
+    ledger rows: the grep cannot see them.)"""
+    src = _source_metrics()
+    ledger = _ledger_metrics()
+    missing = sorted(src - ledger)
+    dead = sorted(ledger - src)
+    assert not missing, f"metrics missing from the doc ledger: {missing}"
+    assert not dead, f"dead ledger entries (metric no longer in source): {dead}"

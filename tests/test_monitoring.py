@@ -21,6 +21,8 @@ from heat_tpu.core.communication import get_comm
 # wrapper (heat_tpu/core/_compat.py), available on every supported jax
 _HAS_SHARD_MAP = True
 
+pytestmark = pytest.mark.monitoring
+
 
 @pytest.fixture(autouse=True)
 def _isolated_monitoring():
