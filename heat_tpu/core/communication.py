@@ -850,7 +850,11 @@ def _watched(fn, kind: str, deadline_ms: float):
         took_ms = (_time.perf_counter() - t0) * 1e3
         if took_ms > deadline_ms:
             if _MON.enabled:
-                _instr.collective_timeout(kind)
+                # the measured blocking time also lands in the
+                # comm.collective_timeout_latency histogram so telemetry()
+                # exports the uniform {count, p50_us, p99_us} latency shape
+                # (ISSUE 14 satellite) beside the per-kind counter
+                _instr.collective_timeout(kind, seconds=took_ms / 1e3)
             _logger.warning(
                 "collective %s exceeded dispatch deadline in flight: %.1fms > %.1fms",
                 kind, took_ms, deadline_ms,

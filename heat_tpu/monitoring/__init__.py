@@ -37,7 +37,19 @@ Modules:
   (``HEAT_TPU_FLIGHT=1``): a bounded ring of per-flush records with XLA cost
   attribution, Chrome-trace/Perfetto export
   (:func:`~heat_tpu.monitoring.flight.export_chrome_trace`), and the
-  ``python -m heat_tpu.monitoring.flight dump|trace|statusz`` CLI.
+  ``python -m heat_tpu.monitoring.flight dump|trace|statusz`` CLI;
+* :mod:`~heat_tpu.monitoring.exporter` — the served fleet plane
+  (``HEAT_TPU_METRICS_PORT``): Prometheus text exposition plus
+  ``/metrics`` ``/healthz`` ``/readyz`` ``/statusz`` ``/trace`` on a
+  stdlib ``http.server`` background thread, and the standalone
+  ``python -m heat_tpu.monitoring.exporter`` spool scraper;
+* :mod:`~heat_tpu.monitoring.aggregate` — the cross-process telemetry
+  spool (``HEAT_TPU_TELEMETRY_DIR``): atomic per-process snapshots on a
+  flush-count cadence, merged into one fleet view with per-process labels;
+* :mod:`~heat_tpu.monitoring.slo` — declarative objectives evaluated over
+  windowed snapshots into multi-window burn rates and the
+  ``scale_signal`` (queue depth × dispatch p99) the fleet ingress
+  consumes.
 """
 
 from __future__ import annotations
@@ -47,6 +59,9 @@ from . import events
 from . import flight
 from . import instrument
 from . import report
+from . import slo
+from . import aggregate
+from . import exporter
 
 from .flight import export_chrome_trace, statusz
 from .registry import (
@@ -69,6 +84,7 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "REGISTRY",
+    "aggregate",
     "capture",
     "disable",
     "enable",
@@ -76,9 +92,11 @@ __all__ = [
     "event",
     "export_chrome_trace",
     "export_jsonl",
+    "exporter",
     "flight",
     "render",
     "reset",
+    "slo",
     "snapshot",
     "span",
     "statusz",
@@ -90,6 +108,11 @@ __all__ = [
 if registry.STATE.enabled:
     registry._run_enable_hooks()
 
+# fleet telemetry plane (ISSUE 14): HEAT_TPU_METRICS_PORT arms the served
+# /metrics /healthz /readyz /statusz /trace endpoints at import. Unset (the
+# default) this is one env read — zero threads, zero sockets.
+exporter.maybe_start()
+
 
 def snapshot() -> dict:
     """Full observability snapshot (metrics + span summary + memory gauges);
@@ -98,8 +121,10 @@ def snapshot() -> dict:
 
 
 def reset() -> None:
-    """Clear all metrics, recorded events, and flight records (test
-    isolation / between benchmark phases)."""
+    """Clear all metrics, recorded events, flight records, the SLO window,
+    and the spool cadence (test isolation / between benchmark phases)."""
     registry.reset()
     events.clear()
     flight.clear()
+    slo.reset()
+    aggregate.reset()

@@ -1,0 +1,329 @@
+"""
+Cross-process telemetry spool + fleet aggregation.
+
+Every observability surface through PR 13 — registry counters,
+``report.telemetry()``, the flight ring, ``statusz`` — is in-process:
+readable only by calling Python *inside* that process. A fleet (ROADMAP
+item 2: many worker processes behind one ingress) needs the inverse: each
+process publishes, an aggregator merges. This module is that plane's
+transport:
+
+* **Writer** — :func:`maybe_snapshot` is called from the runtime's flush
+  paths (the serving scheduler after each dispatched flush, the L2 cache
+  after each persist). With ``HEAT_TPU_TELEMETRY_DIR`` unset (the default)
+  the entire cost is **one env read** — no file, no thread, no timer. Set,
+  every ``HEAT_TPU_TELEMETRY_EVERY``-th trigger (default 32; the *first*
+  trigger always writes so short-lived processes publish at least once)
+  atomically snapshots this process's full registry state + compact
+  telemetry + flight summary + SLO evaluation to
+  ``<dir>/<pid>-<nonce>.json`` (same-directory tempfile + ``os.replace``,
+  the L2-cache atomic-write idiom — a reader sees the old snapshot or the
+  new one, never a torn file). The cadence is **per-flush-count, not a
+  wall-clock thread**: an idle process writes nothing and spawns nothing.
+  Snapshots are **barrier-free** (``report.telemetry(flush=False)``): a
+  telemetry write must never flush pending fused chains — publishing is a
+  pure observation and cannot alter the execution schedule.
+
+* **Aggregator** — :func:`read_snapshots` / :func:`fleet_view` merge the
+  live snapshots of a spool directory into one fleet view with per-process
+  labels (``pid``/``nonce``/``host``). The reader applies the PR 12 footer
+  discipline to other people's files: torn or partial JSON, unparseable
+  payloads, snapshots older than ``max_age_s``, and superseded duplicates
+  (a reused pid with a newer nonce) are **counted, never a crash**
+  (``telemetry_spool.merge{torn,stale,superseded,merged}``). Counter totals
+  sum across processes (labels included), gauges sum (queue depths and
+  memory are additive fleet-wise), histograms sum bucket-wise when the
+  bounds agree (see :func:`registry.merge_snapshots`), and the fleet
+  ``scale_signal`` is ``(Σ queue_depth) × max(p99)`` — additive on backlog,
+  pessimistic on latency.
+
+* **Trace merge** — :func:`merge_chrome_traces` concatenates Chrome-trace
+  exports from several processes into one Perfetto-loadable timeline;
+  every event already carries its real ``pid`` and each process emits
+  ``process_name``/``thread_name`` metadata events, so merged traces
+  render as separate tracks per process.
+
+The spool file name is ``<pid>-<nonce>.json``: one file per process,
+overwritten in place each cadence. The nonce (minted once per process)
+disambiguates pid reuse — the aggregator keeps the newest snapshot per pid
+and counts the loser ``superseded``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import tempfile
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+from . import instrument as _instr
+from . import registry as _registry
+from .registry import STATE as _MON
+
+__all__ = [
+    "spool_dir",
+    "snapshot_every",
+    "maybe_snapshot",
+    "write_snapshot",
+    "build_snapshot",
+    "read_snapshots",
+    "fleet_view",
+    "merge_chrome_traces",
+    "reset",
+]
+
+_DEFAULT_EVERY = 32
+
+#: Per-process spool identity: minted once, survives for the process life,
+#: distinguishes two processes that reused one pid.
+_NONCE = uuid.uuid4().hex[:8]
+
+_LOCK = threading.Lock()
+_TRIGGERS = 0
+_SEQ = 0
+
+
+def spool_dir() -> Optional[str]:
+    """The spool directory (``HEAT_TPU_TELEMETRY_DIR``), or None = off (the
+    default — zero files, zero threads). Read per trigger."""
+    d = os.environ.get("HEAT_TPU_TELEMETRY_DIR", "").strip()
+    return d or None
+
+
+def snapshot_every() -> int:
+    """Trigger count between snapshot writes (``HEAT_TPU_TELEMETRY_EVERY``,
+    default 32, min 1)."""
+    try:
+        return max(1, int(os.environ.get("HEAT_TPU_TELEMETRY_EVERY", "") or _DEFAULT_EVERY))
+    except ValueError:
+        return _DEFAULT_EVERY
+
+
+def build_snapshot() -> dict:
+    """This process's spool payload: identity labels, the full registry
+    snapshot (labels preserved — the fleet exposition re-renders it
+    per-process), the compact telemetry block (barrier-free), the flight
+    summary, and the SLO evaluation over the freshly observed sample."""
+    from . import flight as _flight
+    from . import report as _report
+    from . import slo as _slo
+
+    tel = _report.telemetry(flush=False)
+    eng = _slo.engine()
+    eng.observe(tel)
+    return {
+        "schema": 1,
+        "pid": os.getpid(),
+        "nonce": _NONCE,
+        "host": socket.gethostname(),
+        "time": time.time(),
+        "labels": {"pid": str(os.getpid()), "nonce": _NONCE, "host": socket.gethostname()},
+        "metrics": _registry.snapshot(),
+        "telemetry": tel,
+        "flight": {
+            "enabled": _flight.flight_enabled(),
+            "records": len(_flight.records()),
+            "evicted": _flight.evicted(),
+            "signatures": len(_flight.totals()),
+            "modeled_utilization": _flight.modeled_utilization(),
+        },
+        "slo": eng.evaluate(),
+    }
+
+
+def _atomic_write_text(path: str, text: str) -> None:
+    """Same-directory tempfile + ``os.replace`` (the L2-cache idiom): a
+    concurrent aggregator sees the previous snapshot or this one whole."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    fd, tmp = tempfile.mkstemp(
+        dir=os.path.dirname(path) or ".", prefix=".tmp-", suffix=".json"
+    )
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def write_snapshot(directory: Optional[str] = None, path: Optional[str] = None) -> Optional[dict]:
+    """Write this process's snapshot now (ignoring the cadence): to
+    ``<directory>/<pid>-<nonce>.json``, or to an explicit ``path`` (the
+    bench sidecar uses this). Returns the payload, or None when the write
+    failed (counted ``telemetry_spool.snapshots{error}`` — publishing can
+    never crash the workload)."""
+    global _SEQ
+    try:
+        payload = build_snapshot()
+        with _LOCK:
+            _SEQ += 1
+            payload["seq"] = _SEQ
+        if path is None:
+            if directory is None:
+                directory = spool_dir()
+            if directory is None:
+                return None
+            path = os.path.join(directory, f"{payload['pid']}-{payload['nonce']}.json")
+        _atomic_write_text(path, json.dumps(payload, sort_keys=True, default=str))
+        if _MON.enabled:
+            _instr.telemetry_spool_snapshot("written")
+        return payload
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        if _MON.enabled:
+            _instr.telemetry_spool_snapshot("error")
+        return None
+
+
+def maybe_snapshot() -> None:
+    """The per-flush-count trigger the runtime's flush paths call. Off
+    (``HEAT_TPU_TELEMETRY_DIR`` unset) = one env read, nothing else; armed,
+    the first trigger and every ``snapshot_every()``-th thereafter writes a
+    snapshot."""
+    global _TRIGGERS
+    d = spool_dir()
+    if d is None:
+        return
+    with _LOCK:
+        _TRIGGERS += 1
+        due = _TRIGGERS == 1 or _TRIGGERS % snapshot_every() == 0
+    if due:
+        write_snapshot(d)
+
+
+# ------------------------------------------------------------------ aggregation
+def read_snapshots(
+    directory: str, max_age_s: Optional[float] = None
+) -> Tuple[List[dict], Dict[str, int]]:
+    """All live snapshots of a spool directory, plus the skip accounting.
+
+    Tolerates the fleet's failure modes without ever raising: torn/partial
+    JSON and payloads missing the identity fields count ``torn``; snapshots
+    whose ``time`` is older than ``max_age_s`` (when given) count
+    ``stale``; duplicate pids (reuse across nonces) keep the newest by
+    write time and count the losers ``superseded``. Every accepted
+    snapshot counts ``merged``."""
+    skips = {"merged": 0, "torn": 0, "stale": 0, "superseded": 0}
+    by_pid: Dict[int, dict] = {}
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return [], skips
+    now = time.time()
+    for name in names:
+        if not name.endswith(".json") or name.startswith(".tmp-"):
+            continue
+        path = os.path.join(directory, name)
+        try:
+            with open(path, "r") as f:
+                snap = json.load(f)
+            if not isinstance(snap, dict):
+                raise ValueError("snapshot is not an object")
+            pid = int(snap["pid"])
+            snap["nonce"], snap["time"]
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception:
+            skips["torn"] += 1
+            continue
+        if max_age_s is not None and now - float(snap["time"]) > max_age_s:
+            skips["stale"] += 1
+            continue
+        prev = by_pid.get(pid)
+        if prev is not None:
+            # pid reuse: one of the two processes is gone — keep the newest
+            if float(snap["time"]) >= float(prev["time"]):
+                by_pid[pid] = snap
+            skips["superseded"] += 1
+        else:
+            by_pid[pid] = snap
+    snaps = sorted(by_pid.values(), key=lambda s: (int(s["pid"]), str(s["nonce"])))
+    skips["merged"] = len(snaps)
+    if _MON.enabled:
+        for kind, n in skips.items():
+            if n:
+                _instr.telemetry_spool_merge(kind, n)
+    return snaps, skips
+
+
+def fleet_view(directory: str, max_age_s: Optional[float] = None) -> dict:
+    """One merged fleet view of a spool directory.
+
+    Per-process summaries keyed ``<pid>-<nonce>`` ride beside the merged
+    registry snapshot (:func:`registry.merge_snapshots`: counters and
+    gauges sum, histograms sum bucket-wise where bounds agree) and the
+    fleet ``scale_signal`` — ``(Σ queue_depth) × max(dispatch p99 µs)``
+    across live processes."""
+    snaps, skips = read_snapshots(directory, max_age_s=max_age_s)
+    total_queue = 0.0
+    worst_p99 = 0.0
+    processes = {}
+    for s in snaps:
+        tel = s.get("telemetry") or {}
+        qd = float(tel.get("serving_queue_depth") or 0)
+        p99 = float((tel.get("serving_dispatch_latency") or {}).get("p99_us") or 0.0)
+        total_queue += qd
+        worst_p99 = max(worst_p99, p99)
+        processes[f"{s['pid']}-{s['nonce']}"] = {
+            "pid": s["pid"],
+            "nonce": s["nonce"],
+            "host": s.get("host"),
+            "time": s["time"],
+            "seq": s.get("seq"),
+            "queue_depth": qd,
+            "dispatch_p99_us": p99 or None,
+            "scale_signal": (s.get("slo") or {}).get("scale_signal"),
+            "flight": s.get("flight"),
+        }
+    return {
+        "processes": processes,
+        "metrics": _registry.merge_snapshots([s.get("metrics") or {} for s in snaps]),
+        "scale_signal": round(total_queue * worst_p99, 4),
+        "skips": skips,
+    }
+
+
+def merge_chrome_traces(traces) -> str:
+    """Merge several per-process Chrome-trace exports (JSON strings or
+    already-parsed dicts) into one Perfetto-loadable document. Metadata
+    (``ph: "M"``) events lead; timed events are re-sorted by ``ts`` across
+    processes. Unparseable inputs are skipped (counted ``torn``) — the
+    merged timeline degrades, never crashes."""
+    meta: List[dict] = []
+    timed: List[dict] = []
+    for t in traces:
+        try:
+            doc = json.loads(t) if isinstance(t, str) else t
+            events = doc["traceEvents"]
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception:
+            if _MON.enabled:
+                _instr.telemetry_spool_merge("torn")
+            continue
+        for ev in events:
+            (meta if ev.get("ph") == "M" else timed).append(ev)
+    timed.sort(key=lambda e: e.get("ts", 0.0))
+    return json.dumps(
+        {"traceEvents": meta + timed, "displayTimeUnit": "ms"},
+        sort_keys=True,
+        default=str,
+    )
+
+
+def reset() -> None:
+    """Drop the trigger/sequence state (test isolation). The nonce is
+    deliberately *not* re-minted — it is the process identity."""
+    global _TRIGGERS, _SEQ
+    with _LOCK:
+        _TRIGGERS = 0
+        _SEQ = 0

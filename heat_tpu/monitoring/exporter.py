@@ -1,0 +1,711 @@
+"""
+Prometheus exposition + served health/readiness endpoints.
+
+The registry, ``report.telemetry()``, and the flight ring are all readable
+only from inside the process; this module is the outward-facing door an
+operator's scrape loop, load balancer, and autoscaler actually talk to:
+
+* :func:`exposition` renders the **full registry** in the Prometheus text
+  format (version 0.0.4): counters as ``heat_tpu_<name>_total`` (one
+  series per label under the generic ``label`` key, plus an unattributed
+  ``label=""`` residual so ``sum()`` over the series always equals the
+  counter total), gauges as ``heat_tpu_<name>``, histograms as summaries —
+  ``_count``/``_sum`` plus ``quantile="0.5"``/``"0.99"`` gauges
+  interpolated by the existing ``report._hist_quantile``. Bracketed
+  dynamic names become labels (``memory.bytes_in_use[0]`` →
+  ``heat_tpu_memory_bytes_in_use{device="0"}``; ``slo.burn[obj:win]`` →
+  ``heat_tpu_slo_burn{objective="obj",window="win"}``). Every metric in
+  the static :data:`CATALOG` (the code-side twin of the doc ledger, sync
+  enforced by test) is present even at zero, so a scrape of a fresh
+  process already carries the complete schema. A point-in-time
+  ``heat_tpu_scale_signal`` sample (queue depth × dispatch p99 µs — the
+  ROADMAP item 2 autoscaling input, see :mod:`~heat_tpu.monitoring.slo`)
+  rides along.
+
+* :class:`MetricsServer` serves the plane over a stdlib ``http.server``
+  background thread: ``/metrics`` (exposition), ``/healthz`` (process
+  liveness — always 200 while the thread breathes), ``/readyz``
+  (readiness: 200/503 from :func:`readiness` — open or forced-open
+  circuit breakers, a non-healthy elastic-supervisor state, and the
+  optional cache-SLO / burn-rate floors), ``/statusz`` (the PR 13
+  one-shot deep payload), and ``/trace`` (Chrome-trace JSON for
+  Perfetto). Gating contract: ``HEAT_TPU_METRICS_PORT`` **default off =
+  zero threads, zero sockets** — :func:`maybe_start` (run once at
+  ``heat_tpu.monitoring`` import) reads the env exactly once and returns
+  without side effects when unset/0/invalid; a bind failure warns and
+  degrades (a child process inheriting the env must never crash on the
+  parent's port).
+
+* **Standalone fleet scrape**: ``python -m heat_tpu.monitoring.exporter
+  --spool DIR [--once | --port N]`` aggregates a telemetry spool
+  directory (:mod:`~heat_tpu.monitoring.aggregate`) into one exposition
+  with per-process ``pid``/``nonce`` labels, fleet skip accounting, and
+  the fleet ``scale_signal`` — the sidecar an operator points Prometheus
+  at when the workers themselves have no port armed.
+
+Readiness inputs (the callers own the semantics):
+
+==========================  ================================================
+open / forced-open breaker  ``robustness.breaker.open_sites()`` — a site on
+                            its degraded path is serving, but not a target
+                            you want new traffic routed to
+elastic state               ``robustness.elastic.last_state()`` — anything
+                            but ``healthy`` (or None = never supervised)
+                            means the process is degraded/draining/saving
+cache SLO floor             ``HEAT_TPU_READY_MIN_HIT_RATE`` (optional): the
+                            combined L1+L2 hit rate below the floor marks
+                            the process cold — route warmup traffic, not
+                            user traffic
+burn-rate ceiling           ``HEAT_TPU_READY_MAX_BURN`` (optional): any
+                            objective's *long*-window burn above the
+                            ceiling flips readiness — the SLO engine as an
+                            admission gate
+==========================  ================================================
+
+Every served request is counted ``exporter.requests{route}``. The
+exposition itself is **barrier-free** (no ``flush_pending``) — scraping a
+serving process must never alter its execution schedule; ``/statusz`` is
+the one deliberate exception (it serves the PR 13 payload, which flushes
+by contract — documented there).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from . import instrument as _instr
+from . import registry as _registry
+from .registry import STATE as _MON
+
+__all__ = [
+    "CATALOG",
+    "MetricsServer",
+    "exposition",
+    "fleet_exposition",
+    "metric_name",
+    "validate_exposition",
+    "readiness",
+    "maybe_start",
+    "start",
+    "stop",
+    "running",
+    "port",
+]
+
+_LOG = logging.getLogger("heat_tpu.monitoring")
+
+#: Every statically-named metric in ``heat_tpu/`` as ``(name, kind)`` — the
+#: code-side twin of the doc ledger (``doc/observability_notes.md``), kept
+#: in sync by ``tests/test_exporter.py::test_catalog_matches_source`` (the
+#: same grep as the ledger drift guard). The exposition pre-renders every
+#: row at zero so a fresh process's first scrape already carries the full
+#: schema. Dynamic names (``memory.*[dev]``, ``io.bytes_*``,
+#: ``slo.burn[...]``, per-step ``{name}.*`` templates) appear once their
+#: first sample lands.
+CATALOG: Tuple[Tuple[str, str], ...] = (
+    ("checkpoint.ops", "counter"),
+    ("comm.collective", "counter"),
+    ("comm.collective_timeout", "counter"),
+    ("comm.collective_timeout_latency", "histogram"),
+    ("comm.placement", "counter"),
+    ("comm.redistribution", "counter"),
+    ("comm.resharding", "counter"),
+    ("exporter.requests", "counter"),
+    ("faults.corrupted", "counter"),
+    ("faults.injected", "counter"),
+    ("fusion.cache_hits", "counter"),
+    ("fusion.chain_length", "histogram"),
+    ("fusion.collective_fallbacks", "counter"),
+    ("fusion.compile_latency", "histogram"),
+    ("fusion.elided_writes", "counter"),
+    ("fusion.flush_failures", "counter"),
+    ("fusion.flush_reason", "counter"),
+    ("fusion.flush_recovered", "counter"),
+    ("fusion.flushes", "counter"),
+    ("fusion.kernels_compiled", "counter"),
+    ("fusion.ops_deferred", "counter"),
+    ("fusion.poisoned_signatures", "counter"),
+    ("fusion.reduction_sinks", "counter"),
+    ("fusion.sink_fallbacks", "counter"),
+    ("fusion.view_fallbacks", "counter"),
+    ("io.calls", "counter"),
+    ("io.retries", "counter"),
+    ("io.seconds", "histogram"),
+    ("jit.compile_seconds", "histogram"),
+    ("jit.compiles", "counter"),
+    ("ops.dispatch", "counter"),
+    ("ops.dtype_fallback", "counter"),
+    ("pallas.dispatch", "counter"),
+    ("pallas.fallbacks", "counter"),
+    ("preemption.requests", "counter"),
+    ("robustness.breaker", "counter"),
+    ("robustness.chaos", "counter"),
+    ("robustness.elastic", "counter"),
+    ("robustness.integrity", "counter"),
+    ("serving.bucket", "counter"),
+    ("serving.corpus", "counter"),
+    ("serving.deadline_miss", "counter"),
+    ("serving.disk_cache", "counter"),
+    ("serving.dispatch_latency", "histogram"),
+    ("serving.janitor", "counter"),
+    ("serving.queue_depth", "gauge"),
+    ("serving.shed", "counter"),
+    ("serving.warmup", "counter"),
+    ("slo.evaluations", "counter"),
+    ("slo.scale_signal", "gauge"),
+    ("telemetry_spool.merge", "counter"),
+    ("telemetry_spool.snapshots", "counter"),
+)
+
+_NAME_SAN = re.compile(r"[^a-zA-Z0-9_]")
+_BRACKET = re.compile(r"^(.*?)\[(.*)\]$")
+
+
+def metric_name(name: str, suffix: str = "") -> str:
+    """``heat_tpu_``-prefixed Prometheus metric name for a registry name."""
+    return "heat_tpu_" + _NAME_SAN.sub("_", name).strip("_") + suffix
+
+
+def _esc(value) -> str:
+    """Label-value escaping per the exposition format."""
+    return (
+        str(value).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _labels_str(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_esc(v)}"' for k, v in labels.items())
+    return "{" + inner + "}"
+
+
+def _num(value) -> str:
+    try:
+        f = float(value)
+    except (TypeError, ValueError):
+        return "0"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _gauge_series(name: str) -> Tuple[str, Dict[str, str]]:
+    """Rendered metric name + labels for a gauge, folding the bracketed
+    dynamic-name conventions into labels."""
+    m = _BRACKET.match(name)
+    if not m:
+        return metric_name(name), {}
+    base, arg = m.group(1), m.group(2)
+    if base.startswith("memory."):
+        return metric_name(base), {"device": arg}
+    if base == "slo.burn" and ":" in arg:
+        obj, win = arg.split(":", 1)
+        return metric_name(base), {"objective": obj, "window": win}
+    return metric_name(base), {"key": arg}
+
+
+def _scale_signal_from(snap: dict) -> float:
+    """Point-in-time ``queue_depth × dispatch p99 (µs)`` straight from a
+    registry snapshot (no flush, no telemetry build)."""
+    from . import report as _report
+
+    qd = float((snap.get("gauges") or {}).get("serving.queue_depth", 0) or 0)
+    h = (snap.get("histograms") or {}).get("serving.dispatch_latency")
+    if not qd or not h or not h.get("count"):
+        return 0.0
+    return round(qd * _report._hist_quantile(h, 0.99) * 1e6, 4)
+
+
+def prometheus_text(
+    sources: List[Tuple[Dict[str, str], dict]],
+    include_catalog: bool = True,
+    extra_samples: Optional[List[str]] = None,
+) -> str:
+    """Render one or more ``(extra_labels, registry_snapshot)`` sources as
+    Prometheus text. One ``HELP``/``TYPE`` header per rendered metric name
+    (required by the format even when several processes contribute
+    series); ``extra_samples`` are appended verbatim (pre-rendered
+    fleet-level lines)."""
+    from . import report as _report
+
+    counters: Dict[str, List[str]] = {}
+    gauges: Dict[str, List[str]] = {}
+    summaries: Dict[str, List[str]] = {}
+    catalog = dict(CATALOG) if include_catalog else {}
+
+    def counter_lines(name: str, val, extra: Dict[str, str]) -> None:
+        mname = metric_name(name, "_total")
+        rows = counters.setdefault(mname, [])
+        total = val["total"] if isinstance(val, dict) else val
+        labels = dict(val.get("labels") or {}) if isinstance(val, dict) else {}
+        if labels:
+            for lab in sorted(labels):
+                rows.append(f"{mname}{_labels_str({'label': lab, **extra})} {_num(labels[lab])}")
+            residual = total - sum(labels.values())
+            if residual:
+                rows.append(f"{mname}{_labels_str({'label': '', **extra})} {_num(residual)}")
+        else:
+            rows.append(f"{mname}{_labels_str(extra)} {_num(total)}")
+
+    def gauge_lines(name: str, val, extra: Dict[str, str]) -> None:
+        mname, labels = _gauge_series(name)
+        gauges.setdefault(mname, []).append(
+            f"{mname}{_labels_str({**labels, **extra})} {_num(val)}"
+        )
+
+    def hist_lines(name: str, h: dict, extra: Dict[str, str]) -> None:
+        mname = metric_name(name)
+        rows = summaries.setdefault(mname, [])
+        count = int(h.get("count", 0) or 0)
+        if count and h.get("buckets"):
+            for q in (0.5, 0.99):
+                rows.append(
+                    f"{mname}{_labels_str({'quantile': str(q), **extra})} "
+                    f"{_num(_report._hist_quantile(h, q))}"
+                )
+        rows.append(f"{mname}_sum{_labels_str(extra)} {_num(h.get('sum', 0.0))}")
+        rows.append(f"{mname}_count{_labels_str(extra)} {_num(count)}")
+
+    for extra, snap in sources:
+        for name in sorted((snap.get("counters") or {})):
+            counter_lines(name, snap["counters"][name], extra)
+            catalog.pop(name, None)
+        for name in sorted((snap.get("gauges") or {})):
+            gauge_lines(name, snap["gauges"][name], extra)
+            catalog.pop(name, None)
+        for name in sorted((snap.get("histograms") or {})):
+            hist_lines(name, snap["histograms"][name], extra)
+            catalog.pop(name, None)
+    for name, kind in catalog.items():  # absent catalog rows render at zero
+        if kind == "counter":
+            counter_lines(name, 0, {})
+        elif kind == "gauge":
+            gauge_lines(name, 0, {})
+        else:
+            hist_lines(name, {"count": 0, "sum": 0.0}, {})
+
+    lines: List[str] = []
+    for mname in sorted(counters):
+        lines.append(f"# HELP {mname} heat_tpu counter")
+        lines.append(f"# TYPE {mname} counter")
+        lines.extend(counters[mname])
+    for mname in sorted(gauges):
+        lines.append(f"# HELP {mname} heat_tpu gauge")
+        lines.append(f"# TYPE {mname} gauge")
+        lines.extend(gauges[mname])
+    for mname in sorted(summaries):
+        lines.append(f"# HELP {mname} heat_tpu histogram (summary exposition)")
+        lines.append(f"# TYPE {mname} summary")
+        lines.extend(summaries[mname])
+    lines.extend(extra_samples or [])
+    return "\n".join(lines) + "\n"
+
+
+def exposition() -> str:
+    """This process's registry as Prometheus text (catalog rows included,
+    SLO burn gauges refreshed, point-in-time ``heat_tpu_scale_signal``
+    appended). Barrier-free by contract."""
+    from . import slo as _slo
+
+    try:
+        _slo.engine().evaluate()  # refresh slo.burn[...] + slo.scale_signal
+    except ValueError:
+        pass  # malformed HEAT_TPU_SLO must not take /metrics down with it
+    snap = _registry.snapshot()
+    sig = _scale_signal_from(snap)
+    if _MON.enabled:
+        _instr.slo_scale_signal(sig)
+        snap = _registry.snapshot()
+    extra = [
+        "# HELP heat_tpu_scale_signal queue depth x dispatch p99 (us)",
+        "# TYPE heat_tpu_scale_signal gauge",
+        f"heat_tpu_scale_signal {_num(sig)}",
+    ]
+    return prometheus_text([({}, snap)], include_catalog=True, extra_samples=extra)
+
+
+def fleet_exposition(spool: str, max_age_s: Optional[float] = None) -> str:
+    """A spool directory as one fleet exposition: per-process series
+    labelled ``pid``/``nonce``, the spool skip accounting, process count,
+    and the fleet ``scale_signal``."""
+    from . import aggregate as _aggregate
+
+    snaps, skips = _aggregate.read_snapshots(spool, max_age_s=max_age_s)
+    sources = [
+        ({"pid": str(s["pid"]), "nonce": str(s["nonce"])}, s.get("metrics") or {})
+        for s in snaps
+    ]
+    view = _aggregate.fleet_view(spool, max_age_s=max_age_s)
+    extra = [
+        "# HELP heat_tpu_fleet_processes live processes in the telemetry spool",
+        "# TYPE heat_tpu_fleet_processes gauge",
+        f"heat_tpu_fleet_processes {_num(len(snaps))}",
+        "# HELP heat_tpu_scale_signal fleet scale signal (sum queue depth x max p99 us)",
+        "# TYPE heat_tpu_scale_signal gauge",
+        f"heat_tpu_scale_signal {_num(view['scale_signal'])}",
+        "# HELP heat_tpu_telemetry_spool_skips aggregator skip accounting",
+        "# TYPE heat_tpu_telemetry_spool_skips gauge",
+    ]
+    for kind in sorted(skips):
+        extra.append(
+            f"heat_tpu_telemetry_spool_skips{_labels_str({'kind': kind})} {_num(skips[kind])}"
+        )
+    return prometheus_text(sources, include_catalog=False, extra_samples=extra)
+
+
+# ------------------------------------------------------------- validation
+_HELP_RE = re.compile(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* \S.*$")
+_VALUE = r"[-+]?(?:[0-9]+(?:\.[0-9]+)?(?:[eE][-+]?[0-9]+)?|\.?[0-9]+|NaN|Inf)"
+_LABEL = r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\.)*"'
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(?:\{(?:%s)(?:,(?:%s))*\})? %s$" % (_LABEL, _LABEL, _VALUE)
+)
+
+
+def validate_exposition(text: str) -> List[str]:
+    """Lines that do not parse as Prometheus text format (empty = clean).
+    The CI smoke and the exporter tests assert this returns []."""
+    bad = []
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            if not _HELP_RE.match(line):
+                bad.append(line)
+        elif not _SAMPLE_RE.match(line):
+            bad.append(line)
+    return bad
+
+
+# ------------------------------------------------------------- readiness
+def readiness() -> Tuple[bool, List[str]]:
+    """``(ready, reasons)`` — the /readyz verdict. See the module docstring
+    for the input table; an empty reason list is ready."""
+    reasons: List[str] = []
+    try:
+        from ..robustness import breaker as _BRK
+
+        for site in _BRK.open_sites():
+            reasons.append(f"breaker:{site}")
+    except Exception:
+        pass
+    try:
+        from ..robustness import elastic as _EL
+
+        st = _EL.last_state()
+        if st is not None and st != "healthy":
+            reasons.append(f"elastic:{st}")
+    except Exception:
+        pass
+    min_hr = os.environ.get("HEAT_TPU_READY_MIN_HIT_RATE", "").strip()
+    if min_hr:
+        try:
+            floor = float(min_hr)
+        except ValueError:
+            floor = None
+        if floor is not None:
+            from . import report as _report
+
+            slo = _report.telemetry(flush=False).get("serving_cache_slo") or {}
+            hr = slo.get("hit_rate")
+            if hr is not None and hr < floor:
+                reasons.append(f"cache-slo:hit_rate {hr} < {floor}")
+    max_burn = os.environ.get("HEAT_TPU_READY_MAX_BURN", "").strip()
+    if max_burn:
+        try:
+            ceiling = float(max_burn)
+        except ValueError:
+            ceiling = None
+        if ceiling is not None:
+            from . import slo as _slo
+
+            try:
+                ev = _slo.engine().evaluate()
+            except ValueError:
+                ev = {"objectives": {}}
+            for name, row in ev["objectives"].items():
+                burn = ((row.get("windows") or {}).get("long") or {}).get("burn", 0.0)
+                if burn > ceiling:
+                    reasons.append(f"slo-burn:{name} {burn} > {ceiling}")
+    return (not reasons, reasons)
+
+
+# ------------------------------------------------------------- HTTP plane
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "heat-tpu-exporter"
+
+    def log_message(self, *args):  # the operator scrapes every few seconds
+        pass
+
+    def _send(self, code: int, body: str, ctype: str) -> None:
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_json(self, code: int, payload: dict) -> None:
+        self._send(code, json.dumps(payload, sort_keys=True, default=str), "application/json")
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        route = self.path.split("?", 1)[0].rstrip("/") or "/"
+        spool = getattr(self.server, "heat_tpu_spool", None)
+        max_age = getattr(self.server, "heat_tpu_max_age_s", None)
+        try:
+            if route == "/metrics":
+                if _MON.enabled:
+                    _instr.exporter_request("metrics")
+                text = (
+                    fleet_exposition(spool, max_age_s=max_age) if spool else exposition()
+                )
+                self._send(200, text, "text/plain; version=0.0.4; charset=utf-8")
+            elif route == "/healthz":
+                if _MON.enabled:
+                    _instr.exporter_request("healthz")
+                self._send_json(200, {"ok": True, "pid": os.getpid(), "time": time.time()})
+            elif route == "/readyz":
+                if _MON.enabled:
+                    _instr.exporter_request("readyz")
+                if spool:
+                    from . import aggregate as _aggregate
+
+                    view = _aggregate.fleet_view(spool, max_age_s=max_age)
+                    ready = bool(view["processes"])
+                    payload = {
+                        "ready": ready,
+                        "reasons": [] if ready else ["no live spool snapshots"],
+                        "scale_signal": view["scale_signal"],
+                    }
+                else:
+                    ready, reasons = readiness()
+                    payload = {
+                        "ready": ready,
+                        "reasons": reasons,
+                        "scale_signal": _scale_signal_from(_registry.snapshot()),
+                    }
+                self._send_json(200 if payload["ready"] else 503, payload)
+            elif route == "/statusz":
+                if _MON.enabled:
+                    _instr.exporter_request("statusz")
+                if spool:
+                    from . import aggregate as _aggregate
+
+                    self._send_json(200, _aggregate.fleet_view(spool, max_age_s=max_age))
+                else:
+                    from . import flight as _flight
+
+                    self._send_json(200, _flight.statusz())
+            elif route == "/trace":
+                if _MON.enabled:
+                    _instr.exporter_request("trace")
+                from . import flight as _flight
+
+                self._send(200, _flight.export_chrome_trace(), "application/json")
+            else:
+                if _MON.enabled:
+                    _instr.exporter_request("not-found")
+                self._send_json(404, {"error": f"no route {route}"})
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception as e:  # a handler bug must not kill the server thread
+            try:
+                self._send_json(500, {"error": repr(e)[:400]})
+            except Exception:
+                pass
+
+
+class MetricsServer:
+    """The exporter's HTTP plane on a daemon background thread.
+
+    ``port=0`` binds an ephemeral port (tests); ``spool`` switches the
+    server into fleet mode (``/metrics``/``/readyz``/``/statusz`` answer
+    from the aggregated spool instead of the local registry)."""
+
+    def __init__(
+        self,
+        port: int = 0,
+        host: Optional[str] = None,
+        spool: Optional[str] = None,
+        max_age_s: Optional[float] = None,
+    ):
+        if host is None:
+            host = os.environ.get("HEAT_TPU_METRICS_HOST", "").strip() or "127.0.0.1"
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.heat_tpu_spool = spool
+        self._httpd.heat_tpu_max_age_s = max_age_s
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.5},
+            name="heat-tpu-exporter",
+            daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def host(self) -> str:
+        return self._httpd.server_address[0]
+
+    def url(self, route: str = "/metrics") -> str:
+        host = self.host if self.host not in ("0.0.0.0", "::") else "127.0.0.1"
+        return f"http://{host}:{self.port}{route}"
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+
+_SERVER: Optional[MetricsServer] = None
+_SERVER_LOCK = threading.Lock()
+
+
+def start(
+    port: int = 0,
+    host: Optional[str] = None,
+    spool: Optional[str] = None,
+    max_age_s: Optional[float] = None,
+) -> MetricsServer:
+    """Start (or return) the process-default exporter server."""
+    global _SERVER
+    with _SERVER_LOCK:
+        if _SERVER is None:
+            _SERVER = MetricsServer(port=port, host=host, spool=spool, max_age_s=max_age_s)
+        return _SERVER
+
+
+def stop() -> None:
+    """Stop the process-default server (idempotent)."""
+    global _SERVER
+    with _SERVER_LOCK:
+        srv, _SERVER = _SERVER, None
+    if srv is not None:
+        srv.stop()
+
+
+def running() -> bool:
+    """Whether the process-default server is up (off-mode inertness: with
+    ``HEAT_TPU_METRICS_PORT`` unset this must stay False — zero threads,
+    zero sockets)."""
+    return _SERVER is not None
+
+
+def port() -> Optional[int]:
+    """The bound port of the process-default server, or None."""
+    return _SERVER.port if _SERVER is not None else None
+
+
+def maybe_start() -> Optional[MetricsServer]:
+    """Arm the exporter iff ``HEAT_TPU_METRICS_PORT`` is a positive int —
+    run once at ``heat_tpu.monitoring`` import. Unset/0/invalid = no
+    thread, no socket, no side effect; a bind failure (e.g. a child
+    process inheriting the parent's port) warns and degrades, never
+    raises."""
+    raw = os.environ.get("HEAT_TPU_METRICS_PORT", "").strip()
+    if not raw:
+        return None
+    try:
+        p = int(raw)
+    except ValueError:
+        return None
+    if p <= 0:
+        return None
+    try:
+        return start(port=p)
+    except OSError as e:
+        _LOG.warning("metrics exporter could not bind port %s: %s", p, e)
+        return None
+
+
+# ------------------------------------------------------------------ CLI
+_USAGE = """usage: python -m heat_tpu.monitoring.exporter [--spool DIR] [--max-age S]
+                                              (--once [--out FILE] | --port N)
+
+Standalone scrape surface for a telemetry spool directory (or, without
+--spool, this process's own registry — mostly useful for --once debugging):
+
+  --spool DIR   aggregate <DIR>/<pid>-<nonce>.json snapshots (fleet mode)
+  --max-age S   treat snapshots older than S seconds as stale (skipped)
+  --once        print the Prometheus exposition once and exit
+  --out FILE    write --once output to FILE instead of stdout
+  --port N      serve /metrics /healthz /readyz /statusz until interrupted
+"""
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+
+    def take(flag):
+        if flag in argv:
+            i = argv.index(flag)
+            try:
+                val = argv[i + 1]
+            except IndexError:
+                return "", False
+            del argv[i : i + 2]
+            return val, True
+        return None, True
+
+    spool, ok1 = take("--spool")
+    max_age_raw, ok2 = take("--max-age")
+    out_path, ok3 = take("--out")
+    port_raw, ok4 = take("--port")
+    once = "--once" in argv
+    if once:
+        argv.remove("--once")
+    if not (ok1 and ok2 and ok3 and ok4) or argv or (not once and port_raw is None):
+        sys.stderr.write(_USAGE)
+        return 2
+    max_age = None
+    if max_age_raw is not None:
+        try:
+            max_age = float(max_age_raw)
+        except ValueError:
+            sys.stderr.write(_USAGE)
+            return 2
+    if once:
+        text = (
+            fleet_exposition(spool, max_age_s=max_age) if spool else exposition()
+        )
+        if out_path:
+            with open(out_path, "w") as f:
+                f.write(text)
+        else:
+            sys.stdout.write(text)
+        return 0
+    try:
+        p = int(port_raw)
+    except ValueError:
+        sys.stderr.write(_USAGE)
+        return 2
+    srv = MetricsServer(port=p, spool=spool, max_age_s=max_age)
+    sys.stderr.write(f"serving on {srv.url('/')} (ctrl-c to stop)\n")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover — exercised via subprocess tests
+    # `python -m` executes this file as `__main__` — delegate to the
+    # canonical import so CLI state (default server, counters) is shared
+    # with the runtime hooks (the flight-CLI precedent).
+    from heat_tpu.monitoring import exporter as _canonical
+
+    sys.exit(_canonical.main())

@@ -473,7 +473,15 @@ def export_chrome_trace() -> str:
     measured ``dur``, point events and flight records without a duration as
     ``dur: 0`` — carrying ``ts``/``dur`` in microseconds, the OS thread id,
     and the record's attributes under ``args``. Events are sorted by ``ts``
-    (the viewer requires monotone timestamps per process)."""
+    (the viewer requires monotone timestamps per process).
+
+    Multi-process merging (ISSUE 14 satellite): every event carries the
+    real ``pid``, and the export leads with ``ph: "M"`` metadata events —
+    one ``process_name`` plus a ``thread_name`` per distinct tid — so
+    traces from several processes concatenated by
+    :func:`heat_tpu.monitoring.aggregate.merge_chrome_traces` render as
+    separate named tracks in Perfetto instead of interleaving anonymously
+    (PR 13 emitted tids only)."""
     pid = os.getpid()
     evs: List[dict] = []
     for r in _events.records():
@@ -494,8 +502,31 @@ def export_chrome_trace() -> str:
         )
     evs.extend(_flight_trace_events(pid))
     evs.sort(key=lambda e: e["ts"])
+    meta: List[dict] = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": pid,
+            "tid": 0,
+            "args": {"name": f"heat_tpu pid {pid}"},
+        }
+    ]
+    main_tid = threading.main_thread().ident
+    for tid in sorted({e["tid"] for e in evs}):
+        label = "main" if tid == main_tid else f"thread {tid}"
+        meta.append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": tid,
+                "args": {"name": label},
+            }
+        )
     return json.dumps(
-        {"traceEvents": evs, "displayTimeUnit": "ms"}, sort_keys=True, default=str
+        {"traceEvents": meta + evs, "displayTimeUnit": "ms"},
+        sort_keys=True,
+        default=str,
     )
 
 

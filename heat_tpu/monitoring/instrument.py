@@ -81,6 +81,11 @@ __all__ = [
     "serving_deadline_miss",
     "serving_queue_depth",
     "serving_janitor",
+    "telemetry_spool_snapshot",
+    "telemetry_spool_merge",
+    "exporter_request",
+    "slo_evaluation",
+    "slo_scale_signal",
     "breaker_transition",
     "chaos_fire",
     "integrity",
@@ -161,12 +166,20 @@ def collective(kind: str) -> None:
     REGISTRY.counter("comm.collective").inc(label=kind)
 
 
-def collective_timeout(kind: str) -> None:
+def collective_timeout(kind: str, seconds: Optional[float] = None) -> None:
     """One collective dispatch that exceeded the
     ``HEAT_TPU_COLLECTIVE_TIMEOUT_MS`` deadline in flight (counted + logged,
     never interrupted — the PR 9 dispatch-watchdog semantics applied to the
-    distributed layer; evidence for the elastic supervisor)."""
+    distributed layer; evidence for the elastic supervisor). With
+    ``seconds`` (the measured blocking dispatch time of the overrun — the
+    watchdog already paid the ``block_until_ready``), the overrun also
+    lands in the ``comm.collective_timeout_latency`` histogram so
+    ``report.telemetry()`` can export the uniform ``{count, p50_us,
+    p99_us}`` latency shape (ISSUE 14 satellite) beside the per-kind
+    counter."""
     REGISTRY.counter("comm.collective_timeout").inc(label=kind)
+    if seconds is not None:
+        REGISTRY.histogram("comm.collective_timeout_latency", _DISPATCH_BOUNDS).observe(seconds)
 
 
 def elastic_transition(state: str) -> None:
@@ -352,6 +365,39 @@ def serving_janitor(kind: str, n: int = 1) -> None:
     quarantined / orphans — mixed units by design, the labels are the
     content)."""
     REGISTRY.counter("serving.janitor").inc(int(n), label=kind)
+
+
+def telemetry_spool_snapshot(kind: str) -> None:
+    """One cross-process telemetry-spool snapshot attempt
+    (``telemetry_spool.snapshots{written,error}`` — the writer side of
+    :mod:`heat_tpu.monitoring.aggregate`; errors are counted, never
+    raised)."""
+    REGISTRY.counter("telemetry_spool.snapshots").inc(label=kind)
+
+
+def telemetry_spool_merge(kind: str, n: int = 1) -> None:
+    """Aggregator-side spool accounting
+    (``telemetry_spool.merge{merged,torn,stale,superseded}`` — the
+    footer-discipline ledger: every skipped snapshot is counted, the merge
+    never crashes on someone else's torn file)."""
+    REGISTRY.counter("telemetry_spool.merge").inc(int(n), label=kind)
+
+
+def exporter_request(route: str) -> None:
+    """One request served by the metrics exporter's HTTP plane
+    (``exporter.requests{metrics,healthz,readyz,statusz,trace,not-found}``)."""
+    REGISTRY.counter("exporter.requests").inc(label=route)
+
+
+def slo_evaluation() -> None:
+    """One SLO-engine evaluation pass (``slo.evaluations``)."""
+    REGISTRY.counter("slo.evaluations").inc()
+
+
+def slo_scale_signal(value: float) -> None:
+    """The current scale signal — queue depth × dispatch p99 µs
+    (``slo.scale_signal`` gauge; the ROADMAP item 2 autoscaling input)."""
+    REGISTRY.gauge("slo.scale_signal").set(float(value))
 
 
 def breaker_transition(site: str, state: str) -> None:

@@ -38,6 +38,7 @@ __all__ = [
     "disable",
     "enable",
     "enabled",
+    "merge_snapshots",
     "reset",
     "snapshot",
 ]
@@ -266,3 +267,61 @@ def snapshot() -> dict:
 def reset() -> None:
     """Module-level alias of ``REGISTRY.reset()``."""
     REGISTRY.reset()
+
+
+def merge_snapshots(snaps) -> dict:
+    """Merge several :func:`snapshot`-shaped dicts into one (the fleet
+    aggregation primitive — each input is one process's registry state).
+
+    Counters sum, labels included. Gauges sum — the fleet semantics: queue
+    depths, memory bytes and scale signals are additive across processes
+    (a last-writer-wins merge would silently drop N-1 processes). Histograms
+    sum ``count``/``sum`` always and the bucket counts element-wise when
+    every contributor agrees on the bounds; disagreeing bounds drop the
+    buckets (the count/sum totals stay exact) — quantiles over a fleet of
+    mixed bucket layouts would be a fabricated number."""
+    out = {"counters": {}, "gauges": {}, "histograms": {}}
+    for snap in snaps:
+        if not isinstance(snap, dict):
+            continue
+        for name, val in (snap.get("counters") or {}).items():
+            total = val["total"] if isinstance(val, dict) else val
+            labels = dict(val.get("labels") or {}) if isinstance(val, dict) else {}
+            cur = out["counters"].get(name)
+            if cur is None:
+                out["counters"][name] = {"total": total, "labels": labels} if labels else total
+            else:
+                cur_total = cur["total"] if isinstance(cur, dict) else cur
+                cur_labels = dict(cur.get("labels") or {}) if isinstance(cur, dict) else {}
+                for k, v in labels.items():
+                    cur_labels[k] = cur_labels.get(k, 0) + v
+                merged_total = cur_total + total
+                out["counters"][name] = (
+                    {"total": merged_total, "labels": cur_labels} if cur_labels else merged_total
+                )
+        for name, val in (snap.get("gauges") or {}).items():
+            try:
+                out["gauges"][name] = out["gauges"].get(name, 0.0) + float(val)
+            except (TypeError, ValueError):
+                out["gauges"].setdefault(name, val)
+        for name, h in (snap.get("histograms") or {}).items():
+            if not isinstance(h, dict):
+                continue
+            cur = out["histograms"].get(name)
+            if cur is None:
+                out["histograms"][name] = {
+                    "buckets": list(h.get("buckets") or []),
+                    "counts": list(h.get("counts") or []),
+                    "count": h.get("count", 0),
+                    "sum": h.get("sum", 0.0),
+                }
+            else:
+                cur["count"] += h.get("count", 0)
+                cur["sum"] += h.get("sum", 0.0)
+                if cur.get("buckets") and cur["buckets"] == list(h.get("buckets") or []):
+                    cur["counts"] = [
+                        a + b for a, b in zip(cur["counts"], h.get("counts") or [])
+                    ]
+                else:
+                    cur["buckets"], cur["counts"] = [], []
+    return out

@@ -35,18 +35,24 @@ def _span_summary() -> Dict[str, dict]:
     return out
 
 
-def snapshot() -> dict:
+def snapshot(flush: bool = True) -> dict:
     """Full observability snapshot as a plain (JSON-serialisable) dict.
 
     Exporting is a materialization barrier for the deferred-execution engine:
     pending fused chains are flushed first, so the ``fusion.*`` (and
-    ``jit.*``) counters account for every recorded op."""
-    try:
-        from ..core import fusion as _fusion
+    ``jit.*``) counters account for every recorded op. ``flush=False``
+    skips the barrier — the telemetry-spool writer and the Prometheus
+    exporter (ISSUE 14) use it because a *published* snapshot must be a
+    pure observation: flushing someone else's pending chain from a
+    telemetry thread would alter the execution schedule it is reporting
+    on."""
+    if flush:
+        try:
+            from ..core import fusion as _fusion
 
-        _fusion.flush_pending()
-    except Exception:  # core not importable / partially initialized: export anyway
-        pass
+            _fusion.flush_pending()
+        except Exception:  # core not importable / partially initialized: export anyway
+            pass
     _instrument.sample_memory()
     return {
         "metrics": REGISTRY.snapshot(),
@@ -116,10 +122,12 @@ def render() -> str:
     return "\n".join(lines)
 
 
-def telemetry() -> dict:
+def telemetry(flush: bool = True) -> dict:
     """Compact telemetry block for benchmark output lines: non-zero counters,
-    span counts/totals, compile stats, and device memory (where reported)."""
-    snap = snapshot()
+    span counts/totals, compile stats, and device memory (where reported).
+    ``flush=False`` skips the materialization barrier (see
+    :func:`snapshot`)."""
+    snap = snapshot(flush=flush)
     counters = {}
     for name, val in snap["metrics"]["counters"].items():
         counters[name] = val["total"] if isinstance(val, dict) else val
@@ -181,6 +189,12 @@ def telemetry() -> dict:
         ("checkpoint.ops", "checkpoint_ops"),
         ("preemption.requests", "preemption_requests"),
         ("faults.injected", "faults_injected"),
+        # fleet telemetry plane (ISSUE 14): spool writer/merge outcomes and
+        # the exporter's per-route request accounting — the counters the
+        # exporter-smoke CI legs read back over HTTP
+        ("telemetry_spool.snapshots", "telemetry_spool_snapshots"),
+        ("telemetry_spool.merge", "telemetry_spool_merge"),
+        ("exporter.requests", "exporter_requests"),
     ):
         val = snap["metrics"]["counters"].get(name)
         if isinstance(val, dict) and val.get("labels"):
@@ -220,23 +234,23 @@ def telemetry() -> dict:
     qd = snap["metrics"]["gauges"].get("serving.queue_depth")
     if qd is not None:
         out["serving_queue_depth"] = qd
-    lat = snap["metrics"]["histograms"].get("serving.dispatch_latency")
-    if lat and lat["count"]:
-        out["serving_dispatch_latency"] = {
-            "count": lat["count"],
-            "p50_us": round(_hist_quantile(lat, 0.50) * 1e6, 1),
-            "p99_us": round(_hist_quantile(lat, 0.99) * 1e6, 1),
-        }
-    # L2-miss compile latency (ISSUE 13 satellite): compile time used to be
-    # invisible outside the aggregate jit.compile_seconds sum — the
-    # histogram answers "what does a cold signature cost this process?"
-    comp_lat = snap["metrics"]["histograms"].get("fusion.compile_latency")
-    if comp_lat and comp_lat["count"]:
-        out["fusion_compile_latency"] = {
-            "count": comp_lat["count"],
-            "p50_us": round(_hist_quantile(comp_lat, 0.50) * 1e6, 1),
-            "p99_us": round(_hist_quantile(comp_lat, 0.99) * 1e6, 1),
-        }
+    # latency-histogram export uniformity (ISSUE 14 satellite): the three
+    # latency surfaces — scheduler dispatch, L2-miss compile, and collective
+    # watchdog overruns — all export through ONE shared {count, p50_us,
+    # p99_us} shape via _latency_block (their per-PR shapes had started to
+    # drift; the labelled comm_collective_timeout kind-breakdown stays
+    # exported above as the documented one-release alias)
+    for hist_name, key in (
+        ("serving.dispatch_latency", "serving_dispatch_latency"),
+        # L2-miss compile latency (ISSUE 13 satellite): compile time used to
+        # be invisible outside the aggregate jit.compile_seconds sum — the
+        # histogram answers "what does a cold signature cost this process?"
+        ("fusion.compile_latency", "fusion_compile_latency"),
+        ("comm.collective_timeout_latency", "comm_collective_timeout_latency"),
+    ):
+        h = snap["metrics"]["histograms"].get(hist_name)
+        if h and h["count"]:
+            out[key] = _latency_block(h)
     # execution flight recorder (ISSUE 13): per-signature attribution
     # totals, the modeled-utilization gauge (attributed flops/s over the
     # per-platform peak table), and the ring occupancy — present only when
@@ -249,6 +263,11 @@ def telemetry() -> dict:
             "signatures": len(_flight.totals()),
             "modeled_utilization": _flight.modeled_utilization(),
         }
+    # SLO surface (ISSUE 14): the current scale signal (queue depth ×
+    # dispatch p99 µs) when the engine or exporter has computed one
+    sig = snap["metrics"]["gauges"].get("slo.scale_signal")
+    if sig:
+        out["slo_scale_signal"] = sig
     mem = {k: v for k, v in snap["metrics"]["gauges"].items() if k.startswith("memory.")}
     if mem:
         out["memory"] = mem
@@ -256,6 +275,18 @@ def telemetry() -> dict:
     if comp and comp["count"]:
         out["jit_compile_seconds_total"] = round(comp["sum"], 3)
     return out
+
+
+def _latency_block(h: dict) -> dict:
+    """The shared latency-histogram export shape: ``{count, p50_us,
+    p99_us}`` (ISSUE 14 satellite — every latency surface exports through
+    this one function so the shapes can never drift apart again;
+    regression-pinned by ``test_latency_export_contract``)."""
+    return {
+        "count": h["count"],
+        "p50_us": round(_hist_quantile(h, 0.50) * 1e6, 1),
+        "p99_us": round(_hist_quantile(h, 0.99) * 1e6, 1),
+    }
 
 
 def _hist_quantile(h: dict, q: float) -> float:

@@ -1,0 +1,296 @@
+"""
+Declarative SLO engine: windowed objectives, multi-window burn rates, and
+the ``scale_signal`` the fleet ingress consumes.
+
+ROADMAP item 2 specifies the fleet layer's autoscaling input as "an
+SLO-driven scale signal (queue depth × dispatch p99)". Raw counters cannot
+answer "are we inside the objective *right now*" — a counter only ever grows
+— so this module evaluates declarative objectives over a *window of
+telemetry snapshots* (the cross-process spool's cadence is the window
+clock; see :mod:`~heat_tpu.monitoring.aggregate`) into **burn rates**: the
+fraction of recent snapshots violating the objective, divided by the
+objective's error budget. A burn rate of 1.0 means the budget is being
+consumed exactly as provisioned; >1.0 means faster (alert); ≈0 means
+healthy. Two windows — a short one that reacts and a long one that
+confirms — follow the standard multi-window burn-rate alerting shape, but
+measured in **snapshots, not wall time**: like every robustness knob in
+this repo (breaker cool-downs, fault schedules), the engine is
+call-count-deterministic so a replayed run evaluates identically.
+
+Default objectives (overridable via ``HEAT_TPU_SLO`` — a JSON list, or
+``@/path/to/file.json``):
+
+==================  ========================================================
+``dispatch_p99_us`` scheduler submit-to-materialized p99 (µs, from the
+                    ``serving.dispatch_latency`` histogram) ``<=`` target
+``cache_hit_rate``  combined L1+L2 compilation-cache hit rate ``>=`` target
+``shed_ratio``      admission-control sheds over flushes ``<=`` target
+``queue_depth``     scheduled-but-unfinished flushes ``<=`` target
+``deadline_misses`` new in-flight deadline overruns per snapshot ``<=``
+                    target (a counter *delta*, not the lifetime total)
+==================  ========================================================
+
+Each objective carries a ``budget`` — the allowed violating-snapshot
+fraction. ``evaluate()`` exports one gauge per objective × window
+(``slo.burn[{name}:{window}]``, a dynamic name documented in the metric
+ledger as a template) plus the single ``slo.scale_signal`` gauge:
+
+    ``scale_signal = serving.queue_depth × dispatch p99 (µs)``
+
+— dimensionally "queued work × how slow work currently is", monotone in
+both overload directions, zero when idle. The fleet aggregator combines
+per-process signals as ``(Σ queue_depth) × max(p99)`` (pessimistic on
+latency, additive on backlog).
+
+Everything here is a pure consumer of telemetry dicts: no device, no
+threads, no flush barrier. With no snapshots observed, ``evaluate()``
+reports every burn as 0.0 and ``ok`` — the engine never alarms on absence
+of evidence.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import instrument as _instr
+from .registry import REGISTRY, STATE as _MON
+
+__all__ = [
+    "DEFAULT_OBJECTIVES",
+    "DEFAULT_WINDOWS",
+    "Objective",
+    "SloEngine",
+    "engine",
+    "objectives_from_env",
+    "observe",
+    "evaluate",
+    "scale_signal",
+    "reset",
+]
+
+#: (window-name, window-length-in-snapshots) — short reacts, long confirms.
+DEFAULT_WINDOWS: Tuple[Tuple[str, int], ...] = (("short", 8), ("long", 64))
+
+
+class Objective:
+    """One declarative objective over a telemetry measurement.
+
+    ``op`` is ``"<="`` (measurement must stay at or below ``target``) or
+    ``">="`` (at or above). ``budget`` is the allowed fraction of violating
+    snapshots per window (the error budget the burn rate is measured
+    against). ``metric`` names the extractor (default: same as ``name``);
+    snapshots where the measurement is unavailable (e.g. no dispatch has
+    ever been observed) are skipped, never counted as violations."""
+
+    __slots__ = ("name", "metric", "op", "target", "budget")
+
+    def __init__(self, name, metric=None, op="<=", target=0.0, budget=0.05):
+        if op not in ("<=", ">="):
+            raise ValueError(f"objective op must be '<=' or '>=', got {op!r}")
+        if not 0.0 < float(budget) <= 1.0:
+            raise ValueError(f"objective budget must be in (0, 1], got {budget!r}")
+        self.name = str(name)
+        self.metric = str(metric or name)
+        self.op = op
+        self.target = float(target)
+        self.budget = float(budget)
+
+    def violated(self, value: float) -> bool:
+        return value > self.target if self.op == "<=" else value < self.target
+
+    def _asdict(self) -> dict:
+        return {
+            "name": self.name,
+            "metric": self.metric,
+            "op": self.op,
+            "target": self.target,
+            "budget": self.budget,
+        }
+
+
+#: The out-of-the-box objective set (generous targets — the point of the
+#: defaults is a working burn-rate surface, not a tuned alert policy; a
+#: deployment overrides them via ``HEAT_TPU_SLO``).
+DEFAULT_OBJECTIVES: Tuple[Objective, ...] = (
+    Objective("dispatch_p99_us", op="<=", target=100_000.0, budget=0.05),
+    Objective("cache_hit_rate", op=">=", target=0.5, budget=0.10),
+    Objective("shed_ratio", op="<=", target=0.01, budget=0.10),
+    Objective("queue_depth", op="<=", target=64.0, budget=0.05),
+    Objective("deadline_misses", op="<=", target=0.0, budget=0.05),
+)
+
+
+def _counter_total(tel: dict, name: str):
+    val = (tel.get("counters") or {}).get(name, 0)
+    return float(val) if isinstance(val, (int, float)) else 0.0
+
+
+def _measure(metric: str, tel: dict, prev: Optional[dict]) -> Optional[float]:
+    """Extract one measurement from a compact telemetry dict (None =
+    unavailable this snapshot). ``prev`` is the previous snapshot's
+    telemetry — counter-delta metrics difference against it."""
+    if metric == "dispatch_p99_us":
+        lat = tel.get("serving_dispatch_latency")
+        return float(lat["p99_us"]) if lat and lat.get("p99_us") is not None else None
+    if metric == "cache_hit_rate":
+        slo = tel.get("serving_cache_slo")
+        if not slo or slo.get("hit_rate") is None:
+            return None
+        return float(slo["hit_rate"])
+    if metric == "shed_ratio":
+        flushes = _counter_total(tel, "fusion.flushes")
+        if flushes <= 0:
+            return None
+        return _counter_total(tel, "serving.shed") / flushes
+    if metric == "queue_depth":
+        qd = tel.get("serving_queue_depth")
+        return float(qd) if qd is not None else 0.0
+    if metric == "deadline_misses":
+        cur = _counter_total(tel, "serving.deadline_miss")
+        if prev is None:
+            return cur
+        return max(0.0, cur - _counter_total(prev, "serving.deadline_miss"))
+    # unknown metric: treat a bare counter name as its lifetime total so a
+    # config can target any ledger counter without a code change
+    if (tel.get("counters") or {}).get(metric) is not None:
+        return _counter_total(tel, metric)
+    return None
+
+
+def scale_signal(tel: dict) -> float:
+    """``queue_depth × dispatch p99 (µs)`` from one telemetry dict — the
+    quantity ROADMAP item 2's ingress consumes. 0.0 when idle or when no
+    dispatch latency has ever been observed."""
+    qd = tel.get("serving_queue_depth") or 0
+    lat = tel.get("serving_dispatch_latency") or {}
+    p99 = lat.get("p99_us") or 0.0
+    return float(qd) * float(p99)
+
+
+def objectives_from_env() -> Tuple[Objective, ...]:
+    """The objective set: ``HEAT_TPU_SLO`` (a JSON list of objective dicts,
+    or ``@/path`` to a JSON file) when set and parseable, else the
+    defaults. A malformed spec raises ``ValueError`` — a typo'd SLO config
+    silently falling back to defaults would be an alerting hole."""
+    spec = os.environ.get("HEAT_TPU_SLO", "").strip()
+    if not spec:
+        return DEFAULT_OBJECTIVES
+    if spec.startswith("@"):
+        with open(spec[1:], "r") as f:
+            spec = f.read()
+    try:
+        rows = json.loads(spec)
+        if not isinstance(rows, list):
+            raise TypeError("HEAT_TPU_SLO must be a JSON list")
+        return tuple(Objective(**row) for row in rows)
+    except (ValueError, TypeError, KeyError) as e:
+        raise ValueError(f"malformed HEAT_TPU_SLO spec: {e}") from e
+
+
+class SloEngine:
+    """Windowed burn-rate evaluator over a bounded snapshot history.
+
+    ``observe(telemetry)`` appends one snapshot's measurements;
+    ``evaluate()`` folds the resident window into per-objective,
+    per-window burn rates and updates the ``slo.*`` gauges. History is
+    bounded by the longest window — memory is O(windows), not O(run)."""
+
+    def __init__(
+        self,
+        objectives: Optional[Sequence[Objective]] = None,
+        windows: Optional[Sequence[Tuple[str, int]]] = None,
+    ):
+        self.objectives = tuple(objectives) if objectives is not None else None
+        self.windows = tuple(windows or DEFAULT_WINDOWS)
+        maxlen = max(n for _, n in self.windows)
+        self._samples: deque = deque(maxlen=maxlen)
+        self._prev_tel: Optional[dict] = None
+        self._last_signal = 0.0
+
+    def _objectives(self) -> Tuple[Objective, ...]:
+        return self.objectives if self.objectives is not None else objectives_from_env()
+
+    def observe(self, tel: dict) -> dict:
+        """Fold one compact telemetry dict (``report.telemetry()`` shape)
+        into the window. Returns the extracted measurements."""
+        sample: Dict[str, Optional[float]] = {}
+        for obj in self._objectives():
+            sample[obj.name] = _measure(obj.metric, tel, self._prev_tel)
+        self._samples.append(sample)
+        self._prev_tel = {
+            "counters": dict(tel.get("counters") or {}),
+        }
+        self._last_signal = scale_signal(tel)
+        return sample
+
+    def evaluate(self) -> dict:
+        """Burn rates per objective × window plus the scale signal.
+
+        ``burn = violating-snapshot fraction / budget`` over the window's
+        resident samples (samples where the measurement was unavailable are
+        excluded from the denominator). Updates the ``slo.burn[...]``
+        template gauges and ``slo.scale_signal``; counted
+        ``slo.evaluations``."""
+        samples = list(self._samples)
+        out: Dict[str, dict] = {}
+        for obj in self._objectives():
+            row: dict = {"target": obj.target, "op": obj.op, "budget": obj.budget, "windows": {}}
+            vals = [s.get(obj.name) for s in samples]
+            row["value"] = next((v for v in reversed(vals) if v is not None), None)
+            ok = True
+            for wname, wlen in self.windows:
+                wvals = [v for v in vals[-wlen:] if v is not None]
+                violations = sum(1 for v in wvals if obj.violated(v))
+                frac = violations / len(wvals) if wvals else 0.0
+                burn = frac / obj.budget
+                row["windows"][wname] = {
+                    "samples": len(wvals),
+                    "violations": violations,
+                    "burn": round(burn, 4),
+                }
+                ok = ok and burn < 1.0
+                if _MON.enabled:
+                    name, window = obj.name, wname
+                    REGISTRY.gauge(f"slo.burn[{name}:{window}]").set(round(burn, 4))
+            row["ok"] = ok
+            out[obj.name] = row
+        if _MON.enabled:
+            _instr.slo_evaluation()
+            _instr.slo_scale_signal(self._last_signal)
+        return {"objectives": out, "scale_signal": round(self._last_signal, 4)}
+
+    def reset(self) -> None:
+        self._samples.clear()
+        self._prev_tel = None
+        self._last_signal = 0.0
+
+
+_ENGINE: Optional[SloEngine] = None
+
+
+def engine() -> SloEngine:
+    """The process-default engine (fed by the telemetry spool's cadence and
+    the exporter's scrape handler)."""
+    global _ENGINE
+    if _ENGINE is None:
+        _ENGINE = SloEngine()
+    return _ENGINE
+
+
+def observe(tel: dict) -> dict:
+    """Module-level alias of ``engine().observe``."""
+    return engine().observe(tel)
+
+
+def evaluate() -> dict:
+    """Module-level alias of ``engine().evaluate``."""
+    return engine().evaluate()
+
+
+def reset() -> None:
+    """Drop the default engine's window (test isolation)."""
+    global _ENGINE
+    _ENGINE = None

@@ -88,6 +88,7 @@ __all__ = [
     "breaker",
     "enabled",
     "forced_open",
+    "open_sites",
     "reset",
     "states",
 ]
@@ -240,6 +241,25 @@ def breaker(site: str) -> CircuitBreaker:
 def states() -> Dict[str, str]:
     """Current state per instantiated breaker (diagnostics / telemetry)."""
     return {site: b.state() for site, b in sorted(_BREAKERS.items())}
+
+
+def open_sites() -> list:
+    """Sites currently refusing their primary path — ``open`` or pinned by
+    ``HEAT_TPU_BREAKER_FORCE_OPEN`` (checked for *every* known site, not
+    just instantiated breakers: a fresh process under the forced-open CI
+    leg has no breaker objects yet but is still degraded). Half-open is
+    deliberately not listed — a probe is in flight, the site is
+    recovering. This is the readiness input ``/readyz`` consumes
+    (ISSUE 14)."""
+    out = []
+    for site in BREAKER_SITES:
+        if forced_open(site):
+            out.append(site)
+            continue
+        b = _BREAKERS.get(site)
+        if b is not None and enabled() and b._state == "open":
+            out.append(site)
+    return out
 
 
 def reset(site: Optional[str] = None) -> None:

@@ -71,6 +71,7 @@ __all__ = [
     "ELASTIC_RESTART_EXIT",
     "ElasticSupervisor",
     "PeerLostError",
+    "last_state",
     "survivors",
 ]
 
@@ -83,6 +84,24 @@ _DEFAULT_MISS_THRESHOLD = 3
 
 #: supervisor states, in the order the happy degradation path visits them
 STATES = ("healthy", "degraded", "draining", "saving", "saved", "restart-pending")
+
+#: Last supervisor state observed process-wide (None until a supervisor
+#: transitions) — the readiness input the exporter's /readyz consumes
+#: (ISSUE 14). Updated unconditionally by every transition: readiness must
+#: flip even when no monitoring/flight gate is armed.
+_LAST_STATE: Optional[str] = None
+
+
+def _note_state(state: str) -> None:
+    global _LAST_STATE
+    _LAST_STATE = state
+
+
+def last_state() -> Optional[str]:
+    """The last elastic-supervisor state this process transitioned to, or
+    None when no supervisor ever ran (a process that never supervised is
+    considered healthy by the readiness probe)."""
+    return _LAST_STATE
 
 
 def _miss_threshold_default() -> int:
@@ -189,6 +208,11 @@ class ElasticSupervisor:
     def _to(self, state: str) -> None:
         if state != self._state:
             self._state = state
+            # readiness input (ISSUE 14): the exporter's /readyz reads the
+            # last supervisor state process-wide, independent of the
+            # monitoring/flight gates — a draining process must flip its
+            # readiness even when nobody armed a recorder
+            _note_state(state)
             if _MON.enabled:
                 _instr.elastic_transition(state)
             if _FL.flight_enabled():

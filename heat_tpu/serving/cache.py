@@ -468,6 +468,13 @@ def persist(cache_dir_: str, digest: str, compiled) -> bool:
         # is unset; with a bound, evict LRU entries so the cache never
         # exceeds it by more than the entry just written
         _janitor.maybe_sweep(cache_dir_)
+        # cross-process telemetry spool (ISSUE 14): every L2 persist is a
+        # cadence trigger (fresh-compile activity is exactly what a fleet
+        # operator wants published promptly) — one env read when
+        # HEAT_TPU_TELEMETRY_DIR is unset
+        from ..monitoring import aggregate as _agg
+
+        _agg.maybe_snapshot()
         return True
     except (KeyboardInterrupt, SystemExit):
         raise

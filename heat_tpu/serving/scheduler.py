@@ -69,6 +69,7 @@ import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Iterable, Optional
 
+from ..monitoring import aggregate as _agg
 from ..monitoring import events as _events
 from ..monitoring import flight as _flight
 from ..monitoring import instrument as _instr
@@ -248,6 +249,12 @@ class FlushScheduler:
             finally:
                 if dispatched and _MON.enabled:
                     _instr.serving_dispatch(time.perf_counter() - t0)
+                if dispatched:
+                    # cross-process telemetry spool (ISSUE 14): the
+                    # per-flush-count cadence trigger — one env read when
+                    # HEAT_TPU_TELEMETRY_DIR is unset, an atomic snapshot
+                    # write every Nth dispatched flush when armed
+                    _agg.maybe_snapshot()
                 with self._cond:
                     self._inflight -= 1
                     self._gauge()

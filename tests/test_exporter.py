@@ -1,0 +1,601 @@
+"""
+Fleet telemetry plane (ISSUE 14): Prometheus exposition + served endpoints
+(``heat_tpu/monitoring/exporter.py``), the cross-process telemetry spool +
+aggregator (``monitoring/aggregate.py``), and the SLO burn-rate engine
+(``monitoring/slo.py``). Covers: parse-clean exposition with the full
+metric catalog present at zero, catalog↔source drift, label escaping and
+the label-sum == total residual rule, the HTTP routes + request counters,
+readiness flips on forced-open breakers / elastic degradation / SLO burn,
+off-mode inertness (zero threads/sockets/files, bit-for-bit results), the
+per-flush-count spool cadence and its scheduler/cache trigger sites, the
+aggregator's torn/stale/superseded tolerance (incl. a live two-writer +
+aggregator race), fleet exposition with per-process labels and the fleet
+scale signal, SLO window/burn math + env config, the uniform latency
+export shape (satellite), merged multi-process Chrome traces with
+process/thread metadata (satellite), the bench telemetry sidecar
+(satellite), and the standalone spool-scrape CLI.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.core import fusion
+from heat_tpu.monitoring import aggregate, events, exporter, flight, registry, report, slo
+from heat_tpu.monitoring import instrument as instr
+from heat_tpu.monitoring.registry import REGISTRY
+from heat_tpu.robustness import breaker as rbreaker
+from heat_tpu.robustness import elastic as relastic
+
+pytestmark = pytest.mark.exporter
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """Every knob off on both sides; the armed CI legs set them ambiently,
+    so counting tests pin their own state via monkeypatch (the flight-suite
+    precedent)."""
+    for var in (
+        "HEAT_TPU_METRICS_PORT",
+        "HEAT_TPU_METRICS_HOST",
+        "HEAT_TPU_TELEMETRY_DIR",
+        "HEAT_TPU_TELEMETRY_EVERY",
+        "HEAT_TPU_SLO",
+        "HEAT_TPU_READY_MIN_HIT_RATE",
+        "HEAT_TPU_READY_MAX_BURN",
+        "HEAT_TPU_BREAKER_FORCE_OPEN",
+        "HEAT_TPU_FLIGHT",
+        "HEAT_TPU_CACHE_DIR",
+        "HEAT_TPU_FAULT_PLAN",
+        "HEAT_TPU_CHAOS",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("HEAT_TPU_FUSION", "1")
+    monkeypatch.setattr(relastic, "_LAST_STATE", None)
+    registry.reset()
+    events.clear()
+    flight.clear()
+    slo.reset()
+    aggregate.reset()
+    rbreaker.reset()
+    fusion.clear_cache()
+    yield
+    exporter.stop()
+    fusion.clear_cache()
+    rbreaker.reset()
+    slo.reset()
+    aggregate.reset()
+    flight.clear()
+    events.clear()
+    registry.reset()
+    monkeypatch.setattr(relastic, "_LAST_STATE", None)
+
+
+def _fresh(shape=(6, 10), seed=0, split=None):
+    data = np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+    return ht.array(data, split=split)
+
+
+def _chain(x):
+    return (x * 2.0 + 1.0) / 3.0 - 0.25
+
+
+def _get(url, timeout=10):
+    """(status, body) — 4xx/5xx bodies read instead of raised."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# ------------------------------------------------------------- exposition
+def test_exposition_parse_clean_with_live_counters():
+    with registry.capture():
+        _chain(_fresh(seed=1)).numpy()
+        y = _chain(_fresh(seed=2)).sum()
+        float(y.larray)
+    text = exporter.exposition()
+    assert exporter.validate_exposition(text) == []
+    lines = text.splitlines()
+    # unlabelled counter: a bare sample with the recorded value
+    flushes = REGISTRY.counter("fusion.flushes").get()
+    assert f"heat_tpu_fusion_flushes_total {flushes}" in lines
+    # labelled counter: one series per label; the label sum equals the total
+    reason_lines = [l for l in lines if l.startswith("heat_tpu_fusion_flush_reason_total{")]
+    assert reason_lines
+    total = sum(int(l.rsplit(" ", 1)[1]) for l in reason_lines)
+    assert total == REGISTRY.counter("fusion.flush_reason").get()
+    # histogram: summary exposition with quantiles + _sum/_count
+    assert any(l.startswith('heat_tpu_fusion_chain_length{quantile="0.5"}') for l in lines)
+    assert any(l.startswith("heat_tpu_fusion_chain_length_sum") for l in lines)
+    assert any(l.startswith("heat_tpu_fusion_chain_length_count") for l in lines)
+    # the point-in-time scale signal always rides along
+    assert any(l.startswith("heat_tpu_scale_signal ") for l in lines)
+
+
+def test_exposition_catalog_complete_at_zero():
+    """Acceptance: a fresh process's first scrape already carries every
+    ledger metric (zero-valued) — the scrape schema never depends on which
+    code paths have run."""
+    text = exporter.exposition()
+    assert exporter.validate_exposition(text) == []
+    for name, kind in exporter.CATALOG:
+        mname = exporter.metric_name(name, "_total" if kind == "counter" else "")
+        probe = f"{mname}_count 0" if kind == "histogram" else f"{mname} 0"
+        assert probe in text.splitlines(), (name, probe)
+
+
+def test_catalog_matches_source():
+    """Drift guard: the exposition catalog is the code-side twin of the doc
+    ledger — every statically-named REGISTRY metric in heat_tpu/ (same grep
+    as the ledger guard) must appear, minus the ``{...}`` f-string
+    templates the exposition cannot pre-render."""
+    metric_re = re.compile(r'REGISTRY\.(counter|gauge|histogram)\(\s*f?"([^"]+)"')
+    found = set()
+    for dirpath, _dirs, files in os.walk(os.path.join(_REPO, "heat_tpu")):
+        if "__pycache__" in dirpath:
+            continue
+        for fname in files:
+            if fname.endswith(".py"):
+                with open(os.path.join(dirpath, fname)) as f:
+                    src = f.read()
+                for kind, name in metric_re.findall(src):
+                    if "{" not in name:
+                        found.add((name, kind))
+    assert found == set(exporter.CATALOG)
+
+
+def test_label_escaping_and_unattributed_residual():
+    with registry.capture():
+        c = REGISTRY.counter("serving.shed")
+        c.inc(3, label='weird"label\\x')
+        c.inc(2)  # unattributed: no label
+    text = exporter.exposition()
+    assert exporter.validate_exposition(text) == []
+    lines = text.splitlines()
+    assert 'heat_tpu_serving_shed_total{label="weird\\"label\\\\x"} 3' in lines
+    # the residual keeps sum(series) == counter total
+    assert 'heat_tpu_serving_shed_total{label=""} 2' in lines
+
+
+def test_gauge_bracket_names_become_labels():
+    with registry.capture():
+        REGISTRY.gauge("memory.bytes_in_use[0]").set(1234)
+        name, window = "dispatch_p99_us", "short"
+        REGISTRY.gauge(f"slo.burn[{name}:{window}]").set(0.5)
+    text = exporter.exposition()
+    assert exporter.validate_exposition(text) == []
+    assert 'heat_tpu_memory_bytes_in_use{device="0"} 1234' in text.splitlines()
+    assert (
+        'heat_tpu_slo_burn{objective="dispatch_p99_us",window="short"} 0.5'
+        in text.splitlines()
+    )
+
+
+# ------------------------------------------------------------- HTTP plane
+def test_server_routes_and_request_counters():
+    srv = exporter.MetricsServer(port=0)
+    try:
+        with registry.capture():
+            code, text = _get(srv.url("/metrics"))
+            assert code == 200 and exporter.validate_exposition(text) == []
+            code, body = _get(srv.url("/healthz"))
+            h = json.loads(body)
+            assert code == 200 and h["ok"] is True and h["pid"] == os.getpid()
+            code, body = _get(srv.url("/readyz"))
+            r = json.loads(body)
+            assert code == 200 and r["ready"] is True and r["reasons"] == []
+            code, body = _get(srv.url("/statusz"))
+            assert code == 200 and json.loads(body)["ok"] is True
+            code, body = _get(srv.url("/trace"))
+            assert code == 200 and "traceEvents" in json.loads(body)
+            code, body = _get(srv.url("/nonsense"))
+            assert code == 404
+        reqs = REGISTRY.counter("exporter.requests")
+        for route in ("metrics", "healthz", "readyz", "statusz", "trace", "not-found"):
+            assert reqs.get(route) == 1, route
+    finally:
+        srv.stop()
+
+
+def test_readyz_flips_on_breakers_elastic_and_back(monkeypatch):
+    srv = exporter.MetricsServer(port=0)
+    try:
+        assert _get(srv.url("/readyz"))[0] == 200
+        # forced-open breakers (the CI degraded leg): every known site is a
+        # reason even though no breaker object was ever instantiated
+        monkeypatch.setenv("HEAT_TPU_BREAKER_FORCE_OPEN", "*")
+        code, body = _get(srv.url("/readyz"))
+        payload = json.loads(body)
+        assert code == 503 and payload["ready"] is False
+        assert set(payload["reasons"]) == {
+            f"breaker:{s}" for s in rbreaker.BREAKER_SITES
+        }
+        monkeypatch.delenv("HEAT_TPU_BREAKER_FORCE_OPEN")
+        assert _get(srv.url("/readyz"))[0] == 200
+        # elastic degradation (the supervisor's _to hook updates the
+        # process-wide readiness input unconditionally)
+        monkeypatch.setattr(relastic, "_LAST_STATE", None)
+        relastic._note_state("draining")
+        code, body = _get(srv.url("/readyz"))
+        assert code == 503 and json.loads(body)["reasons"] == ["elastic:draining"]
+        relastic._note_state("healthy")
+        assert _get(srv.url("/readyz"))[0] == 200
+    finally:
+        srv.stop()
+
+
+def test_readyz_slo_burn_ceiling(monkeypatch):
+    """HEAT_TPU_READY_MAX_BURN wires the SLO engine into readiness: a
+    long-window burn above the ceiling flips /readyz."""
+    monkeypatch.setenv("HEAT_TPU_READY_MAX_BURN", "1.0")
+    eng = slo.engine()
+    hot = {"serving_dispatch_latency": {"count": 5, "p50_us": 1.0, "p99_us": 5e8},
+           "counters": {}}
+    for _ in range(8):
+        eng.observe(hot)
+    ready, reasons = exporter.readiness()
+    assert not ready and any(r.startswith("slo-burn:dispatch_p99_us") for r in reasons)
+
+
+def test_off_mode_zero_threads_sockets_files(tmp_path):
+    """Acceptance: all knobs unset = zero threads, zero sockets, zero
+    files, and results bit-for-bit with the armed run (differential)."""
+    assert exporter.maybe_start() is None
+    assert not exporter.running() and exporter.port() is None
+    assert not any(t.name == "heat-tpu-exporter" for t in threading.enumerate())
+    # spool off: the trigger is one env read, no file anywhere
+    aggregate.maybe_snapshot()
+    assert aggregate.write_snapshot() is None
+    assert list(tmp_path.iterdir()) == []
+    base = _chain(_fresh(seed=11, split=0)).numpy()
+    # arm everything, recompute: bit-identical (pure observer)
+    os.environ["HEAT_TPU_TELEMETRY_DIR"] = str(tmp_path)
+    os.environ["HEAT_TPU_TELEMETRY_EVERY"] = "1"
+    try:
+        srv = exporter.start(port=0)
+        fusion.clear_cache()
+        armed = _chain(_fresh(seed=11, split=0)).numpy()
+        aggregate.maybe_snapshot()
+        assert list(tmp_path.glob("*.json"))
+        assert _get(srv.url("/healthz"))[0] == 200
+    finally:
+        os.environ.pop("HEAT_TPU_TELEMETRY_DIR", None)
+        os.environ.pop("HEAT_TPU_TELEMETRY_EVERY", None)
+        exporter.stop()
+    np.testing.assert_array_equal(base, armed)
+
+
+# ------------------------------------------------------------- spool
+def test_spool_cadence_first_then_every_nth(monkeypatch, tmp_path):
+    monkeypatch.setenv("HEAT_TPU_TELEMETRY_DIR", str(tmp_path))
+    monkeypatch.setenv("HEAT_TPU_TELEMETRY_EVERY", "3")
+    with registry.capture():
+        for _ in range(7):  # writes at triggers 1, 3, 6
+            aggregate.maybe_snapshot()
+    files = list(tmp_path.glob("*.json"))
+    assert len(files) == 1  # one file per process, overwritten in place
+    snap = json.loads(files[0].read_text())
+    assert snap["seq"] == 3
+    assert snap["pid"] == os.getpid()
+    assert files[0].name == f"{snap['pid']}-{snap['nonce']}.json"
+    assert snap["labels"]["pid"] == str(os.getpid())
+    for key in ("metrics", "telemetry", "flight", "slo", "time", "schema"):
+        assert key in snap, key
+    assert REGISTRY.counter("telemetry_spool.snapshots").get("written") == 3
+
+
+def test_spool_triggered_by_scheduler_and_cache(monkeypatch, tmp_path):
+    """The two runtime trigger sites: a dispatched scheduler flush and an
+    L2 persist both advance the cadence."""
+    from heat_tpu import serving
+
+    monkeypatch.setenv("HEAT_TPU_TELEMETRY_DIR", str(tmp_path / "spool"))
+    monkeypatch.setenv("HEAT_TPU_TELEMETRY_EVERY", "1")
+    with serving.FlushScheduler(max_workers=2) as sched:
+        x = _chain(_fresh(seed=21))
+        sched.schedule(x).result()
+    files = list((tmp_path / "spool").glob("*.json"))
+    assert len(files) == 1, "scheduler dispatch must trigger a snapshot"
+    first = json.loads(files[0].read_text())["seq"]
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path / "cache"))
+    fusion.clear_cache()
+    _chain(_fresh(seed=22)).numpy()  # L2 miss -> compile -> persist -> trigger
+    later = json.loads(files[0].read_text())["seq"]
+    assert later > first, "L2 persist must trigger a snapshot"
+
+
+def test_spool_snapshot_is_barrier_free(monkeypatch, tmp_path):
+    """Publishing telemetry must not flush pending fused chains — the
+    snapshot is a pure observation of the schedule, not a participant."""
+    monkeypatch.setenv("HEAT_TPU_TELEMETRY_DIR", str(tmp_path))
+    x = _chain(_fresh(seed=31))  # pending
+    assert x._expr() is not None
+    assert aggregate.write_snapshot(str(tmp_path)) is not None
+    assert x._expr() is not None, "write_snapshot flushed a pending chain"
+
+
+def test_aggregator_tolerates_torn_stale_superseded(tmp_path):
+    def put(name, payload):
+        (tmp_path / name).write_text(payload if isinstance(payload, str) else json.dumps(payload))
+
+    now = time.time()
+    base = {"schema": 1, "host": "h", "metrics": {"counters": {"fusion.flushes": 4}},
+            "telemetry": {"serving_queue_depth": 2,
+                          "serving_dispatch_latency": {"count": 9, "p50_us": 50.0, "p99_us": 100.0}},
+            "seq": 1}
+    put("111-aaaa.json", dict(base, pid=111, nonce="aaaa", time=now))
+    put("222-bbbb.json", dict(base, pid=222, nonce="bbbb", time=now,
+                              metrics={"counters": {"fusion.flushes": 6}}))
+    put("333-cccc.json", '{"pid": 333, "nonce": "cc')         # torn mid-replace
+    put(".tmp-999.json", "ignored")                            # writer tempfile
+    put("444-dddd.json", dict(base, pid=444, nonce="dddd", time=now - 3600))  # stale
+    put("111-eeee.json", dict(base, pid=111, nonce="eeee", time=now + 1))     # pid reuse
+    with registry.capture():
+        snaps, skips = aggregate.read_snapshots(str(tmp_path), max_age_s=600)
+    assert skips == {"merged": 2, "torn": 1, "stale": 1, "superseded": 1}
+    keys = {(s["pid"], s["nonce"]) for s in snaps}
+    assert keys == {(111, "eeee"), (222, "bbbb")}  # newest nonce won the pid
+    mc = REGISTRY.counter("telemetry_spool.merge")
+    assert mc.get("torn") == 1 and mc.get("stale") == 1 and mc.get("superseded") == 1
+    view = aggregate.fleet_view(str(tmp_path), max_age_s=600)
+    assert set(view["processes"]) == {"111-eeee", "222-bbbb"}
+    assert view["metrics"]["counters"]["fusion.flushes"] == 10
+    # fleet scale signal: (sum queue depth) x (max p99)
+    assert view["scale_signal"] == pytest.approx((2 + 2) * 100.0)
+
+
+def test_fleet_exposition_per_process_labels(tmp_path):
+    now = time.time()
+    for pid, n in ((111, "aaaa"), (222, "bbbb")):
+        (tmp_path / f"{pid}-{n}.json").write_text(json.dumps({
+            "schema": 1, "pid": pid, "nonce": n, "time": now, "seq": 1,
+            "metrics": {"counters": {"fusion.flushes": pid},
+                        "gauges": {"serving.queue_depth": 1},
+                        "histograms": {}},
+            "telemetry": {"serving_queue_depth": 1,
+                          "serving_dispatch_latency": {"count": 3, "p50_us": 10.0, "p99_us": 20.0}},
+        }))
+    text = exporter.fleet_exposition(str(tmp_path))
+    assert exporter.validate_exposition(text) == []
+    lines = text.splitlines()
+    assert 'heat_tpu_fusion_flushes_total{pid="111",nonce="aaaa"} 111' in lines
+    assert 'heat_tpu_fusion_flushes_total{pid="222",nonce="bbbb"} 222' in lines
+    assert "heat_tpu_fleet_processes 2" in lines
+    assert any(l.startswith("heat_tpu_scale_signal ") for l in lines)
+    assert 'heat_tpu_telemetry_spool_skips{kind="merged"} 2' in lines
+
+
+def test_registry_merge_snapshots():
+    a = {"counters": {"x": 3, "y": {"total": 5, "labels": {"a": 2, "b": 3}}},
+         "gauges": {"g": 1.5},
+         "histograms": {"h": {"buckets": [1.0, 2.0], "counts": [1, 0, 2], "count": 3, "sum": 4.0}}}
+    b = {"counters": {"x": 4, "y": {"total": 1, "labels": {"b": 1}}},
+         "gauges": {"g": 2.5},
+         "histograms": {"h": {"buckets": [1.0, 2.0], "counts": [0, 1, 0], "count": 1, "sum": 1.5}}}
+    m = registry.merge_snapshots([a, b])
+    assert m["counters"]["x"] == 7
+    assert m["counters"]["y"] == {"total": 6, "labels": {"a": 2, "b": 4}}
+    assert m["gauges"]["g"] == 4.0
+    assert m["histograms"]["h"] == {
+        "buckets": [1.0, 2.0], "counts": [1, 1, 2], "count": 4, "sum": 5.5}
+    # disagreeing bounds: totals stay exact, buckets are dropped (a quantile
+    # over mixed layouts would be fabricated)
+    c = {"histograms": {"h": {"buckets": [9.0], "counts": [1, 0], "count": 1, "sum": 9.0}}}
+    m2 = registry.merge_snapshots([a, c])
+    assert m2["histograms"]["h"]["count"] == 4
+    assert m2["histograms"]["h"]["buckets"] == []
+
+
+def test_two_writers_and_aggregator_race(tmp_path):
+    """Satellite: two writer processes + this process aggregating, racing
+    over one spool dir, with torn/stale/duplicate garbage injected mid-race
+    — every merged view stays well-formed and the skips are counted."""
+    prog = (
+        "import os\n"
+        "os.environ['HEAT_TPU_TELEMETRY_DIR'] = r'%s'\n"
+        "os.environ['HEAT_TPU_TELEMETRY_EVERY'] = '1'\n"
+        "os.environ['HEAT_TPU_MONITORING'] = '1'\n"
+        "from heat_tpu.monitoring import aggregate, registry\n"
+        "from heat_tpu.monitoring.registry import REGISTRY\n"
+        "for i in range(12):\n"
+        "    REGISTRY.counter('fusion.flushes').inc()\n"
+        "    aggregate.maybe_snapshot()\n"
+        "print('done')\n" % str(tmp_path)
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("HEAT_TPU_METRICS_PORT", None)
+    procs = [
+        subprocess.Popen([sys.executable, "-c", prog], env=env, cwd=_REPO,
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for _ in range(2)
+    ]
+    # garbage the aggregator must shrug off, injected while writers run
+    (tmp_path / "777-torn.json").write_text('{"pid": 777, "non')
+    (tmp_path / "888-gone.json").write_text(json.dumps(
+        {"schema": 1, "pid": 888, "nonce": "gone", "time": time.time() - 9999,
+         "metrics": {}, "telemetry": {}, "seq": 1}))
+    deadline = time.time() + 240
+    while any(p.poll() is None for p in procs) and time.time() < deadline:
+        view = aggregate.fleet_view(str(tmp_path), max_age_s=600)
+        assert isinstance(view["processes"], dict)  # never raises, always shaped
+        time.sleep(0.05)
+    outs = [p.communicate(timeout=240) for p in procs]
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, err[-800:]
+        assert "done" in out
+    snaps, skips = aggregate.read_snapshots(str(tmp_path), max_age_s=600)
+    pids = {s["pid"] for s in snaps}
+    assert {p.pid for p in procs} <= pids
+    assert skips["torn"] == 1 and skips["stale"] == 1
+    view = aggregate.fleet_view(str(tmp_path), max_age_s=600)
+    assert view["metrics"]["counters"]["fusion.flushes"] == 24
+
+
+# ------------------------------------------------------------- SLO engine
+def _tel(p99=None, hit_rate=None, qd=0, misses=0, flushes=10, shed=0):
+    tel = {"counters": {"fusion.flushes": flushes, "serving.shed": shed,
+                        "serving.deadline_miss": misses},
+           "serving_queue_depth": qd}
+    if p99 is not None:
+        tel["serving_dispatch_latency"] = {"count": 5, "p50_us": p99 / 2, "p99_us": p99}
+    if hit_rate is not None:
+        tel["serving_cache_slo"] = {"hit_rate": hit_rate}
+    return tel
+
+
+def test_slo_windows_and_burn_math():
+    eng = slo.SloEngine(objectives=(
+        slo.Objective("dispatch_p99_us", op="<=", target=100.0, budget=0.25),),
+        windows=(("short", 4), ("long", 8)))
+    for p99 in (50, 50, 200, 50, 50, 50, 200, 50):  # 2/8 violations, 1/4 short
+        eng.observe(_tel(p99=p99))
+    ev = eng.evaluate()
+    row = ev["objectives"]["dispatch_p99_us"]
+    assert row["windows"]["short"] == {"samples": 4, "violations": 1, "burn": 1.0}
+    assert row["windows"]["long"] == {"samples": 8, "violations": 2, "burn": 1.0}
+    assert row["ok"] is False  # burn >= 1.0: the budget is fully consumed
+    assert row["value"] == 50.0
+
+
+def test_slo_measurement_extractors():
+    eng = slo.SloEngine()
+    s1 = eng.observe(_tel(p99=10.0, hit_rate=0.9, qd=3, misses=2, flushes=100, shed=5))
+    assert s1["dispatch_p99_us"] == 10.0
+    assert s1["cache_hit_rate"] == 0.9
+    assert s1["shed_ratio"] == pytest.approx(0.05)
+    assert s1["queue_depth"] == 3.0
+    assert s1["deadline_misses"] == 2.0  # first sample: the lifetime total
+    s2 = eng.observe(_tel(p99=10.0, misses=5))
+    assert s2["deadline_misses"] == 3.0  # counter delta, not the total
+    s3 = eng.observe({"counters": {}})
+    assert s3["dispatch_p99_us"] is None  # unavailable, never a violation
+    assert slo.scale_signal(_tel(p99=200.0, qd=4)) == 800.0
+    assert slo.scale_signal({"counters": {}}) == 0.0
+
+
+def test_slo_gauges_and_telemetry_export():
+    with registry.capture():
+        eng = slo.engine()
+        eng.observe(_tel(p99=5e8, qd=2))  # violates the default 100ms target
+        ev = eng.evaluate()
+    assert ev["scale_signal"] == 2 * 5e8
+    g = REGISTRY.gauge("slo.burn[dispatch_p99_us:short]").get()
+    assert g > 1.0
+    assert REGISTRY.counter("slo.evaluations").get() == 1
+    tel = report.telemetry()
+    assert tel["slo_scale_signal"] == 2 * 5e8
+
+
+def test_slo_env_config(monkeypatch):
+    monkeypatch.setenv(
+        "HEAT_TPU_SLO",
+        json.dumps([{"name": "qd", "metric": "queue_depth", "op": "<=",
+                     "target": 1, "budget": 0.5}]),
+    )
+    objs = slo.objectives_from_env()
+    assert len(objs) == 1 and objs[0].name == "qd" and objs[0].target == 1.0
+    monkeypatch.setenv("HEAT_TPU_SLO", "{not json")
+    with pytest.raises(ValueError):
+        slo.objectives_from_env()
+    # a malformed config must not take /metrics down with it
+    assert exporter.validate_exposition(exporter.exposition()) == []
+    with pytest.raises(ValueError):
+        slo.Objective("x", op="==", target=1)
+    with pytest.raises(ValueError):
+        slo.Objective("x", budget=0.0)
+
+
+# ------------------------------------------------------------- satellites
+def test_latency_export_contract(monkeypatch):
+    """Satellite: the three latency surfaces export through ONE shared
+    {count, p50_us, p99_us} shape, and the labelled per-kind
+    comm_collective_timeout breakdown survives as the documented alias."""
+    with registry.capture():
+        instr.serving_dispatch(0.002)
+        instr.fusion_compile_latency(0.05)
+        instr.collective_timeout("allreduce", seconds=0.3)
+        tel = report.telemetry()
+    shape = {"count", "p50_us", "p99_us"}
+    for key in ("serving_dispatch_latency", "fusion_compile_latency",
+                "comm_collective_timeout_latency"):
+        assert set(tel[key]) == shape, key
+        assert tel[key]["count"] == 1
+        assert tel[key]["p99_us"] >= tel[key]["p50_us"] > 0
+    assert tel["comm_collective_timeout"] == {"allreduce": 1}  # alias kept
+
+
+def test_merged_chrome_traces_render_separate_tracks(monkeypatch):
+    """Satellite: per-process pid tags + process_name/thread_name metadata
+    survive an aggregator merge — Perfetto renders one track per process."""
+    monkeypatch.setenv("HEAT_TPU_FLIGHT", "1")
+    with registry.capture():
+        with events.span("req"):
+            _chain(_fresh(seed=41)).numpy()
+        mine = flight.export_chrome_trace()
+    other = {
+        "traceEvents": [
+            {"name": "process_name", "ph": "M", "pid": 99999, "tid": 0,
+             "args": {"name": "heat_tpu pid 99999"}},
+            {"name": "flush deadbeef", "cat": "flight.flush", "ph": "X",
+             "ts": 1.0, "dur": 2.0, "pid": 99999, "tid": 7, "args": {}},
+        ]
+    }
+    merged = json.loads(aggregate.merge_chrome_traces([mine, other, "{not json"]))
+    evs = merged["traceEvents"]
+    metas = [e for e in evs if e["ph"] == "M"]
+    timed = [e for e in evs if e["ph"] != "M"]
+    assert evs[: len(metas)] == metas  # metadata leads after the merge
+    assert {e["pid"] for e in metas if e["name"] == "process_name"} == {os.getpid(), 99999}
+    assert {e["pid"] for e in timed} == {os.getpid(), 99999}
+    ts = [e["ts"] for e in timed]
+    assert ts == sorted(ts)  # re-sorted across processes
+
+
+def test_bench_sidecar_snapshot(tmp_path):
+    """Satellite: the bench writes the full labelled snapshot + flight
+    summary beside its JSON output via write_snapshot(path=...)."""
+    with registry.capture():
+        _chain(_fresh(seed=51)).numpy()
+        out = tmp_path / "BENCH_TELEMETRY.json"
+        payload = aggregate.write_snapshot(path=str(out))
+    assert payload is not None and out.exists()
+    snap = json.loads(out.read_text())
+    assert snap["metrics"]["counters"]["fusion.flushes"] >= 1
+    # labels preserved — the whole point of the sidecar vs the compact block
+    assert "labels" in snap["metrics"]["counters"]["fusion.flush_reason"]
+    assert set(snap["flight"]) == {"enabled", "records", "evicted", "signatures",
+                                   "modeled_utilization"}
+    assert snap["telemetry"]["counters"]["fusion.flushes"] >= 1
+
+
+def test_exporter_cli_once_over_spool(tmp_path):
+    (tmp_path / "111-aaaa.json").write_text(json.dumps({
+        "schema": 1, "pid": 111, "nonce": "aaaa", "time": time.time(), "seq": 2,
+        "metrics": {"counters": {"fusion.flushes": 7}}, "telemetry": {}}))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "heat_tpu.monitoring.exporter",
+         "--spool", str(tmp_path), "--once"],
+        env=env, cwd=_REPO, capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, out.stderr[-800:]
+    assert exporter.validate_exposition(out.stdout) == []
+    assert 'heat_tpu_fusion_flushes_total{pid="111",nonce="aaaa"} 7' in out.stdout
+    assert "heat_tpu_fleet_processes 1" in out.stdout
+    bad = subprocess.run(
+        [sys.executable, "-m", "heat_tpu.monitoring.exporter", "--bogus"],
+        env=env, cwd=_REPO, capture_output=True, text=True, timeout=240)
+    assert bad.returncode == 2 and "usage:" in bad.stderr

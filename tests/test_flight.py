@@ -189,11 +189,25 @@ def test_chrome_trace_schema(monkeypatch):
             float(y.larray)
         _chain(_fresh(seed=8)).numpy()
         trace = json.loads(flight.export_chrome_trace())
-    evs = trace["traceEvents"]
+    all_evs = trace["traceEvents"]
+    # ISSUE 14 satellite: metadata events lead — one process_name plus a
+    # thread_name per distinct tid, all tagged with the real pid — so
+    # aggregator-merged multi-process traces render as separate tracks
+    meta = [e for e in all_evs if e["ph"] == "M"]
+    evs = [e for e in all_evs if e["ph"] != "M"]
+    assert all_evs[: len(meta)] == meta  # metadata strictly first
+    assert {e["name"] for e in meta} == {"process_name", "thread_name"}
+    assert all(e["pid"] == os.getpid() for e in meta)
+    pname = next(e for e in meta if e["name"] == "process_name")
+    assert str(os.getpid()) in pname["args"]["name"]
+    assert {e["tid"] for e in meta if e["name"] == "thread_name"} == {
+        e["tid"] for e in evs
+    }
     assert isinstance(evs, list) and len(evs) >= 3  # span + >=2 flight records
     for e in evs:
         assert set(("name", "ph", "ts", "dur", "pid", "tid")) <= set(e)
         assert e["ph"] == "X"
+        assert e["pid"] == os.getpid()
         assert isinstance(e["ts"], float) and isinstance(e["tid"], int)
         assert e["dur"] >= 0.0
     ts = [e["ts"] for e in evs]
