@@ -806,6 +806,12 @@ def main():
                     "janitor_bytes_after": None,
                     "janitor_evicted": None,
                     "janitor_valid": None,
+                    "fleet_cold_compiles": None,
+                    "fleet_cold_valid": None,
+                    "fleet_p50_us": None,
+                    "fleet_p99_us": None,
+                    "fleet_goodput_rps": None,
+                    "fleet_valid": None,
                     "serving_error": repr(e)[:160],
                 }
         # pallas kernel tier anchors (ISSUE 10): ring_attention_step_gbps —

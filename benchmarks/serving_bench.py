@@ -29,6 +29,11 @@ Three anchor groups, wired into ``bench.py`` with the null-key crash-dict +
   past a size bound with the same mix and sweeps: ``janitor_valid``
   requires eviction down to <= the bound with the hit-rate SLO telemetry
   still intact afterwards.
+* ``fleet_cold_compiles`` / ``fleet_p50_us`` / ``fleet_p99_us`` /
+  ``fleet_goodput_rps`` (ISSUE 15, see :func:`bench_fleet`) — the recorded
+  multi-tenant trace through a real 2-worker HTTP ingress: the cold-fleet
+  zero-compile contract against a warmed cache dir, and client-side
+  latency/goodput with the PR 9 chaos schedule running underneath.
 
 Run: python benchmarks/serving_bench.py
 """
@@ -248,13 +253,97 @@ def bench_janitor():
         fusion.clear_cache()
 
 
+def bench_fleet(n_requests: int = 72):
+    """Fleet serving anchors (ISSUE 15): the recorded multi-tenant trace
+    driven through a real 2-worker ingress.
+
+    * ``fleet_cold_compiles`` (+ ``fleet_cold_valid``) — the cold-fleet
+      acceptance bar: a FRESH 2-worker server against a cache dir warmed by
+      a previous fleet must serve the whole trace with
+      ``fusion.kernels_compiled == 0`` in EVERY worker (read from each
+      worker's telemetry-spool snapshot).
+    * ``fleet_p50_us`` / ``fleet_p99_us`` / ``fleet_goodput_rps``
+      (+ ``fleet_valid``) — exact client-side percentiles and digest-correct
+      responses per wall second, measured with the PR 9 seeded chaos
+      schedule running underneath in the workers (recovery ladders carry
+      part of the traffic; ``fleet_valid`` requires zero wrong results).
+
+    Workers are CPU-pinned like the cold-restart anchor: the anchor measures
+    the fleet machinery, not backend init; a TPU host rides the identical
+    machinery under its own cache fingerprint.
+    """
+    from heat_tpu.monitoring import aggregate
+    from heat_tpu.serving import loadgen
+    from heat_tpu.serving.server import Ingress
+
+    reqs = loadgen.trace(n=n_requests)
+    expected = loadgen.expected_digests(reqs)
+    with tempfile.TemporaryDirectory(prefix="heat-tpu-fleet-bench-") as tmp:
+        cache = os.path.join(tmp, "cache")
+        env = {"JAX_PLATFORMS": "cpu", "HEAT_TPU_TELEMETRY_EVERY": "1"}
+        for var in (
+            "HEAT_TPU_FAULT_PLAN", "HEAT_TPU_CHAOS",
+            "HEAT_TPU_BREAKER_FORCE_OPEN", "HEAT_TPU_SHAPE_BUCKETS",
+        ):
+            env[var] = ""
+
+        def drive(extra_env, spool=None, concurrency=4):
+            ing = Ingress(
+                workers=2, cache_dir=cache, spool=spool,
+                env={**env, **extra_env},
+            ).start()
+            try:
+                return loadgen.run(
+                    ing.url(), reqs, concurrency=concurrency, expected=expected
+                )
+            finally:
+                ing.stop()
+
+        warm = drive({})  # phase 1: the first fleet warms the shared L2
+        spool = os.path.join(tmp, "spool")
+        os.makedirs(spool)
+        cold = drive({}, spool=spool)  # phase 2: cold-fleet contract
+        snaps, _skips = aggregate.read_snapshots(spool)
+        per_worker = []
+        for s in snaps:
+            c = s["metrics"]["counters"].get("fusion.kernels_compiled", 0)
+            per_worker.append(int(c["total"] if isinstance(c, dict) else c))
+        cold_compiles = sum(per_worker) if per_worker else None
+        # phase 3: latency/goodput under standing chaos in the workers
+        loaded = drive({"HEAT_TPU_CHAOS": "20260805:0.05"}, concurrency=6)
+
+    cold_valid = (
+        warm["mismatches"] == 0 and warm["errors"] == 0
+        and cold["mismatches"] == 0 and cold["errors"] == 0
+        and len(per_worker) == 2
+        and cold_compiles == 0
+    )
+    fleet_valid = (
+        loaded["mismatches"] == 0
+        and loaded["errors"] == 0
+        and loaded["ok"] >= 50
+        and (loaded["p50_us"] or 0) > 0
+    )
+    return {
+        "fleet_cold_compiles": cold_compiles,
+        "fleet_cold_valid": bool(cold_valid),
+        "fleet_p50_us": loaded["p50_us"],
+        "fleet_p99_us": loaded["p99_us"],
+        "fleet_goodput_rps": loaded["goodput_rps"],
+        "fleet_shed": loaded["shed"],
+        "fleet_valid": bool(fleet_valid),
+    }
+
+
 def bench_serving():
     """All serving anchors as one flat dict (the bench.py contract)."""
     bucketed, unbucketed, waste, bucket_valid = bench_bucketing()
     p50, p99, lat_valid = bench_dispatch_latency()
     jan_before, jan_bound, jan_after, jan_evicted, jan_valid = bench_janitor()
     cold_compiles, cold_hits, cold_valid = bench_cold_restart()
+    fleet = bench_fleet()
     return {
+        **fleet,
         "cold_restart_compiles": cold_compiles,
         "cold_restart_disk_hits": cold_hits,
         "cold_restart_valid": cold_valid,

@@ -345,6 +345,25 @@ def _cache_max() -> int:
         return 4096
 
 
+def _l1_cache():
+    """The flush's L1 slice: ``(cache, tenant)`` — the shared trace LRU, or,
+    with ``HEAT_TPU_TENANCY`` armed and this thread tagged by the serving
+    scheduler, the tenant's bounded partition (ISSUE 15: one tenant's
+    shape-diverse burst evicts only its own entries; the persistent L2 stays
+    shared). Untagged work — library calls, tests, anything outside a
+    ``tenancy.tenant_context`` — always gets the shared cache, so the armed
+    knob alone changes nothing (one env read when off)."""
+    spec = os.environ.get("HEAT_TPU_TENANCY", "").strip()
+    if not spec or spec.lower() in ("0", "false", "off"):
+        return _TRACE_CACHE, None
+    from ..serving import tenancy as _tenancy
+
+    tenant = _tenancy.current_tenant()
+    if tenant is None:
+        return _TRACE_CACHE, None
+    return _tenancy.l1_partition(tenant), tenant
+
+
 # ------------------------------------------------------------------ whitelists
 #
 # Only elementwise, shape-preserving jnp callables are recordable: the fused
@@ -2102,7 +2121,7 @@ def cache_info() -> dict:
     abstract-eval memo's occupancy/capacity (``eval_entries``/``eval_max`` —
     the two caches are sized and cleared together; see :func:`clear_cache`)."""
     ev = _eval_node_cached.cache_info()
-    return {
+    info = {
         "entries": len(_TRACE_CACHE),
         "max": _cache_max(),
         "poisoned": len(_POISONED),
@@ -2111,6 +2130,14 @@ def cache_info() -> dict:
         "eval_max": ev.maxsize,
         **_cache_stats,
     }
+    # per-tenant L1 partition occupancy (ISSUE 15) — attached only when
+    # tenancy is armed so the off-mode dict is byte-identical to PR 14
+    spec = os.environ.get("HEAT_TPU_TENANCY", "").strip()
+    if spec and spec.lower() not in ("0", "false", "off"):
+        from ..serving import tenancy as _tenancy
+
+        info["l1_partitions"] = _tenancy.partition_info()
+    return info
 
 
 def clear_cache() -> None:
@@ -2124,6 +2151,12 @@ def clear_cache() -> None:
     _POISONED.clear()
     _BUCKET_OOM.clear()
     _eval_node_cached.cache_clear()
+    try:
+        from ..serving import tenancy as _tenancy
+
+        _tenancy.clear_partitions()
+    except Exception:  # serving package mid-import: nothing partitioned yet
+        pass
 
 
 def _topo(root: _Node):
@@ -2261,7 +2294,9 @@ def _audit_flush(values, program, leaf_arrays, out_idx, donate, key, stable_prog
     if _MON.enabled:
         _instr.integrity("mismatch")
     if key is not None:
-        _TRACE_CACHE.pop(key, None)
+        # same thread as the flush: the tenant context (and so the L1 slice
+        # the broken executable was stored in) is still installed
+        _l1_cache()[0].pop(key, None)
     _poison(key)
     cache_dir = os.environ.get("HEAT_TPU_CACHE_DIR", "").strip()
     if cache_dir and stable_prog is not None:
@@ -2369,8 +2404,9 @@ def _flush_ladder(
         if has_coll:
             _BRK.breaker("collective.dispatch").record_failure()
         if key is not None:
-            # never hand the broken executable to a future flush
-            _TRACE_CACHE.pop(key, None)
+            # never hand the broken executable to a future flush (the ladder
+            # runs on the flush's own thread, so the tenant L1 slice matches)
+            _l1_cache()[0].pop(key, None)
         values = None
         if cls == "oom" and debucket is not None:
             # the padded bucket temporaries are the likeliest extra memory in
@@ -2649,9 +2685,10 @@ def materialize_for(d: DNDarray):
                 return values
 
     leaf_key = _leaf_cache_key(leaf_arrays)
+    l1, l1_tenant = _l1_cache()
     try:
         key = (tuple(key_prog), leaf_key, donate, out_idx)
-        fused = _TRACE_CACHE.get(key)
+        fused = l1.get(key)
     except TypeError:  # unhashable sharding — compile uncached
         key, fused = None, None
 
@@ -2756,15 +2793,24 @@ def materialize_for(d: DNDarray):
                     compile_t0 = None
         if key is not None:
             if compiled or from_disk:
-                _TRACE_CACHE[key] = fused
+                l1[key] = fused
                 _cache_stats["misses"] += 1
-                limit = _cache_max()
-                while len(_TRACE_CACHE) > limit:
-                    _TRACE_CACHE.popitem(last=False)
+                if l1_tenant is None:
+                    limit = _cache_max()
+                else:
+                    from ..serving import tenancy as _tenancy
+
+                    limit = _tenancy.l1_capacity(l1_tenant, _cache_max())
+                while len(l1) > limit:
+                    l1.popitem(last=False)
                     _cache_stats["evictions"] += 1
+                    if l1_tenant is not None:
+                        from ..serving import tenancy as _tenancy
+
+                        _tenancy.count_eviction(l1_tenant)
             else:
                 try:
-                    _TRACE_CACHE.move_to_end(key)
+                    l1.move_to_end(key)
                 except KeyError:  # concurrent eviction (scheduler threads)
                     pass
                 _cache_stats["hits"] += 1

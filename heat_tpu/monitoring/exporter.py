@@ -150,14 +150,17 @@ CATALOG: Tuple[Tuple[str, str], ...] = (
     ("robustness.chaos", "counter"),
     ("robustness.elastic", "counter"),
     ("robustness.integrity", "counter"),
+    ("serving.batch", "counter"),
     ("serving.bucket", "counter"),
     ("serving.corpus", "counter"),
     ("serving.deadline_miss", "counter"),
     ("serving.disk_cache", "counter"),
     ("serving.dispatch_latency", "histogram"),
+    ("serving.ingress", "counter"),
     ("serving.janitor", "counter"),
     ("serving.queue_depth", "gauge"),
     ("serving.shed", "counter"),
+    ("serving.tenant", "counter"),
     ("serving.warmup", "counter"),
     ("slo.evaluations", "counter"),
     ("slo.scale_signal", "gauge"),
@@ -210,6 +213,8 @@ def _gauge_series(name: str) -> Tuple[str, Dict[str, str]]:
     if base == "slo.burn" and ":" in arg:
         obj, win = arg.split(":", 1)
         return metric_name(base), {"objective": obj, "window": win}
+    if base == "serving.tenant_depth":
+        return metric_name(base), {"tenant": arg}
     return metric_name(base), {"key": arg}
 
 

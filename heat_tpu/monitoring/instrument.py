@@ -81,6 +81,10 @@ __all__ = [
     "serving_deadline_miss",
     "serving_queue_depth",
     "serving_janitor",
+    "serving_batch",
+    "serving_tenant",
+    "serving_tenant_depth",
+    "serving_ingress",
     "telemetry_spool_snapshot",
     "telemetry_spool_merge",
     "exporter_request",
@@ -362,9 +366,46 @@ def serving_queue_depth(depth: int) -> None:
 
 def serving_janitor(kind: str, n: int = 1) -> None:
     """One disk-cache janitor outcome (kind: runs / evicted / evicted_bytes /
-    quarantined / orphans — mixed units by design, the labels are the
-    content)."""
+    quarantined / orphans / cost-evicted — a cost card dropped beside its
+    evicted L2 entry / cost-orphans — age-gated sweep of cards whose entry
+    was quarantined or evicted elsewhere (ISSUE 15) — mixed units by design,
+    the labels are the content)."""
     REGISTRY.counter("serving.janitor").inc(int(n), label=kind)
+
+
+def serving_batch(kind: str, n: int = 1) -> None:
+    """Continuous-batching accounting (``serving.batch``, ISSUE 15; kind:
+    coalesced — requests that rode a batched dispatch; flushes_saved —
+    dispatches avoided, Σ (group size − 1); pad_waste_bytes — bucket-pad
+    bytes appended across batched leaves; fallback — members of a failed
+    batched attempt recovered through individual flushes). Mixed units by
+    design — the labels are the content."""
+    REGISTRY.counter("serving.batch").inc(int(n), label=kind)
+
+
+def serving_tenant(tenant: str, event: str, n: int = 1) -> None:
+    """Per-tenant fairness accounting (``serving.tenant{<tenant>:<event>}``,
+    ISSUE 15; event: scheduled / shed-queue-full — the tenant's weighted
+    admission share overflowed under the shed policy / shed-deadline /
+    deadline-miss / l1-evict — an eviction inside the tenant's own L1
+    partition, the proof evictions never cross tenants)."""
+    REGISTRY.counter("serving.tenant").inc(int(n), label=f"{tenant}:{event}")
+
+
+def serving_tenant_depth(tenant: str, depth: int) -> None:
+    """One tenant's scheduled-but-unfinished flushes (gauge; the bracketed
+    dynamic-name convention — the exporter folds it into a ``tenant``
+    label)."""
+    REGISTRY.gauge(f"serving.tenant_depth[{tenant}]").set(int(depth))
+
+
+def serving_ingress(kind: str, n: int = 1) -> None:
+    """One multi-process ingress event (``serving.ingress``, ISSUE 15; kind:
+    routed — a request forwarded to a worker / rerouted — retried on another
+    worker after a connection-level failure / shed — no live worker, 503 /
+    worker-dead — a worker marked dead / respawned — a dead worker
+    restarted)."""
+    REGISTRY.counter("serving.ingress").inc(int(n), label=kind)
 
 
 def telemetry_spool_snapshot(kind: str) -> None:

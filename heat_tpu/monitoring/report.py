@@ -169,11 +169,21 @@ def telemetry(flush: bool = True) -> dict:
         # elastic multi-host runtime breakdowns (ISSUE 11): supervisor state
         # transitions + peer-loss evidence, and collective dispatches that
         # overran the watchdog deadline in flight
+        # NB (ISSUE 15 satellite): the labelled `comm_collective_timeout`
+        # telemetry key — the documented ONE-release alias of the uniform
+        # `comm_collective_timeout_latency` {count,p50_us,p99_us} block that
+        # shipped in PR 14 — is retired; the per-kind breakdown stays
+        # readable from the registry counter `comm.collective_timeout`
         ("robustness.elastic", "robustness_elastic"),
-        ("comm.collective_timeout", "comm_collective_timeout"),
         ("serving.shed", "serving_shed"),
         ("serving.deadline_miss", "serving_deadline_miss"),
         ("serving.janitor", "serving_janitor"),
+        # fleet serving tier (ISSUE 15): continuous-batching coalescing
+        # wins, per-tenant fairness accounting, and the ingress's routing/
+        # reroute/shed ledger
+        ("serving.batch", "serving_batch"),
+        ("serving.tenant", "serving_tenant"),
+        ("serving.ingress", "serving_ingress"),
         ("robustness.breaker", "robustness_breakers"),
         ("robustness.chaos", "chaos_fires"),
         # silent-data-corruption defense (ISSUE 12): audit/mismatch/checksum
@@ -238,8 +248,8 @@ def telemetry(flush: bool = True) -> dict:
     # latency surfaces — scheduler dispatch, L2-miss compile, and collective
     # watchdog overruns — all export through ONE shared {count, p50_us,
     # p99_us} shape via _latency_block (their per-PR shapes had started to
-    # drift; the labelled comm_collective_timeout kind-breakdown stays
-    # exported above as the documented one-release alias)
+    # drift; the labelled comm_collective_timeout alias shipped one release
+    # and is now retired, ISSUE 15 satellite)
     for hist_name, key in (
         ("serving.dispatch_latency", "serving_dispatch_latency"),
         # L2-miss compile latency (ISSUE 13 satellite): compile time used to

@@ -31,8 +31,26 @@ cold start without paying a single JIT:
   shed work stays bit-exact because the owner's read still materializes it).
 * :mod:`~heat_tpu.serving.janitor` — disk-cache janitor
   (``HEAT_TPU_CACHE_MAX_BYTES`` + ``python -m heat_tpu.serving.janitor``):
-  LRU-by-mtime eviction to the size bound, corrupt-entry quarantine, and
-  orphaned-tempfile sweep, safe under concurrent multi-process writers.
+  LRU-by-mtime eviction to the size bound, corrupt-entry quarantine,
+  orphaned-tempfile and cost-card sweeps, safe under concurrent
+  multi-process writers.
+* :mod:`~heat_tpu.serving.batching` — continuous batching
+  (``HEAT_TPU_SERVING_BATCH=1``, ISSUE 15): concurrent scheduled flushes
+  sharing a bucketed signature coalesce into ONE batched dispatch along a
+  new leading batch axis (bit-parity by pointwise/bucket construction,
+  counted ``serving.batch{coalesced,flushes_saved,pad_waste_bytes}``).
+* :mod:`~heat_tpu.serving.tenancy` — per-tenant fairness
+  (``HEAT_TPU_TENANCY``): weighted admission shares on the scheduler's
+  queue bound and per-tenant L1 trace-cache partitions over the shared L2,
+  so one tenant's shape-diverse burst cannot evict another's warm kernels.
+* :mod:`~heat_tpu.serving.server` — multi-process HTTP ingress
+  (``python -m heat_tpu.serving.server --workers N``): JSON requests fanned
+  over N worker processes sharing one cache dir, dead-worker
+  reroute/respawn, ``/healthz``+``/readyz``, and the spool-fed fleet
+  ``scale_signal`` autoscaling output.
+* :mod:`~heat_tpu.serving.loadgen` — the wire format, the recorded
+  multi-tenant trace, and the goodput/latency load driver
+  (``python -m heat_tpu.serving.loadgen --url ...``).
 
 Everything is env-gated and inert by default: with no ``HEAT_TPU_CACHE_DIR``
 and no ``HEAT_TPU_SHAPE_BUCKETS`` the flush path is byte-for-byte the PR 7
@@ -44,18 +62,39 @@ behavior (the cold-dir CI leg proves it). Counters: ``serving.disk_cache``
 SLO) in ``report.telemetry()``. See ``doc/serving_notes.md``.
 """
 
-from . import buckets, cache, corpus, janitor, scheduler
+from . import batching, buckets, cache, corpus, janitor, scheduler, tenancy
 from .scheduler import FlushScheduler, flush_all, schedule
 from .warmup import warmup
 
 __all__ = [
+    "batching",
     "buckets",
     "cache",
     "corpus",
     "janitor",
+    "loadgen",
     "scheduler",
+    "server",
+    "tenancy",
     "FlushScheduler",
+    "Ingress",
     "flush_all",
     "schedule",
     "warmup",
 ]
+
+
+def __getattr__(name):
+    # `server` and `loadgen` load lazily (PEP 562): both are runnable with
+    # `python -m`, and an eager import here would race runpy's execution of
+    # the same module (the sys.modules RuntimeWarning); laziness also keeps
+    # the ingress CLI's parent-package import from touching HTTP machinery.
+    if name in ("server", "loadgen"):
+        import importlib
+
+        return importlib.import_module(f".{name}", __name__)
+    if name == "Ingress":
+        from .server import Ingress
+
+        return Ingress
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,422 @@
+"""
+Continuous batching: coalesce concurrent scheduled flushes into one batched
+dispatch (ISSUE 15 — the serving-side twin of the fusion thesis).
+
+XLA fusion amortizes dispatch and memory traffic across the *ops of one
+chain*; a serving process handling many small concurrent requests leaves
+the same win on the table **across requests**: N scheduled flushes of the
+same program shape pay N kernel dispatches (and, shape-diverse, N cold
+compiles). With ``HEAT_TPU_SERVING_BATCH=1`` the flush scheduler routes
+eligible flushes through this coalescer: flushes that share a **bucketed
+signature** — identical stable program (op names, static params, baked
+constants) over leaves of one bucketed shape — wait in a signature-keyed
+group for a short linger window (``HEAT_TPU_SERVING_BATCH_LINGER_MS``,
+default 2 ms) or until the group fills (``HEAT_TPU_SERVING_BATCH_MAX``,
+default 8), then dispatch as **one** kernel over leaves stacked along a
+new leading batch axis. Per-request results are carved back out of the
+batched output (batch row + the bucket slice), so the owners observe
+exactly what a sequential flush would have produced.
+
+**Bit parity by construction.** Eligibility is the aval-bucketing rule
+(``buckets.py``) sharpened for the batch axis:
+
+* every node pointwise — ``binary`` / ``local`` / ``where`` / ``cast``
+  (each output element a function of same-position input elements only, so
+  neither the bucket pad nor a neighbouring batch row can influence a
+  logical element). ``where_glue`` is excluded: its callable bakes the
+  *root shape* into an in-trace ``zeros``, which a batched operand shape
+  would contradict;
+* single-output program with a cross-process-stable identity;
+* every non-scalar leaf shares the root shape, lives on a single device,
+  and no leaf is weak-typed (stacking erases weak types, and a weak scalar
+  promotes differently than its strong stack — the one way a batch could
+  change bits);
+* scalar (0-d) leaves stack to ``(B, 1, …, 1)`` so per-request scalars
+  broadcast against their own batch row only.
+
+Ineligible flushes — reductions, views, GEMMs, collectives, distributed or
+padded operands, multi-output programs — take the unbatched path unchanged.
+``HEAT_TPU_SERVING_BATCH=0`` (or unset — the default) disables coalescing
+entirely: the scheduler's dispatch path is bit-for-bit the PR 14 behavior
+(one env read).
+
+**Caching.** Batched kernels ride the same two-level cache as every fused
+flush: L1 under ``("serving-batch", signature, B)`` in the shared trace LRU
+(shared deliberately — batched kernels are fleet-wide amortization, not a
+per-tenant asset), L2 under the stable digest of the *stacked* avals, so a
+warmed cache dir serves batched traffic with zero XLA compiles and the
+shape corpus/warmup driver rebuild batched kernels like any other.
+
+**Failure discipline.** A failed batched attempt (compile, execute, or an
+injected ``fusion.compile``/``fusion.execute`` fault) is counted
+(``serving.batch{fallback}``) and every member flushes *individually*
+through ``materialize_for`` — the full recovery ladder, bit-identical by
+construction. A member whose owner read it mid-linger is also fine: the
+owner's synchronous flush wins the race and the batch's later (bit-equal)
+write of the same value is benign.
+
+Counters (``serving.batch``): ``coalesced`` — requests that rode a batched
+dispatch; ``flushes_saved`` — dispatches avoided (Σ (group−1));
+``pad_waste_bytes`` — bucket-pad bytes appended across batched leaves;
+``fallback`` — members recovered through individual flushes.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import List, Optional
+
+from ..monitoring import instrument as _instr
+from ..monitoring.registry import STATE as _MON
+from . import buckets as _buckets
+
+__all__ = [
+    "enabled",
+    "batch_max",
+    "linger_s",
+    "offer",
+    "reset",
+]
+
+#: Pointwise node tags a batched replay is proven bit-identical for.
+#: ``where_glue`` (a pointwise tag for *bucketing*) is excluded here: its
+#: recorded callable closes over the unbatched root shape.
+_BATCHABLE_TAGS = frozenset(("binary", "local", "where", "cast"))
+
+_DEFAULT_MAX = 8
+_DEFAULT_LINGER_MS = 2.0
+
+
+def enabled() -> bool:
+    """Whether continuous batching is armed (``HEAT_TPU_SERVING_BATCH=1``;
+    off by default — one env read on the scheduler's dispatch path)."""
+    return os.environ.get("HEAT_TPU_SERVING_BATCH", "").strip().lower() in (
+        "1", "on", "true",
+    )
+
+
+def batch_max() -> int:
+    """Group size that triggers immediate dispatch
+    (``HEAT_TPU_SERVING_BATCH_MAX``, default 8, min 2)."""
+    try:
+        return max(2, int(os.environ.get("HEAT_TPU_SERVING_BATCH_MAX", "") or _DEFAULT_MAX))
+    except ValueError:
+        return _DEFAULT_MAX
+
+
+def linger_s() -> float:
+    """The coalescing window in seconds (``HEAT_TPU_SERVING_BATCH_LINGER_MS``,
+    default 2 ms): how long the first request of a signature waits for
+    company before dispatching whatever arrived."""
+    try:
+        ms = float(os.environ.get("HEAT_TPU_SERVING_BATCH_LINGER_MS", "") or _DEFAULT_LINGER_MS)
+    except ValueError:
+        ms = _DEFAULT_LINGER_MS
+    return max(0.0, ms) / 1000.0
+
+
+class _Plan:
+    """One eligible flush, ready to join a batch group."""
+
+    __slots__ = (
+        "x", "root", "program", "out_idx", "chain", "stable_prog",
+        "leaves", "slicer", "waste", "sig",
+    )
+
+
+class _Group:
+    __slots__ = ("sig", "items", "closed", "full", "done", "failed")
+
+    def __init__(self, sig):
+        self.sig = sig
+        self.items: List[_Plan] = []
+        self.closed = False
+        self.failed = False
+        self.full = threading.Event()
+        self.done = threading.Event()
+
+
+_LOCK = threading.Lock()
+_GROUPS: dict = {}
+
+
+def _plan_for(x) -> Optional[_Plan]:
+    """Batch plan for one pending array, or None when ineligible (the
+    caller then flushes unbatched — always correct)."""
+    from ..core import fusion as _fusion
+
+    expr = getattr(x, "_expr", None)
+    root = expr() if expr is not None else None
+    if root is None or root.value is not None:
+        return None
+    try:
+        (
+            _topo, index_of, program, _key_prog, stable_prog,
+            leaf_arrays, _owners, _rc,
+        ) = _fusion._build_flush(root)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        return None
+    if stable_prog is None:
+        return None
+    for skey, _specs, _kw, _cast in stable_prog:
+        if skey[0] not in _BATCHABLE_TAGS:
+            return None
+    root_shape = tuple(int(d) for d in root.aval.shape)
+    if not root_shape:
+        return None
+    from jax.sharding import SingleDeviceSharding
+
+    leaf_meta = []
+    dtypes = {str(root.aval.dtype)}
+    has_weak = False
+    for a in leaf_arrays:
+        weak = bool(getattr(a, "weak_type", False))
+        if weak:
+            if a.shape != ():
+                return None
+            has_weak = True
+        if a.shape != () and tuple(a.shape) != root_shape:
+            return None
+        if not isinstance(getattr(a, "sharding", None), SingleDeviceSharding):
+            return None
+        dtypes.add(str(a.dtype))
+        leaf_meta.append((a.shape == (), str(a.dtype), weak))
+    if has_weak and len(dtypes) > 1:
+        # stacking erases weak types, and a weak scalar only promotes
+        # differently when it meets a DIFFERENT dtype (e.g. a weak f32
+        # python constant against a bf16 chain) — single-dtype programs are
+        # weakness-invariant, mixed ones decline to the unbatched path
+        return None
+
+    # the bucketed target shape: with a HEAT_TPU_SHAPE_BUCKETS policy armed
+    # the signature shares a group across every logical shape in the bucket
+    # (the "bucketed signature" contract); without one, exact shapes group.
+    bspec = os.environ.get("HEAT_TPU_SHAPE_BUCKETS", "").strip()
+    parsed = _buckets.policy(bspec) if bspec else None
+    bshape = (
+        _buckets.bucket_shape(root_shape, *parsed) if parsed else root_shape
+    )
+
+    sig = (stable_prog, tuple(leaf_meta), bshape)
+    try:
+        hash(sig)
+    except TypeError:
+        return None
+
+    plan = _Plan()
+    plan.x = x
+    plan.root = root
+    plan.program = program
+    plan.out_idx = (index_of[id(root)],)
+    plan.chain = len(program)
+    plan.stable_prog = stable_prog
+    plan.waste = 0
+    if bshape != root_shape:
+        import jax.numpy as jnp
+
+        widths = tuple((0, b - s) for b, s in zip(bshape, root_shape))
+        padded = []
+        for a in leaf_arrays:
+            if a.shape == ():
+                padded.append(a)
+                continue
+            padded.append(jnp.pad(a, widths))
+            plan.waste += (
+                _buckets.np_prod(bshape) - _buckets.np_prod(root_shape)
+            ) * a.dtype.itemsize
+        plan.leaves = padded
+        plan.slicer = tuple(slice(0, s) for s in root_shape)
+    else:
+        plan.leaves = list(leaf_arrays)
+        plan.slicer = None
+    plan.sig = sig
+    return plan
+
+
+def _assign(item: _Plan, value) -> None:
+    """Canonical placement + retained value for one carved-out member (the
+    single-output tail of ``materialize_for``)."""
+    from ..core.communication import MeshCommunication
+
+    owner = item.x
+    split = owner.split
+    comm = owner.comm
+    if (
+        split is not None
+        and isinstance(comm, MeshCommunication)
+        and comm.is_distributed()
+    ):  # pragma: no cover — eligibility admits single-device leaves only
+        value = comm.placed(value, split, owner.shape)
+    item.root.value = value
+
+
+def _dispatch(items: List[_Plan], group: _Group, reason: str) -> None:
+    """Execute one batch group. Never raises: a failed batched attempt
+    marks the group failed and every member recovers through its own
+    unbatched flush (the full ladder)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core import fusion as _fusion
+    from ..robustness import faultinject as _FI
+    from . import cache as _cache
+
+    B = len(items)
+    sig = items[0].sig
+    rank = len(sig[2])
+    try:
+        stacked = []
+        n_leaves = len(items[0].leaves)
+        for j in range(n_leaves):
+            parts = [it.leaves[j] for it in items]
+            col = jnp.stack(parts)
+            if parts[0].shape == ():
+                # per-request scalars broadcast against their own row only
+                col = col.reshape((B,) + (1,) * rank)
+            stacked.append(col)
+
+        key = ("serving-batch", sig, B)
+        fused = _fusion._TRACE_CACHE.get(key)
+        from_disk = False
+        digest = None
+        cache_dir = ""
+        if fused is None:
+            cache_dir = _cache.cache_dir()
+            if cache_dir:
+                digest = _cache.digest_for(
+                    items[0].stable_prog, stacked, (), items[0].out_idx
+                )
+                if digest is not None:
+                    fused = _cache.load(cache_dir, digest)
+                    from_disk = fused is not None
+        compiled = fused is None
+        compile_t0 = None
+        if fused is None:
+            _FI.check("fusion.compile")
+            compile_t0 = time.perf_counter()
+            fused = jax.jit(_fusion._replay_fn(items[0].program, items[0].out_idx))
+            if digest is not None:
+                aot = _cache.store(
+                    cache_dir, digest, fused, stacked,
+                    items[0].stable_prog, (), items[0].out_idx,
+                )
+                if aot is not None:
+                    fused = aot
+                    if _MON.enabled:
+                        _instr.fusion_compile_latency(
+                            time.perf_counter() - compile_t0
+                        )
+                    compile_t0 = None
+        if compiled or from_disk:
+            _fusion._TRACE_CACHE[key] = fused
+            _fusion._cache_stats["misses"] += 1
+            limit = _fusion._cache_max()
+            while len(_fusion._TRACE_CACHE) > limit:
+                _fusion._TRACE_CACHE.popitem(last=False)
+                _fusion._cache_stats["evictions"] += 1
+        else:
+            try:
+                _fusion._TRACE_CACHE.move_to_end(key)
+            except KeyError:  # concurrent clear_cache
+                pass
+            _fusion._cache_stats["hits"] += 1
+
+        if _MON.enabled:
+            # ONE fused flush carried the whole group — that is the point
+            _instr.fusion_flush(
+                items[0].chain,
+                cache_hit=not compiled,
+                compiled=compiled,
+                reason=reason,
+            )
+
+        _FI.check("fusion.execute")
+        values = fused(*stacked)
+        if compile_t0 is not None and _MON.enabled:
+            # in-memory path: first dispatch timed trace+compile+execute
+            # (compile-dominated), the ISSUE 13 convention
+            _instr.fusion_compile_latency(time.perf_counter() - compile_t0)
+        out = values[0]
+        for b, it in enumerate(items):
+            row = out[b]
+            if it.slicer is not None:
+                row = row[it.slicer]
+            _assign(it, row)
+        if _MON.enabled:
+            _instr.serving_batch("coalesced", B)
+            _instr.serving_batch("flushes_saved", B - 1)
+            waste = sum(it.waste for it in items)
+            if waste:
+                _instr.serving_batch("pad_waste_bytes", waste)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        group.failed = True
+        if _MON.enabled:
+            _instr.serving_batch("fallback", B)
+
+
+def offer(x, reason: str = "serving") -> bool:
+    """Route one scheduled flush through the coalescer. Returns True when
+    the flush was handled here (batched, or recovered individually after a
+    failed batch); False when ineligible — the caller dispatches unbatched.
+
+    The calling (scheduler worker) thread becomes the group **leader** for
+    the first arrival of a signature: it waits out the linger window (woken
+    early when the group fills), then dispatches the whole group. Later
+    arrivals are **followers**: they block until the leader finishes and
+    simply observe their carved-out value."""
+    plan = _plan_for(x)
+    if plan is None:
+        return False
+    bmax = batch_max()
+    with _LOCK:
+        g = _GROUPS.get(plan.sig)
+        leader = g is None or g.closed
+        if leader:
+            g = _Group(plan.sig)
+            _GROUPS[plan.sig] = g
+        g.items.append(plan)
+        if len(g.items) >= bmax:
+            g.closed = True
+            if _GROUPS.get(plan.sig) is g:
+                del _GROUPS[plan.sig]
+            g.full.set()
+    if leader:
+        g.full.wait(timeout=linger_s())
+        with _LOCK:
+            g.closed = True
+            if _GROUPS.get(g.sig) is g:
+                del _GROUPS[g.sig]
+            items = list(g.items)
+        try:
+            if len(items) == 1:
+                # no company arrived: the unbatched path IS the batch of 1
+                # (full L1/L2/ladder semantics, no batched kernel compiled)
+                g.failed = True
+            else:
+                _dispatch(items, g, reason)
+        finally:
+            g.done.set()
+    else:
+        g.done.wait()
+    if g.failed:
+        # individual recovery: the full materialize_for ladder, per member
+        x._flush(reason)
+    return True
+
+
+def reset() -> None:
+    """Drop every open group (test isolation). Pending members are released
+    failed, so their owners' reads materialize individually."""
+    with _LOCK:
+        groups = list(_GROUPS.values())
+        _GROUPS.clear()
+    for g in groups:
+        g.closed = True
+        g.failed = True
+        g.done.set()

@@ -27,7 +27,19 @@ What one :func:`sweep` does, in order:
    the oldest-mtime files are unlinked until the total is ≤ the bound,
    counted ``serving.janitor{evicted}`` / ``{evicted_bytes}``. ``cache.load``
    touches an entry's mtime on every hit, so mtime order approximates LRU
-   across processes without any shared index.
+   across processes without any shared index. Evicting an exec entry also
+   drops its PR 13 **cost card** (``<dir>/cost/<digest>.json``, counted
+   ``{cost-evicted}``) — attribution for an executable no process can load
+   is dead weight.
+4. **Cost-card orphan sweep** (ISSUE 15 satellite) — cards whose exec entry
+   is gone through *any* path the eviction above cannot see (read-time
+   quarantine, the shadow-replay auditor's ``cache.evict``, a concurrent
+   janitor) are deleted once older than ``orphan_age_s`` (the same age gate
+   that keeps the sweep from racing ``cache.persist``, which writes the
+   entry *before* its card), counted ``serving.janitor{cost-orphans}``.
+   Cost cards are deliberately outside the byte bound (a few hundred bytes
+   each, documented in observability_notes) — this stage bounds their
+   *count* by the live entry set instead.
 
 **Concurrency contract** (multi-process writers and readers share the dir):
 every unlink/replace tolerates ``FileNotFoundError`` (a racing janitor or
@@ -47,9 +59,9 @@ Runs two ways:
   [--max-bytes N] [--orphan-age S] [--no-validate] [--dry-run]`` prints the
   stats as one JSON line (the cron-job / init-container form).
 
-Counters: ``serving.janitor{runs,evicted,evicted_bytes,quarantined,orphans}``
-(mixed units by design — the labels are the content), exported labelled via
-``report.telemetry()``.
+Counters: ``serving.janitor{runs,evicted,evicted_bytes,quarantined,orphans,
+cost-evicted,cost-orphans}`` (mixed units by design — the labels are the
+content), exported labelled via ``report.telemetry()``.
 """
 
 from __future__ import annotations
@@ -70,6 +82,7 @@ __all__ = [
     "sweep",
     "maybe_sweep",
     "quarantine_dir",
+    "cost_card_for",
     "main",
 ]
 
@@ -99,6 +112,14 @@ def max_bytes() -> Optional[int]:
 
 def quarantine_dir(cache_dir: str) -> str:
     return os.path.join(cache_dir, "quarantine")
+
+
+def cost_card_for(cache_dir: str, exec_path: str) -> str:
+    """The PR 13 cost card living beside one exec entry (the janitor owns
+    the card's lifecycle, ISSUE 15: evicted with the entry, orphan-swept
+    when the entry vanished through quarantine or a concurrent janitor)."""
+    digest = os.path.basename(exec_path)[: -len(".bin")]
+    return os.path.join(cache_dir, "cost", digest + ".json")
 
 
 def _count(kind: str, n: int = 1) -> None:
@@ -189,6 +210,8 @@ def sweep(
         "quarantined": 0,
         "evicted": 0,
         "evicted_bytes": 0,
+        "cost_evicted": 0,
+        "cost_orphans": 0,
     }
     entries, tmps = scan(cache_dir)
 
@@ -236,12 +259,56 @@ def sweep(
             total -= size
             stats["evicted"] += 1
             stats["evicted_bytes"] += size
+            if path.endswith(".bin"):
+                # the evicted executable's cost card (ISSUE 15 satellite):
+                # attribution for an entry no process can load again
+                card = cost_card_for(cache_dir, path)
+                if not dry_run:
+                    try:
+                        os.unlink(card)
+                    except OSError:
+                        continue
+                elif not os.path.exists(card):
+                    continue
+                stats["cost_evicted"] += 1
         stats["bytes"] = total
+
+    # cost-card orphan sweep (ISSUE 15 satellite): cards whose exec entry is
+    # gone via read-time quarantine / audit eviction / a concurrent janitor.
+    # Age-gated like the tempfile sweep — cache.persist writes the entry
+    # BEFORE its card, so a young unmatched card may simply be mid-store.
+    live = {
+        os.path.basename(p)[: -len(".bin")]
+        for p, _s, _m in entries
+        if p.endswith(".bin")
+    }
+    now = time.time()
+    cdir = os.path.join(cache_dir, "cost")
+    for name in _listdir(cdir):
+        if not name.endswith(".json"):
+            continue
+        if name[: -len(".json")] in live:
+            continue
+        path = os.path.join(cdir, name)
+        try:
+            if now - os.stat(path).st_mtime < orphan_age_s:
+                continue
+        except OSError:
+            continue
+        if not dry_run:
+            try:
+                os.unlink(path)
+            except OSError:
+                continue
+        stats["cost_orphans"] += 1
+
     _count("runs")
     _count("orphans", stats["orphans"])
     _count("quarantined", stats["quarantined"])
     _count("evicted", stats["evicted"])
     _count("evicted_bytes", stats["evicted_bytes"])
+    _count("cost-evicted", stats["cost_evicted"])
+    _count("cost-orphans", stats["cost_orphans"])
     return stats
 
 

@@ -1,0 +1,347 @@
+"""
+Load generator + wire semantics for the fleet serving tier (ISSUE 15).
+
+This module owns three things the ingress (``serving/server.py``), the
+fleet bench (``benchmarks/serving_bench.py``) and the CI ``fleet-smoke``
+job all share:
+
+* **The wire format** — one JSON object per request::
+
+      {"tenant": "alpha", "shape": [33, 5], "dtype": "float32", "seed": 7,
+       "expr": [["mul", 2.0], ["add", 1.0], ["div", 3.0], ["sin"]]}
+
+  ``expr`` is a pipeline of **pointwise** steps over a deterministic
+  operand (``np.random.default_rng(seed).normal(size=shape)``): unary
+  steps name an elementwise function (:data:`UNARY`), binary steps carry
+  one scalar constant (:data:`BINARY`). Pointwise-only is deliberate —
+  it is exactly the continuous-batching eligibility class, so wire
+  traffic coalesces. :func:`eval_request` evaluates a request into a
+  (pending) DNDarray; the worker and the client-side checker run the
+  *same* function, which is what makes correctness checkable.
+
+* **Correctness as a digest** — :func:`digest_of` hashes a materialized
+  result (shape + dtype + C-order bytes). Fused, batched, bucketed,
+  shed, rerouted and recovered paths are all bit-identical by this
+  repo's differential guarantees, and every process on one host shares
+  one compiler stack — so the client can compute the expected digest
+  locally (:func:`expected_digests`) and flag any divergence as a wrong
+  result, not a tolerance judgement call.
+
+* **The recorded multi-tenant trace** — :func:`trace` derandomizes a
+  seeded request mix: tenant ``alpha`` (weight 3) draws from the full
+  shape/expr space (the shape-diverse burst), tenant ``beta`` (weight 1)
+  replays a two-shape warm set (the steady customer whose p99 fairness
+  protects). The same seed reproduces the same trace everywhere — CI,
+  bench, and a debugging session replay identical traffic.
+
+:func:`run` drives a trace against a live ingress over HTTP from a small
+thread pool and reports exact sample percentiles (``p50_us``/``p99_us``),
+**goodput** (digest-correct responses per second of wall time — sheds and
+mismatches don't count), and the shed/error/mismatch ledger.
+
+CLI::
+
+    python -m heat_tpu.serving.loadgen --url http://127.0.0.1:8080 \\
+        [--requests N] [--concurrency C] [--seed S] [--no-check] [--json]
+
+exits 0 on a clean run, 1 on any wrong result or transport error
+(sheds are *not* failures — they are the admission contract working).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "UNARY",
+    "BINARY",
+    "SHAPES",
+    "EXPRS",
+    "eval_request",
+    "digest_of",
+    "expected_digests",
+    "trace",
+    "run",
+    "main",
+]
+
+#: Unary pointwise wire ops -> heat_tpu callables (resolved lazily: this
+#: module must import without pulling jax in — the ingress process parses
+#: wire traffic it never executes).
+UNARY: Tuple[str, ...] = ("sin", "cos", "tanh", "exp", "sqrt", "abs", "negative")
+
+#: Binary-with-scalar pointwise wire ops.
+BINARY: Tuple[str, ...] = ("add", "sub", "mul", "div", "max", "min")
+
+#: The fixed request shape space (2-d, deliberately bucket-diverse).
+SHAPES: Tuple[Tuple[int, int], ...] = (
+    (33, 5), (48, 12), (57, 7), (64, 5), (97, 12), (120, 31),
+    (17, 9), (40, 20), (73, 3), (88, 11), (25, 25), (111, 6),
+)
+
+#: Expression templates the trace draws from (every step pointwise).
+EXPRS: Tuple[Tuple[Tuple, ...], ...] = (
+    (("mul", 2.0), ("add", 1.0), ("div", 3.0), ("sub", 0.5), ("sin",)),
+    (("abs",), ("sqrt",), ("mul", 1.5), ("tanh",)),
+    (("max", 0.0), ("mul", 0.25), ("exp",), ("div", 2.0)),
+)
+
+
+def eval_request(req: dict):
+    """Evaluate one wire request into a (pending) DNDarray — the single
+    evaluation function the worker and the client-side checker share.
+    Raises ``ValueError`` on a malformed request (unknown op, bad shape) —
+    the worker maps that to HTTP 400."""
+    import numpy as np
+
+    import heat_tpu as ht
+
+    unary = {
+        "sin": ht.sin, "cos": ht.cos, "tanh": ht.tanh, "exp": ht.exp,
+        "sqrt": ht.sqrt, "abs": ht.abs, "negative": ht.negative,
+    }
+    binary = {
+        "add": lambda x, c: x + c,
+        "sub": lambda x, c: x - c,
+        "mul": lambda x, c: x * c,
+        "div": lambda x, c: x / c,
+        "max": ht.maximum,
+        "min": ht.minimum,
+    }
+    shape = tuple(int(d) for d in req["shape"])
+    if not shape or any(d < 1 for d in shape):
+        raise ValueError(f"bad request shape {req.get('shape')!r}")
+    dtype = str(req.get("dtype", "float32"))
+    if dtype != "float32":
+        raise ValueError(f"unsupported wire dtype {dtype!r} (float32 only)")
+    seed = int(req.get("seed", 0))
+    data = np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+    x = ht.array(data)
+    for step in req.get("expr", ()):
+        if not step:
+            raise ValueError("empty expr step")
+        op, args = str(step[0]), step[1:]
+        if op in unary:
+            if args:
+                raise ValueError(f"unary op {op!r} takes no argument")
+            x = unary[op](x)
+        elif op in binary:
+            if len(args) != 1:
+                raise ValueError(f"binary op {op!r} takes exactly one scalar")
+            x = binary[op](x, float(args[0]))
+        else:
+            raise ValueError(f"unknown wire op {op!r}")
+    return x
+
+
+def digest_of(x) -> str:
+    """Canonical content digest of a materialized result: sha256 over shape,
+    dtype and C-order bytes — the equality the 'no wrong results' legs
+    assert."""
+    import numpy as np
+
+    arr = np.ascontiguousarray(np.asarray(x.numpy()))
+    h = hashlib.sha256()
+    h.update(repr((arr.shape, str(arr.dtype))).encode())
+    h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def request_key(req: dict) -> str:
+    """The identity of a request for expected-digest matching (tenant
+    excluded: results are tenant-independent by construction)."""
+    return json.dumps(
+        {
+            "shape": [int(d) for d in req["shape"]],
+            "dtype": str(req.get("dtype", "float32")),
+            "seed": int(req.get("seed", 0)),
+            "expr": [list(s) for s in req.get("expr", ())],
+        },
+        sort_keys=True,
+    )
+
+
+def expected_digests(requests: Sequence[dict]) -> Dict[str, str]:
+    """Reference digests for every distinct request, computed locally
+    through the same :func:`eval_request` the workers run."""
+    out: Dict[str, str] = {}
+    for req in requests:
+        key = request_key(req)
+        if key not in out:
+            out[key] = digest_of(eval_request(req))
+    return out
+
+
+def trace(
+    seed: int = 20260805,
+    n: int = 96,
+    tenants: Tuple[Tuple[str, int], ...] = (("alpha", 3), ("beta", 1)),
+) -> List[dict]:
+    """The recorded multi-tenant trace: ``n`` requests, tenant choice
+    weighted, tenant ``alpha`` shape-diverse over the full space, every
+    other tenant confined to the two-shape warm set. Deterministic in
+    ``seed``."""
+    import random
+
+    rng = random.Random(seed)
+    population = [t for t, w in tenants for _ in range(int(w))]
+    reqs = []
+    for _ in range(n):
+        tenant = rng.choice(population)
+        if tenant == tenants[0][0]:
+            shape = rng.choice(SHAPES)
+        else:
+            shape = rng.choice(SHAPES[:2])
+        reqs.append(
+            {
+                "tenant": tenant,
+                "shape": list(shape),
+                "dtype": "float32",
+                "seed": rng.randrange(1 << 16),
+                "expr": [list(s) for s in rng.choice(EXPRS)],
+            }
+        )
+    return reqs
+
+
+def _post(url: str, payload: dict, timeout: float) -> Tuple[int, dict]:
+    body = json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url.rstrip("/") + "/v1/compute",
+        data=body,
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read().decode())
+        except Exception:
+            return e.code, {"ok": False, "error": f"http {e.code}"}
+
+
+def run(
+    url: str,
+    requests: Sequence[dict],
+    concurrency: int = 8,
+    timeout_s: float = 120.0,
+    expected: Optional[Dict[str, str]] = None,
+) -> dict:
+    """Drive ``requests`` against a live ingress from ``concurrency``
+    threads. Returns the stats dict: exact ``p50_us``/``p99_us`` over
+    successful responses, ``goodput_rps`` (digest-correct responses / wall
+    second — when ``expected`` is given; otherwise ok responses / wall),
+    and the ``ok``/``shed``/``errors``/``mismatches`` ledger."""
+    lock = threading.Lock()
+    it = iter(list(enumerate(requests)))
+    lat: List[float] = []
+    stats = {"n": len(requests), "ok": 0, "shed": 0, "errors": 0, "mismatches": 0}
+
+    def worker():
+        while True:
+            with lock:
+                try:
+                    _i, req = next(it)
+                except StopIteration:
+                    return
+            t0 = time.perf_counter()
+            try:
+                status, payload = _post(url, req, timeout_s)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception:
+                with lock:
+                    stats["errors"] += 1
+                continue
+            dt = time.perf_counter() - t0
+            with lock:
+                if payload.get("shed") or status == 503:
+                    stats["shed"] += 1
+                elif status == 200 and payload.get("ok"):
+                    good = True
+                    if expected is not None:
+                        want = expected.get(request_key(req))
+                        if want is not None and payload.get("sha256") != want:
+                            stats["mismatches"] += 1
+                            good = False
+                    if good:
+                        stats["ok"] += 1
+                        lat.append(dt)
+                else:
+                    stats["errors"] += 1
+
+    t_start = time.perf_counter()
+    threads = [
+        threading.Thread(target=worker, name=f"loadgen-{i}", daemon=True)
+        for i in range(max(1, concurrency))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = max(time.perf_counter() - t_start, 1e-9)
+    lat.sort()
+
+    def pct(q: float) -> Optional[float]:
+        if not lat:
+            return None
+        idx = min(len(lat) - 1, max(0, int(round(q * (len(lat) - 1)))))
+        return round(lat[idx] * 1e6, 1)
+
+    stats.update(
+        {
+            "p50_us": pct(0.50),
+            "p99_us": pct(0.99),
+            "wall_s": round(wall, 3),
+            "goodput_rps": round(stats["ok"] / wall, 2),
+        }
+    )
+    return stats
+
+
+def main(argv=None) -> int:
+    """CLI entry point (``python -m heat_tpu.serving.loadgen``)."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m heat_tpu.serving.loadgen",
+        description="Drive the recorded multi-tenant trace against a fleet "
+        "ingress and report p50/p99/goodput plus the correctness ledger.",
+    )
+    p.add_argument("--url", required=True, help="ingress base URL")
+    p.add_argument("--requests", type=int, default=96)
+    p.add_argument("--concurrency", type=int, default=8)
+    p.add_argument("--seed", type=int, default=20260805)
+    p.add_argument("--timeout", type=float, default=120.0)
+    p.add_argument(
+        "--no-check",
+        action="store_true",
+        help="skip the local expected-digest computation (no jax import)",
+    )
+    p.add_argument("--json", action="store_true", help="print stats as JSON")
+    args = p.parse_args(argv)
+    reqs = trace(seed=args.seed, n=args.requests)
+    expected = None if args.no_check else expected_digests(reqs)
+    stats = run(
+        args.url,
+        reqs,
+        concurrency=args.concurrency,
+        timeout_s=args.timeout,
+        expected=expected,
+    )
+    line = json.dumps(stats, sort_keys=True)
+    print(line if args.json else f"loadgen: {line}")
+    return 1 if (stats["mismatches"] or stats["errors"]) else 0
+
+
+if __name__ == "__main__":  # pragma: no cover — exercised via subprocess
+    sys.exit(main())

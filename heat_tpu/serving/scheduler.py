@@ -58,6 +58,20 @@ the buckets; the serving bench reports exact sample percentiles
 (``dispatch_p50_us``/``dispatch_p99_us``).
 
 ``HEAT_TPU_SERVING_THREADS`` sizes the default pool (default 4).
+
+**Fleet tier (ISSUE 15).** Two opt-in layers ride the same dispatch path,
+both one env read when off:
+
+* ``HEAT_TPU_SERVING_BATCH=1`` routes eligible flushes through the
+  continuous-batching coalescer (:mod:`~heat_tpu.serving.batching`):
+  concurrent same-bucketed-signature flushes dispatch as ONE batched
+  kernel, carved back per request — bit-identical by construction.
+* ``HEAT_TPU_TENANCY`` + ``schedule(x, tenant=...)`` (default: the calling
+  thread's :func:`~heat_tpu.serving.tenancy.tenant_context`) arms
+  per-tenant fairness: each tenant is bounded to its weighted share of the
+  admission queue (``serving.tenant{<t>:shed-queue-full}``, gauge
+  ``serving.tenant_depth[<t>]``), and the worker re-installs the tenant tag
+  around the flush so the fusion layer's per-tenant L1 partition sees it.
 """
 
 from __future__ import annotations
@@ -74,6 +88,8 @@ from ..monitoring import events as _events
 from ..monitoring import flight as _flight
 from ..monitoring import instrument as _instr
 from ..monitoring.registry import STATE as _MON
+from . import batching as _batching
+from . import tenancy as _tenancy
 
 __all__ = ["FlushScheduler", "schedule", "flush_all", "shutdown"]
 
@@ -125,6 +141,7 @@ class FlushScheduler:
         self._pool: Optional[ThreadPoolExecutor] = None
         self._lock = threading.Lock()
         self._inflight = 0
+        self._tenant_inflight: dict = {}
         self._cond = threading.Condition()
 
     # ---- knobs (env read per call so tests/monkeypatch reconfigure live)
@@ -156,6 +173,11 @@ class FlushScheduler:
         ``serving.queue_depth``)."""
         return self._inflight
 
+    def tenant_depth(self, tenant: str) -> int:
+        """``tenant``'s scheduled-but-unfinished flushes (also a gauge:
+        ``serving.tenant_depth[<tenant>]``)."""
+        return self._tenant_inflight.get(tenant, 0)
+
     def _executor(self) -> ThreadPoolExecutor:
         if self._pool is None:
             with self._lock:
@@ -166,39 +188,67 @@ class FlushScheduler:
                     )
         return self._pool
 
-    def _gauge(self) -> None:
+    def _gauge(self, tenant: Optional[str] = None) -> None:
         if _MON.enabled:
             _instr.serving_queue_depth(self._inflight)
+            if tenant is not None:
+                _instr.serving_tenant_depth(
+                    tenant, self._tenant_inflight.get(tenant, 0)
+                )
 
-    def _shed(self, x, kind: str) -> Future:
+    def _shed(self, x, kind: str, tenant: Optional[str] = None) -> Future:
         """Refuse the async dispatch (results stay exact: the pending
         expression materializes at the owner's next read)."""
         if _MON.enabled:
             _instr.serving_shed(kind)
+            if tenant is not None:
+                _instr.serving_tenant(tenant, f"shed-{kind}")
         fut: Future = Future()
         fut.set_result(x)
         return fut
 
-    def schedule(self, x, reason: str = "serving") -> Future:
+    def schedule(self, x, reason: str = "serving", tenant: Optional[str] = None) -> Future:
         """Submit ``x``'s pending flush; the Future resolves to ``x``.
 
-        Admission control happens here (queue bound + overflow policy); the
-        deadline is enforced by the worker at dequeue — past-deadline work is
-        shed *before* dispatch, never aborted mid-kernel."""
+        Admission control happens here (queue bound + overflow policy, plus
+        — with ``HEAT_TPU_TENANCY`` armed — the tenant's weighted share of
+        the bound, ISSUE 15); the deadline is enforced by the worker at
+        dequeue — past-deadline work is shed *before* dispatch, never
+        aborted mid-kernel. ``tenant`` tags the flush (default: the calling
+        thread's ``tenancy.tenant_context``); the worker re-installs the tag
+        so the fusion layer's per-tenant L1 partition sees it."""
+        if tenant is None and _tenancy.armed():
+            tenant = _tenancy.current_tenant()
         qmax = self._queue_bound()
-        if qmax:
-            with self._cond:
-                if self._inflight >= qmax:
+        share = None
+        if qmax and tenant is not None and _tenancy.armed():
+            share = _tenancy.queue_share(
+                tenant, qmax, known=set(self._tenant_inflight)
+            )
+        with self._cond:
+            if qmax:
+                def over():
+                    if self._inflight >= qmax:
+                        return True
+                    if share is not None and (
+                        self._tenant_inflight.get(tenant, 0) >= share
+                    ):
+                        return True
+                    return False
+
+                if over():
                     if self._overflow_policy() == "shed":
-                        return self._shed(x, "queue-full")
-                    while self._inflight >= qmax:
+                        return self._shed(x, "queue-full", tenant=tenant)
+                    while over():
                         self._cond.wait()
-                self._inflight += 1
-                self._gauge()
-        else:
-            with self._cond:
-                self._inflight += 1
-                self._gauge()
+            self._inflight += 1
+            if tenant is not None:
+                self._tenant_inflight[tenant] = (
+                    self._tenant_inflight.get(tenant, 0) + 1
+                )
+                if _MON.enabled:
+                    _instr.serving_tenant(tenant, "scheduled")
+            self._gauge(tenant)
 
         deadline = self._deadline_s()
         t0 = time.perf_counter()
@@ -217,16 +267,25 @@ class FlushScheduler:
                     # dequeued already past deadline: shed before dispatch
                     if _MON.enabled:
                         _instr.serving_shed("deadline")
+                        if tenant is not None:
+                            _instr.serving_tenant(tenant, "shed-deadline")
                     return x
                 dispatched = True
                 flush = getattr(x, "_flush", None)
                 if flush is not None:
-                    with _events.span(
+                    with _tenancy.tenant_context(tenant), _events.span(
                         "serving.flush",
                         parent=parent_span,
                         queued_ms=round(waited * 1e3, 3),
                     ):
-                        if _flight.flight_enabled():
+                        # continuous batching (ISSUE 15): with
+                        # HEAT_TPU_SERVING_BATCH=1, eligible flushes coalesce
+                        # with concurrent same-signature flushes into ONE
+                        # batched dispatch; ineligible (or hatch-off = one
+                        # env read) falls through to the unbatched path
+                        if _batching.enabled() and _batching.offer(x, reason):
+                            pass
+                        elif _flight.flight_enabled():
                             # the flush record (written inside
                             # materialize_for) reads its queue time from
                             # this thread-local context
@@ -241,6 +300,8 @@ class FlushScheduler:
                         # killed, only counted and logged
                         if _MON.enabled:
                             _instr.serving_deadline_miss("in-flight")
+                            if tenant is not None:
+                                _instr.serving_tenant(tenant, "deadline-miss")
                         _LOG.warning(
                             "flush exceeded deadline in flight: %.1fms > %.1fms",
                             took * 1e3, deadline * 1e3,
@@ -257,19 +318,33 @@ class FlushScheduler:
                     _agg.maybe_snapshot()
                 with self._cond:
                     self._inflight -= 1
-                    self._gauge()
-                    self._cond.notify()
+                    if tenant is not None:
+                        n = self._tenant_inflight.get(tenant, 1) - 1
+                        if n > 0:
+                            self._tenant_inflight[tenant] = n
+                        else:
+                            self._tenant_inflight.pop(tenant, None)
+                    self._gauge(tenant)
+                    self._cond.notify_all()
 
         try:
             return self._executor().submit(run)
         except BaseException:
             with self._cond:
                 self._inflight -= 1
-                self._gauge()
-                self._cond.notify()
+                if tenant is not None:
+                    n = self._tenant_inflight.get(tenant, 1) - 1
+                    if n > 0:
+                        self._tenant_inflight[tenant] = n
+                    else:
+                        self._tenant_inflight.pop(tenant, None)
+                self._gauge(tenant)
+                self._cond.notify_all()
             raise
 
-    def flush_all(self, arrays: Iterable, reason: str = "serving") -> list:
+    def flush_all(
+        self, arrays: Iterable, reason: str = "serving", tenant: Optional[str] = None
+    ) -> list:
         """Flush a batch concurrently (deduped by identity — scheduling the
         same array twice flushes it once) and return it as a list once every
         flush has landed."""
@@ -279,7 +354,7 @@ class FlushScheduler:
         for a in arrays:
             if id(a) not in seen:
                 seen[id(a)] = True
-                futures.append(self.schedule(a, reason=reason))
+                futures.append(self.schedule(a, reason=reason, tenant=tenant))
         err = None
         for f in futures:
             try:
@@ -319,14 +394,16 @@ def _default_scheduler() -> FlushScheduler:
     return _default
 
 
-def schedule(x, reason: str = "serving") -> Future:
+def schedule(x, reason: str = "serving", tenant: Optional[str] = None) -> Future:
     """Submit one flush to the process-default scheduler."""
-    return _default_scheduler().schedule(x, reason=reason)
+    return _default_scheduler().schedule(x, reason=reason, tenant=tenant)
 
 
-def flush_all(arrays: Iterable, reason: str = "serving") -> list:
+def flush_all(
+    arrays: Iterable, reason: str = "serving", tenant: Optional[str] = None
+) -> list:
     """Fan a batch of flushes out on the process-default scheduler."""
-    return _default_scheduler().flush_all(arrays, reason=reason)
+    return _default_scheduler().flush_all(arrays, reason=reason, tenant=tenant)
 
 
 def shutdown(wait: bool = True) -> None:

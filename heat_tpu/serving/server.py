@@ -1,0 +1,664 @@
+"""
+Multi-process HTTP ingress: the fleet front end (ISSUE 15, ROADMAP item 2).
+
+``python -m heat_tpu.serving.server --workers N`` turns the single-process
+serving runtime into a **service**: an ingress process (stdlib
+``ThreadingHTTPServer`` — the PR 14 exporter idiom, zero new dependencies)
+fans JSON-described requests (the :mod:`~heat_tpu.serving.loadgen` wire
+format) across ``N`` worker subprocesses, each a full heat_tpu runtime —
+scheduler, continuous batching, tenancy, L2 cache — sharing one
+``HEAT_TPU_CACHE_DIR`` (the cross-process contract PR 9's two-writer races
+and PR 8's zero-compile subprocess test prove) and publishing telemetry
+into one ``HEAT_TPU_TELEMETRY_DIR`` spool (PR 14).
+
+Ingress routes:
+
+``POST /v1/compute``
+    Forward the request body to the next live worker (round robin). A
+    connection-level failure — refused, reset, timed out — marks the
+    worker dead (``serving.ingress{worker-dead}``) and **reroutes** the
+    request to the next live worker (``{rerouted}``; wire computations are
+    pure and deterministic, so a retry can never double-apply anything).
+    Every live worker exhausted = **shed**: HTTP 503 with
+    ``{"ok": false, "shed": true}`` (``{shed}``) — the admission contract,
+    not an error. Forwarded responses relay verbatim (``{routed}``).
+``GET /healthz``
+    Ingress liveness: 200 while the server thread breathes, with the live
+    worker count.
+``GET /readyz``
+    Fleet readiness: 200 iff live workers ≥ ``--min-ready`` (default: all
+    of them — one SIGKILLed worker flips readiness until the monitor
+    respawns it), with one reason per dead worker and the fleet
+    ``scale_signal`` aggregated from the workers' telemetry spool
+    (``(Σ queue_depth) × max(dispatch p99)`` — the autoscaling output an
+    operator's HPA consumes).
+``GET /statusz``
+    The worker table (pid/port/alive/routed counts) + the spool fleet view.
+``GET /metrics``
+    Prometheus text: the spool fleet exposition (per-worker ``pid``/
+    ``nonce`` labels) when a spool is armed, else the ingress's own
+    registry.
+
+A monitor thread polls worker processes (``proc.poll()``, no HTTP
+probing); dead workers are respawned by default (``{respawned}``) so
+readiness **recovers** after a crash — the SIGKILL acceptance leg in
+``tests/test_fleet.py``.
+
+Workers are this same module (``--worker``): an HTTP worker serving
+``POST /v1/compute`` by evaluating the wire request through
+:func:`loadgen.eval_request`, scheduling it through the process
+:class:`~heat_tpu.serving.scheduler.FlushScheduler` under the request's
+tenant (tenancy + batching + admission all apply ambiently via env), and
+answering with the result digest. ``--announce`` prints one
+``{"worker_ready": …}`` JSON line once bound — the ingress parent reads it
+to learn the ephemeral port.
+
+Everything here is opt-in by construction (nothing starts unless the CLI
+or :class:`Ingress` is invoked) and the ingress process itself never
+imports jax — it moves bytes and reads spool files.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from ..monitoring import instrument as _instr
+from ..monitoring.registry import STATE as _MON
+
+__all__ = ["Ingress", "WorkerSlot", "run_worker", "main"]
+
+_LOG = logging.getLogger("heat_tpu.serving")
+
+
+# ------------------------------------------------------------------ worker
+class _WorkerHandler(BaseHTTPRequestHandler):
+    server_version = "heat-tpu-worker"
+
+    def log_message(self, *args):
+        pass
+
+    def _send_json(self, code: int, payload: dict) -> None:
+        data = json.dumps(payload, sort_keys=True).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        route = self.path.split("?", 1)[0].rstrip("/") or "/"
+        if route == "/healthz":
+            self._send_json(200, {"ok": True, "pid": os.getpid(), "time": time.time()})
+        else:
+            self._send_json(404, {"error": f"no route {route}"})
+
+    def do_POST(self):  # noqa: N802
+        route = self.path.split("?", 1)[0].rstrip("/")
+        if route != "/v1/compute":
+            self._send_json(404, {"error": f"no route {route}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            req = json.loads(self.rfile.read(length).decode())
+            if not isinstance(req, dict):
+                raise ValueError("request body must be a JSON object")
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            self._send_json(400, {"ok": False, "error": repr(e)[:300]})
+            return
+        try:
+            t0 = time.perf_counter()
+            from . import loadgen as _loadgen
+            from . import scheduler as _scheduler
+            from . import tenancy as _tenancy
+
+            tenant = req.get("tenant")
+            tenant = str(tenant) if tenant is not None else None
+            with _tenancy.tenant_context(tenant):
+                x = _loadgen.eval_request(req)
+                # the serving path proper: admission control, deadlines,
+                # tenancy shares, continuous batching — all via the process
+                # scheduler under the request's tenant tag. A shed resolves
+                # to the unflushed array; the digest read below then
+                # materializes synchronously — bit-identical by contract.
+                _scheduler.schedule(x, tenant=tenant).result()
+                digest = _loadgen.digest_of(x)
+            self._send_json(
+                200,
+                {
+                    "ok": True,
+                    "sha256": digest,
+                    "shape": [int(d) for d in x.shape],
+                    "dtype": str(x.dtype),
+                    "worker_pid": os.getpid(),
+                    "elapsed_ms": round((time.perf_counter() - t0) * 1e3, 3),
+                },
+            )
+        except ValueError as e:  # malformed wire request
+            self._send_json(400, {"ok": False, "error": repr(e)[:300]})
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:  # a compute bug must not kill the worker
+            self._send_json(500, {"ok": False, "error": repr(e)[:300]})
+
+
+def run_worker(port: int = 0, host: str = "127.0.0.1", announce: bool = False) -> None:
+    """Run one worker until interrupted (the ``--worker`` entry).
+
+    A parent-death watchdog rides along: a managed worker that outlives its
+    ingress (the ingress was SIGKILLed, or a SIGTERM bypassed its cleanup)
+    must exit rather than linger as an orphan holding a port and a runtime
+    — observed leak: ``kill <ingress>`` left workers serving forever."""
+    parent = os.getppid()
+    httpd = ThreadingHTTPServer((host, int(port)), _WorkerHandler)
+    httpd.daemon_threads = True
+
+    def watch_parent():
+        while True:
+            time.sleep(2.0)
+            if os.getppid() != parent:  # reparented: the ingress is gone
+                os._exit(0)
+
+    if parent > 1:
+        threading.Thread(
+            target=watch_parent, name="heat-tpu-worker-watchdog", daemon=True
+        ).start()
+    if announce:
+        print(
+            json.dumps(
+                {
+                    "worker_ready": True,
+                    "pid": os.getpid(),
+                    "port": httpd.server_address[1],
+                }
+            ),
+            flush=True,
+        )
+    try:
+        httpd.serve_forever(poll_interval=0.5)
+    except KeyboardInterrupt:  # pragma: no cover — interactive stop
+        pass
+    finally:
+        httpd.server_close()
+
+
+# ------------------------------------------------------------------ ingress
+class WorkerSlot:
+    """One managed worker subprocess."""
+
+    __slots__ = ("proc", "port", "pid", "alive", "routed")
+
+    def __init__(self, proc, port: int):
+        self.proc = proc
+        self.port = int(port)
+        self.pid = proc.pid
+        self.alive = True
+        self.routed = 0
+
+    def as_dict(self) -> dict:
+        return {
+            "pid": self.pid,
+            "port": self.port,
+            "alive": self.alive,
+            "routed": self.routed,
+        }
+
+
+def _spawn_worker(env: dict, host: str, boot_timeout_s: float):
+    """Start one worker subprocess and wait for its announce line."""
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "heat_tpu.serving.server",
+            "--worker", "--port", "0", "--host", host, "--announce",
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=None,  # inherited: a crashing worker's traceback must be visible
+        text=True,
+    )
+    ready: dict = {}
+
+    def read():
+        try:
+            line = proc.stdout.readline()
+            ready.update(json.loads(line))
+        except Exception:
+            pass
+
+    t = threading.Thread(target=read, daemon=True)
+    t.start()
+    t.join(timeout=boot_timeout_s)
+    if not ready.get("worker_ready"):
+        try:
+            proc.kill()
+        except OSError:
+            pass
+        raise RuntimeError("worker failed to announce readiness")
+    return WorkerSlot(proc, ready["port"])
+
+
+class _IngressHandler(BaseHTTPRequestHandler):
+    server_version = "heat-tpu-ingress"
+
+    def log_message(self, *args):
+        pass
+
+    @property
+    def ingress(self) -> "Ingress":
+        return self.server.heat_tpu_ingress
+
+    def _send_json(self, code: int, payload: dict) -> None:
+        data = json.dumps(payload, sort_keys=True, default=str).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_text(self, code: int, body: str, ctype: str) -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_POST(self):  # noqa: N802
+        route = self.path.split("?", 1)[0].rstrip("/")
+        if route != "/v1/compute":
+            self._send_json(404, {"error": f"no route {route}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            body = self.rfile.read(length)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            self._send_json(400, {"ok": False, "error": repr(e)[:300]})
+            return
+        try:
+            result = self.ingress.route(body)
+        except (BrokenPipeError, ConnectionResetError):
+            return
+        if result is None:
+            self._send_json(
+                503, {"ok": False, "shed": True, "error": "no live worker"}
+            )
+        else:
+            code, payload = result
+            self._send_text(code, payload, "application/json")
+
+    def do_GET(self):  # noqa: N802
+        route = self.path.split("?", 1)[0].rstrip("/") or "/"
+        ing = self.ingress
+        try:
+            if route == "/healthz":
+                self._send_json(
+                    200, {"ok": True, "pid": os.getpid(), "workers": ing.live_workers()}
+                )
+            elif route == "/readyz":
+                ready, reasons = ing.readiness()
+                self._send_json(
+                    200 if ready else 503,
+                    {
+                        "ready": ready,
+                        "reasons": reasons,
+                        "workers": ing.live_workers(),
+                        "scale_signal": ing.scale_signal(),
+                    },
+                )
+            elif route == "/statusz":
+                self._send_json(200, ing.statusz())
+            elif route == "/metrics":
+                from ..monitoring import exporter as _exporter
+
+                text = (
+                    _exporter.fleet_exposition(ing.spool, max_age_s=ing.max_age_s)
+                    if ing.spool
+                    else _exporter.exposition()
+                )
+                self._send_text(
+                    200, text, "text/plain; version=0.0.4; charset=utf-8"
+                )
+            else:
+                self._send_json(404, {"error": f"no route {route}"})
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception as e:  # a handler bug must not kill the ingress
+            try:
+                self._send_json(500, {"error": repr(e)[:300]})
+            except Exception:
+                pass
+
+
+class Ingress:
+    """The fleet front end: N managed worker subprocesses behind one HTTP
+    ingress, with round-robin routing, dead-worker reroute/shed, a respawn
+    monitor, and spool-fed readiness + scale signal.
+
+    Programmatic use (tests, benches)::
+
+        ing = Ingress(workers=2, cache_dir=..., spool=...)
+        ing.start()
+        ... loadgen.run(ing.url(), trace) ...
+        ing.stop()
+    """
+
+    def __init__(
+        self,
+        workers: int = 2,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        cache_dir: Optional[str] = None,
+        spool: Optional[str] = None,
+        max_age_s: Optional[float] = None,
+        env: Optional[dict] = None,
+        respawn: bool = True,
+        min_ready: Optional[int] = None,
+        request_timeout_s: float = 120.0,
+        boot_timeout_s: float = 180.0,
+    ):
+        self.n_workers = max(1, int(workers))
+        self.host = host
+        self._port = int(port)
+        self.cache_dir = cache_dir
+        self.spool = spool
+        self.max_age_s = max_age_s
+        self.respawn = respawn
+        self.min_ready = self.n_workers if min_ready is None else int(min_ready)
+        self.request_timeout_s = request_timeout_s
+        self.boot_timeout_s = boot_timeout_s
+        self._extra_env = dict(env or {})
+        self._slots: List[WorkerSlot] = []
+        self._rr = 0
+        self._lock = threading.Lock()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._monitor: Optional[threading.Thread] = None
+        self._stopping = threading.Event()
+
+    # ---- lifecycle
+    def _worker_env(self) -> dict:
+        env = dict(os.environ)
+        env["HEAT_TPU_MONITORING"] = "1"
+        if self.cache_dir:
+            env["HEAT_TPU_CACHE_DIR"] = self.cache_dir
+        if self.spool:
+            env["HEAT_TPU_TELEMETRY_DIR"] = self.spool
+        env.update(self._extra_env)
+        return env
+
+    def start(self) -> "Ingress":
+        env = self._worker_env()
+        for _ in range(self.n_workers):
+            self._slots.append(_spawn_worker(env, self.host, self.boot_timeout_s))
+        self._httpd = ThreadingHTTPServer((self.host, self._port), _IngressHandler)
+        self._httpd.daemon_threads = True
+        self._httpd.heat_tpu_ingress = self
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.5},
+            name="heat-tpu-ingress",
+            daemon=True,
+        )
+        self._thread.start()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="heat-tpu-ingress-monitor", daemon=True
+        )
+        self._monitor.start()
+        return self
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def url(self, route: str = "") -> str:
+        return f"http://{self.host}:{self.port}{route}"
+
+    def stop(self) -> None:
+        self._stopping.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        for slot in self._slots:
+            try:
+                slot.proc.terminate()
+            except OSError:
+                pass
+        for slot in self._slots:
+            try:
+                slot.proc.wait(timeout=10.0)
+            except Exception:
+                try:
+                    slot.proc.kill()
+                except OSError:
+                    pass
+
+    # ---- worker management
+    def _monitor_loop(self) -> None:
+        while not self._stopping.wait(0.5):
+            with self._lock:
+                slots = list(self._slots)
+            for i, slot in enumerate(slots):
+                if slot.proc.poll() is None:
+                    continue
+                if slot.alive:
+                    slot.alive = False
+                    if _MON.enabled:
+                        _instr.serving_ingress("worker-dead")
+                    _LOG.warning("worker pid %s died (rc=%s)", slot.pid, slot.proc.returncode)
+                if self.respawn and not self._stopping.is_set():
+                    try:
+                        fresh = _spawn_worker(
+                            self._worker_env(), self.host, self.boot_timeout_s
+                        )
+                    except (KeyboardInterrupt, SystemExit):
+                        raise
+                    except Exception:
+                        continue  # retried next poll
+                    with self._lock:
+                        self._slots[i] = fresh
+                    if _MON.enabled:
+                        _instr.serving_ingress("respawned")
+
+    def _mark_dead(self, slot: WorkerSlot) -> None:
+        if slot.alive:
+            slot.alive = False
+            if _MON.enabled:
+                _instr.serving_ingress("worker-dead")
+
+    def live_workers(self) -> int:
+        with self._lock:
+            return sum(1 for s in self._slots if s.alive)
+
+    def worker_pids(self) -> List[int]:
+        with self._lock:
+            return [s.pid for s in self._slots if s.alive]
+
+    # ---- routing
+    def route(self, body: bytes):
+        """Forward one request body: ``(status, response_text)`` from the
+        first worker that answers, or None when every live worker is gone
+        (the caller sheds with 503)."""
+        with self._lock:
+            slots = list(self._slots)
+            start = self._rr
+            self._rr += 1
+        tried = 0
+        for k in range(len(slots)):
+            slot = slots[(start + k) % len(slots)]
+            if not slot.alive:
+                continue
+            req = urllib.request.Request(
+                f"http://{self.host}:{slot.port}/v1/compute",
+                data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=self.request_timeout_s) as resp:
+                    payload = resp.read().decode()
+                    slot.routed += 1
+                    if _MON.enabled:
+                        _instr.serving_ingress("routed")
+                        if tried:
+                            _instr.serving_ingress("rerouted")
+                    return resp.status, payload
+            except urllib.error.HTTPError as e:
+                # the worker answered (4xx/5xx): it is alive — relay verbatim
+                slot.routed += 1
+                if _MON.enabled:
+                    _instr.serving_ingress("routed")
+                    if tried:
+                        _instr.serving_ingress("rerouted")
+                try:
+                    return e.code, e.read().decode()
+                except Exception:
+                    return e.code, json.dumps({"ok": False, "error": f"http {e.code}"})
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception:
+                # connection-level failure: dead worker — mark and reroute
+                # (wire computations are pure; a retry cannot double-apply)
+                self._mark_dead(slot)
+                tried += 1
+                continue
+        if _MON.enabled:
+            _instr.serving_ingress("shed")
+        return None
+
+    # ---- readiness / status
+    def readiness(self):
+        live = self.live_workers()
+        reasons = []
+        with self._lock:
+            for s in self._slots:
+                if not s.alive:
+                    reasons.append(f"worker:{s.pid} dead")
+        if live < self.min_ready:
+            reasons.append(f"live {live} < min_ready {self.min_ready}")
+            return False, reasons
+        return True, []
+
+    def scale_signal(self) -> Optional[float]:
+        """The fleet autoscaling output: ``(Σ queue_depth) × max(p99)``
+        aggregated from the workers' telemetry spool (None when no spool
+        is armed)."""
+        if not self.spool:
+            return None
+        try:
+            from ..monitoring import aggregate as _aggregate
+
+            view = _aggregate.fleet_view(self.spool, max_age_s=self.max_age_s)
+            return view["scale_signal"]
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception:
+            return None
+
+    def statusz(self) -> dict:
+        with self._lock:
+            workers = [s.as_dict() for s in self._slots]
+        out = {
+            "pid": os.getpid(),
+            "workers": workers,
+            "min_ready": self.min_ready,
+            "respawn": self.respawn,
+            "scale_signal": self.scale_signal(),
+        }
+        if self.spool:
+            try:
+                from ..monitoring import aggregate as _aggregate
+
+                out["fleet"] = _aggregate.fleet_view(
+                    self.spool, max_age_s=self.max_age_s
+                )
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception:
+                pass
+        return out
+
+
+# ------------------------------------------------------------------ CLI
+def main(argv=None) -> int:
+    """``python -m heat_tpu.serving.server``: ``--worker`` runs one worker;
+    otherwise runs the ingress with ``--workers`` managed subprocesses."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m heat_tpu.serving.server",
+        description="Fleet serving ingress: fan JSON compute requests over N "
+        "worker processes sharing one compilation cache dir, with health/"
+        "readiness endpoints and a spool-fed autoscaling signal.",
+    )
+    p.add_argument("--worker", action="store_true", help="run one worker (internal)")
+    p.add_argument("--announce", action="store_true", help="print the ready line (worker)")
+    p.add_argument("--port", type=int, default=0, help="bind port (0 = ephemeral)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--workers", type=int, default=2, help="worker process count")
+    p.add_argument("--cache-dir", default=None, help="shared HEAT_TPU_CACHE_DIR for the workers")
+    p.add_argument("--spool", default=None, help="shared HEAT_TPU_TELEMETRY_DIR for the workers")
+    p.add_argument("--max-age", type=float, default=None, help="spool staleness bound (s)")
+    p.add_argument("--min-ready", type=int, default=None)
+    p.add_argument("--no-respawn", action="store_true")
+    p.add_argument("--request-timeout", type=float, default=120.0)
+    args = p.parse_args(argv)
+    if args.worker:
+        run_worker(port=args.port, host=args.host, announce=args.announce)
+        return 0
+    ing = Ingress(
+        workers=args.workers,
+        port=args.port,
+        host=args.host,
+        cache_dir=args.cache_dir,
+        spool=args.spool,
+        max_age_s=args.max_age,
+        respawn=not args.no_respawn,
+        min_ready=args.min_ready,
+        request_timeout_s=args.request_timeout,
+    )
+    ing.start()
+    sys.stderr.write(
+        f"ingress on {ing.url('/')} with {ing.n_workers} workers (ctrl-c to stop)\n"
+    )
+    # SIGTERM (the orchestrator's stop signal) must tear the workers down
+    # too — a bare process kill used to leak them as orphans (the worker-
+    # side parent-death watchdog is the backstop; this is the fast path)
+    import signal as _signal
+
+    def _term(_signo, _frame):
+        raise KeyboardInterrupt
+
+    try:
+        _signal.signal(_signal.SIGTERM, _term)
+    except (ValueError, OSError):  # pragma: no cover — non-main thread
+        pass
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        ing.stop()
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover — exercised via subprocess tests
+    # `python -m` runs this file as `__main__` — delegate to the canonical
+    # module so CLI state shares the import the runtime hooks use (the
+    # exporter/flight CLI precedent).
+    from heat_tpu.serving import server as _canonical
+
+    sys.exit(_canonical.main())

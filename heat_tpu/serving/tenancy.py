@@ -1,0 +1,223 @@
+"""
+Per-tenant fairness for the serving runtime (ISSUE 15).
+
+A fleet front end multiplexes many tenants over one process pool, and two
+shared resources let one tenant starve another: the **admission queue**
+(one tenant's burst fills ``HEAT_TPU_SERVING_QUEUE_MAX`` and every other
+tenant blocks or sheds behind it) and the **L1 trace cache** (one tenant's
+shape-diverse burst evicts another tenant's warm kernels, turning their
+steady-state hits back into cold XLA compiles). This module bounds both:
+
+* **Weighted admission shares** — ``HEAT_TPU_TENANCY`` arms tenancy and
+  optionally assigns weights (``"alpha:3,beta:1"``; bare ``"1"``/``"on"``
+  arms with every tenant at weight 1). When the scheduler's queue bound is
+  set, each tenant may occupy at most its weighted share of it
+  (:func:`queue_share`); overflow within a tenant's share follows the
+  scheduler's existing ``block``/``shed`` policy, counted per tenant
+  (``serving.tenant{<t>:shed-queue-full}``) so the operator can see *who*
+  is shedding, not just that shedding happened.
+
+* **Per-tenant L1 partitions over the shared L2** — tenant-tagged flushes
+  key into a per-tenant slice of the in-process trace cache
+  (:func:`l1_partition`), each bounded to the tenant's weighted share of
+  ``HEAT_TPU_FUSION_CACHE_SIZE`` (:func:`l1_capacity`, floor
+  :data:`MIN_PARTITION`). Evictions stay inside the bursting tenant's
+  partition (counted ``serving.tenant{<t>:l1-evict}``) — tenant B's warm
+  kernels survive tenant A's burst by construction. The persistent L2 disk
+  cache stays **shared** deliberately: serialized executables are
+  tenant-agnostic amortization (an eviction victim re-enters from disk
+  without an XLA compile), so partitioning it would only multiply storage.
+
+The tenant travels **thread-local** (:func:`tenant_context` /
+:func:`current_tenant`): the scheduler's worker wraps each flush in the
+submitting request's tenant, so ``core/fusion.py`` (which consults
+:func:`current_tenant` on armed flushes) needs no signature change.
+Untagged work — library calls, tests, anything outside a tenant context —
+uses the shared default cache unchanged, which is also why the CI leg that
+arms ``HEAT_TPU_TENANCY=1`` ambiently over the serving suite is a pure
+no-op for every untagged test.
+
+Off (``HEAT_TPU_TENANCY`` unset/``0`` — the default) every hook here is
+one env read and the runtime is bit-for-bit the PR 14 behavior.
+
+Counters: ``serving.tenant{<tenant>:scheduled / shed-queue-full /
+shed-deadline / deadline-miss / l1-evict}``; gauge
+``serving.tenant_depth[<tenant>]`` — that tenant's
+scheduled-but-unfinished flushes.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+from contextlib import contextmanager
+from typing import Dict, Optional, Tuple
+
+from ..monitoring import instrument as _instr
+from ..monitoring.registry import STATE as _MON
+
+__all__ = [
+    "armed",
+    "weights",
+    "weight_for",
+    "queue_share",
+    "tenant_context",
+    "current_tenant",
+    "l1_partition",
+    "l1_capacity",
+    "partition_info",
+    "clear_partitions",
+    "reset",
+]
+
+ENV_VAR = "HEAT_TPU_TENANCY"
+
+#: Smallest L1 partition a tenant can be squeezed to: below this, every
+#: flush of a modest working set would thrash its own partition.
+MIN_PARTITION = 16
+
+_parse_cache: Dict[str, Optional[Tuple[Tuple[str, float], ...]]] = {}
+
+_TLS = threading.local()
+
+_LOCK = threading.Lock()
+#: tenant -> OrderedDict (that tenant's slice of the trace LRU)
+_PARTITIONS: Dict[str, "collections.OrderedDict"] = {}
+
+
+def _parse(spec: str) -> Optional[Tuple[Tuple[str, float], ...]]:
+    """``HEAT_TPU_TENANCY`` value -> ((tenant, weight), ...) or None = off.
+    ``"1"``/``"on"``/``"true"`` arm tenancy with no explicit weights (every
+    tenant defaults to 1.0). Malformed specs raise ``ValueError`` — a
+    fairness-config typo must be loud, never silently unweighted."""
+    cached = _parse_cache.get(spec, _parse_cache)
+    if cached is not _parse_cache:
+        return cached
+    s = spec.strip().lower()
+    if s in ("", "0", "false", "off"):
+        parsed = None
+    elif s in ("1", "on", "true"):
+        parsed = ()
+    else:
+        rows = []
+        for part in s.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, w = part.partition(":")
+            name = name.strip()
+            if not name:
+                raise ValueError(f"malformed {ENV_VAR} spec {spec!r}")
+            try:
+                weight = float(w) if w else 1.0
+            except ValueError:
+                raise ValueError(f"malformed {ENV_VAR} spec {spec!r}") from None
+            if weight <= 0:
+                raise ValueError(
+                    f"{ENV_VAR} weights must be positive: {spec!r}"
+                )
+            rows.append((name, weight))
+        if not rows:
+            raise ValueError(f"malformed {ENV_VAR} spec {spec!r}")
+        parsed = tuple(rows)
+    _parse_cache[spec] = parsed
+    return parsed
+
+
+def armed() -> bool:
+    """Whether tenancy is armed (one env read — the off-path cost)."""
+    return _parse(os.environ.get(ENV_VAR, "")) is not None
+
+
+def weights() -> Dict[str, float]:
+    """The configured explicit weights (empty when armed bare or off)."""
+    parsed = _parse(os.environ.get(ENV_VAR, ""))
+    return dict(parsed) if parsed else {}
+
+
+def weight_for(tenant: str) -> float:
+    """``tenant``'s weight: the configured value, or 1.0 (unknown tenants
+    are first-class at unit weight — a fleet never hard-rejects a tenant
+    for being missing from a static config)."""
+    return weights().get(tenant, 1.0)
+
+
+def _share(tenant: str, total: int, known: Optional[set] = None) -> int:
+    w = weights()
+    seen = set(w) | {tenant} | (known or set())
+    denom = sum(w.get(t, 1.0) for t in seen)
+    if denom <= 0:
+        return total
+    return max(1, int(total * w.get(tenant, 1.0) / denom))
+
+
+def queue_share(tenant: str, queue_max: int, known: Optional[set] = None) -> int:
+    """``tenant``'s admission-queue share of ``queue_max``: proportional to
+    its weight over every *known* tenant (configured weights plus ``known``
+    — the scheduler passes the tenants it has actually seen), floor 1 so a
+    legitimate tenant can always make progress."""
+    return _share(tenant, queue_max, known)
+
+
+@contextmanager
+def tenant_context(tenant: Optional[str]):
+    """Tag this thread's runtime work with ``tenant`` (nests; ``None`` is a
+    no-op tag). The serving scheduler installs it around each flush so the
+    fusion layer's L1 partitioning needs no API change."""
+    prev = getattr(_TLS, "tenant", None)
+    _TLS.tenant = tenant if tenant is not None else prev
+    try:
+        yield
+    finally:
+        _TLS.tenant = prev
+
+
+def current_tenant() -> Optional[str]:
+    """The thread's active tenant tag, or None (untagged — shared cache)."""
+    return getattr(_TLS, "tenant", None)
+
+
+def l1_partition(tenant: str) -> "collections.OrderedDict":
+    """``tenant``'s slice of the in-process trace LRU (created on first
+    use). The caller (``core/fusion.py``) performs the same GIL-atomic
+    OrderedDict operations it performs on the shared cache."""
+    part = _PARTITIONS.get(tenant)
+    if part is None:
+        with _LOCK:
+            part = _PARTITIONS.setdefault(tenant, collections.OrderedDict())
+    return part
+
+
+def l1_capacity(tenant: str, cache_max: int) -> int:
+    """``tenant``'s partition bound: its weighted share of the process
+    trace-cache capacity over every tenant with a live partition, floored
+    at :data:`MIN_PARTITION`."""
+    return max(MIN_PARTITION, _share(tenant, cache_max, set(_PARTITIONS)))
+
+
+def count_eviction(tenant: str, n: int = 1) -> None:
+    """One L1 eviction inside ``tenant``'s partition (the fairness ledger:
+    a tenant evicting only its own entries is the guarantee)."""
+    if _MON.enabled and n:
+        _instr.serving_tenant(tenant, "l1-evict", n)
+
+
+def partition_info() -> Dict[str, int]:
+    """Occupancy per live tenant partition (``cache_info()`` attaches this
+    when tenancy is armed)."""
+    with _LOCK:
+        return {t: len(p) for t, p in sorted(_PARTITIONS.items())}
+
+
+def clear_partitions() -> None:
+    """Drop every tenant partition (``fusion.clear_cache()`` calls this so
+    'clear every cached executable' keeps meaning exactly that)."""
+    with _LOCK:
+        _PARTITIONS.clear()
+
+
+def reset() -> None:
+    """Test isolation: partitions and the parse cache."""
+    clear_partitions()
+    _parse_cache.clear()

@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""
+CI fleet smoke (ISSUE 15): boot a real 2-worker ingress and drive the
+recorded multi-tenant trace through it over HTTP.
+
+Asserts, end to end:
+
+* every response digest matches the locally computed reference (zero wrong
+  results; sheds are allowed — they are the admission contract);
+* the shared cache dir was written by the workers (the L2 is live);
+* the workers published telemetry-spool snapshots and /readyz serves a
+  fleet ``scale_signal`` from them;
+* /readyz is green with both workers, /metrics parses as Prometheus text
+  with per-process labels;
+* with ``--batching`` (the default), the workers ran with
+  ``HEAT_TPU_SERVING_BATCH=1`` + tenancy armed — the same trace must land
+  identically (the wire-level twin of the differential suite). With
+  ``--no-batching`` the workers run with the hatch pinned off.
+
+Exit 0 clean; 1 on any failed assertion. Usage:
+
+    python scripts/fleet_smoke.py [--no-batching] [--requests N]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--no-batching", action="store_true")
+    p.add_argument("--requests", type=int, default=48)
+    args = p.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("HEAT_TPU_MONITORING", "1")
+    from heat_tpu.monitoring import exporter
+    from heat_tpu.serving import loadgen
+    from heat_tpu.serving.server import Ingress
+
+    failures = []
+
+    def check(ok, what):
+        print(("ok   " if ok else "FAIL ") + what)
+        if not ok:
+            failures.append(what)
+
+    reqs = loadgen.trace(n=args.requests)
+    expected = loadgen.expected_digests(reqs)
+    with tempfile.TemporaryDirectory(prefix="fleet-smoke-") as tmp:
+        cache = os.path.join(tmp, "cache")
+        spool = os.path.join(tmp, "spool")
+        os.makedirs(spool)
+        env = {
+            "JAX_PLATFORMS": "cpu",
+            "HEAT_TPU_TELEMETRY_EVERY": "1",
+            "HEAT_TPU_TENANCY": "alpha:3,beta:1",
+            "HEAT_TPU_SERVING_BATCH": "0" if args.no_batching else "1",
+        }
+        ing = Ingress(workers=2, cache_dir=cache, spool=spool, env=env).start()
+        try:
+            stats = loadgen.run(ing.url(), reqs, concurrency=6, expected=expected)
+            print("loadgen:", json.dumps(stats, sort_keys=True))
+            check(stats["mismatches"] == 0, "zero wrong results")
+            check(stats["errors"] == 0, "zero transport errors")
+            check(stats["ok"] + stats["shed"] == len(reqs), "every request accounted")
+            check(stats["ok"] > 0 and stats["goodput_rps"] > 0, "goodput > 0")
+            check(
+                os.path.isdir(os.path.join(cache, "exec"))
+                and len(os.listdir(os.path.join(cache, "exec"))) > 0,
+                "workers warmed the shared L2",
+            )
+            with urllib.request.urlopen(ing.url("/readyz"), timeout=10) as r:
+                ready = json.loads(r.read().decode())
+            check(ready["ready"] and ready["workers"] == 2, "/readyz green, 2 workers")
+            check(ready["scale_signal"] is not None, "spool-fed scale signal present")
+            with urllib.request.urlopen(ing.url("/metrics"), timeout=10) as r:
+                text = r.read().decode()
+            check(exporter.validate_exposition(text) == [], "/metrics parse-clean")
+            check("heat_tpu_fleet_processes 2" in text, "fleet exposition sees 2 workers")
+        finally:
+            ing.stop()
+    if failures:
+        print(f"fleet smoke: {len(failures)} failure(s)")
+        return 1
+    print("fleet smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

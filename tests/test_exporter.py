@@ -522,8 +522,10 @@ def test_slo_env_config(monkeypatch):
 # ------------------------------------------------------------- satellites
 def test_latency_export_contract(monkeypatch):
     """Satellite: the three latency surfaces export through ONE shared
-    {count, p50_us, p99_us} shape, and the labelled per-kind
-    comm_collective_timeout breakdown survives as the documented alias."""
+    {count, p50_us, p99_us} shape. The labelled `comm_collective_timeout`
+    telemetry key — the PR 14 one-release alias — is RETIRED (ISSUE 15
+    satellite): the per-kind breakdown stays on the registry counter, the
+    uniform latency block is the telemetry surface."""
     with registry.capture():
         instr.serving_dispatch(0.002)
         instr.fusion_compile_latency(0.05)
@@ -535,7 +537,10 @@ def test_latency_export_contract(monkeypatch):
         assert set(tel[key]) == shape, key
         assert tel[key]["count"] == 1
         assert tel[key]["p99_us"] >= tel[key]["p50_us"] > 0
-    assert tel["comm_collective_timeout"] == {"allreduce": 1}  # alias kept
+    assert "comm_collective_timeout" not in tel  # the alias shipped one release
+    # the per-kind breakdown is still first-class on the registry counter
+    assert REGISTRY.counter("comm.collective_timeout").get("allreduce") == 1
+    assert tel["counters"]["comm.collective_timeout"] == 1
 
 
 def test_merged_chrome_traces_render_separate_tracks(monkeypatch):

@@ -1,0 +1,788 @@
+"""
+Fleet serving tier suite (``heat_tpu/serving/{batching,tenancy,server,
+loadgen}.py`` + the janitor cost-card sweep, ISSUE 15).
+
+Guarantees pinned here:
+
+* **Batched ≡ sequential** (the acceptance bar): results under
+  ``HEAT_TPU_SERVING_BATCH=1`` are bit-for-bit those of
+  ``HEAT_TPU_SERVING_BATCH=0`` across split {None, 0, 1} × even/ragged ×
+  f32/bf16, with ``serving.batch{flushes_saved}`` > 0 on the coalescing
+  runs; per-request scalar constants batch correctly; ineligible programs
+  (reductions, distributed operands, mixed weak dtypes) decline to the
+  unbatched path; a failed batched attempt recovers member-by-member
+  through the full ladder; batched kernels persist to and serve from the
+  shared L2.
+* **Fairness** (the acceptance bar): tenant A's shape-diverse burst evicts
+  only A's own L1 partition — tenant B's warm kernels stay hits — and
+  tenant admission shares bound who can occupy the scheduler queue, with
+  per-tenant shed/queue-depth accounting exported.
+* **Ingress** (the acceptance bar + satellite): a 2-worker server answers
+  the recorded multi-tenant trace with zero wrong results; SIGKILLing a
+  worker mid-load sheds/reroutes (never a wrong result), flips ``/readyz``
+  and recovers via respawn; a fresh 2-worker fleet against a warmed cache
+  dir serves the trace with ``fusion.kernels_compiled == 0`` in every
+  worker.
+* **Cost cards** (satellite): the janitor evicts a card with its L2 entry
+  and orphan-sweeps cards whose entry vanished through quarantine.
+* **Default off** (the acceptance bar): with no fleet knob set, no
+  ``serving.batch``/``serving.tenant``/``serving.ingress`` counter ever
+  ticks and the scheduler path is the PR 14 behavior.
+
+The multi-process ingress tests boot real worker subprocesses (full jax
+imports) and are marked ``slow`` to protect the tier-1 wall-clock budget
+(already within ~10% of its cap before this PR); the CI ``fleet-smoke``
+job runs the WHOLE marker (slow included) plus the loadgen smoke script
+and the ambient hatch legs.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu import serving
+from heat_tpu.core import fusion
+from heat_tpu.monitoring import registry, report
+from heat_tpu.robustness import faultinject
+from heat_tpu.serving import batching, loadgen, tenancy
+from heat_tpu.serving import janitor as sjanitor
+
+pytestmark = pytest.mark.fleet
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """Fresh counters, caches, partitions and batch groups on both sides.
+    The fleet knobs are deliberately NOT force-cleared here beyond their
+    defaults: the CI hatch legs run this suite under standing
+    ``HEAT_TPU_SERVING_BATCH=0`` / ``HEAT_TPU_TENANCY=1`` and
+    engagement-asserting tests pin their own gates via monkeypatch (the
+    PR 5/8 pin-the-gate-ON precedent)."""
+    registry.reset()
+    monkeypatch.setenv("HEAT_TPU_FUSION", "1")
+    monkeypatch.delenv("HEAT_TPU_CACHE_DIR", raising=False)
+    monkeypatch.delenv("HEAT_TPU_SHAPE_CORPUS", raising=False)
+    monkeypatch.delenv("HEAT_TPU_SERVING_QUEUE_MAX", raising=False)
+    monkeypatch.delenv("HEAT_TPU_SERVING_OVERFLOW", raising=False)
+    monkeypatch.delenv("HEAT_TPU_FLUSH_DEADLINE_MS", raising=False)
+    fusion.clear_cache()
+    tenancy.reset()
+    batching.reset()
+    yield
+    batching.reset()
+    tenancy.reset()
+    fusion.clear_cache()
+    registry.reset()
+
+
+@pytest.fixture
+def no_faults(monkeypatch):
+    """Pin injection/chaos/breakers/audit off for count-asserting tests
+    (the PR 6/9/12 precedent)."""
+    from heat_tpu.robustness import breaker
+
+    monkeypatch.delenv("HEAT_TPU_FAULT_PLAN", raising=False)
+    monkeypatch.delenv("HEAT_TPU_CHAOS", raising=False)
+    monkeypatch.delenv("HEAT_TPU_BREAKER_FORCE_OPEN", raising=False)
+    monkeypatch.delenv("HEAT_TPU_AUDIT_RATE", raising=False)
+    monkeypatch.delenv("HEAT_TPU_COLLECTIVE_CHECKSUM", raising=False)
+    faultinject.clear()
+    breaker.reset()
+    fusion.clear_cache()
+
+
+def _bitwise(a: np.ndarray, b: np.ndarray) -> bool:
+    return a.shape == b.shape and a.dtype == b.dtype and a.tobytes() == b.tobytes()
+
+
+def _batch(label: str) -> int:
+    return registry.REGISTRY.counter("serving.batch").get(label)
+
+
+def _compiles() -> int:
+    return registry.REGISTRY.counter("fusion.kernels_compiled").get()
+
+
+def _scalar_chain(x):
+    return ht.sin((x * 2.0 + 1.0) / 3.0 - 0.5)
+
+
+def _unary_chain(x):
+    return ht.sin(ht.tanh(ht.negative(x)))
+
+
+def _arm_batching(monkeypatch, group: int, linger_ms: float = 5000.0):
+    """Gate ON with a deterministic window: the group dispatches the moment
+    it fills (``group`` members), the generous linger only backstops a
+    straggling scheduler thread."""
+    monkeypatch.setenv("HEAT_TPU_SERVING_BATCH", "1")
+    monkeypatch.setenv("HEAT_TPU_SERVING_BATCH_MAX", str(group))
+    monkeypatch.setenv("HEAT_TPU_SERVING_BATCH_LINGER_MS", str(linger_ms))
+
+
+# ------------------------------------------------------------- batching
+@pytest.mark.parametrize("split", [None, 0, 1])
+@pytest.mark.parametrize("shape", [(12, 8), (11, 7)], ids=["even", "ragged"])
+@pytest.mark.parametrize("dtype", [np.float32, "bfloat16"], ids=["f32", "bf16"])
+def test_batching_bit_parity_matrix(monkeypatch, split, shape, dtype, no_faults):
+    """The acceptance differential: batched results are bit-identical to
+    ``HEAT_TPU_SERVING_BATCH=0`` across the split/ragged/dtype matrix, and
+    the single-device legs actually coalesce (``flushes_saved`` > 0).
+    Distributed operands decline batching — parity must hold there too."""
+    dt = np.dtype(dtype)
+    # unary chain: single-dtype, so bf16 legs coalesce too (a scalar chain's
+    # weak f32 constants against bf16 operands correctly decline)
+    datas = [
+        np.random.default_rng(i).normal(size=shape).astype(np.float32).astype(dt)
+        for i in range(3)
+    ]
+
+    def work():
+        arrs = [_unary_chain(ht.array(d.copy(), split=split)) for d in datas]
+        with serving.FlushScheduler(max_workers=3) as sched:
+            futs = [sched.schedule(a) for a in arrs]
+            return [np.asarray(f.result().larray) for f in futs]
+
+    monkeypatch.setenv("HEAT_TPU_SERVING_BATCH", "0")
+    sequential = work()
+    assert _batch("coalesced") == 0  # the hatch is a hatch
+    fusion.clear_cache()
+    with registry.capture():
+        _arm_batching(monkeypatch, group=3)
+        batched = work()
+        if split is None:
+            assert _batch("flushes_saved") > 0
+            assert _batch("coalesced") == 3
+        else:
+            # multi-device leaves are ineligible by construction
+            assert _batch("coalesced") == 0
+    for s, b in zip(sequential, batched):
+        assert _bitwise(s, b)
+
+
+def test_batching_coalesces_to_one_kernel(monkeypatch, no_faults):
+    """3 same-signature requests = ONE fused kernel compile, one dispatch,
+    and the scalar-constant chain's per-request constants ride the batch
+    (stacked ``(B, 1, …)``) rather than being shared or baked."""
+    datas = [
+        np.random.default_rng(i).normal(size=(8, 5)).astype(np.float32)
+        for i in range(3)
+    ]
+    consts = [3.0, 4.0, 5.0]
+
+    def work():
+        arrs = [
+            ht.sin((ht.array(d.copy()) * 2.0 + 1.0) / c - 0.5)
+            for d, c in zip(datas, consts)
+        ]
+        with serving.FlushScheduler(max_workers=3) as sched:
+            futs = [sched.schedule(a) for a in arrs]
+            return [f.result().numpy() for f in futs]
+
+    monkeypatch.setenv("HEAT_TPU_SERVING_BATCH", "0")
+    sequential = work()
+    fusion.clear_cache()
+    with registry.capture():
+        _arm_batching(monkeypatch, group=3)
+        before = _compiles()
+        batched = work()
+        assert _compiles() - before == 1  # the whole group, one kernel
+        assert _batch("coalesced") == 3
+        assert _batch("flushes_saved") == 2
+        assert _batch("fallback") == 0
+    for s, b in zip(sequential, batched):
+        assert _bitwise(s, b)
+
+
+def test_batching_bucketed_signature_groups_mixed_shapes(monkeypatch, no_faults):
+    """With a bucket policy armed, requests of DIFFERENT logical shapes in
+    one bucket share a batch group (the 'bucketed signature' contract) and
+    pad waste is accounted."""
+    shapes = [(9, 5), (12, 6), (14, 8)]  # all bucket to (16, 8) under pow2
+    datas = [
+        np.random.default_rng(i).normal(size=s).astype(np.float32)
+        for i, s in enumerate(shapes)
+    ]
+
+    def work():
+        arrs = [_unary_chain(ht.array(d.copy())) for d in datas]
+        with serving.FlushScheduler(max_workers=3) as sched:
+            futs = [sched.schedule(a) for a in arrs]
+            return [f.result().numpy() for f in futs]
+
+    monkeypatch.setenv("HEAT_TPU_SHAPE_BUCKETS", "pow2")
+    monkeypatch.setenv("HEAT_TPU_SERVING_BATCH", "0")
+    sequential = work()
+    fusion.clear_cache()
+    with registry.capture():
+        _arm_batching(monkeypatch, group=3)
+        batched = work()
+        assert _batch("coalesced") == 3
+        assert _batch("flushes_saved") == 2
+        assert _batch("pad_waste_bytes") > 0
+    for s, b in zip(sequential, batched):
+        assert _bitwise(s, b)
+
+
+def test_batching_declines_reduction_programs(monkeypatch, no_faults):
+    """A sink-rooted program is not pointwise: batching declines and the
+    sink path runs unchanged (parity, zero batch counters)."""
+    datas = [
+        np.random.default_rng(i).normal(size=(10, 4)).astype(np.float32)
+        for i in range(3)
+    ]
+
+    def work():
+        arrs = [(ht.array(d.copy()) * 2.0).sum() for d in datas]
+        with serving.FlushScheduler(max_workers=3) as sched:
+            futs = [sched.schedule(a) for a in arrs]
+            return [np.asarray(f.result().larray) for f in futs]
+
+    monkeypatch.setenv("HEAT_TPU_SERVING_BATCH", "0")
+    sequential = work()
+    fusion.clear_cache()
+    with registry.capture():
+        _arm_batching(monkeypatch, group=3, linger_ms=50.0)
+        batched = work()
+        assert _batch("coalesced") == 0
+    for s, b in zip(sequential, batched):
+        assert _bitwise(s, b)
+
+
+def test_batching_failed_attempt_recovers_per_member(monkeypatch, no_faults):
+    """An injected ``fusion.execute`` fault on the batched dispatch recovers
+    member-by-member through the normal flush (counted ``fallback``), bit-
+    identically."""
+    datas = [
+        np.random.default_rng(i).normal(size=(6, 6)).astype(np.float32)
+        for i in range(3)
+    ]
+    monkeypatch.setenv("HEAT_TPU_SERVING_BATCH", "0")
+    sequential = [_scalar_chain(ht.array(d.copy())).numpy() for d in datas]
+    fusion.clear_cache()
+    with registry.capture():
+        _arm_batching(monkeypatch, group=3)
+        # call 1 at the site is the batched attempt; the three individual
+        # recovery flushes then see calls 2..4 and run clean
+        with faultinject.inject("fusion.execute", RuntimeError("batch boom"), at_calls=[1]):
+            arrs = [_scalar_chain(ht.array(d.copy())) for d in datas]
+            with serving.FlushScheduler(max_workers=3) as sched:
+                futs = [sched.schedule(a) for a in arrs]
+                batched = [f.result().numpy() for f in futs]
+        assert _batch("fallback") == 3
+        assert _batch("flushes_saved") == 0
+    for s, b in zip(sequential, batched):
+        assert _bitwise(s, b)
+
+
+def test_batched_kernels_ride_the_l2(monkeypatch, tmp_path, no_faults):
+    """A batched kernel persists under the stacked-aval digest: after an L1
+    clear (process-restart stand-in) the same group is disk-served with
+    ZERO fused compiles."""
+    datas = [
+        np.random.default_rng(i).normal(size=(7, 5)).astype(np.float32)
+        for i in range(3)
+    ]
+
+    def work():
+        arrs = [_unary_chain(ht.array(d.copy())) for d in datas]
+        with serving.FlushScheduler(max_workers=3) as sched:
+            futs = [sched.schedule(a) for a in arrs]
+            return [f.result().numpy() for f in futs]
+
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    with registry.capture():
+        _arm_batching(monkeypatch, group=3)
+        first = work()
+        assert registry.REGISTRY.counter("serving.disk_cache").get("write") >= 1
+        fusion.clear_cache()
+        before = _compiles()
+        second = work()
+        assert _compiles() == before  # deserialized, never compiled
+        assert registry.REGISTRY.counter("serving.disk_cache").get("hit") >= 1
+        assert _batch("coalesced") == 6
+    for a, b in zip(first, second):
+        assert _bitwise(a, b)
+
+
+def test_batching_default_off_is_inert(monkeypatch, no_faults):
+    """No knob, no batching: zero serving.batch counters, no open groups,
+    and the scheduled path is the plain PR 14 dispatch."""
+    monkeypatch.delenv("HEAT_TPU_SERVING_BATCH", raising=False)
+    assert not batching.enabled()
+    with registry.capture():
+        arrs = [
+            _scalar_chain(ht.array(np.random.default_rng(i).normal(size=(5, 5)).astype(np.float32)))
+            for i in range(3)
+        ]
+        with serving.FlushScheduler(max_workers=2) as sched:
+            for f in [sched.schedule(a) for a in arrs]:
+                f.result()
+        snap = registry.snapshot()["counters"]
+        assert "serving.batch" not in snap
+    assert not batching._GROUPS
+
+
+# ------------------------------------------------------------- tenancy
+def test_tenancy_spec_parse_and_shares():
+    assert tenancy._parse("") is None
+    assert tenancy._parse("0") is None
+    assert tenancy._parse("1") == ()
+    assert tenancy._parse("alpha:3,beta:1") == (("alpha", 3.0), ("beta", 1.0))
+    assert tenancy._parse("alpha") == (("alpha", 1.0),)
+    with pytest.raises(ValueError):
+        tenancy._parse("alpha:zero")
+    with pytest.raises(ValueError):
+        tenancy._parse("alpha:-1")
+    os.environ["HEAT_TPU_TENANCY"] = "alpha:3,beta:1"
+    try:
+        assert tenancy.weight_for("alpha") == 3.0
+        assert tenancy.weight_for("unknown") == 1.0  # never hard-rejected
+        # alpha gets 3/4 of the queue, beta 1/4, floor 1
+        assert tenancy.queue_share("alpha", 8) == 6
+        assert tenancy.queue_share("beta", 8) == 2
+        assert tenancy.queue_share("beta", 1) == 1
+    finally:
+        del os.environ["HEAT_TPU_TENANCY"]
+        tenancy.reset()
+
+
+def test_tenant_context_is_thread_local():
+    assert tenancy.current_tenant() is None
+    seen = {}
+    with tenancy.tenant_context("alpha"):
+        assert tenancy.current_tenant() == "alpha"
+
+        def probe():
+            seen["other-thread"] = tenancy.current_tenant()
+
+        t = threading.Thread(target=probe)
+        t.start()
+        t.join()
+        with tenancy.tenant_context("beta"):
+            assert tenancy.current_tenant() == "beta"
+        assert tenancy.current_tenant() == "alpha"
+    assert tenancy.current_tenant() is None
+    assert seen["other-thread"] is None
+
+
+def test_tenant_l1_partitions_protect_warm_kernels(monkeypatch, no_faults):
+    """The fairness acceptance bar: tenant alpha's shape-diverse burst
+    leaves tenant beta's warm kernels resident — beta re-reads compile
+    NOTHING — while the unpartitioned control under the same cache bound
+    evicts beta. Evictions stay inside alpha's own partition."""
+    monkeypatch.setenv("HEAT_TPU_FUSION_CACHE_SIZE", "8")
+
+    def chain(i, rows):
+        x = ht.array(
+            np.random.default_rng(i).normal(size=(rows, 3)).astype(np.float32)
+        )
+        return ((x * 2.0) + 1.0).numpy()
+
+    with registry.capture():
+        monkeypatch.setenv("HEAT_TPU_TENANCY", "alpha:1,beta:1")
+        with tenancy.tenant_context("beta"):
+            for i in range(2):
+                chain(i, 4 + i)  # beta's warm two-kernel set
+        # alpha bursts 20 distinct shapes: over its own partition capacity
+        # (floor 16), far over the process bound (8)
+        with tenancy.tenant_context("alpha"):
+            for i in range(20):
+                chain(i, 30 + i)
+        assert registry.REGISTRY.counter("serving.tenant").get("alpha:l1-evict") > 0
+        assert registry.REGISTRY.counter("serving.tenant").get("beta:l1-evict") == 0
+        before = _compiles()
+        with tenancy.tenant_context("beta"):
+            for i in range(2):
+                chain(i, 4 + i)
+        assert _compiles() == before  # beta's warm set survived the burst
+        info = fusion.cache_info()
+        assert info["l1_partitions"]["beta"] == 2
+
+    # control: same burst, tenancy off, shared 8-entry cache: beta evicted
+    monkeypatch.delenv("HEAT_TPU_TENANCY")
+    tenancy.reset()
+    fusion.clear_cache()
+    with registry.capture():
+        for i in range(2):
+            chain(i, 4 + i)
+        for i in range(20):
+            chain(i, 30 + i)
+        before = _compiles()
+        for i in range(2):
+            chain(i, 4 + i)
+        assert _compiles() > before  # the burst evicted the warm set
+        assert "l1_partitions" not in fusion.cache_info()
+
+
+def test_tenant_admission_shares_and_counters(monkeypatch, no_faults):
+    """Weighted queue shares bound who occupies the admission queue: with
+    qmax=2 split 1/1, tenant a's second flush sheds while tenant b still
+    admits — counted and gauged per tenant."""
+    monkeypatch.setenv("HEAT_TPU_TENANCY", "a:1,b:1")
+
+    class _Gate:
+        def __init__(self, ev):
+            self.ev = ev
+
+        def _flush(self, _reason):
+            self.ev.wait(10)
+
+    with registry.capture():
+        ev = threading.Event()
+        sched = serving.FlushScheduler(max_workers=4, queue_max=2, overflow="shed")
+        try:
+            g1 = _Gate(ev)
+            f1 = sched.schedule(g1, tenant="a")
+            # a's share of qmax=2 over tenants {a, b} is 1: the second a
+            # flush sheds deterministically while its first is in flight
+            shed = sched.schedule(_Gate(ev), tenant="a")
+            assert shed.result(timeout=5) is not None
+            assert registry.REGISTRY.counter("serving.shed").get("queue-full") == 1
+            assert (
+                registry.REGISTRY.counter("serving.tenant").get("a:shed-queue-full")
+                == 1
+            )
+            # b's share is untouched by a's occupancy
+            f3 = sched.schedule(_Gate(ev), tenant="b")
+            assert sched.tenant_depth("a") == 1 and sched.tenant_depth("b") == 1
+            gauges = registry.snapshot()["gauges"]
+            assert gauges["serving.tenant_depth[a]"] == 1
+            ev.set()
+            f1.result(timeout=10)
+            f3.result(timeout=10)
+        finally:
+            ev.set()
+            sched.shutdown()
+        c = registry.REGISTRY.counter("serving.tenant")
+        assert c.get("a:scheduled") == 1 and c.get("b:scheduled") == 1
+
+
+def test_tenancy_ambient_arm_without_tags_is_shared(monkeypatch, no_faults):
+    """The ambient CI leg contract: ``HEAT_TPU_TENANCY=1`` with no tenant
+    tags anywhere partitions nothing and changes nothing."""
+    monkeypatch.setenv("HEAT_TPU_TENANCY", "1")
+    with registry.capture():
+        r = _scalar_chain(
+            ht.array(np.random.default_rng(3).normal(size=(6, 4)).astype(np.float32))
+        ).numpy()
+        assert tenancy.partition_info() == {}
+        assert fusion.cache_info()["l1_partitions"] == {}
+        snap = registry.snapshot()["counters"]
+        assert "serving.tenant" not in snap
+    assert r.shape == (6, 4)
+
+
+# ------------------------------------------------------------- janitor cost cards
+def _fake_entry(cache_dir, digest, body=b"x" * 64, mtime=None):
+    import pickle
+
+    from heat_tpu.serving import cache as scache
+
+    os.makedirs(os.path.join(cache_dir, "exec"), exist_ok=True)
+    os.makedirs(os.path.join(cache_dir, "cost"), exist_ok=True)
+    entry = {
+        "format": 1, "fp": ("x",), "payload": body, "in_tree": None, "out_tree": None,
+    }
+    path = scache.entry_path(cache_dir, digest)
+    with open(path, "wb") as f:
+        f.write(pickle.dumps(entry))
+    card = scache.cost_card_path(cache_dir, digest)
+    with open(card, "w") as f:
+        json.dump({"available": False}, f)
+    if mtime is not None:
+        os.utime(path, (mtime, mtime))
+        os.utime(card, (mtime, mtime))
+    return path, card
+
+
+def test_janitor_evicts_cost_card_with_its_entry(tmp_path, no_faults):
+    """Satellite: LRU eviction of an exec entry drops the PR 13 cost card
+    beside it (counted ``cost-evicted``)."""
+    old = time.time() - 3600
+    p1, c1 = _fake_entry(str(tmp_path), "a" * 8, mtime=old)
+    p2, c2 = _fake_entry(str(tmp_path), "b" * 8)
+    bound = os.path.getsize(p2) + 8  # room for exactly one surviving entry
+    with registry.capture():
+        stats = sjanitor.sweep(str(tmp_path), limit=bound, validate=False)
+    assert stats["evicted"] == 1 and stats["cost_evicted"] == 1
+    assert not os.path.exists(p1) and not os.path.exists(c1)
+    assert os.path.exists(p2) and os.path.exists(c2)
+    assert registry.REGISTRY.counter("serving.janitor").get("cost-evicted") == 1
+
+
+def test_janitor_sweeps_orphaned_cost_cards(tmp_path, no_faults):
+    """Satellite: a card whose entry vanished through quarantine (or any
+    path the eviction loop cannot see) is age-gated swept; a YOUNG
+    unmatched card (a store in flight writes entry-then-card) is kept."""
+    _p, old_card = _fake_entry(str(tmp_path), "c" * 8)
+    os.unlink(_p)  # the entry vanished (quarantine / audit-evict stand-in)
+    past = time.time() - 3600
+    os.utime(old_card, (past, past))
+    young_card = os.path.join(str(tmp_path), "cost", "d" * 8 + ".json")
+    with open(young_card, "w") as f:
+        json.dump({"available": False}, f)
+    with registry.capture():
+        stats = sjanitor.sweep(str(tmp_path), validate=False)
+    assert stats["cost_orphans"] == 1
+    assert not os.path.exists(old_card)
+    assert os.path.exists(young_card)  # age gate: may be mid-store
+    assert registry.REGISTRY.counter("serving.janitor").get("cost-orphans") == 1
+
+
+def test_quarantined_entry_card_is_swept_end_to_end(tmp_path, no_faults):
+    """The real quarantine path: a corrupt entry is quarantined by the
+    validate pass, its card becomes an orphan, and an aged sweep collects
+    it under ``serving.janitor``."""
+    path, card = _fake_entry(str(tmp_path), "e" * 8)
+    with open(path, "wb") as f:
+        f.write(b"not a pickle")
+    stats = sjanitor.sweep(str(tmp_path), validate=True)
+    assert stats["quarantined"] == 1
+    assert os.path.exists(card)  # still young: kept this pass
+    past = time.time() - 3600
+    os.utime(card, (past, past))
+    stats = sjanitor.sweep(str(tmp_path), validate=True)
+    assert stats["cost_orphans"] == 1
+    assert not os.path.exists(card)
+
+
+# ------------------------------------------------------------- wire format
+def test_wire_trace_is_deterministic_and_multi_tenant():
+    a = loadgen.trace(seed=7, n=40)
+    b = loadgen.trace(seed=7, n=40)
+    assert a == b
+    tenants = {r["tenant"] for r in a}
+    assert tenants == {"alpha", "beta"}
+    # beta replays the warm two-shape set; alpha roams the full space
+    beta_shapes = {tuple(r["shape"]) for r in a if r["tenant"] == "beta"}
+    alpha_shapes = {tuple(r["shape"]) for r in a if r["tenant"] == "alpha"}
+    assert beta_shapes <= set(loadgen.SHAPES[:2])
+    assert len(alpha_shapes) > len(beta_shapes)
+
+
+def test_wire_eval_digest_and_errors(no_faults):
+    req = {"shape": [6, 4], "dtype": "float32", "seed": 3,
+           "expr": [["mul", 2.0], ["add", 1.0], ["sin"]]}
+    d1 = loadgen.digest_of(loadgen.eval_request(req))
+    d2 = loadgen.digest_of(loadgen.eval_request(dict(req)))
+    assert d1 == d2
+    # the reference equals the plain eager computation
+    x = np.random.default_rng(3).normal(size=(6, 4)).astype(np.float32)
+    ref = ht.sin(ht.array(x) * 2.0 + 1.0)
+    assert loadgen.digest_of(ref) == d1
+    for bad in (
+        {"shape": [0, 4], "expr": []},
+        {"shape": [4], "dtype": "float64", "expr": []},
+        {"shape": [4], "expr": [["nope"]]},
+        {"shape": [4], "expr": [["sin", 1.0]]},
+        {"shape": [4], "expr": [["mul"]]},
+    ):
+        with pytest.raises(ValueError):
+            loadgen.eval_request(bad)
+    assert loadgen.expected_digests([req, dict(req)]) == {loadgen.request_key(req): d1}
+
+
+# ------------------------------------------------------------- telemetry
+def test_fleet_counters_export_labelled(no_faults):
+    from heat_tpu.monitoring import instrument as instr
+
+    with registry.capture():
+        instr.serving_batch("coalesced", 4)
+        instr.serving_batch("flushes_saved", 3)
+        instr.serving_tenant("alpha", "scheduled")
+        instr.serving_tenant_depth("alpha", 2)
+        instr.serving_ingress("routed", 5)
+        instr.serving_ingress("rerouted")
+        tel = report.telemetry()
+    assert tel["serving_batch"] == {"coalesced": 4, "flushes_saved": 3}
+    assert tel["serving_tenant"] == {"alpha:scheduled": 1}
+    assert tel["serving_ingress"] == {"routed": 5, "rerouted": 1}
+    # the per-tenant depth gauge folds into a tenant label in the exposition
+    from heat_tpu.monitoring import exporter
+
+    with registry.capture():
+        instr.serving_tenant_depth("alpha", 2)
+        text = exporter.exposition()
+    assert exporter.validate_exposition(text) == []
+    assert 'heat_tpu_serving_tenant_depth{tenant="alpha"} 2' in text.splitlines()
+
+
+# ------------------------------------------------------------- ingress (slow)
+def _get(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+@pytest.mark.slow
+def test_ingress_end_to_end_no_wrong_results(tmp_path):
+    """2-worker fleet vs the recorded multi-tenant trace: every response
+    digest matches the locally computed reference, readiness is green, the
+    fleet exposition parses, and the scale signal aggregates from the
+    workers' spool."""
+    from heat_tpu.monitoring import exporter
+    from heat_tpu.serving.server import Ingress
+
+    spool = str(tmp_path / "spool")
+    os.makedirs(spool)
+    reqs = loadgen.trace(n=24)
+    expected = loadgen.expected_digests(reqs)
+    ing = Ingress(
+        workers=2,
+        cache_dir=str(tmp_path / "cache"),
+        spool=spool,
+        env={"JAX_PLATFORMS": "cpu", "HEAT_TPU_TELEMETRY_EVERY": "1",
+             "HEAT_TPU_TENANCY": "alpha:3,beta:1",
+             "HEAT_TPU_SERVING_BATCH": "1"},
+    ).start()
+    try:
+        stats = loadgen.run(ing.url(), reqs, concurrency=6, expected=expected)
+        assert stats["mismatches"] == 0 and stats["errors"] == 0
+        assert stats["ok"] + stats["shed"] == len(reqs)
+        assert stats["ok"] > 0 and stats["goodput_rps"] > 0
+        code, ready = _get(ing.url("/readyz"))
+        assert code == 200 and ready["ready"] and ready["workers"] == 2
+        assert ready["scale_signal"] is not None
+        code, status = _get(ing.url("/statusz"))
+        assert len(status["workers"]) == 2
+        assert status["fleet"]["processes"]  # spool snapshots landed
+        with urllib.request.urlopen(ing.url("/metrics"), timeout=10) as r:
+            text = r.read().decode()
+        assert exporter.validate_exposition(text) == []
+        assert "heat_tpu_fleet_processes" in text
+    finally:
+        ing.stop()
+
+
+@pytest.mark.slow
+def test_ingress_worker_sigkill_sheds_reroutes_recovers(tmp_path):
+    """The failure satellite: one worker SIGKILLed mid-load — the ingress
+    reroutes/sheds (never a wrong result), /readyz flips to 503 and
+    recovers once the monitor respawns the worker. Chaos runs underneath
+    in the workers (the PR 9 seeded schedule) so recovery ladders carry
+    part of the traffic."""
+    from heat_tpu.serving.server import Ingress
+
+    spool = str(tmp_path / "spool")
+    os.makedirs(spool)
+    reqs = loadgen.trace(n=30)
+    expected = loadgen.expected_digests(reqs)
+    with registry.capture():
+        ing = Ingress(
+            workers=2,
+            cache_dir=str(tmp_path / "cache"),
+            spool=spool,
+            env={"JAX_PLATFORMS": "cpu", "HEAT_TPU_CHAOS": "20260805:0.05"},
+        ).start()
+        try:
+            box = {}
+            t = threading.Thread(
+                target=lambda: box.update(
+                    loadgen.run(ing.url(), reqs, concurrency=4, expected=expected)
+                )
+            )
+            t.start()
+            time.sleep(0.25)
+            victim = ing.worker_pids()[0]
+            os.kill(victim, signal.SIGKILL)
+            flipped = False
+            for _ in range(100):
+                try:
+                    urllib.request.urlopen(ing.url("/readyz"), timeout=5)
+                except urllib.error.HTTPError as e:
+                    if e.code == 503:
+                        flipped = True
+                        break
+                time.sleep(0.1)
+            t.join(timeout=300)
+            assert not t.is_alive()
+            assert flipped, "/readyz never flipped after the kill"
+            assert box["mismatches"] == 0 and box["errors"] == 0
+            assert box["ok"] + box["shed"] == len(reqs)
+            recovered = False
+            for _ in range(240):
+                try:
+                    code, _payload = _get(ing.url("/readyz"))
+                    if code == 200:
+                        recovered = True
+                        break
+                except (urllib.error.HTTPError, OSError):
+                    pass
+                time.sleep(0.25)
+            assert recovered, "/readyz never recovered after respawn"
+            c = registry.REGISTRY.counter("serving.ingress")
+            assert c.get("worker-dead") >= 1
+            assert c.get("respawned") >= 1
+        finally:
+            ing.stop()
+
+
+@pytest.mark.slow
+def test_ingress_breaker_force_open_leg(tmp_path):
+    """The degraded-paths leg: workers with every breaker forced open still
+    answer the trace with correct digests (eager replay / in-memory-only
+    serving underneath)."""
+    from heat_tpu.serving.server import Ingress
+
+    reqs = loadgen.trace(n=12)
+    expected = loadgen.expected_digests(reqs)
+    ing = Ingress(
+        workers=2,
+        cache_dir=str(tmp_path / "cache"),
+        env={"JAX_PLATFORMS": "cpu", "HEAT_TPU_BREAKER_FORCE_OPEN": "*"},
+    ).start()
+    try:
+        stats = loadgen.run(ing.url(), reqs, concurrency=4, expected=expected)
+        assert stats["mismatches"] == 0 and stats["errors"] == 0
+        assert stats["ok"] == len(reqs)
+    finally:
+        ing.stop()
+
+
+@pytest.mark.slow
+def test_cold_fleet_zero_compiles_against_warmed_dir(tmp_path):
+    """The cold-fleet acceptance bar: a FRESH 2-worker server against a
+    cache dir warmed by a previous fleet serves the whole trace with
+    ``fusion.kernels_compiled == 0`` in every worker (read from each
+    worker's spool snapshot)."""
+    from heat_tpu.monitoring import aggregate
+    from heat_tpu.serving.server import Ingress
+
+    cache = str(tmp_path / "cache")
+    reqs = loadgen.trace(n=24)
+    expected = loadgen.expected_digests(reqs)
+    env = {"JAX_PLATFORMS": "cpu", "HEAT_TPU_TELEMETRY_EVERY": "1"}
+
+    ing = Ingress(workers=2, cache_dir=cache, env=env).start()
+    try:
+        warm = loadgen.run(ing.url(), reqs, concurrency=4, expected=expected)
+        assert warm["mismatches"] == 0 and warm["errors"] == 0
+    finally:
+        ing.stop()
+    assert os.listdir(os.path.join(cache, "exec"))  # the fleet warmed L2
+
+    spool = str(tmp_path / "spool-cold")
+    os.makedirs(spool)
+    ing = Ingress(workers=2, cache_dir=cache, spool=spool, env=env).start()
+    try:
+        cold = loadgen.run(ing.url(), reqs, concurrency=4, expected=expected)
+        assert cold["mismatches"] == 0 and cold["errors"] == 0
+        assert cold["ok"] == len(reqs)
+        snaps, _skips = aggregate.read_snapshots(spool)
+        assert len(snaps) == 2  # both workers published
+        for snap in snaps:
+            compiled = snap["metrics"]["counters"].get("fusion.kernels_compiled", 0)
+            total = compiled["total"] if isinstance(compiled, dict) else compiled
+            assert total == 0, f"worker {snap['pid']} compiled {total} kernels cold"
+            hits = snap["metrics"]["counters"].get("serving.disk_cache", {})
+            assert (hits.get("labels") or {}).get("hit", 0) > 0
+    finally:
+        ing.stop()
