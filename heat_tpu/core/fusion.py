@@ -191,6 +191,7 @@ import jax.numpy as jnp
 from ..monitoring.registry import STATE as _MON
 from ..monitoring import flight as _FL
 from ..monitoring import instrument as _instr
+from ..monitoring import trace as _trace
 from ..robustness import breaker as _BRK
 from ..robustness import faultinject as _FI
 from ..robustness import integrity as _INTEG
@@ -2380,10 +2381,13 @@ def _flush_ladder(
         # recovery rungs below replay the retained program per-op and are
         # deliberately never corrupted: they are the trusted reference.
         values = _FI.corrupt_value("fusion.execute", values)
-        if compile_t0 is not None and _MON.enabled:
+        if compile_t0 is not None:
             # in-memory compile path: the first dispatch of the fresh jit
             # wrapper just paid trace + XLA compile (+ a negligible execute)
-            _instr.fusion_compile_latency(time.perf_counter() - compile_t0)
+            dt = time.perf_counter() - compile_t0
+            if _MON.enabled:
+                _instr.fusion_compile_latency(dt)
+            _trace.stage("compile", dt)
         if note is not None:
             note["rung"] = "fused"
         if compiled:
@@ -2569,6 +2573,10 @@ def materialize_for(d: DNDarray):
     flight_on = _FL.flight_enabled()
     t_flush0 = time.perf_counter() if flight_on else 0.0
     note: Optional[dict] = {} if flight_on else None
+    # distributed tracing (ISSUE 16): the scheduler installed the request's
+    # trace context on this thread when sampled; unsampled = one thread-local
+    # read, no stamps, no stage records — same pure-observation contract.
+    req_trace = _trace.current()
 
     # Recorded collectives in the program (excluding the pure-slice halo
     # views): they gate the dispatch-site fault check, the comm.collective
@@ -2784,12 +2792,13 @@ def materialize_for(d: DNDarray):
                 )
                 if aot is not None:
                     fused = aot
+                    # the AOT path paid the XLA compile inside store();
+                    # the ladder's rung-1 dispatch is then execute-only
+                    compile_dt = time.perf_counter() - compile_t0
                     if _MON.enabled:
-                        # the AOT path paid the XLA compile inside store();
-                        # the ladder's rung-1 dispatch is then execute-only
-                        _instr.fusion_compile_latency(
-                            time.perf_counter() - compile_t0
-                        )
+                        _instr.fusion_compile_latency(compile_dt)
+                    if req_trace is not None:
+                        _trace.stage("compile", compile_dt, trace=req_trace)
                     compile_t0 = None
         if key is not None:
             if compiled or from_disk:
@@ -2830,11 +2839,20 @@ def materialize_for(d: DNDarray):
         if note is not None:
             note["cache"] = "l2" if from_disk else ("compile" if compiled else "l1")
 
+        # execute = ladder wall minus whatever compile time the ladder itself
+        # attributed (the in-memory first dispatch records its compile stage
+        # inside rung 1) — the two stages partition the dispatch exactly
+        t_exec0 = time.perf_counter()
+        c_before = req_trace.stage_s("compile") if req_trace is not None else 0.0
         values = _flush_ladder(
             fused, program, leaf_arrays, out_idx, donate, compiled, key,
             has_coll=bool(coll_kinds), debucket=debucket, has_pallas=has_pallas,
             note=note, compile_t0=compile_t0,
         )
+        if req_trace is not None:
+            ladder_wall = time.perf_counter() - t_exec0
+            c_gain = req_trace.stage_s("compile") - c_before
+            _trace.stage("execute", max(0.0, ladder_wall - c_gain), trace=req_trace)
 
         # ---- integrity: shadow-replay audit (ISSUE 12). Every Nth fused
         # flush also runs the retained eager replay and compares outputs;
@@ -2851,6 +2869,7 @@ def materialize_for(d: DNDarray):
                 )
             values = audited
 
+    t_carve0 = time.perf_counter() if req_trace is not None else 0.0
     if bucket_slicer is not None:
         # restore the logical view from the bucket-padded root output (the
         # plan admits single-output pointwise programs only)
@@ -2871,6 +2890,8 @@ def materialize_for(d: DNDarray):
             ):
                 value = comm.placed(value, split, owner.shape)
         n.value = value
+    if req_trace is not None:
+        _trace.stage("carve", time.perf_counter() - t_carve0, trace=req_trace)
 
     if flight_on:
         # one structured record per flush. The signature is the L2 digest
@@ -2904,6 +2925,17 @@ def materialize_for(d: DNDarray):
             leaves=len(leaf_arrays),
             donate=list(donate),
             collectives=list(coll_kinds) or None,
+            # trace linkage (ISSUE 16): the flush record parents under the
+            # scheduler's serving.flush span id, so the merged Chrome trace
+            # hangs the ladder under the request's own subtree
+            **(
+                {
+                    "trace_id": req_trace.trace_id,
+                    "parent_span": _trace.current_span_id(),
+                }
+                if req_trace is not None
+                else {}
+            ),
             **note,
         )
     return root.value

@@ -200,6 +200,52 @@ def maybe_snapshot() -> None:
         write_snapshot(d)
 
 
+def write_trace(directory: Optional[str] = None) -> Optional[str]:
+    """Publish this process's Chrome-trace export as a spool sidecar
+    (``<directory>/<pid>-<nonce>.trace.json`` — the ``.trace.json`` suffix
+    keeps :func:`read_snapshots` from counting it torn). The worker calls
+    this after each traced request so the ingress's fleet-merged ``/trace``
+    view (ISSUE 16 satellite) sees worker-side spans without a CLI round
+    trip. Same discipline as :func:`write_snapshot`: atomic replace, never
+    raises, returns the path or None."""
+    try:
+        if directory is None:
+            directory = spool_dir()
+        if directory is None:
+            return None
+        from . import flight as _flight
+
+        path = os.path.join(directory, f"{os.getpid()}-{_NONCE}.trace.json")
+        _atomic_write_text(path, _flight.export_chrome_trace())
+        return path
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        if _MON.enabled:
+            _instr.telemetry_spool_snapshot("error")
+        return None
+
+
+def read_traces(directory: str) -> List[str]:
+    """The raw Chrome-trace sidecar strings of a spool directory (newest
+    write wins per process by filename identity). Unreadable files are
+    skipped — the merged view tolerates a sidecar mid-replace."""
+    out: List[str] = []
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return out
+    for name in names:
+        if not name.endswith(".trace.json") or name.startswith(".tmp-"):
+            continue
+        try:
+            with open(os.path.join(directory, name), "r") as f:
+                out.append(f.read())
+        except OSError:
+            continue
+    return out
+
+
 # ------------------------------------------------------------------ aggregation
 def read_snapshots(
     directory: str, max_age_s: Optional[float] = None
@@ -222,6 +268,8 @@ def read_snapshots(
     for name in names:
         if not name.endswith(".json") or name.startswith(".tmp-"):
             continue
+        if name.endswith(".trace.json"):
+            continue  # Chrome-trace sidecars (write_trace) are not snapshots
         path = os.path.join(directory, name)
         try:
             with open(path, "r") as f:

@@ -166,6 +166,15 @@ CATALOG: Tuple[Tuple[str, str], ...] = (
     ("slo.scale_signal", "gauge"),
     ("telemetry_spool.merge", "counter"),
     ("telemetry_spool.snapshots", "counter"),
+    ("trace.dropped", "counter"),
+    ("trace.sampled", "counter"),
+    ("trace.stage.batch_linger", "histogram"),
+    ("trace.stage.carve", "histogram"),
+    ("trace.stage.compile", "histogram"),
+    ("trace.stage.execute", "histogram"),
+    ("trace.stage.ingress_route", "histogram"),
+    ("trace.stage.queue", "histogram"),
+    ("trace.stage.respond", "histogram"),
 )
 
 _NAME_SAN = re.compile(r"[^a-zA-Z0-9_]")

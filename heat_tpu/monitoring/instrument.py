@@ -85,6 +85,9 @@ __all__ = [
     "serving_tenant",
     "serving_tenant_depth",
     "serving_ingress",
+    "trace_stage",
+    "trace_sampled",
+    "trace_dropped",
     "telemetry_spool_snapshot",
     "telemetry_spool_merge",
     "exporter_request",
@@ -406,6 +409,45 @@ def serving_ingress(kind: str, n: int = 1) -> None:
     worker-dead — a worker marked dead / respawned — a dead worker
     restarted)."""
     REGISTRY.counter("serving.ingress").inc(int(n), label=kind)
+
+
+def trace_stage(stage: str, seconds: float) -> None:
+    """One measured stage of a sampled request's latency decomposition
+    (ISSUE 16 — ``trace.stage.<stage>``, one fixed-name histogram per stage
+    in :data:`heat_tpu.monitoring.trace.STAGES`, the 1-2-5 dispatch buckets).
+    Observed ONLY for sampled requests: an unsampled fleet keeps every one of
+    these at count 0 (the off-inertness contract). The explicit if/elif chain
+    keeps each metric name a grep-visible literal for the catalog and ledger
+    drift guards."""
+    if stage == "ingress_route":
+        REGISTRY.histogram("trace.stage.ingress_route", _DISPATCH_BOUNDS).observe(seconds)
+    elif stage == "queue":
+        REGISTRY.histogram("trace.stage.queue", _DISPATCH_BOUNDS).observe(seconds)
+    elif stage == "batch_linger":
+        REGISTRY.histogram("trace.stage.batch_linger", _DISPATCH_BOUNDS).observe(seconds)
+    elif stage == "compile":
+        REGISTRY.histogram("trace.stage.compile", _DISPATCH_BOUNDS).observe(seconds)
+    elif stage == "execute":
+        REGISTRY.histogram("trace.stage.execute", _DISPATCH_BOUNDS).observe(seconds)
+    elif stage == "carve":
+        REGISTRY.histogram("trace.stage.carve", _DISPATCH_BOUNDS).observe(seconds)
+    elif stage == "respond":
+        REGISTRY.histogram("trace.stage.respond", _DISPATCH_BOUNDS).observe(seconds)
+
+
+def trace_sampled() -> None:
+    """One request the ingress sampled into a trace (``trace.sampled`` —
+    denominator for /rpcz coverage; stays 0 with ``HEAT_TPU_TRACE_SAMPLE``
+    unset)."""
+    REGISTRY.counter("trace.sampled").inc()
+
+
+def trace_dropped(reason: str) -> None:
+    """One sampled trace that could not complete its journey
+    (``trace.dropped{shed,deadline,worker-error}`` — the trace was minted but
+    the request shed at the ingress, missed its queue deadline, or errored in
+    the worker; its partial stage breakdown still reaches /rpcz)."""
+    REGISTRY.counter("trace.dropped").inc(label=reason)
 
 
 def telemetry_spool_snapshot(kind: str) -> None:

@@ -205,6 +205,9 @@ def telemetry(flush: bool = True) -> dict:
         ("telemetry_spool.snapshots", "telemetry_spool_snapshots"),
         ("telemetry_spool.merge", "telemetry_spool_merge"),
         ("exporter.requests", "exporter_requests"),
+        # distributed request tracing (ISSUE 16): sampled traces that could
+        # not complete their journey, by drop reason
+        ("trace.dropped", "trace_dropped"),
     ):
         val = snap["metrics"]["counters"].get(name)
         if isinstance(val, dict) and val.get("labels"):
@@ -213,6 +216,7 @@ def telemetry(flush: bool = True) -> dict:
     for name, key in (
         ("fusion.flush_recovered", "fusion_flush_recovered"),
         ("fusion.poisoned_signatures", "fusion_poisoned_signatures"),
+        ("trace.sampled", "trace_sampled"),
     ):
         val = counters.get(name)
         if val:
@@ -261,6 +265,16 @@ def telemetry(flush: bool = True) -> dict:
         h = snap["metrics"]["histograms"].get(hist_name)
         if h and h["count"]:
             out[key] = _latency_block(h)
+    # per-stage request decomposition (ISSUE 16): one _latency_block per
+    # trace stage with samples — absent entirely when tracing never sampled,
+    # so the off-mode telemetry block stays byte-identical
+    stages = {}
+    for stage in ("ingress_route", "queue", "batch_linger", "compile", "execute", "carve", "respond"):
+        h = snap["metrics"]["histograms"].get(f"trace.stage.{stage}")
+        if h and h["count"]:
+            stages[stage] = _latency_block(h)
+    if stages:
+        out["trace_stage_latency"] = stages
     # execution flight recorder (ISSUE 13): per-signature attribution
     # totals, the modeled-utilization gauge (attributed flops/s over the
     # per-platform peak table), and the ring occupancy — present only when

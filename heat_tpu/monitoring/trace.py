@@ -1,0 +1,232 @@
+"""
+Distributed request tracing: wire-propagated context + per-stage latency
+decomposition (ISSUE 16).
+
+Every observability tier so far is *per-process*: the flight recorder
+(PR 13) sees one runtime's flushes, the telemetry plane (PR 14) merges
+per-process counters, the fleet ingress (PR 15) routes requests it cannot
+attribute. This module is the connective tissue: ONE request's journey —
+ingress routing, scheduler queueing, batch linger, compile, execute,
+carve, respond — tagged with one ``trace_id`` across every process and
+thread it touches, so a fleet p99 outlier decomposes into *which stage,
+which worker* instead of a number.
+
+**Context propagation.** The ingress mints a ``trace_id`` (plus a root
+``span_id``) per sampled request and carries both in the JSON wire body
+(``{"trace_id": ..., "parent_span_id": ...}`` riding beside the loadgen
+wire fields — :func:`~heat_tpu.serving.loadgen.eval_request` ignores
+unknown keys by construction). The worker re-installs the context as a
+thread-local (:class:`trace_context` — the PR 15 ``tenant_context``
+idiom); the scheduler captures it at ``schedule()`` and re-installs it on
+the worker thread (the ``parent_span`` cross-thread precedent), so the
+batching coalescer and the fusion flush ladder read
+:func:`current` from plain thread-local state with zero plumbing through
+call signatures.
+
+**Stage taxonomy** (:data:`STAGES`): ``ingress_route`` (ingress-side
+parse + worker pick + wire overhead), ``queue`` (scheduler
+admission-to-dequeue), ``batch_linger`` (time parked in a continuous-
+batching group), ``compile`` (XLA build, both AOT and first-dispatch
+in-memory — the :func:`~heat_tpu.monitoring.instrument
+.fusion_compile_latency` sites), ``execute`` (fused kernel dispatch,
+ladder wall minus compile), ``carve`` (batched-row carve + canonical
+placement), ``respond`` (everything left: digesting, serialization, wire
+transfer — computed as the residual so the seven stages sum to the
+ingress-measured wall time by construction). Each measured stage lands in
+a per-stage registry histogram (``trace.stage.<stage>``, the 1-2-5
+dispatch buckets) *and* accumulates on the request's :class:`Trace`, which
+the worker echoes back as ``stages_ms`` in the wire response.
+
+**Sampling + overhead contract.** ``HEAT_TPU_TRACE_SAMPLE`` unset (the
+default) costs one env read at the ingress per request and a thread-local
+read (no env read) at the inner hooks: no context is ever installed, no
+stage is recorded, no histogram is touched, no span grows a trace id —
+results are bit-for-bit the PR 15 behavior (differential-tested). Set
+(``1``/``on``/``true``, or a rate ``0 < r < 1`` sampling that fraction of
+requests), sampled requests pay a uuid mint, a dict of float
+accumulators, and one histogram observe per stage.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import uuid
+from typing import Dict, Optional
+
+from . import instrument as _instr
+from .registry import STATE as _MON
+
+__all__ = [
+    "STAGES",
+    "Trace",
+    "sample_rate",
+    "should_sample",
+    "mint_trace_id",
+    "mint_span_id",
+    "trace_context",
+    "install",
+    "current",
+    "current_span_id",
+    "stage",
+]
+
+#: The per-request latency decomposition, in journey order.
+STAGES = (
+    "ingress_route",
+    "queue",
+    "batch_linger",
+    "compile",
+    "execute",
+    "carve",
+    "respond",
+)
+
+_TLS = threading.local()
+
+
+def sample_rate() -> float:
+    """The sampling rate (``HEAT_TPU_TRACE_SAMPLE``): 0.0 = off (the
+    default — one env read, nothing else), 1.0 = every request, a float in
+    between = that fraction. Read per request so tests reconfigure live."""
+    raw = os.environ.get("HEAT_TPU_TRACE_SAMPLE", "").strip().lower()
+    if not raw or raw in ("0", "off", "false"):
+        return 0.0
+    if raw in ("1", "on", "true"):
+        return 1.0
+    try:
+        return min(1.0, max(0.0, float(raw)))
+    except ValueError:
+        return 0.0
+
+
+def should_sample() -> bool:
+    """Sampling decision for one request. Deterministic at the endpoints
+    (0.0 → never, 1.0 → always); fractional rates hash a fresh uuid so no
+    seeded RNG state is consumed (tracing must not perturb any seeded
+    workload stream)."""
+    r = sample_rate()
+    if r <= 0.0:
+        return False
+    if r >= 1.0:
+        return True
+    return (uuid.uuid4().int % 10_000) < r * 10_000
+
+
+def mint_trace_id() -> str:
+    """A fresh 32-hex trace id."""
+    return uuid.uuid4().hex
+
+
+def mint_span_id() -> str:
+    """A fresh 16-hex span id."""
+    return uuid.uuid4().hex[:16]
+
+
+class Trace:
+    """One sampled request's propagated context + stage accumulator.
+
+    The same object travels ingress → worker HTTP thread → scheduler
+    worker thread (→ batching leader thread), so stage accumulation locks.
+    ``parent_span_id`` is the *innermost enclosing* span when the context
+    was installed (the ingress root span on the worker side)."""
+
+    __slots__ = ("trace_id", "parent_span_id", "stages", "_lock")
+
+    def __init__(self, trace_id: Optional[str] = None, parent_span_id: Optional[str] = None):
+        self.trace_id = trace_id or mint_trace_id()
+        self.parent_span_id = parent_span_id
+        self.stages: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def add(self, stage_name: str, seconds: float) -> None:
+        with self._lock:
+            self.stages[stage_name] = self.stages.get(stage_name, 0.0) + max(0.0, seconds)
+
+    def stage_s(self, stage_name: str) -> float:
+        with self._lock:
+            return self.stages.get(stage_name, 0.0)
+
+    def stages_ms(self) -> Dict[str, float]:
+        """The accumulated decomposition in milliseconds (wire shape)."""
+        with self._lock:
+            return {k: round(v * 1e3, 3) for k, v in self.stages.items()}
+
+
+class _NullContext:
+    """Shared no-op context for the unsampled path (the ``events._NULL``
+    idiom — zero allocation per request when tracing is off)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullContext()
+
+
+class trace_context:
+    """Install ``trace`` (and optionally the enclosing ``span_id``) as the
+    calling thread's trace context; restores the previous context on exit
+    (the ``tenancy.tenant_context`` save/restore discipline, so nested
+    installs — scheduler re-install inside a worker HTTP handler — are
+    safe)."""
+
+    __slots__ = ("_trace", "_span_id", "_prev")
+
+    def __init__(self, trace: Trace, span_id: Optional[str] = None):
+        self._trace = trace
+        self._span_id = span_id
+
+    def __enter__(self) -> Trace:
+        self._prev = (
+            getattr(_TLS, "trace", None),
+            getattr(_TLS, "span_id", None),
+        )
+        _TLS.trace = self._trace
+        _TLS.span_id = self._span_id
+        return self._trace
+
+    def __exit__(self, *exc) -> bool:
+        _TLS.trace, _TLS.span_id = self._prev
+        return False
+
+
+def install(trace: Optional[Trace], span_id: Optional[str] = None):
+    """``trace_context(trace, span_id)``, or a shared no-op context when
+    ``trace`` is None — call sites stay one ``with`` line on both the
+    sampled and unsampled paths."""
+    if trace is None:
+        return _NULL
+    return trace_context(trace, span_id)
+
+
+def current() -> Optional[Trace]:
+    """The calling thread's installed :class:`Trace`, or None (one
+    thread-local read — the inner-hook fast path when tracing is off)."""
+    return getattr(_TLS, "trace", None)
+
+
+def current_span_id() -> Optional[str]:
+    """The span id installed beside the current trace (the flush span the
+    flight record should parent under), or None."""
+    return getattr(_TLS, "span_id", None)
+
+
+def stage(stage_name: str, seconds: float, trace: Optional[Trace] = None) -> None:
+    """Record one measured stage: accumulate on the request's trace and
+    observe the per-stage registry histogram. No trace (``trace`` None and
+    none installed) = record nothing — sampled-out requests must leave
+    zero records. ``trace`` overrides the thread-local lookup for call
+    sites acting on behalf of another request (the batching leader
+    recording its followers' stages)."""
+    tr = trace if trace is not None else current()
+    if tr is None:
+        return
+    tr.add(stage_name, seconds)
+    if _MON.enabled:
+        _instr.trace_stage(stage_name, seconds)

@@ -68,7 +68,9 @@ import threading
 import time
 from typing import List, Optional
 
+from ..monitoring import events as _events
 from ..monitoring import instrument as _instr
+from ..monitoring import trace as _trace
 from ..monitoring.registry import STATE as _MON
 from . import buckets as _buckets
 
@@ -123,6 +125,10 @@ class _Plan:
     __slots__ = (
         "x", "root", "program", "out_idx", "chain", "stable_prog",
         "leaves", "slicer", "waste", "sig",
+        # distributed tracing (ISSUE 16): each member keeps its OWN request
+        # trace + enclosing flush-span id + enqueue time — the group shares
+        # one dispatch but never one identity
+        "trace", "span_id", "t_enq",
     )
 
 
@@ -258,6 +264,8 @@ def _dispatch(items: List[_Plan], group: _Group, reason: str) -> None:
     """Execute one batch group. Never raises: a failed batched attempt
     marks the group failed and every member recovers through its own
     unbatched flush (the full ladder)."""
+    import contextlib
+
     import jax
     import jax.numpy as jnp
 
@@ -268,90 +276,127 @@ def _dispatch(items: List[_Plan], group: _Group, reason: str) -> None:
     B = len(items)
     sig = items[0].sig
     rank = len(sig[2])
+    # distributed tracing (ISSUE 16): each traced member keeps its OWN
+    # trace_id — the group shares one dispatch, never one identity. Linger
+    # is per member (enqueue → dispatch start); compile/execute are the
+    # SHARED wall each member actually experienced; carve is per member.
+    t_d0 = time.perf_counter()
+    traced = [it for it in items if it.trace is not None]
+    for it in traced:
+        _trace.stage("batch_linger", t_d0 - it.t_enq, trace=it.trace)
+    if traced:
+        # ONE flush span shared by the whole group, nested (same thread)
+        # under the leader's serving.flush; the member flush-span ids ride
+        # in parent_spans so the merged Chrome trace links every request's
+        # own subtree to this shared dispatch
+        span_ctx = _events.span(
+            "serving.batch_flush",
+            batch=B,
+            span_id=_trace.mint_span_id(),
+            trace_ids=[it.trace.trace_id for it in traced],
+            parent_spans=[it.span_id for it in traced if it.span_id],
+        )
+    else:
+        span_ctx = contextlib.nullcontext()
     try:
-        stacked = []
-        n_leaves = len(items[0].leaves)
-        for j in range(n_leaves):
-            parts = [it.leaves[j] for it in items]
-            col = jnp.stack(parts)
-            if parts[0].shape == ():
-                # per-request scalars broadcast against their own row only
-                col = col.reshape((B,) + (1,) * rank)
-            stacked.append(col)
+        with span_ctx:
+            stacked = []
+            n_leaves = len(items[0].leaves)
+            for j in range(n_leaves):
+                parts = [it.leaves[j] for it in items]
+                col = jnp.stack(parts)
+                if parts[0].shape == ():
+                    # per-request scalars broadcast against their own row only
+                    col = col.reshape((B,) + (1,) * rank)
+                stacked.append(col)
 
-        key = ("serving-batch", sig, B)
-        fused = _fusion._TRACE_CACHE.get(key)
-        from_disk = False
-        digest = None
-        cache_dir = ""
-        if fused is None:
-            cache_dir = _cache.cache_dir()
-            if cache_dir:
-                digest = _cache.digest_for(
-                    items[0].stable_prog, stacked, (), items[0].out_idx
-                )
+            key = ("serving-batch", sig, B)
+            fused = _fusion._TRACE_CACHE.get(key)
+            from_disk = False
+            digest = None
+            cache_dir = ""
+            if fused is None:
+                cache_dir = _cache.cache_dir()
+                if cache_dir:
+                    digest = _cache.digest_for(
+                        items[0].stable_prog, stacked, (), items[0].out_idx
+                    )
+                    if digest is not None:
+                        fused = _cache.load(cache_dir, digest)
+                        from_disk = fused is not None
+            compiled = fused is None
+            compile_t0 = None
+            compile_dt = 0.0
+            if fused is None:
+                _FI.check("fusion.compile")
+                compile_t0 = time.perf_counter()
+                fused = jax.jit(_fusion._replay_fn(items[0].program, items[0].out_idx))
                 if digest is not None:
-                    fused = _cache.load(cache_dir, digest)
-                    from_disk = fused is not None
-        compiled = fused is None
-        compile_t0 = None
-        if fused is None:
-            _FI.check("fusion.compile")
-            compile_t0 = time.perf_counter()
-            fused = jax.jit(_fusion._replay_fn(items[0].program, items[0].out_idx))
-            if digest is not None:
-                aot = _cache.store(
-                    cache_dir, digest, fused, stacked,
-                    items[0].stable_prog, (), items[0].out_idx,
+                    aot = _cache.store(
+                        cache_dir, digest, fused, stacked,
+                        items[0].stable_prog, (), items[0].out_idx,
+                    )
+                    if aot is not None:
+                        fused = aot
+                        compile_dt = time.perf_counter() - compile_t0
+                        if _MON.enabled:
+                            _instr.fusion_compile_latency(compile_dt)
+                        compile_t0 = None
+            if compiled or from_disk:
+                _fusion._TRACE_CACHE[key] = fused
+                _fusion._cache_stats["misses"] += 1
+                limit = _fusion._cache_max()
+                while len(_fusion._TRACE_CACHE) > limit:
+                    _fusion._TRACE_CACHE.popitem(last=False)
+                    _fusion._cache_stats["evictions"] += 1
+            else:
+                try:
+                    _fusion._TRACE_CACHE.move_to_end(key)
+                except KeyError:  # concurrent clear_cache
+                    pass
+                _fusion._cache_stats["hits"] += 1
+
+            if _MON.enabled:
+                # ONE fused flush carried the whole group — that is the point
+                _instr.fusion_flush(
+                    items[0].chain,
+                    cache_hit=not compiled,
+                    compiled=compiled,
+                    reason=reason,
                 )
-                if aot is not None:
-                    fused = aot
-                    if _MON.enabled:
-                        _instr.fusion_compile_latency(
-                            time.perf_counter() - compile_t0
-                        )
-                    compile_t0 = None
-        if compiled or from_disk:
-            _fusion._TRACE_CACHE[key] = fused
-            _fusion._cache_stats["misses"] += 1
-            limit = _fusion._cache_max()
-            while len(_fusion._TRACE_CACHE) > limit:
-                _fusion._TRACE_CACHE.popitem(last=False)
-                _fusion._cache_stats["evictions"] += 1
-        else:
-            try:
-                _fusion._TRACE_CACHE.move_to_end(key)
-            except KeyError:  # concurrent clear_cache
-                pass
-            _fusion._cache_stats["hits"] += 1
 
-        if _MON.enabled:
-            # ONE fused flush carried the whole group — that is the point
-            _instr.fusion_flush(
-                items[0].chain,
-                cache_hit=not compiled,
-                compiled=compiled,
-                reason=reason,
-            )
-
-        _FI.check("fusion.execute")
-        values = fused(*stacked)
-        if compile_t0 is not None and _MON.enabled:
-            # in-memory path: first dispatch timed trace+compile+execute
-            # (compile-dominated), the ISSUE 13 convention
-            _instr.fusion_compile_latency(time.perf_counter() - compile_t0)
-        out = values[0]
-        for b, it in enumerate(items):
-            row = out[b]
-            if it.slicer is not None:
-                row = row[it.slicer]
-            _assign(it, row)
-        if _MON.enabled:
-            _instr.serving_batch("coalesced", B)
-            _instr.serving_batch("flushes_saved", B - 1)
-            waste = sum(it.waste for it in items)
-            if waste:
-                _instr.serving_batch("pad_waste_bytes", waste)
+            _FI.check("fusion.execute")
+            t_exec0 = time.perf_counter()
+            values = fused(*stacked)
+            exec_dt = time.perf_counter() - t_exec0
+            if compile_t0 is not None:
+                # in-memory path: first dispatch timed trace+compile+execute
+                # (compile-dominated), the ISSUE 13 convention — the whole
+                # wall counts as compile, execute 0
+                compile_dt = time.perf_counter() - compile_t0
+                exec_dt = 0.0
+                if _MON.enabled:
+                    _instr.fusion_compile_latency(compile_dt)
+            for it in traced:
+                if compile_dt:
+                    _trace.stage("compile", compile_dt, trace=it.trace)
+                if exec_dt:
+                    _trace.stage("execute", exec_dt, trace=it.trace)
+            out = values[0]
+            for b, it in enumerate(items):
+                t_c0 = time.perf_counter()
+                row = out[b]
+                if it.slicer is not None:
+                    row = row[it.slicer]
+                _assign(it, row)
+                if it.trace is not None:
+                    _trace.stage("carve", time.perf_counter() - t_c0, trace=it.trace)
+            if _MON.enabled:
+                _instr.serving_batch("coalesced", B)
+                _instr.serving_batch("flushes_saved", B - 1)
+                waste = sum(it.waste for it in items)
+                if waste:
+                    _instr.serving_batch("pad_waste_bytes", waste)
     except (KeyboardInterrupt, SystemExit):
         raise
     except Exception:
@@ -373,6 +418,12 @@ def offer(x, reason: str = "serving") -> bool:
     plan = _plan_for(x)
     if plan is None:
         return False
+    # capture the scheduler-installed request context NOW (this is the
+    # member's own thread): the leader dispatches on behalf of the group
+    # and must tag each member's trace, not its own
+    plan.trace = _trace.current()
+    plan.span_id = _trace.current_span_id()
+    plan.t_enq = time.perf_counter()
     bmax = batch_max()
     with _LOCK:
         g = _GROUPS.get(plan.sig)
@@ -397,6 +448,14 @@ def offer(x, reason: str = "serving") -> bool:
             if len(items) == 1:
                 # no company arrived: the unbatched path IS the batch of 1
                 # (full L1/L2/ladder semantics, no batched kernel compiled)
+                if plan.trace is not None:
+                    # the linger window burned waiting for company is this
+                    # member's batch_linger (_dispatch records it for groups)
+                    _trace.stage(
+                        "batch_linger",
+                        time.perf_counter() - plan.t_enq,
+                        trace=plan.trace,
+                    )
                 g.failed = True
             else:
                 _dispatch(items, g, reason)

@@ -244,6 +244,11 @@ def run(
     lock = threading.Lock()
     it = iter(list(enumerate(requests)))
     lat: List[float] = []
+    # distributed tracing (ISSUE 16): when the ingress samples a request it
+    # echoes {trace_id, stages_ms, total_ms} — keep (client wall, server
+    # breakdown) pairs so the client can CHECK the server's decomposition
+    # against what it measured on the wire
+    traced: List[Tuple[float, dict]] = []
     stats = {"n": len(requests), "ok": 0, "shed": 0, "errors": 0, "mismatches": 0}
 
     def worker():
@@ -276,6 +281,8 @@ def run(
                     if good:
                         stats["ok"] += 1
                         lat.append(dt)
+                        if isinstance(payload.get("stages_ms"), dict):
+                            traced.append((dt, payload["stages_ms"]))
                 else:
                     stats["errors"] += 1
 
@@ -305,6 +312,24 @@ def run(
             "goodput_rps": round(stats["ok"] / wall, 2),
         }
     )
+    stats["traced"] = len(traced)
+    if traced:
+        # per-stage client-side aggregate (ms) + the breakdown-ratio check:
+        # server stage sum / client-measured wire latency, per request. The
+        # server decomposition covers the ingress wall, so the ratio sits
+        # just under 1.0 (the gap is loopback client overhead) — the
+        # acceptance contract pins the median within 10%.
+        ratios = sorted(
+            sum(float(v) for v in stages.values()) / 1e3 / dt
+            for dt, stages in traced
+            if dt > 0
+        )
+        per_stage: Dict[str, float] = {}
+        for _dt, stages in traced:
+            for k, v in stages.items():
+                per_stage[k] = per_stage.get(k, 0.0) + float(v)
+        stats["stage_totals_ms"] = {k: round(v, 3) for k, v in sorted(per_stage.items())}
+        stats["breakdown_ratio_p50"] = round(ratios[len(ratios) // 2], 4)
     return stats
 
 

@@ -87,6 +87,7 @@ from ..monitoring import aggregate as _agg
 from ..monitoring import events as _events
 from ..monitoring import flight as _flight
 from ..monitoring import instrument as _instr
+from ..monitoring import trace as _trace
 from ..monitoring.registry import STATE as _MON
 from . import batching as _batching
 from . import tenancy as _tenancy
@@ -258,6 +259,10 @@ class FlushScheduler:
         # its own span stack — concurrent flushes cannot corrupt each
         # other's nesting — and every record carries its thread id)
         parent_span = _events.current_span_name() if _MON.enabled else None
+        # distributed tracing (ISSUE 16): capture the submitting thread's
+        # installed trace context the same way — the worker thread
+        # re-installs it so batching/fusion hooks downstream see it
+        req_trace = _trace.current()
 
         def run():
             dispatched = False
@@ -269,14 +274,29 @@ class FlushScheduler:
                         _instr.serving_shed("deadline")
                         if tenant is not None:
                             _instr.serving_tenant(tenant, "shed-deadline")
+                        if req_trace is not None:
+                            _instr.trace_dropped("deadline")
                     return x
+                _trace.stage("queue", waited, trace=req_trace)
                 dispatched = True
                 flush = getattr(x, "_flush", None)
                 if flush is not None:
-                    with _tenancy.tenant_context(tenant), _events.span(
+                    span_attrs = {}
+                    flush_sid = None
+                    if req_trace is not None:
+                        flush_sid = _trace.mint_span_id()
+                        span_attrs = {
+                            "trace_id": req_trace.trace_id,
+                            "span_id": flush_sid,
+                            "parent_span_id": req_trace.parent_span_id,
+                        }
+                    with _tenancy.tenant_context(tenant), _trace.install(
+                        req_trace, span_id=flush_sid
+                    ), _events.span(
                         "serving.flush",
                         parent=parent_span,
                         queued_ms=round(waited * 1e3, 3),
+                        **span_attrs,
                     ):
                         # continuous batching (ISSUE 15): with
                         # HEAT_TPU_SERVING_BATCH=1, eligible flushes coalesce

@@ -38,6 +38,14 @@ Ingress routes:
     Prometheus text: the spool fleet exposition (per-worker ``pid``/
     ``nonce`` labels) when a spool is armed, else the ingress's own
     registry.
+``GET /rpcz``
+    The top-N slowest recently sampled traces with per-stage breakdowns +
+    exact per-stage ``{count, p50_us, p99_us}`` (ISSUE 16 — empty unless
+    ``HEAT_TPU_TRACE_SAMPLE`` armed sampling at the ingress).
+``GET /trace``
+    The fleet-merged Chrome trace: the ingress's own span export merged
+    with the workers' ``.trace.json`` spool sidecars — one connected
+    cross-process span tree per sampled request, Perfetto-loadable.
 
 A monitor thread polls worker processes (``proc.poll()``, no HTTP
 probing); dead workers are respawned by default (``{respawned}``) so
@@ -73,6 +81,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional
 
 from ..monitoring import instrument as _instr
+from ..monitoring import trace as _trace
 from ..monitoring.registry import STATE as _MON
 
 __all__ = ["Ingress", "WorkerSlot", "run_worker", "main"]
@@ -115,8 +124,22 @@ class _WorkerHandler(BaseHTTPRequestHandler):
         except (KeyboardInterrupt, SystemExit):
             raise
         except Exception as e:
-            self._send_json(400, {"ok": False, "error": repr(e)[:300]})
+            self._send_json(
+                400, {"ok": False, "error": repr(e)[:300],
+                      "trace_id": None, "reason": "bad-request"}
+            )
             return
+        # distributed tracing (ISSUE 16): re-install the ingress-minted
+        # context as this handler thread's trace — the tenant_context idiom —
+        # so the scheduler, batching, and fusion hooks downstream all tag the
+        # same request. Unsampled requests carry no trace_id: two dict reads,
+        # nothing installed, bit-for-bit the PR 15 path.
+        tid = req.get("trace_id")
+        tr = (
+            _trace.Trace(trace_id=str(tid), parent_span_id=req.get("parent_span_id"))
+            if tid
+            else None
+        )
         try:
             t0 = time.perf_counter()
             from . import loadgen as _loadgen
@@ -125,7 +148,7 @@ class _WorkerHandler(BaseHTTPRequestHandler):
 
             tenant = req.get("tenant")
             tenant = str(tenant) if tenant is not None else None
-            with _tenancy.tenant_context(tenant):
+            with _tenancy.tenant_context(tenant), _trace.install(tr):
                 x = _loadgen.eval_request(req)
                 # the serving path proper: admission control, deadlines,
                 # tenancy shares, continuous batching — all via the process
@@ -134,23 +157,39 @@ class _WorkerHandler(BaseHTTPRequestHandler):
                 # materializes synchronously — bit-identical by contract.
                 _scheduler.schedule(x, tenant=tenant).result()
                 digest = _loadgen.digest_of(x)
-            self._send_json(
-                200,
-                {
-                    "ok": True,
-                    "sha256": digest,
-                    "shape": [int(d) for d in x.shape],
-                    "dtype": str(x.dtype),
-                    "worker_pid": os.getpid(),
-                    "elapsed_ms": round((time.perf_counter() - t0) * 1e3, 3),
-                },
-            )
+            payload = {
+                "ok": True,
+                "sha256": digest,
+                "shape": [int(d) for d in x.shape],
+                "dtype": str(x.dtype),
+                "worker_pid": os.getpid(),
+                "elapsed_ms": round((time.perf_counter() - t0) * 1e3, 3),
+            }
+            if tr is not None:
+                payload["trace_id"] = tr.trace_id
+                payload["stages_ms"] = tr.stages_ms()
+            self._send_json(200, payload)
+            if tr is not None:
+                # publish this process's span export as a spool sidecar so
+                # the ingress's fleet-merged /trace sees worker-side spans
+                # (after the response — never on the request's critical path)
+                from ..monitoring import aggregate as _agg
+
+                _agg.write_trace()
         except ValueError as e:  # malformed wire request
-            self._send_json(400, {"ok": False, "error": repr(e)[:300]})
+            self._send_json(
+                400, {"ok": False, "error": repr(e)[:300],
+                      "trace_id": tid, "reason": "bad-request"}
+            )
         except (KeyboardInterrupt, SystemExit):
             raise
         except Exception as e:  # a compute bug must not kill the worker
-            self._send_json(500, {"ok": False, "error": repr(e)[:300]})
+            if tr is not None and _MON.enabled:
+                _instr.trace_dropped("worker-error")
+            self._send_json(
+                500, {"ok": False, "error": repr(e)[:300],
+                      "trace_id": tid, "reason": "worker-error"}
+            )
 
 
 def run_worker(port: int = 0, host: str = "127.0.0.1", announce: bool = False) -> None:
@@ -279,24 +318,56 @@ class _IngressHandler(BaseHTTPRequestHandler):
         if route != "/v1/compute":
             self._send_json(404, {"error": f"no route {route}"})
             return
+        t_recv = time.perf_counter()
         try:
             length = int(self.headers.get("Content-Length", "0"))
             body = self.rfile.read(length)
         except (KeyboardInterrupt, SystemExit):
             raise
         except Exception as e:
-            self._send_json(400, {"ok": False, "error": repr(e)[:300]})
+            self._send_json(
+                400, {"ok": False, "error": repr(e)[:300],
+                      "trace_id": None, "reason": "bad-request"}
+            )
             return
+        # distributed tracing (ISSUE 16): mint the trace here — the fleet's
+        # one entry point — and carry it in the wire body (eval_request
+        # ignores unknown keys, so the injection is invisible to compute).
+        # HEAT_TPU_TRACE_SAMPLE unset = one env read, no minting, no records.
+        trace_id = root_sid = None
+        if _trace.should_sample():
+            try:
+                req = json.loads(body.decode())
+                if isinstance(req, dict):
+                    trace_id = _trace.mint_trace_id()
+                    root_sid = _trace.mint_span_id()
+                    req["trace_id"] = trace_id
+                    req["parent_span_id"] = root_sid
+                    body = json.dumps(req, sort_keys=True).encode()
+                    if _MON.enabled:
+                        _instr.trace_sampled()
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception:
+                trace_id = root_sid = None  # unparseable: let the worker 400
+        t_fwd0 = time.perf_counter()
         try:
             result = self.ingress.route(body)
         except (BrokenPipeError, ConnectionResetError):
             return
         if result is None:
+            if trace_id is not None and _MON.enabled:
+                _instr.trace_dropped("shed")
             self._send_json(
-                503, {"ok": False, "shed": True, "error": "no live worker"}
+                503, {"ok": False, "shed": True, "error": "no live worker",
+                      "trace_id": trace_id, "reason": "no-live-worker"}
             )
         else:
             code, payload = result
+            if trace_id is not None:
+                payload = self.ingress.finish_trace(
+                    trace_id, root_sid, t_recv, t_fwd0, code, payload
+                )
             self._send_text(code, payload, "application/json")
 
     def do_GET(self):  # noqa: N802
@@ -320,6 +391,10 @@ class _IngressHandler(BaseHTTPRequestHandler):
                 )
             elif route == "/statusz":
                 self._send_json(200, ing.statusz())
+            elif route == "/rpcz":
+                self._send_json(200, ing.rpcz())
+            elif route == "/trace":
+                self._send_text(200, ing.merged_trace(), "application/json")
             elif route == "/metrics":
                 from ..monitoring import exporter as _exporter
 
@@ -383,6 +458,12 @@ class Ingress:
         self._slots: List[WorkerSlot] = []
         self._rr = 0
         self._lock = threading.Lock()
+        # /rpcz ring (ISSUE 16): the most recent sampled traces with their
+        # stage breakdowns — bounded, ingress-local, zero cost unsampled
+        from collections import deque
+
+        self._rpcz_buf = deque(maxlen=256)
+        self._rpcz_lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._monitor: Optional[threading.Thread] = None
@@ -540,6 +621,117 @@ class Ingress:
             _instr.serving_ingress("shed")
         return None
 
+    # ---- distributed tracing (ISSUE 16)
+    def finish_trace(
+        self, trace_id: str, root_sid: str,
+        t_recv: float, t_fwd0: float, code: int, payload_text: str,
+    ) -> str:
+        """Close one sampled request at the ingress: fold the worker's
+        measured stages into the full seven-stage decomposition, record the
+        root span + ingress-side histograms, and push the /rpcz entry.
+
+        The two ingress stages are **residuals**, so the seven stages sum to
+        the ingress wall time by construction: ``ingress_route`` is
+        everything outside the worker (parse/mint + route wall minus the
+        worker's own elapsed), ``respond`` is the worker time not claimed by
+        a measured stage (digesting, serialization, wire transfer). Returns
+        the payload to relay — enriched when the worker answered JSON,
+        verbatim otherwise."""
+        from ..monitoring import events as _events
+
+        t_done = time.perf_counter()
+        try:
+            payload = json.loads(payload_text)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception:
+            payload = None
+        if not isinstance(payload, dict):
+            return payload_text
+        total_s = t_done - t_recv
+        worker_s = float(payload.get("elapsed_ms") or 0.0) / 1e3
+        stages = dict(payload.get("stages_ms") or {})
+        measured_s = (
+            sum(
+                float(stages.get(s, 0.0))
+                for s in ("queue", "batch_linger", "compile", "execute", "carve")
+            )
+            / 1e3
+        )
+        ingress_route_s = max(0.0, (t_fwd0 - t_recv) + (t_done - t_fwd0) - worker_s)
+        respond_s = max(0.0, worker_s - measured_s)
+        stages["ingress_route"] = round(ingress_route_s * 1e3, 3)
+        stages["respond"] = round(respond_s * 1e3, 3)
+        payload["trace_id"] = trace_id
+        payload["stages_ms"] = stages
+        payload["total_ms"] = round(total_s * 1e3, 3)
+        if _MON.enabled:
+            _instr.trace_stage("ingress_route", ingress_route_s)
+            _instr.trace_stage("respond", respond_s)
+        # the root span, backdated over the whole ingress wall — every
+        # worker-side span carries parent_span_id == root_sid, so the merged
+        # Chrome trace hangs one connected tree off this record
+        _events.record(
+            "ingress.request",
+            total_s,
+            trace_id=trace_id,
+            span_id=root_sid,
+            status=int(code),
+        )
+        with self._rpcz_lock:
+            self._rpcz_buf.append(
+                {
+                    "trace_id": trace_id,
+                    "status": int(code),
+                    "worker_pid": payload.get("worker_pid"),
+                    "total_ms": round(total_s * 1e3, 3),
+                    "stages_ms": stages,
+                    "time": time.time(),
+                }
+            )
+        return json.dumps(payload, sort_keys=True, default=str)
+
+    def rpcz(self, top: int = 32) -> dict:
+        """The /rpcz surface: the top-N slowest recent sampled traces with
+        stage breakdowns, plus exact per-stage ``{count, p50_us, p99_us}``
+        over the ring (sample percentiles — the ingress never sees worker
+        registries, so these come from the echoed wire breakdowns)."""
+        with self._rpcz_lock:
+            entries = list(self._rpcz_buf)
+        slowest = sorted(entries, key=lambda e: -e["total_ms"])[: int(top)]
+        per_stage = {}
+        for stage in _trace.STAGES:
+            vals = sorted(
+                float(e["stages_ms"].get(stage, 0.0)) * 1e3  # ms → µs
+                for e in entries
+                if stage in e["stages_ms"]
+            )
+            if not vals:
+                continue
+            per_stage[stage] = {
+                "count": len(vals),
+                "p50_us": round(vals[int(0.50 * (len(vals) - 1))], 1),
+                "p99_us": round(vals[int(0.99 * (len(vals) - 1))], 1),
+            }
+        return {
+            "sampling": _trace.sample_rate(),
+            "recent": len(entries),
+            "top": slowest,
+            "stages": per_stage,
+        }
+
+    def merged_trace(self) -> str:
+        """The fleet-merged Chrome trace: this ingress's own span export
+        (the ``ingress.request`` roots) merged with every worker's
+        ``.trace.json`` spool sidecar — ONE Perfetto document, real pids."""
+        from ..monitoring import aggregate as _aggregate
+        from ..monitoring import flight as _flight
+
+        traces = [_flight.export_chrome_trace()]
+        if self.spool:
+            traces.extend(_aggregate.read_traces(self.spool))
+        return _aggregate.merge_chrome_traces(traces)
+
     # ---- readiness / status
     def readiness(self):
         live = self.live_workers()
@@ -620,6 +812,12 @@ def main(argv=None) -> int:
     if args.worker:
         run_worker(port=args.port, host=args.host, announce=args.announce)
         return 0
+    # the ingress records its own root spans (ingress.request) and counters;
+    # without monitoring armed /trace would merge an empty ingress export
+    os.environ.setdefault("HEAT_TPU_MONITORING", "1")
+    from ..monitoring import registry as _registry
+
+    _registry.enable()
     ing = Ingress(
         workers=args.workers,
         port=args.port,

@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""
+CI trace smoke (ISSUE 16): boot a real 2-worker ingress with
+``HEAT_TPU_TRACE_SAMPLE=1``, drive it over HTTP, and WALK the merged
+/trace document — the live twin of the test-suite schema assertions.
+
+Asserts, end to end:
+
+* every response digest matches the local reference and every answered
+  request came back traced (``stages_ms`` on the wire);
+* the sequential phase's server-side stage sum lands within 10% of the
+  client-measured wire latency (the decomposition acceptance bar);
+* /rpcz serves the top-N slowest recent traces, slowest first, each with
+  the full ingress_route→respond breakdown, plus per-stage
+  ``{count, p50_us, p99_us}``;
+* the merged /trace renders ONE connected span tree per sampled request:
+  an ``ingress.request`` root on the ingress pid, every worker-side
+  ``serving.flush`` parented under the root's span id on a real worker
+  pid, timestamps nesting monotonically — at least two distinct pids per
+  tree (the cross-process contract).
+
+Exit 0 clean; 1 on any failed assertion. Usage:
+
+    python scripts/trace_smoke.py [--requests N]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def fetch_json(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def walk_trees(doc, ingress_pid, worker_pids, check):
+    """The span-tree walk: one connected tree per trace id, real pids,
+    monotone timestamps. Returns the trace ids that had a root."""
+    evs = [e for e in doc.get("traceEvents", []) if e.get("ph") == "X"]
+    roots = {
+        e["args"]["trace_id"]: e
+        for e in evs
+        if e.get("name") == "ingress.request" and "trace_id" in e.get("args", {})
+    }
+    check(bool(roots), "merged /trace has ingress.request roots")
+    connected = monotone = cross = 0
+    for tid, root in roots.items():
+        flushes = [
+            e
+            for e in evs
+            if e.get("name") == "serving.flush"
+            and e.get("args", {}).get("trace_id") == tid
+        ]
+        if not flushes:
+            continue
+        if all(f["args"].get("parent_span_id") == root["args"]["span_id"] for f in flushes):
+            connected += 1
+        if root["pid"] == ingress_pid and all(f["pid"] in worker_pids for f in flushes):
+            cross += 1
+        if all(
+            f["ts"] >= root["ts"] - 2000
+            and f["ts"] + f["dur"] <= root["ts"] + root["dur"] + 2000
+            for f in flushes
+        ):
+            monotone += 1
+    n = len(roots)
+    check(connected == n, f"every tree connected ({connected}/{n} flush→root links)")
+    check(cross == n, f"every tree spans >=2 real pids ({cross}/{n})")
+    check(monotone == n, f"every tree's timestamps nest ({monotone}/{n})")
+    return set(roots)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--requests", type=int, default=48)
+    args = p.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("HEAT_TPU_MONITORING", "1")
+    os.environ["HEAT_TPU_TRACE_SAMPLE"] = "1"
+    from heat_tpu.serving import loadgen
+    from heat_tpu.serving.server import Ingress
+
+    failures = []
+
+    def check(ok, what):
+        print(("ok   " if ok else "FAIL ") + what)
+        if not ok:
+            failures.append(what)
+
+    with tempfile.TemporaryDirectory(prefix="trace-smoke-") as tmp:
+        cache = os.path.join(tmp, "cache")
+        spool = os.path.join(tmp, "spool")
+        os.makedirs(spool)
+        env = {
+            "JAX_PLATFORMS": "cpu",
+            "HEAT_TPU_MONITORING": "1",
+            "HEAT_TPU_TELEMETRY_EVERY": "1",
+        }
+        ing = Ingress(workers=2, cache_dir=cache, spool=spool, env=env).start()
+        try:
+            # ---- phase 1: sequential, the strict decomposition check (no
+            # concurrency, so the client wall IS the request wall)
+            reqs = loadgen.trace(seed=5, n=min(12, args.requests))
+            stats = loadgen.run(
+                ing.url(), reqs, concurrency=1, expected=loadgen.expected_digests(reqs)
+            )
+            print("loadgen[seq]:", json.dumps(stats, sort_keys=True))
+            check(stats["mismatches"] == 0 and stats["errors"] == 0, "zero wrong results (seq)")
+            check(stats["ok"] == len(reqs), "every request answered (seq)")
+            check(stats["traced"] == stats["ok"], "every answered request traced")
+            ratio = stats.get("breakdown_ratio_p50", 0.0)
+            check(
+                0.9 <= ratio <= 1.05,
+                f"stage sum within 10% of wire latency (median ratio {ratio})",
+            )
+
+            # ---- phase 2: concurrent load for the tree walk
+            reqs2 = loadgen.trace(seed=6, n=args.requests)
+            stats2 = loadgen.run(
+                ing.url(), reqs2, concurrency=6, expected=loadgen.expected_digests(reqs2)
+            )
+            print("loadgen[conc]:", json.dumps(stats2, sort_keys=True))
+            check(stats2["mismatches"] == 0 and stats2["errors"] == 0, "zero wrong results (conc)")
+            check(stats2["traced"] == stats2["ok"], "every answered request traced (conc)")
+
+            rz = fetch_json(ing.url("/rpcz"))
+            check(rz["sampling"] == 1.0, "/rpcz reports sampling 1.0")
+            check(rz["recent"] >= stats["ok"], "/rpcz ring holds recent traces")
+            tops = rz["top"]
+            check(
+                bool(tops) and tops == sorted(tops, key=lambda e: -e["total_ms"]),
+                "/rpcz top is slowest-first",
+            )
+            check(
+                all("ingress_route" in e["stages_ms"] and "respond" in e["stages_ms"] for e in tops),
+                "/rpcz entries carry the full breakdown",
+            )
+            check(
+                all(rz["stages"][s]["p50_us"] <= rz["stages"][s]["p99_us"] for s in rz["stages"]),
+                "/rpcz per-stage percentiles ordered",
+            )
+
+            # the sidecar of the last response races the walk (it is written
+            # off the critical path) — poll the merged doc briefly
+            want = stats["ok"] + stats2["ok"]
+            doc = {}
+            for _ in range(40):
+                with urllib.request.urlopen(ing.url("/trace"), timeout=10) as r:
+                    doc = json.loads(r.read().decode())
+                evs = [e for e in doc.get("traceEvents", []) if e.get("ph") == "X"]
+                root_ids = {
+                    e["args"]["trace_id"]
+                    for e in evs
+                    if e.get("name") == "ingress.request" and "trace_id" in e.get("args", {})
+                }
+                flushed = {
+                    e["args"]["trace_id"]
+                    for e in evs
+                    if e.get("name") == "serving.flush" and "trace_id" in e.get("args", {})
+                }
+                if len(root_ids) >= want and root_ids <= flushed:
+                    break
+                time.sleep(0.25)
+            seen = walk_trees(doc, os.getpid(), set(ing.worker_pids()), check)
+            check(len(seen) == want, f"one root per sampled request ({len(seen)}/{want})")
+        finally:
+            ing.stop()
+    if failures:
+        print(f"trace smoke: {len(failures)} failure(s)")
+        return 1
+    print("trace smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

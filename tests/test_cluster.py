@@ -72,6 +72,8 @@ def test_kmedoids():
         assert (np.abs(data - c).sum(axis=1) < 1e-5).any()
 
 
+@pytest.mark.slow  # ~8 s Lanczos eigensolve; the unfiltered device-matrix CI
+# job keeps coverage (ISSUE 16 tier-1 rebalance)
 def test_spectral():
     data, truth = _blobs(n=32, seed=3)
     x = ht.array(data, split=0)

@@ -336,6 +336,8 @@ def test_bcgs_qr_no_full_gather():
     assert "all-reduce" in t
 
 
+@pytest.mark.slow  # ~10 s of HLO text dumps; redundant with the value-level
+# differentials — unfiltered device-matrix CI job keeps coverage (ISSUE 16)
 @pytest.mark.parametrize("kind", ["det", "inv"])
 def test_det_inv_no_full_gather(kind):
     """4096x4096 split-0 det/inv run the blocked panel elimination
@@ -359,6 +361,7 @@ def test_det_inv_no_full_gather(kind):
     assert "all-reduce" in t or "reduce-scatter" in t
 
 
+@pytest.mark.slow  # see test_det_inv_no_full_gather (ISSUE 16 tier-1 rebalance)
 def test_solve_no_full_gather():
     """4096x4096 split-0 solve with 8 right-hand sides: the RHS panels ride
     the same psum-broadcasts as the elimination — no full-operand gather."""
@@ -377,6 +380,7 @@ def test_solve_no_full_gather():
     assert "all-reduce" in t or "reduce-scatter" in t
 
 
+@pytest.mark.slow  # see test_det_inv_no_full_gather (ISSUE 16 tier-1 rebalance)
 def test_det_inv_dispatch_distributed():
     """ht.det/ht.inv on a split square matrix actually route through the panel
     programs (and the ragged embed keeps them on that path)."""

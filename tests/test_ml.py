@@ -187,6 +187,8 @@ def test_gaussian_nb_partial_fit_matches_batch():
     np.testing.assert_array_equal(pf, [0, 1])
 
 
+@pytest.mark.slow  # ~10 s Lanczos eigensolve; the unfiltered device-matrix CI
+# job keeps coverage (ISSUE 16 tier-1 rebalance)
 def test_spectral_two_moons_separation():
     rng = np.random.default_rng(94)
     t = rng.uniform(0, np.pi, 40).astype(np.float32)

@@ -160,6 +160,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.slow  # ~2 min of real 2-process gloo boot; the dedicated
+# multihost CI job runs this file unfiltered (ISSUE 16 tier-1 rebalance)
 @pytest.mark.parametrize(
     "nprocs",
     [

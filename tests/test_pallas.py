@@ -366,7 +366,12 @@ def test_sdpa_gspmd_path_uses_flash(pallas_on):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+# bf16 legs re-run the same kernel differential at looser tolerance; slow-marked
+# as redundant — the unfiltered device-matrix CI job and the pallas smoke job's
+# float32 legs keep coverage (ISSUE 16 tier-1 rebalance)
+@pytest.mark.parametrize(
+    "dtype", [jnp.float32, pytest.param(jnp.bfloat16, marks=pytest.mark.slow)]
+)
 def test_ring_attention_flash_differential(pallas_on, causal, dtype):
     from heat_tpu.core.communication import MeshCommunication
 

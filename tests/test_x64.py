@@ -147,6 +147,8 @@ def test_f64_acceptance_tol_scales_with_dtype():
     assert resid < 1e-12, resid  # f64-grade backward stability, not f32-grade
 
 
+@pytest.mark.slow  # f64 duplicate of test_linalg's panel differential;
+# unfiltered device-matrix CI job keeps coverage (ISSUE 16 tier-1 rebalance)
 @requires_native_f64
 def test_f64_det_inv_distributed():
     """The round-4 blocked elimination path under x64 (the CPU-mesh numerics
