@@ -782,7 +782,11 @@ def main():
         # submit-to-materialized percentiles at a fixed mixed-shape request
         # mix; bucket_kernel_count vs unbucketed — the HEAT_TPU_SHAPE_BUCKETS
         # policy bounding distinct kernels (bucket_valid additionally
-        # requires pairwise bit-parity across the whole mix)
+        # requires pairwise bit-parity across the whole mix); ISSUE 17 adds
+        # symbolic_kernel_count (one jax.export family for the whole mix,
+        # zero pad waste), time_to_ready_s vs blind_warmup_s (predictive
+        # warmup ordering) and autoscale_p99_held (the diurnal-ramp
+        # closed-loop contract as a 0/1)
         serving_anchors = {}
         if os.environ.get("BENCH_FAST") != "1":
             try:
@@ -812,6 +816,14 @@ def main():
                     "fleet_p99_us": None,
                     "fleet_goodput_rps": None,
                     "fleet_valid": None,
+                    "symbolic_kernel_count": None,
+                    "symbolic_valid": None,
+                    "time_to_ready_s": None,
+                    "blind_warmup_s": None,
+                    "warmup_order_valid": None,
+                    "autoscale_p99_us": None,
+                    "autoscale_p99_held": None,
+                    "autoscale_valid": None,
                     "serving_error": repr(e)[:160],
                 }
         # pallas kernel tier anchors (ISSUE 10): ring_attention_step_gbps —

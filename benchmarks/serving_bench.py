@@ -34,6 +34,18 @@ Three anchor groups, wired into ``bench.py`` with the null-key crash-dict +
   multi-tenant trace through a real 2-worker HTTP ingress: the cold-fleet
   zero-compile contract against a warmed cache dir, and client-side
   latency/goodput with the PR 9 chaos schedule running underneath.
+* ``symbolic_kernel_count`` vs ``bucket_kernel_count`` (ISSUE 17) — the
+  mix under ``HEAT_TPU_SYMBOLIC_AOT=1`` compiles ONE ``jax.export``
+  family; ``symbolic_valid`` requires pairwise bit-parity with the exact
+  path, zero pad waste, and ``symbolic <= bucketed``.
+* ``time_to_ready_s`` vs ``blind_warmup_s`` (ISSUE 17) — predictive
+  warmup of the traffic-hot half (frequencies mined from a spool
+  snapshot) vs the blind full-corpus warmup; ``warmup_order_valid``
+  requires every hot digest warmed.
+* ``autoscale_p99_held`` (ISSUE 17) — the diurnal ramp against a real
+  autoscaled 1-worker ingress with predictive boot warmup: 1 iff worst
+  per-phase p99 held under the bound with zero wrong results;
+  ``autoscale_valid`` additionally requires ≥1 grow and ≥1 shrink.
 
 Run: python benchmarks/serving_bench.py
 """
@@ -335,15 +347,193 @@ def bench_fleet(n_requests: int = 72):
     }
 
 
+def bench_symbolic(bucketed_count):
+    """(symbolic_kernel_count, symbolic_valid): the mix under
+    ``HEAT_TPU_SYMBOLIC_AOT=1`` — every eligible shape served by ONE
+    ``jax.export`` family. Valid requires pairwise bit-parity with the
+    exact path, ZERO bucket pad waste, and a kernel count at or below the
+    bucketed floor (the mix lands on 1 where pow2 bucketing compiles 6
+    and exact keying 18)."""
+    from heat_tpu.core import fusion
+    from heat_tpu.monitoring import registry
+
+    prev_sym = os.environ.pop("HEAT_TPU_SYMBOLIC_AOT", None)
+    prev_b = os.environ.pop("HEAT_TPU_SHAPE_BUCKETS", None)
+    try:
+        compiles = registry.REGISTRY.counter("fusion.kernels_compiled")
+        bucket = registry.REGISTRY.counter("serving.bucket")
+        fusion.clear_cache()
+        exact = _run_mix()
+        os.environ["HEAT_TPU_SYMBOLIC_AOT"] = "1"
+        fusion.clear_cache()
+        before = compiles.get()
+        waste_before = bucket.get("pad_waste_bytes")
+        sym_res = _run_mix()
+        symbolic = compiles.get() - before
+        waste = bucket.get("pad_waste_bytes") - waste_before
+    finally:
+        for var, prev in (
+            ("HEAT_TPU_SYMBOLIC_AOT", prev_sym),
+            ("HEAT_TPU_SHAPE_BUCKETS", prev_b),
+        ):
+            if prev is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = prev
+    parity = all(
+        a.shape == b.shape and a.tobytes() == b.tobytes()
+        for a, b in zip(exact, sym_res)
+    )
+    valid = parity and waste == 0 and 0 < symbolic <= bucketed_count
+    return symbolic, bool(valid)
+
+
+def bench_warmup_order():
+    """(time_to_ready_s, blind_warmup_s, warmup_order_valid): wall seconds
+    for a predictive warmup (``--top`` = the traffic-hot half, mined from
+    a fabricated spool snapshot carrying the flight per-signature table)
+    to make the hot set serving-ready, vs the blind full-corpus warmup.
+    Valid requires the predictive run to have warmed every hot digest with
+    zero errors — the timing pair is the reported payoff, not the gate
+    (CI wall clocks are noisy)."""
+    import importlib
+
+    from heat_tpu.core import fusion
+    from heat_tpu.serving import corpus as scorpus
+
+    swarmup = importlib.import_module("heat_tpu.serving.warmup")
+    prev = os.environ.get("HEAT_TPU_CACHE_DIR")
+    with tempfile.TemporaryDirectory(prefix="heat-tpu-warmup-bench-") as tmp:
+        warm = os.path.join(tmp, "warm")
+        os.environ["HEAT_TPU_CACHE_DIR"] = warm
+        try:
+            scorpus._seen.clear()
+            fusion.clear_cache()
+            _run_mix()  # record the corpus + its cost cards
+        finally:
+            if prev is None:
+                os.environ.pop("HEAT_TPU_CACHE_DIR", None)
+            else:
+                os.environ["HEAT_TPU_CACHE_DIR"] = prev
+        corpus_dir = os.path.join(warm, "corpus")
+        digests = sorted(d for d, _ in scorpus.entries(corpus_dir))
+        hot = digests[: max(1, len(digests) // 2)]
+        spool = os.path.join(tmp, "spool")
+        os.makedirs(spool)
+        with open(os.path.join(spool, "bench.json"), "w") as f:
+            json.dump(
+                {
+                    "schema": 1, "pid": os.getpid(), "nonce": "bench",
+                    "time": time.time(),
+                    "flight": {
+                        "enabled": True,
+                        "per_signature": {
+                            d: {"flushes": 10, "wall_s": 0.0} for d in hot
+                        },
+                    },
+                },
+                f,
+            )
+        t0 = time.perf_counter()
+        blind = swarmup.warmup(
+            corpus=corpus_dir, cache_dir=os.path.join(tmp, "blind"),
+        )
+        blind_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        stats = swarmup.warmup(
+            corpus=corpus_dir, cache_dir=os.path.join(tmp, "pred"),
+            order="predictive", spool=spool, top=len(hot),
+        )
+        ready_s = time.perf_counter() - t0
+        warmed = {
+            f[: -len(".bin")]
+            for f in os.listdir(os.path.join(tmp, "pred", "exec"))
+        }
+    valid = (
+        set(hot) <= warmed
+        and stats["errors"] == 0
+        and blind["errors"] == 0
+        and blind["compiled"] == len(digests)
+    )
+    return round(ready_s, 3), round(blind_s, 3), bool(valid)
+
+
+def bench_autoscale(p99_bound_us: float = 30_000_000.0, drain_wait_s: float = 20.0):
+    """(autoscale_p99_us, autoscale_p99_held, autoscale_valid): the
+    recorded diurnal ramp (night/ramp/peak/drain) against a real 1-worker
+    ingress with the closed loop armed and predictive boot warmup.
+    ``autoscale_p99_held`` is the contract as a 0/1: worst per-phase p99
+    under the bound with zero wrong results; valid additionally requires
+    the controller to have recorded ≥1 grow and ≥1 shrink."""
+    from heat_tpu.serving import loadgen
+    from heat_tpu.serving.server import Autoscaler, Ingress
+
+    with tempfile.TemporaryDirectory(prefix="heat-tpu-autoscale-bench-") as tmp:
+        cache = os.path.join(tmp, "cache")
+        spool = os.path.join(tmp, "spool")
+        os.makedirs(spool)
+        env = {
+            "JAX_PLATFORMS": "cpu",
+            "HEAT_TPU_TELEMETRY_EVERY": "1",
+            "HEAT_TPU_SERVING_BATCH": "1",
+        }
+        for var in (
+            "HEAT_TPU_FAULT_PLAN", "HEAT_TPU_CHAOS",
+            "HEAT_TPU_BREAKER_FORCE_OPEN", "HEAT_TPU_SHAPE_BUCKETS",
+        ):
+            env[var] = ""
+        scaler = Autoscaler(
+            min_workers=1, max_workers=3,
+            grow_threshold=1_000.0, shrink_threshold=100.0,
+            grow_ticks=2, shrink_ticks=4, cooldown_ticks=4,
+        )
+        ing = Ingress(
+            workers=1, cache_dir=cache, spool=spool, max_age_s=10.0,
+            env=env, autoscaler=scaler, warmup_boot="predictive",
+        ).start()
+        try:
+            result = loadgen.run_phases(ing.url(), settle_s=3.0)
+            deadline = time.time() + drain_wait_s
+            while time.time() < deadline:
+                if scaler.decisions["shrink"] >= 1:
+                    break
+                time.sleep(1.0)
+            decisions = dict(scaler.decisions)
+        finally:
+            ing.stop()
+    p99 = result["p99_us"]
+    held = int(
+        result["mismatches"] == 0
+        and result["errors"] == 0
+        and p99 is not None
+        and p99 <= p99_bound_us
+    )
+    valid = bool(
+        held == 1 and decisions["grow"] >= 1 and decisions["shrink"] >= 1
+    )
+    return p99, held, valid
+
+
 def bench_serving():
     """All serving anchors as one flat dict (the bench.py contract)."""
     bucketed, unbucketed, waste, bucket_valid = bench_bucketing()
+    symbolic, symbolic_valid = bench_symbolic(bucketed)
+    ready_s, blind_s, order_valid = bench_warmup_order()
     p50, p99, lat_valid = bench_dispatch_latency()
     jan_before, jan_bound, jan_after, jan_evicted, jan_valid = bench_janitor()
     cold_compiles, cold_hits, cold_valid = bench_cold_restart()
     fleet = bench_fleet()
+    auto_p99, auto_held, auto_valid = bench_autoscale()
     return {
         **fleet,
+        "symbolic_kernel_count": symbolic,
+        "symbolic_valid": symbolic_valid,
+        "time_to_ready_s": ready_s,
+        "blind_warmup_s": blind_s,
+        "warmup_order_valid": order_valid,
+        "autoscale_p99_us": auto_p99,
+        "autoscale_p99_held": auto_held,
+        "autoscale_valid": auto_valid,
         "cold_restart_compiles": cold_compiles,
         "cold_restart_disk_hits": cold_hits,
         "cold_restart_valid": cold_valid,

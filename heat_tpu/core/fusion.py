@@ -2158,6 +2158,12 @@ def clear_cache() -> None:
         _tenancy.clear_partitions()
     except Exception:  # serving package mid-import: nothing partitioned yet
         pass
+    try:
+        from ..serving import symbolic as _symaot
+
+        _symaot.clear()
+    except Exception:  # same: the serving package may be mid-import
+        pass
 
 
 def _topo(root: _Node):
@@ -2265,7 +2271,9 @@ def _poison(key) -> None:
         _instr.fusion_poisoned()
 
 
-def _audit_flush(values, program, leaf_arrays, out_idx, donate, key, stable_prog):
+def _audit_flush(
+    values, program, leaf_arrays, out_idx, donate, key, stable_prog, digest=None
+):
     """Shadow-replay audit of one sampled fused flush (ISSUE 12,
     ``HEAT_TPU_AUDIT_RATE``): re-run the retained per-op eager replay — the
     ladder's rung-3 program, bit-parity with ``HEAT_TPU_FUSION=0`` by
@@ -2304,11 +2312,22 @@ def _audit_flush(values, program, leaf_arrays, out_idx, donate, key, stable_prog
         try:
             from ..serving import cache as _disk
 
-            digest = _disk.digest_for(stable_prog, leaf_arrays, donate, out_idx)
+            if digest is None:
+                digest = _disk.digest_for(stable_prog, leaf_arrays, donate, out_idx)
             if digest is not None:
                 _disk.evict(cache_dir, digest)
         except Exception:
             pass  # eviction is best-effort; poisoning already isolates L1
+    if digest is not None and digest.startswith("sym-"):
+        # a symbolic family whose flush failed the audit must not serve again
+        # from the in-process family cache either (the L2 entry + corpus
+        # recipe are quarantined above)
+        try:
+            from ..serving import symbolic as _symaot
+
+            _symaot.forget(digest[len("sym-"):])
+        except Exception:
+            pass
     if _INTEG.audit_action() == "raise":
         raise _INTEG.IntegrityError(
             f"shadow-replay audit mismatch at fused output(s) {bad}: the "
@@ -2643,6 +2662,28 @@ def materialize_for(d: DNDarray):
                 del arr
             donate = tuple(donate_idx)
 
+    # ---- serving: symbolic-family AOT (ISSUE 17). Under
+    # HEAT_TPU_SYMBOLIC_AOT=1, a program passing the SAME eligibility rule
+    # bucketing uses (pointwise, single-output, uniform single-device leaves)
+    # is served by one jax.export shape-polymorphic executable per *family*
+    # (shapes erased from the key) instead of one kernel per bucket: no pad,
+    # no slice, kernel count below the bucketing floor. Supersedes bucketing
+    # for eligible programs (the bucket block below is skipped, so
+    # serving.bucket{pad_waste_bytes} stays 0 on symbolic-served flushes);
+    # ineligible programs take the exact path untouched. Env-gated: the off
+    # path costs one os.environ read.
+    sym_family = None
+    if stable_prog is not None and os.environ.get(
+        "HEAT_TPU_SYMBOLIC_AOT", ""
+    ).strip().lower() in ("1", "true", "on"):
+        from ..serving import symbolic as _symaot
+
+        sym_family = _symaot.family_digest(
+            stable_prog, out_idx, tuple(root.aval.shape), leaf_arrays
+        )
+        if sym_family is not None:
+            donate = ()  # family executables are exported donation-free
+
     # ---- serving: aval bucketing (ISSUE 8). Pointwise-only programs over
     # uniform single-device leaves may have their leaves zero-padded up to the
     # configured bucket edges BEFORE keying, so shape-diverse traffic shares
@@ -2653,7 +2694,12 @@ def materialize_for(d: DNDarray):
     bucket_slicer = None
     debucket = None
     bspec = os.environ.get("HEAT_TPU_SHAPE_BUCKETS", "").strip()
-    if bspec and bspec.lower() not in ("0", "false", "off") and stable_prog is not None:
+    if (
+        sym_family is None
+        and bspec
+        and bspec.lower() not in ("0", "false", "off")
+        and stable_prog is not None
+    ):
         from ..serving import buckets as _buckets
 
         # a signature whose bucketed execution already hit OOM (and recovered
@@ -2695,7 +2741,12 @@ def materialize_for(d: DNDarray):
     leaf_key = _leaf_cache_key(leaf_arrays)
     l1, l1_tenant = _l1_cache()
     try:
-        key = (tuple(key_prog), leaf_key, donate, out_idx)
+        # a symbolic-served signature keys under its own tag so flipping the
+        # hatch mid-process never aliases a family executable with an exact
+        # kernel (both are bit-identical; the tag keeps accounting honest)
+        key = (tuple(key_prog), leaf_key, donate, out_idx) + (
+            ("sym",) if sym_family is not None else ()
+        )
         fused = l1.get(key)
     except TypeError:  # unhashable sharding — compile uncached
         key, fused = None, None
@@ -2753,10 +2804,27 @@ def materialize_for(d: DNDarray):
         from_disk = False
         digest = None
         disk = None
+        sym_state = None
         cache_dir = ""
         if fused is None:
             cache_dir = os.environ.get("HEAT_TPU_CACHE_DIR", "").strip()
-        if cache_dir:
+        if fused is None and sym_family is not None:
+            # symbolic-family resolution (ISSUE 17): in-process family cache,
+            # then the L2 symbolic entry, then a fresh export (persisted +
+            # corpus-recorded). A fresh export is the family's ONE compile
+            # tick; family/L2 service is a cache hit. Failure falls through
+            # to the exact path below, bit-identical by construction.
+            from ..serving import symbolic as _symaot
+
+            t_sym0 = time.perf_counter()
+            fused, sym_state = _symaot.executable(
+                cache_dir, sym_family, program, out_idx, leaf_arrays, stable_prog
+            )
+            if fused is not None:
+                digest = _symaot.DIGEST_PREFIX + sym_family
+                if sym_state != "export":
+                    from_disk = True
+        if fused is None and cache_dir:
             from ..serving import cache as disk
 
             if stable_prog is None:
@@ -2768,16 +2836,21 @@ def materialize_for(d: DNDarray):
                 else:
                     fused = disk.load(cache_dir, digest)
                     from_disk = fused is not None
-        compiled = fused is None
+        compiled = fused is None or sym_state == "export"
         if from_disk:
             # a disk-served executable satisfies the compile-class operation
             # (incl. a half-open probe) even though no XLA compile ran
             _BRK.breaker("fusion.compile").record_success()
-            if flight_on:
+            if flight_on and cache_dir and sym_state is None:
                 # a zero-compile process keeps attribution: the compiling
                 # process persisted a cost card beside the L2 entry
                 _FL.load_cost_card(cache_dir, digest)
         compile_t0 = None
+        if sym_state == "export":
+            # the export paid trace + lowering; the first dispatch of
+            # jit(exported.call) below pays the per-shape XLA refinement —
+            # rung 1 attributes the whole span to the compile stage
+            compile_t0 = t_sym0
         if fused is None:
             compile_t0 = time.perf_counter()
             fused = jax.jit(_replay_fn(program, out_idx), donate_argnums=donate)
@@ -2838,6 +2911,8 @@ def materialize_for(d: DNDarray):
 
         if note is not None:
             note["cache"] = "l2" if from_disk else ("compile" if compiled else "l1")
+            if sym_state is not None:
+                note["symbolic"] = sym_state
 
         # execute = ladder wall minus whatever compile time the ladder itself
         # attributed (the in-memory first dispatch records its compile stage
@@ -2860,7 +2935,8 @@ def materialize_for(d: DNDarray):
         # breaker-eager branch above IS the eager replay — nothing to audit.
         if _INTEG.audit_due():
             audited = _audit_flush(
-                values, program, leaf_arrays, out_idx, donate, key, stable_prog
+                values, program, leaf_arrays, out_idx, donate, key, stable_prog,
+                digest=digest,
             )
             if note is not None:
                 note["audit"] = (

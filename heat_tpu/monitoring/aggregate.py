@@ -72,6 +72,8 @@ __all__ = [
     "build_snapshot",
     "read_snapshots",
     "fleet_view",
+    "process_scale_signal",
+    "fleet_scale_signal",
     "merge_chrome_traces",
     "reset",
 ]
@@ -103,6 +105,34 @@ def snapshot_every() -> int:
         return _DEFAULT_EVERY
 
 
+# ------------------------------------------------------------ scale signal
+#
+# THE scale-signal formula, defined exactly once (ISSUE 17 satellite): the
+# single-process SLO gauge (monitoring/slo.py), the fleet /readyz view
+# (fleet_view below) and the ingress autoscaler (serving/server.py) all call
+# these two helpers, so the three consumers can never disagree about what
+# "load" means. The formula is regression-pinned by tests/test_fleet.py.
+
+
+def process_scale_signal(queue_depth, p99_us) -> float:
+    """One process's scale signal: ``queue_depth × dispatch p99 (µs)`` —
+    0.0 when idle or when no dispatch latency has ever been observed
+    (``None`` inputs read as zero)."""
+    return float(queue_depth or 0) * float(p99_us or 0.0)
+
+
+def fleet_scale_signal(queue_depths, p99s_us) -> float:
+    """The fleet aggregation: ``(Σ queue_depth) × max(p99 µs)`` — additive
+    on backlog, pessimistic on latency. Empty inputs read as 0.0."""
+    total = 0.0
+    for q in queue_depths:
+        total += float(q or 0)
+    worst = 0.0
+    for p in p99s_us:
+        worst = max(worst, float(p or 0.0))
+    return total * worst
+
+
 def build_snapshot() -> dict:
     """This process's spool payload: identity labels, the full registry
     snapshot (labels preserved — the fleet exposition re-renders it
@@ -115,6 +145,24 @@ def build_snapshot() -> dict:
     tel = _report.telemetry(flush=False)
     eng = _slo.engine()
     eng.observe(tel)
+    # per-signature traffic frequencies (ISSUE 17): the predictive warmup
+    # driver mines these across the fleet's spool to rank corpus entries by
+    # frequency × compile cost. Only published when the flight recorder is
+    # armed (it owns the per-signature totals); bounded to the hottest 256
+    # signatures so a long-lived process cannot bloat its snapshot.
+    per_signature = None
+    if _flight.flight_enabled():
+        ranked = sorted(
+            _flight.totals().items(),
+            key=lambda kv: (-int(kv[1].get("flushes", 0) or 0), kv[0]),
+        )[:256]
+        per_signature = {
+            sig: {
+                "flushes": int(t.get("flushes", 0) or 0),
+                "wall_s": round(float(t.get("wall_s", 0.0) or 0.0), 6),
+            }
+            for sig, t in ranked
+        }
     return {
         "schema": 1,
         "pid": os.getpid(),
@@ -130,6 +178,7 @@ def build_snapshot() -> dict:
             "evicted": _flight.evicted(),
             "signatures": len(_flight.totals()),
             "modeled_utilization": _flight.modeled_utilization(),
+            **({"per_signature": per_signature} if per_signature is not None else {}),
         },
         "slo": eng.evaluate(),
     }
@@ -312,15 +361,15 @@ def fleet_view(directory: str, max_age_s: Optional[float] = None) -> dict:
     fleet ``scale_signal`` — ``(Σ queue_depth) × max(dispatch p99 µs)``
     across live processes."""
     snaps, skips = read_snapshots(directory, max_age_s=max_age_s)
-    total_queue = 0.0
-    worst_p99 = 0.0
+    queue_depths = []
+    p99s = []
     processes = {}
     for s in snaps:
         tel = s.get("telemetry") or {}
         qd = float(tel.get("serving_queue_depth") or 0)
         p99 = float((tel.get("serving_dispatch_latency") or {}).get("p99_us") or 0.0)
-        total_queue += qd
-        worst_p99 = max(worst_p99, p99)
+        queue_depths.append(qd)
+        p99s.append(p99)
         processes[f"{s['pid']}-{s['nonce']}"] = {
             "pid": s["pid"],
             "nonce": s["nonce"],
@@ -335,7 +384,7 @@ def fleet_view(directory: str, max_age_s: Optional[float] = None) -> dict:
     return {
         "processes": processes,
         "metrics": _registry.merge_snapshots([s.get("metrics") or {} for s in snaps]),
-        "scale_signal": round(total_queue * worst_p99, 4),
+        "scale_signal": round(fleet_scale_signal(queue_depths, p99s), 4),
         "skips": skips,
     }
 

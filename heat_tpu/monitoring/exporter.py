@@ -150,6 +150,7 @@ CATALOG: Tuple[Tuple[str, str], ...] = (
     ("robustness.chaos", "counter"),
     ("robustness.elastic", "counter"),
     ("robustness.integrity", "counter"),
+    ("serving.autoscale", "counter"),
     ("serving.batch", "counter"),
     ("serving.bucket", "counter"),
     ("serving.corpus", "counter"),
@@ -160,6 +161,7 @@ CATALOG: Tuple[Tuple[str, str], ...] = (
     ("serving.janitor", "counter"),
     ("serving.queue_depth", "gauge"),
     ("serving.shed", "counter"),
+    ("serving.symbolic", "counter"),
     ("serving.tenant", "counter"),
     ("serving.warmup", "counter"),
     ("slo.evaluations", "counter"),

@@ -74,8 +74,10 @@ __all__ = [
     "fusion_elided_write",
     "serving_disk_cache",
     "serving_bucket",
+    "serving_symbolic",
     "serving_corpus",
     "serving_warmup",
+    "serving_autoscale",
     "serving_dispatch",
     "serving_shed",
     "serving_deadline_miss",
@@ -328,6 +330,30 @@ def serving_bucket(pad_waste_bytes: int) -> None:
         c.inc(int(pad_waste_bytes), label="pad_waste_bytes")
 
 
+def serving_symbolic(kind: str) -> None:
+    """One symbolic-family AOT event (``serving.symbolic``, ISSUE 17; kind:
+    served — a flush served through a shape-polymorphic family executable;
+    export — a fresh family export (trace+lower, the one
+    ``fusion.kernels_compiled`` tick the family ever pays); hit / miss — the
+    L2 probe outcome for a family not in the in-process cache; write — a
+    family artifact persisted; incompatible — foreign fingerprint/format,
+    re-exported; corrupt / checksum — unreadable / footer-mismatched entry,
+    quarantined and re-exported; fallback — an eligible flush that fell back
+    to the exact path; breaker-open — the shared ``serving.cache_read``
+    breaker refused the disk probe)."""
+    REGISTRY.counter("serving.symbolic").inc(label=kind)
+
+
+def serving_autoscale(kind: str) -> None:
+    """One autoscaler decision applied by the ingress monitor thread
+    (``serving.autoscale``, ISSUE 17; kind: grow — a worker added because the
+    spooled scale signal held above the grow threshold; shrink — a worker
+    retired below the shrink threshold; held — a decision suppressed by
+    hysteresis, cooldown, or the ``--min-workers``/``--max-workers``
+    bounds)."""
+    REGISTRY.counter("serving.autoscale").inc(label=kind)
+
+
 def serving_corpus(kind: str) -> None:
     """One shape-corpus event (kind: recorded / full — bound hit, entry not
     recorded / corrupt — unreadable entry skipped during iteration)."""
@@ -337,7 +363,9 @@ def serving_corpus(kind: str) -> None:
 def serving_warmup(kind: str) -> None:
     """One corpus entry processed by the AOT warmup driver (kind: compiled /
     cached — executable already in the warmed cache / skipped — foreign
-    fingerprint or not rebuildable / error)."""
+    fingerprint or not rebuildable / error / predicted — an entry ranked by
+    the predictive order (frequency × compile cost, ISSUE 17) / budget-cut —
+    an entry left cold by the ``--budget-s`` / ``--top`` cutoff)."""
     REGISTRY.counter("serving.warmup").inc(label=kind)
 
 

@@ -162,12 +162,17 @@ def _measure(metric: str, tel: dict, prev: Optional[dict]) -> Optional[float]:
 
 def scale_signal(tel: dict) -> float:
     """``queue_depth × dispatch p99 (µs)`` from one telemetry dict — the
-    quantity ROADMAP item 2's ingress consumes. 0.0 when idle or when no
-    dispatch latency has ever been observed."""
-    qd = tel.get("serving_queue_depth") or 0
+    quantity the ingress autoscaler consumes. 0.0 when idle or when no
+    dispatch latency has ever been observed. The formula itself lives in
+    :func:`heat_tpu.monitoring.aggregate.process_scale_signal` (ISSUE 17:
+    one definition shared by this gauge, the fleet view, and the
+    autoscaler — they can never disagree)."""
+    from . import aggregate as _agg
+
     lat = tel.get("serving_dispatch_latency") or {}
-    p99 = lat.get("p99_us") or 0.0
-    return float(qd) * float(p99)
+    return _agg.process_scale_signal(
+        tel.get("serving_queue_depth"), lat.get("p99_us")
+    )
 
 
 def objectives_from_env() -> Tuple[Objective, ...]:

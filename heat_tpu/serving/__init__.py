@@ -21,7 +21,14 @@ cold start without paying a single JIT:
   compiled kernel's rebuild recipe, appended at flush time.
 * :mod:`~heat_tpu.serving.warmup` — :func:`warmup` + ``python -m
   heat_tpu.serving.warmup``: AOT-compiles the corpus into the persistent
-  cache at startup (zero cold compiles once warmed).
+  cache at startup (zero cold compiles once warmed); ``--order predictive``
+  (ISSUE 17) ranks the corpus by traffic-frequency × compile-cost mined
+  from the telemetry spool, under a ``--budget-s``/``--top`` startup budget.
+* :mod:`~heat_tpu.serving.symbolic` — shape-polymorphic AOT families
+  (``HEAT_TPU_SYMBOLIC_AOT=1``, ISSUE 17): eligible pointwise programs
+  compile ONCE per family via ``jax.export`` symbolic dimensions and serve
+  every concrete shape of that rank — below even the bucketing kernel
+  floor, with zero pad waste.
 * :mod:`~heat_tpu.serving.scheduler` — async flush scheduler
   (:func:`schedule` / :func:`flush_all`, and
   ``DNDarray.flush_async()``): device dispatch of one flush overlaps the
@@ -47,7 +54,10 @@ cold start without paying a single JIT:
   (``python -m heat_tpu.serving.server --workers N``): JSON requests fanned
   over N worker processes sharing one cache dir, dead-worker
   reroute/respawn, ``/healthz``+``/readyz``, and the spool-fed fleet
-  ``scale_signal`` autoscaling output.
+  ``scale_signal`` autoscaling output; ``--autoscale`` (ISSUE 17) closes
+  the loop — an :class:`~heat_tpu.serving.server.Autoscaler` grows/shrinks
+  the pool from that signal within ``--min-workers``/``--max-workers``,
+  and ``--warmup-boot predictive`` boots new workers hot.
 * :mod:`~heat_tpu.serving.loadgen` — the wire format, the recorded
   multi-tenant trace, and the goodput/latency load driver
   (``python -m heat_tpu.serving.loadgen --url ...``).
@@ -57,9 +67,11 @@ and no ``HEAT_TPU_SHAPE_BUCKETS`` the flush path is byte-for-byte the PR 7
 behavior (the cold-dir CI leg proves it). Counters: ``serving.disk_cache``
 {hit,miss,write,incompatible,corrupt}, ``serving.bucket``
 {hit,pad_waste_bytes}, ``serving.corpus`` {recorded,full,corrupt},
-``serving.warmup`` {compiled,cached,skipped,error}, and the
-``serving.dispatch_latency`` histogram — all surfaced (with the cache-hit
-SLO) in ``report.telemetry()``. See ``doc/serving_notes.md``.
+``serving.warmup`` {compiled,cached,skipped,error,predicted,budget-cut},
+``serving.symbolic`` {served,export,hit,miss,write,incompatible,corrupt,
+checksum,fallback,breaker-open}, ``serving.autoscale`` {grow,shrink,held},
+and the ``serving.dispatch_latency`` histogram — all surfaced (with the
+cache-hit SLO) in ``report.telemetry()``. See ``doc/serving_notes.md``.
 """
 
 from . import batching, buckets, cache, corpus, janitor, scheduler, tenancy
@@ -75,6 +87,7 @@ __all__ = [
     "loadgen",
     "scheduler",
     "server",
+    "symbolic",
     "tenancy",
     "FlushScheduler",
     "Ingress",
@@ -89,7 +102,9 @@ def __getattr__(name):
     # `python -m`, and an eager import here would race runpy's execution of
     # the same module (the sys.modules RuntimeWarning); laziness also keeps
     # the ingress CLI's parent-package import from touching HTTP machinery.
-    if name in ("server", "loadgen"):
+    # `symbolic` stays lazy too: the flush path imports it only when the
+    # HEAT_TPU_SYMBOLIC_AOT hatch is armed.
+    if name in ("server", "loadgen", "symbolic"):
         import importlib
 
         return importlib.import_module(f".{name}", __name__)

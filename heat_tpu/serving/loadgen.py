@@ -64,11 +64,13 @@ __all__ = [
     "BINARY",
     "SHAPES",
     "EXPRS",
+    "DIURNAL_PHASES",
     "eval_request",
     "digest_of",
     "expected_digests",
     "trace",
     "run",
+    "run_phases",
     "main",
 ]
 
@@ -209,6 +211,61 @@ def trace(
             }
         )
     return reqs
+
+
+#: The recorded diurnal ramp (ISSUE 17): ``(name, requests, concurrency)``
+#: phases — overnight trickle, morning ramp, midday peak, evening drain.
+#: Each phase replays the same seeded trace generator at its own offered
+#: load; the autoscale smoke and the ``autoscale_p99_held`` bench anchor
+#: drive it against an ``--autoscale`` ingress and assert the worker count
+#: tracks the ramp while p99 and the zero-wrong-results ledger hold.
+DIURNAL_PHASES: Tuple[Tuple[str, int, int], ...] = (
+    ("night", 16, 1),
+    ("ramp", 48, 6),
+    ("peak", 64, 12),
+    ("drain", 16, 1),
+)
+
+
+def run_phases(
+    url: str,
+    seed: int = 20260805,
+    phases: Sequence[Tuple[str, int, int]] = DIURNAL_PHASES,
+    timeout_s: float = 120.0,
+    check: bool = True,
+    settle_s: float = 0.0,
+    on_phase=None,
+) -> dict:
+    """Drive a multi-phase (diurnal) load profile: each phase replays a
+    seeded trace at its own concurrency, sequentially. Returns
+    ``{"phases": [{name, concurrency, **run-stats}...], "ok", "shed",
+    "errors", "mismatches", "p99_us"}`` where the scalar ledger sums the
+    phases and ``p99_us`` is the worst per-phase p99 (the bound the
+    autoscaling acceptance holds). ``settle_s`` sleeps between phases so a
+    closed-loop controller can observe the load change; ``on_phase(stats)``
+    (when given) is called after each phase — the smoke script samples the
+    live worker count there."""
+    out: List[dict] = []
+    totals = {"ok": 0, "shed": 0, "errors": 0, "mismatches": 0}
+    worst_p99 = None
+    for i, (name, n, concurrency) in enumerate(phases):
+        reqs = trace(seed=seed + i, n=n)
+        expected = expected_digests(reqs) if check else None
+        stats = run(
+            url, reqs, concurrency=concurrency, timeout_s=timeout_s,
+            expected=expected,
+        )
+        stats = dict(stats, phase=name, concurrency=concurrency)
+        out.append(stats)
+        for k in totals:
+            totals[k] += int(stats.get(k) or 0)
+        if stats.get("p99_us") is not None:
+            worst_p99 = max(worst_p99 or 0.0, float(stats["p99_us"]))
+        if on_phase is not None:
+            on_phase(stats)
+        if settle_s > 0 and i + 1 < len(phases):
+            time.sleep(settle_s)
+    return dict(totals, phases=out, p99_us=worst_p99)
 
 
 def _post(url: str, payload: dict, timeout: float) -> Tuple[int, dict]:
@@ -352,17 +409,40 @@ def main(argv=None) -> int:
         action="store_true",
         help="skip the local expected-digest computation (no jax import)",
     )
+    p.add_argument(
+        "--diurnal",
+        action="store_true",
+        help="drive the recorded diurnal ramp (night/ramp/peak/drain phases) "
+        "instead of one flat trace",
+    )
+    p.add_argument(
+        "--settle",
+        type=float,
+        default=0.0,
+        metavar="S",
+        help="sleep S seconds between diurnal phases (lets a closed-loop "
+        "autoscaler observe the load change)",
+    )
     p.add_argument("--json", action="store_true", help="print stats as JSON")
     args = p.parse_args(argv)
-    reqs = trace(seed=args.seed, n=args.requests)
-    expected = None if args.no_check else expected_digests(reqs)
-    stats = run(
-        args.url,
-        reqs,
-        concurrency=args.concurrency,
-        timeout_s=args.timeout,
-        expected=expected,
-    )
+    if args.diurnal:
+        stats = run_phases(
+            args.url,
+            seed=args.seed,
+            timeout_s=args.timeout,
+            check=not args.no_check,
+            settle_s=args.settle,
+        )
+    else:
+        reqs = trace(seed=args.seed, n=args.requests)
+        expected = None if args.no_check else expected_digests(reqs)
+        stats = run(
+            args.url,
+            reqs,
+            concurrency=args.concurrency,
+            timeout_s=args.timeout,
+            expected=expected,
+        )
     line = json.dumps(stats, sort_keys=True)
     print(line if args.json else f"loadgen: {line}")
     return 1 if (stats["mismatches"] or stats["errors"]) else 0

@@ -52,6 +52,23 @@ probing); dead workers are respawned by default (``{respawned}``) so
 readiness **recovers** after a crash — the SIGKILL acceptance leg in
 ``tests/test_fleet.py``.
 
+**Closed autoscaling loop** (ISSUE 17 leg c): with ``--autoscale``, the
+same monitor thread closes the loop the ``scale_signal`` was built for —
+each poll it feeds the spool-aggregated fleet signal through an
+:class:`Autoscaler` (grow/shrink thresholds, *consecutive-tick* hysteresis
+and a cooldown all measured in ``decide()`` calls, never wall clocks — the
+breaker/fault-schedule determinism idiom) and grows or retires workers
+through the exact spawn machinery the respawn path uses, bounded by
+``--min-workers``/``--max-workers``. Decisions are counted
+``serving.autoscale{grow,shrink,held}`` (``held`` = an actionable streak
+suppressed by cooldown or a bound). New workers optionally boot *hot*:
+``--warmup-boot predictive`` makes each worker run the predictive warmup
+driver (:mod:`~heat_tpu.serving.warmup`, frequency × compile-cost order
+mined from the same spool) before announcing readiness, so capacity added
+under load joins with the hottest kernels already compiled. Autoscaling is
+**off by default** — without the flag the monitor loop is bit-for-bit the
+PR 15/16 respawn scan.
+
 Workers are this same module (``--worker``): an HTTP worker serving
 ``POST /v1/compute`` by evaluating the wire request through
 :func:`loadgen.eval_request`, scheduling it through the process
@@ -84,7 +101,7 @@ from ..monitoring import instrument as _instr
 from ..monitoring import trace as _trace
 from ..monitoring.registry import STATE as _MON
 
-__all__ = ["Ingress", "WorkerSlot", "run_worker", "main"]
+__all__ = ["Autoscaler", "Ingress", "WorkerSlot", "run_worker", "main"]
 
 _LOG = logging.getLogger("heat_tpu.serving")
 
@@ -192,6 +209,32 @@ class _WorkerHandler(BaseHTTPRequestHandler):
             )
 
 
+def _boot_warmup() -> None:
+    """Pre-announce warmup (ISSUE 17): when the ingress armed
+    ``HEAT_TPU_WARMUP_BOOT`` (``corpus`` or ``predictive``), warm the shared
+    cache before this worker announces readiness — a worker the autoscaler
+    adds under load joins the pool with the hottest kernels already
+    compiled instead of paying them on live traffic. Best-effort: a warmup
+    failure must never keep capacity offline."""
+    mode = os.environ.get("HEAT_TPU_WARMUP_BOOT", "").strip().lower()
+    if mode not in ("corpus", "predictive"):
+        return
+    if not os.environ.get("HEAT_TPU_CACHE_DIR", "").strip():
+        return
+    try:
+        # importlib, not `from . import`: the package re-exports the warmup
+        # FUNCTION under the submodule's name
+        import importlib
+
+        _warmup = importlib.import_module("heat_tpu.serving.warmup")
+        stats = _warmup.warmup(order=mode)
+        _LOG.info("boot warmup (%s): %s", mode, stats)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        _LOG.warning("boot warmup failed; serving cold", exc_info=True)
+
+
 def run_worker(port: int = 0, host: str = "127.0.0.1", announce: bool = False) -> None:
     """Run one worker until interrupted (the ``--worker`` entry).
 
@@ -200,6 +243,7 @@ def run_worker(port: int = 0, host: str = "127.0.0.1", announce: bool = False) -
     must exit rather than linger as an orphan holding a port and a runtime
     — observed leak: ``kill <ingress>`` left workers serving forever."""
     parent = os.getppid()
+    _boot_warmup()
     httpd = ThreadingHTTPServer((host, int(port)), _WorkerHandler)
     httpd.daemon_threads = True
 
@@ -230,6 +274,124 @@ def run_worker(port: int = 0, host: str = "127.0.0.1", announce: bool = False) -
         pass
     finally:
         httpd.server_close()
+
+
+# ------------------------------------------------------------------ autoscaler
+class Autoscaler:
+    """The closed-loop worker-count controller (ISSUE 17 leg c): a pure,
+    call-count-deterministic state machine over the fleet ``scale_signal``.
+
+    ``decide(signal, live)`` returns ``"grow"``, ``"shrink"`` or ``"hold"``.
+    Hysteresis is *consecutive ticks*: the signal must sit at or above
+    ``grow_threshold`` for ``grow_ticks`` consecutive calls (resp. at or
+    below ``shrink_threshold`` for ``shrink_ticks``) before an action fires,
+    and every action opens a ``cooldown_ticks``-call cooldown during which
+    further actions are suppressed. Like the breaker cool-downs and fault
+    schedules, every knob is measured in **calls, never wall seconds** — a
+    replayed signal sequence reproduces the exact grow/shrink trace, which
+    is what makes the state machine unit-testable without clocks. A ``None``
+    signal (no spool yet) resets both streaks and decides ``hold``.
+
+    Counters (``serving.autoscale``): ``grow``/``shrink`` per action;
+    ``held`` whenever an actionable streak is suppressed — by cooldown or by
+    the ``min_workers``/``max_workers`` bound."""
+
+    __slots__ = (
+        "min_workers", "max_workers", "grow_threshold", "shrink_threshold",
+        "grow_ticks", "shrink_ticks", "cooldown_ticks",
+        "_above", "_below", "_cooldown", "decisions",
+    )
+
+    def __init__(
+        self,
+        min_workers: int = 1,
+        max_workers: int = 4,
+        grow_threshold: float = 50_000.0,
+        shrink_threshold: float = 5_000.0,
+        grow_ticks: int = 2,
+        shrink_ticks: int = 4,
+        cooldown_ticks: int = 8,
+    ):
+        self.min_workers = max(1, int(min_workers))
+        self.max_workers = max(self.min_workers, int(max_workers))
+        self.grow_threshold = float(grow_threshold)
+        self.shrink_threshold = float(shrink_threshold)
+        if self.shrink_threshold > self.grow_threshold:
+            raise ValueError(
+                "shrink_threshold must not exceed grow_threshold "
+                f"({self.shrink_threshold} > {self.grow_threshold})"
+            )
+        self.grow_ticks = max(1, int(grow_ticks))
+        self.shrink_ticks = max(1, int(shrink_ticks))
+        self.cooldown_ticks = max(0, int(cooldown_ticks))
+        self._above = 0
+        self._below = 0
+        self._cooldown = 0
+        #: lifetime action tally (mirrors the counters; statusz surface)
+        self.decisions = {"grow": 0, "shrink": 0, "held": 0}
+
+    def _held(self) -> str:
+        self.decisions["held"] += 1
+        if _MON.enabled:
+            _instr.serving_autoscale("held")
+        return "hold"
+
+    def decide(self, signal, live: int) -> str:
+        """One control tick: fold ``signal`` into the streaks and return the
+        action for a fleet currently at ``live`` workers."""
+        if signal is None:
+            self._above = self._below = 0
+            if self._cooldown > 0:
+                self._cooldown -= 1
+            return "hold"
+        signal = float(signal)
+        if signal >= self.grow_threshold:
+            self._above += 1
+            self._below = 0
+        elif signal <= self.shrink_threshold:
+            self._below += 1
+            self._above = 0
+        else:
+            self._above = self._below = 0
+        grow_armed = self._above >= self.grow_ticks
+        shrink_armed = self._below >= self.shrink_ticks
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            if grow_armed or shrink_armed:
+                return self._held()
+            return "hold"
+        if grow_armed:
+            if live >= self.max_workers:
+                return self._held()
+            self._above = 0
+            self._cooldown = self.cooldown_ticks
+            self.decisions["grow"] += 1
+            if _MON.enabled:
+                _instr.serving_autoscale("grow")
+            return "grow"
+        if shrink_armed:
+            if live <= self.min_workers:
+                return self._held()
+            self._below = 0
+            self._cooldown = self.cooldown_ticks
+            self.decisions["shrink"] += 1
+            if _MON.enabled:
+                _instr.serving_autoscale("shrink")
+            return "shrink"
+        return "hold"
+
+    def as_dict(self) -> dict:
+        return {
+            "min_workers": self.min_workers,
+            "max_workers": self.max_workers,
+            "grow_threshold": self.grow_threshold,
+            "shrink_threshold": self.shrink_threshold,
+            "grow_ticks": self.grow_ticks,
+            "shrink_ticks": self.shrink_ticks,
+            "cooldown_ticks": self.cooldown_ticks,
+            "cooldown_remaining": self._cooldown,
+            "decisions": dict(self.decisions),
+        }
 
 
 # ------------------------------------------------------------------ ingress
@@ -443,6 +605,8 @@ class Ingress:
         min_ready: Optional[int] = None,
         request_timeout_s: float = 120.0,
         boot_timeout_s: float = 180.0,
+        autoscaler: Optional[Autoscaler] = None,
+        warmup_boot: Optional[str] = None,
     ):
         self.n_workers = max(1, int(workers))
         self.host = host
@@ -451,7 +615,20 @@ class Ingress:
         self.spool = spool
         self.max_age_s = max_age_s
         self.respawn = respawn
-        self.min_ready = self.n_workers if min_ready is None else int(min_ready)
+        #: closed autoscaling loop (ISSUE 17) — None keeps the monitor loop
+        #: bit-for-bit the respawn-only scan
+        self.autoscaler = autoscaler
+        #: "corpus"/"predictive" — workers warm the shared cache before
+        #: announcing readiness (None: boot cold, the historical behavior)
+        self.warmup_boot = warmup_boot
+        if min_ready is None:
+            # an autoscaled fleet is ready at its floor — the worker count is
+            # supposed to move, so readiness must not demand the initial size
+            self.min_ready = (
+                autoscaler.min_workers if autoscaler is not None else self.n_workers
+            )
+        else:
+            self.min_ready = int(min_ready)
         self.request_timeout_s = request_timeout_s
         self.boot_timeout_s = boot_timeout_s
         self._extra_env = dict(env or {})
@@ -477,6 +654,8 @@ class Ingress:
             env["HEAT_TPU_CACHE_DIR"] = self.cache_dir
         if self.spool:
             env["HEAT_TPU_TELEMETRY_DIR"] = self.spool
+        if self.warmup_boot:
+            env["HEAT_TPU_WARMUP_BOOT"] = self.warmup_boot
         env.update(self._extra_env)
         return env
 
@@ -533,7 +712,7 @@ class Ingress:
         while not self._stopping.wait(0.5):
             with self._lock:
                 slots = list(self._slots)
-            for i, slot in enumerate(slots):
+            for slot in slots:
                 if slot.proc.poll() is None:
                     continue
                 if slot.alive:
@@ -550,10 +729,71 @@ class Ingress:
                         raise
                     except Exception:
                         continue  # retried next poll
+                    # replace by identity — the autoscaler may have retired
+                    # this slot (or shifted indexes) while the fresh worker
+                    # booted; a stale index must never clobber a live slot
+                    replaced = False
                     with self._lock:
-                        self._slots[i] = fresh
-                    if _MON.enabled:
-                        _instr.serving_ingress("respawned")
+                        try:
+                            self._slots[self._slots.index(slot)] = fresh
+                            replaced = True
+                        except ValueError:
+                            pass
+                    if replaced:
+                        if _MON.enabled:
+                            _instr.serving_ingress("respawned")
+                    else:
+                        self._retire_slot(fresh)
+            if self.autoscaler is not None and not self._stopping.is_set():
+                # the closed loop (ISSUE 17): one controller tick per monitor
+                # poll, fed by the same spool-aggregated signal /readyz serves
+                action = self.autoscaler.decide(
+                    self.scale_signal(), self.live_workers()
+                )
+                if action == "grow":
+                    self._grow()
+                elif action == "shrink":
+                    self._shrink()
+
+    def _grow(self) -> None:
+        """Add one worker (autoscaler action) through the spawn machinery
+        the respawn path uses; a boot failure is dropped — the streak that
+        armed it will re-arm after the cooldown."""
+        try:
+            fresh = _spawn_worker(self._worker_env(), self.host, self.boot_timeout_s)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception:
+            _LOG.warning("autoscale grow failed", exc_info=True)
+            return
+        with self._lock:
+            self._slots.append(fresh)
+        _LOG.info("autoscale: grew to %d workers", self.live_workers())
+
+    def _shrink(self) -> None:
+        """Retire one worker (autoscaler action): the last slot leaves the
+        pool under the lock — the router never sees it again — then its
+        process is terminated outside the lock."""
+        with self._lock:
+            if len(self._slots) <= 1:
+                return
+            slot = self._slots.pop()
+        self._retire_slot(slot)
+        _LOG.info("autoscale: shrank to %d workers", self.live_workers())
+
+    @staticmethod
+    def _retire_slot(slot: WorkerSlot) -> None:
+        try:
+            slot.proc.terminate()
+        except OSError:
+            pass
+        try:
+            slot.proc.wait(timeout=10.0)
+        except Exception:
+            try:
+                slot.proc.kill()
+            except OSError:
+                pass
 
     def _mark_dead(self, slot: WorkerSlot) -> None:
         if slot.alive:
@@ -771,6 +1011,8 @@ class Ingress:
             "respawn": self.respawn,
             "scale_signal": self.scale_signal(),
         }
+        if self.autoscaler is not None:
+            out["autoscale"] = self.autoscaler.as_dict()
         if self.spool:
             try:
                 from ..monitoring import aggregate as _aggregate
@@ -808,6 +1050,35 @@ def main(argv=None) -> int:
     p.add_argument("--min-ready", type=int, default=None)
     p.add_argument("--no-respawn", action="store_true")
     p.add_argument("--request-timeout", type=float, default=120.0)
+    p.add_argument(
+        "--autoscale",
+        action="store_true",
+        help="close the loop: grow/shrink the worker pool from the spool "
+        "scale signal (off = the fixed-size PR 15 fleet)",
+    )
+    p.add_argument("--min-workers", type=int, default=1, help="autoscale floor")
+    p.add_argument("--max-workers", type=int, default=4, help="autoscale ceiling")
+    p.add_argument(
+        "--grow-threshold", type=float, default=50_000.0,
+        help="scale_signal at/above this for --grow-ticks consecutive polls grows",
+    )
+    p.add_argument(
+        "--shrink-threshold", type=float, default=5_000.0,
+        help="scale_signal at/below this for --shrink-ticks consecutive polls shrinks",
+    )
+    p.add_argument("--grow-ticks", type=int, default=2)
+    p.add_argument("--shrink-ticks", type=int, default=4)
+    p.add_argument(
+        "--cooldown-ticks", type=int, default=8,
+        help="monitor polls to hold after any grow/shrink (call-count, not wall)",
+    )
+    p.add_argument(
+        "--warmup-boot",
+        choices=("off", "corpus", "predictive"),
+        default="off",
+        help="workers warm the shared cache in this order before announcing "
+        "readiness (predictive: frequency × compile-cost from the spool)",
+    )
     args = p.parse_args(argv)
     if args.worker:
         run_worker(port=args.port, host=args.host, announce=args.announce)
@@ -818,6 +1089,17 @@ def main(argv=None) -> int:
     from ..monitoring import registry as _registry
 
     _registry.enable()
+    scaler = None
+    if args.autoscale:
+        scaler = Autoscaler(
+            min_workers=args.min_workers,
+            max_workers=args.max_workers,
+            grow_threshold=args.grow_threshold,
+            shrink_threshold=args.shrink_threshold,
+            grow_ticks=args.grow_ticks,
+            shrink_ticks=args.shrink_ticks,
+            cooldown_ticks=args.cooldown_ticks,
+        )
     ing = Ingress(
         workers=args.workers,
         port=args.port,
@@ -828,6 +1110,8 @@ def main(argv=None) -> int:
         respawn=not args.no_respawn,
         min_ready=args.min_ready,
         request_timeout_s=args.request_timeout,
+        autoscaler=scaler,
+        warmup_boot=None if args.warmup_boot == "off" else args.warmup_boot,
     )
     ing.start()
     sys.stderr.write(

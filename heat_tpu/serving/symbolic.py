@@ -1,0 +1,375 @@
+"""
+Shape-polymorphic AOT executables: one compiled artifact per shape *family*.
+
+PR 8's aval bucketing bounds kernel count by padding: shape-diverse pointwise
+traffic shares one kernel per bucket edge, paying ``pad_waste_bytes`` for the
+privilege. This module (ISSUE 17, ROADMAP item 4) removes the padding tax for
+the same eligible program class: under ``HEAT_TPU_SYMBOLIC_AOT=1`` an
+eligible flush program is exported ONCE with ``jax.export`` *symbolic
+dimensions* — every non-scalar leaf traced at ``(d0, d1, …)`` instead of a
+concrete shape — and the resulting artifact serves **every** concrete size of
+the family: no pad, no slice, kernel count below the bucketing floor
+(18 shapes → 1 family on the serving bench mix).
+
+**Family = program structure + leaf ranks/dtypes/shardings, shapes erased.**
+The family digest is the exact-entry digest's sibling: the same canonical
+serializer over ``(format, fingerprint, "symbolic", stable_prog,
+family leaf descriptors, out_idx)``, where a family leaf descriptor keeps the
+leaf's rank (or scalar-ness), dtype, weak-type flag and sharding but NOT its
+shape. Entries live beside the exact ones under their own namespace —
+``exec/sym-<digest>.bin`` — with the same sha256 footer, fingerprint check,
+janitor mtime-LRU/quarantine and scrubber discipline; the payload is the
+``jax.export`` serialization (versioned StableHLO), which is exactly the
+cross-process-stable artifact the exact entries approximate with
+``serialize_executable``.
+
+**Eligibility** is the PR 8 bucketing rule, reused verbatim (single-output,
+every node pointwise, every non-scalar leaf sharing the root's shape on a
+single device) plus one symbolic-only carve-out: no zero-extent dims
+(symbolic dims are ≥ 1 — a degenerate shape takes the exact path).
+Weak-typed scalar leaves (recorded Python-number operands) export with
+``weak_type`` preserved on their avals, so promotion semantics match the
+exact kernel bit-for-bit. Reductions, sinks, collectives, multi-output
+flushes and sharded leaves all take the exact path untouched.
+
+**Bit parity.** The exported callable is ``jax.export``'s round trip of the
+very ``jax.jit(replay)`` program the hatch-off path compiles — same ops,
+same order, one fused kernel — so outputs are bit-identical to
+``HEAT_TPU_SYMBOLIC_AOT=0`` by construction (the differential matrix in
+``tests/test_serving.py`` is the gate). Any failure — export, disk,
+deserialize, call — falls back to the exact path (counted ``fallback``),
+and the recovery ladder's eager replay + poisoning apply unchanged.
+
+**Compile accounting** (documented honestly): ``fusion.kernels_compiled``
+ticks once per fresh family *export* (the trace + lowering); XLA still
+refines the polymorphic module per concrete shape inside the in-process
+``jax.jit(exported.call)`` cache, exactly like a deserialized exact entry
+still loads per process. What the family amortizes cross-process is the
+tracing, lowering and the disk artifact: a fresh process serves every size
+of a warmed family with zero ``fusion.kernels_compiled``.
+
+Counters (``serving.symbolic``): ``served`` — a flush served through a
+family executable; ``export`` — a fresh family export (trace+lower);
+``hit`` / ``miss`` — the L2 probe outcome for a family not yet in the
+in-process cache; ``write`` — a family artifact persisted; ``incompatible``
+— foreign fingerprint/format (re-exported); ``corrupt`` / ``checksum`` —
+unreadable / footer-mismatched entry (quarantined, re-exported);
+``fallback`` — an eligible flush that fell back to the exact path;
+``breaker-open`` — the shared ``serving.cache_read`` breaker refused the
+disk probe.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+from ..monitoring import instrument as _instr
+from ..monitoring.registry import STATE as _MON
+from ..robustness import breaker as _BRK
+from ..robustness import faultinject as _FI
+from . import buckets as _buckets
+from . import cache as _cache
+
+__all__ = [
+    "enabled",
+    "family_digest",
+    "executable",
+    "export_family",
+    "forget",
+    "clear",
+    "DIGEST_PREFIX",
+]
+
+#: The on-disk namespace marker: symbolic entries are ``exec/sym-<digest>.bin``
+#: (and ``corpus/sym-<digest>.pkl``) so exact and symbolic artifacts can never
+#: collide even under a digest-scheme change.
+DIGEST_PREFIX = "sym-"
+
+#: In-process family cache: family digest -> ``jax.jit(exported.call)``.
+#: Bounded like the poison memos; OrderedDict single-bytecode ops are
+#: GIL-atomic, so scheduler threads race at worst into a duplicate export
+#: (benign: the atomic persist is last-writer-wins, outputs identical).
+_FAMILY_MAX = 256
+_families: "OrderedDict[str, object]" = OrderedDict()
+
+
+def enabled() -> bool:
+    """Whether symbolic-family AOT is armed (``HEAT_TPU_SYMBOLIC_AOT=1``;
+    read per flush so tests and mid-process reconfiguration work)."""
+    return os.environ.get("HEAT_TPU_SYMBOLIC_AOT", "").strip().lower() in (
+        "1", "true", "on",
+    )
+
+
+def _count(kind: str) -> None:
+    if _MON.enabled:
+        _instr.serving_symbolic(kind)
+
+
+def forget(family: str) -> None:
+    """Drop one family executable from the in-process cache (the audit
+    eviction path: a family whose flush failed the shadow-replay audit must
+    not serve again from memory either)."""
+    _families.pop(family, None)
+
+
+def clear() -> None:
+    """Drop every in-process family executable (tests)."""
+    _families.clear()
+
+
+# ------------------------------------------------------------ family digest
+def family_digest(stable_prog, out_idx, root_shape, leaf_arrays) -> Optional[str]:
+    """The family digest for one flush, or None when ineligible.
+
+    Eligibility is the PR 8 bucketing rule (``buckets.plan``) reused: a
+    single-output program of pointwise nodes over uniform single-device
+    leaves; plus the symbolic carve-out — no dim < 1 (symbolic dims are
+    ≥ 1). The digest erases the leaf *shapes* (keeping rank / scalar-ness,
+    dtype, weak-type flag and sharding) so every concrete size of the family
+    maps to one entry."""
+    if stable_prog is None or len(out_idx) != 1:
+        return None
+    for skey, _specs, _kw, _cast in stable_prog:
+        if skey[0] not in _buckets._POINTWISE_TAGS:
+            return None
+    root_shape = tuple(int(d) for d in root_shape)
+    if not root_shape or any(d < 1 for d in root_shape):
+        return None
+    from jax.sharding import SingleDeviceSharding
+
+    descs = []
+    for a in leaf_arrays:
+        if a.shape != () and tuple(a.shape) != root_shape:
+            return None
+        if not isinstance(getattr(a, "sharding", None), SingleDeviceSharding):
+            return None
+        d = _cache._leaf_desc(a)
+        if d is None:
+            return None
+        _shape, dtype, weak, sd = d
+        descs.append(
+            ("scalar" if a.shape == () else ("poly", len(root_shape)), dtype, weak, sd)
+        )
+    out: list = []
+    try:
+        _cache._canon(
+            (
+                _cache._FORMAT,
+                _cache.fingerprint(),
+                "symbolic",
+                stable_prog,
+                tuple(descs),
+                tuple(out_idx),
+            ),
+            out,
+        )
+    except _cache._Unstable:
+        return None
+    return hashlib.sha256("".join(out).encode()).hexdigest()
+
+
+# ------------------------------------------------------------ export / disk
+def export_family(program, out_idx, leaves, rank: int):
+    """Trace + lower the positional replay of ``program`` at symbolic avals
+    (one shared ``(d0, …, d<rank-1>)`` tuple for every non-scalar leaf,
+    ``()`` for scalars) and return the ``jax.export.Exported``. ``leaves``
+    need only carry ``.shape``/``.dtype`` (concrete arrays or
+    ``ShapeDtypeStruct``s — the warmup driver rebuilds from descriptors).
+    Raises on any export failure — callers count and fall back."""
+    import jax
+    from jax import export as _jexport
+
+    from ..core import fusion as _fusion
+
+    dims = _jexport.symbolic_shape(", ".join(f"d{i}" for i in range(rank)))
+    avals = [
+        jax.ShapeDtypeStruct(
+            () if tuple(a.shape) == () else tuple(dims),
+            a.dtype,
+            # weak-typed scalar leaves (recorded Python-number operands) keep
+            # their promotion semantics through the export
+            weak_type=bool(getattr(a, "weak_type", False)),
+        )
+        for a in leaves
+    ]
+    fn = _fusion._replay_fn(program, tuple(out_idx))
+    return _jexport.export(jax.jit(fn))(*avals)
+
+
+def _persist(cache_dir: str, digest: str, exp) -> bool:
+    """Serialize one family artifact under the symbolic namespace (atomic,
+    footered, counted ``write``); never raises."""
+    try:
+        blob = _cache.with_footer(
+            pickle.dumps(
+                {
+                    "format": _cache._FORMAT,
+                    "kind": "symbolic",
+                    "fp": _cache.fingerprint(),
+                    "payload": bytes(exp.serialize()),
+                },
+                protocol=_cache._PICKLE_PROTOCOL,
+            )
+        )
+        _cache._atomic_write(_cache.entry_path(cache_dir, digest), blob)
+        _count("write")
+        from . import janitor as _janitor
+
+        _janitor.maybe_sweep(cache_dir)
+        from ..monitoring import aggregate as _agg
+
+        _agg.maybe_snapshot()
+        return True
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        _count("incompatible")
+        return False
+
+
+def _load(cache_dir: str, digest: str):
+    """Deserialize the family artifact for ``digest``, or None — the exact
+    L2 ``load()`` discipline verbatim: ``serving.cache_read`` breaker + fault
+    site, sha256 footer (mismatch quarantined), explicit fingerprint/format
+    check, mtime touch on hit. Every non-hit re-exports fresh."""
+    b = _BRK.breaker("serving.cache_read")
+    if not b.allow():
+        _count("breaker-open")
+        return None
+    path = _cache.entry_path(cache_dir, digest)
+    try:
+        _FI.check("serving.cache_read")
+    except (KeyboardInterrupt, SystemExit, _FI.FaultPlanError):
+        raise
+    except Exception:
+        b.record_failure()
+        _count("corrupt")
+        return None
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except FileNotFoundError:
+        b.record_success()
+        _count("miss")
+        return None
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        b.record_failure()
+        _count("corrupt")
+        return None
+    blob = _FI.corrupt_value("serving.cache_read", blob)
+    body, verdict = _cache.split_footer(blob)
+    if verdict is False:
+        b.record_failure()
+        _count("checksum")
+        _cache._quarantine_entry(cache_dir, path)
+        return None
+    try:
+        entry = pickle.loads(body)
+        if not isinstance(entry, dict):
+            raise ValueError("symbolic cache entry is not a dict")
+        if verdict is None:
+            b.record_success()
+            _count("incompatible")
+            return None
+        if (
+            entry.get("format") != _cache._FORMAT
+            or entry.get("kind") != "symbolic"
+            or entry.get("fp") != _cache.fingerprint()
+        ):
+            b.record_success()
+            _count("incompatible")
+            return None
+        from jax import export as _jexport
+
+        exp = _jexport.deserialize(bytearray(entry["payload"]))
+        b.record_success()
+        _count("hit")
+        try:
+            os.utime(path)  # LRU signal for the janitor's mtime eviction
+        except OSError:
+            pass
+        return exp
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        b.record_failure()
+        _count("corrupt")
+        _cache._quarantine_entry(cache_dir, path)
+        return None
+
+
+def executable(
+    cache_dir: str, family: str, program, out_idx, leaf_arrays, stable_prog
+) -> Tuple[Optional[object], Optional[str]]:
+    """The family executable for one eligible flush: ``(fused, state)`` with
+    ``state`` in ``{"family", "l2", "export"}``, or ``(None, None)`` when
+    every symbolic avenue failed (counted ``fallback`` — the caller takes
+    the exact path, bit-identical by construction).
+
+    Resolution order: the in-process family cache; the L2 symbolic entry
+    (``cache_dir`` set); a fresh export — persisted + corpus-recorded so
+    every future process (and the warmup driver) skips the trace."""
+    import jax
+
+    fused = _families.get(family)
+    if fused is not None:
+        try:
+            _families.move_to_end(family)
+        except KeyError:  # concurrent forget/clear
+            pass
+        _count("served")
+        return fused, "family"
+    digest = DIGEST_PREFIX + family
+    exp = _load(cache_dir, digest) if cache_dir else None
+    state = "l2" if exp is not None else "export"
+    if exp is None:
+        try:
+            rank = max((len(a.shape) for a in leaf_arrays), default=0)
+            exp = export_family(program, out_idx, leaf_arrays, rank)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception:
+            _count("fallback")
+            return None, None
+        _count("export")
+        if cache_dir and _persist(cache_dir, digest, exp):
+            try:
+                from . import corpus as _corpus
+
+                _corpus.record(
+                    cache_dir,
+                    digest,
+                    {
+                        "format": _cache._FORMAT,
+                        "fp": _cache.fingerprint(),
+                        "kind": "symbolic",
+                        "stable_prog": stable_prog,
+                        "leaf_descs": _cache.leaf_descs(leaf_arrays),
+                        "rank": max((len(a.shape) for a in leaf_arrays), default=0),
+                        "donate": (),
+                        "out_idx": tuple(out_idx),
+                    },
+                )
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception:
+                pass  # corpus recording is best-effort; the entry is live
+    try:
+        fused = jax.jit(exp.call)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        _count("fallback")
+        return None, None
+    _families[family] = fused
+    while len(_families) > _FAMILY_MAX:
+        _families.popitem(last=False)
+    _count("served")
+    return fused, state

@@ -23,9 +23,32 @@ rebuild needs single-device avals today), an op name this jax build lacks.
 Each outcome is counted (``serving.warmup{compiled,cached,skipped,error}``)
 and returned in the stats dict.
 
+**Symbolic families** (ISSUE 17): a corpus recipe recorded with
+``kind == "symbolic"`` (see :mod:`~heat_tpu.serving.symbolic`) is warmed by
+re-exporting the family at symbolic avals — the recipe's ``rank`` and leaf
+descriptors reproduce the exact export the live path would have taken — and
+persisting the serialized ``jax.export.Exported`` under its ``sym-`` digest.
+One warmed family then serves *every* shape of that rank with zero cold
+compiles, not just the recorded one.
+
+**Predictive ordering** (ISSUE 17 leg b): ``order="predictive"`` ranks the
+corpus by *expected compile-time saved* before warming — the per-signature
+traffic frequency mined from the telemetry spool (the ``flight.per_signature``
+table each process publishes; see :mod:`~heat_tpu.monitoring.aggregate`)
+joined against the persisted cost card's FLOP estimate as the compile-cost
+proxy — so under a startup budget (``budget_s``, wall seconds, or ``top``,
+an entry count) the hottest-and-most-expensive kernels warm first. Entries
+the cutoff leaves cold are counted ``budget_cut`` (and
+``serving.warmup{budget-cut}``) — *not* ``skipped``, so the ``--strict``
+exit contract is unchanged. The ranking is deterministic: ties (and the
+no-spool degenerate case) break on digest order. ``order="corpus"`` (the
+default) preserves the original directory-order behavior bit-for-bit.
+
 CLI::
 
     python -m heat_tpu.serving.warmup [--cache-dir DIR] [--corpus DIR]
+                                      [--order {corpus,predictive}]
+                                      [--spool DIR] [--budget-s S] [--top N]
                                       [--strict] [-q]
 
 prints the stats as one JSON line plus a human summary line (stderr) — the
@@ -161,17 +184,97 @@ def _count(kind: str) -> None:
         _instr.serving_warmup(kind)
 
 
-def warmup(corpus: Optional[str] = None, cache_dir: Optional[str] = None) -> dict:
-    """Compile every corpus recipe into the persistent cache. Returns
-    ``{"entries", "compiled", "cached", "skipped", "errors"}`` — ``cached``
-    counts recipes whose executable already sits in the cache (the warmed
-    steady state; a cold-restart replay reports ``compiled == 0`` there)."""
+def _mine_frequencies(spool: Optional[str]) -> dict:
+    """``digest -> total recorded flushes`` summed across every live spool
+    snapshot (the ``flight.per_signature`` table each process publishes —
+    flight signatures *are* L2 digests, so the join is direct). Empty when
+    the spool is absent, unreadable, or the flight recorder was off."""
+    if not spool:
+        return {}
+    from ..monitoring import aggregate as _agg
+
+    freq: dict = {}
+    try:
+        snaps, _skips = _agg.read_snapshots(spool)
+    except Exception:
+        return {}
+    for snap in snaps:
+        table = (snap.get("flight") or {}).get("per_signature") or {}
+        if not isinstance(table, dict):
+            continue
+        for sig, row in table.items():
+            try:
+                freq[sig] = freq.get(sig, 0) + int(row.get("flushes", 0) or 0)
+            except (TypeError, ValueError, AttributeError):
+                continue
+    return freq
+
+
+def _compile_cost(cache_dir: str, digest: str) -> float:
+    """Compile-cost proxy for one digest: the persisted cost card's FLOP
+    estimate (``cost/<digest>.json``, ISSUE 13), or 1.0 when no card is
+    available — frequency alone still ranks hot kernels first."""
+    from . import cache as _cache
+
+    try:
+        with open(_cache.cost_card_path(cache_dir, digest), "r") as f:
+            card = json.load(f)
+        if card.get("available") and card.get("flops"):
+            return max(1.0, float(card["flops"]))
+    except (OSError, ValueError, TypeError, KeyError):
+        pass
+    return 1.0
+
+
+def _predictive_order(items, cache_dir: str, spool: Optional[str]):
+    """Rank ``(digest, entry)`` pairs by descending ``frequency × cost``
+    (expected compile-seconds saved), digest-ascending on ties — fully
+    deterministic for a fixed spool. Returns ``(ranked, predicted_digests)``
+    where the second element is the set of digests that carried a nonzero
+    traffic prediction (they tick ``serving.warmup{predicted}``)."""
+    freq = _mine_frequencies(spool)
+    scored = []
+    predicted = set()
+    for digest, entry in items:
+        f = freq.get(digest, 0)
+        if f > 0:
+            predicted.add(digest)
+        score = float(f) * _compile_cost(cache_dir, digest)
+        scored.append((score, digest, entry))
+    scored.sort(key=lambda t: (-t[0], t[1]))
+    return [(d, e) for _, d, e in scored], predicted
+
+
+def warmup(
+    corpus: Optional[str] = None,
+    cache_dir: Optional[str] = None,
+    order: str = "corpus",
+    budget_s: Optional[float] = None,
+    top: Optional[int] = None,
+    spool: Optional[str] = None,
+) -> dict:
+    """Compile corpus recipes into the persistent cache. Returns
+    ``{"entries", "compiled", "cached", "skipped", "errors", "budget_cut",
+    "saved_s"}`` — ``cached`` counts recipes whose executable already sits
+    in the cache (the warmed steady state; a cold-restart replay reports
+    ``compiled == 0`` there), ``budget_cut`` counts entries the
+    ``budget_s``/``top`` cutoff left cold (never an error or a skip), and
+    ``saved_s`` is the measured compile wall-seconds this run banked — the
+    time a cold serving process will *not* spend.
+
+    ``order="predictive"`` warms in descending frequency × compile-cost
+    order mined from the telemetry ``spool`` (default:
+    ``$HEAT_TPU_TELEMETRY_DIR``); ``"corpus"`` keeps directory order."""
+    import time as _time
+
     import jax
 
     from . import cache as _cache
     from . import corpus as _corpus
     from ..core.fusion import _replay_fn
 
+    if order not in ("corpus", "predictive"):
+        raise ValueError(f"order must be 'corpus' or 'predictive', got {order!r}")
     if cache_dir is None:
         cache_dir = _cache.cache_dir()
     if not cache_dir:
@@ -180,10 +283,39 @@ def warmup(corpus: Optional[str] = None, cache_dir: Optional[str] = None) -> dic
         )
     if corpus is None:
         corpus = _corpus.corpus_dir(cache_dir) or os.path.join(cache_dir, "corpus")
-    stats = {"entries": 0, "compiled": 0, "cached": 0, "skipped": 0, "errors": 0}
+    stats = {
+        "entries": 0,
+        "compiled": 0,
+        "cached": 0,
+        "skipped": 0,
+        "errors": 0,
+        "budget_cut": 0,
+        "saved_s": 0.0,
+    }
     fp = _cache.fingerprint()
-    for digest, entry in _corpus.entries(corpus):
+    predicted: set = set()
+    seq = _corpus.entries(corpus)
+    if order == "predictive":
+        if spool is None:
+            from ..monitoring import aggregate as _agg
+
+            spool = _agg.spool_dir()
+        seq, predicted = _predictive_order(list(seq), cache_dir, spool)
+    t0 = _time.perf_counter()
+    attempted = 0
+    for digest, entry in seq:
         stats["entries"] += 1
+        over_top = top is not None and attempted >= top
+        over_budget = (
+            budget_s is not None and _time.perf_counter() - t0 >= budget_s
+        )
+        if over_top or over_budget:
+            stats["budget_cut"] += 1
+            _count("budget-cut")
+            continue
+        attempted += 1
+        if digest in predicted:
+            _count("predicted")
         try:
             if entry.get("fp") != fp or entry.get("format") != 1:
                 stats["skipped"] += 1
@@ -194,10 +326,26 @@ def warmup(corpus: Optional[str] = None, cache_dir: Optional[str] = None) -> dic
                 _count("cached")
                 continue
             program, avals, donate, out_idx = _rebuild(entry)
-            jitted = jax.jit(_replay_fn(program, out_idx), donate_argnums=donate)
-            compiled = jitted.lower(*avals).compile()
-            if _cache.persist(cache_dir, digest, compiled):
+            t1 = _time.perf_counter()
+            if entry.get("kind") == "symbolic":
+                from . import symbolic as _symbolic
+
+                rank = int(
+                    entry.get(
+                        "rank", max((len(a.shape) for a in avals), default=0)
+                    )
+                )
+                exp = _symbolic.export_family(program, out_idx, avals, rank)
+                persisted = _symbolic._persist(cache_dir, digest, exp)
+            else:
+                jitted = jax.jit(
+                    _replay_fn(program, out_idx), donate_argnums=donate
+                )
+                compiled = jitted.lower(*avals).compile()
+                persisted = _cache.persist(cache_dir, digest, compiled)
+            if persisted:
                 stats["compiled"] += 1
+                stats["saved_s"] += _time.perf_counter() - t1
                 _count("compiled")
             else:
                 stats["errors"] += 1
@@ -210,6 +358,7 @@ def warmup(corpus: Optional[str] = None, cache_dir: Optional[str] = None) -> dic
         except Exception:
             stats["errors"] += 1
             _count("error")
+    stats["saved_s"] = round(stats["saved_s"], 3)
     return stats
 
 
@@ -234,6 +383,36 @@ def main(argv=None) -> int:
         help="corpus directory (default: <cache-dir>/corpus or $HEAT_TPU_SHAPE_CORPUS)",
     )
     p.add_argument(
+        "--order",
+        choices=("corpus", "predictive"),
+        default="corpus",
+        help="warm order: 'corpus' (directory order, the historical default) "
+        "or 'predictive' (descending traffic-frequency × compile-cost mined "
+        "from the telemetry spool)",
+    )
+    p.add_argument(
+        "--spool",
+        default=None,
+        help="telemetry spool directory the predictive order mines "
+        "(default: $HEAT_TPU_TELEMETRY_DIR)",
+    )
+    p.add_argument(
+        "--budget-s",
+        type=float,
+        default=None,
+        metavar="S",
+        help="stop warming after S wall-seconds; remaining entries count as "
+        "budget_cut, never as errors or skips",
+    )
+    p.add_argument(
+        "--top",
+        type=int,
+        default=None,
+        metavar="N",
+        help="warm at most N entries (applied after ordering); the rest "
+        "count as budget_cut",
+    )
+    p.add_argument(
         "--strict",
         action="store_true",
         help="also fail (exit 1) when any entry was skipped, not just errored",
@@ -241,17 +420,26 @@ def main(argv=None) -> int:
     p.add_argument("-q", "--quiet", action="store_true", help="suppress the stats line")
     args = p.parse_args(argv)
     try:
-        stats = warmup(corpus=args.corpus, cache_dir=args.cache_dir)
+        stats = warmup(
+            corpus=args.corpus,
+            cache_dir=args.cache_dir,
+            order=args.order,
+            budget_s=args.budget_s,
+            top=args.top,
+            spool=args.spool,
+        )
     except ValueError as e:
         print(str(e), file=sys.stderr)
         return 2
     if not args.quiet:
         print(json.dumps(stats, sort_keys=True))
     print(
-        "warmup: %d entries — %d compiled, %d cached, %d skipped, %d errors"
+        "warmup: %d entries — %d compiled, %d cached, %d skipped, %d errors, "
+        "%d budget-cut, ~%.3fs compile saved"
         % (
             stats["entries"], stats["compiled"], stats["cached"],
-            stats["skipped"], stats["errors"],
+            stats["skipped"], stats["errors"], stats["budget_cut"],
+            stats["saved_s"],
         ),
         file=sys.stderr,
     )

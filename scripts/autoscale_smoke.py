@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""
+CI autoscale smoke (ISSUE 17): boot a 1-worker ingress with the closed
+autoscaling loop armed and drive the recorded diurnal ramp
+(night/ramp/peak/drain) through it over HTTP.
+
+Asserts, end to end:
+
+* every response digest matches the locally computed reference (zero wrong
+  results — sheds are allowed, they are the admission contract);
+* the worker pool GREW under the peak phase (live workers > 1 observed)
+  and came back down by the end of the drain idle window — the worker
+  count tracks offered load;
+* the pool never left the ``[min_workers, max_workers]`` bounds;
+* the controller's decision ledger (``/statusz`` → ``autoscale``) shows at
+  least one grow and one shrink;
+* worst per-phase p99 stays under the (generous, CI-calibrated) bound.
+
+Workers boot through the predictive warmup driver (``--warmup-boot
+predictive``): capacity added at the peak warms the corpus recorded during
+the night/ramp phases before taking traffic.
+
+Exit 0 clean; 1 on any failed assertion. Usage:
+
+    python scripts/autoscale_smoke.py [--p99-bound-us N] [--max-workers N]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _get(url: str) -> dict:
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--max-workers", type=int, default=3)
+    p.add_argument(
+        "--p99-bound-us", type=float, default=30_000_000.0,
+        help="worst per-phase p99 bound (generous: CI CPUs compile inline)",
+    )
+    p.add_argument(
+        "--drain-wait-s", type=float, default=20.0,
+        help="post-drain idle window for the shrink leg to land",
+    )
+    args = p.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("HEAT_TPU_MONITORING", "1")
+    from heat_tpu.serving import loadgen
+    from heat_tpu.serving.server import Autoscaler, Ingress
+
+    failures = []
+
+    def check(ok, what):
+        print(("ok   " if ok else "FAIL ") + what)
+        if not ok:
+            failures.append(what)
+
+    with tempfile.TemporaryDirectory(prefix="autoscale-smoke-") as tmp:
+        cache = os.path.join(tmp, "cache")
+        spool = os.path.join(tmp, "spool")
+        os.makedirs(spool)
+        env = {
+            "JAX_PLATFORMS": "cpu",
+            "HEAT_TPU_TELEMETRY_EVERY": "1",
+            "HEAT_TPU_SERVING_BATCH": "1",
+        }
+        scaler = Autoscaler(
+            min_workers=1,
+            max_workers=args.max_workers,
+            # CPU-CI calibration: queue_depth × p99_us — a saturated single
+            # worker sits well above 1000, an idle fleet at exactly 0
+            grow_threshold=1_000.0,
+            shrink_threshold=100.0,
+            grow_ticks=2,
+            shrink_ticks=4,
+            cooldown_ticks=4,
+        )
+        ing = Ingress(
+            workers=1,
+            cache_dir=cache,
+            spool=spool,
+            max_age_s=10.0,
+            env=env,
+            autoscaler=scaler,
+            warmup_boot="predictive",
+        ).start()
+        try:
+            observed = []
+
+            def on_phase(stats):
+                live = _get(ing.url("/healthz"))["workers"]
+                observed.append(live)
+                print(
+                    "phase %-5s: live=%d ok=%d shed=%d p99_us=%s"
+                    % (stats["phase"], live, stats["ok"], stats["shed"],
+                       stats["p99_us"])
+                )
+
+            result = loadgen.run_phases(
+                ing.url(), settle_s=3.0, on_phase=on_phase
+            )
+            check(result["mismatches"] == 0, "zero wrong results across the ramp")
+            check(result["errors"] == 0, "zero transport errors")
+            check(max(observed) > 1, "pool grew under load (live > 1 observed)")
+            check(
+                all(1 <= n <= args.max_workers for n in observed),
+                "worker count stayed within [1, %d]" % args.max_workers,
+            )
+            check(
+                result["p99_us"] is not None
+                and result["p99_us"] <= args.p99_bound_us,
+                "worst phase p99 %.0fµs within bound" % (result["p99_us"] or -1),
+            )
+            # the drain leg: give the controller its idle window, then the
+            # pool must have shrunk back toward the floor
+            deadline = time.time() + args.drain_wait_s
+            final = observed[-1]
+            while time.time() < deadline:
+                final = _get(ing.url("/healthz"))["workers"]
+                if final < max(observed):
+                    break
+                time.sleep(1.0)
+            check(final < max(observed), "pool shrank after the drain (%d -> %d)"
+                  % (max(observed), final))
+            status = _get(ing.url("/statusz"))
+            decisions = (status.get("autoscale") or {}).get("decisions") or {}
+            print("autoscale decisions:", json.dumps(decisions, sort_keys=True))
+            check(decisions.get("grow", 0) >= 1, "controller recorded a grow")
+            check(decisions.get("shrink", 0) >= 1, "controller recorded a shrink")
+        finally:
+            ing.stop()
+    if failures:
+        print(f"autoscale smoke: {len(failures)} failure(s)")
+        return 1
+    print("autoscale smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -786,3 +786,160 @@ def test_cold_fleet_zero_compiles_against_warmed_dir(tmp_path):
             assert (hits.get("labels") or {}).get("hit", 0) > 0
     finally:
         ing.stop()
+
+
+# ------------------------------------------------------------------ scale signal + autoscaler (ISSUE 17)
+def test_scale_signal_formula_pinned():
+    """Regression-pin the ONE scale-signal definition (ISSUE 17 satellite):
+    process = queue_depth × dispatch p99 µs, fleet = Σ depth × max p99, and
+    both the slo gauge and the fleet view delegate to it — a drive-by edit
+    to either consumer cannot silently fork the formula."""
+    from heat_tpu.monitoring import aggregate, slo
+
+    assert aggregate.process_scale_signal(3, 1200.0) == 3600.0
+    assert aggregate.process_scale_signal(None, 1200.0) == 0.0
+    assert aggregate.process_scale_signal(3, None) == 0.0
+    assert aggregate.process_scale_signal(0, 0.0) == 0.0
+    assert aggregate.fleet_scale_signal([2, 3], [100.0, 250.0]) == 1250.0
+    assert aggregate.fleet_scale_signal([], []) == 0.0
+    assert aggregate.fleet_scale_signal([None, 4], [None, 50.0]) == 200.0
+    tel = {
+        "serving_queue_depth": 7,
+        "serving_dispatch_latency": {"p99_us": 900.0},
+    }
+    assert slo.scale_signal(tel) == aggregate.process_scale_signal(7, 900.0)
+    assert slo.scale_signal({}) == 0.0
+
+
+def test_autoscaler_hysteresis_grow_shrink_cooldown(no_faults):
+    """The controller FSM, call-count deterministic (no wall clocks —
+    the breaker/fault-schedule idiom): grow needs ``grow_ticks``
+    CONSECUTIVE loud ticks, shrink needs ``shrink_ticks`` silent ones,
+    and every action opens a ``cooldown_ticks``-call suppression window
+    that counts ``held`` when it suppresses an armed streak."""
+    from heat_tpu.serving.server import Autoscaler
+
+    a = Autoscaler(
+        min_workers=1, max_workers=3, grow_threshold=100.0,
+        shrink_threshold=10.0, grow_ticks=2, shrink_ticks=3, cooldown_ticks=2,
+    )
+    live = 1
+    # one loud tick is not enough; the second fires the grow
+    assert a.decide(500.0, live) == "hold"
+    assert a.decide(500.0, live) == "grow"
+    live = 2
+    # cooldown: the re-armed streak is HELD while cooling, then grows
+    assert a.decide(500.0, live) == "hold"   # streak 1/2 during cooldown
+    assert a.decide(500.0, live) == "hold"   # armed 2/2 but cooling -> held
+    assert a.decide(500.0, live) == "grow"
+    live = 3
+    # mid-band signal resets both streaks
+    assert a.decide(50.0, live) == "hold"
+    assert a.decide(50.0, live) == "hold"
+    # three consecutive silent ticks arm the shrink; cooldown from the
+    # last grow already expired (two mid-band calls decremented it)
+    assert a.decide(0.0, live) == "hold"
+    assert a.decide(0.0, live) == "hold"
+    assert a.decide(0.0, live) == "shrink"
+    live = 2
+    # a loud tick interrupts the silent streak: shrink re-arms from zero
+    assert a.decide(0.0, live) == "hold"
+    assert a.decide(0.0, live) == "hold"     # cooldown spends down
+    assert a.decide(500.0, live) == "hold"   # streak broken
+    assert a.decide(0.0, live) == "hold"
+    assert a.decide(0.0, live) == "hold"
+    assert a.decide(0.0, live) == "shrink"
+    assert a.decisions["grow"] == 2 and a.decisions["shrink"] == 2
+    assert a.decisions["held"] == 1  # the one armed-while-cooling tick
+
+
+def test_autoscaler_bounds_none_reset_and_validation(no_faults):
+    """Bounds hold (armed actions at the rails count ``held``), a ``None``
+    signal resets streaks, and an inverted threshold pair is rejected."""
+    from heat_tpu.serving.server import Autoscaler
+
+    with pytest.raises(ValueError):
+        Autoscaler(grow_threshold=100.0, shrink_threshold=200.0)
+
+    a = Autoscaler(
+        min_workers=1, max_workers=2, grow_threshold=100.0,
+        shrink_threshold=10.0, grow_ticks=1, shrink_ticks=1, cooldown_ticks=0,
+    )
+    # at the ceiling: armed grow is held, never returned
+    assert a.decide(500.0, live=2) == "hold"
+    assert a.decisions["held"] == 1
+    # at the floor: armed shrink is held
+    assert a.decide(0.0, live=1) == "hold"
+    assert a.decisions["held"] == 2
+    # None (no spool yet) resets an in-progress streak
+    b = Autoscaler(
+        min_workers=1, max_workers=3, grow_threshold=100.0,
+        shrink_threshold=10.0, grow_ticks=2, shrink_ticks=2, cooldown_ticks=0,
+    )
+    assert b.decide(500.0, live=1) == "hold"
+    assert b.decide(None, live=1) == "hold"   # streak wiped
+    assert b.decide(500.0, live=1) == "hold"  # back to 1/2
+    assert b.decide(500.0, live=1) == "grow"
+    assert b.decisions == {"grow": 1, "shrink": 0, "held": 0}
+
+
+def test_diurnal_trace_phases_structure():
+    """The recorded diurnal ramp (night/ramp/peak/drain) is fixed shape:
+    deterministic phase names, monotone load up to the peak, and a drain
+    tail — the autoscale smoke's offered-load contract."""
+    names = [p[0] for p in loadgen.DIURNAL_PHASES]
+    assert names == ["night", "ramp", "peak", "drain"]
+    reqs = [p[1] for p in loadgen.DIURNAL_PHASES]
+    conc = [p[2] for p in loadgen.DIURNAL_PHASES]
+    assert reqs[0] < reqs[1] < reqs[2] and reqs[3] < reqs[2]
+    assert conc[0] < conc[1] < conc[2] and conc[3] < conc[2]
+
+
+@pytest.mark.slow
+def test_ingress_autoscaler_closed_loop_grows_and_shrinks(tmp_path):
+    """The closed loop against REAL workers (ISSUE 17 leg c acceptance,
+    deterministic form): an Ingress whose ``scale_signal`` replays a
+    scripted loud→silent sequence must spawn a real second worker, keep
+    serving correct results through the resize, and retire it again —
+    no load generator, no timing-sensitive thresholds."""
+    from heat_tpu.serving.server import Autoscaler, Ingress
+
+    script = [50_000.0] * 8 + [0.0] * 60
+
+    class Scripted(Ingress):
+        def scale_signal(self):
+            return script.pop(0) if script else 0.0
+
+    scaler = Autoscaler(
+        min_workers=1, max_workers=2, grow_threshold=1_000.0,
+        shrink_threshold=100.0, grow_ticks=2, shrink_ticks=3,
+        cooldown_ticks=1,
+    )
+    env = {"JAX_PLATFORMS": "cpu"}
+    ing = Scripted(
+        workers=1, cache_dir=str(tmp_path / "cache"), env=env,
+        autoscaler=scaler,
+    ).start()
+    try:
+        def wait_live(n, timeout_s):
+            deadline = time.time() + timeout_s
+            while time.time() < deadline:
+                if ing.live_workers() == n:
+                    return True
+                time.sleep(0.25)
+            return ing.live_workers() == n
+
+        assert wait_live(2, 90.0), "pool never grew to 2 workers"
+        reqs = loadgen.trace(n=8)
+        stats = loadgen.run(
+            ing.url(), reqs, concurrency=2,
+            expected=loadgen.expected_digests(reqs),
+        )
+        assert stats["mismatches"] == 0 and stats["errors"] == 0
+        assert wait_live(1, 60.0), "pool never shrank back to 1 worker"
+        assert scaler.decisions["grow"] >= 1
+        assert scaler.decisions["shrink"] >= 1
+        # the retired worker was terminated, not leaked
+        assert ing.live_workers() == 1
+    finally:
+        ing.stop()

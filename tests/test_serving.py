@@ -462,6 +462,7 @@ def test_warmup_skips_foreign_fingerprint_and_garbage(monkeypatch, tmp_path, no_
         stats = serving.warmup(cache_dir=str(tmp_path))
     assert stats == {
         "entries": 2, "compiled": 0, "cached": 1, "skipped": 1, "errors": 0,
+        "budget_cut": 0, "saved_s": 0.0,
     }
     assert registry.REGISTRY.counter("serving.corpus").get("corrupt") == 1
 
@@ -918,3 +919,245 @@ def test_warmup_cli_exit_codes_and_summary(monkeypatch, tmp_path, capsys, no_fau
     rc = swarmup.main(["--cache-dir", str(tmp_path), "--strict"])
     capsys.readouterr()
     assert rc == 1  # ...but --strict gates on them
+
+
+# ------------------------------------------------------------------ symbolic AOT (ISSUE 17)
+def _sym(label: str) -> int:
+    return registry.REGISTRY.counter("serving.symbolic").get(label)
+
+
+def _sym_chain(x):
+    # scalar Python operands become weak-typed scalar leaves — the family
+    # eligibility rule must carry them (the bench-mix shape)
+    return ht.sin((x * 2.0 + 1.0) / 3.0 - 0.5)
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+@pytest.mark.parametrize("shape", [(12, 8), (11, 7)], ids=["even", "ragged"])
+@pytest.mark.parametrize("dtype", [np.float32, "bfloat16"], ids=["f32", "bf16"])
+def test_symbolic_aot_differential_matrix(monkeypatch, split, shape, dtype, no_faults):
+    """The bit-parity gate: HEAT_TPU_SYMBOLIC_AOT=1 must be byte-identical
+    to the hatch pinned off across split × even/ragged × dtype (split
+    arrays are family-ineligible and prove the exact-path fallback)."""
+    monkeypatch.setenv("HEAT_TPU_SYMBOLIC_AOT", "0")
+    ref = np.asarray(_sym_chain(_fresh(shape, seed=7, dtype=dtype, split=split)).larray)
+    fusion.clear_cache()
+    monkeypatch.setenv("HEAT_TPU_SYMBOLIC_AOT", "1")
+    out = np.asarray(_sym_chain(_fresh(shape, seed=7, dtype=dtype, split=split)).larray)
+    assert _bitwise(ref, out)
+
+
+def test_symbolic_one_family_one_compile_many_shapes(monkeypatch, tmp_path, no_faults):
+    """The tentpole bar: N distinct shapes of one pointwise program under
+    the symbolic hatch cost ONE compile (the family export) — below the
+    bucketing floor — with zero bucket pad waste, one ``sym-`` L2 entry and
+    one ``sym-`` corpus recipe."""
+    monkeypatch.setenv("HEAT_TPU_SYMBOLIC_AOT", "1")
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    shapes = [(33, 5), (48, 12), (57, 7), (64, 5), (97, 12), (120, 31)]
+    with registry.capture():
+        for i, s in enumerate(shapes):
+            _sym_chain(_fresh(s, seed=i)).numpy()
+        assert _compiles() == 1  # one export, five family serves
+        assert _sym("export") == 1 and _sym("served") == len(shapes)
+        assert registry.REGISTRY.counter("serving.bucket").get("pad_waste_bytes") == 0
+    execs = os.listdir(tmp_path / "exec")
+    assert len(execs) == 1 and execs[0].startswith("sym-")
+    recipes = os.listdir(tmp_path / "corpus")
+    assert len(recipes) == 1 and recipes[0].startswith("sym-")
+
+
+def test_symbolic_cross_process_three_sizes_zero_compiles(monkeypatch, tmp_path, no_faults):
+    """Acceptance: a fresh process serves THREE distinct sizes of one
+    family from the symbolic L2 entry with ``fusion.kernels_compiled == 0``,
+    each bit-identical to this process's exact-path reference."""
+    # exact-path references first (hatch off), then the family export
+    monkeypatch.setenv("HEAT_TPU_SYMBOLIC_AOT", "0")
+    sizes = [(9, 4), (17, 11), (40, 3)]
+    refs = [np.asarray(_sym_chain(_fresh(s, seed=i)).larray) for i, s in enumerate(sizes)]
+    fusion.clear_cache()
+    monkeypatch.setenv("HEAT_TPU_SYMBOLIC_AOT", "1")
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    _sym_chain(_fresh((5, 7), seed=99)).numpy()  # a FOURTH size writes the family
+    prog = textwrap.dedent(
+        """
+        import json, os, sys
+        import numpy as np
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import heat_tpu as ht
+        from heat_tpu.monitoring import registry
+        registry.STATE.enabled = True
+        outs = []
+        for i, s in enumerate(%r):
+            data = np.random.default_rng(i).normal(size=tuple(s)).astype(np.float32)
+            r = ht.sin((ht.array(data) * 2.0 + 1.0) / 3.0 - 0.5).numpy()
+            outs.append(r.tobytes().hex())
+        print(json.dumps({
+            "compiled": registry.REGISTRY.counter("fusion.kernels_compiled").get(),
+            "sym_hit": registry.REGISTRY.counter("serving.symbolic").get("hit"),
+            "outs": outs,
+        }))
+        """
+        % (sizes,)
+    )
+    env = dict(os.environ)
+    env.update(
+        HEAT_TPU_CACHE_DIR=str(tmp_path), HEAT_TPU_SYMBOLIC_AOT="1",
+        JAX_PLATFORMS="cpu", HEAT_TPU_FUSION="1",
+    )
+    for k in ("HEAT_TPU_FAULT_PLAN", "HEAT_TPU_CHAOS", "HEAT_TPU_BREAKER_FORCE_OPEN",
+              "HEAT_TPU_AUDIT_RATE", "HEAT_TPU_SHAPE_BUCKETS"):
+        env.pop(k, None)
+    res = subprocess.run(
+        [sys.executable, "-c", prog], env=env, capture_output=True, text=True,
+        timeout=240,
+    )
+    assert res.returncode == 0, res.stderr[-800:]
+    got = json.loads(res.stdout.strip().splitlines()[-1])
+    assert got["compiled"] == 0  # three sizes, zero compiles, one L2 read
+    assert got["sym_hit"] == 1
+    for ref, hexed in zip(refs, got["outs"]):
+        assert ref.tobytes().hex() == hexed
+
+
+def test_symbolic_fingerprint_mismatch_reexports(monkeypatch, tmp_path, no_faults):
+    """A symbolic entry from a foreign toolchain must never deserialize:
+    counted ``incompatible``, re-exported fresh, results bit-identical."""
+    monkeypatch.setenv("HEAT_TPU_SYMBOLIC_AOT", "1")
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    with registry.capture():
+        r1 = _sym_chain(_fresh(seed=21)).numpy()
+        (entry,) = (tmp_path / "exec").iterdir()
+        payload, _ = scache.split_footer(entry.read_bytes())
+        doctored = pickle.loads(payload)
+        doctored["fp"] = ("other-jax", "0", "tpu", "")
+        entry.write_bytes(scache.with_footer(pickle.dumps(doctored, protocol=2)))
+        fusion.clear_cache()
+        r2 = _sym_chain(_fresh(seed=21)).numpy()
+        assert _sym("incompatible") >= 1
+        assert _sym("export") == 2  # the mismatch forced a fresh export
+    assert _bitwise(r1, r2)
+
+
+def test_symbolic_corrupt_entry_quarantined_reexports(monkeypatch, tmp_path, no_faults):
+    """A bit-flipped symbolic entry fails the sha256 footer (``checksum``),
+    is quarantined and re-exported; footer-less garbage is ``corrupt`` with
+    the same quarantine discipline — never a crash either way."""
+    monkeypatch.setenv("HEAT_TPU_SYMBOLIC_AOT", "1")
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    with registry.capture():
+        r1 = _sym_chain(_fresh(seed=22)).numpy()
+        (entry,) = (tmp_path / "exec").iterdir()
+        blob = bytearray(entry.read_bytes())
+        blob[len(blob) // 2] ^= 0xFF  # body flip: footer present, sha mismatch
+        entry.write_bytes(bytes(blob))
+        fusion.clear_cache()
+        r2 = _sym_chain(_fresh(seed=22)).numpy()
+        assert _sym("checksum") == 1
+        assert (tmp_path / "quarantine" / entry.name).exists()
+        assert entry.exists()  # re-export re-stored a good entry
+        entry.write_bytes(b"not an exported family")  # no footer at all
+        fusion.clear_cache()
+        r3 = _sym_chain(_fresh(seed=22)).numpy()
+        assert _sym("corrupt") == 1
+    assert _bitwise(r1, r2) and _bitwise(r1, r3)
+
+
+def test_symbolic_off_is_inert(monkeypatch, tmp_path, no_faults):
+    """Hatch off (pinned "0"): the exact per-shape path, no symbolic
+    counters, no ``sym-`` artifacts — bit-for-bit the PR 16 behavior."""
+    monkeypatch.setenv("HEAT_TPU_SYMBOLIC_AOT", "0")
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    with registry.capture():
+        _sym_chain(_fresh((6, 4), seed=1)).numpy()
+        _sym_chain(_fresh((8, 3), seed=2)).numpy()
+        assert _compiles() == 2  # one exact kernel per shape
+        for label in ("served", "export", "hit", "miss", "write"):
+            assert _sym(label) == 0
+    assert not [f for f in os.listdir(tmp_path / "exec") if f.startswith("sym-")]
+
+
+# ------------------------------------------------------------------ predictive warmup (ISSUE 17)
+def _spool_snapshot(spool, pid, freq_by_digest):
+    """One fabricated telemetry-spool snapshot carrying a per-signature
+    frequency table (the exact shape ``aggregate.build_snapshot`` publishes
+    when the flight recorder is armed)."""
+    import time as _time
+
+    snap = {
+        "schema": 1, "pid": pid, "nonce": "t%d" % pid, "time": _time.time(),
+        "flight": {
+            "enabled": True,
+            "per_signature": {
+                d: {"flushes": n, "wall_s": 0.0} for d, n in freq_by_digest.items()
+            },
+        },
+    }
+    os.makedirs(spool, exist_ok=True)
+    with open(os.path.join(spool, "%d-t.json" % pid), "w") as f:
+        json.dump(snap, f)
+
+
+def test_warmup_predictive_order_deterministic_and_budget(
+    monkeypatch, tmp_path, no_faults
+):
+    """Predictive ordering: frequency × compile-cost rank mined from a
+    seeded spool is deterministic, --top cuts the tail as ``budget_cut``
+    (never skipped/errored — the strict exit contract is load-independent),
+    and the hottest digest warms first."""
+    import importlib
+
+    swarmup = importlib.import_module("heat_tpu.serving.warmup")
+    warm = tmp_path / "warm"
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(warm))
+    scorpus._seen.clear()
+    digests = []
+    for i, s in enumerate([(4, 6), (3, 9), (8, 2)]):
+        before = set(os.listdir(warm / "exec")) if (warm / "exec").exists() else set()
+        _chain(_fresh(s, seed=i)).numpy()
+        (fresh,) = set(os.listdir(warm / "exec")) - before
+        digests.append(fresh[: -len(".bin")])
+    spool = tmp_path / "spool"
+    # the middle digest is by far the hottest across two fleet processes
+    _spool_snapshot(str(spool), 101, {digests[1]: 40, digests[0]: 2})
+    _spool_snapshot(str(spool), 102, {digests[1]: 25})
+    items = list(scorpus.entries(str(warm / "corpus")))
+    ranked1, predicted = swarmup._predictive_order(items, str(warm), str(spool))
+    ranked2, _ = swarmup._predictive_order(items, str(warm), str(spool))
+    assert [d for d, _ in ranked1] == [d for d, _ in ranked2]  # deterministic
+    assert ranked1[0][0] == digests[1]  # hottest first (65 flushes summed)
+    assert predicted == {digests[0], digests[1]}
+    cold = tmp_path / "cold"
+    with registry.capture():
+        stats = swarmup.warmup(
+            corpus=str(warm / "corpus"), cache_dir=str(cold),
+            order="predictive", spool=str(spool), top=1,
+        )
+        assert registry.REGISTRY.counter("serving.warmup").get("predicted") == 1
+        assert registry.REGISTRY.counter("serving.warmup").get("budget-cut") == 2
+    assert stats["compiled"] == 1 and stats["budget_cut"] == 2
+    assert stats["skipped"] == 0 and stats["errors"] == 0
+    (warmed,) = os.listdir(cold / "exec")
+    assert warmed[: -len(".bin")] == digests[1]  # the budget went to the hottest
+
+
+def test_warmup_cli_predictive_flags_and_summary(monkeypatch, tmp_path, capsys, no_faults):
+    """CLI hardening satellite: --order/--spool/--top parse, the corpus
+    default is untouched, budget-cut entries do not trip --strict, and the
+    stderr summary reports the cut + estimated compile-seconds saved."""
+    import importlib
+
+    swarmup = importlib.import_module("heat_tpu.serving.warmup")
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    scorpus._seen.clear()
+    _chain(_fresh(seed=71)).numpy()
+    _chain(_fresh((7, 3), seed=72)).numpy()
+    rc = swarmup.main(
+        ["--cache-dir", str(tmp_path), "--order", "predictive", "--top", "1",
+         "--spool", str(tmp_path / "no-such-spool"), "--strict"]
+    )
+    captured = capsys.readouterr()
+    assert rc == 0  # cached+budget_cut only: strict gates on SKIPS, not cuts
+    stats = json.loads(captured.out.strip())
+    assert stats["entries"] == 2 and stats["budget_cut"] == 1
+    assert "budget-cut" in captured.err and "compile saved" in captured.err
