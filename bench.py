@@ -867,6 +867,41 @@ def main():
                     io_pipe = bench_io_pipeline()
             except Exception as e:
                 io_pipe = {"io_pipeline_valid": None, "io_pipeline_error": repr(e)[:160]}
+        # measured-autotuning anchors (ISSUE 18): paired same-process
+        # tuned-vs-default percentages for the flash tile and the blocked QR
+        # panel (winner-stability-gated), and the corpus-mined bucket edges
+        # vs pow2 on the fixed serving mix (kernel count bounded, pad waste
+        # strictly lower). The BENCH_TELEMETRY sidecar carries the live
+        # tuning.chosen() payload whenever the run is made with
+        # HEAT_TPU_TUNING=1, making a chip number attributable to its knobs.
+        tuning_anchors = {}
+        if os.environ.get("BENCH_FAST") != "1":
+            try:
+                _add_benchmarks_path()
+                from tuning_bench import bench_tuning
+
+                with _mev.span("bench.tuning"):
+                    tuning_anchors = bench_tuning()
+            except Exception as e:
+                # explicit null-valued keys, like the neighbouring benches: a
+                # crashed anchor must be distinguishable from a BENCH_FAST skip
+                tuning_anchors = {
+                    "flash_tile_tuned_vs_default_pct": None,
+                    "flash_tile_tuned": None,
+                    "flash_tile_tuning_valid": None,
+                    "qr_panel_tuned_vs_default_pct": None,
+                    "qr_panel_tuned": None,
+                    "qr_panel_default": None,
+                    "qr_panel_tuning_valid": None,
+                    "bucket_kernel_count_tuned": None,
+                    "bucket_kernel_count_pow2": None,
+                    "bucket_pad_waste_bytes_tuned": None,
+                    "bucket_pad_waste_bytes_pow2": None,
+                    "bucket_edges_tuned": None,
+                    "bucket_tuning_valid": None,
+                    "tuning_chosen": None,
+                    "tuning_error": repr(e)[:160],
+                }
         telemetry = monitoring.report.telemetry()
     print(
         json.dumps(
@@ -914,6 +949,7 @@ def main():
                 **serving_anchors,
                 **pallas_anchors,
                 **io_pipe,
+                **tuning_anchors,
                 "telemetry": telemetry,
             }
         )

@@ -21,6 +21,7 @@ from . import regression
 from . import robustness
 from . import serving
 from . import spatial
+from . import tuning
 from . import utils
 
 # ---------------------------------------------------------------------- methods

@@ -328,22 +328,49 @@ def _donate_enabled() -> bool:
     return val.strip().lower() not in ("0", "false", "off")
 
 
-def _max_chain() -> int:
+def _tuned_bound(knob: str, default: int) -> int:
+    """Measured chain/cache bound under ``HEAT_TPU_TUNING=1`` (one env read
+    when off): the tuning layer mines the PR 13 cost cards for the
+    compile-vs-replay tradeoff; any failure serves the static default."""
+    from .. import tuning as _tuning
+
+    if not _tuning.enabled():
+        return default
     try:
-        return int(os.environ.get("HEAT_TPU_FUSION_MAX_CHAIN", "64"))
-    except ValueError:
-        return 64
+        v = _tuning.lookup(knob)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        return default
+    return default if v is None else int(v)
+
+
+def _max_chain() -> int:
+    # an explicit env bound always wins; unset, the default may come from
+    # the cost-card-mined tuning knob (fusion.max_chain, ISSUE 18)
+    raw = os.environ.get("HEAT_TPU_FUSION_MAX_CHAIN", "").strip()
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            return 64
+    return _tuned_bound("fusion.max_chain", 64)
 
 
 def _cache_max() -> int:
     # sized for shape-diverse workloads (test suites, exploratory sessions):
     # a fused CPU/TPU executable is a few hundred KB at most, and an evicted
     # entry costs a full XLA recompile on its next appearance — measured 267
-    # evictions across four op-heavy test files at 256 entries
-    try:
-        return int(os.environ.get("HEAT_TPU_FUSION_CACHE_SIZE", "4096"))
-    except ValueError:
-        return 4096
+    # evictions across four op-heavy test files at 256 entries. An explicit
+    # env size always wins; unset, the default may come from the
+    # cost-card-mined working-set knob (fusion.cache_size, ISSUE 18).
+    raw = os.environ.get("HEAT_TPU_FUSION_CACHE_SIZE", "").strip()
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            return 4096
+    return _tuned_bound("fusion.cache_size", 4096)
 
 
 def _l1_cache():

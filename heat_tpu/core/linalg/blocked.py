@@ -39,8 +39,12 @@ Dispatch policy (``doc/blocked_linalg_notes.md`` has the measured table):
   the compiled-builder cache key (``qr.py``/``_elimination.py`` shard_map
   programs), so flipping it mid-process never serves a stale kernel.
 * Below a per-op crossover size (``CROSSOVER``) the ``jnp.linalg`` kernel wins
-  on latency and the dispatcher falls back automatically; panel width is
-  autotuned by shape (``default_panel_width``).
+  on latency and the dispatcher falls back automatically; panel width defaults
+  to a static size-thresholded heuristic (``default_panel_width``). Under
+  ``HEAT_TPU_TUNING=1`` both become per-device measurements: the tuning layer
+  (ISSUE 18, ``heat_tpu/tuning/``) probes panel widths per shape class and
+  races blocked-vs-``jnp.linalg`` at bracketing sizes to cache the measured
+  crossover (``panel_width`` / ``_crossover`` below).
 
 Observability: each eager entry point runs under a PR-1 ``monitoring`` span
 with the panel geometry attached, and per-phase flop counters
@@ -67,6 +71,7 @@ __all__ = [
     "CROSSOVER",
     "kernels_enabled",
     "default_panel_width",
+    "panel_width",
     "qr",
     "local_qr",
     "lu_factor",
@@ -104,12 +109,18 @@ def kernels_enabled() -> bool:
 
 
 def default_panel_width(m: int, n: int) -> int:
-    """Autotuned-by-shape panel width (doc/blocked_linalg_notes.md table).
+    """Static size-thresholded panel-width heuristic
+    (doc/blocked_linalg_notes.md table): ``k = min(m, n)`` maps to 32
+    (k < 256), 64 (k < 512), 128 (k < 8192), else 256 — fixed thresholds,
+    not a measurement. The trailing-update GEMM contracts over the panel
+    width, so MXU-aligned widths (128/256) win once the factorization is
+    large enough to amortize the O(2mnb) slow-panel work; small problems
+    take narrow panels to keep the sequential Householder sweep short.
 
-    The trailing-update GEMM contracts over the panel width, so MXU-aligned
-    widths (128/256) win once the factorization is large enough to amortize
-    the O(2mnb) slow-panel work; small problems take narrow panels to keep
-    the sequential Householder sweep short.
+    A *measured* per-device panel width exists only under
+    ``HEAT_TPU_TUNING=1``: :func:`panel_width` probes the
+    ``linalg.blocked.panel`` knob (ISSUE 18) and falls back to this
+    heuristic whenever tuning is off or the probe fails.
     """
     k = min(m, n)
     if k < 256:
@@ -121,12 +132,52 @@ def default_panel_width(m: int, n: int) -> int:
     return 256
 
 
+def panel_width(m: int, n: int) -> int:
+    """The panel width the eager entry points actually use: the static
+    :func:`default_panel_width` heuristic, or — under ``HEAT_TPU_TUNING=1``
+    (one env read when off) — the measured winner for this factorization's
+    pow2 shape class (``linalg.blocked.panel``)."""
+    from ... import tuning as _tuning
+
+    if not _tuning.enabled():
+        return default_panel_width(m, n)
+    k = max(1, min(m, n))
+    k_bucket = min(1 << (k - 1).bit_length(), 8192)
+    try:
+        return _tuning.lookup(
+            "linalg.blocked.panel",
+            shape_class=k_bucket,
+            context={"m": m, "n": n, "k_bucket": k_bucket},
+        )
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        return default_panel_width(m, n)
+
+
+def _crossover(op: str) -> int:
+    """The min(m, n) at which the blocked ``op`` takes over from
+    ``jnp.linalg``: the static ``CROSSOVER`` table, or — under
+    ``HEAT_TPU_TUNING=1`` — the measured blocked-vs-reference race result
+    (``linalg.blocked.crossover.<op>``)."""
+    from ... import tuning as _tuning
+
+    if not _tuning.enabled():
+        return CROSSOVER[op]
+    try:
+        return _tuning.lookup(f"linalg.blocked.crossover.{op}")
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        return CROSSOVER[op]
+
+
 def _size_ok(op: str, m: int, n: int, dtype) -> bool:
     """Crossover + dtype eligibility, independent of the env flag (compiled
     builders capture the flag separately, into their cache key)."""
     if jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating):
         return False  # complex Householder/QDWH not implemented; jnp handles
-    return min(m, n) >= CROSSOVER[op]
+    return min(m, n) >= _crossover(op)
 
 
 def _use_blocked(op: str, m: int, n: int, dtype) -> bool:
@@ -295,7 +346,7 @@ def local_qr(a, calc_q: bool = True, use_blocked: Optional[bool] = None, panel: 
         return jnp.linalg.qr(a, mode="r")
     cdt = _f32_compute_dtype(a.dtype)
     x = a.astype(cdt)
-    out = _qr_impl(x, panel or default_panel_width(m, n), calc_q)
+    out = _qr_impl(x, panel or panel_width(m, n), calc_q)
     if calc_q:
         q, r = out
         return q.astype(a.dtype), r.astype(a.dtype)
@@ -314,7 +365,7 @@ def qr(a, calc_q: bool = True, panel: Optional[int] = None):
             q, r = jnp.linalg.qr(a)
             return q, r
         return jnp.linalg.qr(a, mode="r")
-    b = panel or default_panel_width(m, n)
+    b = panel or panel_width(m, n)
     pf, uf, qf = _qr_flops(m, n, calc_q)
     if _MON.enabled and not _is_tracer(a):
         _REG.counter("linalg.blocked.dispatch").inc(label="qr")
@@ -396,7 +447,7 @@ def lu_factor_local(a, use_blocked: Optional[bool] = None, panel: Optional[int] 
         use_blocked = kernels_enabled()
     if not use_blocked or not _size_ok("lu", m, n, a.dtype):
         return jax.scipy.linalg.lu_factor(a)
-    return _lu_impl(a, panel or default_panel_width(m, n))
+    return _lu_impl(a, panel or panel_width(m, n))
 
 
 def lu_factor(a, panel: Optional[int] = None):
@@ -407,7 +458,7 @@ def lu_factor(a, panel: Optional[int] = None):
     m, n = a.shape
     if not _use_blocked("lu", m, n, a.dtype):
         return jax.scipy.linalg.lu_factor(a)
-    b = panel or default_panel_width(m, n)
+    b = panel or panel_width(m, n)
     pf, tf, uf = _lu_flops(m, n)
     if _MON.enabled and not _is_tracer(a):
         _REG.counter("linalg.blocked.dispatch").inc(label="lu")
@@ -552,7 +603,7 @@ def polar(a, panel: Optional[int] = None):
     a = jnp.asarray(a)
     n = a.shape[0]
     cdt = _f32_compute_dtype(a.dtype)
-    b = panel or default_panel_width(2 * n, n)
+    b = panel or panel_width(2 * n, n)
     u, h = _polar_jit(n, np.dtype(cdt).name, b, _default_l0(cdt))(a.astype(cdt))
     return u.astype(a.dtype), h.astype(a.dtype)
 
@@ -612,7 +663,7 @@ def svd(a, full_matrices: bool = False, compute_uv: bool = True, panel: Optional
         ut, s, vht = out
         return vht.T, s, ut.T
     cdt = _f32_compute_dtype(a.dtype)
-    b = panel or default_panel_width(m, n)
+    b = panel or panel_width(m, n)
     l0 = _default_l0(cdt)
     n_iters = len(_qdwh_schedule(l0, float(jnp.finfo(cdt).eps)))
     if _MON.enabled and not _is_tracer(a):

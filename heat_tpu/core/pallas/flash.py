@@ -56,6 +56,26 @@ def _tile(n: int, pref: int) -> int:
     return n  # single tile (shape_ok bounds this to MAX_SEQ_SINGLE_TILE)
 
 
+def _tile_prefs(interpret: bool):
+    """Preferred (tile_q, tile_k): the static 128s, or the measured winner
+    under ``HEAT_TPU_TUNING=1`` (ISSUE 18; one env read when off). The
+    tuned preference rides the same :func:`_tile` rails — a preference that
+    does not divide the sequence degrades to the single-tile path exactly
+    like the static one."""
+    from ... import tuning as _tuning
+
+    if not _tuning.enabled():
+        return TILE_Q, TILE_K
+    try:
+        return _tuning.lookup(
+            "pallas.flash.tile", context={"interpret": bool(interpret)}
+        )
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        return TILE_Q, TILE_K
+
+
 def shape_ok(sq: int, sk: int, head_dim: int) -> bool:
     """Whether the kernel's tiling expresses these extents: head_dim within
     the VMEM budget, and each sequence either a 128-multiple or small enough
@@ -69,9 +89,9 @@ def shape_ok(sq: int, sk: int, head_dim: int) -> bool:
 
 
 @functools.lru_cache(maxsize=128)
-def _update_call(bh, sq, sk, d, causal, scale, interpret):
-    tq = _tile(sq, TILE_Q)
-    tk = _tile(sk, TILE_K)
+def _update_call(bh, sq, sk, d, causal, scale, interpret, tq_pref=TILE_Q, tk_pref=TILE_K):
+    tq = _tile(sq, tq_pref)
+    tk = _tile(sk, tk_pref)
     nk = sk // tk
     scale = float(scale)
 
@@ -143,7 +163,11 @@ def tile_update(q, k, v, m, l, o, *, scale, causal, q_pos, k_pos, interpret):
     updated ``(m, l, o)``."""
     bh, sq, d = q.shape
     sk = k.shape[1]
-    call = _update_call(bh, sq, sk, d, bool(causal), float(scale), bool(interpret))
+    tq_pref, tk_pref = _tile_prefs(bool(interpret))
+    call = _update_call(
+        bh, sq, sk, d, bool(causal), float(scale), bool(interpret),
+        tq_pref, tk_pref,
+    )
     qp = jnp.asarray(q_pos, jnp.int32).reshape(1, sq)
     kp = jnp.asarray(k_pos, jnp.int32).reshape(1, sk)
     k32 = k.astype(jnp.float32)

@@ -93,6 +93,23 @@ def _step_call(n_pad, f, k, dt_str, n_log, tile_n, interpret):
     )
 
 
+def _tile_n_pref(interpret: bool) -> int:
+    """The preferred sample-tile height: the static 128, or the measured
+    winner under ``HEAT_TPU_TUNING=1`` (ISSUE 18; one env read when off)."""
+    from ... import tuning as _tuning
+
+    if not _tuning.enabled():
+        return TILE_N
+    try:
+        return _tuning.lookup(
+            "pallas.kmeans.tile_n", context={"interpret": bool(interpret)}
+        )
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        return TILE_N
+
+
 def fused_step(x_phys, centers, n_log: int, interpret: bool):
     """One fused assignment+update pass. ``x_phys`` is the (possibly
     canonically padded) physical sample block ``(n_phys, f)``; ``centers``
@@ -101,8 +118,9 @@ def fused_step(x_phys, centers, n_log: int, interpret: bool):
     """
     n_phys, f = x_phys.shape
     k = centers.shape[0]
-    if n_phys > TILE_N:
-        tile_n = TILE_N
+    pref = _tile_n_pref(bool(interpret))
+    if n_phys > pref:
+        tile_n = pref
     else:
         tile_n = max(8, -(-n_phys // 8) * 8) if n_phys > 1 else 1
     n_pad = -(-n_phys // tile_n) * tile_n

@@ -364,11 +364,30 @@ def _arg_call(op, r_pad, c, tile_r, dt_str, row_bound, col_bound, interpret):
     )
 
 
-def _tile_geometry(r):
-    """(tile_r, r_pad): 128-row tiles for tall operands, one sublane-aligned
-    tile otherwise."""
-    if r > TILE_R:
-        tile_r = TILE_R
+def _tile_r_pref(interpret: bool) -> int:
+    """The preferred tall-operand tile height: the static 128, or the
+    measured winner under ``HEAT_TPU_TUNING=1`` (ISSUE 18; one env read
+    when off)."""
+    from ... import tuning as _tuning
+
+    if not _tuning.enabled():
+        return TILE_R
+    try:
+        return _tuning.lookup(
+            "pallas.ragged.tile_r", context={"interpret": bool(interpret)}
+        )
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        return TILE_R
+
+
+def _tile_geometry(r, interpret: bool = False):
+    """(tile_r, r_pad): preferred-height tiles for tall operands, one
+    sublane-aligned tile otherwise."""
+    pref = _tile_r_pref(interpret)
+    if r > pref:
+        tile_r = pref
     else:
         tile_r = max(8, -(-r // 8) * 8) if r > 1 else 1
     return tile_r, -(-r // tile_r) * tile_r
@@ -386,7 +405,7 @@ def _execute(task, v, *dyn):
     row_bound = n_log if split2d == 0 else r
     col_bound = n_log if split2d == 1 else c
     mode = _axmode(ndim, axis, split_ax)[0]
-    tile_r, r_pad = _tile_geometry(r)
+    tile_r, r_pad = _tile_geometry(r, interpret)
 
     mask = None
     if has_where:

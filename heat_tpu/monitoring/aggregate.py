@@ -163,12 +163,24 @@ def build_snapshot() -> dict:
             }
             for sig, t in ranked
         }
+    # the measured-autotuning values this process is serving (ISSUE 18):
+    # published so a bench/chip run is attributable to its knob settings.
+    # Off (the default) this is one env read and no key at all.
+    tuning_chosen = None
+    try:
+        from .. import tuning as _tuning
+
+        if _tuning.enabled():
+            tuning_chosen = _tuning.chosen()
+    except Exception:  # pragma: no cover — publishing never crashes
+        tuning_chosen = None
     return {
         "schema": 1,
         "pid": os.getpid(),
         "nonce": _NONCE,
         "host": socket.gethostname(),
         "time": time.time(),
+        **({"tuning": tuning_chosen} if tuning_chosen else {}),
         "labels": {"pid": str(os.getpid()), "nonce": _NONCE, "host": socket.gethostname()},
         "metrics": _registry.snapshot(),
         "telemetry": tel,

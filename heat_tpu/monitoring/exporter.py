@@ -177,6 +177,7 @@ CATALOG: Tuple[Tuple[str, str], ...] = (
     ("trace.stage.ingress_route", "histogram"),
     ("trace.stage.queue", "histogram"),
     ("trace.stage.respond", "histogram"),
+    ("tuning.lookup", "counter"),
 )
 
 _NAME_SAN = re.compile(r"[^a-zA-Z0-9_]")

@@ -91,6 +91,7 @@ __all__ = [
     "trace_sampled",
     "trace_dropped",
     "telemetry_spool_snapshot",
+    "tuning_event",
     "telemetry_spool_merge",
     "exporter_request",
     "slo_evaluation",
@@ -412,6 +413,16 @@ def serving_batch(kind: str, n: int = 1) -> None:
     batched attempt recovered through individual flushes). Mixed units by
     design — the labels are the content."""
     REGISTRY.counter("serving.batch").inc(int(n), label=kind)
+
+
+def tuning_event(kind: str, n: int = 1) -> None:
+    """One autotuning lookup outcome (``tuning.lookup``, ISSUE 18; kind:
+    probed — a timed micro-probe or data miner ran; served — a measured
+    value answered a lookup (memo, tune-dir, or fresh probe); fallback — the
+    static default answered (tuning off never counts — the armed funnel
+    could not measure); quarantined — a corrupt/truncated/foreign tune
+    entry was moved to quarantine, never served)."""
+    REGISTRY.counter("tuning.lookup").inc(int(n), label=kind)
 
 
 def serving_tenant(tenant: str, event: str, n: int = 1) -> None:

@@ -101,22 +101,51 @@ def enabled() -> bool:
 
 def batch_max() -> int:
     """Group size that triggers immediate dispatch
-    (``HEAT_TPU_SERVING_BATCH_MAX``, default 8, min 2)."""
-    try:
-        return max(2, int(os.environ.get("HEAT_TPU_SERVING_BATCH_MAX", "") or _DEFAULT_MAX))
-    except ValueError:
-        return _DEFAULT_MAX
+    (``HEAT_TPU_SERVING_BATCH_MAX``, default 8, min 2). An explicit env
+    value always wins; with it unset and ``HEAT_TPU_TUNING=1``, the default
+    comes from spool-mined occupancy statistics
+    (``serving.batching.max``, ISSUE 18)."""
+    raw = os.environ.get("HEAT_TPU_SERVING_BATCH_MAX", "").strip()
+    if raw:
+        try:
+            return max(2, int(raw))
+        except ValueError:
+            return _DEFAULT_MAX
+    return max(2, int(_tuned("serving.batching.max", _DEFAULT_MAX)))
 
 
 def linger_s() -> float:
     """The coalescing window in seconds (``HEAT_TPU_SERVING_BATCH_LINGER_MS``,
     default 2 ms): how long the first request of a signature waits for
-    company before dispatching whatever arrived."""
-    try:
-        ms = float(os.environ.get("HEAT_TPU_SERVING_BATCH_LINGER_MS", "") or _DEFAULT_LINGER_MS)
-    except ValueError:
-        ms = _DEFAULT_LINGER_MS
+    company before dispatching whatever arrived. An explicit env value
+    always wins; with it unset and ``HEAT_TPU_TUNING=1``, the default comes
+    from spool-mined arrival statistics (``serving.batching.linger_ms``,
+    ISSUE 18)."""
+    raw = os.environ.get("HEAT_TPU_SERVING_BATCH_LINGER_MS", "").strip()
+    if raw:
+        try:
+            ms = float(raw)
+        except ValueError:
+            ms = _DEFAULT_LINGER_MS
+    else:
+        ms = float(_tuned("serving.batching.linger_ms", _DEFAULT_LINGER_MS))
     return max(0.0, ms) / 1000.0
+
+
+def _tuned(knob: str, default):
+    """The measured value of ``knob`` under ``HEAT_TPU_TUNING=1`` (one env
+    read when off); the static default on any failure."""
+    from .. import tuning as _tuning
+
+    if not _tuning.enabled():
+        return default
+    try:
+        v = _tuning.lookup(knob)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        return default
+    return default if v is None else v
 
 
 class _Plan:
@@ -202,7 +231,7 @@ def _plan_for(x) -> Optional[_Plan]:
     # the signature shares a group across every logical shape in the bucket
     # (the "bucketed signature" contract); without one, exact shapes group.
     bspec = os.environ.get("HEAT_TPU_SHAPE_BUCKETS", "").strip()
-    parsed = _buckets.policy(bspec) if bspec else None
+    parsed = _buckets.effective(bspec) if bspec else None
     bshape = (
         _buckets.bucket_shape(root_shape, *parsed) if parsed else root_shape
     )

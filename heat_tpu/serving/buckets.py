@@ -40,14 +40,23 @@ its leaves.
 from __future__ import annotations
 
 import math
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax.numpy as jnp
 
 from ..monitoring import instrument as _instr
 from ..monitoring.registry import STATE as _MON
 
-__all__ = ["policy", "bucket_dim", "bucket_shape", "plan"]
+__all__ = [
+    "policy",
+    "effective",
+    "bucket_dim",
+    "bucket_shape",
+    "plan",
+    "corpus_dims",
+    "mine_edges",
+    "main",
+]
 
 #: Node kinds (skey tags) whose recorded op is pointwise: the pad region of a
 #: bucketed operand flows through without touching any logical element.
@@ -99,6 +108,35 @@ def policy(spec: str) -> Optional[Tuple[Tuple[int, ...], int]]:
     return parsed
 
 
+def effective(spec: str) -> Optional[Tuple[Tuple[int, ...], int]]:
+    """The ``(edges, tail)`` the serving tier should key on: the parsed env
+    policy, with the corpus-mined optimal-pad-waste edges replacing it under
+    ``HEAT_TPU_TUNING=1`` (ISSUE 18; one extra env read when off).
+
+    Bucketing stays opt-in either way — with no enabled policy this returns
+    None and tuning never forces padding on. A mined edge list is a
+    *refinement* of an armed policy: the pointwise-only bit-parity contract
+    is edge-agnostic, so swapping edges never changes a logical element,
+    only the kernel count and the pad waste."""
+    parsed = policy(spec)
+    if parsed is None:
+        return None
+    from .. import tuning as _tuning
+
+    if not _tuning.enabled():
+        return parsed
+    try:
+        edges = _tuning.lookup("serving.buckets.edges")
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        return parsed
+    if not edges:
+        return parsed  # miner fell back (no corpus / too small)
+    edges = tuple(int(e) for e in edges)
+    return edges, edges[-1]
+
+
 def bucket_dim(d: int, edges: Tuple[int, ...], tail: int) -> int:
     """The smallest bucket edge >= ``d`` (linear ``tail`` multiples above the
     last edge). Zero-extent dims stay zero."""
@@ -129,7 +167,7 @@ def plan(spec: str, stable_prog, out_idx, root_shape, leaf_arrays):
     compile and execute on, and the index restoring the logical root view —
     or None. Counts ``serving.bucket{hit}`` for every flush keyed through a
     bucketed shape and ``{pad_waste_bytes}`` for the pad bytes appended."""
-    parsed = policy(spec)
+    parsed = effective(spec)
     if parsed is None:
         return None
     if len(out_idx) != 1 or stable_prog is None:
@@ -177,3 +215,160 @@ def np_prod(shape) -> int:
     for d in shape:
         p *= int(d)
     return p
+
+
+# ----------------------------------------------------------- edge mining
+#
+# pow2 edges are shape-blind: a corpus full of 384-row requests pads every
+# one of them to 512. Given the recorded shape corpus (ISSUE 13), the
+# optimal edge list for a bounded kernel count is a classic 1-D
+# k-partition: pick k edges from the observed dims minimizing
+# Σ count(d) · (edge(d) − d). Mined edges are observed dims, so recorded
+# traffic pads to the *nearest recorded* extent instead of the nearest
+# power of two. The per-dim independent weighting is an approximation of
+# the true multiplicative pad volume of multi-dim shapes — exact joint
+# optimization over shape tuples is NP-shaped, and per-dim already
+# dominates pow2 on every recorded mix (the bench's pad-waste anchor).
+
+
+def corpus_dims(path: str) -> Dict[int, int]:
+    """Occurrence counts of every positive leaf dimension extent recorded in
+    a shape-corpus directory (unreadable entries skipped by
+    ``corpus.entries``'s own discipline)."""
+    from . import corpus as _corpus
+
+    dims: Dict[int, int] = {}
+    for _digest, recipe in _corpus.entries(path):
+        for desc in recipe.get("leaf_descs") or ():
+            shape = desc[0] if desc else ()
+            for d in shape:
+                d = int(d)
+                if d > 0:
+                    dims[d] = dims.get(d, 0) + 1
+    return dims
+
+
+def _pow2_edge(d: int) -> int:
+    return 1 << max(0, int(d - 1).bit_length())
+
+
+def waste_of(dims: Dict[int, int], edges: Tuple[int, ...], tail: int) -> int:
+    """Σ count · (bucketed − dim) of a dim histogram under an edge list —
+    the per-dim pad-waste objective the miner minimizes."""
+    return sum(c * (bucket_dim(d, edges, tail) - d) for d, c in dims.items())
+
+
+def mine_edges(dims: Dict[int, int], k: Optional[int] = None) -> Tuple[int, ...]:
+    """The optimal-pad-waste edge list for a dim histogram.
+
+    ``k`` bounds the edge count; default is the number of distinct pow2
+    buckets the observed dims occupy, which guarantees the mined list never
+    uses more kernels than ``pow2`` would on the recorded mix while its
+    pad waste is ≤ pow2's (the pow2 partition is a feasible candidate).
+    Dynamic program over sorted distinct dims: O(m²k) for m distinct
+    extents — the corpus is bounded, m stays small."""
+    if not dims:
+        raise ValueError("empty dim histogram")
+    ds = sorted(dims)
+    counts = [dims[d] for d in ds]
+    m = len(ds)
+    if k is None:
+        k = len({_pow2_edge(d) for d in ds})
+    k = max(1, min(int(k), m))
+    # cost[i][j]: waste of covering dims i..j (inclusive) with edge ds[j]
+    prefix = [0]
+    for c in counts:
+        prefix.append(prefix[-1] + c)
+    weighted = [0.0]
+    for d, c in zip(ds, counts):
+        weighted.append(weighted[-1] + d * c)
+
+    def cost(i: int, j: int) -> float:
+        return ds[j] * (prefix[j + 1] - prefix[i]) - (weighted[j + 1] - weighted[i])
+
+    INF = float("inf")
+    # best[t][j]: min waste covering dims 0..j with t edges, last edge ds[j]
+    best = [[INF] * m for _ in range(k + 1)]
+    back = [[-1] * m for _ in range(k + 1)]
+    for j in range(m):
+        best[1][j] = cost(0, j)
+    for t in range(2, k + 1):
+        for j in range(t - 1, m):
+            for i in range(t - 2, j):
+                w = best[t - 1][i] + cost(i + 1, j)
+                if w < best[t][j]:
+                    best[t][j] = w
+                    back[t][j] = i
+    # the last edge must be ds[-1] so every recorded dim is covered; take
+    # the edge count with minimal waste (fewer edges never hurt kernel
+    # count, and waste is monotone non-increasing in t anyway)
+    t_best = min(range(1, k + 1), key=lambda t: best[t][m - 1])
+    edges = []
+    t, j = t_best, m - 1
+    while j >= 0 and t >= 1:
+        edges.append(ds[j])
+        j = back[t][j]
+        t -= 1
+    return tuple(sorted(edges))
+
+
+def main(argv=None) -> int:
+    """CLI entry point (``python -m heat_tpu.serving.buckets``): mine the
+    optimal-pad-waste edge spec from a recorded shape corpus.
+
+    Prints the edge spec in the explicit-edges ``HEAT_TPU_SHAPE_BUCKETS``
+    format on the first line and one JSON stats line after it (the
+    janitor/warmup CLI conventions). Exit 0 on success, 2 when the corpus
+    is missing or holds no usable dims."""
+    import argparse
+    import json as _json
+
+    p = argparse.ArgumentParser(
+        prog="python -m heat_tpu.serving.buckets",
+        description="Mine the optimal-pad-waste bucket-edge spec from a "
+        "recorded shape-corpus directory (the offline companion to the "
+        "HEAT_TPU_TUNING=1 tuned path).",
+    )
+    p.add_argument(
+        "--from-corpus",
+        required=True,
+        metavar="DIR",
+        help="shape-corpus directory (<cache_dir>/corpus)",
+    )
+    p.add_argument(
+        "--k",
+        type=int,
+        default=None,
+        help="max edge count (default: the pow2 bucket count of the mix)",
+    )
+    args = p.parse_args(argv)
+    dims = corpus_dims(args.from_corpus)
+    stats = {
+        "corpus": args.from_corpus,
+        "distinct_dims": len(dims),
+        "samples": sum(dims.values()),
+    }
+    if not dims:
+        stats["error"] = "no usable corpus dims"
+        print(_json.dumps(stats, sort_keys=True))
+        return 2
+    edges = mine_edges(dims, k=args.k)
+    pow2_edges = tuple(sorted({_pow2_edge(d) for d in dims}))
+    stats.update(
+        {
+            "edges": list(edges),
+            "kernel_count": len({bucket_dim(d, edges, edges[-1]) for d in dims}),
+            "pad_waste": waste_of(dims, edges, edges[-1]),
+            "pow2_kernel_count": len(
+                {bucket_dim(d, pow2_edges, pow2_edges[-1]) for d in dims}
+            ),
+            "pow2_pad_waste": waste_of(dims, pow2_edges, pow2_edges[-1]),
+        }
+    )
+    print(",".join(str(e) for e in edges))
+    print(_json.dumps(stats, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

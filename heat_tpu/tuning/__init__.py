@@ -1,0 +1,155 @@
+"""
+Measured autotuning: probe-and-cache replacement for static performance
+knobs (ROADMAP item 5).
+
+Fifteen PRs of kernels and serving machinery run on constants that were
+never measured — pallas tiles hardcoded at 128, blocked-linalg panel
+widths and crossovers guessed, bucket edges blind pow2, batching linger
+and fusion bounds encoding no arrival or compile-cost data. This package
+turns each of those into a *per-device measurement* with the same
+amortization thesis as XLA fusion itself: pay a one-time measured search,
+serve every later dispatch from the cached result.
+
+Three layers:
+
+* :mod:`~heat_tpu.tuning.knobs` — the typed registry: every tunable
+  declares its candidate grid, its probe workload (or data miner), and its
+  static fallback.
+* :mod:`~heat_tpu.tuning.probe` — deterministic timed micro-probes:
+  paired, interleaved, median-of-k, ``block_until_ready``-fenced, seeded
+  inputs, call-count-deterministic budgets.
+* :mod:`~heat_tpu.tuning.store` — the persisted tune cache beside the L2
+  dir (``tune/<digest>.json``), sha256-footered and fingerprinted like PR 8
+  cache entries, with the janitor quarantine discipline.
+
+**The contract.** ``HEAT_TPU_TUNING`` unset (the default) is bit-for-bit
+PR 17: consumers pay exactly one env read per lookup, no probe ever runs,
+no file is ever written. ``HEAT_TPU_TUNING=1`` arms the funnel in
+:func:`lookup`: in-process memo → tune-dir entry → probe/mine → persist,
+falling back to the knob's static default whenever measurement fails. A
+tuned kernel is bit-identical to the default-knob kernel for exact dtypes
+and within ``integrity.tolerance_for`` for floats (tile/panel changes
+reassociate) — pinned by the differential matrix in
+``tests/test_tuning.py``.
+
+Every outcome is counted under ``tuning.lookup``: ``probed`` (a
+measurement ran), ``served`` (a measured value answered a lookup),
+``fallback`` (the static default answered), ``quarantined`` (a poisoned
+tune entry was moved aside, never served).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional
+
+from ..monitoring import instrument as _instr
+from ..monitoring.registry import STATE as _MON
+from . import knobs, probe, store
+
+__all__ = ["chosen", "enabled", "knobs", "lookup", "probe", "reset", "store"]
+
+_lock = threading.Lock()
+_memo: Dict[tuple, Any] = {}  # measured values (probed, mined, or disk-served)
+_fallback_memo: Dict[tuple, Any] = {}  # failed measurements: static defaults
+
+
+def enabled() -> bool:
+    """Whether measured autotuning is armed (``HEAT_TPU_TUNING=1``; off by
+    default — the one env read consumers pay per lookup)."""
+    return os.environ.get("HEAT_TPU_TUNING", "").strip().lower() in (
+        "1", "on", "true",
+    )
+
+
+def _count(kind: str) -> None:
+    if _MON.enabled:
+        _instr.tuning_event(kind)
+
+
+def lookup(name: str, shape_class=None, context: Optional[dict] = None):
+    """The tuned value for knob ``name`` (or its static default).
+
+    The funnel, armed: in-process memo → persisted tune entry (when a tune
+    dir is configured) → run the knob's probe/miner, persist, serve.
+    Unknown knob names raise ``KeyError`` (a wiring bug, never silent);
+    every other failure serves the static default. With tuning off this
+    returns the static default after one env read — callers on hot paths
+    gate on :func:`enabled` and skip the call entirely.
+    """
+    knob = knobs.get(name)
+    if not enabled():
+        return knob.static_default(context)
+    key = (name, shape_class)
+    with _lock:
+        if key in _memo:
+            _count("served")
+            return _memo[key]
+        if key in _fallback_memo:
+            _count("fallback")
+            return _fallback_memo[key]
+    d = store.tune_dir()
+    digest = store.key_digest(name, knob.grid, shape_class)
+    if d and digest:
+        record = store.load(d, digest)
+        if record is not None:
+            try:
+                value = knob.normalize(record["value"])
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception:
+                # a well-formed entry whose value fails the consumer rails:
+                # poisoned the same as a bad checksum
+                store.quarantine(d, store.entry_path(d, digest))
+                _count("quarantined")
+            else:
+                with _lock:
+                    _memo[key] = value
+                _count("served")
+                return value
+    try:
+        value, stats = knob.compute(context)
+        value = knob.normalize(value)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        # a failed probe/miner is memoized too: a knob that cannot measure
+        # now will not measure better on the next hot-path call
+        value = knob.static_default(context)
+        with _lock:
+            _fallback_memo[key] = value
+        _count("fallback")
+        return value
+    _count("probed")
+    if d and digest:
+        store.save(d, digest, name, shape_class, _jsonable(value), stats)
+    with _lock:
+        _memo[key] = value
+    _count("served")
+    return value
+
+
+def _jsonable(value):
+    return list(value) if isinstance(value, tuple) else value
+
+
+def chosen() -> Dict[str, Any]:
+    """The values this process is serving (memo snapshot), keyed
+    ``name`` or ``name@shape_class`` — the bench telemetry payload that
+    makes a chip run attributable to its knob settings."""
+    with _lock:
+        out = {}
+        for (name, shape_class), value in sorted(
+            _memo.items(), key=lambda kv: (kv[0][0], repr(kv[0][1]))
+        ):
+            key = name if shape_class is None else f"{name}@{shape_class}"
+            out[key] = value
+        return out
+
+
+def reset() -> None:
+    """Drop the in-process memo (tests; a fresh process is the real reset)."""
+    with _lock:
+        _memo.clear()
+        _fallback_memo.clear()

@@ -1,0 +1,600 @@
+"""
+The typed knob registry: every tunable performance constant, declared.
+
+A *knob* is one static performance constant somewhere in the codebase —
+a pallas tile, a panel width, a crossover size, a bucket-edge list, a
+linger window, a chain bound — wrapped in a declaration of
+
+* its **candidate grid** (what a probe may choose between),
+* its **measurement** (a timed micro-probe workload, or a miner over
+  recorded data: the shape corpus, the telemetry spool, the PR 13 cost
+  cards), and
+* its **static fallback** (the exact pre-tuning constant, served verbatim
+  whenever tuning is off, a probe fails, or a tune entry is poisoned).
+
+Two measurement families:
+
+* ``timed`` knobs run :func:`heat_tpu.tuning.probe.pick` over seeded
+  workloads built from the *real* kernels (the lru-cached pallas builders
+  and jitted blocked-linalg factorizations — never models of them).
+* ``mined`` knobs compute their value from data previous processes already
+  recorded: bucket edges from the shape corpus, batching linger/max from
+  spool-mined arrival statistics, fusion chain/cache bounds from the cost
+  cards. This is the PR 13 cost-card seeding path: a zero-compile process
+  sharing a warmed cache dir mines informed values without executing one
+  probe workload.
+
+Every knob's ``normalize`` repairs the JSON round-trip (lists → tuples)
+and enforces the consumer's rails (the MAX_* bounds, panel/edge sanity) —
+a tune entry that fails its rails is never served.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from . import probe as _probe
+
+__all__ = ["Knob", "KNOBS", "get", "register"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One tunable: identity, candidates, measurement, and fallback."""
+
+    name: str  #: registry key, e.g. ``"pallas.flash.tile"``
+    kind: str  #: ``"timed"`` (micro-probe) or ``"mined"`` (recorded data)
+    grid: tuple  #: candidate values (timed) or bracket sizes (mined: ())
+    default: Any  #: static fallback — a value, or ``callable(ctx) -> value``
+    compute: Callable[[Optional[dict]], Tuple[Any, dict]]  #: measurement
+    normalize: Callable[[Any], Any]  #: JSON repair + rails; raises on invalid
+    doc: str  #: one-line catalog entry (doc/tuning_notes.md table)
+
+    def static_default(self, context: Optional[dict] = None):
+        return self.default(context) if callable(self.default) else self.default
+
+
+KNOBS: Dict[str, Knob] = {}
+
+
+def register(knob: Knob) -> Knob:
+    KNOBS[knob.name] = knob
+    return knob
+
+
+def get(name: str) -> Knob:
+    return KNOBS[name]
+
+
+# ----------------------------------------------------------------- helpers
+def _seeded(shape, dtype=np.float32, seed: int = 0):
+    """Deterministic probe operand: fixed-seed host RNG, device-put once."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.standard_normal(shape), dtype)
+
+
+def _pow2_ceil(x: int) -> int:
+    return 1 << max(0, int(x - 1).bit_length())
+
+
+def _int_tuple(v) -> tuple:
+    return tuple(int(d) for d in v)
+
+
+# ------------------------------------------------------- pallas tile knobs
+#
+# Probe shapes are fixed constants (512-long sequences / 1024-row operands)
+# so every candidate tile divides evenly and the probe exercises multi-tile
+# grids. The winner is a per-device value, not per-shape: pallas tiles trade
+# VMEM residency against grid overhead, which is a property of the chip
+# generation (the store's fingerprint), not of the request shape.
+
+_TILE_GRID = (64, 128, 256, 512)
+
+
+def _flash_compute(ctx):
+    from ..core.pallas import flash as _flash
+
+    import jax.numpy as jnp
+
+    interpret = bool((ctx or {}).get("interpret", False))
+    bh, s, d = 1, 512, 64
+    q = _seeded((bh, s, d), np.float32, 1)
+    k = _seeded((bh, s, d), np.float32, 2)
+    v = _seeded((bh, s, d), np.float32, 3)
+    qp = jnp.arange(s, dtype=jnp.int32).reshape(1, s)
+    kp = jnp.arange(s, dtype=jnp.int32).reshape(1, s)
+    m0 = jnp.full((bh, s), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bh, s), jnp.float32)
+    o0 = jnp.zeros((bh, s, d), jnp.float32)
+
+    def build(tile):
+        tq, tk = tile
+
+        def _b():
+            call = _flash._update_call(bh, s, s, d, False, 1.0, interpret, tq, tk)
+            return lambda: call(q, k, v, qp, kp, m0, l0, o0)
+
+        return _b
+
+    grid = get("pallas.flash.tile").grid
+    return _probe.pick([(t, build(t)) for t in grid])
+
+
+def _flash_normalize(v):
+    tq, tk = _int_tuple(v)
+    if not (8 <= tq <= 1024 and 8 <= tk <= 1024 and tq % 8 == 0 and tk % 8 == 0):
+        raise ValueError(f"flash tile out of rails: {(tq, tk)}")
+    return (tq, tk)
+
+
+register(
+    Knob(
+        name="pallas.flash.tile",
+        kind="timed",
+        grid=tuple((tq, tk) for tq in _TILE_GRID for tk in _TILE_GRID),
+        default=(128, 128),
+        compute=_flash_compute,
+        normalize=_flash_normalize,
+        doc="flash attention (tile_q, tile_k) block shape",
+    )
+)
+
+
+def _ragged_compute(ctx):
+    from ..core.pallas import ragged as _ragged
+
+    interpret = bool((ctx or {}).get("interpret", False))
+    r, c = 1024, 256
+    x = _seeded((r, c), np.float32, 4)
+
+    def build(tile_r):
+        def _b():
+            call = _ragged._reduce_call(
+                "sum", r, c, tile_r, "float32", r - 24, c, "all", False, False,
+                interpret,
+            )
+            return lambda: call(x)
+
+        return _b
+
+    grid = get("pallas.ragged.tile_r").grid
+    return _probe.pick([(t, build(t)) for t in grid])
+
+
+def _tile_r_normalize(v):
+    t = int(v)
+    if not (8 <= t <= 1024 and t % 8 == 0):
+        raise ValueError(f"ragged tile_r out of rails: {t}")
+    return t
+
+
+register(
+    Knob(
+        name="pallas.ragged.tile_r",
+        kind="timed",
+        grid=_TILE_GRID,
+        default=128,
+        compute=_ragged_compute,
+        normalize=_tile_r_normalize,
+        doc="masked-reduce row-tile height for tall ragged operands",
+    )
+)
+
+
+def _kmeans_compute(ctx):
+    from ..core.pallas import kmeans as _kmeans
+
+    interpret = bool((ctx or {}).get("interpret", False))
+    n, f, k = 1024, 64, 16
+    x = _seeded((n, f), np.float32, 5)
+    centers = _seeded((k, f), np.float32, 6)
+
+    def build(tile_n):
+        def _b():
+            call = _kmeans._step_call(n, f, k, "float32", n - 24, tile_n, interpret)
+            return lambda: call(x, centers)
+
+        return _b
+
+    grid = get("pallas.kmeans.tile_n").grid
+    return _probe.pick([(t, build(t)) for t in grid])
+
+
+register(
+    Knob(
+        name="pallas.kmeans.tile_n",
+        kind="timed",
+        grid=_TILE_GRID,
+        default=128,
+        compute=_kmeans_compute,
+        normalize=_tile_r_normalize,
+        doc="fused assignment+update sample-tile height",
+    )
+)
+
+
+# ------------------------------------------------- blocked-linalg knobs
+#
+# The panel knob is the one *shape-classed* knob: its value depends on the
+# factorization size, so lookups carry shape_class = pow2 bucket of
+# min(m, n) and the probe factors a representative matrix of that class
+# (capped at 512 — beyond that the ranking is stable and the probe cost is
+# not). Crossover knobs race the blocked kernel against the exact
+# ``jnp.linalg`` path it replaces at bracketing sizes and cache the
+# smallest size where blocked wins.
+
+
+def _panel_default(ctx):
+    from ..core.linalg import blocked as _blocked
+
+    ctx = ctx or {}
+    return _blocked.default_panel_width(
+        int(ctx.get("m", 512)), int(ctx.get("n", 512))
+    )
+
+
+def _panel_compute(ctx):
+    from ..core.linalg import blocked as _blocked
+
+    k_bucket = int((ctx or {}).get("k_bucket", 512))
+    rep = max(64, min(k_bucket, 512))
+    a = _seeded((rep, rep), np.float32, 7)
+
+    def build(panel):
+        def _b():
+            fn = _blocked._qr_jit(rep, rep, "float32", panel, True)
+            return lambda: fn(a)
+
+        return _b
+
+    grid = tuple(p for p in get("linalg.blocked.panel").grid if p <= rep)
+    return _probe.pick([(p, build(p)) for p in grid])
+
+
+def _panel_normalize(v):
+    p = int(v)
+    if not (8 <= p <= 1024):
+        raise ValueError(f"panel width out of rails: {p}")
+    return p
+
+
+register(
+    Knob(
+        name="linalg.blocked.panel",
+        kind="timed",
+        grid=(32, 64, 128, 256),
+        default=_panel_default,
+        compute=_panel_compute,
+        normalize=_panel_normalize,
+        doc="compact-WY panel width per min(m,n) pow2 shape class",
+    )
+)
+
+
+def _crossover_compute_for(op: str, brackets: tuple):
+    def compute(ctx):
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.linalg import blocked as _blocked
+
+        per_size = {}
+        crossover = None
+        for s in brackets:
+            a = _seeded((s, s), np.float32, 8)
+            if op == "qr":
+                blocked_fn = _blocked._qr_jit(s, s, "float32",
+                                              _blocked.default_panel_width(s, s), True)
+                ref_fn = jax.jit(jnp.linalg.qr)
+            elif op == "lu":
+                blocked_fn = _blocked._lu_jit(s, s, "float32",
+                                              _blocked.default_panel_width(s, s))
+                ref_fn = jax.jit(jax.scipy.linalg.lu_factor)
+            else:  # svd
+                blocked_fn = _blocked._svd_jit(s, s, "float32",
+                                               _blocked.default_panel_width(s, s),
+                                               _blocked._default_l0(np.float32), True)
+                ref_fn = jax.jit(jnp.linalg.svd)
+            winner, stats = _probe.pick(
+                [("blocked", lambda f=blocked_fn: (lambda: f(a))),
+                 ("reference", lambda f=ref_fn: (lambda: f(a)))]
+            )
+            per_size[s] = stats["medians_s"]
+            if winner == "blocked" and crossover is None:
+                crossover = s
+        if crossover is None:
+            # blocked never won on this device: park the crossover above the
+            # largest bracket so only sizes the probe could not afford to
+            # race keep the blocked path
+            crossover = brackets[-1] * 2
+        return crossover, {"per_size_medians_s": per_size, "brackets": list(brackets)}
+
+    return compute
+
+
+def _crossover_normalize(v):
+    c = int(v)
+    if not (16 <= c <= 65536):
+        raise ValueError(f"crossover out of rails: {c}")
+    return c
+
+
+def _crossover_default_for(op: str):
+    # late-bound through the live CROSSOVER table so a monkeypatched entry
+    # is honored as the fallback
+    def default(ctx):
+        from ..core.linalg import blocked as _blocked
+
+        return _blocked.CROSSOVER[op]
+
+    return default
+
+
+for _op, _brackets in (("qr", (64, 128, 256, 512)),
+                       ("lu", (64, 128, 256, 512)),
+                       ("svd", (64, 128, 256))):
+    register(
+        Knob(
+            name=f"linalg.blocked.crossover.{_op}",
+            kind="timed",
+            grid=_brackets,
+            default=_crossover_default_for(_op),
+            compute=_crossover_compute_for(_op, _brackets),
+            normalize=_crossover_normalize,
+            doc=f"min(m,n) where blocked {_op} beats jnp.linalg (measured race)",
+        )
+    )
+
+
+# ----------------------------------------------------------- mined knobs
+#
+# No timed probes: these knobs read what the serving tier already recorded.
+# ``min_samples()`` keeps tiny test-sized corpora/spools from flipping
+# behavior ambiently — a mined knob that lacks data raises, and the lookup
+# serves the static fallback (counted ``fallback``).
+
+
+def min_samples() -> int:
+    """Observations a mined knob needs before it trusts the data
+    (``HEAT_TPU_TUNING_MIN_SAMPLES``, default 16)."""
+    raw = os.environ.get("HEAT_TPU_TUNING_MIN_SAMPLES", "").strip()
+    try:
+        return max(1, int(raw)) if raw else 16
+    except ValueError:
+        return 16
+
+
+class MiningError(RuntimeError):
+    """A mined knob found no (or not enough) recorded data."""
+
+
+def _buckets_compute(ctx):
+    from ..serving import buckets as _buckets
+    from ..serving import cache as _cache
+    from ..serving import corpus as _corpus
+
+    base = _cache.cache_dir()
+    cdir = _corpus.corpus_dir(base) if base else os.environ.get(
+        "HEAT_TPU_SHAPE_CORPUS", ""
+    )
+    if not cdir:
+        raise MiningError("no shape corpus configured")
+    dims = _buckets.corpus_dims(cdir)
+    if sum(dims.values()) < min_samples():
+        raise MiningError(f"corpus too small: {sum(dims.values())} dims")
+    edges = _buckets.mine_edges(dims)
+    return edges, {
+        "corpus": cdir,
+        "distinct_dims": len(dims),
+        "samples": sum(dims.values()),
+    }
+
+
+def _edges_normalize(v):
+    edges = _int_tuple(v)
+    if not edges or any(e < 1 for e in edges) or list(edges) != sorted(set(edges)):
+        raise ValueError(f"mined edges must be ascending positive ints: {edges}")
+    return edges
+
+
+register(
+    Knob(
+        name="serving.buckets.edges",
+        kind="mined",
+        grid=(),
+        default=None,  # fallback is the parsed env policy, resolved in buckets.py
+        compute=_buckets_compute,
+        normalize=_edges_normalize,
+        doc="optimal-pad-waste bucket edges mined from the shape corpus",
+    )
+)
+
+
+def _spool_group_stats():
+    """(mean group size, batched groups, coalesced requests) across the live
+    telemetry spool — the arrival statistics the batching knobs mine."""
+    from ..monitoring import aggregate as _aggregate
+
+    d = _aggregate.spool_dir()
+    if not d:
+        raise MiningError("no telemetry spool configured")
+    snaps, _skips = _aggregate.read_snapshots(d)
+    coalesced = saved = 0
+    for snap in snaps:
+        counters = ((snap.get("metrics") or {}).get("counters") or {})
+        batch = counters.get("serving.batch") or {}
+        labels = batch.get("labels") or {}
+        coalesced += int(labels.get("coalesced", 0) or 0)
+        saved += int(labels.get("flushes_saved", 0) or 0)
+    groups = coalesced - saved
+    if coalesced < min_samples() or groups <= 0:
+        raise MiningError(f"spool too thin: {coalesced} coalesced requests")
+    return coalesced / groups, groups, coalesced
+
+
+def _linger_compute(ctx):
+    g, groups, coalesced = _spool_group_stats()
+    # sparse arrivals: the window times out with little company — halve it
+    # and return latency; dense arrivals fill the cap before the window
+    # matters — keep the default
+    value = 1.0 if g < 2.0 else 2.0
+    return value, {"mean_group": round(g, 3), "groups": groups,
+                   "coalesced": coalesced}
+
+
+def _linger_normalize(v):
+    ms = float(v)
+    if not (0.0 < ms <= 1000.0):
+        raise ValueError(f"linger out of rails: {ms}")
+    return ms
+
+
+register(
+    Knob(
+        name="serving.batching.linger_ms",
+        kind="mined",
+        grid=(),
+        default=2.0,
+        compute=_linger_compute,
+        normalize=_linger_normalize,
+        doc="coalescing window from spool-mined mean batch occupancy",
+    )
+)
+
+
+def _batch_max_compute(ctx):
+    g, groups, coalesced = _spool_group_stats()
+    # the cap binds when measured occupancy crowds it: double headroom
+    value = min(32, _pow2_ceil(int(2 * g))) if g >= 6.0 else 8
+    return value, {"mean_group": round(g, 3), "groups": groups,
+                   "coalesced": coalesced}
+
+
+def _batch_max_normalize(v):
+    m = int(v)
+    if not (2 <= m <= 1024):
+        raise ValueError(f"batch max out of rails: {m}")
+    return m
+
+
+register(
+    Knob(
+        name="serving.batching.max",
+        kind="mined",
+        grid=(),
+        default=8,
+        compute=_batch_max_compute,
+        normalize=_batch_max_normalize,
+        doc="group-size dispatch trigger from spool-mined occupancy",
+    )
+)
+
+
+def _cost_cards():
+    """Parsed PR 13 cost cards of the configured cache dir (footer-tolerant:
+    cards are written both bare and footered across generations)."""
+    from ..serving import cache as _cache
+
+    base = _cache.cache_dir()
+    if not base:
+        raise MiningError("no cache dir configured")
+    d = os.path.join(base, "cost")
+    try:
+        names = sorted(n for n in os.listdir(d) if n.endswith(".json"))
+    except OSError:
+        raise MiningError("no cost cards recorded") from None
+    cards = []
+    from ..serving import cache as _c
+
+    for name in names:
+        try:
+            with open(os.path.join(d, name), "rb") as f:
+                blob = f.read()
+            body, verdict = _c.split_footer(blob)
+            card = json.loads(body.decode("utf-8"))
+            if isinstance(card, dict) and card.get("available"):
+                cards.append(card)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception:
+            continue
+    if not cards:
+        raise MiningError("no readable cost cards")
+    return cards
+
+
+def _median_of(xs):
+    s = sorted(xs)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def _max_chain_compute(ctx):
+    cards = _cost_cards()
+    if len(cards) < 4:
+        raise MiningError(f"only {len(cards)} cost cards")
+    ratios = [
+        float(c.get("bytes_accessed", 0) or 0) / max(1.0, float(c.get("output_bytes", 0) or 0))
+        for c in cards
+    ]
+    rho = _median_of(ratios)
+    # high traffic-per-output-byte means each replay amortizes more fused
+    # memory traffic: longer chains repay their one-time compile
+    value = 128 if rho >= 4.0 else 64
+    return value, {"cards": len(cards), "median_traffic_ratio": round(rho, 3)}
+
+
+def _chain_normalize(v):
+    c = int(v)
+    if not (2 <= c <= 4096):
+        raise ValueError(f"chain bound out of rails: {c}")
+    return c
+
+
+register(
+    Knob(
+        name="fusion.max_chain",
+        kind="mined",
+        grid=(),
+        default=64,
+        compute=_max_chain_compute,
+        normalize=_chain_normalize,
+        doc="chain bound from cost-card compile-vs-replay amortization",
+    )
+)
+
+
+def _cache_size_compute(ctx):
+    cards = _cost_cards()
+    # the cards enumerate the deployment's distinct compiled signatures:
+    # size the trace LRU to hold that working set with 2x headroom
+    value = max(256, min(16384, _pow2_ceil(2 * len(cards))))
+    return value, {"cards": len(cards)}
+
+
+def _cache_size_normalize(v):
+    c = int(v)
+    if not (16 <= c <= 1 << 20):
+        raise ValueError(f"cache size out of rails: {c}")
+    return c
+
+
+register(
+    Knob(
+        name="fusion.cache_size",
+        kind="mined",
+        grid=(),
+        default=4096,
+        compute=_cache_size_compute,
+        normalize=_cache_size_normalize,
+        doc="trace-LRU capacity from the cost-card working set",
+    )
+)

@@ -1,0 +1,128 @@
+"""
+Deterministic timed micro-probes: the measurement half of the tuning layer.
+
+A probe answers one question — *which candidate knob value is fastest on
+this device?* — in a way that is reproducible enough to cache:
+
+* **Paired and interleaved.** All candidates are timed in round-robin
+  rounds (`A B C  A B C …`), never back-to-back blocks, so clock drift,
+  thermal ramp, and background load hit every candidate equally. The
+  comparison is always within-round.
+* **Median-of-k.** Each candidate's score is the median of its
+  ``budget()`` timed repetitions — robust to a single preempted rep.
+* **Fenced.** Every timed call is ``jax.block_until_ready``-fenced on its
+  result, so async dispatch cannot attribute one candidate's work to the
+  next candidate's clock window.
+* **Warmed.** Each workload runs once untimed before any timed rep:
+  compilation (or the pallas interpret-mode trace) is never on the clock —
+  the probe measures steady-state execute, which is what the serving tier
+  replays.
+* **Seeded.** Workload builders in :mod:`heat_tpu.tuning.knobs` draw
+  inputs from fixed seeds; two probes of the same knob time identical
+  numerics.
+* **Call-count deterministic.** The budget is read once per probe from
+  ``HEAT_TPU_TUNING_BUDGET`` (default 3, floor 1) — like every robustness
+  knob, the number of timed calls is a pure function of configuration, so
+  a pinned timer (tests monkeypatch :data:`_timer`) makes the entire probe,
+  winner included, deterministic.
+
+Ties break toward the earliest candidate in grid order — with a pinned
+timer every run picks the same winner, and on real hardware a dead heat
+prefers the static default's neighborhood (grids list defaults first).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, List, Sequence, Tuple
+
+__all__ = ["ProbeError", "budget", "measure_once", "pick"]
+
+#: The probe clock. Module-level and monkeypatchable: tests pin it to a
+#: scripted counter to make winners deterministic.
+_timer = time.perf_counter
+
+
+class ProbeError(RuntimeError):
+    """No candidate produced a timing — the lookup falls back to the
+    static default (counted ``tuning.lookup{fallback}``)."""
+
+
+def budget() -> int:
+    """Timed repetitions per candidate: ``HEAT_TPU_TUNING_BUDGET``
+    (default 3, floor 1). Read once per probe, not per rep."""
+    raw = os.environ.get("HEAT_TPU_TUNING_BUDGET", "").strip()
+    try:
+        k = int(raw) if raw else 3
+    except ValueError:
+        k = 3
+    return max(1, k)
+
+
+def measure_once(fn: Callable[[], Any]) -> float:
+    """One fenced timing of ``fn``: seconds from call to
+    ``block_until_ready`` on everything it returned."""
+    import jax
+
+    t0 = _timer()
+    out = fn()
+    if out is not None:
+        jax.block_until_ready(out)
+    return _timer() - t0
+
+
+def _median(xs: List[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def pick(
+    candidates: Sequence[Tuple[Any, Callable[[], Callable[[], Any]]]],
+    repeats: int = 0,
+) -> Tuple[Any, dict]:
+    """Time every candidate and return ``(winning value, stats)``.
+
+    ``candidates`` is ``[(value, build), ...]`` where ``build()`` returns a
+    zero-arg workload callable for that value. A builder that raises drops
+    its candidate (a tile the backend rejects is not a probe failure);
+    raises :class:`ProbeError` when none survive. ``repeats`` overrides the
+    env budget when > 0 (the bench's paired anchors pass their own).
+
+    Stats record per-candidate medians (seconds), the budget used, and how
+    many candidates were dropped — persisted beside the winner so a cached
+    decision stays auditable.
+    """
+    k = repeats if repeats > 0 else budget()
+    built = []
+    dropped = 0
+    for value, build in candidates:
+        try:
+            fn = build()
+            measure_once(fn)  # warm: compile/trace off the clock
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception:
+            dropped += 1
+            continue
+        built.append((value, fn, []))
+    if not built:
+        raise ProbeError("all %d probe candidates failed to build" % len(candidates))
+    for _ in range(k):  # interleaved rounds: within-round comparisons only
+        for _value, fn, times in built:
+            times.append(measure_once(fn))
+    best_value, best_median = None, None
+    medians = {}
+    for value, _fn, times in built:
+        m = _median(times)
+        medians[repr(value)] = m
+        if best_median is None or m < best_median:  # strict: ties keep earliest
+            best_value, best_median = value, m
+    return best_value, {
+        "budget": k,
+        "dropped": dropped,
+        "medians_s": medians,
+        "winner_median_s": best_median,
+    }

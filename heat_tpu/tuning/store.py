@@ -1,0 +1,186 @@
+"""
+Persisted tune cache: measured knob values keyed like L2 cache entries.
+
+A probe result is only worth the probe if every later process can reuse it.
+This module gives measured knob values the exact persistence discipline the
+L2 executable cache (PR 8) gives compiled kernels:
+
+* **Location** — ``<tune_dir>/<digest>.json`` where the tune dir is
+  ``HEAT_TPU_TUNING_DIR`` when set, else ``<HEAT_TPU_CACHE_DIR>/tune``
+  (beside the ``exec``/``cost``/``corpus`` siblings), else nothing: with no
+  directory configured, tuned values live only in the in-process memo and
+  each process pays its own probes.
+* **Key** — sha256 over the canonical (sharing-insensitive, PR 8
+  ``cache._canon``) serialization of ``(format, device fingerprint, knob
+  name, candidate grid, shape class)``. The device fingerprint extends the
+  L2 ``cache.fingerprint()`` (jax/jaxlib versions, platform, platform
+  version) with the **device generation** (``device_kind``, e.g.
+  ``"TPU v5e"``): a tile measured on one chip generation must never be
+  served on another. The candidate grid is part of the key so widening a
+  knob's grid in a later release invalidates stale winners.
+* **Integrity** — the JSON body carries the PR 12 sha256 footer
+  (``body || HTPUSHA\\x01 || sha256(body)``) and repeats the fingerprint
+  *inside* the body (defense in depth, the L2 ``incompatible`` discipline).
+  Corrupt, truncated, or foreign-fingerprint entries are never served and
+  never crash a lookup: they fall back to the static default and the file
+  is moved to ``<tune_dir>/quarantine/`` (the janitor idiom — quarantined,
+  never deleted), counted ``tuning.lookup{quarantined}``.
+* **Writes** — same-directory tempfile + ``os.replace``: a concurrent
+  reader sees the old entry or the new one, never a torn file.
+
+Cost-card seeding (PR 13) lives one layer up: the *mined* knobs in
+:mod:`heat_tpu.tuning.knobs` compute their values from the ``cost/`` cards
+and the telemetry spool rather than from timed probes, so a zero-compile
+process sharing a warmed cache dir still gets informed defaults; this
+module only persists whatever a knob computed.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import Optional
+
+from ..monitoring import instrument as _instr
+from ..monitoring.registry import STATE as _MON
+from ..serving import cache as _cache
+
+__all__ = [
+    "FORMAT",
+    "device_fingerprint",
+    "entry_path",
+    "key_digest",
+    "load",
+    "quarantine",
+    "save",
+    "tune_dir",
+]
+
+#: Tune-entry format version: part of every digest and every body, bumped on
+#: any layout change so old entries miss instead of misparse.
+FORMAT = 1
+
+_fingerprint_cache = None
+
+
+def tune_dir() -> str:
+    """The configured tune directory ('' when persistence is off):
+    ``HEAT_TPU_TUNING_DIR`` when set, else ``<HEAT_TPU_CACHE_DIR>/tune``."""
+    d = os.environ.get("HEAT_TPU_TUNING_DIR", "").strip()
+    if d:
+        return d
+    base = _cache.cache_dir()
+    return os.path.join(base, "tune") if base else ""
+
+
+def device_fingerprint() -> tuple:
+    """The L2 ``cache.fingerprint()`` extended with the device generation
+    (``device_kind`` of device 0). Process-stable; a measurement is only
+    valid for the exact toolchain *and* chip generation that produced it."""
+    global _fingerprint_cache
+    if _fingerprint_cache is None:
+        import jax
+
+        try:
+            kind = str(jax.devices()[0].device_kind)
+        except Exception:  # pragma: no cover — backend init failure
+            kind = "unknown"
+        _fingerprint_cache = _cache.fingerprint() + (kind,)
+    return _fingerprint_cache
+
+
+def key_digest(name: str, grid, shape_class) -> Optional[str]:
+    """sha256 of the canonical serialization of
+    ``(FORMAT, device_fingerprint(), name, grid, shape_class)``, or None
+    when a component has no canonical cross-process form."""
+    out: list = []
+    try:
+        _cache._canon((FORMAT, device_fingerprint(), name, grid, shape_class), out)
+    except _cache._Unstable:
+        return None
+    return hashlib.sha256("".join(out).encode()).hexdigest()
+
+
+def entry_path(tune_dir_: str, digest: str) -> str:
+    return os.path.join(tune_dir_, digest + ".json")
+
+
+def quarantine(tune_dir_: str, path: str) -> bool:
+    """Move one poisoned tune entry into ``<tune_dir>/quarantine/`` (the
+    janitor discipline: atomic, tolerant of a concurrent removal winning)."""
+    from ..serving import janitor as _janitor
+
+    return _janitor._quarantine(tune_dir_, path)
+
+
+def _count(kind: str) -> None:
+    if _MON.enabled:
+        _instr.tuning_event(kind)
+
+
+def load(tune_dir_: str, digest: str) -> Optional[dict]:
+    """Read one tune entry, or None. A missing file is a plain miss; a
+    corrupt/truncated body (bad footer, unparseable JSON, wrong layout) or a
+    foreign fingerprint/format is quarantined and counted — never served,
+    never a crash."""
+    path = entry_path(tune_dir_, digest)
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError:
+        return None
+    try:
+        body, verdict = _cache.split_footer(blob)
+        if verdict is not True:  # tune entries have no pre-footer generation
+            raise ValueError("missing or mismatched sha256 footer")
+        record = json.loads(body.decode("utf-8"))
+        if not isinstance(record, dict) or "value" not in record:
+            raise ValueError("tune entry is not a record")
+        if record.get("format") != FORMAT or tuple(
+            record.get("fingerprint", ())
+        ) != device_fingerprint():
+            raise ValueError("foreign fingerprint")
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        quarantine(tune_dir_, path)
+        _count("quarantined")
+        return None
+    return record
+
+
+def save(tune_dir_: str, digest: str, name: str, shape_class, value, stats) -> bool:
+    """Persist one measured value (atomic, footered, fingerprinted).
+    Returns whether the entry is on disk; persistence failures are
+    swallowed — a read-only tune dir degrades to per-process probing."""
+    record = {
+        "format": FORMAT,
+        "fingerprint": list(device_fingerprint()),
+        "knob": name,
+        "shape_class": shape_class,
+        "value": value,
+        "stats": stats,
+    }
+    blob = _cache.with_footer(
+        json.dumps(record, sort_keys=True, default=str).encode("utf-8")
+    )
+    try:
+        os.makedirs(tune_dir_, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=tune_dir_, prefix=".tmp-", suffix=".json")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, entry_path(tune_dir_, digest))
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        return False
+    return True

@@ -1,0 +1,706 @@
+"""
+Measured-autotuning suite (``heat_tpu/tuning/``, ISSUE 18).
+
+Guarantees pinned here:
+
+* **Off-mode inertness** (the contract): with ``HEAT_TPU_TUNING`` unset,
+  no consumer ever calls :func:`tuning.lookup`, no tune file is written,
+  and consumer outputs are bit-for-bit the static-knob outputs.
+* **The lookup funnel**: armed lookups probe once, persist the winner
+  beside the L2 dir, and every later lookup — in-process or a fresh
+  process sharing the tune dir — serves from memo/disk with
+  ``tuning.probed == 0`` (the cross-process acceptance bar).
+* **Store lifecycle**: corrupt, truncated, foreign-fingerprint, or
+  out-of-rails tune entries are quarantined into ``<tune>/quarantine/``
+  (never deleted, never served, never a crash) and the lookup falls back
+  to the static default.
+* **Probe determinism**: under a pinned ``probe._timer`` the whole probe —
+  call count, medians, winner — is deterministic, and ties keep the
+  earliest candidate.
+* **Tuned ≡ static semantics**: a tuned knob changes the schedule, not the
+  result — bit-identical for exact dtypes, within the PR 12
+  ``integrity.tolerance_for`` comparator for floats, across the
+  split/ragged/dtype matrix per wired consumer.
+* **Miner optimality**: mined bucket edges never use more kernels than the
+  pow2 policy on the recorded mix and never pad more; the CLI prints the
+  explicit-edges spec + one JSON stats line (exit 0/2).
+
+Marked ``tuning`` for the CI smoke selection; the real-probe cross-process
+leg is additionally ``slow``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import heat_tpu as ht
+from heat_tpu import monitoring, tuning
+from heat_tpu.core import fusion
+from heat_tpu.core.linalg import blocked
+from heat_tpu.core.pallas import flash as pflash
+from heat_tpu.core.pallas import kmeans as pkmeans
+from heat_tpu.core.pallas import ragged as pragged
+from heat_tpu.monitoring import aggregate, registry
+from heat_tpu.robustness import integrity
+from heat_tpu.serving import batching as sbatching
+from heat_tpu.serving import buckets as sbuckets
+from heat_tpu.serving import cache as scache
+from heat_tpu.serving import corpus as scorpus
+from heat_tpu.tuning import knobs as tknobs
+from heat_tpu.tuning import probe as tprobe
+from heat_tpu.tuning import store as tstore
+
+pytestmark = pytest.mark.tuning
+
+_ENV = (
+    "HEAT_TPU_TUNING",
+    "HEAT_TPU_TUNING_DIR",
+    "HEAT_TPU_TUNING_BUDGET",
+    "HEAT_TPU_TUNING_MIN_SAMPLES",
+    "HEAT_TPU_CACHE_DIR",
+    "HEAT_TPU_SHAPE_CORPUS",
+    "HEAT_TPU_TELEMETRY_DIR",
+    "HEAT_TPU_SERVING_BATCH_MAX",
+    "HEAT_TPU_SERVING_BATCH_LINGER_MS",
+    "HEAT_TPU_FUSION_MAX_CHAIN",
+    "HEAT_TPU_FUSION_CACHE",
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """Fresh memo + counters both sides; every tuning env cleared so the
+    CI standing-gate leg's ambient HEAT_TPU_TUNING=1 cannot cross-couple
+    tests (arming tests pin the gate themselves, the PR 5 precedent)."""
+    registry.reset()
+    tuning.reset()
+    for k in _ENV:
+        monkeypatch.delenv(k, raising=False)
+    yield
+    tuning.reset()
+    registry.reset()
+
+
+def _cnt(kind):
+    return registry.REGISTRY.counter("tuning.lookup").get(label=kind)
+
+
+def _fake_knob(monkeypatch, name="test.fake", value=7, default=3, fail=False):
+    """Register a synthetic knob so funnel tests never pay a real probe."""
+    calls = {"compute": 0}
+
+    def compute(ctx):
+        calls["compute"] += 1
+        if fail:
+            raise RuntimeError("probe boom")
+        return value, {"budget": 1}
+
+    knob = tknobs.Knob(
+        name=name,
+        kind="timed",
+        grid=(1, 2, 3),
+        default=default,
+        compute=compute,
+        normalize=lambda v: int(v),
+        doc="synthetic test knob",
+    )
+    monkeypatch.setitem(tknobs.KNOBS, name, knob)
+    return knob, calls
+
+
+# --------------------------------------------------------------- the funnel
+def test_lookup_off_serves_static_default_without_probe(monkeypatch, tmp_path):
+    _, calls = _fake_knob(monkeypatch)
+    monkeypatch.setenv("HEAT_TPU_TUNING_DIR", str(tmp_path / "tune"))
+    with monitoring.capture():
+        assert tuning.lookup("test.fake") == 3
+    assert calls["compute"] == 0
+    assert not (tmp_path / "tune").exists()  # zero files with the gate unset
+    assert _cnt("probed") == 0 and _cnt("served") == 0
+
+
+def test_lookup_unknown_knob_raises():
+    with pytest.raises(KeyError):
+        tuning.lookup("no.such.knob")
+
+
+def test_funnel_probe_persist_then_disk_serve(monkeypatch, tmp_path):
+    _, calls = _fake_knob(monkeypatch)
+    d = tmp_path / "tune"
+    monkeypatch.setenv("HEAT_TPU_TUNING", "1")
+    monkeypatch.setenv("HEAT_TPU_TUNING_DIR", str(d))
+    with monitoring.capture():
+        assert tuning.lookup("test.fake") == 7  # probe -> persist -> serve
+        assert calls["compute"] == 1
+        assert _cnt("probed") == 1 and _cnt("served") == 1
+        files = [n for n in os.listdir(d) if n.endswith(".json")]
+        assert len(files) == 1
+
+        assert tuning.lookup("test.fake") == 7  # memo hit
+        assert calls["compute"] == 1
+        assert _cnt("served") == 2 and _cnt("probed") == 1
+
+        tuning.reset()  # "new process": memo gone, disk entry remains
+        assert tuning.lookup("test.fake") == 7
+        assert calls["compute"] == 1  # disk hit — no second measurement
+        assert _cnt("served") == 3 and _cnt("probed") == 1
+    assert tuning.chosen() == {"test.fake": 7}
+
+
+def test_funnel_failed_probe_falls_back_and_memoizes(monkeypatch, tmp_path):
+    _, calls = _fake_knob(monkeypatch, fail=True)
+    monkeypatch.setenv("HEAT_TPU_TUNING", "1")
+    monkeypatch.setenv("HEAT_TPU_TUNING_DIR", str(tmp_path / "tune"))
+    with monitoring.capture():
+        assert tuning.lookup("test.fake") == 3  # static default
+        assert tuning.lookup("test.fake") == 3
+    assert calls["compute"] == 1  # a knob that cannot measure is memoized
+    assert _cnt("fallback") == 2 and _cnt("probed") == 0 and _cnt("served") == 0
+    assert tuning.chosen() == {}  # fallbacks are not "chosen" values
+    assert not (tmp_path / "tune").exists()
+
+
+def test_armed_snapshot_carries_chosen_knobs(monkeypatch, tmp_path):
+    _fake_knob(monkeypatch)
+    monkeypatch.setenv("HEAT_TPU_TUNING", "1")
+    monkeypatch.setenv("HEAT_TPU_TUNING_DIR", str(tmp_path / "tune"))
+    with monitoring.capture():
+        tuning.lookup("test.fake")
+        armed = aggregate.build_snapshot()
+    assert armed.get("tuning") == {"test.fake": 7}
+    monkeypatch.delenv("HEAT_TPU_TUNING")  # gate back off: key absent
+    assert "tuning" not in aggregate.build_snapshot()
+
+
+# ------------------------------------------------------------ store lifecycle
+def _write_entry(d, digest, record):
+    blob = scache.with_footer(json.dumps(record, sort_keys=True).encode())
+    os.makedirs(d, exist_ok=True)
+    with open(tstore.entry_path(str(d), digest), "wb") as f:
+        f.write(blob)
+
+
+def test_store_roundtrip(tmp_path):
+    d = str(tmp_path / "tune")
+    digest = tstore.key_digest("test.fake", (1, 2, 3), None)
+    assert digest and len(digest) == 64
+    assert tstore.load(d, digest) is None  # plain miss, nothing quarantined
+    assert tstore.save(d, digest, "test.fake", None, 7, {"budget": 1})
+    rec = tstore.load(d, digest)
+    assert rec["value"] == 7 and rec["knob"] == "test.fake"
+    assert rec["fingerprint"] == list(tstore.device_fingerprint())
+
+
+@pytest.mark.parametrize("damage", ["corrupt", "truncated", "foreign", "layout"])
+def test_store_damage_quarantines_never_serves(tmp_path, damage):
+    d = str(tmp_path / "tune")
+    digest = tstore.key_digest("test.fake", (1, 2, 3), None)
+    path = tstore.entry_path(d, digest)
+    if damage == "foreign":
+        _write_entry(d, digest, {
+            "format": tstore.FORMAT,
+            "fingerprint": ["jax", "jaxlib", "tpu", "v999", "TPU v999"],
+            "knob": "test.fake", "shape_class": None, "value": 7, "stats": {},
+        })
+    elif damage == "layout":
+        _write_entry(d, digest, ["not", "a", "record"])
+    else:
+        assert tstore.save(d, digest, "test.fake", None, 7, {})
+        with open(path, "rb") as f:
+            blob = f.read()
+        blob = blob[:40] if damage == "truncated" else blob[:-8] + b"\x00" * 8
+        with open(path, "wb") as f:
+            f.write(blob)
+    with monitoring.capture():
+        assert tstore.load(d, digest) is None  # never served, never a crash
+    assert not os.path.exists(path)  # moved aside, not deleted
+    q = os.listdir(os.path.join(d, "quarantine"))
+    assert len(q) == 1
+    assert _cnt("quarantined") == 1
+
+
+def test_out_of_rails_entry_quarantined_then_remeasured(monkeypatch, tmp_path):
+    _, calls = _fake_knob(monkeypatch)
+    d = tmp_path / "tune"
+    monkeypatch.setenv("HEAT_TPU_TUNING", "1")
+    monkeypatch.setenv("HEAT_TPU_TUNING_DIR", str(d))
+    knob = tknobs.Knob(
+        name="test.fake", kind="timed", grid=(1, 2, 3), default=3,
+        compute=tknobs.KNOBS["test.fake"].compute,
+        normalize=lambda v: (_ for _ in ()).throw(ValueError("rails"))
+        if int(v) > 100 else int(v),
+        doc="railed",
+    )
+    monkeypatch.setitem(tknobs.KNOBS, "test.fake", knob)
+    digest = tstore.key_digest("test.fake", (1, 2, 3), None)
+    assert tstore.save(str(d), digest, "test.fake", None, 999, {})  # poisoned
+    with monitoring.capture():
+        assert tuning.lookup("test.fake") == 7  # rails reject -> re-measure
+    assert _cnt("quarantined") == 1 and _cnt("probed") == 1
+    assert calls["compute"] == 1
+    assert os.listdir(d / "quarantine")  # the poisoned entry, preserved
+
+
+# --------------------------------------------------------- off-mode inertness
+def test_off_mode_inertness_no_consumer_reaches_lookup(monkeypatch, tmp_path):
+    """With the gate unset every wired consumer resolves its static value
+    without ever calling lookup — the one-env-read contract."""
+    reached = []
+
+    def recorder(name, shape_class=None, context=None):
+        reached.append(name)
+        raise AssertionError("tuning.lookup reached with the gate unset")
+
+    monkeypatch.setattr(tuning, "lookup", recorder)
+    monkeypatch.setenv("HEAT_TPU_TUNING_DIR", str(tmp_path / "tune"))
+
+    assert pflash._tile_prefs(False) == (pflash.TILE_Q, pflash.TILE_K)
+    assert pragged._tile_r_pref(False) == pragged.TILE_R
+    assert pkmeans._tile_n_pref(False) == pkmeans.TILE_N
+    assert blocked.panel_width(512, 512) == blocked.default_panel_width(512, 512)
+    for op in ("qr", "lu", "svd"):
+        assert blocked._crossover(op) == blocked.CROSSOVER[op]
+    assert sbuckets.effective("pow2") == sbuckets.policy("pow2")
+    assert sbatching.batch_max() == 8
+    assert sbatching.linger_s() == pytest.approx(0.002)
+    assert fusion._max_chain() == 64
+    assert fusion._cache_max() == 4096
+    assert reached == []
+    assert not (tmp_path / "tune").exists()  # zero tune files
+
+
+def test_off_mode_inert_bitwise_parity(monkeypatch):
+    """The full consumer path is bit-for-bit the pre-tuning path when off:
+    the same factorization with lookup replaced by a bomb produces the
+    identical bits (it is never consulted)."""
+    rng = np.random.default_rng(11)
+    a = jnp.asarray(rng.standard_normal((160, 96)).astype(np.float32))
+    q0, r0 = blocked.qr(a)
+
+    def bomb(name, shape_class=None, context=None):  # pragma: no cover
+        raise AssertionError("lookup reached with the gate unset")
+
+    monkeypatch.setattr(tuning, "lookup", bomb)
+    q1, r1 = blocked.qr(a)
+    np.testing.assert_array_equal(np.asarray(q0), np.asarray(q1))
+    np.testing.assert_array_equal(np.asarray(r0), np.asarray(r1))
+
+
+# ---------------------------------------------------------- probe determinism
+def _scripted_timer(deltas):
+    """A fake perf counter: each measure_once (two timer calls) consumes one
+    scripted duration, making every probe fully deterministic."""
+    it = iter(deltas)
+    state = {"t": 0.0, "phase": 0}
+
+    def timer():
+        if state["phase"] == 0:
+            state["phase"] = 1
+            return state["t"]
+        state["phase"] = 0
+        state["t"] += next(it)
+        return state["t"]
+
+    return timer
+
+
+def test_probe_pinned_timer_is_deterministic(monkeypatch):
+    monkeypatch.setenv("HEAT_TPU_TUNING_BUDGET", "2")
+    candidates = [("a", lambda: (lambda: None)), ("b", lambda: (lambda: None))]
+    # warm a, warm b (untimed values still consume deltas), then two
+    # interleaved rounds: a=10, b=1 each round -> b wins with median 1.0
+    # (binary-exact deltas: the fake clock must not round)
+    deltas = [1.0, 1.0, 10.0, 1.0, 10.0, 1.0]
+    winners = []
+    for _ in range(2):
+        monkeypatch.setattr(tprobe, "_timer", _scripted_timer(deltas))
+        value, stats = tprobe.pick(candidates)
+        winners.append(value)
+        assert stats["budget"] == 2 and stats["dropped"] == 0
+        assert stats["winner_median_s"] == pytest.approx(1.0)
+    assert winners == ["b", "b"]  # same script, same winner, every run
+
+
+def test_probe_tie_keeps_earliest_candidate(monkeypatch):
+    monkeypatch.setenv("HEAT_TPU_TUNING_BUDGET", "1")
+    monkeypatch.setattr(tprobe, "_timer", _scripted_timer([1.0, 1.0, 4.0, 4.0]))
+    value, _stats = tprobe.pick(
+        [("a", lambda: (lambda: None)), ("b", lambda: (lambda: None))]
+    )
+    assert value == "a"  # strict <: a dead heat prefers grid order
+
+
+def test_probe_drops_failing_builders(monkeypatch):
+    monkeypatch.setenv("HEAT_TPU_TUNING_BUDGET", "1")
+    monkeypatch.setattr(tprobe, "_timer", _scripted_timer([0.1, 3.0]))
+
+    def broken():
+        raise RuntimeError("backend rejects this tile")
+
+    value, stats = tprobe.pick([("bad", broken), ("ok", lambda: (lambda: None))])
+    assert value == "ok" and stats["dropped"] == 1
+    with pytest.raises(tprobe.ProbeError):
+        tprobe.pick([("bad", broken)])
+
+
+def test_probe_budget_floor_and_default(monkeypatch):
+    monkeypatch.delenv("HEAT_TPU_TUNING_BUDGET", raising=False)
+    assert tprobe.budget() == 3
+    monkeypatch.setenv("HEAT_TPU_TUNING_BUDGET", "0")
+    assert tprobe.budget() == 1
+    monkeypatch.setenv("HEAT_TPU_TUNING_BUDGET", "junk")
+    assert tprobe.budget() == 3
+
+
+# -------------------------------------------------------------- mined knobs
+def _write_cost_cards(base, n, ratio=8.0):
+    d = os.path.join(base, "cost")
+    os.makedirs(d, exist_ok=True)
+    for i in range(n):
+        card = {"available": True, "flops": 1.0e9,
+                "bytes_accessed": ratio * 1.0e6, "output_bytes": 1.0e6}
+        with open(os.path.join(d, f"card{i}.json"), "wb") as f:
+            f.write(scache.with_footer(json.dumps(card).encode()))
+
+
+def _write_spool_snapshot(d, coalesced, flushes_saved):
+    os.makedirs(d, exist_ok=True)
+    snap = {"pid": 1234, "nonce": "t", "time": 1.0, "metrics": {"counters": {
+        "serving.batch": {"total": coalesced,
+                          "labels": {"coalesced": coalesced,
+                                     "flushes_saved": flushes_saved}}}}}
+    with open(os.path.join(d, "1234-t.json"), "w") as f:
+        json.dump(snap, f)
+
+
+def _record_corpus(cdir, shapes, tag):
+    for i, shape in enumerate(shapes):
+        entry = {"leaf_descs": ((tuple(shape), "float32", False, None),)}
+        assert scorpus.record(cdir, f"tuning-{tag}-{i}", entry)
+
+
+def test_mined_fusion_bounds_from_cost_cards(monkeypatch, tmp_path):
+    monkeypatch.setenv("HEAT_TPU_TUNING", "1")
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    _write_cost_cards(str(tmp_path), 6, ratio=8.0)
+    assert tuning.lookup("fusion.max_chain") == 128  # traffic-heavy mix
+    assert tuning.lookup("fusion.cache_size") == 256  # pow2ceil(12) -> floor
+    assert fusion._max_chain() == 128  # the consumer serves the tuned bound
+    monkeypatch.setenv("HEAT_TPU_FUSION_MAX_CHAIN", "17")
+    assert fusion._max_chain() == 17  # explicit env always beats tuned
+
+
+def test_mined_fusion_bounds_fall_back_on_thin_evidence(monkeypatch, tmp_path):
+    monkeypatch.setenv("HEAT_TPU_TUNING", "1")
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    _write_cost_cards(str(tmp_path), 3)  # below the 4-card floor
+    with monitoring.capture():
+        assert tuning.lookup("fusion.max_chain") == 64
+    assert _cnt("fallback") == 1 and _cnt("probed") == 0
+
+
+def test_mined_batching_from_spool(monkeypatch, tmp_path):
+    spool = str(tmp_path / "spool")
+    monkeypatch.setenv("HEAT_TPU_TUNING", "1")
+    monkeypatch.setenv("HEAT_TPU_TELEMETRY_DIR", spool)
+    _write_spool_snapshot(spool, coalesced=40, flushes_saved=35)  # g = 8
+    assert tuning.lookup("serving.batching.linger_ms") == 2.0
+    assert tuning.lookup("serving.batching.max") == 16  # pow2ceil(16)
+    assert sbatching.batch_max() == 16
+    monkeypatch.setenv("HEAT_TPU_SERVING_BATCH_MAX", "5")
+    assert sbatching.batch_max() == 5  # explicit env always wins
+
+
+def test_mined_batching_thin_spool_keeps_defaults(monkeypatch, tmp_path):
+    spool = str(tmp_path / "spool")
+    monkeypatch.setenv("HEAT_TPU_TUNING", "1")
+    monkeypatch.setenv("HEAT_TPU_TELEMETRY_DIR", spool)
+    _write_spool_snapshot(spool, coalesced=4, flushes_saved=2)  # < min_samples
+    assert sbatching.batch_max() == 8
+    assert sbatching.linger_s() == pytest.approx(0.002)
+
+
+def test_mined_bucket_edges_refine_armed_policy(monkeypatch, tmp_path):
+    monkeypatch.setenv("HEAT_TPU_TUNING", "1")
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(tmp_path))
+    monkeypatch.setenv("HEAT_TPU_TUNING_MIN_SAMPLES", "4")
+    cdir = scorpus.corpus_dir(str(tmp_path))
+    _record_corpus(str(tmp_path), [(200,), (200,), (384,), (1000,)], "edges")
+    dims = sbuckets.corpus_dims(cdir)
+    mined = sbuckets.mine_edges(dims)
+    edges, tail = sbuckets.effective("pow2")
+    assert edges == mined and tail == mined[-1]
+    assert sbuckets.effective("0") is None  # tuning never forces bucketing on
+    assert tuning.lookup("serving.buckets.edges") == mined
+
+
+# ---------------------------------------------------------- miner optimality
+@pytest.mark.parametrize("dims", [
+    {200: 3, 130: 1, 384: 2, 1000: 1},
+    {64: 10, 65: 10, 1023: 1},
+    {7: 1, 9: 2, 15: 4, 17: 8, 4096: 1},
+    {512: 5},
+])
+def test_mined_edges_dominate_pow2(dims):
+    pow2 = tuple(sorted({sbuckets._pow2_edge(d) for d in dims}))
+    mined = sbuckets.mine_edges(dims)
+    assert mined[-1] == max(dims)  # every recorded dim is covered
+    assert len(mined) <= len(pow2)
+    assert sbuckets.waste_of(dims, mined, mined[-1]) <= sbuckets.waste_of(
+        dims, pow2, pow2[-1]
+    )
+
+
+def test_mined_edges_respect_explicit_k():
+    dims = {100: 4, 300: 2, 900: 1}
+    assert sbuckets.mine_edges(dims, k=1) == (900,)
+    assert len(sbuckets.mine_edges(dims, k=2)) <= 2
+
+
+def test_miner_cli_spec_and_stats(tmp_path):
+    cdir = scorpus.corpus_dir(str(tmp_path))
+    _record_corpus(str(tmp_path), [(384, 200), (384,), (130,), (1000,)], "cli")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "heat_tpu.serving.buckets", "--from-corpus", cdir],
+        capture_output=True, text=True, env=env,
+    )
+    assert out.returncode == 0, out.stderr
+    spec_line, stats_line = out.stdout.strip().splitlines()[-2:]
+    edges = tuple(int(e) for e in spec_line.split(","))
+    assert edges == tuple(sorted(edges)) and edges[-1] == 1000
+    stats = json.loads(stats_line)
+    assert tuple(stats["edges"]) == edges
+    assert stats["kernel_count"] <= stats["pow2_kernel_count"]
+    assert stats["pad_waste"] <= stats["pow2_pad_waste"]
+    # the spec round-trips through the explicit-edges policy parser
+    assert sbuckets.policy(spec_line) == (edges, edges[-1])
+
+    missing = subprocess.run(
+        [sys.executable, "-m", "heat_tpu.serving.buckets",
+         "--from-corpus", str(tmp_path / "nope")],
+        capture_output=True, text=True, env=env,
+    )
+    assert missing.returncode == 2
+    assert "error" in json.loads(missing.stdout.strip().splitlines()[-1])
+
+
+# ------------------------------------------------- cross-process acceptance
+_MINED_SCRIPT = """
+import json
+from heat_tpu import monitoring, tuning
+from heat_tpu.monitoring import registry
+
+with monitoring.capture():
+    vals = {}
+    for name in ("fusion.max_chain", "fusion.cache_size",
+                 "serving.buckets.edges"):
+        vals[name] = tuning._jsonable(tuning.lookup(name))
+    c = registry.REGISTRY.counter("tuning.lookup")
+    print(json.dumps({"values": vals,
+                      "probed": c.get(label="probed"),
+                      "served": c.get(label="served"),
+                      "fallback": c.get(label="fallback")}))
+"""
+
+
+def _run_lookup_process(env):
+    out = subprocess.run(
+        [sys.executable, "-c", _MINED_SCRIPT],
+        capture_output=True, text=True, env=env,
+    )
+    assert out.returncode == 0, out.stderr
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def test_second_process_serves_with_zero_probes(tmp_path):
+    """The acceptance bar: a fresh process sharing the tune dir serves every
+    knob from disk — ``tuning.probed == 0``."""
+    base = str(tmp_path)
+    _write_cost_cards(base, 6, ratio=8.0)
+    _record_corpus(base, [(200,), (200,), (384,), (1000,)], "xproc")
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu", HEAT_TPU_TUNING="1",
+        HEAT_TPU_CACHE_DIR=base, HEAT_TPU_TUNING_MIN_SAMPLES="4",
+    )
+    env.pop("HEAT_TPU_TUNING_DIR", None)
+    first = _run_lookup_process(env)
+    assert first["probed"] == 3 and first["served"] == 3
+    assert first["fallback"] == 0
+    tune_files = [n for n in os.listdir(os.path.join(base, "tune"))
+                  if n.endswith(".json")]
+    assert len(tune_files) == 3
+
+    second = _run_lookup_process(env)
+    assert second["probed"] == 0  # every knob served from the shared dir
+    assert second["served"] == 3 and second["fallback"] == 0
+    assert second["values"] == first["values"]
+
+
+_TIMED_SCRIPT = """
+import json
+from heat_tpu import monitoring, tuning
+from heat_tpu.monitoring import registry
+
+LOOKUPS = [
+    ("pallas.flash.tile", None, {"interpret": True}),
+    ("pallas.ragged.tile_r", None, {"interpret": True}),
+    ("pallas.kmeans.tile_n", None, {"interpret": True}),
+    ("linalg.blocked.panel", 128, {"m": 128, "n": 128, "k_bucket": 128}),
+    ("linalg.blocked.crossover.qr", None, None),
+]
+with monitoring.capture():
+    vals = {}
+    for name, sc, ctx in LOOKUPS:
+        vals[name] = tuning._jsonable(tuning.lookup(name, sc, ctx))
+    c = registry.REGISTRY.counter("tuning.lookup")
+    print(json.dumps({"values": vals,
+                      "probed": c.get(label="probed"),
+                      "served": c.get(label="served")}))
+"""
+
+
+@pytest.mark.slow
+def test_second_process_serves_timed_knobs_with_zero_probes(tmp_path):
+    """The full-acceptance variant with REAL probes (budget 1, interpret
+    mode): pallas tiles, the panel width, and the qr crossover are measured
+    once, persisted, and a second process serves them all from disk."""
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu", HEAT_TPU_TUNING="1",
+        HEAT_TPU_TUNING_BUDGET="1", HEAT_TPU_TUNING_DIR=str(tmp_path),
+        HEAT_TPU_PALLAS_INTERPRET="1",
+    )
+    out = subprocess.run([sys.executable, "-c", _TIMED_SCRIPT],
+                         capture_output=True, text=True, env=env, timeout=560)
+    assert out.returncode == 0, out.stderr
+    first = json.loads(out.stdout.strip().splitlines()[-1])
+    assert first["probed"] == 5 and first["served"] == 5
+
+    out2 = subprocess.run([sys.executable, "-c", _TIMED_SCRIPT],
+                          capture_output=True, text=True, env=env, timeout=560)
+    assert out2.returncode == 0, out2.stderr
+    second = json.loads(out2.stdout.strip().splitlines()[-1])
+    assert second["probed"] == 0 and second["served"] == 5
+    assert second["values"] == first["values"]
+
+
+# ------------------------------------------------- tuned-vs-static semantics
+def _force_tuned(monkeypatch, forced):
+    """Arm the gate and pin lookup to forced (non-default) knob values —
+    the differential isolates the *value change*, not the probe."""
+    monkeypatch.setenv("HEAT_TPU_TUNING", "1")
+
+    def fake_lookup(name, shape_class=None, context=None):
+        if name in forced:
+            return forced[name]
+        return tknobs.get(name).static_default(context)
+
+    monkeypatch.setattr(tuning, "lookup", fake_lookup)
+
+
+def _match_tree(got, ref):
+    got = got if isinstance(got, (tuple, list)) else (got,)
+    ref = ref if isinstance(ref, (tuple, list)) else (ref,)
+    assert len(got) == len(ref)
+    for g, r in zip(got, ref):
+        assert integrity.outputs_match(np.asarray(g), np.asarray(r))
+
+
+@pytest.mark.parametrize("shape,dtype", [
+    # f32 below the static crossover: the tuned run (crossover 16) engages
+    # the blocked kernel where the static run rides jnp.linalg.qr
+    ((160, 96), np.float32),
+    ((150, 91), np.float32),  # ragged: min-dim not a panel multiple
+    # bf16 above the crossover (CPU lapack has no bf16 qr to fall back to):
+    # both runs are blocked — the differential isolates the panel change
+    ((192, 160), jnp.bfloat16),
+    ((190, 149), jnp.bfloat16),
+])
+def test_tuned_vs_static_differential_blocked(monkeypatch, shape, dtype):
+    """A tuned panel width + a lowered crossover change which kernel runs,
+    never what it computes: tuned blocked output matches the static path
+    under the PR 12 comparator."""
+    rng = np.random.default_rng(17)
+    a = jnp.asarray(rng.standard_normal(shape).astype(np.float32)).astype(dtype)
+    static = blocked.qr(a)
+    _force_tuned(monkeypatch, {
+        "linalg.blocked.panel": 32,
+        "linalg.blocked.crossover.qr": 16,  # tuned run engages blocked
+    })
+    tuned = blocked.qr(a)
+    _match_tree(tuned, static)
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+def test_tuned_vs_static_differential_split_matrix(monkeypatch, split):
+    """The split matrix: a tuned panel on the distributed TSQR path matches
+    the static-panel result per the comparator at every split."""
+    rng = np.random.default_rng(23)
+    # tall + divisible so split=0 takes the real TSQR path and split=1 the
+    # BCGS2 path on the 8-device test mesh (no gathered fallback)
+    a_np = rng.standard_normal((512, 64)).astype(np.float32)
+    q0, r0 = ht.linalg.qr(ht.array(a_np, split=split))
+    static = (q0.numpy(), r0.numpy())
+    _force_tuned(monkeypatch, {"linalg.blocked.panel": 32})
+    q1, r1 = ht.linalg.qr(ht.array(a_np, split=split))
+    _match_tree((q1.numpy(), r1.numpy()), static)
+
+
+def test_tuned_vs_static_differential_flash_tile(monkeypatch):
+    rng = np.random.default_rng(29)
+    bh, s, d = 1, 256, 64
+    q = jnp.asarray(rng.standard_normal((bh, s, d)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((bh, s, d)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((bh, s, d)).astype(np.float32))
+    pos = jnp.arange(s, dtype=jnp.int32)
+    m = jnp.full((bh, s), -jnp.inf, jnp.float32)
+    l = jnp.zeros((bh, s), jnp.float32)
+    o = jnp.zeros((bh, s, d), jnp.float32)
+
+    def run():
+        return pflash.tile_update(q, k, v, m, l, o, scale=0.125, causal=False,
+                                  q_pos=pos, k_pos=pos, interpret=True)
+
+    static = run()
+    _force_tuned(monkeypatch, {"pallas.flash.tile": (64, 64)})
+    tuned = run()
+    _match_tree(tuned, static)
+
+
+@pytest.mark.parametrize("dt_str", ["float32", "bfloat16"])
+def test_tuned_vs_static_differential_ragged_tile(monkeypatch, dt_str):
+    rng = np.random.default_rng(31)
+    r, c, bound = 512, 128, 488
+    x_np = rng.standard_normal((r, c)).astype(np.float32)
+    x_np[bound:] = 0.0  # padded rows are neutral-filled by the wrapper
+    x = jnp.asarray(x_np).astype(jnp.dtype(dt_str))
+
+    def run(tile_r):
+        call = pragged._reduce_call("sum", r, c, tile_r, dt_str, bound, c,
+                                    "all", False, False, True)
+        return call(x)
+
+    _match_tree(run(256), run(128))  # tuned tile vs the static 128
+
+
+def test_tuned_vs_static_differential_kmeans_tile():
+    rng = np.random.default_rng(37)
+    n, f, k, bound = 512, 32, 8, 500
+    x_np = rng.standard_normal((n, f)).astype(np.float32)
+    x_np[bound:] = 0.0
+    x = jnp.asarray(x_np)
+    centers = jnp.asarray(rng.standard_normal((k, f)).astype(np.float32))
+
+    def run(tile_n):
+        return pkmeans._step_call(n, f, k, "float32", bound, tile_n, True)(
+            x, centers
+        )
+
+    tuned, static = run(256), run(128)
+    _match_tree(tuned, static)  # labels bit-equal (int), sums/counts bounded
