@@ -902,6 +902,34 @@ def main():
                     "tuning_chosen": None,
                     "tuning_error": repr(e)[:160],
                 }
+        # autoregressive decode serving anchors (ISSUE 19): the 32-step
+        # zero-compile steady-state window of the iteration-level scheduler
+        # (with mid-window join/leave churn), generated-token throughput,
+        # exact inter-token latency percentiles and batch occupancy —
+        # decode_steady_valid additionally requires the persistent KV-cache
+        # to re-donate on every trace-cache hit (fusion.donated{steady_state})
+        generation_anchors = {}
+        if os.environ.get("BENCH_FAST") != "1":
+            try:
+                _add_benchmarks_path()
+                from generation_bench import bench_generation
+
+                with _mev.span("bench.generation"):
+                    generation_anchors = bench_generation()
+            except Exception as e:
+                # explicit null-valued keys, like the neighbouring benches: a
+                # crashed anchor must be distinguishable from a BENCH_FAST skip
+                generation_anchors = {
+                    "decode_tokens_per_s": None,
+                    "inter_token_p50_us": None,
+                    "inter_token_p99_us": None,
+                    "batch_occupancy_pct": None,
+                    "decode_steady_compiles": None,
+                    "decode_steady_donated": None,
+                    "decode_steady_valid": None,
+                    "decode_throughput_valid": None,
+                    "generation_error": repr(e)[:160],
+                }
         telemetry = monitoring.report.telemetry()
     print(
         json.dumps(
@@ -950,6 +978,7 @@ def main():
                 **pallas_anchors,
                 **io_pipe,
                 **tuning_anchors,
+                **generation_anchors,
                 "telemetry": telemetry,
             }
         )

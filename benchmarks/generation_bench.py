@@ -1,0 +1,165 @@
+"""
+Autoregressive decode serving anchors (ISSUE 19).
+
+Four anchors for the persistent-KV-cache decode loop, wired into
+``bench.py`` with the null-key crash-dict + ``*_valid`` gating discipline
+of the PR 4/5 anchors:
+
+* ``decode_steady_compiles`` — the tentpole contract as a number: after a
+  short warmup, a 32-step measured window of the iteration-level scheduler
+  (including mid-window admissions and retirements — slot membership churn
+  is exactly what must NOT recompile) reports its ``fusion.kernels_compiled``
+  delta. Target **0**: the fixed-B decode batch re-enters the same fused
+  chain every step, donating the previous step's KV buffers in place.
+  ``decode_steady_valid`` additionally requires ``flush_reason{collective}``
+  to stay flat across the window (the decode chain must never break on a
+  collective) and a positive ``fusion.donated{steady_state}`` delta — the
+  persistent-cache re-donation proof.
+* ``decode_tokens_per_s`` — aggregate generated-token throughput of the
+  measured window across all batch slots (the scheduler's
+  ``serving.generation{tokens}`` delta / window wall).
+* ``inter_token_p50_us`` / ``inter_token_p99_us`` — exact sample
+  percentiles of per-step wall time over the window: the latency a
+  streaming consumer observes between consecutive tokens of its sequence
+  (every live generating slot emits exactly one token per step, so step
+  time IS inter-token time).
+* ``batch_occupancy_pct`` — mean occupied-slot fraction over the window
+  (the utilization side of the recompile-free fixed-B contract).
+
+``decode_throughput_valid`` gates the timing anchors on bit-exactness:
+every sequence the bench ran must match its single-sequence
+:func:`~heat_tpu.nn.generation.generate_reference` replay token for token —
+a throughput number from a wrong decode is worthless.
+
+The bench runs on the CPU backend with ``HEAT_TPU_FUSION_DONATE=force``
+(jax ignores the donation mask on CPU with a warning, results are
+bit-identical — the force knob exists so the donation *bookkeeping* is
+exercised off-chip); on a TPU host the same code path donates for real.
+
+Run: python benchmarks/generation_bench.py
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+#: (prompt, max_new) workload: two long sequences span the whole window,
+#: two short ones retire mid-window, and two joiners submitted at window
+#: step 10 take over the recycled slots — admission, maxlen retirement and
+#: slot recycling all happen INSIDE the measured 32 steps. Deterministic —
+#: the parity gate replays each sequence standalone.
+SEED_SEQUENCES = [
+    ([3, 1, 4], 40),
+    ([1, 5], 40),
+    ([9, 2, 6, 5], 10),
+    ([3, 5, 8], 10),
+]
+JOINER_SEQUENCES = [
+    ([2, 7], 8),
+    ([1, 8, 2], 8),
+]
+WARMUP_STEPS = 6
+WINDOW_STEPS = 32
+
+
+def bench_generation():
+    from heat_tpu.monitoring import registry
+    from heat_tpu.nn import generation as gen
+    from heat_tpu.serving.generation_scheduler import GenerationScheduler
+
+    prev = {
+        var: os.environ.get(var)
+        for var in (
+            "HEAT_TPU_GENERATION",
+            "HEAT_TPU_FUSION_DONATE",
+            "HEAT_TPU_SHAPE_BUCKETS",
+            "HEAT_TPU_TENANCY",
+        )
+    }
+    os.environ["HEAT_TPU_GENERATION"] = "1"
+    os.environ["HEAT_TPU_FUSION_DONATE"] = "force"
+    os.environ.pop("HEAT_TPU_SHAPE_BUCKETS", None)
+    os.environ.pop("HEAT_TPU_TENANCY", None)
+    try:
+        with registry.capture():
+            compiles = registry.REGISTRY.counter("fusion.kernels_compiled")
+            reasons = registry.REGISTRY.counter("fusion.flush_reason")
+            donated = registry.REGISTRY.counter("fusion.donated")
+            gcount = registry.REGISTRY.counter("serving.generation")
+
+            model = gen.ToyModel.from_env()
+            # capacity covers prompt+max_new for every sequence: no mid-window
+            # grow, so the zero-compile window isolates the membership churn
+            sched = GenerationScheduler(model=model, slots=4, capacity=64)
+            handles = [sched.submit(p, max_new=m) for p, m in SEED_SEQUENCES]
+            for _ in range(WARMUP_STEPS):
+                sched.step()
+
+            before_compiles = compiles.get()
+            before_collective = reasons.get("collective")
+            before_steady = donated.get("steady_state")
+            before_tokens = gcount.get("tokens")
+            step_s, occ = [], []
+            t0 = time.perf_counter()
+            for i in range(WINDOW_STEPS):
+                if i == 10:  # mid-window churn: join the recycled slots
+                    handles.extend(
+                        sched.submit(p, max_new=m) for p, m in JOINER_SEQUENCES
+                    )
+                s0 = time.perf_counter()
+                sched.step()
+                step_s.append(time.perf_counter() - s0)
+                occ.append(sched.occupancy())
+            window_wall = time.perf_counter() - t0
+            steady_compiles = compiles.get() - before_compiles
+            collective_delta = reasons.get("collective") - before_collective
+            steady_donated = donated.get("steady_state") - before_steady
+            window_tokens = gcount.get("tokens") - before_tokens
+
+            sched.run(max_steps=200)  # drain: parity needs full sequences
+            for h in handles:
+                if not h.done.is_set():
+                    raise RuntimeError("bench workload failed to drain")
+            parity = all(
+                h.tokens
+                == gen.generate_reference(
+                    model, h.prompt, max_new=h.max_new, eos=h.eos
+                )
+                for h in handles
+            )
+
+        gaps_us = sorted(1e6 * s for s in step_s)
+
+        def pct(p):
+            return gaps_us[min(len(gaps_us) - 1, int(p / 100.0 * len(gaps_us)))]
+
+        steady_valid = (
+            steady_compiles == 0 and collective_delta == 0 and steady_donated > 0
+        )
+        return {
+            "decode_tokens_per_s": round(window_tokens / window_wall, 1),
+            "inter_token_p50_us": round(pct(50), 1),
+            "inter_token_p99_us": round(pct(99), 1),
+            "batch_occupancy_pct": round(float(np.mean(occ)), 1),
+            "decode_steady_compiles": int(steady_compiles),
+            "decode_steady_donated": int(steady_donated),
+            "decode_steady_valid": bool(steady_valid),
+            "decode_throughput_valid": bool(parity and window_tokens > 0),
+        }
+    finally:
+        for var, val in prev.items():
+            if val is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = val
+
+
+if __name__ == "__main__":
+    print(json.dumps(bench_generation(), sort_keys=True))

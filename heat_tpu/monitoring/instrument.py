@@ -72,6 +72,7 @@ __all__ = [
     "fusion_flush_recovered",
     "fusion_poisoned",
     "fusion_elided_write",
+    "fusion_donated",
     "serving_disk_cache",
     "serving_bucket",
     "serving_symbolic",
@@ -84,6 +85,8 @@ __all__ = [
     "serving_queue_depth",
     "serving_janitor",
     "serving_batch",
+    "serving_generation",
+    "serving_batch_occupancy",
     "serving_tenant",
     "serving_tenant_depth",
     "serving_ingress",
@@ -306,6 +309,19 @@ def fusion_elided_write() -> None:
     REGISTRY.counter("fusion.elided_writes").inc()
 
 
+def fusion_donated(n: int, steady: bool = False) -> None:
+    """Donated input buffers of one fused flush (``fusion.donated``, ISSUE
+    19). Label ``buffers`` counts every leaf in the flush's donation mask;
+    ``steady_state`` additionally counts the ones riding a trace-cache HIT —
+    the persistent KV-cache re-donation proof (before this counter only the
+    first, compiling, donation was observable on the ledger: every later
+    steady-state step donated invisibly)."""
+    c = REGISTRY.counter("fusion.donated")
+    c.inc(int(n), label="buffers")
+    if steady:
+        c.inc(int(n), label="steady_state")
+
+
 #: serving.dispatch_latency buckets: 1-2-5 log steps from 1 µs to 10 s —
 #: dispatch latencies need finer resolution than the decade-wide defaults
 #: for the p50/p99 interpolation in ``report.telemetry()`` to mean anything.
@@ -413,6 +429,25 @@ def serving_batch(kind: str, n: int = 1) -> None:
     batched attempt recovered through individual flushes). Mixed units by
     design — the labels are the content."""
     REGISTRY.counter("serving.batch").inc(int(n), label=kind)
+
+
+def serving_generation(kind: str, n: int = 1) -> None:
+    """Iteration-level generation-scheduler accounting
+    (``serving.generation``, ISSUE 19; kind: admitted — a sequence joined
+    the running decode batch / retired-eos / retired-maxlen /
+    retired-deadline — why it left / steps — decode iterations /
+    tokens — generated tokens emitted across all slots / grown — the KV
+    cache re-bucketed to the next capacity edge / shed-budget — admission
+    deferred because the tenant's weighted slot budget was full). Mixed
+    units by design — the labels are the content."""
+    REGISTRY.counter("serving.generation").inc(int(n), label=kind)
+
+
+def serving_batch_occupancy(pct: float) -> None:
+    """Decode-batch slot occupancy of the last generation step (gauge,
+    0–100: occupied slots / fixed batch slots — the utilization side of the
+    recompile-free fixed-B contract, ISSUE 19)."""
+    REGISTRY.gauge("serving.batch_occupancy").set(float(pct))
 
 
 def tuning_event(kind: str, n: int = 1) -> None:

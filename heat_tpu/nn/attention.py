@@ -48,7 +48,10 @@ def _heat_flash_ok(q: jax.Array, k: jax.Array, v: jax.Array) -> bool:
     either the interpreter or a provably single-device placement. This is the
     fused path for the multi-device GSPMD case the jax TPU kernel refuses
     (and for single-tile sequence lengths its 128-block tiling cannot
-    divide)."""
+    divide). The ``sq == 1`` autoregressive decode case (ISSUE 19) rides
+    the relaxed :func:`~heat_tpu.core.pallas.flash.shape_ok` K-side rule,
+    so a bucketed KV-cache capacity (320, 1536, a mined edge) no longer
+    silently falls back to the dense jnp path."""
     from ..core.pallas import flash as _plflash
 
     if q.ndim != 4 or k.shape != v.shape or q.shape[-1] != k.shape[-1]:

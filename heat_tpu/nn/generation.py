@@ -1,0 +1,443 @@
+"""
+Autoregressive decode with persistent KV-cache state (ISSUE 19, ROADMAP
+item 2): one decode step = ONE fused chain over a persistent cache.
+
+The generative-serving thesis is the fusion engine's amortization argument
+applied across *time*: a decode loop re-executes one small program thousands
+of times, so everything per-step must be cache-hits — no compile, no
+allocation, no per-op dispatch. Three mechanisms compose here:
+
+**One fused chain per step.** A decode step records exactly three nodes via
+:func:`~heat_tpu.core.fusion.defer_app`: ``append_k`` and ``append_v``
+(embed the step's tokens, project, and write each request's row at its own
+cache position via a vmapped ``dynamic_update_slice``) and a root
+``attend`` SINK (project q, attend over the just-appended caches at ragged
+per-request lengths, project out, tied-embedding logits). Because the root
+is a sink and the new cache DNDarrays stay alive in the returned
+:class:`KVCache`, ``materialize_for`` widens the flush: the logits AND both
+updated caches return from the SAME jitted kernel — three outputs, one
+dispatch, one trace-cache entry.
+
+**Steady-state donation.** The *previous* step's cache buffers enter the
+chain as dead-owner leaves (the scheduler rebinds its ``KVCache`` before
+reading logits), shape/dtype-matching the append outputs — the PR 3
+donation machinery aliases them to the new caches, so a steady-state decode
+step allocates nothing and the L1 key (program, leaves, donation mask,
+outputs) is IDENTICAL every step: ``fusion.kernels_compiled == 0`` after
+the first step, proven re-donation via ``fusion.donated{steady_state}``.
+
+**Bucketed capacities.** Cache capacity is chosen at *allocation* time from
+:func:`heat_tpu.serving.buckets.effective` edges (pow2 default, PR 18
+corpus-mined edges when tuning is armed), so the compiled-kernel count is
+bounded by the bucket count as sequences grow — and the flush itself stays
+un-bucketed (flush-time bucketing would void donation).
+
+Ragged lengths ride as a traced ``(B,)`` i32 leaf: per-request masking
+changes VALUES, never the program, so requests of distinct lengths share
+one kernel. Attention routes to flash's M=1 decode kernel
+(:func:`heat_tpu.core.pallas.flash.attention_decode`) when the pallas tier
+admits it, else the dense jnp reference — the choice is baked into the
+node's stable identity so the two never alias in any cache.
+
+Everything is gated behind ``HEAT_TPU_GENERATION=1``; off (the default)
+:func:`decode_step` runs the eager per-op reference path — bit-for-bit the
+pre-ISSUE-19 engine, and the differential oracle for the fused chain.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import factories as _factories
+from ..core import fusion as _fusion
+from ..core import types as _types
+from ..core.dndarray import DNDarray
+
+__all__ = [
+    "enabled",
+    "capacity_for",
+    "ToyModel",
+    "KVCache",
+    "decode_step",
+    "read_logits",
+    "greedy",
+    "generate_reference",
+    "digest_of_tokens",
+]
+
+
+def enabled() -> bool:
+    """Whether the fused generation decode path is armed
+    (``HEAT_TPU_GENERATION=1``; one env read — the off-path cost). Off, a
+    :func:`decode_step` runs the eager per-op reference — bit-for-bit the
+    pre-ISSUE-19 engine."""
+    return os.environ.get("HEAT_TPU_GENERATION", "").strip().lower() in (
+        "1", "true", "on",
+    )
+
+
+#: Fallback bucket spec for cache capacities when no policy is configured:
+#: pow2 edges up to 1024, linear 1024-multiples above (serving/buckets.py).
+_DEFAULT_BUCKETS = "pow2"
+
+#: Floor capacity — below this the bucket ladder would churn kernels for
+#: trivial sequence-length differences.
+MIN_CAPACITY = 16
+
+
+def capacity_for(n: int, spec: Optional[str] = None) -> int:
+    """The bucketed KV-cache capacity for ``n`` tokens: the smallest edge
+    >= n of the effective bucket policy (``HEAT_TPU_GENERATION_BUCKETS``,
+    default pow2; the PR 18 corpus-mined edges replace the parsed policy
+    when ``HEAT_TPU_TUNING=1`` is armed), floored at :data:`MIN_CAPACITY`.
+    Capacity bucketing happens at *allocation* time, so the per-step fused
+    flush keys on exact shapes and donation stays live."""
+    from ..serving import buckets as _buckets
+
+    if spec is None:
+        spec = os.environ.get("HEAT_TPU_GENERATION_BUCKETS", "").strip() or (
+            _DEFAULT_BUCKETS
+        )
+    parsed = _buckets.effective(spec)
+    if parsed is None:
+        parsed = _buckets.policy(_DEFAULT_BUCKETS)
+    edges, tail = parsed
+    return max(MIN_CAPACITY, _buckets.bucket_dim(max(1, int(n)), edges, tail))
+
+
+# ------------------------------------------------------------------ toy model
+class ToyModel:
+    """A deterministic single-layer attention LM — the smallest model that
+    exercises the full cache-state machinery (ISSUE 19 scopes the tentpole
+    to the scheduler/cache work, not the ROADMAP item 1 transformer).
+
+    Parameters are seeded host-side (``np.random.default_rng``) and held as
+    jax arrays ON the model object — the live references keep the donation
+    pass from ever aliasing a weight buffer (strict refcount bound in
+    ``fusion._donatable``). Logits tie the embedding (``h @ E.T``) in f32.
+    """
+
+    def __init__(self, vocab: int = 64, dim: int = 32, heads: int = 2,
+                 head_dim: int = 8, seed: int = 0, dtype: str = "float32"):
+        if dtype not in ("float32", "bfloat16"):
+            raise ValueError(f"unsupported generation model dtype {dtype!r}")
+        self.vocab, self.dim = int(vocab), int(dim)
+        self.heads, self.head_dim = int(heads), int(head_dim)
+        self.seed, self.dtype = int(seed), dtype
+        jdt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+        rng = np.random.default_rng(self.seed)
+
+        def w(shape, scale):
+            return jnp.asarray(rng.standard_normal(shape) * scale, jdt)
+
+        self.E = w((self.vocab, self.dim), 0.4)
+        # positional rows (indexed by each slot's ragged length, mod table
+        # size): without them a greedy toy LM hits an argmax fixed point in a
+        # few steps and every differential/digest test would compare constant
+        # sequences
+        self.P = w((64, self.dim), 0.5)
+        self.Wq = w((self.dim, self.heads * self.head_dim), 0.3)
+        self.Wk = w((self.dim, self.heads * self.head_dim), 0.3)
+        self.Wv = w((self.dim, self.heads * self.head_dim), 0.3)
+        self.Wo = w((self.heads * self.head_dim, self.dim), 0.3)
+        self.scale = float(self.head_dim) ** -0.5
+
+    @property
+    def jnp_dtype(self):
+        return jnp.bfloat16 if self.dtype == "bfloat16" else jnp.float32
+
+    @property
+    def heat_dtype(self):
+        return _types.bfloat16 if self.dtype == "bfloat16" else _types.float32
+
+    @classmethod
+    def from_env(cls) -> "ToyModel":
+        """The serving-side model: seeded by ``HEAT_TPU_GENERATION_SEED``
+        (default 0) at the fixed toy geometry, so a loadgen client computes
+        bit-identical expected digests without any weight exchange."""
+        return cls(seed=int(os.environ.get("HEAT_TPU_GENERATION_SEED", "0") or 0))
+
+
+# ---------------------------------------------------------------- kernels
+#
+# One memoized callable per static configuration: ``defer_app`` keys the
+# trace cache on the fn's object identity and the L2 digest on
+# (opname, static) — both shear unless the SAME object serves every step.
+_FNS: dict = {}
+
+
+def _append_fn_for(heads: int, head_dim: int):
+    """Embed + project the step's tokens and write each request's (1, H, D)
+    row at its own cache position — the in-place KV append (positions are
+    traced, so ragged lengths share one kernel; XLA CSEs the embedding
+    gather with the attend node's inside the fused program)."""
+    key = ("append", heads, head_dim)
+    fn = _FNS.get(key)
+    if fn is None:
+        def fn(cache, emb, pemb, w, tokens, lengths, _h=heads, _d=head_dim):
+            x = jnp.take(emb, tokens, axis=0)
+            x = x + jnp.take(pemb, lengths % pemb.shape[0], axis=0)
+            proj = jnp.dot(x, w).reshape(x.shape[0], _h, _d).astype(cache.dtype)
+            pos = jnp.clip(lengths, 0, cache.shape[1] - 1)
+
+            def put(c, p, u):
+                return jax.lax.dynamic_update_slice(c, u[None], (p, 0, 0))
+
+            return jax.vmap(put)(cache, pos, proj)
+
+        _FNS[key] = fn
+    return fn
+
+
+def _attend_fn_for(heads: int, head_dim: int, scale: float, flash: bool,
+                   interpret: bool):
+    """Project q, attend over the appended caches at ragged per-request
+    lengths, project out with a residual, and emit tied-embedding f32
+    logits. ``flash`` bakes the M=1 pallas decode route vs the dense jnp
+    reference into the node identity (the two differ by the kernel's
+    documented reassociation carve-out and must never alias in a cache)."""
+    key = ("attend", heads, head_dim, float(scale), bool(flash), bool(interpret))
+    fn = _FNS.get(key)
+    if fn is None:
+        def fn(kc, vc, emb, pemb, wq, wo, tokens, lengths, _h=heads,
+               _d=head_dim, _scale=float(scale), _flash=bool(flash),
+               _interp=bool(interpret)):
+            x = jnp.take(emb, tokens, axis=0)
+            x = x + jnp.take(pemb, lengths % pemb.shape[0], axis=0)
+            q = jnp.dot(x, wq).reshape(x.shape[0], 1, _h, _d).astype(kc.dtype)
+            att = jnp.clip(lengths, 0, kc.shape[1] - 1) + 1  # incl. this step
+            if _flash:
+                from ..core.pallas import flash as _fl
+
+                o = _fl.attention_decode(
+                    q, kc, vc, att, scale=_scale, interpret=_interp
+                )
+            else:
+                qf, kf, vf = (a.astype(jnp.float32) for a in (q, kc, vc))
+                s = jnp.einsum("bqhd,bchd->bhqc", qf, kf) * _scale
+                mask = jnp.arange(kc.shape[1])[None, :] < att[:, None]
+                s = jnp.where(mask[:, None, None, :], s, -jnp.inf)
+                p = jax.nn.softmax(s, axis=-1)
+                o = jnp.einsum("bhqc,bchd->bqhd", p, vf).astype(kc.dtype)
+            h = x + jnp.dot(o.reshape(o.shape[0], _h * _d).astype(x.dtype), wo)
+            return jnp.dot(h.astype(jnp.float32), emb.T.astype(jnp.float32))
+
+        _FNS[key] = fn
+    return fn
+
+
+def _flash_route(model: ToyModel, capacity: int, split) -> bool:
+    """Whether this decode step's attention takes the pallas M=1 kernel:
+    the registry predicates (platform/hatch/dtype), the relaxed decode
+    ``shape_ok``, and a single-device (or interpreted) placement — a
+    compiled ``pallas_call`` has no GSPMD partitioning rule."""
+    from ..core import pallas as _PL
+    from ..core.pallas import flash as _plflash
+
+    if split is not None:
+        return False
+    ok = _plflash.shape_ok(1, int(capacity), model.head_dim)
+    if not _PL.available(
+        "flash_ring", dtype=np.dtype(model.jnp_dtype), shape_ok=ok
+    ):
+        return False
+    return bool(_PL.use_interpret()) or jax.device_count() == 1
+
+
+# ---------------------------------------------------------------- cache state
+class KVCache:
+    """The persistent decode state: ``k``/``v`` DNDarrays of shape
+    ``(B, capacity, heads, head_dim)`` plus HOST-side per-slot valid lengths
+    (``np.int32`` — scheduler bookkeeping; the traced copy enters each
+    step's chain as a leaf). Holding the returned cache alive is the state
+    contract: it is exactly what keeps the append nodes' owners live (so
+    they ride the fused kernel as extra outputs) and what the NEXT step's
+    leaves donate from once rebound."""
+
+    __slots__ = ("k", "v", "lengths", "capacity")
+
+    def __init__(self, k: DNDarray, v: DNDarray, lengths: np.ndarray,
+                 capacity: int):
+        self.k = k
+        self.v = v
+        self.lengths = np.asarray(lengths, np.int32)
+        self.capacity = int(capacity)
+
+    @property
+    def batch(self) -> int:
+        return int(self.k.shape[0])
+
+    @classmethod
+    def alloc(cls, model: ToyModel, batch: int, capacity: Optional[int] = None,
+              split: Optional[int] = None) -> "KVCache":
+        cap = int(capacity) if capacity else capacity_for(MIN_CAPACITY)
+        shape = (int(batch), cap, model.heads, model.head_dim)
+        k = _factories.zeros(shape, dtype=model.heat_dtype, split=split)
+        v = _factories.zeros(shape, dtype=model.heat_dtype, split=split)
+        return cls(k, v, np.zeros(int(batch), np.int32), cap)
+
+    def grow(self, model: ToyModel, need: int) -> "KVCache":
+        """Re-bucket to the smallest capacity edge >= ``need`` (a rare
+        boundary event: one eager pad + one new kernel per bucket edge —
+        the bounded-kernel-count contract). Returns self when no growth is
+        needed."""
+        if need <= self.capacity:
+            return self
+        cap = capacity_for(need)
+        split = self.k.split
+        pad = [(0, 0)] * 4
+        pad[1] = (0, cap - self.capacity)
+
+        def widen(d: DNDarray) -> DNDarray:
+            arr = np.asarray(jnp.pad(d.larray, pad))
+            return _factories.array(
+                arr, dtype=model.heat_dtype, split=split, copy=False
+            )
+
+        return KVCache(widen(self.k), widen(self.v), self.lengths, cap)
+
+
+# ---------------------------------------------------------------- decode step
+def _decode_eager(model: ToyModel, cache: KVCache, tok, lens):
+    """The eager per-op reference: the SAME memoized callables the fused
+    chain records, dispatched standalone on concrete arrays — the
+    differential oracle, and the serving path when the knob is off."""
+    append = _append_fn_for(model.heads, model.head_dim)
+    attend = _attend_fn_for(
+        model.heads, model.head_dim, model.scale,
+        _flash_route(model, cache.capacity, cache.k.split), _interpret(),
+    )
+    kc = append(cache.k.parray, model.E, model.P, model.Wk, tok, lens)
+    vc = append(cache.v.parray, model.E, model.P, model.Wv, tok, lens)
+    logits = attend(kc, vc, model.E, model.P, model.Wq, model.Wo, tok, lens)
+    split = cache.k.split
+    k2 = _factories.array(kc, dtype=model.heat_dtype, split=split, copy=False)
+    v2 = _factories.array(vc, dtype=model.heat_dtype, split=split, copy=False)
+    lg = _factories.array(logits, dtype=_types.float32, copy=False)
+    return lg, k2, v2
+
+
+def _interpret() -> bool:
+    from ..core import pallas as _PL
+
+    return bool(_PL.use_interpret())
+
+
+def decode_step(model: ToyModel, cache: KVCache, tokens,
+                advance=None):
+    """One decode step over the persistent cache: append ``tokens`` (host
+    ``(B,)`` int32, one per slot) at each slot's current length, attend over
+    the appended caches, and return ``(logits, new_cache)`` — logits a
+    ``(B, vocab)`` f32 DNDarray (deferred when the fused path records),
+    ``new_cache`` the advanced state.
+
+    ``advance`` (host bool ``(B,)``, default all) selects which slots'
+    lengths move forward: an inactive slot still gets the (ignored) append
+    at its frozen position — values change, the program never does, so
+    sequences join and leave the batch at zero recompiles. The caller must
+    drop its reference to the OLD cache before reading the logits: that is
+    what makes the old buffers dead-owner leaves the donation pass may
+    alias (the steady-state zero-allocation contract)."""
+    B = cache.batch
+    tok = jnp.asarray(np.asarray(tokens, np.int32).reshape(B))
+    lens = jnp.asarray(cache.lengths)
+    if advance is None:
+        new_lengths = cache.lengths + 1
+    else:
+        new_lengths = cache.lengths + np.asarray(advance, np.int32).reshape(B)
+
+    if enabled() and _fusion.enabled():
+        append = _append_fn_for(model.heads, model.head_dim)
+        attend = _attend_fn_for(
+            model.heads, model.head_dim, model.scale,
+            _flash_route(model, cache.capacity, cache.k.split), _interpret(),
+        )
+        stat = (model.heads, model.head_dim)
+        split = cache.k.split
+        kc = _fusion.defer_app(
+            append, "gen-append",
+            (cache.k, model.E, model.P, model.Wk, tok, lens),
+            static=stat, out_split=split, kind="generation",
+        )
+        vc = (
+            None if kc is None else _fusion.defer_app(
+                append, "gen-append",
+                (cache.v, model.E, model.P, model.Wv, tok, lens),
+                static=stat, out_split=split, kind="generation",
+            )
+        )
+        lg = (
+            None if vc is None else _fusion.defer_app(
+                attend, "gen-attend",
+                (kc, vc, model.E, model.P, model.Wq, model.Wo, tok, lens),
+                static=stat + (
+                    float(model.scale),
+                    bool(_flash_route(model, cache.capacity, split)),
+                    _interpret(),
+                ),
+                sink=True, out_split=None, kind="generation",
+            )
+        )
+        if lg is not None:
+            return lg, KVCache(kc, vc, new_lengths, cache.capacity)
+
+    lg, k2, v2 = _decode_eager(model, cache, tok, lens)
+    return lg, KVCache(k2, v2, new_lengths, cache.capacity)
+
+
+def read_logits(logits: DNDarray) -> np.ndarray:
+    """The per-step materialization barrier: flush the decode chain
+    (attributed ``fusion.flush_reason{generation}``) and return host f32
+    logits."""
+    with _fusion.flush_reason("generation"):
+        return np.asarray(logits.larray)
+
+
+def greedy(logits: np.ndarray) -> np.ndarray:
+    """Greedy next-token choice, host-side (``(B,)`` int32)."""
+    return np.argmax(np.asarray(logits), axis=-1).astype(np.int32)
+
+
+# ---------------------------------------------------------------- reference
+def generate_reference(model: ToyModel, prompt: Sequence[int], max_new: int,
+                       eos: Optional[int] = None) -> List[int]:
+    """Single-sequence greedy generation through the EAGER reference path —
+    the loadgen client's expected-digest oracle (deterministic: seeded
+    weights, argmax sampling, batch-independent per-slot math)."""
+    prompt = [int(t) for t in prompt]
+    if not prompt:
+        raise ValueError("generation prompt must be non-empty")
+    cache = KVCache.alloc(
+        model, 1, capacity=capacity_for(len(prompt) + int(max_new))
+    )
+    out: List[int] = []
+    nxt: Optional[int] = None
+    feed = list(prompt)
+    while len(out) < int(max_new):
+        tok = np.asarray([feed.pop(0) if feed else nxt], np.int32)
+        lg, k2, v2 = _decode_eager(
+            model, cache, jnp.asarray(tok), jnp.asarray(cache.lengths)
+        )
+        cache = KVCache(k2, v2, cache.lengths + 1, cache.capacity)
+        if feed:
+            continue  # still consuming the prompt: logits ignored
+        nxt = int(greedy(read_logits(lg))[0])
+        if eos is not None and nxt == int(eos):
+            break
+        out.append(nxt)
+    return out
+
+
+def digest_of_tokens(tokens: Sequence[int]) -> str:
+    """Canonical sha256 of a generated token sequence — the streaming wire
+    format's integrity check (server final line, loadgen comparison)."""
+    return hashlib.sha256(
+        json.dumps([int(t) for t in tokens]).encode()
+    ).hexdigest()

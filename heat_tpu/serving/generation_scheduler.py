@@ -1,0 +1,306 @@
+"""
+Iteration-level continuous batching for autoregressive decode (ISSUE 19).
+
+PR 15's :mod:`~heat_tpu.serving.batching` coalesces independent one-shot
+requests into one flush; generative inference inverts the problem — ONE
+program (the fused decode step of :mod:`heat_tpu.nn.generation`) runs
+thousands of iterations, and the batch's *membership* changes between them.
+This scheduler owns that membership:
+
+* **Fixed-B slots, recompile-free.** The decode batch is ``slots`` wide
+  forever; a sequence occupies one slot from admission to retirement, and a
+  free slot decodes a frozen zero-length row whose (ignored) output costs
+  nothing extra — values change per step, the compiled program never does.
+  Occupancy is exported per step (``serving.batch_occupancy`` gauge).
+* **Admission between steps**, FIFO under per-tenant slot budgets: with
+  ``HEAT_TPU_TENANCY`` armed a tenant may hold at most its weighted share
+  of the B slots (:func:`~heat_tpu.serving.tenancy.queue_share` — the same
+  share math the flush scheduler's admission queue uses), counted
+  ``serving.generation{shed-budget}`` when the head of the queue must wait.
+  Unarmed, budgets are the full batch (one env read — the off-path cost).
+* **Retirement between steps** on EOS / max-new-tokens / per-request step
+  deadlines (``serving.generation{retired-eos,-maxlen,-deadline}``); the
+  slot's cache row is length-reset and immediately reusable.
+* **Bucketed cache growth**: when the longest active sequence would
+  overflow the KV capacity, the cache re-buckets to the next
+  :func:`~heat_tpu.nn.generation.capacity_for` edge (one new kernel per
+  bucket edge — ``serving.generation{grown}``).
+
+The per-step flush runs UNTAGGED by design: a decode batch mixes tenants,
+so the shared fused kernel lives in the shared L1 partition — tenant
+attribution happens at admission, where the scheduling decision is.
+
+Streaming consumers read a :class:`GenerationHandle`: tokens arrive on its
+queue as each step retires, ``result()`` blocks for the full sequence, and
+``digest()`` is the wire-format integrity hash. Everything is opt-in by
+construction — nothing here runs unless a scheduler is instantiated.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from collections import deque
+from typing import List, Optional, Sequence
+
+import numpy as np
+import queue as _queue
+
+from ..monitoring import instrument as _instr
+from ..monitoring.registry import STATE as _MON
+from ..nn import generation as _gen
+
+__all__ = ["GenerationHandle", "GenerationScheduler"]
+
+_ids = itertools.count(1)
+
+
+class GenerationHandle:
+    """One submitted sequence: the caller's streaming view of a slot."""
+
+    def __init__(self, prompt: Sequence[int], max_new: int,
+                 eos: Optional[int], tenant: Optional[str],
+                 deadline_steps: Optional[int]):
+        self.id = next(_ids)
+        self.prompt = [int(t) for t in prompt]
+        self.max_new = int(max_new)
+        self.eos = None if eos is None else int(eos)
+        self.tenant = tenant
+        self.deadline_steps = None if deadline_steps is None else int(deadline_steps)
+        self.tokens: List[int] = []
+        self.queue: _queue.Queue = _queue.Queue()
+        self.done = threading.Event()
+        self.finish_reason: Optional[str] = None
+        self._budget_counted = False
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until retirement; returns the generated tokens."""
+        if not self.done.wait(timeout):
+            raise TimeoutError(f"generation {self.id} incomplete")
+        return list(self.tokens)
+
+    def digest(self) -> str:
+        return _gen.digest_of_tokens(self.tokens)
+
+
+class _Slot:
+    """Scheduler-internal per-slot state."""
+
+    __slots__ = ("handle", "feed", "next_tok", "steps")
+
+    def __init__(self, handle: GenerationHandle):
+        self.handle = handle
+        self.feed = deque(handle.prompt)  # prompt tokens not yet consumed
+        self.next_tok: Optional[int] = None  # last generated token to feed
+        self.steps = 0
+
+
+class GenerationScheduler:
+    """Iteration-level scheduler over one fused decode step (fixed batch of
+    ``slots``; ``auto=True`` runs a daemon stepping thread — the serving
+    worker mode; tests drive :meth:`step` directly for call-count
+    determinism)."""
+
+    def __init__(self, model: Optional[_gen.ToyModel] = None, slots: int = 4,
+                 split: Optional[int] = None, capacity: Optional[int] = None,
+                 auto: bool = False):
+        self.model = model if model is not None else _gen.ToyModel.from_env()
+        self.slots = int(slots)
+        self.split = split
+        self.cache = _gen.KVCache.alloc(
+            self.model, self.slots, capacity=capacity, split=split
+        )
+        self._slots: List[Optional[_Slot]] = [None] * self.slots
+        self._pending: deque = deque()
+        self._lock = threading.RLock()
+        self._work = threading.Condition(self._lock)
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self.steps_run = 0
+        if auto:
+            self._thread = threading.Thread(
+                target=self._loop, name="heat-tpu-generation", daemon=True
+            )
+            self._thread.start()
+
+    # ------------------------------------------------------------- submit
+    def submit(self, prompt: Sequence[int], max_new: int,
+               eos: Optional[int] = None, tenant: Optional[str] = None,
+               deadline_steps: Optional[int] = None) -> GenerationHandle:
+        if not prompt:
+            raise ValueError("generation prompt must be non-empty")
+        if max_new < 1:
+            raise ValueError("max_new must be >= 1")
+        h = GenerationHandle(prompt, max_new, eos, tenant, deadline_steps)
+        with self._work:
+            self._pending.append(h)
+            self._work.notify_all()
+        return h
+
+    def shutdown(self) -> None:
+        with self._work:
+            self._stop = True
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    # ---------------------------------------------------------- accounting
+    def _count(self, kind: str, n: int = 1) -> None:
+        if _MON.enabled:
+            _instr.serving_generation(kind, n)
+
+    def _budget(self, tenant: Optional[str], known: set) -> int:
+        """``tenant``'s concurrent-slot budget: its weighted share of the B
+        slots when tenancy is armed, else the whole batch."""
+        from . import tenancy as _tenancy
+
+        if not _tenancy.armed():
+            return self.slots
+        return _tenancy.queue_share(tenant or "default", self.slots, known)
+
+    # ------------------------------------------------------------- stepping
+    def _retire(self, i: int, reason: str) -> None:
+        slot = self._slots[i]
+        self._slots[i] = None
+        self.cache.lengths[i] = 0  # slot row recycled, no recompile
+        self._count(f"retired-{reason}")
+        h = slot.handle
+        h.finish_reason = reason
+        h.done.set()
+        h.queue.put(None)  # stream sentinel
+
+    def _admit(self) -> None:
+        if not self._pending:
+            return
+        active_by_tenant: dict = {}
+        known = set()
+        for s in self._slots:
+            if s is not None:
+                t = s.handle.tenant or "default"
+                known.add(t)
+                active_by_tenant[t] = active_by_tenant.get(t, 0) + 1
+        for h in self._pending:
+            known.add(h.tenant or "default")
+        kept: deque = deque()
+        for i in range(self.slots):
+            if not self._pending:
+                break
+            if self._slots[i] is not None:
+                continue
+            while self._pending:
+                h = self._pending.popleft()
+                t = h.tenant or "default"
+                if active_by_tenant.get(t, 0) >= self._budget(h.tenant, known):
+                    if not h._budget_counted:
+                        h._budget_counted = True
+                        self._count("shed-budget")
+                    kept.append(h)  # deferred, not dropped: FIFO within tenant
+                    continue
+                self._slots[i] = _Slot(h)
+                self.cache.lengths[i] = 0
+                active_by_tenant[t] = active_by_tenant.get(t, 0) + 1
+                self._count("admitted")
+                break
+        kept.extend(self._pending)
+        self._pending = kept
+
+    def step(self) -> bool:
+        """One decode iteration: retire deadlined slots, admit from the
+        queue, run ONE fused decode step over the fixed batch, distribute
+        the sampled tokens, and retire finished slots. Returns False when
+        there was nothing to do (idle)."""
+        with self._lock:
+            for i, s in enumerate(self._slots):
+                if (
+                    s is not None
+                    and s.handle.deadline_steps is not None
+                    and s.steps >= s.handle.deadline_steps
+                ):
+                    self._retire(i, "deadline")
+            self._admit()
+            active = [i for i, s in enumerate(self._slots) if s is not None]
+            if _MON.enabled:
+                _instr.serving_batch_occupancy(
+                    100.0 * len(active) / max(1, self.slots)
+                )
+            if not active:
+                return False
+
+            need = int(max(self.cache.lengths[i] for i in active)) + 1
+            if need > self.cache.capacity:
+                self.cache = self.cache.grow(self.model, need)
+                self._count("grown")
+
+            tokens = np.zeros(self.slots, np.int32)
+            advance = np.zeros(self.slots, np.int32)
+            for i in active:
+                s = self._slots[i]
+                tokens[i] = s.feed.popleft() if s.feed else s.next_tok
+                advance[i] = 1
+                s.steps += 1
+
+            # ONE fused chain; rebinding self.cache BEFORE the read is what
+            # kills the old buffers' owners so the flush donates them
+            logits, self.cache = _gen.decode_step(
+                self.model, self.cache, tokens, advance=advance
+            )
+            nxt = _gen.greedy(_gen.read_logits(logits))
+            self.steps_run += 1
+            self._count("steps")
+
+            emitted = 0
+            for i in active:
+                s = self._slots[i]
+                if s is None or s.feed:
+                    continue  # retired above, or still consuming its prompt
+                tok = int(nxt[i])
+                h = s.handle
+                if h.eos is not None and tok == h.eos:
+                    self._retire(i, "eos")
+                    continue
+                h.tokens.append(tok)
+                h.queue.put(tok)
+                emitted += 1
+                s.next_tok = tok
+                if len(h.tokens) >= h.max_new:
+                    self._retire(i, "maxlen")
+            if emitted:
+                self._count("tokens", emitted)
+            return True
+
+    def idle(self) -> bool:
+        with self._lock:
+            return not self._pending and all(s is None for s in self._slots)
+
+    def run(self, max_steps: Optional[int] = None) -> int:
+        """Step until idle (or ``max_steps``); returns steps run."""
+        n = 0
+        while (max_steps is None or n < max_steps) and not self.idle():
+            self.step()
+            n += 1
+        return n
+
+    def occupancy(self) -> float:
+        with self._lock:
+            live = sum(1 for s in self._slots if s is not None)
+            return 100.0 * live / max(1, self.slots)
+
+    # ---------------------------------------------------------- auto mode
+    def _loop(self) -> None:
+        while True:
+            with self._work:
+                while not self._stop and self.idle():
+                    self._work.wait(timeout=0.5)
+                if self._stop:
+                    return
+            try:
+                self.step()
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception:
+                # a decode bug must not kill the serving thread: fail every
+                # in-flight sequence and keep accepting work
+                with self._lock:
+                    for i, s in enumerate(self._slots):
+                        if s is not None:
+                            self._retire(i, "error")

@@ -39,10 +39,21 @@ thread pool and reports exact sample percentiles (``p50_us``/``p99_us``),
 **goodput** (digest-correct responses per second of wall time — sheds and
 mismatches don't count), and the shed/error/mismatch ledger.
 
+**Generative mode** (ISSUE 19, ``--generate``): the same contract for
+autoregressive decode — :func:`gen_trace` records a seeded prompt mix,
+:func:`expected_generation` computes every request's full expected token
+sequence locally through the eager decode reference (decode is
+deterministic: seeded weights, greedy argmax), and :func:`run_generate`
+consumes the ``/v1/generate`` NDJSON streams, double-checking each
+request's client-recomputed token digest against the server's ``done``
+line AND the local reference. Reported: ``decode_tokens_per_s`` and exact
+``inter_token_p50_us``/``inter_token_p99_us``.
+
 CLI::
 
     python -m heat_tpu.serving.loadgen --url http://127.0.0.1:8080 \\
         [--requests N] [--concurrency C] [--seed S] [--no-check] [--json]
+        [--generate]
 
 exits 0 on a clean run, 1 on any wrong result or transport error
 (sheds are *not* failures — they are the admission contract working).
@@ -71,6 +82,10 @@ __all__ = [
     "trace",
     "run",
     "run_phases",
+    "gen_trace",
+    "gen_request_key",
+    "expected_generation",
+    "run_generate",
     "main",
 ]
 
@@ -390,6 +405,206 @@ def run(
     return stats
 
 
+# ------------------------------------------------------------- generation
+def gen_trace(
+    seed: int = 20260806,
+    n: int = 24,
+    tenants: Tuple[Tuple[str, int], ...] = (("alpha", 3), ("beta", 1)),
+    vocab: int = 64,
+) -> List[dict]:
+    """The recorded generative trace (ISSUE 19): ``n`` ``/v1/generate``
+    requests with seeded prompts (1-6 tokens), ``max_new`` in 4-16, and an
+    occasional EOS token (early-retirement coverage). Deterministic in
+    ``seed`` — the same trace replays everywhere, and because decode is
+    deterministic too (seeded weights, greedy argmax) the full expected
+    token sequence of every request is computable client-side."""
+    import random
+
+    rng = random.Random(seed)
+    population = [t for t, w in tenants for _ in range(int(w))]
+    reqs = []
+    for _ in range(n):
+        req = {
+            "tenant": rng.choice(population),
+            "prompt": [rng.randrange(vocab) for _ in range(rng.randint(1, 6))],
+            "max_new": rng.randint(4, 16),
+        }
+        if rng.random() < 0.25:
+            req["eos"] = rng.randrange(vocab)
+        reqs.append(req)
+    return reqs
+
+
+def gen_request_key(req: dict) -> str:
+    """Identity of a generation request for expected-digest matching
+    (tenant excluded: decode is tenant-independent by construction)."""
+    return json.dumps(
+        {
+            "prompt": [int(t) for t in req["prompt"]],
+            "max_new": int(req.get("max_new", 16)),
+            "eos": None if req.get("eos") is None else int(req["eos"]),
+        },
+        sort_keys=True,
+    )
+
+
+def expected_generation(requests: Sequence[dict]) -> Dict[str, str]:
+    """Reference digests for every distinct generation request, computed
+    locally through the EAGER decode reference
+    (:func:`heat_tpu.nn.generation.generate_reference`) with the same
+    env-seeded toy model the workers serve — no weight exchange, same
+    bit-exact sequence."""
+    from ..nn import generation as _generation
+
+    model = _generation.ToyModel.from_env()
+    out: Dict[str, str] = {}
+    for req in requests:
+        key = gen_request_key(req)
+        if key not in out:
+            toks = _generation.generate_reference(
+                model, [int(t) for t in req["prompt"]],
+                int(req.get("max_new", 16)),
+                eos=None if req.get("eos") is None else int(req["eos"]),
+            )
+            out[key] = _generation.digest_of_tokens(toks)
+    return out
+
+
+def _post_generate(url: str, payload: dict, timeout: float):
+    """POST one ``/v1/generate`` and consume the NDJSON stream. Returns
+    ``(status, tokens, final_line_dict_or_None, inter_token_gaps_s)``."""
+    import http.client
+    import urllib.parse
+
+    u = urllib.parse.urlparse(url)
+    conn = http.client.HTTPConnection(
+        u.hostname, u.port or 80, timeout=timeout
+    )
+    tokens: List[int] = []
+    gaps: List[float] = []
+    final = None
+    try:
+        conn.request(
+            "POST", "/v1/generate", body=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        if resp.status != 200:
+            try:
+                final = json.loads(resp.read().decode())
+            except Exception:
+                final = {"ok": False, "error": f"http {resp.status}"}
+            return resp.status, tokens, final, gaps
+        t_prev = time.perf_counter()
+        while True:
+            line = resp.readline()
+            if not line:
+                break  # truncated: no done line -> caller counts an error
+            rec = json.loads(line)
+            if rec.get("done") is not None:
+                final = rec
+                break
+            if "t" in rec:
+                now = time.perf_counter()
+                if tokens:
+                    gaps.append(now - t_prev)
+                t_prev = now
+                tokens.append(int(rec["t"]))
+        return resp.status, tokens, final, gaps
+    finally:
+        conn.close()
+
+
+def run_generate(
+    url: str,
+    requests: Sequence[dict],
+    concurrency: int = 4,
+    timeout_s: float = 120.0,
+    expected: Optional[Dict[str, str]] = None,
+) -> dict:
+    """Drive a generative trace against a live ingress from ``concurrency``
+    threads, consuming each request's token stream. Correctness is
+    **double-checked** per request: the digest recomputed client-side over
+    the exact tokens received off the wire must match BOTH the server's
+    ``done``-line sha256 and (when ``expected`` is given) the locally
+    computed reference digest — a reroute mid-stream that dropped or
+    duplicated a token fails here, which is the zero-wrong-results leg of
+    the SIGKILL acceptance. Returns the ledger + ``decode_tokens_per_s``
+    and exact ``inter_token_p50_us``/``inter_token_p99_us``."""
+    from ..nn import generation as _generation
+
+    lock = threading.Lock()
+    it = iter(list(enumerate(requests)))
+    gaps_all: List[float] = []
+    stats = {
+        "n": len(requests), "ok": 0, "shed": 0, "errors": 0,
+        "mismatches": 0, "tokens": 0,
+    }
+
+    def worker():
+        while True:
+            with lock:
+                try:
+                    _i, req = next(it)
+                except StopIteration:
+                    return
+            try:
+                status, tokens, final, gaps = _post_generate(
+                    url, req, timeout_s
+                )
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception:
+                with lock:
+                    stats["errors"] += 1
+                continue
+            with lock:
+                stats["tokens"] += len(tokens)
+                gaps_all.extend(gaps)
+                if status == 503 or (final or {}).get("shed"):
+                    stats["shed"] += 1
+                elif status == 200 and final is not None and final.get("done"):
+                    wire = _generation.digest_of_tokens(tokens)
+                    good = wire == final.get("sha256")
+                    if good and expected is not None:
+                        want = expected.get(gen_request_key(req))
+                        good = want is None or wire == want
+                    if good:
+                        stats["ok"] += 1
+                    else:
+                        stats["mismatches"] += 1
+                else:
+                    stats["errors"] += 1
+
+    t_start = time.perf_counter()
+    threads = [
+        threading.Thread(target=worker, name=f"gen-loadgen-{i}", daemon=True)
+        for i in range(max(1, concurrency))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = max(time.perf_counter() - t_start, 1e-9)
+    gaps_all.sort()
+
+    def pct(q: float) -> Optional[float]:
+        if not gaps_all:
+            return None
+        idx = min(len(gaps_all) - 1, max(0, int(round(q * (len(gaps_all) - 1)))))
+        return round(gaps_all[idx] * 1e6, 1)
+
+    stats.update(
+        {
+            "wall_s": round(wall, 3),
+            "decode_tokens_per_s": round(stats["tokens"] / wall, 2),
+            "inter_token_p50_us": pct(0.50),
+            "inter_token_p99_us": pct(0.99),
+        }
+    )
+    return stats
+
+
 def main(argv=None) -> int:
     """CLI entry point (``python -m heat_tpu.serving.loadgen``)."""
     import argparse
@@ -423,9 +638,25 @@ def main(argv=None) -> int:
         help="sleep S seconds between diurnal phases (lets a closed-loop "
         "autoscaler observe the load change)",
     )
+    p.add_argument(
+        "--generate",
+        action="store_true",
+        help="drive the recorded GENERATIVE trace against /v1/generate "
+        "(streaming decode; requires workers with HEAT_TPU_GENERATION=1)",
+    )
     p.add_argument("--json", action="store_true", help="print stats as JSON")
     args = p.parse_args(argv)
-    if args.diurnal:
+    if args.generate:
+        reqs = gen_trace(seed=args.seed, n=args.requests)
+        expected = None if args.no_check else expected_generation(reqs)
+        stats = run_generate(
+            args.url,
+            reqs,
+            concurrency=args.concurrency,
+            timeout_s=args.timeout,
+            expected=expected,
+        )
+    elif args.diurnal:
         stats = run_phases(
             args.url,
             seed=args.seed,

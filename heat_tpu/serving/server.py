@@ -22,6 +22,15 @@ Ingress routes:
     Every live worker exhausted = **shed**: HTTP 503 with
     ``{"ok": false, "shed": true}`` (``{shed}``) — the admission contract,
     not an error. Forwarded responses relay verbatim (``{routed}``).
+``POST /v1/generate``
+    Autoregressive decode, streamed (ISSUE 19): forward to a live worker
+    and relay its NDJSON token stream line by line — ``{"t": token}`` per
+    decode iteration, then a terminal ``{"done": true, "sha256": …}``
+    integrity line. A worker death mid-stream reroutes to the next live
+    worker with the already-delivered token prefix **skipped** (decode is
+    deterministic, so the retry's prefix is bit-identical): the client sees
+    one gapless sequence and the digest still verifies. 404 with reason
+    ``generation-off`` unless the worker armed ``HEAT_TPU_GENERATION=1``.
 ``GET /healthz``
     Ingress liveness: 200 while the server thread breathes, with the live
     worker count.
@@ -107,6 +116,30 @@ _LOG = logging.getLogger("heat_tpu.serving")
 
 
 # ------------------------------------------------------------------ worker
+_GEN_LOCK = threading.Lock()
+_GEN_SCHED = None
+
+
+def _generation_scheduler():
+    """The process-wide generation scheduler (ISSUE 19), created on the
+    first ``/v1/generate`` request: one auto-stepping
+    :class:`~heat_tpu.serving.generation_scheduler.GenerationScheduler`
+    whose fixed decode batch (``HEAT_TPU_GENERATION_SLOTS``, default 4) all
+    handler threads' sequences share — iteration-level continuous batching
+    behind a streaming HTTP front."""
+    global _GEN_SCHED
+    with _GEN_LOCK:
+        if _GEN_SCHED is None:
+            from ..nn import generation as _generation
+            from .generation_scheduler import GenerationScheduler
+
+            slots = int(os.environ.get("HEAT_TPU_GENERATION_SLOTS", "4") or 4)
+            _GEN_SCHED = GenerationScheduler(
+                model=_generation.ToyModel.from_env(), slots=slots, auto=True
+            )
+        return _GEN_SCHED
+
+
 class _WorkerHandler(BaseHTTPRequestHandler):
     server_version = "heat-tpu-worker"
 
@@ -130,6 +163,9 @@ class _WorkerHandler(BaseHTTPRequestHandler):
 
     def do_POST(self):  # noqa: N802
         route = self.path.split("?", 1)[0].rstrip("/")
+        if route == "/v1/generate":
+            self._do_generate()
+            return
         if route != "/v1/compute":
             self._send_json(404, {"error": f"no route {route}"})
             return
@@ -207,6 +243,86 @@ class _WorkerHandler(BaseHTTPRequestHandler):
                 500, {"ok": False, "error": repr(e)[:300],
                       "trace_id": tid, "reason": "worker-error"}
             )
+
+    def _do_generate(self) -> None:
+        """``POST /v1/generate`` (ISSUE 19): submit one sequence to the
+        process generation scheduler and STREAM its tokens as NDJSON — one
+        ``{"t": token}`` line per decode iteration as the shared batch
+        produces it, then a final ``{"done": true, "sha256": …}`` integrity
+        line (the loadgen digest contract). 404 unless
+        ``HEAT_TPU_GENERATION=1`` armed the decode path — the off-knob wire
+        surface is exactly PR 18's."""
+        import queue as _queue_mod
+
+        from ..nn import generation as _generation
+
+        if not _generation.enabled():
+            self._send_json(
+                404, {"ok": False, "reason": "generation-off",
+                      "error": "HEAT_TPU_GENERATION is not armed"}
+            )
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            req = json.loads(self.rfile.read(length).decode())
+            if not isinstance(req, dict):
+                raise ValueError("request body must be a JSON object")
+            prompt = [int(t) for t in req["prompt"]]
+            max_new = int(req.get("max_new", 16))
+            eos = req.get("eos")
+            eos = int(eos) if eos is not None else None
+            tenant = req.get("tenant")
+            tenant = str(tenant) if tenant is not None else None
+            deadline = req.get("deadline_steps")
+            deadline = int(deadline) if deadline is not None else None
+            handle = _generation_scheduler().submit(
+                prompt, max_new, eos=eos, tenant=tenant,
+                deadline_steps=deadline,
+            )
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            self._send_json(
+                400, {"ok": False, "error": repr(e)[:300],
+                      "reason": "bad-request"}
+            )
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        try:
+            while True:
+                try:
+                    tok = handle.queue.get(timeout=120.0)
+                except _queue_mod.Empty:
+                    line = {"done": False, "error": "generation stalled",
+                            "worker_pid": os.getpid()}
+                    self.wfile.write(
+                        (json.dumps(line, sort_keys=True) + "\n").encode()
+                    )
+                    return
+                if tok is None:
+                    final = {
+                        "done": True,
+                        "n": len(handle.tokens),
+                        "sha256": handle.digest(),
+                        "finish_reason": handle.finish_reason,
+                        "worker_pid": os.getpid(),
+                    }
+                    self.wfile.write(
+                        (json.dumps(final, sort_keys=True) + "\n").encode()
+                    )
+                    self.wfile.flush()
+                    return
+                self.wfile.write(
+                    (json.dumps({"t": int(tok)}) + "\n").encode()
+                )
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            # client (or ingress) gone mid-stream: the scheduler retires the
+            # slot on its own; nothing to unwind
+            return
 
 
 def _boot_warmup() -> None:
@@ -477,6 +593,23 @@ class _IngressHandler(BaseHTTPRequestHandler):
 
     def do_POST(self):  # noqa: N802
         route = self.path.split("?", 1)[0].rstrip("/")
+        if route == "/v1/generate":
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                body = self.rfile.read(length)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                self._send_json(
+                    400, {"ok": False, "error": repr(e)[:300],
+                          "reason": "bad-request"}
+                )
+                return
+            try:
+                self.ingress.route_generate(body, self)
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # client hung up mid-stream
+            return
         if route != "/v1/compute":
             self._send_json(404, {"error": f"no route {route}"})
             return
@@ -860,6 +993,119 @@ class Ingress:
         if _MON.enabled:
             _instr.serving_ingress("shed")
         return None
+
+    def route_generate(self, body: bytes, handler) -> bool:
+        """Stream one ``/v1/generate`` request through a worker (ISSUE 19),
+        relaying NDJSON lines as they arrive. A mid-stream worker death
+        (refused / reset / truncated before the ``done`` line) marks the
+        worker dead and REROUTES to the next one, **skipping the tokens the
+        client already received** — decode is deterministic (seeded weights,
+        greedy argmax), so the retry's prefix is bit-identical and the
+        client observes one gapless sequence whose final digest still
+        verifies. Every worker exhausted = shed (503 if nothing was sent
+        yet, a terminal ``{"done": false, "shed": true}`` line otherwise)."""
+        import http.client
+
+        with self._lock:
+            slots = list(self._slots)
+            start = self._rr
+            self._rr += 1
+        sent = 0  # tokens already relayed to the client (across attempts)
+        headers_out = False
+        tried = 0
+        for k in range(len(slots)):
+            slot = slots[(start + k) % len(slots)]
+            if not slot.alive:
+                continue
+            conn = http.client.HTTPConnection(
+                self.host, slot.port, timeout=max(30.0, self.request_timeout_s)
+            )
+            try:
+                try:
+                    conn.request(
+                        "POST", "/v1/generate", body=body,
+                        headers={"Content-Type": "application/json"},
+                    )
+                    resp = conn.getresponse()
+                    if resp.status != 200:
+                        # the worker answered (4xx — generation off, bad
+                        # request): it is alive — relay verbatim
+                        payload = resp.read().decode()
+                        slot.routed += 1
+                        if _MON.enabled:
+                            _instr.serving_ingress("routed")
+                            if tried:
+                                _instr.serving_ingress("rerouted")
+                        if not headers_out:
+                            handler._send_text(
+                                resp.status, payload, "application/json"
+                            )
+                        return True
+                    idx = 0  # this attempt's token index
+                    while True:
+                        line = resp.readline()
+                        if not line:
+                            raise ConnectionError("stream truncated")
+                        rec = json.loads(line)
+                        if rec.get("done") is not None:
+                            if not headers_out:
+                                handler.send_response(200)
+                                handler.send_header(
+                                    "Content-Type", "application/x-ndjson"
+                                )
+                                handler.send_header("Connection", "close")
+                                handler.end_headers()
+                                headers_out = True
+                            handler.wfile.write(line)
+                            handler.wfile.flush()
+                            slot.routed += 1
+                            if _MON.enabled:
+                                _instr.serving_ingress("routed")
+                                if tried:
+                                    _instr.serving_ingress("rerouted")
+                            return True
+                        if "t" in rec:
+                            if idx >= sent:
+                                if not headers_out:
+                                    handler.send_response(200)
+                                    handler.send_header(
+                                        "Content-Type", "application/x-ndjson"
+                                    )
+                                    handler.send_header("Connection", "close")
+                                    handler.end_headers()
+                                    headers_out = True
+                                handler.wfile.write(line)
+                                handler.wfile.flush()
+                                sent += 1
+                            idx += 1
+                except (BrokenPipeError, ConnectionResetError):
+                    raise  # CLIENT side gone: abort, do not mark the worker
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception:
+                    # connection-level worker failure mid-stream: mark dead,
+                    # reroute with the already-sent prefix skipped
+                    self._mark_dead(slot)
+                    tried += 1
+                    continue
+            finally:
+                conn.close()
+        if _MON.enabled:
+            _instr.serving_ingress("shed")
+        if not headers_out:
+            handler._send_json(
+                503, {"ok": False, "shed": True, "error": "no live worker",
+                      "reason": "no-live-worker"}
+            )
+        else:
+            handler.wfile.write(
+                (json.dumps(
+                    {"done": False, "shed": True, "error": "no live worker"},
+                    sort_keys=True,
+                ) + "\n").encode()
+            )
+            handler.wfile.flush()
+        return False
 
     # ---- distributed tracing (ISSUE 16)
     def finish_trace(

@@ -148,6 +148,58 @@ register(
 )
 
 
+def _flash_decode_compute(ctx):
+    from ..core.pallas import flash as _flash
+
+    import jax.numpy as jnp
+
+    interpret = bool((ctx or {}).get("interpret", False))
+    bh, sk, d = 8, 1024, 64  # M=1 decode against a pow2 cache capacity
+    q = _seeded((bh, 1, d), np.float32, 11)
+    k = _seeded((bh, sk, d), np.float32, 12)
+    v = _seeded((bh, sk, d), np.float32, 13)
+    # per-(batch·head) ragged positions — the decode kernel variant proper
+    qp = jnp.asarray(
+        np.random.default_rng(14).integers(0, sk, size=(bh, 1)), jnp.int32
+    )
+    kp = jnp.arange(sk, dtype=jnp.int32).reshape(1, sk)
+    m0 = jnp.full((bh, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bh, 1), jnp.float32)
+    o0 = jnp.zeros((bh, 1, d), jnp.float32)
+
+    def build(tk):
+        def _b():
+            call = _flash._update_call(
+                bh, 1, sk, d, True, 1.0, interpret, _flash.TILE_Q, tk, True
+            )
+            return lambda: call(q, k, v, qp, kp, m0, l0, o0)
+
+        return _b
+
+    grid = get("pallas.flash.decode_tile").grid
+    return _probe.pick([(t, build(t)) for t in grid])
+
+
+def _flash_decode_normalize(v):
+    t = int(v)
+    if not (8 <= t <= 1024 and t % 8 == 0):
+        raise ValueError(f"flash decode tile out of rails: {t}")
+    return t
+
+
+register(
+    Knob(
+        name="pallas.flash.decode_tile",
+        kind="timed",
+        grid=(64, 128, 256, 512),
+        default=128,
+        compute=_flash_decode_compute,
+        normalize=_flash_decode_normalize,
+        doc="flash M=1 decode K-tile extent (ISSUE 19 ragged decode walk)",
+    )
+)
+
+
 def _ragged_compute(ctx):
     from ..core.pallas import ragged as _ragged
 

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""
+CI generation smoke (ISSUE 19): boot a real 2-worker ingress with the
+generation knob armed, stream the seeded generative trace through
+``/v1/generate``, and SIGKILL one worker mid-load.
+
+Asserts, end to end:
+
+* every completed stream's wire digest matches BOTH the server's final-line
+  sha256 AND the locally recomputed ``generate_reference`` oracle (zero
+  wrong results — the acceptance bar; mid-stream reroute resumes the
+  deterministic decode on the surviving worker and skips the already-sent
+  token prefix, so the client sequence stays gapless);
+* one worker was SIGKILLed while streams were in flight and the run still
+  completed with zero mismatches and zero transport errors;
+* the off-knob control: a worker booted WITHOUT ``HEAT_TPU_GENERATION``
+  answers ``/v1/generate`` 404 ``generation-off`` through the relay.
+
+Exit 0 clean; 1 on any failed assertion. Usage:
+
+    python scripts/generation_smoke.py [--requests N] [--no-kill]
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--requests", type=int, default=24)
+    p.add_argument("--no-kill", action="store_true")
+    args = p.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("HEAT_TPU_MONITORING", "1")
+    for var in ("HEAT_TPU_FAULT_PLAN", "HEAT_TPU_CHAOS",
+                "HEAT_TPU_BREAKER_FORCE_OPEN"):
+        os.environ.pop(var, None)
+    from heat_tpu.serving import loadgen
+    from heat_tpu.serving.server import Ingress
+
+    failures = []
+
+    def check(ok, what):
+        print(("ok   " if ok else "FAIL ") + what)
+        if not ok:
+            failures.append(what)
+
+    reqs = loadgen.gen_trace(seed=20260806, n=args.requests)
+    expected = loadgen.expected_generation(reqs)
+    with tempfile.TemporaryDirectory(prefix="generation-smoke-") as tmp:
+        env = {
+            "JAX_PLATFORMS": "cpu",
+            "HEAT_TPU_GENERATION": "1",
+            "HEAT_TPU_FUSION_DONATE": "force",
+        }
+        ing = Ingress(
+            workers=2, cache_dir=os.path.join(tmp, "cache"), env=env
+        ).start()
+        try:
+            killed = {}
+            if not args.no_kill:
+                def killer():
+                    time.sleep(0.4)
+                    pids = ing.worker_pids()
+                    if pids:
+                        os.kill(pids[0], signal.SIGKILL)
+                        killed["pid"] = pids[0]
+
+                t = threading.Thread(target=killer)
+                t.start()
+            stats = loadgen.run_generate(
+                ing.url(), reqs, concurrency=6, expected=expected
+            )
+            if not args.no_kill:
+                t.join()
+            print("loadgen:", json.dumps(stats, sort_keys=True))
+            check(stats["mismatches"] == 0, "zero wrong results")
+            check(stats["errors"] == 0, "zero transport errors")
+            check(
+                stats["ok"] + stats["shed"] == len(reqs),
+                "every request accounted",
+            )
+            check(
+                stats["ok"] > 0 and stats["decode_tokens_per_s"] > 0,
+                "generative goodput > 0",
+            )
+            if not args.no_kill:
+                check(bool(killed), "a worker was SIGKILLed mid-load")
+        finally:
+            ing.stop()
+
+        # off-knob control: no generation env -> the endpoint does not exist
+        ing = Ingress(
+            workers=1,
+            cache_dir=os.path.join(tmp, "cache-off"),
+            env={"JAX_PLATFORMS": "cpu"},
+        ).start()
+        try:
+            req = urllib.request.Request(
+                ing.url("/v1/generate"),
+                data=json.dumps({"prompt": [1, 2], "max_new": 4}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                urllib.request.urlopen(req, timeout=30)
+                check(False, "off-knob worker answers 404 generation-off")
+            except urllib.error.HTTPError as e:
+                body = json.loads(e.read().decode())
+                check(
+                    e.code == 404 and body.get("reason") == "generation-off",
+                    "off-knob worker answers 404 generation-off",
+                )
+        finally:
+            ing.stop()
+    if failures:
+        print(f"generation smoke: {len(failures)} failure(s)")
+        return 1
+    print("generation smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

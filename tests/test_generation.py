@@ -1,0 +1,543 @@
+"""
+Autoregressive decode serving suite (``heat_tpu/nn/generation.py`` +
+``heat_tpu/serving/generation_scheduler.py`` + the flash M=1 decode case,
+ISSUE 19).
+
+Guarantees pinned here:
+
+* **Fused ≡ eager** (the acceptance bar): the fused decode chain's logits
+  and advanced caches match the eager per-op reference across split
+  {None, 0, 1} × even/ragged lengths × f32/bf16, within the
+  ``integrity.tolerance_for`` carve-outs — and the *decisions* (greedy
+  token sequences) are bit-identical, including through the flash
+  interpret route.
+* **Zero-compile steady state** (the tentpole): 32+ consecutive scheduler
+  steps — with sequences joining and leaving the fixed-B batch mid-window
+  — compile ZERO kernels and never break the chain on a collective, while
+  ``fusion.donated{steady_state}`` proves the persistent KV-cache buffers
+  re-donate on every trace-cache hit; a second PROCESS replaying the same
+  decode against a warmed ``HEAT_TPU_CACHE_DIR`` also compiles zero.
+* **Iteration-level scheduling**: FIFO admission under per-tenant slot
+  budgets (``shed-budget`` counted, deferred not dropped), retirement on
+  EOS / max-new / step deadlines with the slot row recycled recompile-free,
+  bucketed cache growth counted, and a mixed batch's per-slot sequences
+  bit-identical to the B=1 ``generate_reference`` replay.
+* **Default off** (the acceptance bar): with ``HEAT_TPU_GENERATION``
+  unset, ``decode_step`` runs the eager per-op reference (no generation
+  flush, no donation tick) and a standard fused workload's results and
+  compile counts are byte-identical whether or not the knob exists.
+
+The live streaming-wire legs boot real worker subprocesses (full jax
+imports) and are marked ``slow`` to protect the tier-1 wall-clock budget;
+the CI ``generation-smoke`` job runs the WHOLE marker (slow included)
+plus the SIGKILL smoke script.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.core import fusion
+from heat_tpu.core.pallas import flash as plflash
+from heat_tpu.monitoring import registry
+from heat_tpu.nn import generation as gen
+from heat_tpu.robustness import faultinject, integrity
+from heat_tpu.serving import loadgen
+from heat_tpu.serving.generation_scheduler import GenerationScheduler
+
+pytestmark = pytest.mark.generation
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """Fresh counters/caches; the generation knob is deliberately left at
+    its default (off) — engagement-asserting tests pin it ON themselves
+    (the PR 5/8 pin-the-gate precedent)."""
+    registry.reset()
+    monkeypatch.setenv("HEAT_TPU_FUSION", "1")
+    monkeypatch.delenv("HEAT_TPU_CACHE_DIR", raising=False)
+    monkeypatch.delenv("HEAT_TPU_SHAPE_BUCKETS", raising=False)
+    monkeypatch.delenv("HEAT_TPU_GENERATION_BUCKETS", raising=False)
+    monkeypatch.delenv("HEAT_TPU_GENERATION_SEED", raising=False)
+    monkeypatch.delenv("HEAT_TPU_TENANCY", raising=False)
+    monkeypatch.delenv("HEAT_TPU_TUNING", raising=False)
+    fusion.clear_cache()
+    yield
+    fusion.clear_cache()
+    registry.reset()
+
+
+@pytest.fixture
+def no_faults(monkeypatch):
+    """Pin injection/chaos/breakers/audit off for count-asserting tests
+    (the PR 6/9/12 precedent)."""
+    from heat_tpu.robustness import breaker
+
+    monkeypatch.delenv("HEAT_TPU_FAULT_PLAN", raising=False)
+    monkeypatch.delenv("HEAT_TPU_CHAOS", raising=False)
+    monkeypatch.delenv("HEAT_TPU_BREAKER_FORCE_OPEN", raising=False)
+    monkeypatch.delenv("HEAT_TPU_AUDIT_RATE", raising=False)
+    faultinject.clear()
+    breaker.reset()
+    fusion.clear_cache()
+
+
+@pytest.fixture
+def gen_on(monkeypatch):
+    monkeypatch.setenv("HEAT_TPU_GENERATION", "1")
+    # CPU test host: force admits the donation mask so the bookkeeping
+    # (and its refcount tripwire) is exercised; jax ignores the mask on
+    # CPU with a warning and results are bit-identical
+    monkeypatch.setenv("HEAT_TPU_FUSION_DONATE", "force")
+
+
+def _compiles() -> int:
+    return registry.REGISTRY.counter("fusion.kernels_compiled").get()
+
+
+def _steps_tokens(model, sched_tokens):
+    return [int(t) for t in sched_tokens]
+
+
+# ------------------------------------------------------------- capacities
+def test_capacity_bucketing_pow2_and_floor():
+    assert gen.capacity_for(1) == gen.MIN_CAPACITY
+    assert gen.capacity_for(16) == 16
+    assert gen.capacity_for(17) == 32
+    assert gen.capacity_for(100) == 128
+    assert gen.capacity_for(1025) == 2048  # linear 1024-multiples above 1024
+
+
+def test_capacity_env_spec(monkeypatch):
+    monkeypatch.setenv("HEAT_TPU_GENERATION_BUCKETS", "24,48,96")
+    assert gen.capacity_for(20) == 24
+    assert gen.capacity_for(25) == 48
+    # above the last edge: tail multiples, still floored at MIN_CAPACITY
+    assert gen.capacity_for(97) >= 97
+
+
+# ------------------------------------------------------- flash decode case
+def test_shape_ok_decode_relaxation():
+    # pre-existing square rails unchanged
+    assert plflash.shape_ok(128, 128, 64)
+    assert not plflash.shape_ok(320, 320, 64)
+    # sq=1 decode: any %8 capacity up to MAX_SEQ_DECODE
+    assert plflash.shape_ok(1, 320, 64)
+    assert plflash.shape_ok(1, 1536, 64)
+    assert plflash.shape_ok(1, gen_cap := plflash.MAX_SEQ_DECODE, 64)
+    assert not plflash.shape_ok(1, gen_cap + 8, 64)
+    assert not plflash.shape_ok(1, 324, 64)  # not lane-aligned, > single tile
+    assert plflash.shape_ok(1, 20, 64)  # small: single whole-sequence tile
+    assert not plflash.shape_ok(1, 0, 64)
+    assert not plflash.shape_ok(0, 128, 64)
+    assert not plflash.shape_ok(1, 128, plflash.MAX_HEAD_DIM + 1)
+
+
+def test_attention_decode_matches_dense_ragged():
+    """The M=1 kernel (interpreted) vs the dense masked-softmax reference
+    at ragged per-request lengths spanning 1..capacity."""
+    b, cap, h, d = 4, 64, 2, 8
+    rng = np.random.default_rng(5)
+    q = np.asarray(rng.standard_normal((b, 1, h, d)), np.float32)
+    k = np.asarray(rng.standard_normal((b, cap, h, d)), np.float32)
+    v = np.asarray(rng.standard_normal((b, cap, h, d)), np.float32)
+    lengths = np.asarray([1, 7, 33, 64], np.int32)
+    scale = d ** -0.5
+    out = np.asarray(
+        plflash.attention_decode(q, k, v, lengths, scale=scale, interpret=True)
+    )
+    s = np.einsum("bqhd,bchd->bhqc", q, k) * scale
+    mask = np.arange(cap)[None, :] < lengths[:, None]
+    s = np.where(mask[:, None, None, :], s, -np.inf)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bhqc,bchd->bqhd", p, v)
+    assert np.allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------- fused vs eager matrix
+def _run_steps(model, split, lengths0, n_steps, capacity=32):
+    """Drive ``n_steps`` decode steps from a fixed starting state; returns
+    (logits_list, final_cache). Engagement is whatever the ambient knob
+    says — callers pin it."""
+    B = len(lengths0)
+    cache = gen.KVCache.alloc(model, B, capacity=capacity, split=split)
+    # pre-fill each slot's history so ragged lengths are real: feed
+    # deterministic tokens one step at a time up to each slot's length
+    warm = int(max(lengths0))
+    for t in range(warm):
+        adv = (np.arange(B) * 0 + (t < np.asarray(lengths0))).astype(np.int32)
+        tok = np.full(B, (t * 7 + 3) % model.vocab, np.int32)
+        lg, cache = gen.decode_step(model, cache, tok, advance=adv)
+        gen.read_logits(lg)
+    assert list(cache.lengths) == [int(x) for x in lengths0]
+    outs = []
+    for t in range(n_steps):
+        tok = np.full(B, (t * 5 + 1) % model.vocab, np.int32)
+        lg, cache = gen.decode_step(model, cache, tok)
+        outs.append(gen.read_logits(lg))
+    return outs, cache
+
+
+@pytest.mark.parametrize("split", [None, 0, 1])
+@pytest.mark.parametrize(
+    "lengths0",
+    [(3,) * 8, (1, 4, 2, 7, 3, 6, 2, 5)],
+    ids=["even", "ragged"],
+)
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"], ids=["f32", "bf16"])
+def test_fused_vs_eager_matrix(monkeypatch, split, lengths0, dtype, no_faults):
+    """The differential acceptance bar: fused-chain logits and caches match
+    the eager reference within the documented per-dtype carve-outs (the
+    chain's intermediates carry the MODEL dtype even though logits emit
+    f32, so the bf16 carve-out governs bf16 runs), and the greedy
+    decisions are bit-identical. B = the 8-device mesh width so split=0
+    shards evenly — the serving scheduler itself always runs split=None."""
+    model = gen.ToyModel(dtype=dtype)
+    monkeypatch.delenv("HEAT_TPU_GENERATION", raising=False)
+    eager, ecache = _run_steps(model, split, lengths0, 4)
+    monkeypatch.setenv("HEAT_TPU_GENERATION", "1")
+    fusion.clear_cache()
+    fused, fcache = _run_steps(model, split, lengths0, 4)
+    ctol = integrity.tolerance_for(model.jnp_dtype)
+    for a, b in zip(eager, fused):
+        assert np.allclose(a, b, rtol=ctol, atol=ctol)
+        assert np.array_equal(gen.greedy(a), gen.greedy(b))
+    for ec, fc in ((ecache.k, fcache.k), (ecache.v, fcache.v)):
+        ea = np.asarray(ec.larray, np.float32)
+        fa = np.asarray(fc.larray, np.float32)
+        assert np.allclose(ea, fa, rtol=ctol, atol=ctol)
+
+
+def test_fused_flash_route_matches_dense(monkeypatch, gen_on, no_faults):
+    """The interpret-forced flash route's token decisions match the dense
+    attend's — the kernel's reassociation carve-out never flips a greedy
+    argmax at toy scale."""
+    model = gen.ToyModel()
+    monkeypatch.delenv("HEAT_TPU_PALLAS_INTERPRET", raising=False)
+    dense, _ = _run_steps(model, None, (2, 5, 1, 3), 4)
+    monkeypatch.setenv("HEAT_TPU_PALLAS_INTERPRET", "1")
+    fusion.clear_cache()
+    gen._FNS.clear()
+    try:
+        flashy, _ = _run_steps(model, None, (2, 5, 1, 3), 4)
+    finally:
+        gen._FNS.clear()
+    for a, b in zip(dense, flashy):
+        assert np.array_equal(gen.greedy(a), gen.greedy(b))
+
+
+def test_mixed_batch_slots_match_b1_reference(gen_on, no_faults):
+    """Per-slot batch independence: every sequence decoded in a mixed batch
+    is bit-identical to its own single-sequence reference replay."""
+    model = gen.ToyModel()
+    sched = GenerationScheduler(model=model, slots=3, capacity=32)
+    specs = [([3, 1, 4], 8), ([9], 6), ([2, 7, 1, 8], 5)]
+    handles = [sched.submit(p, max_new=m) for p, m in specs]
+    sched.run(max_steps=60)
+    for h, (p, m) in zip(handles, specs):
+        assert h.result(timeout=0) == gen.generate_reference(model, p, max_new=m)
+        assert h.digest() == gen.digest_of_tokens(h.tokens)
+
+
+# ------------------------------------------------- steady-state contracts
+def test_zero_compile_steady_state_with_join_leave(gen_on, no_faults):
+    """The tentpole: 32+ consecutive decode steps — admission, retirement
+    and slot recycling happening mid-window — at ZERO compiled kernels and
+    zero collective chain breaks, with the persistent cache re-donating on
+    every step (``fusion.donated{steady_state}`` strictly increasing)."""
+    with registry.capture():
+        compiles = registry.REGISTRY.counter("fusion.kernels_compiled")
+        reasons = registry.REGISTRY.counter("fusion.flush_reason")
+        donated = registry.REGISTRY.counter("fusion.donated")
+        model = gen.ToyModel()
+        sched = GenerationScheduler(model=model, slots=4, capacity=64)
+        sched.submit([3, 1, 4], max_new=40)
+        sched.submit([1, 5], max_new=40)
+        sched.submit([9, 2, 6], max_new=8)   # leaves mid-window
+        sched.submit([3, 5, 8], max_new=8)   # leaves mid-window
+        for _ in range(4):
+            sched.step()  # warmup: the single compile happens here
+        assert compiles.get() >= 1
+        before_steady = donated.get("steady_state")
+        for i in range(34):
+            if i == 14:  # join the recycled slots mid-window
+                sched.submit([2, 7], max_new=10)
+                sched.submit([1, 8, 2], max_new=10)
+            c0, r0 = compiles.get(), reasons.get("collective")
+            sched.step()
+            assert compiles.get() == c0, f"step {i} compiled a kernel"
+            assert reasons.get("collective") == r0
+        assert donated.get("steady_state") > before_steady
+        assert sched.occupancy() > 0.0
+
+
+def test_steady_state_redonation_regression(gen_on, no_faults):
+    """Satellite 2 regression: N decode steps re-donate the SAME logical
+    cache buffers every step — ``fusion.donated`` grows by 2 buffers/step
+    (k and v) and every post-warmup donation rides a trace-cache hit."""
+    with registry.capture():
+        donated = registry.REGISTRY.counter("fusion.donated")
+        model = gen.ToyModel()
+        cache = gen.KVCache.alloc(model, 2, capacity=32)
+        per_step = []
+        for t in range(8):
+            before = donated.get("buffers")
+            tok = np.full(2, (t + 1) % model.vocab, np.int32)
+            lg, cache = gen.decode_step(model, cache, tok)
+            gen.read_logits(lg)  # old cache rebound above: buffers are dead
+            per_step.append(donated.get("buffers") - before)
+        # step 1 donates nothing (zeros factories are fresh un-dead leaves);
+        # every subsequent step donates exactly k and v
+        assert per_step[2:] == [2] * 6
+        steady = donated.get("steady_state")
+        assert steady >= 2 * 6  # all post-warmup donations were cache HITS
+
+
+@pytest.mark.slow
+def test_cross_process_zero_compile_against_warmed_dir(tmp_path, gen_on):
+    """A fresh PROCESS replaying the decode loop against a warmed
+    ``HEAT_TPU_CACHE_DIR`` compiles ZERO kernels — the fused decode chain
+    rides the L2 disk cache like any other serving kernel."""
+    script = (
+        "import os, sys\n"
+        "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+        "import numpy as np\n"
+        "from heat_tpu.nn import generation as gen\n"
+        "from heat_tpu.monitoring import registry\n"
+        "registry.enable()\n"
+        "model = gen.ToyModel()\n"
+        "cache = gen.KVCache.alloc(model, 2, capacity=32)\n"
+        "for t in range(6):\n"
+        "    tok = np.full(2, (t + 1) % 5, np.int32)\n"
+        "    lg, cache = gen.decode_step(model, cache, tok)\n"
+        "    gen.read_logits(lg)\n"
+        "print('COMPILES', registry.REGISTRY.counter('fusion.kernels_compiled').get())\n"
+    )
+    env = dict(os.environ)
+    env.update({
+        "HEAT_TPU_GENERATION": "1",
+        "HEAT_TPU_CACHE_DIR": str(tmp_path / "l2"),
+        "JAX_PLATFORMS": "cpu",
+    })
+    first = subprocess.run(
+        [sys.executable, "-c", script], env=env, capture_output=True, text=True,
+        timeout=300,
+    )
+    assert first.returncode == 0, first.stderr[-2000:]
+    assert "COMPILES 1" in first.stdout
+    second = subprocess.run(
+        [sys.executable, "-c", script], env=env, capture_output=True, text=True,
+        timeout=300,
+    )
+    assert second.returncode == 0, second.stderr[-2000:]
+    assert "COMPILES 0" in second.stdout
+
+
+def test_capacity_bucket_bounds_kernels(gen_on, no_faults):
+    """Growing past a bucket edge compiles exactly ONE new kernel (the new
+    capacity's chain) and the scheduler counts it ``grown``."""
+    with registry.capture():
+        gcount = registry.REGISTRY.counter("serving.generation")
+        model = gen.ToyModel()
+        sched = GenerationScheduler(model=model, slots=2, capacity=16)
+        h = sched.submit([1, 2, 3], max_new=20)  # 3 + 20 > 16: must grow
+        sched.run(max_steps=40)
+        assert h.result(timeout=0) == gen.generate_reference(
+            model, [1, 2, 3], max_new=20
+        )
+        assert gcount.get("grown") >= 1
+        assert sched.cache.capacity == 32
+
+
+# ------------------------------------------------------------- scheduler
+def test_scheduler_submit_validation(gen_on):
+    sched = GenerationScheduler(model=gen.ToyModel(), slots=1)
+    with pytest.raises(ValueError):
+        sched.submit([], max_new=4)
+    with pytest.raises(ValueError):
+        sched.submit([1], max_new=0)
+
+
+def test_scheduler_retirement_reasons(gen_on, no_faults):
+    model = gen.ToyModel()
+    ref = gen.generate_reference(model, [3, 1], max_new=10)
+    eos = ref[3]  # guaranteed to occur: deterministic greedy decode
+    with registry.capture():
+        sched = GenerationScheduler(model=model, slots=3, capacity=32)
+        h_eos = sched.submit([3, 1], max_new=10, eos=eos)
+        h_max = sched.submit([9], max_new=4)
+        h_dead = sched.submit([2, 7], max_new=50, deadline_steps=5)
+        sched.run(max_steps=80)
+        assert h_eos.finish_reason == "eos"
+        assert h_eos.tokens == gen.generate_reference(
+            model, [3, 1], max_new=10, eos=eos
+        )
+        assert h_max.finish_reason == "maxlen" and len(h_max.tokens) == 4
+        assert h_dead.finish_reason == "deadline" and len(h_dead.tokens) < 50
+        gc = registry.REGISTRY.counter("serving.generation")
+        for kind in ("retired-eos", "retired-maxlen", "retired-deadline"):
+            assert gc.get(kind) == 1
+        assert gc.get("admitted") == 3
+
+
+def test_scheduler_tenant_budget_defers_not_drops(monkeypatch, gen_on,
+                                                  no_faults):
+    """With tenancy armed, a tenant at its weighted slot share waits
+    (counted ``shed-budget`` once) while other tenants admit — and still
+    completes once a slot frees."""
+    monkeypatch.setenv("HEAT_TPU_TENANCY", "alpha:1,beta:1")
+    model = gen.ToyModel()
+    with registry.capture():
+        sched = GenerationScheduler(model=model, slots=2, capacity=32)
+        a1 = sched.submit([3], max_new=3, tenant="alpha")
+        a2 = sched.submit([5], max_new=3, tenant="alpha")  # over alpha's share
+        b1 = sched.submit([7], max_new=3, tenant="beta")
+        sched.step()
+        gc = registry.REGISTRY.counter("serving.generation")
+        assert gc.get("admitted") == 2  # a1 + b1; a2 deferred
+        assert gc.get("shed-budget") == 1
+        sched.run(max_steps=40)
+        for h, p in ((a1, [3]), (a2, [5]), (b1, [7])):
+            assert h.result(timeout=0) == gen.generate_reference(
+                model, p, max_new=3
+            )
+
+
+def test_scheduler_occupancy_gauge(gen_on, no_faults):
+    with registry.capture():
+        sched = GenerationScheduler(model=gen.ToyModel(), slots=4, capacity=16)
+        sched.submit([1], max_new=2)
+        sched.step()
+        g = registry.REGISTRY.gauge("serving.batch_occupancy")
+        assert g.get() == 25.0
+        assert sched.occupancy() == 25.0
+
+
+def test_handle_result_timeout(gen_on):
+    sched = GenerationScheduler(model=gen.ToyModel(), slots=1)
+    h = sched.submit([1], max_new=4)
+    with pytest.raises(TimeoutError):
+        h.result(timeout=0.01)  # never stepped
+    sched.run(max_steps=20)
+    assert len(h.result(timeout=0)) == 4
+
+
+# ---------------------------------------------------------------- loadgen
+def test_gen_trace_deterministic_and_digests():
+    t1, t2 = loadgen.gen_trace(seed=9, n=6), loadgen.gen_trace(seed=9, n=6)
+    assert t1 == t2
+    assert loadgen.gen_trace(seed=10, n=6) != t1
+    expected = loadgen.expected_generation(t1)
+    for req in t1:
+        key = loadgen.gen_request_key(req)
+        ref = gen.generate_reference(
+            gen.ToyModel.from_env(), req["prompt"],
+            max_new=req.get("max_new", 16), eos=req.get("eos"),
+        )
+        assert expected[key] == gen.digest_of_tokens(ref)
+
+
+# ------------------------------------------------------------- off = inert
+def test_off_knob_decode_is_eager_reference(monkeypatch, no_faults):
+    """Knob off: ``decode_step`` never records a fused chain — no
+    generation flush, no donation, logits concrete immediately."""
+    monkeypatch.delenv("HEAT_TPU_GENERATION", raising=False)
+    assert not gen.enabled()
+    with registry.capture():
+        model = gen.ToyModel()
+        cache = gen.KVCache.alloc(model, 2, capacity=16)
+        lg, cache = gen.decode_step(model, cache, np.asarray([1, 2], np.int32))
+        gen.read_logits(lg)
+        reasons = registry.REGISTRY.counter("fusion.flush_reason")
+        assert reasons.get("generation") == 0
+        assert registry.REGISTRY.counter("fusion.donated").get("buffers") == 0
+
+
+def test_off_knob_standard_workload_byte_identical(monkeypatch, no_faults):
+    """The off-inertness differential: a standard fused workload's results
+    and compile counts are byte-identical whether the generation knob is
+    absent or armed — arming it must not perturb non-generation flushes."""
+
+    def work():
+        x = ht.arange(48, dtype=ht.float32, split=0).reshape((6, 8))
+        y = ht.sin(x * 2.0 + 1.0) / 3.0
+        return np.asarray(y.larray).tobytes()
+
+    monkeypatch.delenv("HEAT_TPU_GENERATION", raising=False)
+    with registry.capture():
+        fusion.clear_cache()
+        base = work()
+        base_compiles = _compiles()
+    registry.reset()
+    monkeypatch.setenv("HEAT_TPU_GENERATION", "1")
+    with registry.capture():
+        fusion.clear_cache()
+        armed = work()
+        armed_compiles = _compiles()
+    assert base == armed
+    assert base_compiles == armed_compiles
+
+
+# --------------------------------------------------------- live wire legs
+@pytest.mark.slow
+def test_generation_streaming_live_fleet(tmp_path, gen_on):
+    """The streaming wire mode end-to-end: a real 2-worker ingress serves
+    the seeded generative trace over NDJSON with every wire digest AND
+    every client-recomputed digest matching the local reference oracle."""
+    from heat_tpu.serving.server import Ingress
+
+    ing = Ingress(
+        workers=2,
+        cache_dir=str(tmp_path / "cache"),
+        env={"JAX_PLATFORMS": "cpu", "HEAT_TPU_GENERATION": "1",
+             "HEAT_TPU_FUSION_DONATE": "force"},
+    ).start()
+    try:
+        reqs = loadgen.gen_trace(seed=13, n=10)
+        expected = loadgen.expected_generation(reqs)
+        stats = loadgen.run_generate(
+            ing.url(), reqs, concurrency=4, expected=expected
+        )
+        assert stats["mismatches"] == 0 and stats["errors"] == 0
+        assert stats["ok"] == len(reqs) and stats["tokens"] > 0
+        assert stats["decode_tokens_per_s"] > 0
+        assert stats["inter_token_p99_us"] >= stats["inter_token_p50_us"] >= 0
+    finally:
+        ing.stop()
+
+
+@pytest.mark.slow
+def test_generation_off_worker_answers_404(tmp_path, monkeypatch):
+    """Off-knob wire inertness: a fleet booted WITHOUT the generation knob
+    answers ``/v1/generate`` with 404 ``generation-off`` through the
+    ingress relay — the endpoint does not exist until armed."""
+    import urllib.error
+    import urllib.request
+
+    from heat_tpu.serving.server import Ingress
+
+    monkeypatch.delenv("HEAT_TPU_GENERATION", raising=False)
+    ing = Ingress(
+        workers=1,
+        cache_dir=str(tmp_path / "cache"),
+        env={"JAX_PLATFORMS": "cpu"},
+    ).start()
+    try:
+        req = urllib.request.Request(
+            ing.url("/v1/generate"),
+            data=json.dumps({"prompt": [1, 2], "max_new": 4}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=30)
+        assert exc.value.code == 404
+        body = json.loads(exc.value.read().decode())
+        assert body["reason"] == "generation-off"
+    finally:
+        ing.stop()
