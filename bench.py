@@ -930,6 +930,34 @@ def main():
                     "decode_throughput_valid": None,
                     "generation_error": repr(e)[:160],
                 }
+        # end-to-end fused-transformer anchors (ISSUE 20): the 16-step
+        # steady-state train window must record as ONE fused executable per
+        # step (executables_per_step == 1 with a zero kernels_compiled delta
+        # and zero collective flushes, parameter buffers re-donated every
+        # step), plus trained/inferred tokens-per-second and the flight
+        # recorder's cost-card modeled MFU for the window
+        transformer_anchors = {}
+        if os.environ.get("BENCH_FAST") != "1":
+            try:
+                _add_benchmarks_path()
+                from transformer_bench import bench_transformer
+
+                with _mev.span("bench.transformer"):
+                    transformer_anchors = bench_transformer()
+            except Exception as e:
+                # explicit null-valued keys, like the neighbouring benches: a
+                # crashed anchor must be distinguishable from a BENCH_FAST skip
+                transformer_anchors = {
+                    "train_tokens_per_s": None,
+                    "infer_tokens_per_s": None,
+                    "executables_per_step": None,
+                    "train_steady_compiles": None,
+                    "train_steady_donated": None,
+                    "train_steady_valid": None,
+                    "modeled_mfu_pct": None,
+                    "modeled_mfu_valid": None,
+                    "transformer_error": repr(e)[:160],
+                }
         telemetry = monitoring.report.telemetry()
     print(
         json.dumps(
@@ -979,6 +1007,7 @@ def main():
                 **io_pipe,
                 **tuning_anchors,
                 **generation_anchors,
+                **transformer_anchors,
                 "telemetry": telemetry,
             }
         )

@@ -1,0 +1,148 @@
+"""
+End-to-end fused-transformer train/infer anchors (ISSUE 20).
+
+Four anchors for the one-executable-per-step train loop, wired into
+``bench.py`` with the null-key crash-dict + ``*_valid`` gating discipline
+of the PR 4/5 anchors:
+
+* ``executables_per_step`` — the tentpole contract as a number: over a
+  16-step measured window after warmup, ``fusion.flushes`` delta divided
+  by the step count. Target **1.0**: every train step materializes as
+  exactly one fused program (forward + backward + momentum + update +
+  loss sink). ``train_steady_valid`` requires it to equal 1, the window's
+  ``fusion.kernels_compiled`` delta to be 0 (steady state recompiles
+  nothing), ``flush_reason{collective}`` to stay flat (the chain never
+  breaks on a collective), and a positive ``fusion.donated{steady_state}``
+  delta — the parameter-buffer re-donation proof.
+* ``train_tokens_per_s`` — trained tokens (batch × seq × steps) over the
+  measured window wall.
+* ``modeled_mfu_pct`` — the flight recorder's cost-card
+  ``modeled_util`` aggregated over the window (the run is made with
+  ``HEAT_TPU_FLIGHT=1`` so compile-time cost cards land): modeled flops /
+  wall / device peak, the bench-side MFU anchor. ``modeled_mfu_valid``
+  gates it on the recorder having produced a number.
+* ``infer_tokens_per_s`` — no-grad fused-forward throughput (one sink per
+  batch) over its own measured window.
+
+The bench runs on the CPU backend with ``HEAT_TPU_FUSION_DONATE=force``
+(the donation *bookkeeping* is exercised off-chip; on a TPU host the same
+path donates for real).
+
+Run: python benchmarks/transformer_bench.py
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+WARMUP_STEPS = 3
+WINDOW_STEPS = 16
+INFER_ITERS = 12
+BATCH, SEQ = 8, 16
+
+
+def bench_transformer():
+    from heat_tpu.monitoring import flight, registry
+    from heat_tpu.nn import transformer as tf
+
+    prev = {
+        var: os.environ.get(var)
+        for var in (
+            "HEAT_TPU_TRANSFORMER",
+            "HEAT_TPU_FUSION_DONATE",
+            "HEAT_TPU_FLIGHT",
+            "HEAT_TPU_CACHE_DIR",
+            "HEAT_TPU_SHAPE_BUCKETS",
+        )
+    }
+    os.environ["HEAT_TPU_TRANSFORMER"] = "1"
+    os.environ["HEAT_TPU_FUSION_DONATE"] = "force"
+    os.environ["HEAT_TPU_FLIGHT"] = "1"
+    # cost cards ride the L2 disk cache (the compiling process persists a
+    # card beside each entry; note_cost_card feeds the recorder) — the MFU
+    # anchor needs a cache dir even for a single-process run
+    cache_dir = tempfile.mkdtemp(prefix="tf_bench_cache_")
+    os.environ["HEAT_TPU_CACHE_DIR"] = cache_dir
+    os.environ.pop("HEAT_TPU_SHAPE_BUCKETS", None)
+    try:
+        with registry.capture():
+            compiles = registry.REGISTRY.counter("fusion.kernels_compiled")
+            reasons = registry.REGISTRY.counter("fusion.flush_reason")
+            donated = registry.REGISTRY.counter("fusion.donated")
+            flushes = registry.REGISTRY.counter("fusion.flushes")
+
+            cfg = tf.TransformerConfig.from_env()
+            state = tf.init_state(cfg)
+            rng = np.random.default_rng(1234)
+
+            def batch():
+                x = rng.integers(0, cfg.vocab, (BATCH, SEQ), dtype=np.int64)
+                return x.astype(np.int32), np.roll(x, -1, axis=1).astype(np.int32)
+
+            for _ in range(WARMUP_STEPS):
+                x, y = batch()
+                loss, state = tf.train_step(state, x, y)
+                tf.read_loss(loss)
+
+            before_compiles = compiles.get()
+            before_collective = reasons.get("collective")
+            before_steady = donated.get("steady_state")
+            before_flushes = flushes.get()
+            t0 = time.perf_counter()
+            for _ in range(WINDOW_STEPS):
+                x, y = batch()
+                loss, state = tf.train_step(state, x, y)
+                tf.read_loss(loss)
+            train_wall = time.perf_counter() - t0
+            steady_compiles = compiles.get() - before_compiles
+            collective_delta = reasons.get("collective") - before_collective
+            steady_donated = donated.get("steady_state") - before_steady
+            execs_per_step = (flushes.get() - before_flushes) / WINDOW_STEPS
+
+            mfu = flight.modeled_utilization()
+
+            x, _ = batch()
+            tf.read_logits(tf.infer_step(state, x))  # compile outside window
+            t0 = time.perf_counter()
+            for _ in range(INFER_ITERS):
+                tf.read_logits(tf.infer_step(state, x))
+            infer_wall = time.perf_counter() - t0
+
+        steady_valid = (
+            execs_per_step == 1.0
+            and steady_compiles == 0
+            and collective_delta == 0
+            and steady_donated > 0
+        )
+        return {
+            "train_tokens_per_s": round(WINDOW_STEPS * BATCH * SEQ / train_wall, 1),
+            "infer_tokens_per_s": round(INFER_ITERS * BATCH * SEQ / infer_wall, 1),
+            "executables_per_step": round(execs_per_step, 3),
+            "train_steady_compiles": int(steady_compiles),
+            "train_steady_donated": int(steady_donated),
+            "train_steady_valid": bool(steady_valid),
+            "modeled_mfu_pct": (
+                None if mfu is None else round(100.0 * float(mfu), 3)
+            ),
+            "modeled_mfu_valid": bool(mfu is not None),
+        }
+    finally:
+        for var, val in prev.items():
+            if val is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = val
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    print(json.dumps(bench_transformer(), sort_keys=True))

@@ -1723,6 +1723,30 @@ def defer_app(
     )
 
 
+#: ``(kind, opname) -> builder(static) -> fn`` — the cross-process rebuild
+#: hook for :func:`defer_app` nodes (ISSUE 20). A recording module registers
+#: one builder per opname it emits, returning the SAME memoized callable the
+#: live recorder would use for that static tuple, so the serving warmup can
+#: AOT-compile app/sink programs straight from the corpus instead of counting
+#: them as rebuild errors. Keyed by the skey fields only — builders must not
+#: close over live state.
+_APP_REBUILDERS: dict = {}
+
+
+def register_app_rebuilder(kind: str, opname: str, builder) -> None:
+    """Register the warmup rebuild hook for ``defer_app(kind=..., opname=...)``
+    nodes: ``builder(static) -> fn`` with ``fn`` the memoized jax-traceable
+    callable whose closure bakes exactly ``static``."""
+    _APP_REBUILDERS[(str(kind), str(opname))] = builder
+
+
+def app_rebuilder(kind: str, opname: str):
+    """The registered rebuild hook for ``(kind, opname)``, or None. The
+    warmup driver lazily imports ``heat_tpu.nn.<kind>`` before asking, so a
+    recording module's import-time registrations are visible cross-process."""
+    return _APP_REBUILDERS.get((str(kind), str(opname)))
+
+
 _CUM_FNS: dict = {}
 
 
@@ -2286,7 +2310,7 @@ def _topo(root: _Node):
     return order
 
 
-def _donatable(arr, owner_ref, out_avals) -> bool:
+def _donatable(arr, owner_ref, out_avals, wrappers: int = 1) -> bool:
     """A leaf buffer may be donated to the fused call iff its owning DNDarray
     is dead, nothing else references the buffer (strict refcount bound), the
     backend actually implements donation, and the buffer aliases one of the
@@ -2314,10 +2338,14 @@ def _donatable(arr, owner_ref, out_avals) -> bool:
     # between here and the flush add nothing). Measured invariant at this
     # site: a cleanly dead single-graph buffer sits at exactly 6 across graph
     # shapes (calibrated by the ISSUE 19 decode steady-state, where the old
-    # KV-cache buffer must donate every step). One more means a reference
-    # OUTSIDE this flush — a second graph's leaf, a user-held .larray, a live
-    # node.value — and the buffer must survive this call.
-    return sys.getrefcount(arr) <= 6
+    # KV-cache buffer must donate every step) — with ONE in-graph holder.
+    # A leaf consumed by several recorded nodes carries one live wrapper
+    # (_Leaf.array or a concrete _Node.value) per holder, so the bound
+    # widens by exactly the extra holders ``_build_flush`` counted (the
+    # ISSUE 20 train step feeds theta to grad AND loss). One more than that
+    # means a reference OUTSIDE this flush — a second graph's leaf, a
+    # user-held .larray — and the buffer must survive this call.
+    return sys.getrefcount(arr) <= 5 + max(1, int(wrappers))
 
 
 def _replay_fn(program, out_idx):
@@ -2601,15 +2629,22 @@ def _build_flush(root: _Node):
     ``(skey, specs, kwargs, cast_key)`` with baked constants carried as
     ``("c", type_name, value)`` instead of live type objects. It is ``None``
     whenever any node lacks a stable identity (collective nodes close over
-    mesh/comm objects) — such programs stay in-memory-only."""
+    mesh/comm objects) — such programs stay in-memory-only.
+
+    ``leaf_holders`` (parallel to ``leaf_arrays``) counts the DISTINCT live
+    wrapper objects holding each deduplicated buffer inside this graph — one
+    ``_Leaf`` per (node, operand) record site, or one concrete ``_Node`` —
+    so :func:`_donatable`'s refcount bound can widen for multi-consumer
+    leaves instead of silently refusing donation."""
     topo = _topo(root)
     index_of = {id(n): i for i, n in enumerate(topo)}
 
     leaf_ids: dict = {}
     leaf_arrays: list = []
     leaf_owners: list = []
+    leaf_holder_ids: list = []
 
-    def leaf_index(arr, owner):
+    def leaf_index(arr, owner, holder):
         key = id(arr)
         i = leaf_ids.get(key)
         if i is None:
@@ -2617,6 +2652,8 @@ def _build_flush(root: _Node):
             leaf_ids[key] = i
             leaf_arrays.append(arr)
             leaf_owners.append(owner)
+            leaf_holder_ids.append(set())
+        leaf_holder_ids[i].add(id(holder))
         return i
 
     program = []  # (fn, specs, kwargs, cast) per node, positional
@@ -2631,7 +2668,7 @@ def _build_flush(root: _Node):
         for a in n.args:
             if isinstance(a, _Node):
                 if a.value is not None:
-                    i = leaf_index(a.value, a.owner)
+                    i = leaf_index(a.value, a.owner, a)
                     specs.append(("l", i))
                     key_specs.append(("l", i))
                     stable_specs.append(("l", i))
@@ -2641,7 +2678,7 @@ def _build_flush(root: _Node):
                     key_specs.append(("n", index_of[id(a)]))
                     stable_specs.append(("n", index_of[id(a)]))
             elif isinstance(a, _Leaf):
-                i = leaf_index(a.array, a.owner)
+                i = leaf_index(a.array, a.owner, a)
                 specs.append(("l", i))
                 key_specs.append(("l", i))
                 stable_specs.append(("l", i))
@@ -2660,6 +2697,7 @@ def _build_flush(root: _Node):
         topo, index_of, program, key_prog,
         tuple(stable_prog) if stable_ok else None,
         leaf_arrays, leaf_owners, internal_rc,
+        tuple(len(h) for h in leaf_holder_ids),
     )
 
 
@@ -2688,7 +2726,7 @@ def materialize_for(d: DNDarray):
 
     (
         topo, index_of, program, key_prog, stable_prog,
-        leaf_arrays, leaf_owners, internal_rc,
+        leaf_arrays, leaf_owners, internal_rc, leaf_holders,
     ) = _build_flush(root)
 
     # ---- observability: execution flight recorder (ISSUE 13). Armed by
@@ -2761,10 +2799,27 @@ def materialize_for(d: DNDarray):
             for n in topo
         )
         if private:
+            # L2-persistable flushes (cache dir armed + stable program: this
+            # executable may be serialized and later DESERIALIZED by another
+            # process) never donate a MULTI-consumer leaf. A deserialized
+            # executable honors the baked-in input-output alias, but the
+            # reloaded call contract loses the donated-argument bookkeeping
+            # for a buffer the program also reads through a second node —
+            # input and aliased output then both own the allocation and it
+            # double-frees at teardown. Single-consumer aliases round-trip
+            # cleanly (the ISSUE 19 decode caches); in-memory-only flushes
+            # keep the widened multi-holder mask. The mask is part of the
+            # L2 digest, so every process derives the same rule and no
+            # entry with the unsafe alias ever lands on disk.
+            persistable = stable_prog is not None and bool(
+                os.environ.get("HEAT_TPU_CACHE_DIR", "").strip()
+            )
             donate_idx = []
             for i in range(len(leaf_arrays)):
+                if persistable and leaf_holders[i] > 1:
+                    continue
                 arr = leaf_arrays[i]
-                if _donatable(arr, leaf_owners[i], out_avals):
+                if _donatable(arr, leaf_owners[i], out_avals, leaf_holders[i]):
                     donate_idx.append(i)
                 del arr
             donate = tuple(donate_idx)
@@ -3153,9 +3208,10 @@ def flush_through(x: DNDarray, consumer, consumer_key, reason: str = "linalg"):
     if root is None or root.value is not None:
         return None
 
-    topo, index_of, program, key_prog, _stable, leaf_arrays, _owners, _rc = (
-        _build_flush(root)
-    )
+    (
+        topo, index_of, program, key_prog, _stable,
+        leaf_arrays, _owners, _rc, _holders,
+    ) = _build_flush(root)
     ridx = index_of[id(root)]
     chain_replay = _replay_fn(program, (ridx,))
 

@@ -122,6 +122,29 @@ def _decode_tile_pref(interpret: bool) -> int:
         return TILE_K
 
 
+def _train_tile_pref(interpret: bool):
+    """Preferred (tile_q, tile_k) of the causal TRAINING update (sq > 1 with
+    gradients flowing — the ISSUE 20 transformer block), or None to keep the
+    generic preference. Training sequences are long and causal, so half the
+    score tiles are masked out: the winning tile trades differently than the
+    bidirectional square update's, hence its own knob
+    (``pallas.flash.train_tile``, ISSUE 18 discipline — one env read when
+    tuning is off, bit-identical rails either way)."""
+    from ... import tuning as _tuning
+
+    if not _tuning.enabled():
+        return None
+    try:
+        tq, tk = _tuning.lookup(
+            "pallas.flash.train_tile", context={"interpret": bool(interpret)}
+        )
+        return int(tq), int(tk)
+    except (KeyboardInterrupt, SystemExit):
+        raise
+    except Exception:
+        return None
+
+
 @functools.lru_cache(maxsize=128)
 def _update_call(bh, sq, sk, d, causal, scale, interpret, tq_pref=TILE_Q, tk_pref=TILE_K,
                  per_bh_qpos=False):
@@ -192,7 +215,8 @@ def _update_call(bh, sq, sk, d, causal, scale, interpret, tq_pref=TILE_Q, tk_pre
     )
 
 
-def tile_update(q, k, v, m, l, o, *, scale, causal, q_pos, k_pos, interpret):
+def tile_update(q, k, v, m, l, o, *, scale, causal, q_pos, k_pos, interpret,
+                train=False):
     """One online-softmax update of the running triple with a (K, V) block.
 
     ``q``: (bh, sq, d) f32; ``k``/``v``: (bh, sk, d); ``m``/``l``: (bh, sq)
@@ -200,12 +224,19 @@ def tile_update(q, k, v, m, l, o, *, scale, causal, q_pos, k_pos, interpret):
     positions, traced values allowed. ``q_pos`` is shape (sq,) — one row
     vector shared across batch·head — or (bh, sq): per-(batch·head)
     positions, the ragged decode case (ISSUE 19) where every request masks
-    at its own cache length. Returns the updated ``(m, l, o)``."""
+    at its own cache length. ``train=True`` marks the causal training-shape
+    call (ISSUE 20): under ``HEAT_TPU_TUNING=1`` it consults the
+    ``pallas.flash.train_tile`` knob instead of the generic tile preference.
+    Returns the updated ``(m, l, o)``."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     qp = jnp.asarray(q_pos, jnp.int32)
     per_bh = qp.ndim == 2 and qp.shape[0] != 1
     tq_pref, tk_pref = _tile_prefs(bool(interpret))
+    if train and sq > 1:
+        pref = _train_tile_pref(bool(interpret))
+        if pref is not None:
+            tq_pref, tk_pref = pref
     if sq == 1:
         tk_pref = _decode_tile_pref(bool(interpret))
     call = _update_call(
@@ -219,12 +250,13 @@ def tile_update(q, k, v, m, l, o, *, scale, causal, q_pos, k_pos, interpret):
     return call(q, k32, v32, qp, kp, m, l, o)
 
 
-def attention_local(q, k, v, *, causal, scale, interpret):
+def attention_local(q, k, v, *, causal, scale, interpret, train=False):
     """Single-pass flash attention over whole (K, V) via one init → update →
     normalize round of the ring-step kernel. Operands are
     ``(batch, seq, heads, head_dim)`` like
     :func:`~heat_tpu.nn.scaled_dot_product_attention`; returns the attention
-    output in the same layout and ``q``'s dtype."""
+    output in the same layout and ``q``'s dtype. ``train=True`` routes the
+    tile preference through the training-shape knob (ISSUE 20)."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
     bh = b * h
@@ -241,6 +273,7 @@ def attention_local(q, k, v, *, causal, scale, interpret):
     m, l, acc = tile_update(
         qm, merge(k), merge(v), m0, l0, o0,
         scale=scale, causal=causal, q_pos=q_pos, k_pos=k_pos, interpret=interpret,
+        train=train,
     )
     out = acc / l[..., None]
     out = jnp.transpose(out.reshape(b, h, sq, d), (0, 2, 1, 3))

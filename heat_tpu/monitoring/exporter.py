@@ -142,6 +142,7 @@ CATALOG: Tuple[Tuple[str, str], ...] = (
     ("io.seconds", "histogram"),
     ("jit.compile_seconds", "histogram"),
     ("jit.compiles", "counter"),
+    ("nn.transformer", "counter"),
     ("ops.dispatch", "counter"),
     ("ops.dtype_fallback", "counter"),
     ("pallas.dispatch", "counter"),

@@ -450,6 +450,15 @@ def serving_batch_occupancy(pct: float) -> None:
     REGISTRY.gauge("serving.batch_occupancy").set(float(pct))
 
 
+def transformer_event(kind: str, n: int = 1) -> None:
+    """Fused-transformer step accounting (``nn.transformer``, ISSUE 20;
+    kind: step-fused — a train step recorded as the one-executable chain /
+    step-eager — the per-op reference ran instead (knob off or chain
+    refused) / infer-fused / infer-eager — same split for the no-grad
+    forward)."""
+    REGISTRY.counter("nn.transformer").inc(int(n), label=kind)
+
+
 def tuning_event(kind: str, n: int = 1) -> None:
     """One autotuning lookup outcome (``tuning.lookup``, ISSUE 18; kind:
     probed — a timed micro-probe or data miner ran; served — a measured

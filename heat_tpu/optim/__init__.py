@@ -11,6 +11,7 @@ import optax as _optax
 
 from .dp_optimizer import DASO, DataParallelOptimizer
 from .utils import DetectMetricPlateau
+from . import fused_sgd
 from . import lr_scheduler
 from . import utils
 

@@ -1,0 +1,34 @@
+"""
+Packed momentum-SGD: the optimizer math of the one-executable train step
+(ISSUE 20).
+
+The fused transformer keeps ALL parameters in one flat ``theta`` vector and
+the velocity in a same-shaped ``mu`` — so the whole optimizer is two
+vector expressions whose outputs shape/dtype-match their donated inputs
+exactly. These are the jax-traceable primitives
+:mod:`heat_tpu.nn.transformer` bakes into its recorded ``tf-momentum`` /
+``tf-update`` nodes; they accumulate in f32 whatever the storage dtype
+(the classic bf16-training discipline) and are exposed here so other
+packed trainers can reuse them without importing the transformer.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["momentum_update", "apply_update"]
+
+
+def momentum_update(mu, grad, momentum: float):
+    """``mu' = momentum · mu + grad`` — f32 accumulate, stored back in
+    ``mu``'s dtype (the donation alias must match bit-exactly)."""
+    return (
+        mu.astype(jnp.float32) * float(momentum) + grad.astype(jnp.float32)
+    ).astype(mu.dtype)
+
+
+def apply_update(theta, mu2, lr: float):
+    """``theta' = theta - lr · mu'`` — f32 math, ``theta``'s dtype out."""
+    return (
+        theta.astype(jnp.float32) - float(lr) * mu2.astype(jnp.float32)
+    ).astype(theta.dtype)

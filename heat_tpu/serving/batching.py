@@ -189,7 +189,7 @@ def _plan_for(x) -> Optional[_Plan]:
     try:
         (
             _topo, index_of, program, _key_prog, stable_prog,
-            leaf_arrays, _owners, _rc,
+            leaf_arrays, _owners, _rc, _holders,
         ) = _fusion._build_flush(root)
     except (KeyboardInterrupt, SystemExit):
         raise

@@ -126,6 +126,26 @@ def _node_fn(skey):
             None if dtstr is None else np.dtype(dtstr),
             F._precision_from_token(ptok),
         )
+    if tag in ("app", "sink") and len(skey) == 4:
+        # a defer_app node (ISSUE 19/20): (tag, kind, opname, static). The
+        # recording module (heat_tpu.nn.<kind>) registers its rebuilders at
+        # import time; import it lazily so a warmup process that never saw
+        # the recorder still rebuilds its corpus entries.
+        import importlib
+
+        _, kind, opname, static = skey
+        builder = F.app_rebuilder(kind, opname)
+        if builder is None:
+            try:
+                importlib.import_module(f"heat_tpu.nn.{kind}")
+            except ImportError:
+                raise _Unbuildable(
+                    f"no recorder module for app kind {kind!r}"
+                ) from None
+            builder = F.app_rebuilder(kind, opname)
+        if builder is None:
+            raise _Unbuildable(f"no rebuilder for app node {kind!r}:{opname!r}")
+        return builder(tuple(static) if isinstance(static, list) else static)
     if tag == "sink":
         _, _kind, opname, pre, axis, keepdims, static_items, dyn_names, nanfix = skey
         return F._sink_fn_for(

@@ -200,6 +200,90 @@ register(
 )
 
 
+def _flash_train_compute(ctx):
+    from ..core.pallas import flash as _flash
+
+    import jax.numpy as jnp
+
+    interpret = bool((ctx or {}).get("interpret", False))
+    bh, s, d = 1, 512, 64  # causal training shape: half the tiles masked
+    q = _seeded((bh, s, d), np.float32, 21)
+    k = _seeded((bh, s, d), np.float32, 22)
+    v = _seeded((bh, s, d), np.float32, 23)
+    qp = jnp.arange(s, dtype=jnp.int32).reshape(1, s)
+    kp = jnp.arange(s, dtype=jnp.int32).reshape(1, s)
+    m0 = jnp.full((bh, s), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((bh, s), jnp.float32)
+    o0 = jnp.zeros((bh, s, d), jnp.float32)
+
+    def build(tile):
+        tq, tk = tile
+
+        def _b():
+            call = _flash._update_call(bh, s, s, d, True, 1.0, interpret, tq, tk)
+            return lambda: call(q, k, v, qp, kp, m0, l0, o0)
+
+        return _b
+
+    grid = get("pallas.flash.train_tile").grid
+    return _probe.pick([(t, build(t)) for t in grid])
+
+
+register(
+    Knob(
+        name="pallas.flash.train_tile",
+        kind="timed",
+        grid=tuple((tq, tk) for tq in _TILE_GRID for tk in _TILE_GRID),
+        default=(128, 128),
+        compute=_flash_train_compute,
+        normalize=_flash_normalize,
+        doc="flash CAUSAL training (tile_q, tile_k) block shape (ISSUE 20)",
+    )
+)
+
+
+def _mlp_tile_compute(ctx):
+    import jax
+
+    b, dim, hidden = 8, 256, 1024  # a transformer-block MLP at toy-plus scale
+    x = _seeded((b * 64, dim), np.float32, 31)
+    w1 = _seeded((dim, hidden), np.float32, 32)
+    w2 = _seeded((hidden, dim), np.float32, 33)
+
+    def build(tile):
+        from ..nn import transformer as _tf
+
+        fn = jax.jit(lambda a: _tf._mlp_chunked(a, w1, w2, tile))
+
+        def _b():
+            return lambda: fn(x)
+
+        return _b
+
+    grid = get("transformer.mlp.tile").grid
+    return _probe.pick([(t, build(t)) for t in grid])
+
+
+def _mlp_tile_normalize(v):
+    t = int(v)
+    if not (8 <= t <= 4096 and t % 8 == 0):
+        raise ValueError(f"transformer mlp tile out of rails: {t}")
+    return t
+
+
+register(
+    Knob(
+        name="transformer.mlp.tile",
+        kind="timed",
+        grid=(64, 128, 256, 512),
+        default=128,
+        compute=_mlp_tile_compute,
+        normalize=_mlp_tile_normalize,
+        doc="transformer fused-MLP GEMM row-block height (ISSUE 20)",
+    )
+)
+
+
 def _ragged_compute(ctx):
     from ..core.pallas import ragged as _ragged
 

@@ -1,0 +1,240 @@
+#!/usr/bin/env python
+"""
+CI transformer smoke (ISSUE 20): the one-executable-per-step train loop,
+end to end, plus the elastic mid-train choreography across real OS
+processes.
+
+Leg 1 — fused steady state, in process: a fused train run must record ONE
+flush per step with a flat ``fusion.kernels_compiled`` counter after
+warmup, zero collective flush reasons, parameter/momentum buffers
+re-donated every step, a falling loss, and fused-vs-eager loss parity at
+f32 tolerance.
+
+Leg 2 — elastic kill -9, across processes: two workers train the fused
+loop against a shared heartbeat directory; the victim takes a real
+``kill -9`` mid-train (no atexit, its heartbeat file freezes), the
+survivor's per-step supervisor poll detects the loss, drains the pending
+fused chain, checkpoints through the preemption-safe manager, and exits
+``ELASTIC_RESTART_EXIT``; the relaunched SHRUNK (1-process) run restores
+the latest valid checkpoint at the saved step and keeps training.
+
+Exit 0 clean; 1 on any failed assertion. Usage:
+
+    python scripts/transformer_smoke.py [--steps N] [--no-kill]
+"""
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_WORKER = textwrap.dedent(
+    """
+    import os, signal, sys, time
+
+    import numpy as np
+
+    sys.path.insert(0, os.environ["TF_SMOKE_REPO"])
+    from heat_tpu.nn import transformer as tf
+    from heat_tpu.robustness import elastic
+    from heat_tpu.utils.checkpoint import CheckpointManager
+
+    pid = int(sys.argv[1]); nprocs = int(sys.argv[2])
+    hb, ck, steps = sys.argv[3], sys.argv[4], int(sys.argv[5])
+
+    cfg = tf.TransformerConfig()
+    state = tf.init_state(cfg)
+    rng = np.random.default_rng(1234)
+
+    def batch():
+        x = rng.integers(0, cfg.vocab, (4, 16), dtype=np.int64)
+        return x.astype(np.int32), np.roll(x, -1, axis=1).astype(np.int32)
+
+    if nprocs > 1 and pid == 1:
+        # the victim: beats while training, then takes a real kill -9 —
+        # no atexit, no flush, the heartbeat file freezes mid-run
+        sup = elastic.ElasticSupervisor(hb, process_id=1, num_processes=2)
+        for _ in range(3):
+            sup.beat()
+            x, y = batch()
+            loss, state = tf.train_step(state, x, y)
+            tf.read_loss(loss)
+            time.sleep(0.02)
+        sup.beat()
+        print("victim about to die", flush=True)
+        os.kill(os.getpid(), signal.SIGKILL)
+    elif nprocs > 1:
+        # the survivor: full supervision; the generous miss threshold
+        # tolerates scheduler skew (a live-but-slow peer resets the count
+        # on its next beat; only a dead one misses 40 straight)
+        mgr = CheckpointManager(ck)
+        sup = elastic.ElasticSupervisor(
+            hb, process_id=0, num_processes=2, miss_threshold=40,
+            manager=mgr,
+        )
+        try:
+            for _ in range(10_000):
+                sup.check(state.checkpoint_state, state.step)
+                x, y = batch()
+                loss, state = tf.train_step(state, x, y)
+                tf.read_loss(loss)
+                time.sleep(0.01)
+            print("survivor never saw the loss", flush=True)
+            sys.exit(3)
+        except elastic.PeerLostError as e:
+            print(f"survivor saved step {e.saved_step}", flush=True)
+            sys.exit(elastic.ELASTIC_RESTART_EXIT)
+    else:
+        # the shrunk relaunch: restore the drained checkpoint, keep training
+        mgr = CheckpointManager(ck)
+        restored = mgr.restore_latest_valid(state.checkpoint_state())
+        state = tf.TrainState.from_checkpoint(restored, cfg)
+        start = state.step
+        for _ in range(steps):
+            x, y = batch()
+            loss, state = tf.train_step(state, x, y)
+            val = tf.read_loss(loss)
+        print(f"shrunk resumed from {start} reached {state.step} "
+              f"loss {val:.4f}", flush=True)
+        sys.exit(0 if (start >= 1 and state.step == start + steps
+                       and np.isfinite(val)) else 4)
+    """
+)
+
+
+def leg_fused(check, steps: int) -> None:
+    import numpy as np
+
+    from heat_tpu.core import fusion
+    from heat_tpu.monitoring import registry
+    from heat_tpu.nn import transformer as tf
+
+    with registry.capture():
+        compiles = registry.REGISTRY.counter("fusion.kernels_compiled")
+        reasons = registry.REGISTRY.counter("fusion.flush_reason")
+        donated = registry.REGISTRY.counter("fusion.donated")
+        flushes = registry.REGISTRY.counter("fusion.flushes")
+
+        cfg = tf.TransformerConfig()
+        state = tf.init_state(cfg)
+        rng = np.random.default_rng(7)
+        x = rng.integers(0, cfg.vocab, (4, 16), dtype=np.int64).astype(np.int32)
+        y = np.roll(x, -1, axis=1).astype(np.int32)
+
+        losses, per_step = [], []
+        for _ in range(steps):
+            c0, f0 = compiles.get(), flushes.get()
+            loss, state = tf.train_step(state, x, y)
+            losses.append(tf.read_loss(loss))
+            per_step.append((compiles.get() - c0, flushes.get() - f0))
+
+        check(all(c == 0 for c, _ in per_step[2:]),
+              "zero steady-state compiles")
+        check(all(f == 1 for _, f in per_step),
+              "one fused executable per step")
+        check(reasons.get("collective") == 0, "zero collective flushes")
+        check(donated.get("steady_state") >= 2 * (steps - 2),
+              "theta+mu re-donated per steady step")
+        check(losses[-1] < losses[0] and np.isfinite(losses[-1]),
+              "loss falls and stays finite")
+
+        # fused-vs-eager parity on a fresh model (the differential oracle)
+        fusion.clear_cache()
+        ref = tf.init_state(cfg)
+        prev = os.environ.pop("HEAT_TPU_TRANSFORMER")
+        try:
+            for _ in range(3):
+                loss, ref = tf.train_step(ref, x, y)
+                eager_val = tf.read_loss(loss)
+        finally:
+            os.environ["HEAT_TPU_TRANSFORMER"] = prev
+        check(abs(eager_val - losses[2]) < 1e-5,
+              "fused == eager loss at f32 tolerance")
+
+
+def leg_elastic(check, tmp: str) -> None:
+    from heat_tpu.robustness import elastic
+
+    worker = os.path.join(tmp, "worker.py")
+    with open(worker, "w") as f:
+        f.write(_WORKER)
+    hb = os.path.join(tmp, "hb")
+    ck = os.path.join(tmp, "ck")
+    os.makedirs(hb, exist_ok=True)
+    env = dict(os.environ, TF_SMOKE_REPO=REPO, JAX_PLATFORMS="cpu",
+               HEAT_TPU_TRANSFORMER="1")
+
+    def spawn(pid, nprocs, steps=4):
+        return subprocess.Popen(
+            [sys.executable, worker, str(pid), str(nprocs), hb, ck,
+             str(steps)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+
+    procs = [spawn(0, 2), spawn(1, 2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    check(procs[1].returncode == -signal.SIGKILL,
+          f"victim died by SIGKILL (rc={procs[1].returncode})")
+    check(procs[0].returncode == elastic.ELASTIC_RESTART_EXIT,
+          f"survivor exited ELASTIC_RESTART_EXIT (rc={procs[0].returncode})")
+    check("survivor saved step" in outs[0],
+          "survivor drained and saved mid-train")
+
+    shrunk = spawn(0, 1, steps=4)
+    out, _ = shrunk.communicate(timeout=600)
+    check(shrunk.returncode == 0,
+          f"shrunk relaunch restored and trained (rc={shrunk.returncode})")
+    print(textwrap.indent(out.strip(), "     "))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--no-kill", action="store_true")
+    args = p.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("HEAT_TPU_MONITORING", "1")
+    os.environ["HEAT_TPU_TRANSFORMER"] = "1"
+    os.environ["HEAT_TPU_FUSION_DONATE"] = "force"
+    for var in ("HEAT_TPU_FAULT_PLAN", "HEAT_TPU_CHAOS",
+                "HEAT_TPU_BREAKER_FORCE_OPEN", "HEAT_TPU_AUDIT_RATE"):
+        os.environ.pop(var, None)
+
+    failures = []
+
+    def check(ok, what):
+        print(("ok   " if ok else "FAIL ") + what)
+        if not ok:
+            failures.append(what)
+
+    leg_fused(check, args.steps)
+    if not args.no_kill:
+        with tempfile.TemporaryDirectory(prefix="transformer-smoke-") as tmp:
+            leg_elastic(check, tmp)
+
+    if failures:
+        print(f"transformer smoke: {len(failures)} failure(s)")
+        return 1
+    print("transformer smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

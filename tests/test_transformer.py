@@ -1,0 +1,535 @@
+"""
+End-to-end fused-transformer suite (``heat_tpu/nn/transformer.py`` +
+``heat_tpu/optim/fused_sgd.py`` + the wrapper-aware donation and
+app-rebuilder rails, ISSUE 20).
+
+Guarantees pinned here:
+
+* **One fused executable per step** (the tentpole): a steady-state train
+  step materializes as exactly ONE flush with a flat
+  ``fusion.kernels_compiled`` counter after warmup, zero
+  ``flush_reason{collective}`` ticks, and ``fusion.donated{steady_state}``
+  growing by exactly 2 per step — the packed ``theta``/``mu`` buffers
+  re-donating on every trace-cache hit (the multi-consumer leaf case the
+  widened ``_donatable`` wrapper bound admits).
+* **Fused ≡ eager** (the acceptance bar): losses and logits match the
+  per-op eager reference (``HEAT_TPU_TRANSFORMER`` unset — the SAME
+  memoized callables dispatched standalone) across split {None, 0, 1} ×
+  even/ragged × f32/bf16, within ``integrity.tolerance_for``; the same
+  matrix runs clean (zero mismatches) under the standing shadow-replay
+  audit at rate 1 with action=raise.
+* **Cross-process warm start**: the train-step signature lands in the L2
+  shape corpus; ``serving.warmup`` rebuilds it in a process that never
+  imported the recorder (the app-rebuilder registry), and a restarted
+  worker replaying the loop against the warmed cache compiles ZERO kernels.
+* **Tuning rails**: the ``transformer.mlp.tile`` / ``pallas.flash.train_tile``
+  knobs enforce their rails, and with the gate unset no consumer ever
+  reaches ``tuning.lookup`` (the lookup-bomb inertness contract).
+* **Default off**: with ``HEAT_TPU_TRANSFORMER`` unset, ``train_step``
+  runs the eager reference (no transformer flush, no donation tick) and a
+  standard fused workload is byte-identical whether or not the knob exists.
+
+The heavy train-loop and DASO legs are marked ``slow`` to protect the
+tier-1 wall-clock budget; the CI ``transformer-smoke`` job runs the WHOLE
+marker (slow included) plus the elastic kill -9 smoke script.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import heat_tpu as ht
+from heat_tpu.core import factories, fusion
+from heat_tpu.monitoring import registry
+from heat_tpu.nn import transformer as tf
+from heat_tpu.robustness import faultinject, integrity
+
+pytestmark = pytest.mark.transformer
+
+#: tiny geometry for the differential matrices (one block keeps the
+#: value_and_grad compile cheap on the CPU tier-1 host)
+SMALL = dict(vocab=32, dim=16, heads=2, depth=1, mlp_ratio=2, max_seq=16)
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """Fresh counters/caches; the transformer knob is deliberately left at
+    its default (off) — engagement-asserting tests pin it ON themselves
+    (the PR 5/8 pin-the-gate precedent)."""
+    registry.reset()
+    monkeypatch.setenv("HEAT_TPU_FUSION", "1")
+    monkeypatch.delenv("HEAT_TPU_TRANSFORMER", raising=False)
+    monkeypatch.delenv("HEAT_TPU_TRANSFORMER_SEED", raising=False)
+    monkeypatch.delenv("HEAT_TPU_CACHE_DIR", raising=False)
+    monkeypatch.delenv("HEAT_TPU_SHAPE_BUCKETS", raising=False)
+    monkeypatch.delenv("HEAT_TPU_TUNING", raising=False)
+    monkeypatch.delenv("HEAT_TPU_FLIGHT", raising=False)
+    fusion.clear_cache()
+    yield
+    fusion.clear_cache()
+    registry.reset()
+
+
+@pytest.fixture
+def no_faults(monkeypatch):
+    """Pin injection/chaos/breakers/audit off for count-asserting tests
+    (the PR 6/9/12 precedent)."""
+    from heat_tpu.robustness import breaker
+
+    monkeypatch.delenv("HEAT_TPU_FAULT_PLAN", raising=False)
+    monkeypatch.delenv("HEAT_TPU_CHAOS", raising=False)
+    monkeypatch.delenv("HEAT_TPU_BREAKER_FORCE_OPEN", raising=False)
+    monkeypatch.delenv("HEAT_TPU_AUDIT_RATE", raising=False)
+    monkeypatch.delenv("HEAT_TPU_AUDIT_ACTION", raising=False)
+    faultinject.clear()
+    breaker.reset()
+    fusion.clear_cache()
+
+
+@pytest.fixture
+def tf_on(monkeypatch):
+    monkeypatch.setenv("HEAT_TPU_TRANSFORMER", "1")
+    # CPU test host: force admits the donation mask so the bookkeeping
+    # (and its refcount tripwire) is exercised; jax ignores the mask on
+    # CPU with a warning and results are bit-identical
+    monkeypatch.setenv("HEAT_TPU_FUSION_DONATE", "force")
+
+
+def _compiles() -> int:
+    return registry.REGISTRY.counter("fusion.kernels_compiled").get()
+
+
+def _batch(cfg, B, S, seed=5, split=None):
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, cfg.vocab, (B, S), dtype=np.int64).astype(np.int32)
+    y = np.roll(x, -1, axis=1).astype(np.int32)
+    if split is None:
+        return x, y
+    return factories.array(x, split=split), factories.array(y, split=split)
+
+
+# ------------------------------------------------------------------ config
+def test_config_validation():
+    with pytest.raises(ValueError):
+        tf.TransformerConfig(dtype="float16")
+    with pytest.raises(ValueError):
+        tf.TransformerConfig(dim=30, heads=4)
+    cfg = tf.TransformerConfig(**SMALL)
+    assert cfg.head_dim == 8
+    assert tf.param_count(cfg) > 0
+
+
+def test_layout_contiguous_and_tree_views_match_packed():
+    cfg = tf.TransformerConfig(**SMALL)
+    lay, total = tf._layout(cfg.vocab, cfg.dim, cfg.heads, cfg.depth,
+                            cfg.mlp_ratio, cfg.max_seq)
+    off = 0
+    for _name, shape, o, size in lay:
+        assert o == off and size == int(np.prod(shape))
+        off += size
+    assert off == total == tf.param_count(cfg)
+    # the DP/DASO pytree is a view of the SAME seeded packed init
+    flat = tf._init_flat(cfg)
+    tree = tf.init_tree(cfg)
+    for name, shape, o, size in lay:
+        np.testing.assert_array_equal(
+            np.asarray(tree[name], np.float32),
+            flat[o:o + size].reshape(shape),
+        )
+
+
+# -------------------------------------------------------- fused ≡ eager
+def _matrix_params(fast):
+    """The full split {None,0,1} × even/ragged × f32/bf16 matrix; combos
+    outside ``fast`` ride the CI ``transformer-smoke`` job (slow-marked)
+    to protect the tier-1 wall clock — the fast subset keeps one fused
+    even leg per dtype and the ragged eager-fallthrough leg in tier-1."""
+    out = []
+    for split in (None, 0, 1):
+        for shape, sid in (((8, 16), "even"), ((3, 11), "ragged")):
+            for dtype, did in (("float32", "f32"), ("bfloat16", "bf16")):
+                combo = (split, sid, did)
+                out.append(pytest.param(
+                    split, shape, dtype,
+                    id=f"{did}-{sid}-{split}",
+                    marks=() if combo in fast else (pytest.mark.slow,),
+                ))
+    return out
+
+
+_DIFF_FAST = {(None, "even", "f32"), (None, "ragged", "f32"),
+              (None, "even", "bf16")}
+_AUDIT_FAST = {(None, "even", "f32"), (None, "even", "bf16")}
+
+
+def _run_matrix(cfg, split, B, S, steps=2):
+    state = tf.init_state(cfg)
+    x, y = _batch(cfg, B, S, split=split)
+    losses = []
+    for _ in range(steps):
+        loss, state = tf.train_step(state, x, y)
+        losses.append(tf.read_loss(loss))
+    logits = tf.read_logits(tf.infer_step(state, x))
+    return losses, logits
+
+
+@pytest.mark.parametrize("split,shape,dtype", _matrix_params(_DIFF_FAST))
+def test_fused_matches_eager_matrix(monkeypatch, no_faults, split, shape,
+                                    dtype):
+    """The acceptance differential: the fused one-executable step's loss
+    trajectory and the no-grad logits match the eager per-op reference
+    within the PR 12 comparator tolerances (exact where the recorded and
+    eager paths coincide)."""
+    cfg = tf.TransformerConfig(dtype=dtype, **SMALL)
+    B, S = shape
+    monkeypatch.setenv("HEAT_TPU_TRANSFORMER", "1")
+    monkeypatch.setenv("HEAT_TPU_FUSION_DONATE", "force")
+    fused_losses, fused_logits = _run_matrix(cfg, split, B, S)
+    fusion.clear_cache()
+    monkeypatch.delenv("HEAT_TPU_TRANSFORMER")
+    eager_losses, eager_logits = _run_matrix(cfg, split, B, S)
+    tol = integrity.tolerance_for(cfg.jnp_dtype) or 1e-6
+    np.testing.assert_allclose(fused_losses, eager_losses, rtol=tol, atol=tol)
+    np.testing.assert_allclose(
+        fused_logits, eager_logits, rtol=tol,
+        atol=tol * max(1.0, float(np.max(np.abs(eager_logits)))),
+    )
+
+
+# -------------------------------------------- one executable per step
+def test_steady_state_one_executable_zero_compiles(tf_on, no_faults):
+    """The tentpole regression: after warmup every train step is ONE flush,
+    ZERO fresh compiles, ZERO collective chain breaks — and the packed
+    theta+mu pair re-donates (exactly 2 buffers) on every trace-cache hit."""
+    with registry.capture():
+        compiles = registry.REGISTRY.counter("fusion.kernels_compiled")
+        flushes = registry.REGISTRY.counter("fusion.flushes")
+        reasons = registry.REGISTRY.counter("fusion.flush_reason")
+        donated = registry.REGISTRY.counter("fusion.donated")
+        tfc = registry.REGISTRY.counter("nn.transformer")
+
+        cfg = tf.TransformerConfig(**SMALL)
+        state = tf.init_state(cfg)
+        x, y = _batch(cfg, 4, 16)
+        per_step = []
+        losses = []
+        for _ in range(8):
+            c0, f0, d0 = compiles.get(), flushes.get(), donated.get("steady_state")
+            loss, state = tf.train_step(state, x, y)
+            losses.append(tf.read_loss(loss))
+            per_step.append(
+                (compiles.get() - c0, flushes.get() - f0,
+                 donated.get("steady_state") - d0)
+            )
+        assert all(c == 0 for c, _, _ in per_step[2:]), per_step
+        assert all(f == 1 for _, f, _ in per_step), per_step
+        # the re-donation regression, extended to the train loop (PR 19
+        # precedent): exactly theta+mu per steady step, never less
+        assert [d for _, _, d in per_step[2:]] == [2] * 6, per_step
+        assert reasons.get("collective") == 0
+        assert reasons.get("transformer") == 8
+        assert tfc.get("step-fused") == 8 and tfc.get("step-eager") == 0
+        assert losses[-1] < losses[0] and np.isfinite(losses[-1])
+
+
+def test_infer_steady_state_zero_compiles(tf_on, no_faults):
+    with registry.capture():
+        cfg = tf.TransformerConfig(**SMALL)
+        state = tf.init_state(cfg)
+        x, _ = _batch(cfg, 4, 16)
+        tf.read_logits(tf.infer_step(state, x))
+        before = _compiles()
+        out = [tf.read_logits(tf.infer_step(state, x)) for _ in range(3)]
+        assert _compiles() == before
+        for o in out[1:]:
+            assert o.tobytes() == out[0].tobytes()
+
+
+def test_checkpoint_roundtrip_resumes_identically(tf_on, no_faults):
+    """PR 6 wiring: a state serialized mid-train and restored continues
+    with a bit-identical packed vector and the same loss trajectory."""
+    cfg = tf.TransformerConfig(**SMALL)
+    state = tf.init_state(cfg)
+    x, y = _batch(cfg, 4, 16)
+    for _ in range(3):
+        loss, state = tf.train_step(state, x, y)
+        tf.read_loss(loss)
+    snap = state.checkpoint_state()
+    restored = tf.TrainState.from_checkpoint(snap, cfg)
+    assert restored.step == state.step == 3
+    np.testing.assert_array_equal(
+        np.asarray(restored.theta.larray, np.float32),
+        np.asarray(state.theta.larray, np.float32),
+    )
+    la, ra = state, restored
+    for _ in range(2):
+        l1, la = tf.train_step(la, x, y)
+        l2, ra = tf.train_step(ra, x, y)
+        assert abs(tf.read_loss(l1) - tf.read_loss(l2)) < 1e-6
+
+
+# ------------------------------------------------------------- audit leg
+@pytest.mark.parametrize("split,shape,dtype", _matrix_params(_AUDIT_FAST))
+def test_audit_clean_train_step_zero_mismatches(monkeypatch, split, shape,
+                                                dtype):
+    """The shadow-replay correctness leg: a full fused transformer step
+    (grad + momentum + update + loss sink) under ``HEAT_TPU_AUDIT_RATE=1``
+    with ``ACTION=raise`` completes with ZERO mismatches — any divergence
+    between the fused program and its eager replay raises."""
+    monkeypatch.setenv("HEAT_TPU_TRANSFORMER", "1")
+    monkeypatch.setenv("HEAT_TPU_AUDIT_RATE", "1")
+    monkeypatch.setenv("HEAT_TPU_AUDIT_ACTION", "raise")
+    cfg = tf.TransformerConfig(dtype=dtype, **SMALL)
+    B, S = shape
+    with registry.capture():
+        state = tf.init_state(cfg)
+        x, y = _batch(cfg, B, S, split=split)
+        loss, state = tf.train_step(state, x, y)
+        assert np.isfinite(tf.read_loss(loss))
+        ic = registry.REGISTRY.counter("robustness.integrity")
+        if split is None or B % 8 == 0 or (split == 1 and S % 8 == 0):
+            assert ic.get("audit") >= 1  # the fused chain WAS audited
+        assert ic.get("mismatch") == 0
+
+
+# ------------------------------------------------------------ tuning rails
+def test_mlp_tile_knob_rails():
+    from heat_tpu.tuning import knobs
+
+    k = knobs.get("transformer.mlp.tile")
+    assert k.normalize(128) == 128
+    assert k.default == 128
+    for bad in (7, 9, 4, 8192):
+        with pytest.raises(ValueError):
+            k.normalize(bad)
+
+
+def test_flash_train_tile_knob_rails():
+    from heat_tpu.tuning import knobs
+
+    k = knobs.get("pallas.flash.train_tile")
+    assert k.normalize((128, 128)) == (128, 128)
+    assert k.default == (128, 128)
+    for bad in ((7, 128), (128, 12), (0, 0)):
+        with pytest.raises(ValueError):
+            k.normalize(bad)
+
+
+def test_off_mode_lookup_bomb_inert(monkeypatch, no_faults):
+    """With the tuning gate unset neither the MLP-tile nor the flash
+    train-tile consumer ever reaches ``tuning.lookup`` — and the fused
+    step's result is byte-identical to the pre-knob path."""
+    from heat_tpu import tuning
+    from heat_tpu.core.pallas import flash as pflash
+
+    monkeypatch.setenv("HEAT_TPU_TRANSFORMER", "1")
+    cfg = tf.TransformerConfig(**SMALL)
+    state = tf.init_state(cfg)
+    x, y = _batch(cfg, 4, 16)
+    loss, _ = tf.train_step(state, x, y)
+    base = tf.read_loss(loss)
+
+    def bomb(name, shape_class=None, context=None):  # pragma: no cover
+        raise AssertionError("tuning.lookup reached with the gate unset")
+
+    monkeypatch.setattr(tuning, "lookup", bomb)
+    assert tf._mlp_tile_pref() == 128
+    assert pflash._train_tile_pref(False) is None
+    fusion.clear_cache()
+    state = tf.init_state(cfg)
+    loss, _ = tf.train_step(state, x, y)
+    assert tf.read_loss(loss) == base
+
+
+def test_flash_train_tile_pref_served_when_armed(monkeypatch):
+    """Gate on: the training-shape flash call consults the train-tile knob
+    (context-keyed on interpret) and applies the served pair."""
+    from heat_tpu import tuning
+    from heat_tpu.core.pallas import flash as pflash
+
+    seen = []
+
+    def lookup(name, shape_class=None, context=None):
+        seen.append((name, dict(context or {})))
+        return (64, 64)
+
+    monkeypatch.setattr(tuning, "enabled", lambda: True)
+    monkeypatch.setattr(tuning, "lookup", lookup)
+    assert pflash._train_tile_pref(True) == (64, 64)
+    assert seen == [("pallas.flash.train_tile", {"interpret": True})]
+
+
+# ------------------------------------------------------------- off = inert
+def test_off_knob_train_step_is_eager_reference(no_faults):
+    """Knob off: ``train_step`` never records a fused chain — no
+    transformer flush, no donation, the loss concrete immediately — and
+    still trains (loss falls)."""
+    assert not tf.enabled()
+    with registry.capture():
+        cfg = tf.TransformerConfig(**SMALL)
+        state = tf.init_state(cfg)
+        x, y = _batch(cfg, 4, 16)
+        losses = []
+        for _ in range(3):
+            loss, state = tf.train_step(state, x, y)
+            losses.append(tf.read_loss(loss))
+        reasons = registry.REGISTRY.counter("fusion.flush_reason")
+        tfc = registry.REGISTRY.counter("nn.transformer")
+        assert reasons.get("transformer") == 0
+        assert registry.REGISTRY.counter("fusion.donated").get("buffers") == 0
+        assert tfc.get("step-eager") == 3 and tfc.get("step-fused") == 0
+        assert losses[-1] < losses[0]
+
+
+def test_off_knob_standard_workload_byte_identical(monkeypatch, no_faults):
+    """The off-inertness differential: a standard fused workload's results
+    and compile counts are byte-identical whether the transformer knob is
+    absent or armed — arming it must not perturb non-transformer flushes."""
+
+    def work():
+        x = ht.arange(48, dtype=ht.float32, split=0).reshape((6, 8))
+        y = ht.sin(x * 2.0 + 1.0) / 3.0
+        return np.asarray(y.larray).tobytes()
+
+    monkeypatch.delenv("HEAT_TPU_TRANSFORMER", raising=False)
+    with registry.capture():
+        fusion.clear_cache()
+        base = work()
+        base_compiles = _compiles()
+    registry.reset()
+    monkeypatch.setenv("HEAT_TPU_TRANSFORMER", "1")
+    with registry.capture():
+        fusion.clear_cache()
+        armed = work()
+        armed_compiles = _compiles()
+    assert base == armed
+    assert base_compiles == armed_compiles
+
+
+# --------------------------------------------------- warmup + corpus
+def test_warmup_rebuilds_train_step_from_corpus(monkeypatch, tmp_path,
+                                                tf_on, no_faults):
+    """The app-rebuilder satellite: the recorded train-step sink lands in
+    the L2 shape corpus, and ``serving.warmup`` rebuilds it into a FRESH
+    cache through the registered ``("transformer", opname)`` hooks — zero
+    errors, nothing skipped as unbuildable."""
+    from heat_tpu import serving
+    from heat_tpu.serving import corpus as scorpus
+
+    warm = tmp_path / "warm"
+    cold = tmp_path / "cold"
+    monkeypatch.setenv("HEAT_TPU_CACHE_DIR", str(warm))
+    scorpus._seen.clear()
+    cfg = tf.TransformerConfig(**SMALL)
+    state = tf.init_state(cfg)
+    x, y = _batch(cfg, 4, 16)
+    for _ in range(3):
+        loss, state = tf.train_step(state, x, y)
+        tf.read_loss(loss)
+    assert scorpus.size(str(warm / "corpus")) >= 1
+    stats = serving.warmup(corpus=str(warm / "corpus"), cache_dir=str(cold))
+    assert stats["errors"] == 0
+    assert stats["compiled"] >= 1
+
+
+@pytest.mark.slow
+def test_cross_process_warm_restart_zero_compiles(tmp_path):
+    """ISSUE 20 satellite 6: a restarted worker replaying the train loop
+    against a warmed ``HEAT_TPU_CACHE_DIR`` reaches steady state at ZERO
+    compiles (PR 17/19 precedent, extended to the train-step signature)."""
+    script = (
+        "import numpy as np\n"
+        "from heat_tpu.nn import transformer as tf\n"
+        "from heat_tpu.monitoring import registry\n"
+        "registry.enable()\n"
+        "cfg = tf.TransformerConfig(vocab=32, dim=16, heads=2, depth=1,"
+        " mlp_ratio=2, max_seq=16)\n"
+        "state = tf.init_state(cfg)\n"
+        "rng = np.random.default_rng(5)\n"
+        "x = rng.integers(0, cfg.vocab, (4, 16), dtype=np.int64).astype(np.int32)\n"
+        "y = np.roll(x, -1, axis=1).astype(np.int32)\n"
+        "for _ in range(4):\n"
+        "    loss, state = tf.train_step(state, x, y)\n"
+        "    tf.read_loss(loss)\n"
+        "print('COMPILES', registry.REGISTRY.counter('fusion.kernels_compiled').get())\n"
+    )
+    env = dict(os.environ)
+    env.update({
+        "HEAT_TPU_TRANSFORMER": "1",
+        "HEAT_TPU_FUSION_DONATE": "force",
+        "HEAT_TPU_CACHE_DIR": str(tmp_path / "l2"),
+        "JAX_PLATFORMS": "cpu",
+    })
+    first = subprocess.run(
+        [sys.executable, "-c", script], env=env, capture_output=True,
+        text=True, timeout=300,
+    )
+    assert first.returncode == 0, first.stderr[-2000:]
+    assert "COMPILES" in first.stdout
+    assert "COMPILES 0" not in first.stdout  # the cold process compiled
+    second = subprocess.run(
+        [sys.executable, "-c", script], env=env, capture_output=True,
+        text=True, timeout=300,
+    )
+    assert second.returncode == 0, second.stderr[-2000:]
+    assert "COMPILES 0" in second.stdout
+
+
+# --------------------------------------------------------- trainer legs
+def test_data_parallel_trainer_leg(no_faults):
+    """The DP adapter: TransformerModule's flax-free init/apply under
+    DataParallel trains the tree-form model (loss finite, step counted)."""
+    import optax
+
+    cfg = tf.TransformerConfig(**SMALL)
+    module = tf.TransformerModule(cfg)
+    dp = ht.nn.DataParallel(module, optimizer=optax.sgd(0.1, momentum=0.9))
+    dp.init(0, np.zeros((2, 8), np.int32))
+    dp.make_train_step(tf.tree_loss)
+    x, y = _batch(cfg, 8, 8)
+    losses = [float(dp.train_step(x, y)) for _ in range(3)]
+    assert all(np.isfinite(v) for v in losses)
+    assert dp.step_count == 3
+
+
+@pytest.mark.slow
+def test_daso_two_tier_trainer_leg(no_faults):
+    """The DASO adapter: the hierarchical trainer over the two-tier
+    ICI/DCN comm (local/global split pinned to ``comm.tiers``) trains the
+    same tree-form model."""
+    import optax
+
+    from heat_tpu.core.communication import MeshCommunication
+
+    cfg = tf.TransformerConfig(**SMALL)
+    module = tf.TransformerModule(cfg)
+    comm = MeshCommunication.two_tier(ici=4, dcn=2)
+    daso = ht.optim.DASO(
+        local_optimizer=optax.sgd(0.1, momentum=0.9), total_epochs=1,
+        comm=comm, warmup_epochs=0, cooldown_epochs=0,
+    )
+    assert (daso.nodes, daso.local_size) == (2, 4)
+    daso.init(tf.init_tree(cfg))
+    daso.make_train_step(tf.tree_loss, module.apply)
+    x, y = _batch(cfg, 8, 8)
+    losses = [float(daso.step(x, y)) for _ in range(3)]
+    assert all(np.isfinite(v) for v in losses)
+    assert daso.step_count == 3
+
+
+@pytest.mark.slow
+def test_transformer_smoke_script_passes(tmp_path):
+    """The CI smoke entry point end-to-end: fused steady-state checks plus
+    the elastic kill -9 drain/save/restore-shrunk choreography."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "transformer_smoke.py"),
+         "--steps", "6"],
+        env=env, capture_output=True, text=True, timeout=580,
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+    assert "all checks passed" in proc.stdout
